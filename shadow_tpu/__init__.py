@@ -1,0 +1,34 @@
+"""shadow_tpu — a TPU-native discrete-event network simulation framework.
+
+Capabilities modeled on Shadow (discrete-event network simulator that executes
+real Linux binaries under syscall interposition and connects them through a
+simulated network), re-architected TPU-first:
+
+- Network state is struct-of-arrays over fixed host/socket/event capacities.
+- A simulation round is a pure function ``step(state, window) -> state``
+  compiled once with ``jax.jit`` and executed per conservative time window
+  (reference: src/main/core/manager.c:543-577 round loop).
+- Hosts shard across a ``jax.sharding.Mesh``; cross-shard packet delivery is
+  an XLA collective, the round barrier is a global min-reduction
+  (reference: src/main/core/scheduler/scheduler.c:232 scheduler_push,
+  src/main/core/worker.c:332 min-reduce).
+- The CPU side keeps a native interposition plane (preload shim, shared-memory
+  IPC, syscall emulation) feeding batched event arrays across the host↔device
+  boundary at the Router/Topology seam.
+
+Simulated time is int64 nanoseconds (reference:
+src/main/core/support/simulation_time.rs), so x64 mode is enabled on import.
+Floating-point dtypes remain explicitly float32/bfloat16 throughout the
+package; enabling x64 only widens our integer clocks.
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+from shadow_tpu.core import simtime, units  # noqa: E402
+from shadow_tpu.core.config import Config, load_config  # noqa: E402
+
+__version__ = "0.1.0"
+
+__all__ = ["simtime", "units", "Config", "load_config", "__version__"]

@@ -1,0 +1,354 @@
+"""Experiment configuration: YAML file + programmatic overrides.
+
+Accepts the reference's YAML schema (docs/shadow_config_spec.md;
+src/main/core/support/configuration.rs): ``general``, ``network``,
+``experimental``, ``host_defaults``, and ``hosts.<name>`` with a ``processes``
+list and ``quantity`` expansion. Host defaults merge field-wise into each host
+(configuration.rs:102-108); unknown fields are rejected like serde's
+``deny_unknown_fields``.
+
+Device-facing additions (not in the reference schema) live under
+``experimental``: event pool capacity, per-window event cap, sockets per host
+— the static shapes the TPU engine compiles against.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+from typing import Any, Optional
+
+import yaml
+
+from shadow_tpu.core import units
+
+
+class ConfigError(ValueError):
+    pass
+
+
+def _check_fields(section: str, d: dict, allowed: set[str]) -> None:
+    unknown = set(d) - allowed
+    if unknown:
+        raise ConfigError(f"unknown field(s) in {section}: {sorted(unknown)}")
+
+
+@dataclasses.dataclass
+class GeneralOptions:
+    """docs/shadow_config_spec.md `general` (configuration.rs:129-178)."""
+
+    stop_time: int = 0  # ns
+    seed: int = 1
+    parallelism: int = 1
+    bootstrap_end_time: int = 0  # ns; infinite-bandwidth lossless warmup
+    log_level: str = "info"
+    heartbeat_interval: int = units.parse_time_ns("1 s")
+    data_directory: str = "shadow.data"
+    template_directory: Optional[str] = None
+    progress: bool = False
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "GeneralOptions":
+        _check_fields("general", d, {f.name for f in dataclasses.fields(cls)})
+        out = cls()
+        if "stop_time" not in d:
+            raise ConfigError("general.stop_time is required")
+        out.stop_time = units.parse_time_ns(d["stop_time"])
+        out.seed = int(d.get("seed", out.seed))
+        out.parallelism = int(d.get("parallelism", out.parallelism))
+        out.bootstrap_end_time = units.parse_time_ns(d.get("bootstrap_end_time", 0))
+        out.log_level = str(d.get("log_level", out.log_level))
+        out.heartbeat_interval = units.parse_time_ns(
+            d.get("heartbeat_interval", "1 s")
+        )
+        out.data_directory = str(d.get("data_directory", out.data_directory))
+        td = d.get("template_directory")
+        out.template_directory = None if td is None else str(td)
+        out.progress = bool(d.get("progress", False))
+        return out
+
+
+@dataclasses.dataclass
+class GraphSource:
+    """network.graph: gml file/inline or built-in named graph."""
+
+    type: str = "gml"  # "gml" | "1_gbit_switch"
+    path: Optional[str] = None
+    inline: Optional[str] = None
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "GraphSource":
+        _check_fields("network.graph", d, {"type", "path", "inline", "file"})
+        g = cls(type=str(d.get("type", "gml")))
+        if g.type not in ("gml", "1_gbit_switch"):
+            raise ConfigError(f"unknown network.graph.type {g.type!r}")
+        g.path = d.get("path") or d.get("file")
+        g.inline = d.get("inline")
+        if g.type == "gml" and not (g.path or g.inline):
+            raise ConfigError("network.graph needs `path` or `inline` for type gml")
+        return g
+
+
+# Built-in graph matching the reference's `1_gbit_switch` compiled-in topology.
+ONE_GBIT_SWITCH_GML = """\
+graph [
+  directed 0
+  node [
+    id 0
+    bandwidth_down "1 Gbit"
+    bandwidth_up "1 Gbit"
+  ]
+  edge [
+    source 0
+    target 0
+    latency "1 ms"
+    packet_loss 0.0
+  ]
+]
+"""
+
+
+@dataclasses.dataclass
+class NetworkOptions:
+    """docs/shadow_config_spec.md `network` (configuration.rs:198-209)."""
+
+    graph: GraphSource = dataclasses.field(default_factory=GraphSource)
+    use_shortest_path: bool = True
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "NetworkOptions":
+        _check_fields("network", d, {"graph", "use_shortest_path"})
+        if "graph" not in d:
+            raise ConfigError("network.graph is required")
+        return cls(
+            graph=GraphSource.from_dict(d["graph"]),
+            use_shortest_path=bool(d.get("use_shortest_path", True)),
+        )
+
+
+@dataclasses.dataclass
+class ExperimentalOptions:
+    """Reference experimental flags we honor (configuration.rs:229-340) plus
+    the TPU engine's static-shape knobs."""
+
+    # Reference-compatible:
+    runahead: Optional[int] = None  # ns; None = derive from min topology latency
+    interface_buffer: int = units.parse_bytes("1024000")
+    interface_qdisc: str = "fifo"  # "fifo" | "roundrobin"
+    socket_recv_buffer: int = 174760
+    socket_send_buffer: int = 131072
+    socket_recv_autotune: bool = True
+    socket_send_autotune: bool = True
+    use_memory_manager: bool = True
+    use_seccomp: bool = True
+    use_syscall_counters: bool = False
+    use_object_counters: bool = True
+    worker_threads: Optional[int] = None
+    interpose_method: str = "preload"
+    # TPU engine static shapes:
+    event_capacity: int = 1 << 14  # event-pool rows per shard
+    events_per_host_per_window: int = 32  # K: scan depth of the window kernel
+    sockets_per_host: int = 8
+    router_queue_slots: int = 64  # per-host CoDel ring capacity
+    devices: int = 1  # mesh size over the host axis
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ExperimentalOptions":
+        fields = {f.name for f in dataclasses.fields(cls)}
+        # Accept (and ignore) reference-only flags that have no TPU analog so
+        # reference configs load unmodified.
+        ignored = {
+            "use_cpu_pinning", "use_sched_fifo", "scheduler_policy",
+            "preload_spin_max", "use_explicit_block_message",
+            "use_shim_syscall_handler", "use_o_n_waitpid_workarounds",
+            "use_legacy_working_dir", "max_unapplied_cpu_latency",
+            "host_heartbeat_interval",
+        }
+        _check_fields("experimental", d, fields | ignored)
+        out = cls()
+        if d.get("runahead") is not None:
+            # Bare numbers are seconds (configuration.rs:289 value_name="seconds").
+            out.runahead = units.parse_time_ns(d["runahead"])
+        for name in ("interface_buffer", "socket_recv_buffer", "socket_send_buffer"):
+            if name in d:
+                setattr(out, name, units.parse_bytes(d[name]))
+        for name in (
+            "socket_recv_autotune", "socket_send_autotune", "use_memory_manager",
+            "use_seccomp", "use_syscall_counters", "use_object_counters",
+        ):
+            if name in d:
+                setattr(out, name, bool(d[name]))
+        for name in (
+            "event_capacity", "events_per_host_per_window", "sockets_per_host",
+            "router_queue_slots", "devices",
+        ):
+            if name in d:
+                setattr(out, name, int(d[name]))
+        if "worker_threads" in d and d["worker_threads"] is not None:
+            out.worker_threads = int(d["worker_threads"])
+        if "interface_qdisc" in d:
+            q = str(d["interface_qdisc"]).lower()
+            if q not in ("fifo", "roundrobin", "rr"):
+                raise ConfigError(f"unknown interface_qdisc {q!r}")
+            out.interface_qdisc = "roundrobin" if q in ("roundrobin", "rr") else "fifo"
+        if "interpose_method" in d:
+            out.interpose_method = str(d["interpose_method"])
+        return out
+
+
+@dataclasses.dataclass
+class ProcessOptions:
+    """hosts.<name>.processes[*] (configuration.rs:471-515)."""
+
+    path: str = ""
+    args: list[str] = dataclasses.field(default_factory=list)
+    environment: dict[str, str] = dataclasses.field(default_factory=dict)
+    quantity: int = 1
+    start_time: int = 0  # ns
+    stop_time: Optional[int] = None  # ns
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ProcessOptions":
+        _check_fields(
+            "process", d,
+            {"path", "args", "environment", "quantity", "start_time", "stop_time"},
+        )
+        if "path" not in d:
+            raise ConfigError("process.path is required")
+        args = d.get("args", [])
+        if isinstance(args, str):
+            args = args.split()
+        env = d.get("environment", {}) or {}
+        if isinstance(env, str):
+            env = dict(kv.split("=", 1) for kv in env.split(";") if kv)
+        return cls(
+            path=str(d["path"]),
+            args=[str(a) for a in args],
+            environment={str(k): str(v) for k, v in env.items()},
+            quantity=int(d.get("quantity", 1)),
+            start_time=units.parse_time_ns(d.get("start_time", 0)),
+            stop_time=(
+                units.parse_time_ns(d["stop_time"])
+                if d.get("stop_time") is not None
+                else None
+            ),
+        )
+
+
+@dataclasses.dataclass
+class HostOptions:
+    """hosts.<name> merged with host_defaults (configuration.rs:386-431,498+)."""
+
+    name: str = ""
+    bandwidth_down: Optional[int] = None  # bits/sec; None = from graph vertex
+    bandwidth_up: Optional[int] = None
+    ip_address_hint: Optional[str] = None
+    country_code_hint: Optional[str] = None
+    city_code_hint: Optional[str] = None
+    log_level: Optional[str] = None
+    pcap_directory: Optional[str] = None
+    network_node_id: Optional[int] = None
+    quantity: int = 1
+    processes: list[ProcessOptions] = dataclasses.field(default_factory=list)
+    # Device-side app model (shadow_tpu extension): workloads that run fully
+    # on-device with no managed process — "phold", "udp_flood", "tcp_bulk",
+    # "udp_echo_server", ... with model-specific options.
+    app_model: Optional[str] = None
+    app_options: dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    @classmethod
+    def from_dict(cls, name: str, d: dict, defaults: dict) -> "HostOptions":
+        allowed = {
+            "bandwidth_down", "bandwidth_up", "options", "quantity", "processes",
+            "ip_address_hint", "country_code_hint", "city_code_hint",
+            "log_level", "pcap_directory", "network_node_id",
+            "app_model", "app_options", "heartbeat_interval",
+            "heartbeat_log_info", "heartbeat_log_level",
+        }
+        _check_fields(f"hosts.{name}", d, allowed)
+        merged = dict(defaults)
+        merged.update(d.get("options", {}) or {})
+        merged.update({k: v for k, v in d.items() if k not in ("processes", "options")})
+        out = cls(name=name)
+        if merged.get("bandwidth_down") is not None:
+            out.bandwidth_down = units.parse_bits(merged["bandwidth_down"])
+        if merged.get("bandwidth_up") is not None:
+            out.bandwidth_up = units.parse_bits(merged["bandwidth_up"])
+        for f in (
+            "ip_address_hint", "country_code_hint", "city_code_hint",
+            "log_level", "pcap_directory",
+        ):
+            if merged.get(f) is not None:
+                setattr(out, f, str(merged[f]))
+        if merged.get("network_node_id") is not None:
+            out.network_node_id = int(merged["network_node_id"])
+        out.quantity = int(merged.get("quantity", 1))
+        out.processes = [ProcessOptions.from_dict(p) for p in d.get("processes", [])]
+        if merged.get("app_model") is not None:
+            out.app_model = str(merged["app_model"])
+        out.app_options = dict(merged.get("app_options", {}) or {})
+        return out
+
+    def expand(self) -> list["HostOptions"]:
+        """quantity: N>1 → N hosts named name1..nameN (reference:
+        controller.c:277-280 appends i+1 for every host when quantity > 1)."""
+        if self.quantity <= 1:
+            return [self]
+        out = []
+        for i in range(1, self.quantity + 1):
+            h = dataclasses.replace(self, quantity=1)
+            h.name = f"{self.name}{i}"
+            out.append(h)
+        return out
+
+
+@dataclasses.dataclass
+class Config:
+    general: GeneralOptions
+    network: NetworkOptions
+    experimental: ExperimentalOptions
+    hosts: list[HostOptions]
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Config":
+        _check_fields(
+            "config", d, {"general", "network", "experimental", "host_defaults", "hosts"}
+        )
+        if "general" not in d:
+            raise ConfigError("general section is required")
+        if "network" not in d:
+            raise ConfigError("network section is required")
+        general = GeneralOptions.from_dict(d["general"] or {})
+        network = NetworkOptions.from_dict(d["network"] or {})
+        experimental = ExperimentalOptions.from_dict(d.get("experimental") or {})
+        defaults = d.get("host_defaults") or {}
+        hosts: list[HostOptions] = []
+        for name, hd in (d.get("hosts") or {}).items():
+            hosts.extend(HostOptions.from_dict(str(name), hd or {}, defaults).expand())
+        # Deterministic host ordering regardless of YAML dict order, matching
+        # the reference's BTreeMap iteration (configuration.rs:75-76).
+        hosts.sort(key=lambda h: h.name)
+        return cls(general, network, experimental, hosts)
+
+    def graph_gml(self) -> str:
+        g = self.network.graph
+        if g.type == "1_gbit_switch":
+            return ONE_GBIT_SWITCH_GML
+        if g.inline is not None:
+            return g.inline
+        assert g.path is not None
+        with open(g.path) as f:
+            return f.read()
+
+
+def load_config(source) -> Config:
+    """Load from a YAML path, file object, or string, or a raw dict."""
+    if isinstance(source, dict):
+        return Config.from_dict(source)
+    if isinstance(source, io.IOBase):
+        return Config.from_dict(yaml.safe_load(source))
+    text = str(source)
+    if "\n" in text or text.strip().startswith("{"):
+        return Config.from_dict(yaml.safe_load(text))
+    with open(text) as f:
+        return Config.from_dict(yaml.safe_load(f))

@@ -1,0 +1,44 @@
+"""Deterministic total order over simulation events.
+
+The reference guarantees a total, repeatable event order with the 4-tuple
+(time, dst host id, src host id, per-source sequence number)
+(src/main/core/work/event.c:109-152). We keep exactly that key, as four
+sortable device arrays, and sort lexicographically with ``jax.lax.sort``
+(num_keys=4) — no u128 packing needed, and int64 time stays exact.
+
+Empty event slots carry time == simtime.NEVER so they sort to the end.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def sort_events(time, dst, src, seq, *payload):
+    """Sort event columns by the deterministic key (time, dst, src, seq).
+
+    Returns the same tuple of arrays, reordered. ``payload`` arrays are
+    carried through the sort (values, not keys).
+    """
+    operands = [time, dst, src, seq, *payload]
+    out = jax.lax.sort(operands, num_keys=4, is_stable=True)
+    return tuple(out)
+
+
+def argsort_events(time, dst, src, seq):
+    """Permutation that sorts events by the deterministic key."""
+    idx = jnp.arange(time.shape[0], dtype=jnp.int32)
+    *_, perm = jax.lax.sort([time, dst, src, seq, idx], num_keys=4, is_stable=True)
+    return perm
+
+
+def argsort_events_by_dst(time, dst, src, seq):
+    """Permutation sorting by (dst, time, src, seq).
+
+    Used to build the per-host [H, K] window matrix: events group by
+    destination host, ordered by the deterministic key within each host.
+    """
+    idx = jnp.arange(time.shape[0], dtype=jnp.int32)
+    *_, perm = jax.lax.sort([dst, time, src, seq, idx], num_keys=4, is_stable=True)
+    return perm

@@ -1,0 +1,55 @@
+"""Deterministic seeded randomness hierarchy.
+
+The reference derives per-host RNG streams from a single experiment seed
+(controller seed → manager → per-host nodeSeed; src/main/utility/random.c:15-51,
+src/main/core/manager.c:344, src/main/host/host.c:164) so results are
+reproducible and independent of worker scheduling. We replicate the hierarchy
+with ``jax.random.fold_in``:
+
+    root  = PRNGKey(config seed)
+    host  = fold_in(root, host_id)
+    draw  = fold_in(host, per-host draw counter)
+
+The per-host draw counter lives in device state, so every random decision
+(packet drop rolls, jitter, app payload choices) is a pure function of
+(seed, host_id, counter) — independent of sharding layout and event batching,
+which is what makes the TPU engine bit-deterministic across runs AND across
+mesh shapes.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def root_key(seed: int):
+    return jax.random.PRNGKey(seed)
+
+
+def host_keys(seed: int, num_hosts: int):
+    """[H] key array: one independent stream root per host."""
+    root = root_key(seed)
+    return jax.vmap(lambda i: jax.random.fold_in(root, i))(
+        jnp.arange(num_hosts, dtype=jnp.uint32)
+    )
+
+
+def uniform_per_host(hkeys, counters):
+    """One uniform [0,1) float32 draw per host at the given draw counters.
+
+    hkeys: [H] key array from host_keys(); counters: [H] uint32 per-host draw
+    counters (caller increments after use).
+    """
+    def draw(k, c):
+        return jax.random.uniform(jax.random.fold_in(k, c), dtype=jnp.float32)
+
+    return jax.vmap(draw)(hkeys, counters)
+
+
+def bits_per_host(hkeys, counters):
+    """One uint32 draw per host at the given draw counters."""
+    def draw(k, c):
+        return jax.random.bits(jax.random.fold_in(k, c), dtype=jnp.uint32)
+
+    return jax.vmap(draw)(hkeys, counters)

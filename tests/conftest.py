@@ -1,0 +1,24 @@
+"""Test fixtures: force an 8-device virtual CPU platform.
+
+Multi-chip TPU hardware is not available in CI; the sharding layer is
+validated on a virtual 8-device CPU mesh exactly as the driver's
+dryrun_multichip does.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    return jax.devices()

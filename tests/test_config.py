@@ -1,0 +1,94 @@
+import pytest
+
+from shadow_tpu.core import simtime
+from shadow_tpu.core.config import ConfigError, load_config
+
+PHOLD_LIKE = """
+general:
+  stop_time: 10
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        directed 0
+        node [
+          id 0
+          country_code "US"
+          bandwidth_down "81920 Kibit"
+          bandwidth_up "81920 Kibit"
+        ]
+        edge [
+          source 0
+          target 0
+          latency "50 ms"
+          packet_loss 0.0
+        ]
+      ]
+hosts:
+  peer:
+    quantity: 3
+    processes:
+    - path: test-phold
+      args: loglevel=info quantity=3
+      start_time: 1
+"""
+
+
+def test_load_phold_like():
+    cfg = load_config(PHOLD_LIKE)
+    assert cfg.general.stop_time == 10 * simtime.NS_PER_SEC
+    assert cfg.general.seed == 1
+    # reference names every host name1..nameN when quantity > 1
+    assert [h.name for h in cfg.hosts] == ["peer1", "peer2", "peer3"]
+    assert cfg.hosts[0].processes[0].path == "test-phold"
+    assert cfg.hosts[0].processes[0].start_time == simtime.NS_PER_SEC
+    assert "graph [" in cfg.graph_gml()
+
+
+def test_host_defaults_merge():
+    cfg = load_config(
+        {
+            "general": {"stop_time": "1 s", "seed": 7},
+            "network": {"graph": {"type": "1_gbit_switch"}},
+            "host_defaults": {"bandwidth_down": "10 Mbit", "country_code_hint": "US"},
+            "hosts": {
+                "a": {},
+                "b": {"bandwidth_down": "20 Mbit"},
+            },
+        }
+    )
+    a = next(h for h in cfg.hosts if h.name == "a")
+    b = next(h for h in cfg.hosts if h.name == "b")
+    assert a.bandwidth_down == 10**7
+    assert b.bandwidth_down == 2 * 10**7
+    assert a.country_code_hint == "US"
+    assert b.country_code_hint == "US"
+
+
+def test_unknown_field_rejected():
+    with pytest.raises(ConfigError):
+        load_config(
+            {
+                "general": {"stop_time": 1, "bogus": True},
+                "network": {"graph": {"type": "1_gbit_switch"}},
+            }
+        )
+
+
+def test_required_sections():
+    with pytest.raises(ConfigError):
+        load_config({"network": {"graph": {"type": "1_gbit_switch"}}})
+    with pytest.raises(ConfigError):
+        load_config({"general": {"stop_time": 1}})
+
+
+def test_deterministic_host_order():
+    cfg = load_config(
+        {
+            "general": {"stop_time": 1},
+            "network": {"graph": {"type": "1_gbit_switch"}},
+            "hosts": {"zeta": {}, "alpha": {}, "mid": {}},
+        }
+    )
+    assert [h.name for h in cfg.hosts] == ["alpha", "mid", "zeta"]

@@ -1,0 +1,166 @@
+import numpy as np
+import pytest
+
+from shadow_tpu.core import simtime
+from shadow_tpu.routing.dns import Dns
+from shadow_tpu.routing.gml import GmlParseError, parse_gml
+from shadow_tpu.routing.topology import Topology, TopologyError
+
+SELF_LOOP = """
+graph [
+  directed 0
+  node [ id 0 country_code "US" bandwidth_down "81920 Kibit" bandwidth_up "81920 Kibit" ]
+  edge [ source 0 target 0 latency "50 ms" packet_loss 0.0 ]
+]
+"""
+
+TRIANGLE = """
+graph [
+  directed 0
+  node [ id 0 bandwidth_down "1 Gbit" bandwidth_up "1 Gbit" ]
+  node [ id 1 bandwidth_down "1 Gbit" bandwidth_up "1 Gbit" ]
+  node [ id 2 bandwidth_down "1 Gbit" bandwidth_up "1 Gbit" ]
+  edge [ source 0 target 0 latency "1 ms" ]
+  edge [ source 0 target 1 latency "10 ms" packet_loss 0.1 ]
+  edge [ source 1 target 2 latency "10 ms" packet_loss 0.1 ]
+  edge [ source 0 target 2 latency "100 ms" packet_loss 0.0 ]
+]
+"""
+
+
+def test_parse_gml_basic():
+    g = parse_gml(SELF_LOOP)
+    assert not g.directed
+    assert len(g.nodes) == 1 and len(g.edges) == 1
+    assert g.nodes[0]["country_code"] == "US"
+
+
+def test_parse_gml_bad():
+    with pytest.raises(GmlParseError):
+        parse_gml("nothing here")
+
+
+def test_self_loop_bake():
+    topo = Topology.from_gml(SELF_LOOP)
+    for i in range(4):
+        topo.attach_host(i)
+    baked = topo.bake()
+    assert baked.latency_vv.shape == (1, 1)
+    assert baked.latency_vv[0, 0] == 50 * simtime.NS_PER_MS
+    assert baked.reliability_vv[0, 0] == 1.0
+    assert baked.min_latency_ns == 50 * simtime.NS_PER_MS
+    assert list(baked.host_vertex) == [0, 0, 0, 0]
+
+
+def test_shortest_path_and_reliability():
+    topo = Topology.from_gml(TRIANGLE)
+    topo.attach_host(0)  # vertex 0
+    topo.attach_host(1)  # vertex 1
+    topo.attach_host(2)  # vertex 2
+    baked = topo.bake()
+    # 0→2: via 1 costs 20ms vs direct 100ms → shortest picks 20ms
+    assert baked.latency_vv[0, 2] == 20 * simtime.NS_PER_MS
+    # reliability along 0→1→2 = 0.9 * 0.9
+    assert np.isclose(baked.reliability_vv[0, 2], 0.81, atol=1e-6)
+    # direct edge 0→1
+    assert baked.latency_vv[0, 1] == 10 * simtime.NS_PER_MS
+    # min latency feeds runahead: self-loop 1ms is the min
+    assert baked.min_latency_ns == 1 * simtime.NS_PER_MS
+
+
+def test_direct_edge_mode_requires_edges():
+    topo = Topology.from_gml(TRIANGLE, use_shortest_path=False)
+    topo.attach_host(0, network_node_id=0)
+    topo.attach_host(1, network_node_id=2)
+    baked = topo.bake()  # 0↔2 has a direct edge
+    assert baked.latency_vv[0, 1] == 100 * simtime.NS_PER_MS
+
+    topo2 = Topology.from_gml(
+        """
+        graph [
+          node [ id 0 ]
+          node [ id 1 ]
+          node [ id 2 ]
+          edge [ source 0 target 1 latency "5 ms" ]
+          edge [ source 1 target 2 latency "5 ms" ]
+        ]
+        """,
+        use_shortest_path=False,
+    )
+    topo2.attach_host(0)
+    topo2.attach_host(1)
+    topo2.attach_host(2)
+    baked2 = topo2.bake()
+    # no direct 0↔2 edge → unreachable in direct mode (dropped at send time)
+    assert baked2.latency_vv[0, 2] == np.iinfo(np.int64).max
+    assert baked2.latency_vv[0, 1] == 5 * simtime.NS_PER_MS
+
+
+def test_attach_hints():
+    topo = Topology.from_gml(
+        """
+        graph [
+          node [ id 0 country_code "US" ip_address "1.2.3.4" ]
+          node [ id 1 country_code "DE" ip_address "5.6.7.8" ]
+          edge [ source 0 target 1 latency "5 ms" ]
+          edge [ source 0 target 0 latency "1 ms" ]
+          edge [ source 1 target 1 latency "1 ms" ]
+        ]
+        """
+    )
+    v = topo.attach_host(0, country_code_hint="DE")
+    assert v.id == 1
+    v = topo.attach_host(1, ip_address_hint="1.2.3.4")
+    assert v.id == 0
+    v = topo.attach_host(2)  # round robin over all: index 2 % 2 = 0
+    assert v.id == 0
+
+
+def test_gml_hash_in_string_and_comments():
+    g = parse_gml(
+        """
+        # a leading comment
+        graph [
+          node [ id 0 label "rack#3-us" ]  # trailing comment
+          edge [ source 0 target 0 latency "1 ms" ]
+        ]
+        """
+    )
+    assert g.nodes[0]["label"] == "rack#3-us"
+
+
+def test_bare_latency_is_seconds():
+    # graph spec: bare numeric latency is seconds
+    topo = Topology.from_gml(
+        'graph [ node [ id 0 ] edge [ source 0 target 0 latency 2 ] ]'
+    )
+    topo.attach_host(0)
+    assert topo.bake().latency_vv[0, 0] == 2 * simtime.NS_PER_SEC
+
+
+def test_edge_unknown_node_id():
+    with pytest.raises(TopologyError):
+        Topology.from_gml(
+            'graph [ node [ id 0 ] edge [ source 0 target 5 latency "1 ms" ] ]'
+        )
+
+
+def test_dns_restricted_ranges():
+    dns = Dns()
+    # restricted hints are regenerated like the reference (dns.c:141-142)
+    ip = dns.register(0, "a", ip_hint="127.0.0.2")
+    assert Dns.ip_str(ip) == "11.0.0.1"
+    ip = dns.register(1, "b", ip_hint="224.0.0.1")
+    assert Dns.ip_str(ip) == "11.0.0.2"
+
+
+def test_dns():
+    dns = Dns()
+    ip_a = dns.register(0, "alpha")
+    ip_b = dns.register(1, "beta", ip_hint="11.0.0.50")
+    assert dns.resolve_name("alpha") == ip_a
+    assert dns.ip_str(ip_b) == "11.0.0.50"
+    assert dns.host_for_ip(ip_b) == 1
+    assert dns.resolve_ip(ip_a) == "alpha"
+    ip_c = dns.register(2, "gamma", ip_hint="11.0.0.50")  # taken → sequential
+    assert ip_c != ip_b

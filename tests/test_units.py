@@ -1,0 +1,36 @@
+from shadow_tpu.core import simtime, units
+
+import pytest
+
+
+def test_time_parsing():
+    assert units.parse_time_ns("50 ms") == 50 * simtime.NS_PER_MS
+    assert units.parse_time_ns("10") == 10 * simtime.NS_PER_SEC
+    assert units.parse_time_ns(10) == 10 * simtime.NS_PER_SEC
+    assert units.parse_time_ns("2 min") == 120 * simtime.NS_PER_SEC
+    assert units.parse_time_ns("1.5 s") == 1_500_000_000
+    assert units.parse_time_ns("100 us") == 100_000
+    assert units.parse_time_ns("1 h") == 3600 * simtime.NS_PER_SEC
+    assert units.parse_time_ns("3 ns") == 3
+
+
+def test_bit_parsing():
+    assert units.parse_bits("1 Gbit") == 10**9
+    assert units.parse_bits("81920 Kibit") == 81920 * 1024
+    assert units.parse_bits("10 Mbit") == 10 * 10**6
+    assert units.parse_bits("100") == 100
+    assert units.parse_bits("1 MiB") == 2**20 * 8  # byte bandwidths → bits
+
+
+def test_byte_parsing():
+    assert units.parse_bytes("1 KiB") == 1024
+    assert units.parse_bytes("1 kB") == 1000
+    assert units.parse_bytes("174760") == 174760
+    assert units.parse_bytes(131072) == 131072
+
+
+def test_bad_units():
+    with pytest.raises(units.UnitParseError):
+        units.parse_time_ns("10 parsecs")
+    with pytest.raises(units.UnitParseError):
+        units.parse_bits("nonsense")
