@@ -1,0 +1,588 @@
+"""The batched PDES window kernel and simulation driver.
+
+Reference execution model (src/main/core/manager.c:543-577,
+scheduler/scheduler.c:77-94, controller.c:390-422): time advances in
+conservative windows bounded by the minimum topology latency ("runahead");
+within a window each worker pops its hosts' events in deterministic order
+(time, dst, src, seq — event.c:109-152) and runs them; a barrier plus a
+min-next-event-time reduction ends the round.
+
+TPU-first re-architecture (one jitted pure function per window):
+
+1. EXTRACT — one sort of the event pool by (dst, time, src, seq) builds a
+   per-host ordered matrix [H, K] of this window's events. This replaces all
+   per-host priority queues and their locks.
+2. MICRO-STEP LOOP — a `lax.while_loop` whose body processes AT MOST ONE
+   event per host, fully vectorized across all hosts: candidate = key-min of
+   (matrix head, self-inbox); handlers apply masked SoA updates. Per-host
+   event order is preserved exactly; hosts are data-parallel, which is the
+   same parallelism the reference exploits with worker threads (P1 in
+   SURVEY.md §2.5) — but over lanes instead of pthreads.
+3. The conservative-window invariant (window length ≤ min path latency,
+   controller.c:125-153) guarantees cross-host emissions land at or after
+   window end, so only SELF-emissions (short timers, NIC refills) can need
+   intra-window processing — they go to a small per-host inbox. Everything
+   else accumulates in a per-host outbox (no scatter collisions).
+4. MERGE — outbox + any spilled leftovers are merged into the pool with one
+   sort by time, truncating to capacity (drops counted). The next window
+   start is the min pool time — the reference's min-reduce barrier
+   (worker.c:332-363) becomes a jnp.min.
+
+The whole multi-window run can itself be a `lax.while_loop` on device
+(`Simulation.run_compiled`), so a complete simulation is ONE XLA program.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from shadow_tpu.core import rng as rng_mod
+from shadow_tpu.core import simtime
+from shadow_tpu.core.state import (
+    PAYLOAD_WORDS,
+    Counters,
+    EventPool,
+    HostState,
+    NetParams,
+    SimState,
+    make_host_state,
+)
+
+NEVER = simtime.NEVER
+
+
+# ---------------------------------------------------------------------------
+# Event view + emission interface for handlers
+# ---------------------------------------------------------------------------
+
+
+@struct.dataclass
+class EventView:
+    """The (at most one) event each host is processing this micro-step.
+
+    All arrays are [H]-indexed; the destination host of event i IS host i.
+    ``mask`` is set per handler: valid event AND kind match.
+    """
+
+    mask: jnp.ndarray  # [H] bool
+    time: jnp.ndarray  # [H] i64
+    src: jnp.ndarray  # [H] i32
+    seq: jnp.ndarray  # [H] i32
+    kind: jnp.ndarray  # [H] i32
+    payload: jnp.ndarray  # [H, P] i32
+
+
+class Emission(NamedTuple):
+    mask: jnp.ndarray  # [H] bool — which hosts emit
+    time: jnp.ndarray  # [H] i64
+    dst: jnp.ndarray  # [H] i32
+    kind: jnp.ndarray  # [H] i32 (may be per-host)
+    payload: jnp.ndarray  # [H, P] i32
+
+
+class Emitter:
+    """Collects handler emissions; the engine routes them (inbox/outbox)
+    in collection order, which fixes the per-source sequence numbering."""
+
+    def __init__(self):
+        self.records: list[Emission] = []
+
+    def emit(self, mask, time, dst, kind, payload):
+        kind = jnp.broadcast_to(jnp.asarray(kind, jnp.int32), mask.shape)
+        self.records.append(
+            Emission(mask, time.astype(jnp.int64), dst.astype(jnp.int32), kind, payload)
+        )
+
+
+# handler(state, ev, emitter, params) -> state
+Handler = Callable[[SimState, EventView, Emitter, NetParams], SimState]
+
+
+def draw_uniform(state: SimState, mask):
+    """One deterministic uniform draw per masked host; bumps draw counters
+    only where masked (so inactive hosts' streams don't advance — matching a
+    per-host sequential RNG)."""
+    u = rng_mod.uniform_per_host(state.rng_keys, state.host.rng_counter)
+    new_c = jnp.where(mask, state.host.rng_counter + 1, state.host.rng_counter)
+    state = state.replace(host=state.host.replace(rng_counter=new_c))
+    return state, u
+
+
+# ---------------------------------------------------------------------------
+# Window data structures
+# ---------------------------------------------------------------------------
+
+
+@struct.dataclass
+class _Matrix:
+    time: jnp.ndarray  # [H, K] i64 (NEVER padded)
+    src: jnp.ndarray  # [H, K] i32
+    seq: jnp.ndarray  # [H, K] i32
+    kind: jnp.ndarray  # [H, K] i32
+    payload: jnp.ndarray  # [H, K, P] i32
+
+
+@struct.dataclass
+class _Inbox:
+    time: jnp.ndarray  # [H, B] i64
+    src: jnp.ndarray
+    seq: jnp.ndarray
+    kind: jnp.ndarray
+    payload: jnp.ndarray  # [H, B, P]
+
+    @classmethod
+    def empty(cls, H, B):
+        return cls(
+            time=jnp.full((H, B), NEVER, dtype=jnp.int64),
+            src=jnp.zeros((H, B), dtype=jnp.int32),
+            seq=jnp.zeros((H, B), dtype=jnp.int32),
+            kind=jnp.zeros((H, B), dtype=jnp.int32),
+            payload=jnp.zeros((H, B, PAYLOAD_WORDS), dtype=jnp.int32),
+        )
+
+
+@struct.dataclass
+class _Outbox:
+    time: jnp.ndarray  # [H, O] i64
+    dst: jnp.ndarray
+    src: jnp.ndarray
+    seq: jnp.ndarray
+    kind: jnp.ndarray
+    payload: jnp.ndarray  # [H, O, P]
+    count: jnp.ndarray  # [H] i32
+
+    @classmethod
+    def empty(cls, H, O):
+        return cls(
+            time=jnp.full((H, O), NEVER, dtype=jnp.int64),
+            dst=jnp.zeros((H, O), dtype=jnp.int32),
+            src=jnp.zeros((H, O), dtype=jnp.int32),
+            seq=jnp.zeros((H, O), dtype=jnp.int32),
+            kind=jnp.zeros((H, O), dtype=jnp.int32),
+            payload=jnp.zeros((H, O, PAYLOAD_WORDS), dtype=jnp.int32),
+            count=jnp.zeros((H,), dtype=jnp.int32),
+        )
+
+
+def _extract_window(pool: EventPool, win_end, H: int, K: int):
+    """One sort by (dst, time, src, seq) → per-host ordered [H, K] matrix.
+
+    Events beyond K per host stay in the pool; their keys are strictly larger
+    than every extracted event's, so deferring them to the next window keeps
+    per-host order. Also returns defer_time[H]: the earliest LEFTOVER event
+    time per host (NEVER if none) — self-emissions at or past it must bypass
+    the inbox and go to the pool, otherwise they could be processed ahead of
+    the deferred leftover. (Known tie edge: a leftover and an extracted event
+    at the exact same nanosecond can still invert against a same-time
+    self-emission; requires K overflow + an exact time tie, and K is
+    configurable — tracked for an exact re-extraction fix.)"""
+    C = pool.capacity
+    inwin = pool.time < win_end
+    sort_dst = jnp.where(inwin, pool.dst, jnp.int32(H))
+    idx = jnp.arange(C, dtype=jnp.int32)
+    s_dst, s_time, s_src, s_seq, s_idx = jax.lax.sort(
+        [sort_dst, pool.time, pool.src, pool.seq, idx], num_keys=4, is_stable=True
+    )
+    starts = jnp.searchsorted(s_dst, jnp.arange(H, dtype=jnp.int32)).astype(jnp.int32)
+    pos = jnp.arange(C, dtype=jnp.int32)
+    rank = pos - starts[jnp.clip(s_dst, 0, H - 1)]
+    valid = s_dst < H
+    extract = valid & (rank < K)
+    # Scatter into the matrix; invalid rows target index H → dropped.
+    mrow = jnp.where(extract, s_dst, jnp.int32(H))
+    mcol = jnp.where(extract, rank, 0)
+    gathered_kind = pool.kind[s_idx]
+    gathered_payload = pool.payload[s_idx]
+
+    def scat(init, vals):
+        return init.at[mrow, mcol].set(vals, mode="drop")
+
+    mat = _Matrix(
+        time=scat(jnp.full((H, K), NEVER, dtype=jnp.int64), s_time),
+        src=scat(jnp.zeros((H, K), dtype=jnp.int32), s_src),
+        seq=scat(jnp.zeros((H, K), dtype=jnp.int32), s_seq),
+        kind=scat(jnp.zeros((H, K), dtype=jnp.int32), gathered_kind),
+        payload=jnp.zeros((H, K, PAYLOAD_WORDS), dtype=jnp.int32)
+        .at[mrow, mcol]
+        .set(gathered_payload, mode="drop"),
+    )
+    # Earliest leftover (rank == K) per host; NEVER if the host fit in K.
+    defer_row = jnp.where(valid & (rank == K), s_dst, jnp.int32(H))
+    defer_time = (
+        jnp.full((H,), NEVER, dtype=jnp.int64)
+        .at[defer_row]
+        .set(s_time, mode="drop")
+    )
+    # Free the extracted slots in the pool.
+    clear_idx = jnp.where(extract, s_idx, jnp.int32(C))
+    new_time = pool.time.at[clear_idx].set(NEVER, mode="drop")
+    return mat, pool.replace(time=new_time), defer_time
+
+
+def _inbox_min(inbox: _Inbox):
+    """Per-host lexicographic min of the inbox by (time, src, seq).
+    Returns (time, src, seq, slot) each [H]."""
+    B = inbox.time.shape[1]
+    slot = jnp.broadcast_to(jnp.arange(B, dtype=jnp.int32), inbox.time.shape)
+    t, s, q, i = jax.lax.sort(
+        [inbox.time, inbox.src, inbox.seq, slot], num_keys=3, is_stable=True, dimension=1
+    )
+    return t[:, 0], s[:, 0], q[:, 0], i[:, 0]
+
+
+def _key_lt(t1, s1, q1, t2, s2, q2):
+    """(t1,s1,q1) < (t2,s2,q2) lexicographically (same dst implied)."""
+    return (t1 < t2) | ((t1 == t2) & ((s1 < s2) | ((s1 == s2) & (q1 < q2))))
+
+
+# ---------------------------------------------------------------------------
+# The window step factory
+# ---------------------------------------------------------------------------
+
+
+def make_window_step(
+    handlers: dict[int, Handler],
+    num_hosts: int,
+    K: int = 32,
+    B: int = 8,
+    O: int = 64,
+    max_iters: int | None = None,
+):
+    """Build step(state, params, win_start, win_end) -> (state, min_next).
+
+    ``handlers`` maps event kind → handler; handler order within a micro-step
+    follows ascending kind (fixed, deterministic).
+    """
+    H = num_hosts
+    if max_iters is None:
+        max_iters = K + 4 * B + 16
+    hosts = jnp.arange(H, dtype=jnp.int32)
+    kinds = sorted(handlers)
+
+    def step(state: SimState, params: NetParams, win_start, win_end):
+        win_start = jnp.asarray(win_start, jnp.int64)
+        win_end = jnp.asarray(win_end, jnp.int64)
+        mat, pool, defer_time = _extract_window(state.pool, win_end, H, K)
+        state = state.replace(pool=pool, now=win_start)
+        carry0 = (
+            state,
+            mat,
+            jnp.zeros((H,), dtype=jnp.int32),  # ptr
+            _Inbox.empty(H, B),
+            _Outbox.empty(H, O),
+            jnp.int32(0),  # iteration counter
+            jnp.bool_(True),  # work remaining
+        )
+
+        def cond(carry):
+            _, _, _, _, _, it, work = carry
+            return work & (it < max_iters)
+
+        def body(carry):
+            state, mat, ptr, inbox, outbox, it, _ = carry
+
+            # --- candidate per host: matrix head vs inbox min ---
+            p = jnp.clip(ptr, 0, K - 1)
+            m_time = jnp.take_along_axis(mat.time, p[:, None], axis=1)[:, 0]
+            m_time = jnp.where(ptr < K, m_time, NEVER)
+            m_src = jnp.take_along_axis(mat.src, p[:, None], axis=1)[:, 0]
+            m_seq = jnp.take_along_axis(mat.seq, p[:, None], axis=1)[:, 0]
+            i_time, i_src, i_seq, i_slot = _inbox_min(inbox)
+            use_inbox = _key_lt(i_time, i_src, i_seq, m_time, m_src, m_seq)
+            ev_time = jnp.where(use_inbox, i_time, m_time)
+            valid = ev_time < win_end
+
+            m_kind = jnp.take_along_axis(mat.kind, p[:, None], axis=1)[:, 0]
+            m_payload = jnp.take_along_axis(mat.payload, p[:, None, None], axis=1)[
+                :, 0, :
+            ]
+            i_kind = jnp.take_along_axis(inbox.kind, i_slot[:, None], axis=1)[:, 0]
+            i_payload = jnp.take_along_axis(
+                inbox.payload, i_slot[:, None, None], axis=1
+            )[:, 0, :]
+            ev = EventView(
+                mask=valid,
+                time=ev_time,
+                src=jnp.where(use_inbox, i_src, m_src),
+                seq=jnp.where(use_inbox, i_seq, m_seq),
+                kind=jnp.where(use_inbox, i_kind, m_kind),
+                payload=jnp.where(use_inbox[:, None], i_payload, m_payload),
+            )
+
+            # --- consume the chosen event ---
+            ptr = jnp.where(valid & ~use_inbox, ptr + 1, ptr)
+            clear_slot = jnp.where(valid & use_inbox, i_slot, jnp.int32(B))
+            inbox = inbox.replace(
+                time=inbox.time.at[hosts, clear_slot].set(NEVER, mode="drop")
+            )
+
+            # --- run handlers (ascending kind; masked SoA updates) ---
+            emitter = Emitter()
+            for k in kinds:
+                hev = ev.replace(mask=valid & (ev.kind == k))
+                state = handlers[k](state, hev, emitter, params)
+
+            state = state.replace(
+                counters=state.counters.replace(
+                    events_committed=state.counters.events_committed
+                    + jnp.sum(valid, dtype=jnp.int64)
+                )
+            )
+
+            # --- route emissions (order fixes per-source seq numbers) ---
+            for em in emitter.records:
+                seq = state.host.seq_next
+                state = state.replace(
+                    host=state.host.replace(
+                        seq_next=jnp.where(em.mask, seq + 1, seq)
+                    )
+                )
+                # Self-emissions past the host's earliest deferred leftover
+                # must not jump the queue: route them through the pool.
+                is_self = (
+                    em.mask
+                    & (em.dst == hosts)
+                    & (em.time < win_end)
+                    & (em.time < defer_time)
+                )
+                to_out = em.mask & ~is_self
+
+                free = inbox.time == NEVER  # [H, B]
+                ff = jnp.argmax(free, axis=1).astype(jnp.int32)
+                has_free = jnp.any(free, axis=1)
+                ins_slot = jnp.where(is_self & has_free, ff, jnp.int32(B))
+                inbox = inbox.replace(
+                    time=inbox.time.at[hosts, ins_slot].set(em.time, mode="drop"),
+                    src=inbox.src.at[hosts, ins_slot].set(hosts, mode="drop"),
+                    seq=inbox.seq.at[hosts, ins_slot].set(seq, mode="drop"),
+                    kind=inbox.kind.at[hosts, ins_slot].set(em.kind, mode="drop"),
+                    payload=inbox.payload.at[hosts, ins_slot].set(
+                        em.payload, mode="drop"
+                    ),
+                )
+
+                oslot = jnp.where(
+                    to_out & (outbox.count < O), outbox.count, jnp.int32(O)
+                )
+                outbox = outbox.replace(
+                    time=outbox.time.at[hosts, oslot].set(em.time, mode="drop"),
+                    dst=outbox.dst.at[hosts, oslot].set(em.dst, mode="drop"),
+                    src=outbox.src.at[hosts, oslot].set(hosts, mode="drop"),
+                    seq=outbox.seq.at[hosts, oslot].set(seq, mode="drop"),
+                    kind=outbox.kind.at[hosts, oslot].set(em.kind, mode="drop"),
+                    payload=outbox.payload.at[hosts, oslot].set(
+                        em.payload, mode="drop"
+                    ),
+                    count=outbox.count + (oslot < O).astype(jnp.int32),
+                )
+                state = state.replace(
+                    counters=state.counters.replace(
+                        events_emitted=state.counters.events_emitted
+                        + jnp.sum(em.mask, dtype=jnp.int64),
+                        inbox_overflow_dropped=state.counters.inbox_overflow_dropped
+                        + jnp.sum(is_self & ~has_free, dtype=jnp.int64),
+                        outbox_overflow_dropped=state.counters.outbox_overflow_dropped
+                        + jnp.sum(to_out & (outbox.count >= O) & (oslot >= O),
+                                  dtype=jnp.int64),
+                    )
+                )
+
+            work = jnp.any(valid)
+            return (state, mat, ptr, inbox, outbox, it + 1, work)
+
+        state, mat, ptr, inbox, outbox, _, _ = jax.lax.while_loop(
+            cond, body, carry0
+        )
+
+        # --- merge: pool ∪ outbox ∪ spilled leftovers (inbox/matrix) ---
+        # Leftovers are only non-empty if max_iters capped the loop; their
+        # keys exceed everything processed, so deferring them is still a
+        # correct (if slower) schedule.
+        pool = state.pool
+        C = pool.capacity
+        col = jnp.broadcast_to(jnp.arange(K, dtype=jnp.int32), (H, K))
+        mat_left = col >= ptr[:, None]
+        mat_time_left = jnp.where(mat_left, mat.time, NEVER)
+
+        all_time = jnp.concatenate(
+            [pool.time, outbox.time.reshape(-1), inbox.time.reshape(-1),
+             mat_time_left.reshape(-1)]
+        )
+        hostsK = jnp.broadcast_to(hosts[:, None], (H, K)).reshape(-1)
+        hostsB = jnp.broadcast_to(hosts[:, None], inbox.time.shape).reshape(-1)
+        all_dst = jnp.concatenate(
+            [pool.dst, outbox.dst.reshape(-1), hostsB, hostsK]
+        )
+        all_src = jnp.concatenate(
+            [pool.src, outbox.src.reshape(-1), inbox.src.reshape(-1),
+             mat.src.reshape(-1)]
+        )
+        all_seq = jnp.concatenate(
+            [pool.seq, outbox.seq.reshape(-1), inbox.seq.reshape(-1),
+             mat.seq.reshape(-1)]
+        )
+        all_kind = jnp.concatenate(
+            [pool.kind, outbox.kind.reshape(-1), inbox.kind.reshape(-1),
+             mat.kind.reshape(-1)]
+        )
+        all_payload = jnp.concatenate(
+            [pool.payload, outbox.payload.reshape(-1, PAYLOAD_WORDS),
+             inbox.payload.reshape(-1, PAYLOAD_WORDS),
+             mat.payload.reshape(-1, PAYLOAD_WORDS)]
+        )
+        idx = jnp.arange(all_time.shape[0], dtype=jnp.int32)
+        s_time, s_idx = jax.lax.sort([all_time, idx], num_keys=1, is_stable=True)
+        keep = s_idx[:C]
+        dropped = jnp.sum(s_time[C:] != NEVER, dtype=jnp.int64)
+        new_pool = EventPool(
+            time=s_time[:C],
+            dst=all_dst[keep],
+            src=all_src[keep],
+            seq=all_seq[keep],
+            kind=all_kind[keep],
+            payload=all_payload[keep],
+        )
+        state = state.replace(
+            pool=new_pool,
+            counters=state.counters.replace(
+                pool_overflow_dropped=state.counters.pool_overflow_dropped + dropped
+            ),
+        )
+        min_next = jnp.min(new_pool.time)
+        return state, min_next
+
+    return step
+
+
+# ---------------------------------------------------------------------------
+# Simulation driver (controller/manager analog)
+# ---------------------------------------------------------------------------
+
+
+class Simulation:
+    """Owns the built state + jitted kernels and plays the round loop.
+
+    Construct via shadow_tpu.sim.build_simulation (from a Config) or directly
+    with prebuilt pieces for tests.
+    """
+
+    def __init__(
+        self,
+        *,
+        num_hosts: int,
+        handlers: dict[int, Handler],
+        params: NetParams,
+        host_vertex: np.ndarray,
+        seed: int,
+        stop_time: int,
+        runahead: int,
+        event_capacity: int = 1 << 14,
+        K: int = 32,
+        B: int = 8,
+        O: int = 64,
+        subs: dict | None = None,
+        initial_events: list[tuple[int, int, int, int, list[int]]] | None = None,
+    ):
+        # initial_events: (time, dst, src, kind, payload words)
+        self.num_hosts = num_hosts
+        self.stop_time = int(stop_time)
+        self.runahead = int(runahead)
+        if self.runahead <= 0:
+            raise ValueError("runahead must be > 0 (min topology latency)")
+        self.params = params
+        pool = EventPool.empty(event_capacity)
+        n0 = len(initial_events or [])
+        if n0 > event_capacity:
+            raise ValueError("initial events exceed event pool capacity")
+        if initial_events:
+            # Assign per-source sequence numbers in list order, like the
+            # reference assigns per-source event IDs at push time.
+            seq_ctr: dict[int, int] = {}
+            times, dsts, srcs, seqs, kinds_, pls = [], [], [], [], [], []
+            for (t, d, s, k, pl) in initial_events:
+                q = seq_ctr.get(s, 0)
+                seq_ctr[s] = q + 1
+                times.append(t)
+                dsts.append(d)
+                srcs.append(s)
+                seqs.append(q)
+                kinds_.append(k)
+                row = list(pl) + [0] * (PAYLOAD_WORDS - len(pl))
+                pls.append(row[:PAYLOAD_WORDS])
+            sl = slice(0, n0)
+            pool = pool.replace(
+                time=pool.time.at[sl].set(jnp.asarray(times, jnp.int64)),
+                dst=pool.dst.at[sl].set(jnp.asarray(dsts, jnp.int32)),
+                src=pool.src.at[sl].set(jnp.asarray(srcs, jnp.int32)),
+                seq=pool.seq.at[sl].set(jnp.asarray(seqs, jnp.int32)),
+                kind=pool.kind.at[sl].set(jnp.asarray(kinds_, jnp.int32)),
+                payload=pool.payload.at[sl].set(jnp.asarray(pls, jnp.int32)),
+            )
+            seq_init = np.zeros(num_hosts, dtype=np.int32)
+            for s, q in seq_ctr.items():
+                seq_init[s] = q
+        else:
+            seq_init = np.zeros(num_hosts, dtype=np.int32)
+
+        host = make_host_state(num_hosts, host_vertex)
+        host = host.replace(seq_next=jnp.asarray(seq_init))
+        self.state = SimState(
+            now=jnp.int64(0),
+            pool=pool,
+            host=host,
+            counters=Counters.zeros(),
+            rng_keys=rng_mod.host_keys(seed, num_hosts),
+            subs=subs or {},
+        )
+        step = make_window_step(handlers, num_hosts, K=K, B=B, O=O)
+        self._step = jax.jit(step)
+        self._run_to = jax.jit(self._make_run_to(step))
+
+    def _make_run_to(self, step):
+        runahead = jnp.int64(self.runahead)
+
+        def run_to(state: SimState, params: NetParams, stop):
+            stop = jnp.asarray(stop, jnp.int64)
+
+            def cond(c):
+                state, mn = c
+                return mn < stop
+
+            def body(c):
+                state, mn = c
+                ws = mn
+                we = jnp.minimum(ws + runahead, stop)
+                return step(state, params, ws, we)
+
+            mn0 = jnp.min(state.pool.time)
+            state, _ = jax.lax.while_loop(cond, body, (state, mn0))
+            return state
+
+        return run_to
+
+    # -- host-driven round loop (one device sync per window; debuggable) --
+    def run_stepwise(self, until: int | None = None) -> int:
+        stop = self.stop_time if until is None else min(until, self.stop_time)
+        windows = 0
+        min_next = int(jnp.min(self.state.pool.time))
+        while min_next < stop:
+            ws = min_next
+            we = min(ws + self.runahead, stop)
+            self.state, mn = self._step(self.state, self.params, ws, we)
+            min_next = int(mn)
+            windows += 1
+        return windows
+
+    # -- fully-fused run: the whole simulation is one XLA while_loop --
+    def run(self, until: int | None = None) -> None:
+        stop = self.stop_time if until is None else min(until, self.stop_time)
+        self.state = self._run_to(self.state, self.params, stop)
+
+    def counters(self) -> dict[str, int]:
+        c = jax.device_get(self.state.counters)
+        return {k: int(v) for k, v in c.__dict__.items()}

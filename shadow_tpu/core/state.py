@@ -1,0 +1,140 @@
+"""Device-side simulation state: struct-of-arrays over fixed capacities.
+
+The reference keeps pointer-rich per-host objects (Host owns interfaces,
+router, processes; events live in per-host locked priority queues —
+src/main/host/host.c:49-95, scheduler_policy_host_single.c:18-54). The TPU
+design inverts this: ALL simulation state is flat arrays indexed by host /
+pool-slot / socket, registered as pytrees, and a window step is a pure
+function over them.
+
+Capacities are static (compiled into the kernel):
+    C  event-pool slots per shard
+    K  max events extracted per host per window
+    B  self-inbox slots (intra-window self-emitted events, e.g. short timers)
+    O  outbox slots per host per window (emissions buffered until merge)
+    P  payload words per event (packet header fields)
+Overflow never corrupts the sim: it drops the latest-keyed work and counts it
+in `Counters`, mirroring the reference's drop-and-count philosophy.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from shadow_tpu.core import simtime
+
+# Payload words per event. Layout is defined by shadow_tpu.net.packet.
+PAYLOAD_WORDS = 12
+
+# Event kinds. Handlers register against these (engine.HandlerRegistry).
+KIND_NONE = 0
+KIND_APP_MSG = 1  # app-level message delivery (engine-v1 path, PHOLD)
+KIND_APP_TIMER = 2  # app-defined timer
+KIND_PKT_DELIVER = 3  # packet arrives at dst host's upstream router
+KIND_NIC_REFILL = 4  # token-bucket refill retry (network_interface.c:127-193)
+KIND_TCP_TIMER = 5  # TCP retransmit timeout
+KIND_PROC_SYSCALL = 6  # CPU-plane syscall completion injection
+NUM_KINDS = 7
+
+
+@struct.dataclass
+class EventPool:
+    """Pending events, one row per slot; time == NEVER marks a free slot.
+
+    The deterministic total order (event.c:109-152) is the tuple
+    (time, dst, src, seq); seq is assigned from the emitting host's counter
+    like the reference's per-source event ID.
+    """
+
+    time: jnp.ndarray  # [C] i64 ns
+    dst: jnp.ndarray  # [C] i32 global host index
+    src: jnp.ndarray  # [C] i32
+    seq: jnp.ndarray  # [C] i32
+    kind: jnp.ndarray  # [C] i32
+    payload: jnp.ndarray  # [C, P] i32
+
+    @classmethod
+    def empty(cls, capacity: int) -> "EventPool":
+        return cls(
+            time=jnp.full((capacity,), simtime.NEVER, dtype=jnp.int64),
+            dst=jnp.zeros((capacity,), dtype=jnp.int32),
+            src=jnp.zeros((capacity,), dtype=jnp.int32),
+            seq=jnp.zeros((capacity,), dtype=jnp.int32),
+            kind=jnp.zeros((capacity,), dtype=jnp.int32),
+            payload=jnp.zeros((capacity, PAYLOAD_WORDS), dtype=jnp.int32),
+        )
+
+    @property
+    def capacity(self) -> int:
+        return self.time.shape[0]
+
+
+@struct.dataclass
+class Counters:
+    """Device-side observability counters (reference: tracker.c, counter.rs).
+
+    All [()] i64 scalars summed across the mesh at fetch time.
+    """
+
+    events_committed: jnp.ndarray
+    events_emitted: jnp.ndarray
+    packets_sent: jnp.ndarray
+    packets_delivered: jnp.ndarray
+    packets_dropped_loss: jnp.ndarray  # reliability roll failures (worker.c:539)
+    packets_dropped_unreachable: jnp.ndarray
+    pool_overflow_dropped: jnp.ndarray
+    outbox_overflow_dropped: jnp.ndarray
+    inbox_overflow_dropped: jnp.ndarray
+    bytes_sent: jnp.ndarray
+    bytes_delivered: jnp.ndarray
+
+    @classmethod
+    def zeros(cls) -> "Counters":
+        z = lambda: jnp.zeros((), dtype=jnp.int64)  # noqa: E731
+        return cls(**{f.name: z() for f in dataclasses.fields(cls)})
+
+
+@struct.dataclass
+class HostState:
+    """Per-host scalars the engine itself needs. [H] arrays."""
+
+    seq_next: jnp.ndarray  # i32: next event sequence number for emissions
+    rng_counter: jnp.ndarray  # u32: per-host RNG draw counter
+    vertex: jnp.ndarray  # i32: used-vertex index in the baked topology
+
+
+@struct.dataclass
+class NetParams:
+    """Immutable baked network model (broadcast to all shards)."""
+
+    latency_vv: jnp.ndarray  # [U, U] i64 ns; NEVER = unreachable
+    reliability_vv: jnp.ndarray  # [U, U] f32
+    bootstrap_end: jnp.ndarray  # [] i64: no drops before this time
+    # (configuration.rs:149-152, worker.c:536-545)
+
+
+@struct.dataclass
+class SimState:
+    """Everything a window step reads and writes."""
+
+    now: jnp.ndarray  # [] i64: current window start
+    pool: EventPool
+    host: HostState
+    counters: Counters
+    rng_keys: jnp.ndarray  # [H] per-host PRNG key array (core.rng.host_keys)
+    # Subsystem states keyed by name ("nic", "udp", "tcp", app models...).
+    # A plain dict is a pytree node; handlers look up their own slice.
+    subs: dict[str, Any] = struct.field(default_factory=dict)
+
+
+def make_host_state(num_hosts: int, host_vertex: np.ndarray) -> HostState:
+    return HostState(
+        seq_next=jnp.zeros((num_hosts,), dtype=jnp.int32),
+        rng_counter=jnp.zeros((num_hosts,), dtype=jnp.uint32),
+        vertex=jnp.asarray(host_vertex, dtype=jnp.int32),
+    )

@@ -1,0 +1,52 @@
+"""The flagship on-device workload: PHOLD over a 50ms self-loop link.
+
+This is the reference's PDES canary (src/test/phold/phold.yaml: peers on a
+single-vertex self-loop graph exchanging random-destination messages) scaled
+to arbitrary host counts. Shared by bench.py and __graft_entry__.py so the
+benchmark and the driver's compile checks always exercise the same model.
+"""
+
+from __future__ import annotations
+
+SELF_LOOP_50MS_GML = """\
+graph [
+  node [ id 0 bandwidth_down "81920 Kibit" bandwidth_up "81920 Kibit" ]
+  edge [ source 0 target 0 latency "50 ms" packet_loss 0.0 ]
+]
+"""
+
+
+def build_phold_flagship(
+    num_hosts: int,
+    msgload: int = 2,
+    stop_s: int = 10,
+    runtime_s: int | None = None,
+    event_capacity: int | None = None,
+    K: int | None = None,
+    seed: int = 42,
+):
+    from shadow_tpu.sim import build_simulation
+
+    if runtime_s is None:
+        runtime_s = max(stop_s - 2, 1)
+    if event_capacity is None:
+        event_capacity = max(4 * num_hosts * msgload, 4096)
+    if K is None:
+        K = max(2 * msgload + 4, 8)
+    return build_simulation(
+        {
+            "general": {"stop_time": stop_s, "seed": seed},
+            "network": {"graph": {"type": "gml", "inline": SELF_LOOP_50MS_GML}},
+            "experimental": {
+                "event_capacity": event_capacity,
+                "events_per_host_per_window": K,
+            },
+            "hosts": {
+                "peer": {
+                    "quantity": num_hosts,
+                    "app_model": "phold",
+                    "app_options": {"msgload": msgload, "runtime": runtime_s},
+                }
+            },
+        }
+    )

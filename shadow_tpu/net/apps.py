@@ -1,0 +1,97 @@
+"""Device-side application models.
+
+The reference runs real binaries (test_phold.c, tgen) as managed processes.
+shadow_tpu supports that via the CPU interposition plane, but ALSO offers
+fully on-device app models — vectorized behaviors that generate the same
+traffic patterns with zero CPU↔TPU round-trips. These are the workloads for
+the staged benchmark configs (BASELINE.md) and the analog of the reference's
+PHOLD PDES canary (src/test/phold/test_phold.c: peers exchange
+random-destination messages; msgload seeds circulate until runtime ends).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from shadow_tpu.core import simtime
+from shadow_tpu.core.engine import Emitter, EventView, draw_uniform
+from shadow_tpu.core.state import KIND_APP_MSG, NetParams, SimState
+from shadow_tpu.net import link
+
+
+class PholdApp:
+    """PHOLD: each received message is forwarded to a random peer over the
+    simulated network; message population = hosts × msgload; senders stop
+    once sim time passes `runtime` (phold.yaml args: msgload, size, runtime).
+    """
+
+    SUB = "phold"
+
+    def __init__(
+        self,
+        num_hosts: int,
+        msgload: int = 1,
+        size_bytes: int = 64,
+        start_time: int = simtime.NS_PER_SEC,
+        runtime: int = 5 * simtime.NS_PER_SEC,
+    ):
+        self.num_hosts = num_hosts
+        self.msgload = msgload
+        self.size_bytes = size_bytes
+        self.start_time = start_time
+        self.stop_sending = start_time + runtime
+
+    def init_sub(self) -> dict:
+        H = self.num_hosts
+        return {
+            "received": jnp.zeros((H,), dtype=jnp.int64),
+            "forwarded": jnp.zeros((H,), dtype=jnp.int64),
+        }
+
+    def initial_events(self):
+        """msgload seed messages per host, self-delivered at start_time; the
+        first processing forwards each to a random peer."""
+        out = []
+        for h in range(self.num_hosts):
+            for _ in range(self.msgload):
+                out.append(
+                    (self.start_time, h, h, KIND_APP_MSG, [self.size_bytes])
+                )
+        return out
+
+    def handle_msg(
+        self, state: SimState, ev: EventView, emitter: Emitter, params: NetParams
+    ) -> SimState:
+        H = self.num_hosts
+        hosts = jnp.arange(H, dtype=jnp.int32)
+        sub = state.subs[self.SUB]
+        sub = dict(sub)
+        sub["received"] = sub["received"] + ev.mask.astype(jnp.int64)
+
+        send_mask = ev.mask & (ev.time < self.stop_sending)
+        # Uniform peer choice over the other H-1 hosts.
+        state, u = draw_uniform(state, send_mask)
+        if H > 1:
+            dst = jnp.floor(u * (H - 1)).astype(jnp.int32)
+            dst = jnp.clip(dst, 0, H - 2)
+            dst = dst + (dst >= hosts)  # skip self
+        else:
+            dst = hosts
+        sub["forwarded"] = sub["forwarded"] + send_mask.astype(jnp.int64)
+        subs = dict(state.subs)
+        subs[self.SUB] = sub
+        state = state.replace(subs=subs)
+        return link.send(
+            state,
+            emitter,
+            send_mask,
+            dst,
+            ev.time,
+            KIND_APP_MSG,
+            ev.payload,
+            params,
+            self.size_bytes,
+        )
+
+    def handlers(self):
+        return {KIND_APP_MSG: self.handle_msg}

@@ -1,0 +1,72 @@
+"""Cross-host packet transit — the TPU form of the reference's hot path.
+
+Reference (src/main/core/worker.c:517-576 worker_sendPacket): per packet,
+roll reliability against the path's loss product (skip drops during
+bootstrap, and never drop zero-length control packets), look up path latency,
+and push a delivery event into the destination host's queue.
+
+Here all of that is one vectorized step over every sending host at once:
+two gathers (latency, reliability), one per-host RNG draw, one emission.
+The destination "queue push" is the engine's outbox → pool merge; across a
+mesh it becomes the all_to_all exchange in shadow_tpu.parallel.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from shadow_tpu.core import simtime
+from shadow_tpu.core.engine import Emitter, draw_uniform
+from shadow_tpu.core.state import NetParams, SimState
+
+
+def send(
+    state: SimState,
+    emitter: Emitter,
+    mask,
+    dst_host,
+    now,
+    kind,
+    payload,
+    params: NetParams,
+    size_bytes,
+):
+    """Send one packet per masked host to dst_host, delivering at
+    now + path latency, subject to the path's reliability roll.
+
+    size_bytes == 0 marks a control packet: never dropped by loss
+    (worker.c:543-545 keeps congestion control sane).
+    Returns updated state (counters + RNG advance).
+    """
+    vs = state.host.vertex  # [H]
+    vd = state.host.vertex[dst_host]  # [H]
+    lat = params.latency_vv[vs, vd]
+    rel = params.reliability_vv[vs, vd]
+    reachable = lat != simtime.NEVER
+
+    roll_mask = mask & reachable
+    state, u = draw_uniform(state, roll_mask)
+    in_bootstrap = now < params.bootstrap_end
+    is_control = jnp.asarray(size_bytes) == 0
+    kept = in_bootstrap | is_control | (u < rel)
+    deliver = roll_mask & kept
+
+    emitter.emit(deliver, now + lat, dst_host, kind, payload)
+
+    c = state.counters
+    n_sent = jnp.sum(mask, dtype=jnp.int64)
+    state = state.replace(
+        counters=c.replace(
+            packets_sent=c.packets_sent + n_sent,
+            packets_dropped_loss=c.packets_dropped_loss
+            + jnp.sum(roll_mask & ~kept, dtype=jnp.int64),
+            packets_dropped_unreachable=c.packets_dropped_unreachable
+            + jnp.sum(mask & ~reachable, dtype=jnp.int64),
+            bytes_sent=c.bytes_sent
+            + jnp.sum(
+                jnp.where(mask, jnp.asarray(size_bytes, jnp.int64), 0),
+                dtype=jnp.int64,
+            ),
+        )
+    )
+    return state

@@ -2,12 +2,14 @@
 
 Multi-chip TPU hardware is not available in CI; the sharding layer is
 validated on a virtual 8-device CPU mesh exactly as the driver's
-dryrun_multichip does.
+dryrun_multichip does. The environment's axon site hook pre-registers the
+TPU platform and pins JAX_PLATFORMS=axon, so we must override both the env
+var AND the jax config value before any backend initializes.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -15,6 +17,8 @@ if "xla_force_host_platform_device_count" not in flags:
     ).strip()
 
 import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,294 @@
+"""Engine correctness: PHOLD on-device vs a sequential heapq oracle.
+
+The oracle replays the reference semantics (global event order by
+(time, dst, src, seq); per-host RNG streams) in plain Python. Because the
+engine's randomness is a pure function of (seed, host, draw counter), the
+oracle and the vectorized engine must agree EXACTLY: same delivery counts,
+same drop counts, same per-host draw counters.
+"""
+
+import heapq
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from shadow_tpu.core import rng, simtime
+from shadow_tpu.core.engine import Simulation, draw_uniform
+from shadow_tpu.core.state import (
+    KIND_APP_MSG,
+    KIND_APP_TIMER,
+    NetParams,
+)
+from shadow_tpu.net.apps import PholdApp
+
+MS = simtime.NS_PER_MS
+SEC = simtime.NS_PER_SEC
+
+
+def make_params(H, latency_ns, reliability=1.0, bootstrap_end=0):
+    return NetParams(
+        latency_vv=jnp.full((1, 1), latency_ns, dtype=jnp.int64),
+        reliability_vv=jnp.full((1, 1), reliability, dtype=jnp.float32),
+        bootstrap_end=jnp.int64(bootstrap_end),
+    )
+
+
+def phold_oracle(H, seed, latency_ns, reliability, msgload, start, stop_send, stop):
+    """Sequential reference implementation mirroring the engine bit-for-bit."""
+    hkeys = [jax.random.fold_in(jax.random.PRNGKey(seed), i) for i in range(H)]
+    counters = [0] * H
+    seq_next = [0] * H
+
+    def draw(h):
+        u = float(
+            jax.random.uniform(
+                jax.random.fold_in(hkeys[h], counters[h]), dtype=jnp.float32
+            )
+        )
+        counters[h] += 1
+        return u
+
+    heap = []
+    for h in range(H):
+        for _ in range(msgload):
+            heapq.heappush(heap, (start, h, h, seq_next[h]))
+            seq_next[h] += 1
+    # mirror Simulation.__init__: initial events consume seq in list order
+    received = [0] * H
+    forwarded = [0] * H
+    sent = dropped = 0
+    while heap and heap[0][0] < stop:
+        t, dsth, srch, seq = heapq.heappop(heap)
+        received[dsth] += 1
+        if t < stop_send:
+            u = draw(dsth)
+            # same float math as the engine (f32)
+            dst = int(np.float32(u) * np.float32(H - 1))
+            dst = min(max(dst, 0), H - 2)
+            if dst >= dsth:
+                dst += 1
+            forwarded[dsth] += 1
+            sent += 1
+            u2 = draw(dsth)  # reliability roll (path always reachable here)
+            if u2 < reliability:
+                heapq.heappush(heap, (t + latency_ns, dst, dsth, seq_next[dsth]))
+                seq_next[dsth] += 1
+            else:
+                dropped += 1
+    return {
+        "received": received,
+        "forwarded": forwarded,
+        "sent": sent,
+        "dropped": dropped,
+        "rng_counters": counters,
+    }
+
+
+def build_phold_sim(H, seed, latency_ns, reliability, msgload, runtime, stop):
+    app = PholdApp(
+        H,
+        msgload=msgload,
+        size_bytes=64,
+        start_time=SEC,
+        runtime=runtime,
+    )
+    params = make_params(H, latency_ns, reliability)
+    return (
+        Simulation(
+            num_hosts=H,
+            handlers=app.handlers(),
+            params=params,
+            host_vertex=np.zeros(H, dtype=np.int32),
+            seed=seed,
+            stop_time=stop,
+            runahead=latency_ns,
+            event_capacity=4096,
+            K=16,
+            B=4,
+            O=16,
+            subs={PholdApp.SUB: app.init_sub()},
+            initial_events=app.initial_events(),
+        ),
+        app,
+    )
+
+
+def test_phold_matches_oracle():
+    H, seed = 5, 12345
+    latency, rel, msgload = 50 * MS, 1.0, 2
+    runtime, stop = 5 * SEC, 10 * SEC
+    sim, app = build_phold_sim(H, seed, latency, rel, msgload, runtime, stop)
+    windows = sim.run_stepwise()
+    assert windows > 0
+    oracle = phold_oracle(H, seed, latency, rel, msgload, SEC, SEC + runtime, stop)
+
+    sub = jax.device_get(sim.state.subs[PholdApp.SUB])
+    assert list(sub["received"]) == oracle["received"]
+    assert list(sub["forwarded"]) == oracle["forwarded"]
+    c = sim.counters()
+    assert c["packets_sent"] == oracle["sent"]
+    assert c["packets_dropped_loss"] == oracle["dropped"]
+    assert c["pool_overflow_dropped"] == 0
+    assert c["outbox_overflow_dropped"] == 0
+    assert c["inbox_overflow_dropped"] == 0
+    rng_c = jax.device_get(sim.state.host.rng_counter)
+    assert list(rng_c) == oracle["rng_counters"]
+
+
+def test_phold_lossy_matches_oracle():
+    H, seed = 4, 777
+    latency, rel, msgload = 10 * MS, 0.7, 3
+    runtime, stop = 3 * SEC, 6 * SEC
+    sim, app = build_phold_sim(H, seed, latency, rel, msgload, runtime, stop)
+    sim.run_stepwise()
+    oracle = phold_oracle(H, seed, latency, rel, msgload, SEC, SEC + runtime, stop)
+    sub = jax.device_get(sim.state.subs[PholdApp.SUB])
+    assert list(sub["received"]) == oracle["received"]
+    c = sim.counters()
+    assert c["packets_sent"] == oracle["sent"]
+    assert c["packets_dropped_loss"] == oracle["dropped"]
+
+
+def test_fused_run_matches_stepwise():
+    H, seed = 4, 99
+    sim1, _ = build_phold_sim(H, seed, 50 * MS, 0.9, 1, 3 * SEC, 5 * SEC)
+    sim2, _ = build_phold_sim(H, seed, 50 * MS, 0.9, 1, 3 * SEC, 5 * SEC)
+    sim1.run_stepwise()
+    sim2.run()  # single fused XLA while_loop
+    c1, c2 = sim1.counters(), sim2.counters()
+    assert c1 == c2
+    s1 = jax.device_get(sim1.state.subs[PholdApp.SUB])
+    s2 = jax.device_get(sim2.state.subs[PholdApp.SUB])
+    assert list(s1["received"]) == list(s2["received"])
+
+
+def test_determinism_rerun():
+    """Reference determinism gate: identical configs → identical results
+    (src/test/determinism)."""
+    a, _ = build_phold_sim(6, 31337, 25 * MS, 0.8, 2, 4 * SEC, 8 * SEC)
+    b, _ = build_phold_sim(6, 31337, 25 * MS, 0.8, 2, 4 * SEC, 8 * SEC)
+    a.run()
+    b.run()
+    assert a.counters() == b.counters()
+    sa = jax.device_get(a.state.subs[PholdApp.SUB])
+    sb = jax.device_get(b.state.subs[PholdApp.SUB])
+    assert list(sa["received"]) == list(sb["received"])
+    assert list(sa["forwarded"]) == list(sb["forwarded"])
+
+
+def test_k_overflow_defers_self_emissions_past_leftovers():
+    """When a host overflows K (window matrix full), a self-emission landing
+    AFTER the earliest deferred leftover must not jump the queue via the
+    inbox — it must be processed in timestamp order in a later window."""
+    H = 1
+    T = 8
+
+    def record(state, ev, emitter, params):
+        sub = dict(state.subs["trace"])
+        n = sub["n"]
+        hosts = jnp.arange(H, dtype=jnp.int32)
+        slot = jnp.where(ev.mask, jnp.clip(n, 0, T - 1), T)
+        sub["times"] = sub["times"].at[hosts, slot].set(ev.time, mode="drop")
+        sub["n"] = n + ev.mask.astype(jnp.int32)
+        subs = dict(state.subs)
+        subs["trace"] = sub
+        return state.replace(subs=subs)
+
+    def timer_then_emit(state, ev, emitter, params):
+        state = record(state, ev, emitter, params)
+        hosts = jnp.arange(H, dtype=jnp.int32)
+        # lands at 4ms — after the deferred 3ms leftover
+        emitter.emit(
+            ev.mask, ev.time + 3 * MS, hosts, jnp.int32(KIND_APP_MSG), ev.payload
+        )
+        return state
+
+    params = make_params(H, 50 * MS)
+    sim = Simulation(
+        num_hosts=H,
+        handlers={KIND_APP_TIMER: timer_then_emit, KIND_APP_MSG: record},
+        params=params,
+        host_vertex=np.zeros(H, dtype=np.int32),
+        seed=1,
+        stop_time=SEC,
+        runahead=50 * MS,
+        event_capacity=64,
+        K=2,  # forces the 3ms event to be a leftover
+        B=4,
+        O=8,
+        subs={
+            "trace": {
+                "times": jnp.full((H, T), -1, dtype=jnp.int64),
+                "n": jnp.zeros((H,), dtype=jnp.int32),
+            }
+        },
+        initial_events=[
+            (1 * MS, 0, 0, KIND_APP_TIMER, []),  # emits MSG at 4ms
+            (2 * MS, 0, 0, KIND_APP_MSG, []),
+            (3 * MS, 0, 0, KIND_APP_MSG, []),  # leftover (rank K)
+        ],
+    )
+    sim.run_stepwise()
+    trace = jax.device_get(sim.state.subs["trace"])
+    assert list(trace["times"][0][:4]) == [1 * MS, 2 * MS, 3 * MS, 4 * MS]
+    assert trace["n"][0] == 4
+
+
+def test_intra_window_self_events_processed_in_order():
+    """A self-emitted event landing inside the current window must be
+    processed before later pre-existing events of the same host (the
+    reference's per-host priority queue does this naturally)."""
+    H = 2
+    T = 8
+
+    def record(state, ev, emitter, params):
+        sub = dict(state.subs["trace"])
+        n = sub["n"]
+        hosts = jnp.arange(H, dtype=jnp.int32)
+        slot = jnp.where(ev.mask, jnp.clip(n, 0, T - 1), T)
+        sub["times"] = sub["times"].at[hosts, slot].set(ev.time, mode="drop")
+        sub["n"] = n + ev.mask.astype(jnp.int32)
+        subs = dict(state.subs)
+        subs["trace"] = sub
+        return state.replace(subs=subs)
+
+    def timer_then_emit(state, ev, emitter, params):
+        state = record(state, ev, emitter, params)
+        hosts = jnp.arange(H, dtype=jnp.int32)
+        # self event 2ms later — still inside the 50ms window
+        emitter.emit(
+            ev.mask, ev.time + 2 * MS, hosts, jnp.int32(KIND_APP_MSG), ev.payload
+        )
+        return state
+
+    params = make_params(H, 50 * MS)
+    sim = Simulation(
+        num_hosts=H,
+        handlers={KIND_APP_TIMER: timer_then_emit, KIND_APP_MSG: record},
+        params=params,
+        host_vertex=np.zeros(H, dtype=np.int32),
+        seed=1,
+        stop_time=SEC,
+        runahead=50 * MS,
+        event_capacity=64,
+        K=8,
+        B=4,
+        O=8,
+        subs={
+            "trace": {
+                "times": jnp.full((H, T), -1, dtype=jnp.int64),
+                "n": jnp.zeros((H,), dtype=jnp.int32),
+            }
+        },
+        initial_events=[
+            (1 * MS, 0, 0, KIND_APP_TIMER, []),  # emits self MSG at 3ms
+            (5 * MS, 0, 0, KIND_APP_MSG, []),
+            (5 * MS, 1, 1, KIND_APP_MSG, []),
+        ],
+    )
+    sim.run_stepwise()
+    trace = jax.device_get(sim.state.subs["trace"])
+    assert list(trace["times"][0][:3]) == [1 * MS, 3 * MS, 5 * MS]
+    assert trace["n"][0] == 3
+    assert list(trace["times"][1][:1]) == [5 * MS]
