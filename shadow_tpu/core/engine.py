@@ -350,12 +350,16 @@ def make_window_step(
                     & (em.time < win_end)
                     & (em.time < defer_time)
                 )
-                to_out = em.mask & ~is_self
 
                 free = inbox.time == NEVER  # [H, B]
                 ff = jnp.argmax(free, axis=1).astype(jnp.int32)
                 has_free = jnp.any(free, axis=1)
-                ins_slot = jnp.where(is_self & has_free, ff, jnp.int32(B))
+                ins = is_self & has_free
+                # Inbox overflow DEFERS to the pool via the outbox (processed
+                # next window, late but never lost — a lost NIC pump event
+                # would wedge its queue); the counter records the deferral.
+                to_out = em.mask & ~ins
+                ins_slot = jnp.where(ins, ff, jnp.int32(B))
                 inbox = inbox.replace(
                     time=inbox.time.at[hosts, ins_slot].set(em.time, mode="drop"),
                     src=inbox.src.at[hosts, ins_slot].set(hosts, mode="drop"),
@@ -384,7 +388,7 @@ def make_window_step(
                     counters=state.counters.replace(
                         events_emitted=state.counters.events_emitted
                         + jnp.sum(em.mask, dtype=jnp.int64),
-                        inbox_overflow_dropped=state.counters.inbox_overflow_dropped
+                        inbox_overflow_deferred=state.counters.inbox_overflow_deferred
                         + jnp.sum(is_self & ~has_free, dtype=jnp.int64),
                         outbox_overflow_dropped=state.counters.outbox_overflow_dropped
                         + jnp.sum(to_out & (outbox.count >= O) & (oslot >= O),
@@ -546,22 +550,29 @@ class Simulation:
     def _make_run_to(self, step):
         runahead = jnp.int64(self.runahead)
 
-        def run_to(state: SimState, params: NetParams, stop):
+        def run_to(state: SimState, params: NetParams, stop, max_windows):
+            """Advance up to max_windows windows (or until stop). Bounding
+            the on-device while_loop keeps each dispatch short — long single
+            dispatches can trip accelerator-runtime watchdogs."""
             stop = jnp.asarray(stop, jnp.int64)
+            max_windows = jnp.asarray(max_windows, jnp.int32)
 
             def cond(c):
-                state, mn = c
-                return mn < stop
+                state, mn, w = c
+                return (mn < stop) & (w < max_windows)
 
             def body(c):
-                state, mn = c
+                state, mn, w = c
                 ws = mn
                 we = jnp.minimum(ws + runahead, stop)
-                return step(state, params, ws, we)
+                state, mn = step(state, params, ws, we)
+                return state, mn, w + 1
 
             mn0 = jnp.min(state.pool.time)
-            state, _ = jax.lax.while_loop(cond, body, (state, mn0))
-            return state
+            state, mn, _ = jax.lax.while_loop(
+                cond, body, (state, mn0, jnp.int32(0))
+            )
+            return state, mn
 
         return run_to
 
@@ -578,10 +589,17 @@ class Simulation:
             windows += 1
         return windows
 
-    # -- fully-fused run: the whole simulation is one XLA while_loop --
-    def run(self, until: int | None = None) -> None:
+    # -- fused run: windows execute in on-device while_loop chunks --
+    def run(
+        self, until: int | None = None, windows_per_dispatch: int = 64
+    ) -> None:
         stop = self.stop_time if until is None else min(until, self.stop_time)
-        self.state = self._run_to(self.state, self.params, stop)
+        while True:
+            self.state, mn = self._run_to(
+                self.state, self.params, stop, windows_per_dispatch
+            )
+            if int(mn) >= stop:
+                break
 
     def counters(self) -> dict[str, int]:
         c = jax.device_get(self.state.counters)

@@ -89,7 +89,7 @@ class Counters:
     packets_dropped_unreachable: jnp.ndarray
     pool_overflow_dropped: jnp.ndarray
     outbox_overflow_dropped: jnp.ndarray
-    inbox_overflow_dropped: jnp.ndarray
+    inbox_overflow_deferred: jnp.ndarray
     bytes_sent: jnp.ndarray
     bytes_delivered: jnp.ndarray
 
@@ -130,6 +130,13 @@ class SimState:
     # Subsystem states keyed by name ("nic", "udp", "tcp", app models...).
     # A plain dict is a pytree node; handlers look up their own slice.
     subs: dict[str, Any] = struct.field(default_factory=dict)
+
+    def with_sub(self, key: str, value) -> "SimState":
+        """Functional sub-state update (dict copy; the pytree structure is
+        unchanged so jit caches stay valid)."""
+        subs = dict(self.subs)
+        subs[key] = value
+        return self.replace(subs=subs)
 
 
 def make_host_state(num_hosts: int, host_vertex: np.ndarray) -> HostState:

@@ -15,8 +15,13 @@ import jax.numpy as jnp
 
 from shadow_tpu.core import simtime
 from shadow_tpu.core.engine import Emitter, EventView, draw_uniform
-from shadow_tpu.core.state import KIND_APP_MSG, NetParams, SimState
-from shadow_tpu.net import link
+from shadow_tpu.core.state import (
+    KIND_APP_MSG,
+    KIND_APP_TIMER,
+    NetParams,
+    SimState,
+)
+from shadow_tpu.net import link, packet as pkt
 
 
 class PholdApp:
@@ -78,9 +83,7 @@ class PholdApp:
         else:
             dst = hosts
         sub["forwarded"] = sub["forwarded"] + send_mask.astype(jnp.int64)
-        subs = dict(state.subs)
-        subs[self.SUB] = sub
-        state = state.replace(subs=subs)
+        state = state.with_sub(self.SUB, sub)
         return link.send(
             state,
             emitter,
@@ -95,3 +98,216 @@ class PholdApp:
 
     def handlers(self):
         return {KIND_APP_MSG: self.handle_msg}
+
+
+SERVER_PORT = 9000
+CLIENT_PORT_BASE = 40000
+
+
+class UdpFloodApp:
+    """BASELINE config 2: client hosts flood a server with UDP datagrams at a
+    fixed rate through the full NIC/router/token-bucket path.
+
+    role[h]: 0 = server (binds SERVER_PORT), 1 = client (timer-driven sends).
+    """
+
+    SUB = "udp_flood"
+
+    def __init__(
+        self,
+        num_hosts: int,
+        server_hosts,  # list[int]
+        interval_ns: int,
+        size_bytes: int = 1024,
+        start_time: int = simtime.NS_PER_SEC,
+        stop_sending: int | None = None,
+    ):
+        self.num_hosts = num_hosts
+        self.server_hosts = list(server_hosts)
+        self.interval_ns = int(interval_ns)
+        self.size_bytes = int(size_bytes)
+        if self.size_bytes > pkt.MTU - pkt.UDP_HEADER_BYTES:
+            raise ValueError(
+                f"datagram size {self.size_bytes} exceeds MTU payload "
+                f"{pkt.MTU - pkt.UDP_HEADER_BYTES} (fragmentation unsupported)"
+            )
+        self.start_time = int(start_time)
+        self.stop_sending = stop_sending
+
+    def attach(self, stack):
+        self.stack = stack
+        import numpy as np
+
+        role = np.ones(self.num_hosts, dtype=np.int32)
+        role[self.server_hosts] = 0
+        self._role = jnp.asarray(role)
+        # clients target servers round-robin
+        tgt = np.array(
+            [
+                self.server_hosts[i % len(self.server_hosts)]
+                for i in range(self.num_hosts)
+            ],
+            dtype=np.int32,
+        )
+        self._target = jnp.asarray(tgt)
+        for s in self.server_hosts:
+            stack.bind_udp(s, 0, SERVER_PORT)
+        for h in range(self.num_hosts):
+            if role[h] == 1:
+                stack.bind_udp(h, 0, CLIENT_PORT_BASE)
+
+    def init_sub(self) -> dict:
+        H = self.num_hosts
+        return {
+            "sent": jnp.zeros((H,), jnp.int64),
+            "recv": jnp.zeros((H,), jnp.int64),
+        }
+
+    def initial_events(self):
+        return [
+            (self.start_time, h, h, KIND_APP_TIMER, [])
+            for h in range(self.num_hosts)
+            if int(self._role[h]) == 1
+        ]
+
+    def on_timer(self, state, ev, emitter, params):
+        send = ev.mask & (self._role == 1)
+        if self.stop_sending is not None:
+            send = send & (ev.time < self.stop_sending)
+        sub = dict(state.subs[self.SUB])
+        sub["sent"] = sub["sent"] + send.astype(jnp.int64)
+        state = state.with_sub(self.SUB, sub)
+        state = self.stack.udp_sendto(
+            state, emitter, send, ev.time, self._target, SERVER_PORT,
+            CLIENT_PORT_BASE, self.size_bytes, 0,
+        )
+        hosts = jnp.arange(self.num_hosts, dtype=jnp.int32)
+        emitter.emit(
+            send, ev.time + self.interval_ns, hosts,
+            jnp.int32(KIND_APP_TIMER), ev.payload,
+        )
+        return state
+
+    def on_receive(self, state, mask, slot, src, payload, emitter, now, params):
+        got = mask & (self._role == 0)
+        sub = dict(state.subs[self.SUB])
+        sub["recv"] = sub["recv"] + got.astype(jnp.int64)
+        return state.with_sub(self.SUB, sub)
+
+    def handlers(self):
+        return {KIND_APP_TIMER: self.on_timer}
+
+
+class UdpEchoApp:
+    """BASELINE config 1 analog (tgen-echo style): clients send a datagram to
+    the server every interval; the server echoes it back; clients accumulate
+    round-trip samples. Exercises both directions of the NIC path."""
+
+    SUB = "udp_echo"
+
+    def __init__(
+        self,
+        num_hosts: int,
+        server_host: int,
+        interval_ns: int,
+        size_bytes: int = 512,
+        start_time: int = simtime.NS_PER_SEC,
+        stop_sending: int | None = None,
+    ):
+        self.num_hosts = num_hosts
+        self.server_host = int(server_host)
+        self.interval_ns = int(interval_ns)
+        self.size_bytes = int(size_bytes)
+        if self.size_bytes > pkt.MTU - pkt.UDP_HEADER_BYTES:
+            raise ValueError(
+                f"datagram size {self.size_bytes} exceeds MTU payload "
+                f"{pkt.MTU - pkt.UDP_HEADER_BYTES} (fragmentation unsupported)"
+            )
+        self.start_time = int(start_time)
+        self.stop_sending = stop_sending
+
+    def attach(self, stack):
+        self.stack = stack
+        import numpy as np
+
+        role = np.ones(self.num_hosts, dtype=np.int32)
+        role[self.server_host] = 0
+        self._role = jnp.asarray(role)
+        stack.bind_udp(self.server_host, 0, SERVER_PORT)
+        for h in range(self.num_hosts):
+            if h != self.server_host:
+                stack.bind_udp(h, 0, CLIENT_PORT_BASE)
+
+    def init_sub(self) -> dict:
+        H = self.num_hosts
+        return {
+            "sent": jnp.zeros((H,), jnp.int64),
+            "echoed": jnp.zeros((H,), jnp.int64),
+            "rtt_sum": jnp.zeros((H,), jnp.int64),
+            "rtt_count": jnp.zeros((H,), jnp.int64),
+        }
+
+    def initial_events(self):
+        return [
+            (self.start_time, h, h, KIND_APP_TIMER, [])
+            for h in range(self.num_hosts)
+            if h != self.server_host
+        ]
+
+    def on_timer(self, state, ev, emitter, params):
+        H = self.num_hosts
+        hosts = jnp.arange(H, dtype=jnp.int32)
+        send = ev.mask & (self._role == 1)
+        if self.stop_sending is not None:
+            send = send & (ev.time < self.stop_sending)
+        sub = dict(state.subs[self.SUB])
+        sub["sent"] = sub["sent"] + send.astype(jnp.int64)
+        state = state.with_sub(self.SUB, sub)
+        # The send timestamp travels IN the datagram (spare seq/ack words)
+        # and the server echoes it back — RTT is then exact even when
+        # multiple requests are in flight.
+        req = pkt.make_udp(
+            src_port=jnp.full((H,), CLIENT_PORT_BASE, jnp.int32),
+            dst_port=jnp.full((H,), SERVER_PORT, jnp.int32),
+            length=jnp.full((H,), self.size_bytes, jnp.int32),
+            priority=jnp.zeros((H,), jnp.int32),
+            src_host=hosts,
+            socket_slot=jnp.zeros((H,), jnp.int32),
+        )
+        req = pkt.pack_time(req, jnp.where(send, ev.time, 0))
+        state = self.stack.udp_sendto(
+            state, emitter, send, ev.time,
+            jnp.full((H,), self.server_host, jnp.int32),
+            SERVER_PORT, CLIENT_PORT_BASE, self.size_bytes, 0, payload=req,
+        )
+        emitter.emit(
+            send, ev.time + self.interval_ns, hosts,
+            jnp.int32(KIND_APP_TIMER), ev.payload,
+        )
+        return state
+
+    def on_receive(self, state, mask, slot, src, payload, emitter, now, params):
+        H = self.num_hosts
+        hosts = jnp.arange(H, dtype=jnp.int32)
+        # server: echo back to (src, src_port), preserving the timestamp words
+        server_got = mask & (self._role == 0)
+        sub = dict(state.subs[self.SUB])
+        sub["echoed"] = sub["echoed"] + server_got.astype(jnp.int64)
+        # client: RTT from the echoed timestamp
+        client_got = mask & (self._role == 1)
+        rtt = now - pkt.unpack_time(payload)
+        sub["rtt_sum"] = sub["rtt_sum"] + jnp.where(client_got, rtt, 0)
+        sub["rtt_count"] = sub["rtt_count"] + client_got.astype(jnp.int64)
+        state = state.with_sub(self.SUB, sub)
+        reply = payload
+        reply = reply.at[:, pkt.W_SRC_PORT].set(SERVER_PORT)
+        reply = reply.at[:, pkt.W_DST_PORT].set(payload[:, pkt.W_SRC_PORT])
+        reply = reply.at[:, pkt.W_SRC_HOST].set(hosts)
+        state = self.stack.udp_sendto(
+            state, emitter, server_got, now, src,
+            None, None, None, 0, payload=reply,
+        )
+        return state
+
+    def handlers(self):
+        return {KIND_APP_TIMER: self.on_timer}

@@ -1,0 +1,217 @@
+"""Upstream-router queue with CoDel AQM, vectorized per host.
+
+Reference (src/main/routing/router_queue_codel.c, RFC 8289): packets from
+the simulated network enter the host's upstream-ISP router queue; the NIC
+receive path dequeues them. CoDel tracks per-packet sojourn time; if it
+stays ≥ TARGET (10 ms, Shadow's doubled value) for a full INTERVAL (100 ms),
+the router enters drop mode and drops with increasing frequency per the
+control law, until delays recover.
+
+Differences from the reference, both deliberate:
+- The reference's control law divides the absolute timestamp by sqrt(count)
+  (`(ts + interval)/sqrt(count)`), which for count > 1 produces a
+  next-drop time far in the past and collapses into consecutive drops. We
+  implement the law its own comments cite (RFC 8289):
+  next = ts + interval/sqrt(count).
+- At most DROP_UNROLL packets are dropped per dequeue; a longer drop burst
+  continues on the next pump round (the receive pump re-arms itself while
+  the queue is non-empty), so bursts are spread over same-timestamp
+  micro-steps instead of one call.
+- The queue is a bounded ring (the reference is unbounded); overflow drops
+  are counted separately.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import struct
+
+from shadow_tpu.core import simtime
+from shadow_tpu.core.state import PAYLOAD_WORDS
+from shadow_tpu.net import packet as pkt
+
+TARGET_NS = 10 * simtime.NS_PER_MS
+INTERVAL_NS = 100 * simtime.NS_PER_MS
+DROP_UNROLL = 2
+
+SUB = "router"
+
+
+@struct.dataclass
+class RouterState:
+    # ring [H, Q]
+    q_payload: jnp.ndarray  # [H, Q, P] i32
+    q_src: jnp.ndarray  # [H, Q] i32
+    q_enq_ts: jnp.ndarray  # [H, Q] i64
+    q_head: jnp.ndarray  # [H] i32
+    q_tail: jnp.ndarray  # [H] i32
+    # codel per-host state
+    drop_mode: jnp.ndarray  # [H] bool (False = store)
+    interval_expire: jnp.ndarray  # [H] i64 (0 = unset)
+    next_drop: jnp.ndarray  # [H] i64
+    drop_count: jnp.ndarray  # [H] i32
+    drop_count_last: jnp.ndarray  # [H] i32
+    total_size: jnp.ndarray  # [H] i64 queued wire bytes
+    # counters
+    codel_dropped: jnp.ndarray  # [] i64
+    overflow_dropped: jnp.ndarray  # [] i64
+
+
+def init(num_hosts: int, queue_slots: int = 64) -> RouterState:
+    H, Q = num_hosts, queue_slots
+    z64 = lambda: jnp.zeros((H,), jnp.int64)  # noqa: E731
+    z32 = lambda: jnp.zeros((H,), jnp.int32)  # noqa: E731
+    return RouterState(
+        q_payload=jnp.zeros((H, Q, PAYLOAD_WORDS), jnp.int32),
+        q_src=jnp.zeros((H, Q), jnp.int32),
+        q_enq_ts=jnp.zeros((H, Q), jnp.int64),
+        q_head=z32(),
+        q_tail=z32(),
+        drop_mode=jnp.zeros((H,), bool),
+        interval_expire=z64(),
+        next_drop=z64(),
+        drop_count=z32(),
+        drop_count_last=z32(),
+        total_size=z64(),
+        codel_dropped=jnp.zeros((), jnp.int64),
+        overflow_dropped=jnp.zeros((), jnp.int64),
+    )
+
+
+def enqueue(router: RouterState, mask, payload, src, now) -> RouterState:
+    """router_enqueue (router.c:103-121): append with enqueue timestamp."""
+    H, Q = router.q_src.shape
+    hosts = jnp.arange(H, dtype=jnp.int32)
+    room = (router.q_tail - router.q_head) < Q
+    ok = mask & room
+    slot = jnp.where(ok, router.q_tail % Q, Q)
+    size = pkt.total_bytes(payload).astype(jnp.int64)
+    return router.replace(
+        q_payload=router.q_payload.at[hosts, slot].set(payload, mode="drop"),
+        q_src=router.q_src.at[hosts, slot].set(src.astype(jnp.int32), mode="drop"),
+        q_enq_ts=router.q_enq_ts.at[hosts, slot].set(
+            jnp.broadcast_to(now, (H,)).astype(jnp.int64), mode="drop"
+        ),
+        q_tail=router.q_tail + ok.astype(jnp.int32),
+        total_size=router.total_size + jnp.where(ok, size, 0),
+        overflow_dropped=router.overflow_dropped
+        + jnp.sum(mask & ~room, dtype=jnp.int64),
+    )
+
+
+def _control_law(count, ts):
+    # next = ts + interval/sqrt(count); float64 is fine here — this runs on
+    # [H] scalars a few times per dequeue, not in the packet fast path.
+    inc = jnp.round(
+        INTERVAL_NS / jnp.sqrt(jnp.maximum(count, 1).astype(jnp.float64))
+    ).astype(jnp.int64)
+    return ts + inc
+
+
+def _pop_helper(router: RouterState, now, want):
+    """One masked ring pop with sojourn bookkeeping
+    (_routerqueuecodel_dequeueHelper). Returns
+    (router, have [H], payload [H,P], src [H], ok_to_drop [H])."""
+    H, Q = router.q_src.shape
+    hosts = jnp.arange(H, dtype=jnp.int32)
+    nonempty = router.q_head < router.q_tail
+    have = want & nonempty
+    empty_hit = want & ~nonempty
+
+    slot = router.q_head % Q
+    payload = router.q_payload[hosts, slot]
+    src = router.q_src[hosts, slot]
+    enq_ts = router.q_enq_ts[hosts, slot]
+
+    size = pkt.total_bytes(payload).astype(jnp.int64)
+    new_total = jnp.where(have, router.total_size - size, router.total_size)
+    sojourn = now - enq_ts
+    good = (sojourn < TARGET_NS) | (new_total < pkt.MTU)
+
+    # good state: reset interval expiration
+    interval_expire = jnp.where(have & good, 0, router.interval_expire)
+    # bad state, first time: arm the interval
+    entering_bad = have & ~good & (router.interval_expire == 0)
+    interval_expire = jnp.where(entering_bad, now + INTERVAL_NS, interval_expire)
+    # bad state, sustained a full interval: ok to drop
+    ok_to_drop = have & ~good & (router.interval_expire != 0) & (
+        now >= router.interval_expire
+    )
+    # empty queue resets the interval expiration
+    interval_expire = jnp.where(empty_hit, 0, interval_expire)
+
+    router = router.replace(
+        q_head=router.q_head + have.astype(jnp.int32),
+        total_size=new_total,
+        interval_expire=interval_expire,
+    )
+    return router, have, payload, src, ok_to_drop
+
+
+def dequeue(router: RouterState, now, mask):
+    """CoDel dequeue (_routerqueuecodel_dequeue), one deliverable packet per
+    masked host. Returns (router, have, payload, src)."""
+    router, have, payload, src, ok = _pop_helper(router, now, mask)
+
+    # empty → store mode
+    router = router.replace(
+        drop_mode=jnp.where(mask & ~have, False, router.drop_mode)
+    )
+
+    in_drop = mask & have & router.drop_mode
+    # delays low again → leave drop mode
+    router = router.replace(
+        drop_mode=jnp.where(in_drop & ~ok, False, router.drop_mode)
+    )
+
+    # drop-mode loop: drop while now >= next_drop (bounded unroll).
+    # `ok` tracks the okToDrop verdict of the packet CURRENTLY in hand —
+    # it must follow each re-pop or a fresh low-sojourn packet would be
+    # judged by its dropped predecessor's verdict.
+    for _ in range(DROP_UNROLL):
+        cond = mask & have & router.drop_mode & (now >= router.next_drop)
+        router = router.replace(
+            codel_dropped=router.codel_dropped + jnp.sum(cond, dtype=jnp.int64),
+            drop_count=router.drop_count + cond.astype(jnp.int32),
+        )
+        router, have2, payload2, src2, ok2 = _pop_helper(router, now, cond)
+        have = jnp.where(cond, have2, have)
+        payload = jnp.where(cond[:, None], payload2, payload)
+        src = jnp.where(cond, src2, src)
+        ok = jnp.where(cond, ok2, ok)
+        router = router.replace(
+            next_drop=jnp.where(
+                cond & ok2,
+                _control_law(router.drop_count, router.next_drop),
+                router.next_drop,
+            ),
+            drop_mode=jnp.where(cond & ~ok2, False, router.drop_mode),
+        )
+
+    # store mode but the packet in hand should now drop: drop it, enter
+    # drop mode
+    trans = mask & have & ~router.drop_mode & ok
+    router = router.replace(
+        codel_dropped=router.codel_dropped + jnp.sum(trans, dtype=jnp.int64)
+    )
+    router, have3, payload3, src3, _ok3 = _pop_helper(router, now, trans)
+    have = jnp.where(trans, have3, have)
+    payload = jnp.where(trans[:, None], payload3, payload)
+    src = jnp.where(trans, src3, src)
+    delta = router.drop_count - router.drop_count_last
+    recently = now < (router.next_drop + 16 * INTERVAL_NS)
+    new_count = jnp.where(recently & (delta > 1), delta, 1).astype(jnp.int32)
+    router = router.replace(
+        drop_mode=jnp.where(trans, True, router.drop_mode),
+        drop_count=jnp.where(trans, new_count, router.drop_count),
+        next_drop=jnp.where(
+            trans, _control_law(new_count, jnp.broadcast_to(now, new_count.shape)),
+            router.next_drop,
+        ),
+        drop_count_last=jnp.where(trans, new_count, router.drop_count_last),
+    )
+    return router, have, payload, src
+
+
+def nonempty(router: RouterState):
+    return router.q_head < router.q_tail

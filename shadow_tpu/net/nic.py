@@ -1,0 +1,152 @@
+"""Per-host network interface: token buckets + send queue (qdisc).
+
+Reference (src/main/host/network_interface.c):
+- Token buckets both directions; refill every 1 ms with bytes = bandwidth ×
+  1ms; capacity = one refill + MTU (:99-126, :196-228). A refill task
+  self-reschedules only while traffic is pending (:127-193).
+- Send loop drains the qdisc while send tokens ≥ MTU, consuming each
+  packet's full wire length (:497-539).
+- Receive loop drains the upstream router while rx tokens ≥ MTU (:448-485).
+- During the bootstrap period bandwidth is unlimited (:459-481).
+
+TPU-first differences:
+- Refills are LAZY: effective tokens are recomputed from the 1ms grid
+  (anchored at t=0) whenever the bucket is touched — identical arithmetic to
+  the reference's periodic refill, with no refill events at all. The only
+  scheduled NIC events are send/receive pumps, and those self-defer to the
+  next grid tick when out of tokens.
+- One packet moves per pump event; the pump re-emits itself at the same
+  timestamp while work remains. All hosts pump in parallel each micro-step,
+  so per-window cost is max-packets-per-host, not total packets.
+- The send queue is a single per-host ring ordered FIFO-by-priority
+  (the reference's default fifo qdisc selects by packet app priority);
+  round-robin-over-sockets qdisc is a planned variant.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import struct
+
+from shadow_tpu.core import simtime
+from shadow_tpu.core.state import PAYLOAD_WORDS
+from shadow_tpu.net import packet as pkt
+
+REFILL_NS = simtime.NS_PER_MS  # refill interval (1 ms)
+
+SUB = "nic"
+
+
+@struct.dataclass
+class NicState:
+    # token buckets, bytes
+    tx_rem: jnp.ndarray  # [H] i64
+    rx_rem: jnp.ndarray  # [H] i64
+    tx_tick: jnp.ndarray  # [H] i64 — last refill grid tick applied
+    rx_tick: jnp.ndarray  # [H] i64
+    tx_refill: jnp.ndarray  # [H] i64 bytes per interval
+    rx_refill: jnp.ndarray  # [H] i64
+    tx_cap: jnp.ndarray  # [H] i64 = refill + MTU
+    rx_cap: jnp.ndarray  # [H] i64
+    # send ring [H, NQ]
+    q_payload: jnp.ndarray  # [H, NQ, P] i32
+    q_dst: jnp.ndarray  # [H, NQ] i32
+    q_head: jnp.ndarray  # [H] i32 (monotonic; slot = idx % NQ)
+    q_tail: jnp.ndarray  # [H] i32
+    # pump-pending flags (reference isRefillPending analog for pump events)
+    send_pending: jnp.ndarray  # [H] bool
+    recv_pending: jnp.ndarray  # [H] bool
+    # drop counter for send-ring overflow
+    sendq_dropped: jnp.ndarray  # [] i64
+
+
+def init(bw_up_bits, bw_down_bits, queue_slots: int = 64) -> NicState:
+    """bw_*_bits: [H] int64 bits/sec per host."""
+    H = bw_up_bits.shape[0]
+    tx_refill = jnp.maximum(
+        (jnp.asarray(bw_up_bits, jnp.int64) // 8) * REFILL_NS // simtime.NS_PER_SEC,
+        1,
+    )
+    rx_refill = jnp.maximum(
+        (jnp.asarray(bw_down_bits, jnp.int64) // 8) * REFILL_NS // simtime.NS_PER_SEC,
+        1,
+    )
+    tx_cap = tx_refill + pkt.MTU
+    rx_cap = rx_refill + pkt.MTU
+    NQ = queue_slots
+    return NicState(
+        tx_rem=tx_cap,
+        rx_rem=rx_cap,
+        tx_tick=jnp.zeros((H,), jnp.int64),
+        rx_tick=jnp.zeros((H,), jnp.int64),
+        tx_refill=tx_refill,
+        rx_refill=rx_refill,
+        tx_cap=tx_cap,
+        rx_cap=rx_cap,
+        q_payload=jnp.zeros((H, NQ, PAYLOAD_WORDS), jnp.int32),
+        q_dst=jnp.zeros((H, NQ), jnp.int32),
+        q_head=jnp.zeros((H,), jnp.int32),
+        q_tail=jnp.zeros((H,), jnp.int32),
+        send_pending=jnp.zeros((H,), bool),
+        recv_pending=jnp.zeros((H,), bool),
+        sendq_dropped=jnp.zeros((), jnp.int64),
+    )
+
+
+def lazy_refill(rem, tick, refill, cap, now, mask=None):
+    """Apply all grid refills since `tick` (the reference applies one refill
+    per elapsed interval, clamped to capacity — with capacity ≤ refill+MTU a
+    single interval always fills the bucket, so the clamp form is exact).
+
+    ``mask`` gates which lanes update: handler lanes whose host is not
+    processing a real event carry garbage `now` values (NEVER) and must not
+    touch the bucket state.
+    """
+    now_tick = now // REFILL_NS
+    new_rem = jnp.minimum(cap, rem + (now_tick - tick) * refill)
+    new_rem = jnp.where(now_tick > tick, new_rem, rem)
+    new_tick = jnp.maximum(tick, now_tick)
+    if mask is not None:
+        new_rem = jnp.where(mask, new_rem, rem)
+        new_tick = jnp.where(mask, new_tick, tick)
+    return new_rem, new_tick
+
+
+def next_refill_time(now):
+    return (now // REFILL_NS + 1) * REFILL_NS
+
+
+def enqueue_send(nic: NicState, mask, dst_host, payload) -> tuple[NicState, jnp.ndarray]:
+    """Append a packet to the send ring, FIFO order. Returns (nic, ok_mask).
+
+    Priority-ordered selection: the ring is kept in arrival order, and
+    arrival order IS priority order for device apps (priority = emission
+    sequence), matching the reference's fifo qdisc selection by app priority.
+    """
+    H, NQ = nic.q_dst.shape
+    hosts = jnp.arange(H, dtype=jnp.int32)
+    room = (nic.q_tail - nic.q_head) < NQ
+    ok = mask & room
+    slot = jnp.where(ok, nic.q_tail % NQ, NQ)
+    nic = nic.replace(
+        q_payload=nic.q_payload.at[hosts, slot].set(payload, mode="drop"),
+        q_dst=nic.q_dst.at[hosts, slot].set(dst_host.astype(jnp.int32), mode="drop"),
+        q_tail=nic.q_tail + ok.astype(jnp.int32),
+        sendq_dropped=nic.sendq_dropped + jnp.sum(mask & ~room, dtype=jnp.int64),
+    )
+    return nic, ok
+
+
+def peek_send(nic: NicState):
+    """Head packet per host: (payload [H,P], dst [H], nonempty [H])."""
+    H, NQ = nic.q_dst.shape
+    hosts = jnp.arange(H, dtype=jnp.int32)
+    nonempty = nic.q_head < nic.q_tail
+    slot = nic.q_head % NQ
+    payload = nic.q_payload[hosts, slot]
+    dst = nic.q_dst[hosts, slot]
+    return payload, dst, nonempty
+
+
+def pop_send(nic: NicState, mask) -> NicState:
+    return nic.replace(q_head=nic.q_head + mask.astype(jnp.int32))

@@ -1,0 +1,75 @@
+"""Simulated packet representation: payload-word layout.
+
+The reference's Packet (src/main/routing/packet.c:37-77) carries protocol
+headers (local/UDP/TCP with seq/ack/window/SACK), payload bytes, an app
+priority used by the FIFO qdisc, and a delivery-status trail. On device a
+packet is PAYLOAD_WORDS int32 words riding inside an event row; actual
+payload BYTES are never materialized on device — only lengths (for device
+apps) or CPU-side buffer handles (for managed processes).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from shadow_tpu.core.state import PAYLOAD_WORDS
+
+# word indices
+W_PROTO = 0  # 17 = UDP, 6 = TCP
+W_SRC_PORT = 1
+W_DST_PORT = 2
+W_LEN = 3  # payload bytes
+W_PRIORITY = 4  # app-order priority (qdisc FIFO key, packet.c priority)
+W_FLAGS = 5  # TCP flags
+W_SEQ = 6  # TCP sequence number
+W_ACK = 7  # TCP acknowledgment
+W_WND = 8  # TCP advertised window
+W_SRC_HOST = 9  # global host index of the original sender
+W_SOCKET = 10  # sender-side socket slot (for completions)
+W_HANDLE = 11  # CPU-side payload buffer handle (managed processes)
+
+PROTO_UDP = 17
+PROTO_TCP = 6
+
+# header sizes (IPv4 20 + UDP 8 / TCP 20), matching the reference's
+# packet_getHeaderSize accounting.
+UDP_HEADER_BYTES = 28
+TCP_HEADER_BYTES = 40
+MTU = 1500  # CONFIG_MTU
+
+
+def header_bytes(proto):
+    return jnp.where(proto == PROTO_TCP, TCP_HEADER_BYTES, UDP_HEADER_BYTES)
+
+
+def total_bytes(payload):
+    """Wire size of a packet given its payload words [...,P]."""
+    return payload[..., W_LEN] + header_bytes(payload[..., W_PROTO])
+
+
+def pack_time(payload, t):
+    """Stash an int64 timestamp in the (UDP-unused) seq/ack words."""
+    lo = (t & 0xFFFFFFFF).astype(jnp.uint32).astype(jnp.int32)
+    hi = (t >> 32).astype(jnp.int32)
+    return payload.at[:, W_SEQ].set(lo).at[:, W_ACK].set(hi)
+
+
+def unpack_time(payload):
+    lo = payload[:, W_SEQ].astype(jnp.int64) & 0xFFFFFFFF
+    hi = payload[:, W_ACK].astype(jnp.int64)
+    return (hi << 32) | lo
+
+
+def make_udp(src_port, dst_port, length, priority, src_host, socket_slot=None):
+    """Assemble [H, P] payload words for a UDP datagram (vectorized)."""
+    H = src_port.shape[0]
+    pl = jnp.zeros((H, PAYLOAD_WORDS), dtype=jnp.int32)
+    pl = pl.at[:, W_PROTO].set(PROTO_UDP)
+    pl = pl.at[:, W_SRC_PORT].set(src_port.astype(jnp.int32))
+    pl = pl.at[:, W_DST_PORT].set(dst_port.astype(jnp.int32))
+    pl = pl.at[:, W_LEN].set(length.astype(jnp.int32))
+    pl = pl.at[:, W_PRIORITY].set(priority.astype(jnp.int32))
+    pl = pl.at[:, W_SRC_HOST].set(src_host.astype(jnp.int32))
+    if socket_slot is not None:
+        pl = pl.at[:, W_SOCKET].set(socket_slot.astype(jnp.int32))
+    return pl

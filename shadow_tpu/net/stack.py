@@ -1,0 +1,275 @@
+"""NetStack: composes NIC + router(CoDel) + UDP into engine handlers.
+
+Wiring mirrors the reference's packet path (SURVEY.md §3.4):
+
+  send:    app → udp_sendto → NIC send ring → send pump (tokens, qdisc)
+           → link transit (loss roll + latency) → KIND_PKT_DELIVER event
+  receive: KIND_PKT_DELIVER → router CoDel enqueue → receive pump
+           (rx tokens) → CoDel dequeue → port demux → socket counters
+           → app receive hooks
+
+Loopback traffic (dst == src host) bypasses router and token buckets, like
+the reference's loopback interface which has no upstream router
+(network_interface.c:448-457).
+
+Event kinds used: KIND_PKT_DELIVER, KIND_NIC_SEND (send pump),
+KIND_NIC_REFILL is reused as the receive pump kind (KIND_NIC_RECV alias).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax.numpy as jnp
+
+from shadow_tpu.core.engine import Emitter, EventView
+from shadow_tpu.core.state import (
+    KIND_NIC_REFILL,
+    KIND_PKT_DELIVER,
+    NetParams,
+    SimState,
+)
+from shadow_tpu.net import codel, link, nic, packet as pkt, udp
+
+KIND_NIC_SEND = 100
+KIND_NIC_RECV = KIND_NIC_REFILL
+
+# hook(state, mask, slot, src_host, payload, emitter, now, params) -> state
+RecvHook = Callable
+
+
+class NetStack:
+    def __init__(
+        self,
+        num_hosts: int,
+        bw_up_bits,
+        bw_down_bits,
+        sockets_per_host: int = 8,
+        router_queue_slots: int = 64,
+        nic_queue_slots: int = 64,
+    ):
+        self.num_hosts = num_hosts
+        self._init_nic = nic.init(bw_up_bits, bw_down_bits, nic_queue_slots)
+        self._init_router = codel.init(num_hosts, router_queue_slots)
+        self._init_udp = udp.init(num_hosts, sockets_per_host)
+        self.recv_hooks: list[RecvHook] = []
+
+    # ---- build-time API ----
+
+    def bind_udp(self, host: int, slot: int, port: int, peer_host: int = udp.ANY_PEER,
+                 peer_port: int = 0):
+        self._init_udp = udp.bind_static(
+            self._init_udp, host, slot, port, peer_host, peer_port
+        )
+
+    def on_receive(self, hook: RecvHook):
+        self.recv_hooks.append(hook)
+
+    def init_subs(self) -> dict:
+        return {
+            nic.SUB: self._init_nic,
+            codel.SUB: self._init_router,
+            udp.SUB: self._init_udp,
+        }
+
+    # ---- runtime API (called from app handlers) ----
+
+    def udp_sendto(
+        self,
+        state: SimState,
+        emitter: Emitter,
+        mask,
+        now,
+        dst_host,
+        dst_port,
+        src_port,
+        size_bytes,
+        socket_slot,
+        payload=None,
+    ) -> SimState:
+        """Queue a datagram on the sender's NIC and arm the send pump
+        (transport_sendUserData → socket buffer → networkinterface_wantsSend).
+        Apps may pass a prebuilt [H, P] payload (e.g. carrying timestamps in
+        the spare words); ports/size args are ignored in that case."""
+        H = self.num_hosts
+        hosts = jnp.arange(H, dtype=jnp.int32)
+        if payload is None:
+            payload = pkt.make_udp(
+                src_port=jnp.broadcast_to(jnp.asarray(src_port, jnp.int32), (H,)),
+                dst_port=jnp.broadcast_to(jnp.asarray(dst_port, jnp.int32), (H,)),
+                length=jnp.broadcast_to(jnp.asarray(size_bytes, jnp.int32), (H,)),
+                priority=jnp.zeros((H,), jnp.int32),
+                src_host=hosts,
+                socket_slot=jnp.broadcast_to(
+                    jnp.asarray(socket_slot, jnp.int32), (H,)
+                ),
+            )
+        n = state.subs[nic.SUB]
+        n, ok = nic.enqueue_send(n, mask, dst_host, payload)
+        u = udp.count_sent(
+            state.subs[udp.SUB], ok,
+            jnp.broadcast_to(jnp.asarray(socket_slot, jnp.int32), (H,)), payload,
+        )
+        need = ok & ~n.send_pending
+        emitter.emit(
+            need, jnp.broadcast_to(now, (H,)).astype(jnp.int64), hosts,
+            jnp.int32(KIND_NIC_SEND), jnp.zeros_like(payload),
+        )
+        n = n.replace(send_pending=n.send_pending | need)
+        return state.with_sub(nic.SUB, n).with_sub(udp.SUB, u)
+
+    # ---- engine handlers ----
+
+    def _deliver_local(self, state, mask, src, payload, emitter, now, params):
+        """Demux + deliver + app hooks for packets that reached the NIC."""
+        u = state.subs[udp.SUB]
+        is_udp = mask & (payload[:, pkt.W_PROTO] == pkt.PROTO_UDP)
+        slot, found = udp.demux(u, is_udp, payload, src)
+        u = udp.deliver(u, found, slot, payload)
+        u = u.replace(
+            drop_no_socket=u.drop_no_socket + jnp.sum(is_udp & ~found, dtype=jnp.int64)
+        )
+        c = state.counters
+        state = state.replace(
+            counters=c.replace(
+                packets_delivered=c.packets_delivered + jnp.sum(found, dtype=jnp.int64),
+                bytes_delivered=c.bytes_delivered
+                + jnp.sum(
+                    jnp.where(found, payload[:, pkt.W_LEN].astype(jnp.int64), 0)
+                ),
+            )
+        )
+        state = state.with_sub(udp.SUB, u)
+        for hook in self.recv_hooks:
+            state = hook(state, found, slot, src, payload, emitter, now, params)
+        return state
+
+    def on_pkt_deliver(
+        self, state: SimState, ev: EventView, emitter: Emitter, params: NetParams
+    ) -> SimState:
+        """Packet arrives at the destination: remote traffic enters the
+        upstream router (CoDel); loopback skips straight to the socket."""
+        H = self.num_hosts
+        hosts = jnp.arange(H, dtype=jnp.int32)
+        now = ev.time
+        loopback = ev.mask & (ev.src == hosts)
+        remote = ev.mask & (ev.src != hosts)
+
+        r = codel.enqueue(state.subs[codel.SUB], remote, ev.payload, ev.src, now)
+        state = state.with_sub(codel.SUB, r)
+
+        state = self._deliver_local(
+            state, loopback, ev.src, ev.payload, emitter, now, params
+        )
+
+        n = state.subs[nic.SUB]
+        need = remote & ~n.recv_pending
+        emitter.emit(
+            need, now, hosts, jnp.int32(KIND_NIC_RECV),
+            jnp.zeros_like(ev.payload),
+        )
+        n = n.replace(recv_pending=n.recv_pending | need)
+        return state.with_sub(nic.SUB, n)
+
+    def on_nic_send(
+        self, state: SimState, ev: EventView, emitter: Emitter, params: NetParams
+    ) -> SimState:
+        """Send pump: one packet per invocation while tokens allow; re-arms
+        itself at `now` (more tokens) or the next refill tick (exhausted)."""
+        H = self.num_hosts
+        hosts = jnp.arange(H, dtype=jnp.int32)
+        now = ev.time
+        mask = ev.mask
+        n = state.subs[nic.SUB]
+        n = n.replace(send_pending=n.send_pending & ~mask)
+
+        tx_rem, tx_tick = nic.lazy_refill(
+            n.tx_rem, n.tx_tick, n.tx_refill, n.tx_cap, now, mask
+        )
+        n = n.replace(tx_rem=tx_rem, tx_tick=tx_tick)
+
+        payload, dst, has_pkt = nic.peek_send(n)
+        bootstrap = now < params.bootstrap_end
+        can = bootstrap | (n.tx_rem >= pkt.MTU)
+        do = mask & has_pkt & can
+
+        # Charge the FULL wire size (may go negative — token debt). For
+        # MTU-conformant packets this is identical to the reference's
+        # clamp-at-zero (rem ≥ MTU ≥ size when the gate passes); for
+        # oversize packets debt prevents exceeding configured bandwidth.
+        size = pkt.total_bytes(payload).astype(jnp.int64)
+        n = n.replace(
+            tx_rem=jnp.where(do & ~bootstrap, n.tx_rem - size, n.tx_rem)
+        )
+        n = nic.pop_send(n, do)
+        state = state.with_sub(nic.SUB, n)
+
+        remote = do & (dst != hosts)
+        state = link.send(
+            state, emitter, remote, dst, now, KIND_PKT_DELIVER, payload, params,
+            jnp.where(remote, size, 0),
+        )
+        # loopback: deliver at the same timestamp, no transit
+        lb = do & (dst == hosts)
+        emitter.emit(lb, now, hosts, jnp.int32(KIND_PKT_DELIVER), payload)
+
+        n = state.subs[nic.SUB]
+        still = n.q_head < n.q_tail
+        need = mask & still
+        can_next = bootstrap | (n.tx_rem >= pkt.MTU)
+        t_next = jnp.where(can_next, now, nic.next_refill_time(now))
+        emitter.emit(
+            need, t_next, hosts, jnp.int32(KIND_NIC_SEND), jnp.zeros_like(payload)
+        )
+        n = n.replace(send_pending=n.send_pending | need)
+        return state.with_sub(nic.SUB, n)
+
+    def on_nic_recv(
+        self, state: SimState, ev: EventView, emitter: Emitter, params: NetParams
+    ) -> SimState:
+        """Receive pump: CoDel-dequeue one packet per invocation while rx
+        tokens allow; re-arms while the router queue is non-empty."""
+        H = self.num_hosts
+        hosts = jnp.arange(H, dtype=jnp.int32)
+        now = ev.time
+        mask = ev.mask
+        n = state.subs[nic.SUB]
+        n = n.replace(recv_pending=n.recv_pending & ~mask)
+
+        rx_rem, rx_tick = nic.lazy_refill(
+            n.rx_rem, n.rx_tick, n.rx_refill, n.rx_cap, now, mask
+        )
+        n = n.replace(rx_rem=rx_rem, rx_tick=rx_tick)
+
+        bootstrap = now < params.bootstrap_end
+        can = bootstrap | (n.rx_rem >= pkt.MTU)
+        want = mask & can
+
+        r = state.subs[codel.SUB]
+        r, have, payload, src = codel.dequeue(r, now, want)
+        size = pkt.total_bytes(payload).astype(jnp.int64)
+        n = n.replace(
+            rx_rem=jnp.where(have & ~bootstrap, n.rx_rem - size, n.rx_rem)
+        )
+        state = state.with_sub(codel.SUB, r).with_sub(nic.SUB, n)
+
+        state = self._deliver_local(state, have, src, payload, emitter, now, params)
+
+        n = state.subs[nic.SUB]
+        r = state.subs[codel.SUB]
+        still = codel.nonempty(r)
+        need = mask & still
+        can_next = bootstrap | (n.rx_rem >= pkt.MTU)
+        t_next = jnp.where(can_next, now, nic.next_refill_time(now))
+        emitter.emit(
+            need, t_next, hosts, jnp.int32(KIND_NIC_RECV), jnp.zeros_like(payload)
+        )
+        n = n.replace(recv_pending=n.recv_pending | need)
+        return state.with_sub(nic.SUB, n)
+
+    def handlers(self) -> dict:
+        return {
+            KIND_PKT_DELIVER: self.on_pkt_deliver,
+            KIND_NIC_SEND: self.on_nic_send,
+            KIND_NIC_RECV: self.on_nic_recv,
+        }

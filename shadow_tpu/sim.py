@@ -15,7 +15,8 @@ from shadow_tpu.core import simtime, units
 from shadow_tpu.core.config import Config, load_config
 from shadow_tpu.core.engine import Simulation
 from shadow_tpu.core.state import NetParams
-from shadow_tpu.net.apps import PholdApp
+from shadow_tpu.net.apps import PholdApp, UdpEchoApp, UdpFloodApp
+from shadow_tpu.net.stack import NetStack
 from shadow_tpu.routing.dns import Dns
 from shadow_tpu.routing.topology import BakedPaths, Topology
 
@@ -92,7 +93,82 @@ def build_simulation(source) -> Simulation:
         handlers.update(app.handlers())
         subs[PholdApp.SUB] = app.init_sub()
         initial_events.extend(app.initial_events())
-    unknown = app_names - {"phold"}
+
+    stack_apps = app_names & {"udp_flood", "udp_echo"}
+    if stack_apps:
+        if len(stack_apps) > 1 or "phold" in app_names:
+            raise BuildError("only one app model per simulation for now")
+        name = next(iter(stack_apps))
+        roles = {}
+        client_opts = None
+        for i, h in enumerate(cfg.hosts):
+            if h.app_model != name:
+                raise BuildError(f"{name} requires every host to run it")
+            roles[i] = str(h.app_options.get("role", "client"))
+            if roles[i] == "client":
+                o = {k: v for k, v in h.app_options.items() if k != "role"}
+                if client_opts is None:
+                    client_opts = o
+                elif client_opts != o:
+                    raise BuildError(
+                        f"{name} client app_options must be identical"
+                    )
+        servers = [i for i, r in roles.items() if r == "server"]
+        if not servers:
+            raise BuildError(f"{name} needs at least one role: server host")
+        client_opts = client_opts or {}
+
+        # per-host bandwidths: host override, else attachment vertex's
+        bw_up = np.zeros(H, dtype=np.int64)
+        bw_down = np.zeros(H, dtype=np.int64)
+        for i, h in enumerate(cfg.hosts):
+            v = baked.host_vertex[i]
+            bw_up[i] = h.bandwidth_up or baked.vertex_bw_up_bits[v]
+            bw_down[i] = h.bandwidth_down or baked.vertex_bw_down_bits[v]
+            if bw_up[i] <= 0 or bw_down[i] <= 0:
+                raise BuildError(
+                    f"host {h.name}: no bandwidth configured (host or graph "
+                    f"vertex must set bandwidth_up/down)"
+                )
+        stack = NetStack(
+            H,
+            jnp.asarray(bw_up),
+            jnp.asarray(bw_down),
+            sockets_per_host=cfg.experimental.sockets_per_host,
+            router_queue_slots=cfg.experimental.router_queue_slots,
+        )
+        interval = units.parse_time_ns(
+            client_opts.get("interval", "100 ms"), default_unit="ms"
+        )
+        start = units.parse_time_ns(client_opts.get("start_time", 1))
+        stop_send = (
+            units.parse_time_ns(client_opts["runtime"]) + start
+            if "runtime" in client_opts
+            else None
+        )
+        if name == "udp_flood":
+            app = UdpFloodApp(
+                H, servers, interval,
+                size_bytes=int(client_opts.get("size", 1024)),
+                start_time=start, stop_sending=stop_send,
+            )
+        else:
+            if len(servers) != 1:
+                raise BuildError("udp_echo supports exactly one server host")
+            app = UdpEchoApp(
+                H, servers[0], interval,
+                size_bytes=int(client_opts.get("size", 512)),
+                start_time=start, stop_sending=stop_send,
+            )
+        app.attach(stack)
+        stack.on_receive(app.on_receive)
+        handlers.update(stack.handlers())
+        handlers.update(app.handlers())
+        subs.update(stack.init_subs())
+        subs[app.SUB] = app.init_sub()
+        initial_events.extend(app.initial_events())
+
+    unknown = app_names - {"phold", "udp_flood", "udp_echo"}
     if unknown:
         raise BuildError(f"unknown app model(s): {sorted(unknown)}")
 

@@ -131,7 +131,7 @@ def test_phold_matches_oracle():
     assert c["packets_dropped_loss"] == oracle["dropped"]
     assert c["pool_overflow_dropped"] == 0
     assert c["outbox_overflow_dropped"] == 0
-    assert c["inbox_overflow_dropped"] == 0
+    assert c["inbox_overflow_deferred"] == 0
     rng_c = jax.device_get(sim.state.host.rng_counter)
     assert list(rng_c) == oracle["rng_counters"]
 

@@ -1,0 +1,280 @@
+"""Network-stack tests: token buckets, CoDel, UDP echo/flood end-to-end."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from shadow_tpu.core import simtime
+from shadow_tpu.net import codel, nic, packet as pkt
+from shadow_tpu.sim import build_simulation
+
+MS = simtime.NS_PER_MS
+SEC = simtime.NS_PER_SEC
+
+GML_2V = """
+graph [
+  node [ id 0 bandwidth_down "1 Gbit" bandwidth_up "1 Gbit" ]
+  node [ id 1 bandwidth_down "1 Gbit" bandwidth_up "1 Gbit" ]
+  edge [ source 0 target 0 latency "1 ms" ]
+  edge [ source 1 target 1 latency "1 ms" ]
+  edge [ source 0 target 1 latency "50 ms" packet_loss 0.0 ]
+]
+"""
+
+
+# ---------------------------------------------------------------------------
+# unit: token bucket lazy refill
+# ---------------------------------------------------------------------------
+
+
+def test_lazy_refill_grid():
+    rem = jnp.asarray([0, 500], dtype=jnp.int64)
+    tick = jnp.asarray([0, 0], dtype=jnp.int64)
+    refill = jnp.asarray([1000, 1000], dtype=jnp.int64)
+    cap = refill + pkt.MTU
+    # at t = 3.5ms, 3 grid ticks elapsed → +3000, clamped to cap
+    new_rem, new_tick = nic.lazy_refill(rem, tick, refill, cap, jnp.int64(3_500_000))
+    assert list(new_rem) == [min(3000, 2500), 2500]
+    assert list(new_tick) == [3, 3]
+    # no time passed → unchanged
+    r2, t2 = nic.lazy_refill(new_rem, new_tick, refill, cap, jnp.int64(3_600_000))
+    assert list(r2) == list(new_rem)
+
+
+def test_next_refill_time():
+    assert int(nic.next_refill_time(jnp.int64(0))) == MS
+    assert int(nic.next_refill_time(jnp.int64(MS - 1))) == MS
+    assert int(nic.next_refill_time(jnp.int64(MS))) == 2 * MS
+
+
+# ---------------------------------------------------------------------------
+# unit: CoDel dequeue law
+# ---------------------------------------------------------------------------
+
+
+def _mk_router(H=1, Q=32):
+    return codel.init(H, Q)
+
+
+def _payload(size=1472):
+    p = jnp.zeros((1, 12), dtype=jnp.int32)
+    p = p.at[0, pkt.W_PROTO].set(pkt.PROTO_UDP)
+    p = p.at[0, pkt.W_LEN].set(size)
+    return p
+
+
+def test_codel_below_target_no_drops():
+    r = _mk_router()
+    mask = jnp.asarray([True])
+    src = jnp.asarray([0], dtype=jnp.int32)
+    t = 0
+    for i in range(5):
+        r = codel.enqueue(r, mask, _payload(), src, jnp.int64(t))
+    # dequeue immediately: sojourn 0 → all delivered
+    got = 0
+    for i in range(5):
+        r, have, payload, s = codel.dequeue(r, jnp.int64(t + 1 * MS), mask)
+        got += int(have[0])
+    assert got == 5
+    assert int(r.codel_dropped) == 0
+
+
+def test_codel_sustained_delay_drops():
+    """Packets sojourning > 10ms for over 100ms trigger drop mode."""
+    r = _mk_router(Q=64)
+    mask = jnp.asarray([True])
+    src = jnp.asarray([0], dtype=jnp.int32)
+    # enqueue 40 packets at t=0
+    for i in range(40):
+        r = codel.enqueue(r, mask, _payload(), src, jnp.int64(0))
+    # dequeue one per 10ms starting at t=50ms: sojourn always > 10ms (bad
+    # state). First interval arms at 50ms, expires at 150ms; from then on
+    # packets start dropping.
+    delivered, times = 0, []
+    t = 50 * MS
+    while True:
+        r, have, payload, s = codel.dequeue(r, jnp.int64(t), mask)
+        if not bool(codel.nonempty(r)[0]) and not bool(have[0]):
+            break
+        if bool(have[0]):
+            delivered += 1
+            times.append(t)
+        t += 10 * MS
+    dropped = int(r.codel_dropped)
+    assert dropped > 0, "sustained over-target sojourn must drop"
+    assert delivered + dropped == 40
+    # before the interval expired (t < 150ms) nothing was dropped
+    assert times[:10] == [50 * MS + i * 10 * MS for i in range(10)]
+
+
+def test_codel_fresh_packet_ends_drop_mode():
+    """Regression: in drop mode, dropping a stale packet and popping a FRESH
+    (low-sojourn) one must deliver the fresh packet and exit drop mode — the
+    fresh packet must be judged by its own sojourn, not its predecessor's."""
+    r = _mk_router(Q=8)
+    mask = jnp.asarray([True])
+    src = jnp.asarray([0], dtype=jnp.int32)
+    r = codel.enqueue(r, mask, _payload(), src, jnp.int64(0))  # A, stale
+    r = codel.enqueue(r, mask, _payload(), src, jnp.int64(199 * MS))  # B, fresh
+    r = r.replace(
+        drop_mode=jnp.asarray([True]),
+        next_drop=jnp.asarray([200 * MS], dtype=jnp.int64),
+        interval_expire=jnp.asarray([150 * MS], dtype=jnp.int64),
+    )
+    r, have, payload, s = codel.dequeue(r, jnp.int64(200 * MS), mask)
+    assert bool(have[0]), "fresh packet B must be delivered"
+    assert int(r.codel_dropped) == 1  # only stale A dropped
+    assert not bool(r.drop_mode[0]), "low sojourn must exit drop mode"
+
+
+def test_codel_queue_overflow_counted():
+    r = _mk_router(Q=4)
+    mask = jnp.asarray([True])
+    src = jnp.asarray([0], dtype=jnp.int32)
+    for i in range(6):
+        r = codel.enqueue(r, mask, _payload(), src, jnp.int64(0))
+    assert int(r.overflow_dropped) == 2
+
+
+# ---------------------------------------------------------------------------
+# e2e: UDP echo RTT through the full stack
+# ---------------------------------------------------------------------------
+
+
+def _echo_cfg(interval="200 ms", runtime=2, stop=4, size=512):
+    return {
+        "general": {"stop_time": stop, "seed": 5},
+        "network": {"graph": {"type": "gml", "inline": GML_2V}},
+        "experimental": {"event_capacity": 4096, "events_per_host_per_window": 8},
+        "hosts": {
+            "server": {
+                "network_node_id": 0,
+                "app_model": "udp_echo",
+                "app_options": {"role": "server"},
+            },
+            "client": {
+                "network_node_id": 1,
+                "app_model": "udp_echo",
+                "app_options": {
+                    "interval": interval,
+                    "runtime": runtime,
+                    "size": size,
+                },
+            },
+        },
+    }
+
+
+def test_udp_echo_rtt():
+    sim = build_simulation(_echo_cfg())
+    sim.run()
+    sub = jax.device_get(sim.state.subs["udp_echo"])
+    # hosts sorted by name: client=0, server=1 → roles: client at index 0
+    ci = [i for i, h in enumerate(sim.config.hosts) if h.name == "client"][0]
+    si = [i for i, h in enumerate(sim.config.hosts) if h.name == "server"][0]
+    sent = int(sub["sent"][ci])
+    echoed = int(sub["echoed"][si])
+    rtt_count = int(sub["rtt_count"][ci])
+    assert sent >= 10
+    assert echoed == sent  # unloaded, lossless: everything echoes
+    assert rtt_count == sent
+    # RTT = exactly 2 × 50ms path latency (ample tokens, empty queues)
+    avg_rtt = int(sub["rtt_sum"][ci]) / rtt_count
+    assert avg_rtt == 2 * 50 * MS, f"avg rtt {avg_rtt}"
+    c = sim.counters()
+    assert c["pool_overflow_dropped"] == 0
+    assert c["outbox_overflow_dropped"] == 0
+
+
+def test_udp_echo_deterministic():
+    a = build_simulation(_echo_cfg())
+    b = build_simulation(_echo_cfg())
+    a.run()
+    b.run()
+    assert a.counters() == b.counters()
+    sa = jax.device_get(a.state.subs["udp_echo"])
+    sb = jax.device_get(b.state.subs["udp_echo"])
+    assert list(sa["rtt_sum"]) == list(sb["rtt_sum"])
+
+
+# ---------------------------------------------------------------------------
+# e2e: UDP flood with a rate-limited sender (token-bucket pacing)
+# ---------------------------------------------------------------------------
+
+
+def test_udp_flood_paced_and_conserved():
+    # client bw_up = 12 Mbit → 1500 B/ms refill; wire size 1500 → steady
+    # state 1 packet/ms after an initial 2-packet burst (cap = refill + MTU).
+    cfg = {
+        "general": {"stop_time": 3, "seed": 3},
+        "network": {"graph": {"type": "gml", "inline": GML_2V}},
+        "experimental": {"event_capacity": 8192, "events_per_host_per_window": 8},
+        "hosts": {
+            "server": {
+                "network_node_id": 0,
+                "app_model": "udp_flood",
+                "app_options": {"role": "server"},
+            },
+            "client": {
+                "network_node_id": 1,
+                "bandwidth_up": "12 Mbit",
+                "app_model": "udp_flood",
+                "app_options": {
+                    "interval": "250 us",
+                    "runtime": "20 ms",
+                    "size": 1472,
+                },
+            },
+        },
+    }
+    sim = build_simulation(cfg)
+    sim.run()
+    sub = jax.device_get(sim.state.subs["udp_flood"])
+    ci = [i for i, h in enumerate(sim.config.hosts) if h.name == "client"][0]
+    si = [i for i, h in enumerate(sim.config.hosts) if h.name == "server"][0]
+    sent = int(sub["sent"][ci])
+    recv = int(sub["recv"][si])
+    assert sent == 80  # 20ms / 250us
+    n = jax.device_get(sim.state.subs["nic"])
+    ring_left = int(n.q_tail[ci] - n.q_head[ci])
+    ring_dropped = int(n.sendq_dropped)
+    # conservation: all sent packets are delivered, still queued, or dropped
+    assert recv + ring_left + ring_dropped == sent
+    # pacing: after the 2-packet burst, at most 1 packet/ms leaves the NIC.
+    # From first send (t=1s) to stop (t=3s) ≈ 2000 refills max.
+    assert recv <= 2 + 2000
+    # the 2-second drain at 1 pkt/ms empties far more than the burst
+    assert recv > 40
+    c = sim.counters()
+    assert c["packets_delivered"] == recv
+    u = jax.device_get(sim.state.subs["udp"])
+    assert int(u.drop_no_socket) == 0
+
+
+def test_loopback_bypasses_router():
+    """Self-addressed traffic must not consume router/bucket resources."""
+    cfg = {
+        "general": {"stop_time": 2, "seed": 1},
+        "network": {
+            "graph": {
+                "type": "gml",
+                "inline": (
+                    'graph [ node [ id 0 bandwidth_down "1 Mbit" '
+                    'bandwidth_up "1 Mbit" ] '
+                    'edge [ source 0 target 0 latency "1 ms" ] ]'
+                ),
+            }
+        },
+        "experimental": {"event_capacity": 1024},
+        "hosts": {
+            "server": {"app_model": "udp_echo", "app_options": {"role": "server"}},
+            "client": {
+                "app_model": "udp_echo",
+                "app_options": {"interval": "100 ms", "runtime": 1},
+            },
+        },
+    }
+    sim = build_simulation(cfg)
+    sim.run()
+    sub = jax.device_get(sim.state.subs["udp_echo"])
+    assert int(sub["echoed"].sum()) == int(sub["sent"].sum())
