@@ -1,0 +1,103 @@
+// Simulator <-> managed-process IPC channel (shadow_tpu native plane).
+//
+// Role parity: the reference's shm message channel + spinning binary
+// semaphores (src/lib/shim/ipc.h, binary_spinning_sem.h:13-50) and shm
+// block registry (src/main/shmem/). Design differences, both deliberate:
+//   * One channel per managed thread lives in its OWN shm file (created by
+//     the driver, name passed via env) — no global buddy allocator needed,
+//     because the only cross-process allocations ARE the channels.
+//   * The data plane rides inline in the channel (DATA_MAX chunks) instead
+//     of a remote memory manager reading /proc/pid/mem: syscall buffer
+//     contents are memcpy'd by the shim itself. Bounded, simple, and the
+//     copy cost is far below the simulated network's per-packet work.
+//   * Semaphores are POSIX process-shared sems with a bounded user-space
+//     spin before sem_wait (same hybrid the reference built by hand).
+//
+// Layout is pinned with static_asserts so the Python driver can address
+// fields by fixed offsets (ctypes) without a bindings generator.
+
+#pragma once
+
+#include <semaphore.h>
+#include <stddef.h>
+#include <stdint.h>
+
+namespace shadow_tpu {
+
+constexpr uint32_t IPC_MAGIC = 0x53545031;  // "STP1"
+constexpr uint32_t IPC_DATA_MAX = 1 << 16;  // inline data plane per message
+
+// message types
+enum MsgType : int32_t {
+  MSG_NONE = 0,
+  MSG_HELLO = 1,     // shim -> driver: managed process is alive (ret = pid)
+  MSG_SYSCALL = 2,   // shim -> driver: sysno + args (+ inline data for writes)
+  MSG_RESULT = 3,    // driver -> shim: ret (+ inline data for reads)
+  MSG_DO_NATIVE = 4, // driver -> shim: run the syscall natively, in-process
+  MSG_STOP = 5,      // driver -> shim: tear the process down
+};
+
+// pseudo-syscall numbers for calls that have no raw-syscall form or need
+// simulator-side name resolution (reference analog: the custom
+// shadow_hostname_to_addr_ipv4 syscall used by getaddrinfo interposition)
+enum PseudoSys : int64_t {
+  PSYS_RESOLVE_NAME = -100,  // data = hostname; ret = ipv4 (host order)
+  PSYS_YIELD = -101,         // report-in; lets the driver advance sim time
+  PSYS_GETHOSTNAME = -102,   // reply data = this host's simulated name
+};
+
+#pragma pack(push, 8)
+struct Channel {
+  uint32_t magic;        // 0
+  int32_t shim_pid;      // 4
+  sem_t to_driver;       // 8   (sem_t = 32 bytes on x86-64 glibc)
+  sem_t to_shim;         // 40
+  int32_t type;          // 72
+  int32_t pad0;          // 76
+  int64_t sysno;         // 80
+  int64_t args[6];       // 88
+  int64_t ret;           // 136
+  int64_t sim_time_ns;   // 144  driver stamps sim clock on every response
+  int32_t data_len;      // 152
+  int32_t pad1;          // 156
+  uint8_t data[IPC_DATA_MAX];  // 160
+};
+#pragma pack(pop)
+
+static_assert(sizeof(sem_t) == 32, "expected glibc x86-64 sem_t");
+static_assert(offsetof(Channel, to_driver) == 8, "layout pinned for ctypes");
+static_assert(offsetof(Channel, type) == 72, "layout pinned for ctypes");
+static_assert(offsetof(Channel, sysno) == 80, "layout pinned for ctypes");
+static_assert(offsetof(Channel, args) == 88, "layout pinned for ctypes");
+static_assert(offsetof(Channel, ret) == 136, "layout pinned for ctypes");
+static_assert(offsetof(Channel, sim_time_ns) == 144, "layout pinned");
+static_assert(offsetof(Channel, data_len) == 152, "layout pinned");
+static_assert(offsetof(Channel, data) == 160, "layout pinned for ctypes");
+
+// Bounded spin before parking on the semaphore: the driver usually replies
+// within a few microseconds; spinning avoids a futex round trip
+// (binary_spinning_sem.h analog). The spin count is tuned by env
+// SHADOW_TPU_SPIN (0 disables).
+inline void sem_wait_spinning(sem_t* sem, long spin_max) {
+  for (long i = 0; i < spin_max; ++i) {
+    if (sem_trywait(sem) == 0) return;
+#if defined(__x86_64__)
+    __builtin_ia32_pause();
+#endif
+  }
+  while (sem_wait(sem) != 0) {
+  }
+}
+
+// env var names (driver sets them in the child environment)
+constexpr const char* ENV_SHM = "SHADOW_TPU_SHM";     // shm file name
+constexpr const char* ENV_SPIN = "SHADOW_TPU_SPIN";   // spin iterations
+constexpr const char* ENV_DEBUG = "SHADOW_TPU_SHIM_DEBUG";
+
+// emulated fd space starts here; lower fds (stdio, real files the process
+// opens itself) stay native. The reference instead virtualizes the entire
+// fd table (descriptor_table.rs); partitioning keeps real-file IO native
+// with zero syscall traffic.
+constexpr int FD_BASE = 1000;
+
+}  // namespace shadow_tpu

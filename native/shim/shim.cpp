@@ -1,0 +1,662 @@
+// shadow_tpu managed-process shim (LD_PRELOAD).
+//
+// Role parity with the reference's shim plane (src/lib/shim/shim.c,
+// preload_syscall.c, preload_libraries.c): co-opts a real Linux binary into
+// the simulation by interposing at the libc API, relaying network/time
+// syscalls over a shared-memory channel to the simulator driver, which
+// executes them against the device-stepped network and the simulated clock.
+//
+// Interposition model (differences from the reference, all deliberate):
+//   * libc-symbol interposition only (no seccomp/SIGSYS backstop and no
+//     ptrace mode yet): raw inline syscalls bypass the shim. Fine for the
+//     workload classes the framework targets first (sockets-and-time apps);
+//     the seccomp backstop is a planned hardening step.
+//   * fd space is PARTITIONED: emulated sockets/epolls live at
+//     fd >= FD_BASE; anything below is passed through natively. Real-file
+//     IO therefore costs zero simulator traffic (the reference instead
+//     virtualizes the whole fd table and dups real files into it).
+//   * Buffers are memcpy'd through the channel's inline data plane
+//     (bounded; large transfers chunk at DATA_MAX per call) rather than
+//     read remotely out of plugin memory by the simulator.
+//
+// Thread model: all threads of the process share one channel under a mutex
+// (syscalls serialize; each blocks until its own reply). The driver sees
+// one logical execution stream per process.
+
+#include "../common/ipc.h"
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <poll.h>
+#include <pthread.h>
+#include <stdarg.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/epoll.h>
+#include <sys/mman.h>
+#include <sys/select.h>
+#include <sys/socket.h>
+#include <sys/syscall.h>
+#include <sys/time.h>
+#include <sys/types.h>
+#include <time.h>
+#include <unistd.h>
+
+using namespace shadow_tpu;
+
+namespace {
+
+Channel* g_ch = nullptr;
+long g_spin = 8192;
+int g_debug = 0;
+pthread_mutex_t g_lock = PTHREAD_MUTEX_INITIALIZER;
+
+#define SHIM_LOG(...)                                 \
+  do {                                                \
+    if (g_debug) {                                    \
+      fprintf(stderr, "[shadow-tpu-shim %d] ", getpid()); \
+      fprintf(stderr, __VA_ARGS__);                   \
+      fprintf(stderr, "\n");                          \
+    }                                                 \
+  } while (0)
+
+bool is_managed_fd(int fd) { return g_ch != nullptr && fd >= FD_BASE; }
+
+// One request/response round trip. data_in/data_in_len ride to the driver;
+// the reply's inline data is copied to data_out (up to data_out_cap).
+// Returns the driver's ret, with errno set for negative returns.
+int64_t ipc_call(int64_t sysno, const int64_t args[6], const void* data_in,
+                 uint32_t data_in_len, void* data_out, uint32_t data_out_cap,
+                 uint32_t* data_out_len) {
+  if (!g_ch) {
+    errno = ENOSYS;
+    return -1;
+  }
+  pthread_mutex_lock(&g_lock);
+  g_ch->type = MSG_SYSCALL;
+  g_ch->sysno = sysno;
+  for (int i = 0; i < 6; i++) g_ch->args[i] = args ? args[i] : 0;
+  uint32_t n = data_in_len > IPC_DATA_MAX ? IPC_DATA_MAX : data_in_len;
+  g_ch->data_len = (int32_t)n;
+  if (n && data_in) memcpy(g_ch->data, data_in, n);
+  sem_post(&g_ch->to_driver);
+  sem_wait_spinning(&g_ch->to_shim, g_spin);
+
+  int64_t ret = g_ch->ret;
+  int32_t mtype = g_ch->type;
+  uint32_t out_n = 0;
+  if (data_out && g_ch->data_len > 0) {
+    out_n = (uint32_t)g_ch->data_len;
+    if (out_n > data_out_cap) out_n = data_out_cap;
+    memcpy(data_out, g_ch->data, out_n);
+  }
+  if (data_out_len) *data_out_len = out_n;
+  pthread_mutex_unlock(&g_lock);
+
+  if (mtype == MSG_STOP) {
+    SHIM_LOG("driver requested stop");
+    _exit((int)ret);
+  }
+  if (mtype == MSG_DO_NATIVE) {
+    return syscall((long)sysno, args[0], args[1], args[2], args[3], args[4],
+                   args[5]);
+  }
+  if (ret < 0) {
+    errno = (int)-ret;
+    return -1;
+  }
+  return ret;
+}
+
+int64_t ipc_call6(int64_t sysno, int64_t a0 = 0, int64_t a1 = 0,
+                  int64_t a2 = 0, int64_t a3 = 0, int64_t a4 = 0,
+                  int64_t a5 = 0) {
+  int64_t args[6] = {a0, a1, a2, a3, a4, a5};
+  return ipc_call(sysno, args, nullptr, 0, nullptr, 0, nullptr);
+}
+
+// Extract (ipv4 host-order, port host-order) from a sockaddr.
+bool parse_inet(const struct sockaddr* addr, socklen_t len, uint32_t* ip,
+                uint16_t* port) {
+  if (!addr || len < (socklen_t)sizeof(struct sockaddr_in)) return false;
+  if (addr->sa_family != AF_INET) return false;
+  const struct sockaddr_in* sin = (const struct sockaddr_in*)addr;
+  *ip = ntohl(sin->sin_addr.s_addr);
+  *port = ntohs(sin->sin_port);
+  return true;
+}
+
+void fill_inet(struct sockaddr* addr, socklen_t* alen, uint32_t ip,
+               uint16_t port) {
+  if (!addr || !alen) return;
+  struct sockaddr_in sin;
+  memset(&sin, 0, sizeof(sin));
+  sin.sin_family = AF_INET;
+  sin.sin_addr.s_addr = htonl(ip);
+  sin.sin_port = htons(port);
+  socklen_t n = *alen < (socklen_t)sizeof(sin) ? *alen : (socklen_t)sizeof(sin);
+  memcpy(addr, &sin, n);
+  *alen = (socklen_t)sizeof(sin);
+}
+
+__attribute__((constructor)) void shim_init() {
+  const char* path = getenv(ENV_SHM);
+  if (!path) return;  // not under the simulator; stay inert
+  const char* spin = getenv(ENV_SPIN);
+  if (spin) g_spin = atol(spin);
+  g_debug = getenv(ENV_DEBUG) != nullptr;
+  int fd = open(path, O_RDWR);
+  if (fd < 0) {
+    fprintf(stderr, "shadow-tpu-shim: cannot open %s: %s\n", path,
+            strerror(errno));
+    return;
+  }
+  void* p = mmap(nullptr, sizeof(Channel), PROT_READ | PROT_WRITE, MAP_SHARED,
+                 fd, 0);
+  close(fd);
+  if (p == MAP_FAILED || ((Channel*)p)->magic != IPC_MAGIC) {
+    fprintf(stderr, "shadow-tpu-shim: bad channel mapping\n");
+    return;
+  }
+  g_ch = (Channel*)p;
+  g_ch->shim_pid = getpid();
+  SHIM_LOG("attached, channel=%s", path);
+  // HELLO round trip: driver replies with the current sim time
+  pthread_mutex_lock(&g_lock);
+  g_ch->type = MSG_HELLO;
+  g_ch->ret = getpid();
+  g_ch->data_len = 0;
+  sem_post(&g_ch->to_driver);
+  sem_wait_spinning(&g_ch->to_shim, g_spin);
+  pthread_mutex_unlock(&g_lock);
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// sockets
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+int socket(int domain, int type, int protocol) {
+  if (!g_ch || domain != AF_INET)
+    return (int)syscall(SYS_socket, domain, type, protocol);
+  return (int)ipc_call6(SYS_socket, domain, type, protocol);
+}
+
+int bind(int fd, const struct sockaddr* addr, socklen_t len) {
+  if (!is_managed_fd(fd)) return (int)syscall(SYS_bind, fd, addr, len);
+  uint32_t ip = 0;
+  uint16_t port = 0;
+  if (!parse_inet(addr, len, &ip, &port)) {
+    errno = EINVAL;
+    return -1;
+  }
+  return (int)ipc_call6(SYS_bind, fd, ip, port);
+}
+
+int listen(int fd, int backlog) {
+  if (!is_managed_fd(fd)) return (int)syscall(SYS_listen, fd, backlog);
+  return (int)ipc_call6(SYS_listen, fd, backlog);
+}
+
+int connect(int fd, const struct sockaddr* addr, socklen_t len) {
+  if (!is_managed_fd(fd)) return (int)syscall(SYS_connect, fd, addr, len);
+  uint32_t ip = 0;
+  uint16_t port = 0;
+  if (!parse_inet(addr, len, &ip, &port)) {
+    errno = EINVAL;
+    return -1;
+  }
+  return (int)ipc_call6(SYS_connect, fd, ip, port);
+}
+
+int accept4(int fd, struct sockaddr* addr, socklen_t* alen, int flags) {
+  if (!is_managed_fd(fd))
+    return (int)syscall(SYS_accept4, fd, addr, alen, flags);
+  // reply data = [u32 peer_ip, u16 peer_port] packed in ret-adjacent words
+  int64_t args[6] = {fd, flags, 0, 0, 0, 0};
+  uint8_t out[8];
+  uint32_t out_len = 0;
+  int64_t r = ipc_call(SYS_accept4, args, nullptr, 0, out, sizeof(out),
+                       &out_len);
+  if (r >= 0 && out_len >= 6 && addr && alen) {
+    uint32_t ip;
+    uint16_t port;
+    memcpy(&ip, out, 4);
+    memcpy(&port, out + 4, 2);
+    fill_inet(addr, alen, ip, port);
+  }
+  return (int)r;
+}
+
+int accept(int fd, struct sockaddr* addr, socklen_t* alen) {
+  return accept4(fd, addr, alen, 0);
+}
+
+ssize_t sendto(int fd, const void* buf, size_t n, int flags,
+               const struct sockaddr* addr, socklen_t alen) {
+  if (!is_managed_fd(fd))
+    return syscall(SYS_sendto, fd, buf, n, flags, addr, alen);
+  uint32_t ip = 0;
+  uint16_t port = 0;
+  int has_addr = parse_inet(addr, alen, &ip, &port) ? 1 : 0;
+  if (n > IPC_DATA_MAX) n = IPC_DATA_MAX;  // caller loops for the rest
+  int64_t args[6] = {fd, (int64_t)n, flags, has_addr, ip, port};
+  return (ssize_t)ipc_call(SYS_sendto, args, buf, (uint32_t)n, nullptr, 0,
+                           nullptr);
+}
+
+ssize_t send(int fd, const void* buf, size_t n, int flags) {
+  if (!is_managed_fd(fd)) return syscall(SYS_sendto, fd, buf, n, flags, 0, 0);
+  return sendto(fd, buf, n, flags, nullptr, 0);
+}
+
+ssize_t recvfrom(int fd, void* buf, size_t n, int flags,
+                 struct sockaddr* addr, socklen_t* alen) {
+  if (!is_managed_fd(fd))
+    return syscall(SYS_recvfrom, fd, buf, n, flags, addr, alen);
+  size_t want = n > IPC_DATA_MAX ? IPC_DATA_MAX : n;
+  int64_t args[6] = {fd, (int64_t)want, flags, addr ? 1 : 0, 0, 0};
+  // reply: data = [u32 src_ip, u16 src_port, payload...]
+  static thread_local uint8_t tmp[IPC_DATA_MAX];
+  uint32_t out_len = 0;
+  int64_t r =
+      ipc_call(SYS_recvfrom, args, nullptr, 0, tmp, IPC_DATA_MAX, &out_len);
+  if (r < 0) return -1;
+  uint32_t hdr = 6;
+  uint32_t payload = out_len > hdr ? out_len - hdr : 0;
+  if (payload > want) payload = (uint32_t)want;
+  if (payload && buf) memcpy(buf, tmp + hdr, payload);
+  if (addr && alen && out_len >= hdr) {
+    uint32_t ip;
+    uint16_t port;
+    memcpy(&ip, tmp, 4);
+    memcpy(&port, tmp + 4, 2);
+    fill_inet(addr, alen, ip, port);
+  }
+  return (ssize_t)r;
+}
+
+ssize_t recv(int fd, void* buf, size_t n, int flags) {
+  if (!is_managed_fd(fd)) return syscall(SYS_recvfrom, fd, buf, n, flags, 0, 0);
+  return recvfrom(fd, buf, n, flags, nullptr, nullptr);
+}
+
+ssize_t read(int fd, void* buf, size_t n) {
+  if (!is_managed_fd(fd)) return syscall(SYS_read, fd, buf, n);
+  return recvfrom(fd, buf, n, 0, nullptr, nullptr);
+}
+
+ssize_t write(int fd, const void* buf, size_t n) {
+  if (!is_managed_fd(fd)) return syscall(SYS_write, fd, buf, n);
+  return sendto(fd, buf, n, 0, nullptr, 0);
+}
+
+int close(int fd) {
+  if (!is_managed_fd(fd)) return (int)syscall(SYS_close, fd);
+  return (int)ipc_call6(SYS_close, fd);
+}
+
+int shutdown(int fd, int how) {
+  if (!is_managed_fd(fd)) return (int)syscall(SYS_shutdown, fd, how);
+  return (int)ipc_call6(SYS_shutdown, fd, how);
+}
+
+int setsockopt(int fd, int level, int optname, const void* optval,
+               socklen_t optlen) {
+  if (!is_managed_fd(fd))
+    return (int)syscall(SYS_setsockopt, fd, level, optname, optval, optlen);
+  int64_t v = 0;
+  if (optval && optlen >= sizeof(int)) v = *(const int*)optval;
+  return (int)ipc_call6(SYS_setsockopt, fd, level, optname, v);
+}
+
+int getsockopt(int fd, int level, int optname, void* optval,
+               socklen_t* optlen) {
+  if (!is_managed_fd(fd))
+    return (int)syscall(SYS_getsockopt, fd, level, optname, optval, optlen);
+  int64_t r = ipc_call6(SYS_getsockopt, fd, level, optname);
+  if (r < 0) return -1;
+  if (optval && optlen && *optlen >= sizeof(int)) {
+    *(int*)optval = (int)r;
+    *optlen = sizeof(int);
+  }
+  return 0;
+}
+
+int getsockname(int fd, struct sockaddr* addr, socklen_t* alen) {
+  if (!is_managed_fd(fd)) return (int)syscall(SYS_getsockname, fd, addr, alen);
+  uint8_t out[8];
+  uint32_t out_len = 0;
+  int64_t args[6] = {fd, 0, 0, 0, 0, 0};
+  int64_t r =
+      ipc_call(SYS_getsockname, args, nullptr, 0, out, sizeof(out), &out_len);
+  if (r < 0) return -1;
+  if (out_len >= 6) {
+    uint32_t ip;
+    uint16_t port;
+    memcpy(&ip, out, 4);
+    memcpy(&port, out + 4, 2);
+    fill_inet(addr, alen, ip, port);
+  }
+  return 0;
+}
+
+int getpeername(int fd, struct sockaddr* addr, socklen_t* alen) {
+  if (!is_managed_fd(fd)) return (int)syscall(SYS_getpeername, fd, addr, alen);
+  uint8_t out[8];
+  uint32_t out_len = 0;
+  int64_t args[6] = {fd, 0, 0, 0, 0, 0};
+  int64_t r =
+      ipc_call(SYS_getpeername, args, nullptr, 0, out, sizeof(out), &out_len);
+  if (r < 0) return -1;
+  if (out_len >= 6) {
+    uint32_t ip;
+    uint16_t port;
+    memcpy(&ip, out, 4);
+    memcpy(&port, out + 4, 2);
+    fill_inet(addr, alen, ip, port);
+  }
+  return 0;
+}
+
+int fcntl(int fd, int cmd, ...) {
+  va_list ap;
+  va_start(ap, cmd);
+  long arg = va_arg(ap, long);
+  va_end(ap);
+  if (!is_managed_fd(fd)) return (int)syscall(SYS_fcntl, fd, cmd, arg);
+  return (int)ipc_call6(SYS_fcntl, fd, cmd, arg);
+}
+
+int ioctl(int fd, unsigned long req, ...) {
+  va_list ap;
+  va_start(ap, req);
+  void* argp = va_arg(ap, void*);
+  va_end(ap);
+  if (!is_managed_fd(fd)) return (int)syscall(SYS_ioctl, fd, req, argp);
+  // FIONREAD is the one sockets commonly use
+  int64_t r = ipc_call6(SYS_ioctl, fd, (int64_t)req);
+  if (r < 0) return -1;
+  if (argp) *(int*)argp = (int)r;
+  return 0;
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// time virtualization (reference analog: shim_syscall.c time cache +
+// clock_gettime interposition; sim time is authoritative)
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+int clock_gettime(clockid_t clk, struct timespec* tp) {
+  if (!g_ch) return (int)syscall(SYS_clock_gettime, clk, tp);
+  int64_t r = ipc_call6(SYS_clock_gettime, clk);
+  if (r < 0) return -1;
+  if (tp) {
+    tp->tv_sec = r / 1000000000LL;
+    tp->tv_nsec = r % 1000000000LL;
+  }
+  return 0;
+}
+
+int gettimeofday(struct timeval* tv, void* tz) {
+  (void)tz;
+  if (!g_ch) return (int)syscall(SYS_gettimeofday, tv, tz);
+  struct timespec ts;
+  if (clock_gettime(CLOCK_REALTIME, &ts) != 0) return -1;
+  if (tv) {
+    tv->tv_sec = ts.tv_sec;
+    tv->tv_usec = ts.tv_nsec / 1000;
+  }
+  return 0;
+}
+
+time_t time(time_t* t) {
+  if (!g_ch) {
+    struct timespec ts;
+    syscall(SYS_clock_gettime, CLOCK_REALTIME, &ts);
+    if (t) *t = ts.tv_sec;
+    return ts.tv_sec;
+  }
+  struct timespec ts;
+  if (clock_gettime(CLOCK_REALTIME, &ts) != 0) return (time_t)-1;
+  if (t) *t = ts.tv_sec;
+  return ts.tv_sec;
+}
+
+int nanosleep(const struct timespec* req, struct timespec* rem) {
+  if (!g_ch) return (int)syscall(SYS_nanosleep, req, rem);
+  if (!req) {
+    errno = EFAULT;
+    return -1;
+  }
+  int64_t ns = (int64_t)req->tv_sec * 1000000000LL + req->tv_nsec;
+  int64_t r = ipc_call6(SYS_nanosleep, ns);
+  if (rem) {
+    rem->tv_sec = 0;
+    rem->tv_nsec = 0;
+  }
+  return r < 0 ? -1 : 0;
+}
+
+unsigned int sleep(unsigned int seconds) {
+  struct timespec ts = {(time_t)seconds, 0};
+  nanosleep(&ts, nullptr);
+  return 0;
+}
+
+int usleep(useconds_t usec) {
+  struct timespec ts = {(time_t)(usec / 1000000),
+                        (long)(usec % 1000000) * 1000};
+  return nanosleep(&ts, nullptr);
+}
+
+// ---------------------------------------------------------------------------
+// readiness: epoll / poll / select
+// ---------------------------------------------------------------------------
+
+int epoll_create1(int flags) {
+  if (!g_ch) return (int)syscall(SYS_epoll_create1, flags);
+  return (int)ipc_call6(SYS_epoll_create1, flags);
+}
+
+int epoll_create(int size) {
+  (void)size;
+  return epoll_create1(0);
+}
+
+int epoll_ctl(int epfd, int op, int fd, struct epoll_event* ev) {
+  if (!is_managed_fd(epfd))
+    return (int)syscall(SYS_epoll_ctl, epfd, op, fd, ev);
+  int64_t events = ev ? (int64_t)ev->events : 0;
+  int64_t data = ev ? (int64_t)ev->data.u64 : 0;
+  return (int)ipc_call6(SYS_epoll_ctl, epfd, op, fd, events, data);
+}
+
+int epoll_wait(int epfd, struct epoll_event* evs, int maxevents,
+               int timeout_ms) {
+  if (!is_managed_fd(epfd))
+    return (int)syscall(SYS_epoll_wait, epfd, evs, maxevents, timeout_ms);
+  // reply data = maxevents × {u32 events, u64 data} packed (12 bytes each)
+  int want = maxevents;
+  if (want > (int)(IPC_DATA_MAX / 12)) want = IPC_DATA_MAX / 12;
+  int64_t args[6] = {epfd, want, timeout_ms, 0, 0, 0};
+  static thread_local uint8_t tmp[IPC_DATA_MAX];
+  uint32_t out_len = 0;
+  int64_t r = ipc_call(SYS_epoll_wait, args, nullptr, 0, tmp, IPC_DATA_MAX,
+                       &out_len);
+  if (r < 0) return -1;
+  int nready = (int)r;
+  for (int i = 0; i < nready && (uint32_t)(i * 12 + 12) <= out_len; i++) {
+    uint32_t e;
+    uint64_t d;
+    memcpy(&e, tmp + i * 12, 4);
+    memcpy(&d, tmp + i * 12 + 4, 8);
+    evs[i].events = e;
+    evs[i].data.u64 = d;
+  }
+  return nready;
+}
+
+int poll(struct pollfd* fds, nfds_t nfds, int timeout_ms) {
+  bool any_managed = false;
+  for (nfds_t i = 0; i < nfds; i++)
+    if (is_managed_fd(fds[i].fd)) any_managed = true;
+  if (!any_managed) return (int)syscall(SYS_poll, fds, nfds, timeout_ms);
+  // request data = nfds × {i32 fd, i16 events} (6 bytes); native fds in a
+  // mixed set are reported to the driver too (it treats them as never
+  // ready — a documented v1 simplification).
+  if (nfds > IPC_DATA_MAX / 6) nfds = IPC_DATA_MAX / 6;
+  static thread_local uint8_t tmp[IPC_DATA_MAX];
+  for (nfds_t i = 0; i < nfds; i++) {
+    int32_t fd = fds[i].fd;
+    int16_t ev = fds[i].events;
+    memcpy(tmp + i * 6, &fd, 4);
+    memcpy(tmp + i * 6 + 4, &ev, 2);
+  }
+  int64_t args[6] = {(int64_t)nfds, timeout_ms, 0, 0, 0, 0};
+  static thread_local uint8_t out[IPC_DATA_MAX];
+  uint32_t out_len = 0;
+  int64_t r = ipc_call(SYS_poll, args, tmp, (uint32_t)(nfds * 6), out,
+                       IPC_DATA_MAX, &out_len);
+  if (r < 0) return -1;
+  // reply data = nfds × i16 revents
+  for (nfds_t i = 0; i < nfds && (uint32_t)(i * 2 + 2) <= out_len; i++) {
+    int16_t rev;
+    memcpy(&rev, out + i * 2, 2);
+    fds[i].revents = rev;
+  }
+  return (int)r;
+}
+
+int select(int nfds, fd_set* rd, fd_set* wr, fd_set* ex,
+           struct timeval* timeout) {
+  bool any_managed = false;
+  for (int fd = FD_BASE; fd < nfds; fd++) {
+    if ((rd && FD_ISSET(fd, rd)) || (wr && FD_ISSET(fd, wr)) ||
+        (ex && FD_ISSET(fd, ex)))
+      any_managed = true;
+  }
+  if (!g_ch || !any_managed)
+    return (int)syscall(SYS_select, nfds, rd, wr, ex, timeout);
+  // convert to a pollfd set over the managed fds, forward as poll
+  struct pollfd pfds[64];
+  int n = 0;
+  for (int fd = 0; fd < nfds && n < 64; fd++) {
+    short ev = 0;
+    if (rd && FD_ISSET(fd, rd)) ev |= POLLIN;
+    if (wr && FD_ISSET(fd, wr)) ev |= POLLOUT;
+    if (ex && FD_ISSET(fd, ex)) ev |= POLLERR;
+    if (ev) {
+      pfds[n].fd = fd;
+      pfds[n].events = ev;
+      pfds[n].revents = 0;
+      n++;
+    }
+  }
+  int timeout_ms = -1;
+  if (timeout)
+    timeout_ms = (int)(timeout->tv_sec * 1000 + timeout->tv_usec / 1000);
+  int r = poll(pfds, n, timeout_ms);
+  if (r < 0) return -1;
+  if (rd) FD_ZERO(rd);
+  if (wr) FD_ZERO(wr);
+  if (ex) FD_ZERO(ex);
+  int count = 0;
+  for (int i = 0; i < n; i++) {
+    if (pfds[i].revents & (POLLIN | POLLHUP)) {
+      if (rd) {
+        FD_SET(pfds[i].fd, rd);
+        count++;
+      }
+    }
+    if (pfds[i].revents & POLLOUT) {
+      if (wr) {
+        FD_SET(pfds[i].fd, wr);
+        count++;
+      }
+    }
+    if (pfds[i].revents & POLLERR) {
+      if (ex) {
+        FD_SET(pfds[i].fd, ex);
+        count++;
+      }
+    }
+  }
+  return count;
+}
+
+// ---------------------------------------------------------------------------
+// name resolution (reference analog: preload_libraries.c getaddrinfo via a
+// custom simulator-side resolution syscall + DNS registry in routing/dns.c)
+// ---------------------------------------------------------------------------
+
+int getaddrinfo(const char* node, const char* service,
+                const struct addrinfo* hints, struct addrinfo** res) {
+  if (!g_ch) return EAI_FAIL;  // no native fallback under the simulator
+  uint32_t ip = 0;
+  if (node) {
+    struct in_addr a;
+    if (inet_aton(node, &a)) {
+      ip = ntohl(a.s_addr);
+    } else {
+      int64_t args[6] = {0, 0, 0, 0, 0, 0};
+      int64_t r = ipc_call(PSYS_RESOLVE_NAME, args, node,
+                           (uint32_t)strlen(node), nullptr, 0, nullptr);
+      if (r < 0) return EAI_NONAME;
+      ip = (uint32_t)r;
+    }
+  } else {
+    ip = INADDR_LOOPBACK;
+  }
+  uint16_t port = 0;
+  if (service) port = (uint16_t)atoi(service);
+
+  struct addrinfo* ai = (struct addrinfo*)calloc(1, sizeof(struct addrinfo));
+  struct sockaddr_in* sin =
+      (struct sockaddr_in*)calloc(1, sizeof(struct sockaddr_in));
+  sin->sin_family = AF_INET;
+  sin->sin_addr.s_addr = htonl(ip);
+  sin->sin_port = htons(port);
+  ai->ai_family = AF_INET;
+  ai->ai_socktype = hints ? hints->ai_socktype : SOCK_STREAM;
+  ai->ai_protocol = hints ? hints->ai_protocol : 0;
+  ai->ai_addrlen = sizeof(struct sockaddr_in);
+  ai->ai_addr = (struct sockaddr*)sin;
+  *res = ai;
+  return 0;
+}
+
+void freeaddrinfo(struct addrinfo* res) {
+  while (res) {
+    struct addrinfo* next = res->ai_next;
+    free(res->ai_addr);
+    free(res);
+    res = next;
+  }
+}
+
+int gethostname(char* name, size_t len) {
+  if (!g_ch) return (int)syscall(SYS_uname, 0) ? -1 : 0;
+  static thread_local char tmp[256];
+  uint32_t out_len = 0;
+  int64_t args[6] = {0, 0, 0, 0, 0, 0};
+  int64_t r = ipc_call(PSYS_GETHOSTNAME, args, nullptr, 0, tmp, sizeof(tmp),
+                       &out_len);
+  if (r < 0) return -1;
+  size_t n = out_len < len - 1 ? out_len : len - 1;
+  memcpy(name, tmp, n);
+  name[n] = 0;
+  return 0;
+}
+
+}  // extern "C"

@@ -311,3 +311,100 @@ class UdpEchoApp:
 
     def handlers(self):
         return {KIND_APP_TIMER: self.on_timer}
+
+
+class TcpBulkApp:
+    """BASELINE config 3: each client opens a TCP connection to a server and
+    pushes `total_bytes` through the congestion-controlled stream, then
+    closes. Exercises handshake, Reno, retransmission, and teardown.
+
+    Server hosts listen on SERVER_PORT (slot 0); child sockets are allocated
+    per accepted connection, so a server needs sockets_per_host > its client
+    count. Clients connect from slot 0 at start_time.
+    """
+
+    SUB = "tcp_bulk"
+
+    def __init__(
+        self,
+        num_hosts: int,
+        server_hosts,
+        total_bytes: int,
+        start_time: int = simtime.NS_PER_SEC,
+    ):
+        self.num_hosts = num_hosts
+        self.server_hosts = list(server_hosts)
+        self.total_bytes = int(total_bytes)
+        self.start_time = int(start_time)
+
+    def attach(self, stack):
+        self.stack = stack
+        import numpy as np
+
+        role = np.ones(self.num_hosts, dtype=np.int32)
+        role[self.server_hosts] = 0
+        self._role = jnp.asarray(role)
+        tgt = np.array(
+            [
+                self.server_hosts[i % len(self.server_hosts)]
+                for i in range(self.num_hosts)
+            ],
+            dtype=np.int32,
+        )
+        self._target = jnp.asarray(tgt)
+        for s in self.server_hosts:
+            stack.tcp_listen(s, 0, SERVER_PORT)
+        stack.tcp.on_established(self.on_established)
+        stack.tcp.on_peer_fin(self.on_peer_fin)
+
+    def init_sub(self) -> dict:
+        H = self.num_hosts
+        return {
+            "connected": jnp.zeros((H,), jnp.int64),
+            "accepted": jnp.zeros((H,), jnp.int64),
+            "eof_seen": jnp.zeros((H,), jnp.int64),
+        }
+
+    def initial_events(self):
+        return [
+            (self.start_time, h, h, KIND_APP_TIMER, [])
+            for h in range(self.num_hosts)
+            if int(self._role[h]) == 1
+        ]
+
+    def on_timer(self, state, ev, emitter, params):
+        """Client start: active open toward the target server."""
+        go = ev.mask & (self._role == 1)
+        state = self.stack.tcp.connect(
+            state, emitter, go, jnp.zeros((self.num_hosts,), jnp.int32),
+            self._target, SERVER_PORT, CLIENT_PORT_BASE, ev.time,
+        )
+        return state
+
+    def on_established(self, state, mask, slot, is_accept, src, now, emitter,
+                       params):
+        client_up = mask & ~is_accept & (self._role == 1)
+        sub = dict(state.subs[self.SUB])
+        sub["connected"] = sub["connected"] + client_up.astype(jnp.int64)
+        sub["accepted"] = sub["accepted"] + (
+            mask & is_accept & (self._role == 0)
+        ).astype(jnp.int64)
+        state = state.with_sub(self.SUB, sub)
+        # write the whole stream into sequence space; FIN rides after it
+        state = self.stack.tcp.send_app(
+            state, emitter, client_up, slot, self.total_bytes, now
+        )
+        state = self.stack.tcp.close_app(state, emitter, client_up, slot, now)
+        return state
+
+    def on_peer_fin(self, state, mask, slot, now, emitter, params):
+        """Server side: client finished sending → close our half too."""
+        srv = mask & (self._role == 0)
+        sub = dict(state.subs[self.SUB])
+        sub["eof_seen"] = sub["eof_seen"] + srv.astype(jnp.int64)
+        state = state.with_sub(self.SUB, sub)
+        state = self.stack.tcp.close_app(state, emitter, srv, slot, now)
+        return state
+
+    def handlers(self):
+        return {KIND_APP_TIMER: self.on_timer}

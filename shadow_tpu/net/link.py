@@ -30,12 +30,15 @@ def send(
     payload,
     params: NetParams,
     size_bytes,
+    control_mask=None,
 ):
     """Send one packet per masked host to dst_host, delivering at
     now + path latency, subject to the path's reliability roll.
 
-    size_bytes == 0 marks a control packet: never dropped by loss
-    (worker.c:543-545 keeps congestion control sane).
+    Control packets — zero PAYLOAD length (worker.c:543-545 keeps congestion
+    control sane) — are never dropped by loss. By default that's inferred
+    from size_bytes == 0; callers whose size_bytes includes headers pass
+    control_mask explicitly.
     Returns updated state (counters + RNG advance).
     """
     vs = state.host.vertex  # [H]
@@ -47,7 +50,11 @@ def send(
     roll_mask = mask & reachable
     state, u = draw_uniform(state, roll_mask)
     in_bootstrap = now < params.bootstrap_end
-    is_control = jnp.asarray(size_bytes) == 0
+    is_control = (
+        control_mask
+        if control_mask is not None
+        else jnp.asarray(size_bytes) == 0
+    )
     kept = in_bootstrap | is_control | (u < rel)
     deliver = roll_mask & kept
 

@@ -29,7 +29,7 @@ from shadow_tpu.core.state import (
     NetParams,
     SimState,
 )
-from shadow_tpu.net import codel, link, nic, packet as pkt, udp
+from shadow_tpu.net import codel, link, nic, packet as pkt, tcp as tcp_mod, udp
 
 KIND_NIC_SEND = 100
 KIND_NIC_RECV = KIND_NIC_REFILL
@@ -47,11 +47,14 @@ class NetStack:
         sockets_per_host: int = 8,
         router_queue_slots: int = 64,
         nic_queue_slots: int = 64,
+        tcp_ooo_chunks: int = tcp_mod.OOO_CHUNKS,
     ):
         self.num_hosts = num_hosts
         self._init_nic = nic.init(bw_up_bits, bw_down_bits, nic_queue_slots)
         self._init_router = codel.init(num_hosts, router_queue_slots)
         self._init_udp = udp.init(num_hosts, sockets_per_host)
+        self.tcp = tcp_mod.Tcp(num_hosts, sockets_per_host, tcp_ooo_chunks)
+        self.tcp.attach(self)
         self.recv_hooks: list[RecvHook] = []
 
     # ---- build-time API ----
@@ -62,6 +65,9 @@ class NetStack:
             self._init_udp, host, slot, port, peer_host, peer_port
         )
 
+    def tcp_listen(self, host: int, slot: int, port: int):
+        self.tcp.listen(host, slot, port)
+
     def on_receive(self, hook: RecvHook):
         self.recv_hooks.append(hook)
 
@@ -70,7 +76,26 @@ class NetStack:
             nic.SUB: self._init_nic,
             codel.SUB: self._init_router,
             udp.SUB: self._init_udp,
+            tcp_mod.SUB: self.tcp.init_sub(),
         }
+
+    # ---- generic transmit path (all protocols) ----
+
+    def _tx(self, state: SimState, emitter: Emitter, mask, now, dst_host,
+            payload) -> SimState:
+        """Queue an assembled packet on the sender's NIC ring and arm the
+        send pump (networkinterface_wantsSend analog)."""
+        H = self.num_hosts
+        hosts = jnp.arange(H, dtype=jnp.int32)
+        n = state.subs[nic.SUB]
+        n, ok = nic.enqueue_send(n, mask, dst_host.astype(jnp.int32), payload)
+        need = ok & ~n.send_pending
+        emitter.emit(
+            need, jnp.broadcast_to(now, (H,)).astype(jnp.int64), hosts,
+            jnp.int32(KIND_NIC_SEND), jnp.zeros_like(payload),
+        )
+        n = n.replace(send_pending=n.send_pending | need)
+        return state.with_sub(nic.SUB, n)
 
     # ---- runtime API (called from app handlers) ----
 
@@ -104,19 +129,15 @@ class NetStack:
                     jnp.asarray(socket_slot, jnp.int32), (H,)
                 ),
             )
-        n = state.subs[nic.SUB]
-        n, ok = nic.enqueue_send(n, mask, dst_host, payload)
+        n0 = state.subs[nic.SUB]
+        room = (n0.q_tail - n0.q_head) < n0.q_dst.shape[1]
+        ok = mask & room
         u = udp.count_sent(
             state.subs[udp.SUB], ok,
             jnp.broadcast_to(jnp.asarray(socket_slot, jnp.int32), (H,)), payload,
         )
-        need = ok & ~n.send_pending
-        emitter.emit(
-            need, jnp.broadcast_to(now, (H,)).astype(jnp.int64), hosts,
-            jnp.int32(KIND_NIC_SEND), jnp.zeros_like(payload),
-        )
-        n = n.replace(send_pending=n.send_pending | need)
-        return state.with_sub(nic.SUB, n).with_sub(udp.SUB, u)
+        state = self._tx(state, emitter, mask, now, dst_host, payload)
+        return state.with_sub(udp.SUB, u)
 
     # ---- engine handlers ----
 
@@ -132,16 +153,20 @@ class NetStack:
         c = state.counters
         state = state.replace(
             counters=c.replace(
-                packets_delivered=c.packets_delivered + jnp.sum(found, dtype=jnp.int64),
+                packets_delivered=c.packets_delivered + jnp.sum(mask, dtype=jnp.int64),
                 bytes_delivered=c.bytes_delivered
                 + jnp.sum(
-                    jnp.where(found, payload[:, pkt.W_LEN].astype(jnp.int64), 0)
+                    jnp.where(mask, payload[:, pkt.W_LEN].astype(jnp.int64), 0)
                 ),
             )
         )
         state = state.with_sub(udp.SUB, u)
         for hook in self.recv_hooks:
             state = hook(state, found, slot, src, payload, emitter, now, params)
+        is_tcp = mask & (payload[:, pkt.W_PROTO] == pkt.PROTO_TCP)
+        state = self.tcp.on_segment(
+            state, is_tcp, src, payload, emitter, now, params
+        )
         return state
 
     def on_pkt_deliver(
@@ -208,6 +233,7 @@ class NetStack:
         state = link.send(
             state, emitter, remote, dst, now, KIND_PKT_DELIVER, payload, params,
             jnp.where(remote, size, 0),
+            control_mask=payload[:, pkt.W_LEN] == 0,
         )
         # loopback: deliver at the same timestamp, no transit
         lb = do & (dst == hosts)
@@ -272,4 +298,5 @@ class NetStack:
             KIND_PKT_DELIVER: self.on_pkt_deliver,
             KIND_NIC_SEND: self.on_nic_send,
             KIND_NIC_RECV: self.on_nic_recv,
+            **self.tcp.handlers(),
         }

@@ -1,0 +1,1103 @@
+"""Vectorized TCP: per-socket SoA state machines stepped in parallel.
+
+Reference: src/main/host/descriptor/tcp.c (2665 LoC) — state machine
+CLOSED..LASTACK (tcp.c:41-46), server child-socket demux (:90-112), seq/ack
+send+receive windows (:124-172), retransmit queue + RTO + backoff (:174-189),
+congestion vtable with RENO (:202-203, tcp_cong_reno.c), RTT smoothing
+(:205-208), SACK lists (:145,171, tcp_retransmit_tally.cc).
+
+TPU-first re-architecture (SURVEY.md §7 hard part #1):
+
+- All sockets of all hosts live in one [H, S] struct-of-arrays table; every
+  handler applies masked element-wise updates, so one incoming segment per
+  host per micro-step advances H independent state machines at once.
+- Segment TRANSMISSION is a self-rearming output pump event (KIND_TCP_OUT,
+  one MSS segment per micro-step per host) feeding the NIC ring — the same
+  shape as the NIC send pump, replacing tcp.c's throttled-output queue.
+- The receive-side reorder buffer / SACK scoreboard
+  (tcp_retransmit_tally.cc's sorted interval lists) is re-expressed as a
+  bounded [H, S, W] boolean array of MSS-sized chunks beyond rcv_nxt:
+  out-of-order arrivals set their chunk flag; an in-order arrival absorbs
+  the contiguous prefix with a cumprod count and a gather shift. Segments
+  that are not MSS-aligned or land beyond W chunks are dropped (a dup-ACK
+  still goes back, so the sender retransmits; correctness is preserved,
+  only efficiency of the rare unaligned/far case is lost).
+- Retransmit timers are LAZY: the armed expire time lives in the table; the
+  scheduled event just says "look at socket s". Re-arming on every ACK
+  mutates only `rtx_expire` (no event churn); a firing timer whose expire
+  moved into the future re-emits itself at the new time. Generation counters
+  invalidate events from closed/reused sockets.
+- Sequence-number arithmetic is int32 with two's-complement wraparound
+  (seq_lt via sign of the wrapped difference), like the kernel's before/after
+  macros.
+
+Byte payloads are never materialized on device: the app-side stream is just
+sequence-space (`snd_buf_end` = bytes the app has written). Device apps
+consume instantly; the CPU syscall plane moves real bytes host-side keyed by
+sequence ranges.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import struct
+
+from shadow_tpu.core import simtime
+from shadow_tpu.core.state import PAYLOAD_WORDS
+from shadow_tpu.net import packet as pkt
+
+SUB = "tcp"
+
+# --- states (tcp.c:41-46) ---
+CLOSED = 0
+LISTEN = 1
+SYN_SENT = 2
+SYN_RECEIVED = 3
+ESTABLISHED = 4
+FIN_WAIT_1 = 5
+FIN_WAIT_2 = 6
+CLOSING = 7
+TIME_WAIT = 8
+CLOSE_WAIT = 9
+LAST_ACK = 10
+
+# --- header flags (standard bit positions) ---
+FIN = 0x01
+SYN = 0x02
+RST = 0x04
+ACK = 0x10
+
+MSS = pkt.MTU - pkt.TCP_HEADER_BYTES  # 1460
+INIT_CWND_SEGS = 10  # Linux-style initial window
+INIT_SSTHRESH = 1 << 30
+RTO_INIT_NS = simtime.NS_PER_SEC  # RFC 6298 initial RTO = 1 s
+RTO_MIN_NS = 200 * simtime.NS_PER_MS
+RTO_MAX_NS = 60 * simtime.NS_PER_SEC
+TIME_WAIT_NS = 60 * simtime.NS_PER_SEC  # reference CONFIG_TCPCLOSETIMER_DELAY
+RECV_WND = 1 << 20  # advertised receive window (app consumes instantly)
+OOO_BITS = 32  # legacy bitmap width (see _popcount/_trailing_ones helpers)
+OOO_CHUNKS = 64  # default reorder-scoreboard width in MSS chunks (~93 KiB)
+
+# timer kinds riding in timer-event payloads
+TIMER_RTX = 0
+TIMER_TIMEWAIT = 1
+
+# payload word assignments for TCP self-events (output pump / timers)
+EV_SLOT = 0  # socket slot
+EV_TKIND = 1  # timer kind
+EV_GEN = 2  # generation at scheduling time
+
+ANY_PEER = -1
+
+
+@struct.dataclass
+class TcpState:
+    # identity / binding
+    used: jnp.ndarray  # [H,S] bool
+    local_port: jnp.ndarray  # [H,S] i32
+    peer_host: jnp.ndarray  # [H,S] i32 (ANY_PEER for listeners)
+    peer_port: jnp.ndarray  # [H,S] i32
+    state: jnp.ndarray  # [H,S] i32
+    # send sequence space (int32, wraparound arithmetic)
+    snd_una: jnp.ndarray  # [H,S] oldest unacked
+    snd_nxt: jnp.ndarray  # [H,S] next to send
+    snd_max: jnp.ndarray  # [H,S] highest ever sent (retransmit detection)
+    snd_wnd: jnp.ndarray  # [H,S] peer-advertised window
+    snd_buf_end: jnp.ndarray  # [H,S] app stream write pointer (seq space)
+    fin_pending: jnp.ndarray  # [H,S] bool — app closed; FIN after data
+    fin_seq: jnp.ndarray  # [H,S] seq consumed by our FIN (valid once sent)
+    fin_sent: jnp.ndarray  # [H,S] bool
+    # receive sequence space
+    rcv_nxt: jnp.ndarray  # [H,S] i32
+    ooo_map: jnp.ndarray  # [H,S,W] bool — MSS chunks beyond rcv_nxt received
+    fin_rcvd_seq: jnp.ndarray  # [H,S] i32 seq of peer FIN (valid if fin_rcvd)
+    fin_rcvd: jnp.ndarray  # [H,S] bool — peer FIN seen (maybe out of order)
+    # congestion control (Reno — tcp_cong_reno.c)
+    cwnd: jnp.ndarray  # [H,S] i32 bytes
+    ssthresh: jnp.ndarray  # [H,S] i32 bytes
+    dup_acks: jnp.ndarray  # [H,S] i32
+    fast_recovery: jnp.ndarray  # [H,S] bool
+    recover: jnp.ndarray  # [H,S] i32 snd_max at FR entry (NewReno)
+    # RTT estimation (RFC 6298; tcp.c:205-208)
+    srtt: jnp.ndarray  # [H,S] i64 ns (0 = no sample yet)
+    rttvar: jnp.ndarray  # [H,S] i64 ns
+    rto: jnp.ndarray  # [H,S] i64 ns
+    rtt_armed: jnp.ndarray  # [H,S] bool — a timing sample is in flight
+    rtt_seq: jnp.ndarray  # [H,S] i32 — ack covering this seq closes the sample
+    rtt_start: jnp.ndarray  # [H,S] i64
+    # retransmit timer (lazy)
+    rtx_armed: jnp.ndarray  # [H,S] bool — an event is in flight
+    rtx_expire: jnp.ndarray  # [H,S] i64
+    gen: jnp.ndarray  # [H,S] i32 — invalidates stale timer events
+    # output pump dedup
+    out_pending: jnp.ndarray  # [H,S] bool
+    # app-visible accounting
+    bytes_acked: jnp.ndarray  # [H,S] i64 — app bytes the peer has acked
+    bytes_received: jnp.ndarray  # [H,S] i64 — in-order bytes delivered up
+    # drop/diagnostic counters
+    drop_no_socket: jnp.ndarray  # [] i64
+    drop_ooo: jnp.ndarray  # [] i64 — unaligned/far out-of-order discards
+    retransmits: jnp.ndarray  # [] i64
+    timeouts: jnp.ndarray  # [] i64
+    accept_overflow: jnp.ndarray  # [] i64 — SYN with no free child slot
+
+
+def init(num_hosts: int, sockets_per_host: int = 8,
+         ooo_chunks: int = OOO_CHUNKS) -> TcpState:
+    H, S = num_hosts, sockets_per_host
+    i32 = lambda v=0: jnp.full((H, S), v, jnp.int32)  # noqa: E731
+    i64 = lambda v=0: jnp.full((H, S), v, jnp.int64)  # noqa: E731
+    b = lambda: jnp.zeros((H, S), bool)  # noqa: E731
+    return TcpState(
+        used=b(), local_port=i32(), peer_host=i32(ANY_PEER), peer_port=i32(),
+        state=i32(CLOSED),
+        snd_una=i32(), snd_nxt=i32(), snd_max=i32(), snd_wnd=i32(RECV_WND),
+        snd_buf_end=i32(), fin_pending=b(), fin_seq=i32(), fin_sent=b(),
+        rcv_nxt=i32(), ooo_map=jnp.zeros((H, S, ooo_chunks), bool),
+        fin_rcvd_seq=i32(), fin_rcvd=b(),
+        cwnd=i32(INIT_CWND_SEGS * MSS), ssthresh=i32(INIT_SSTHRESH),
+        dup_acks=i32(), fast_recovery=b(), recover=i32(),
+        srtt=i64(), rttvar=i64(), rto=i64(RTO_INIT_NS),
+        rtt_armed=b(), rtt_seq=i32(), rtt_start=i64(),
+        rtx_armed=b(), rtx_expire=i64(simtime.NEVER), gen=i32(),
+        out_pending=b(),
+        bytes_acked=jnp.zeros((H, S), jnp.int64),
+        bytes_received=jnp.zeros((H, S), jnp.int64),
+        drop_no_socket=jnp.zeros((), jnp.int64),
+        drop_ooo=jnp.zeros((), jnp.int64),
+        retransmits=jnp.zeros((), jnp.int64),
+        timeouts=jnp.zeros((), jnp.int64),
+        accept_overflow=jnp.zeros((), jnp.int64),
+    )
+
+
+def listen_static(tcp: TcpState, host: int, slot: int, port: int) -> TcpState:
+    """Build-time passive open (socket+bind+listen)."""
+    return tcp.replace(
+        used=tcp.used.at[host, slot].set(True),
+        local_port=tcp.local_port.at[host, slot].set(port),
+        peer_host=tcp.peer_host.at[host, slot].set(ANY_PEER),
+        state=tcp.state.at[host, slot].set(LISTEN),
+    )
+
+
+# ---------------------------------------------------------------------------
+# sequence arithmetic (int32 wraparound, kernel before()/after() style)
+# ---------------------------------------------------------------------------
+
+
+def seq_lt(a, b):
+    return (b - a).astype(jnp.int32) > 0
+
+
+def seq_leq(a, b):
+    return (b - a).astype(jnp.int32) >= 0
+
+
+# ---------------------------------------------------------------------------
+# gather/scatter helpers at (host, slot)
+# ---------------------------------------------------------------------------
+
+
+def _g(arr, slot):
+    H = arr.shape[0]
+    hosts = jnp.arange(H, dtype=jnp.int32)
+    return arr[hosts, jnp.clip(slot, 0, arr.shape[1] - 1)]
+
+
+def _s(arr, mask, slot, val):
+    """Masked per-host scatter: arr[h, slot[h]] = val[h] where mask."""
+    H, S = arr.shape[:2]
+    hosts = jnp.arange(H, dtype=jnp.int32)
+    sl = jnp.where(mask, slot, S)
+    return arr.at[hosts, sl].set(val, mode="drop")
+
+
+# ---------------------------------------------------------------------------
+# demux (network_interface.c:391-441 + tcp.c:90-112 child demux)
+# ---------------------------------------------------------------------------
+
+
+def demux(tcp: TcpState, mask, payload, src_host):
+    """Match an incoming segment to a socket: established 4-tuple match
+    outranks a listener port match; lowest slot wins ties.
+
+    Returns (slot [H] i32, found [H] bool, is_listener [H] bool).
+    """
+    dport = payload[:, pkt.W_DST_PORT][:, None]
+    sport = payload[:, pkt.W_SRC_PORT][:, None]
+    srch = src_host.astype(jnp.int32)[:, None]
+    port_ok = tcp.used & (tcp.local_port == dport)
+    conn = port_ok & (tcp.peer_host == srch) & (tcp.peer_port == sport) & (
+        tcp.state != LISTEN
+    )
+    listener = port_ok & (tcp.state == LISTEN)
+    score = conn.astype(jnp.int32) * 2 + listener.astype(jnp.int32)
+    best = jnp.max(score, axis=1)
+    slot = jnp.argmax(score, axis=1).astype(jnp.int32)
+    found = mask & (best > 0)
+    is_listener = found & (best == 1)
+    return slot, found, is_listener
+
+
+# ---------------------------------------------------------------------------
+# segment assembly
+# ---------------------------------------------------------------------------
+
+
+def make_segment(src_port, dst_port, length, flags, seq, ack, wnd, src_host,
+                 socket_slot):
+    H = src_port.shape[0]
+    pl = jnp.zeros((H, PAYLOAD_WORDS), dtype=jnp.int32)
+    pl = pl.at[:, pkt.W_PROTO].set(pkt.PROTO_TCP)
+    pl = pl.at[:, pkt.W_SRC_PORT].set(src_port.astype(jnp.int32))
+    pl = pl.at[:, pkt.W_DST_PORT].set(dst_port.astype(jnp.int32))
+    pl = pl.at[:, pkt.W_LEN].set(length.astype(jnp.int32))
+    pl = pl.at[:, pkt.W_FLAGS].set(flags.astype(jnp.int32))
+    pl = pl.at[:, pkt.W_SEQ].set(seq.astype(jnp.int32))
+    pl = pl.at[:, pkt.W_ACK].set(ack.astype(jnp.int32))
+    pl = pl.at[:, pkt.W_WND].set(wnd.astype(jnp.int32))
+    pl = pl.at[:, pkt.W_SRC_HOST].set(src_host.astype(jnp.int32))
+    pl = pl.at[:, pkt.W_SOCKET].set(socket_slot.astype(jnp.int32))
+    return pl
+
+
+# ---------------------------------------------------------------------------
+# RTT / RTO (RFC 6298)
+# ---------------------------------------------------------------------------
+
+
+def _rtt_update(tcp: TcpState, mask, slot, now):
+    """Close the in-flight timing sample where the new ack covers rtt_seq."""
+    armed = _g(tcp.rtt_armed, slot)
+    take = mask & armed
+    r = (now - _g(tcp.rtt_start, slot)).astype(jnp.int64)
+    srtt0 = _g(tcp.srtt, slot)
+    rttvar0 = _g(tcp.rttvar, slot)
+    first = srtt0 == 0
+    srtt1 = jnp.where(first, r, srtt0 + (r - srtt0) // 8)
+    rttvar1 = jnp.where(
+        first, r // 2, rttvar0 + (jnp.abs(srtt0 - r) - rttvar0) // 4
+    )
+    rto1 = jnp.clip(srtt1 + 4 * rttvar1, RTO_MIN_NS, RTO_MAX_NS)
+    return tcp.replace(
+        srtt=_s(tcp.srtt, take, slot, srtt1),
+        rttvar=_s(tcp.rttvar, take, slot, rttvar1),
+        rto=_s(tcp.rto, take, slot, rto1),
+        rtt_armed=_s(tcp.rtt_armed, take, slot, jnp.zeros_like(armed)),
+    )
+
+
+# ---------------------------------------------------------------------------
+# OOO bitmap helpers (the bounded SACK scoreboard)
+# ---------------------------------------------------------------------------
+
+
+def _popcount(x):
+    x = x.astype(jnp.uint32)
+    x = x - ((x >> 1) & jnp.uint32(0x55555555))
+    x = (x & jnp.uint32(0x33333333)) + ((x >> 2) & jnp.uint32(0x33333333))
+    x = (x + (x >> 4)) & jnp.uint32(0x0F0F0F0F)
+    return ((x * jnp.uint32(0x01010101)) >> 24).astype(jnp.uint32)
+
+
+def _trailing_ones(x):
+    """Count of consecutive set bits from bit 0 of uint32 x."""
+    y = (~x).astype(jnp.uint32)
+    lsb = y & (jnp.uint32(0) - y)
+    return jnp.where(
+        y == 0, jnp.uint32(OOO_BITS), _popcount(lsb - jnp.uint32(1))
+    ).astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# The vectorized TCP machine
+# ---------------------------------------------------------------------------
+
+
+class Tcp:
+    """Composable TCP module: the stack feeds it demuxed segments; it feeds
+    the stack outgoing segments via ``stack._tx`` and schedules its own
+    output-pump/timer events.
+
+    App integration points:
+      on_established hooks: (state, mask, slot, is_accept, src, now, emitter,
+                             params) -> state
+      on_receive hooks:     (state, mask, slot, nbytes, src, now, emitter,
+                             params) -> state
+      on_peer_fin hooks:    (state, mask, slot, now, emitter, params) -> state
+    """
+
+    KIND_OUT = 101  # output pump self-event
+    KIND_TIMER = 102  # retransmit / timewait timer event
+
+    def __init__(self, num_hosts: int, sockets_per_host: int = 8,
+                 ooo_chunks: int = OOO_CHUNKS):
+        self.num_hosts = num_hosts
+        self.sockets_per_host = sockets_per_host
+        self.ooo_chunks = ooo_chunks
+        self._init = init(num_hosts, sockets_per_host, ooo_chunks)
+        self.established_hooks = []
+        self.receive_hooks = []
+        self.peer_fin_hooks = []
+
+    def attach(self, stack):
+        self.stack = stack
+
+    # ---- build-time API ----
+
+    def listen(self, host: int, slot: int, port: int):
+        self._init = listen_static(self._init, host, slot, port)
+
+    def init_sub(self) -> TcpState:
+        return self._init
+
+    def on_established(self, hook):
+        self.established_hooks.append(hook)
+
+    def on_receive(self, hook):
+        self.receive_hooks.append(hook)
+
+    def on_peer_fin(self, hook):
+        self.peer_fin_hooks.append(hook)
+
+    # ---- internal helpers ----
+
+    def _hosts(self):
+        return jnp.arange(self.num_hosts, dtype=jnp.int32)
+
+    def _arm_out(self, t: TcpState, emitter, mask, slot, now):
+        """Schedule the output pump for (host, slot) unless already pending."""
+        pending = _g(t.out_pending, slot)
+        need = mask & ~pending
+        H = self.num_hosts
+        pl = jnp.zeros((H, PAYLOAD_WORDS), jnp.int32)
+        pl = pl.at[:, EV_SLOT].set(slot.astype(jnp.int32))
+        emitter.emit(
+            need, jnp.broadcast_to(now, (H,)).astype(jnp.int64), self._hosts(),
+            jnp.int32(self.KIND_OUT), pl,
+        )
+        return t.replace(
+            out_pending=_s(t.out_pending, need, slot, jnp.ones_like(pending))
+        )
+
+    def _arm_rtx(self, t: TcpState, emitter, mask, slot, now):
+        """Start the lazy retransmit timer where not already running."""
+        armed = _g(t.rtx_armed, slot)
+        need = mask & ~armed
+        rto = _g(t.rto, slot)
+        expire = now + rto
+        H = self.num_hosts
+        pl = jnp.zeros((H, PAYLOAD_WORDS), jnp.int32)
+        pl = pl.at[:, EV_SLOT].set(slot.astype(jnp.int32))
+        pl = pl.at[:, EV_TKIND].set(TIMER_RTX)
+        pl = pl.at[:, EV_GEN].set(_g(t.gen, slot))
+        emitter.emit(
+            need, jnp.where(need, expire, 0).astype(jnp.int64), self._hosts(),
+            jnp.int32(self.KIND_TIMER), pl,
+        )
+        return t.replace(
+            rtx_armed=_s(t.rtx_armed, need, slot, jnp.ones_like(armed)),
+            rtx_expire=_s(t.rtx_expire, need, slot, expire),
+        )
+
+    def _push_back_rtx(self, t: TcpState, mask, slot, now):
+        """On new data acked: slide the armed timer's deadline to now+rto
+        without touching the in-flight event (it re-checks on fire)."""
+        armed = _g(t.rtx_armed, slot)
+        m = mask & armed
+        return t.replace(
+            rtx_expire=_s(t.rtx_expire, m, slot, now + _g(t.rto, slot))
+        )
+
+    def _tx_segment(self, state, emitter, mask, now, dst_host, *, slot,
+                    length, flags, seq, ack, dst_port=None, src_port=None):
+        """Assemble + hand a segment to the NIC ring (stack transmit path)."""
+        t = state.subs[SUB]
+        sp = src_port if src_port is not None else _g(t.local_port, slot)
+        dp = dst_port if dst_port is not None else _g(t.peer_port, slot)
+        seg = make_segment(
+            src_port=sp, dst_port=dp,
+            length=jnp.broadcast_to(jnp.asarray(length, jnp.int32),
+                                    (self.num_hosts,)),
+            flags=jnp.broadcast_to(jnp.asarray(flags, jnp.int32),
+                                   (self.num_hosts,)),
+            seq=seq, ack=ack,
+            wnd=jnp.full((self.num_hosts,), RECV_WND, jnp.int32),
+            src_host=self._hosts(), socket_slot=slot,
+        )
+        return self.stack._tx(state, emitter, mask, now, dst_host, seg)
+
+    # ---- runtime app API ----
+
+    def connect(self, state, emitter, mask, slot, dst_host, dst_port,
+                local_port, now):
+        """Active open: full slot re-init + SYN + retransmit timer.
+
+        Reference: tcp.c connect path; ISS is 0 (deterministic) — the
+        reference draws a random ISS but determinism is the property that
+        matters (SURVEY.md §5.2)."""
+        t = state.subs[SUB]
+        H = self.num_hosts
+        z32 = jnp.zeros((H,), jnp.int32)
+        one32 = jnp.ones((H,), jnp.int32)
+        fb = jnp.zeros((H,), bool)
+        slot = jnp.broadcast_to(jnp.asarray(slot, jnp.int32), (H,))
+        dst_host = jnp.broadcast_to(jnp.asarray(dst_host, jnp.int32), (H,))
+        dst_port = jnp.broadcast_to(jnp.asarray(dst_port, jnp.int32), (H,))
+        local_port = jnp.broadcast_to(jnp.asarray(local_port, jnp.int32), (H,))
+        m = mask
+        t = t.replace(
+            used=_s(t.used, m, slot, jnp.ones((H,), bool)),
+            local_port=_s(t.local_port, m, slot, local_port),
+            peer_host=_s(t.peer_host, m, slot, dst_host),
+            peer_port=_s(t.peer_port, m, slot, dst_port),
+            state=_s(t.state, m, slot, jnp.full((H,), SYN_SENT, jnp.int32)),
+            snd_una=_s(t.snd_una, m, slot, z32),
+            snd_nxt=_s(t.snd_nxt, m, slot, one32),
+            snd_max=_s(t.snd_max, m, slot, one32),
+            snd_wnd=_s(t.snd_wnd, m, slot, jnp.full((H,), RECV_WND, jnp.int32)),
+            snd_buf_end=_s(t.snd_buf_end, m, slot, one32),
+            fin_pending=_s(t.fin_pending, m, slot, fb),
+            fin_sent=_s(t.fin_sent, m, slot, fb),
+            rcv_nxt=_s(t.rcv_nxt, m, slot, z32),
+            ooo_map=_s(t.ooo_map, m, slot,
+                       jnp.zeros((H, self.ooo_chunks), bool)),
+            fin_rcvd=_s(t.fin_rcvd, m, slot, fb),
+            cwnd=_s(t.cwnd, m, slot,
+                    jnp.full((H,), INIT_CWND_SEGS * MSS, jnp.int32)),
+            ssthresh=_s(t.ssthresh, m, slot,
+                        jnp.full((H,), INIT_SSTHRESH, jnp.int32)),
+            dup_acks=_s(t.dup_acks, m, slot, z32),
+            fast_recovery=_s(t.fast_recovery, m, slot, fb),
+            srtt=_s(t.srtt, m, slot, jnp.zeros((H,), jnp.int64)),
+            rttvar=_s(t.rttvar, m, slot, jnp.zeros((H,), jnp.int64)),
+            rto=_s(t.rto, m, slot, jnp.full((H,), RTO_INIT_NS, jnp.int64)),
+            rtt_armed=_s(t.rtt_armed, m, slot, jnp.ones((H,), bool)),
+            rtt_seq=_s(t.rtt_seq, m, slot, one32),
+            rtt_start=_s(t.rtt_start, m, slot,
+                         jnp.broadcast_to(now, (H,)).astype(jnp.int64)),
+            out_pending=_s(t.out_pending, m, slot, fb),
+            bytes_acked=_s(t.bytes_acked, m, slot, jnp.zeros((H,), jnp.int64)),
+            bytes_received=_s(t.bytes_received, m, slot,
+                              jnp.zeros((H,), jnp.int64)),
+        )
+        state = state.with_sub(SUB, t)
+        # SYN: seq=iss(0), no data
+        state = self._tx_segment(
+            state, emitter, m, now, dst_host, slot=slot, length=0, flags=SYN,
+            seq=z32, ack=z32, dst_port=dst_port, src_port=local_port,
+        )
+        t = state.subs[SUB]
+        t = self._arm_rtx(t, emitter, m, slot, now)
+        return state.with_sub(SUB, t)
+
+    def send_app(self, state, emitter, mask, slot, nbytes, now):
+        """App writes nbytes into the stream (sequence space only)."""
+        t = state.subs[SUB]
+        ok = mask & _g(t.used, slot) & (
+            (_g(t.state, slot) == ESTABLISHED)
+            | (_g(t.state, slot) == CLOSE_WAIT)
+            | (_g(t.state, slot) == SYN_SENT)
+            | (_g(t.state, slot) == SYN_RECEIVED)
+        ) & ~_g(t.fin_pending, slot)
+        nb = jnp.broadcast_to(jnp.asarray(nbytes, jnp.int32),
+                              (self.num_hosts,))
+        t = t.replace(
+            snd_buf_end=_s(t.snd_buf_end, ok, slot,
+                           _g(t.snd_buf_end, slot) + nb)
+        )
+        t = self._arm_out(t, emitter, ok, slot, now)
+        return state.with_sub(SUB, t)
+
+    def close_app(self, state, emitter, mask, slot, now):
+        """App close: FIN goes out after all buffered data."""
+        t = state.subs[SUB]
+        ok = mask & _g(t.used, slot) & ~_g(t.fin_pending, slot) & (
+            (_g(t.state, slot) == ESTABLISHED)
+            | (_g(t.state, slot) == CLOSE_WAIT)
+            | (_g(t.state, slot) == SYN_SENT)
+            | (_g(t.state, slot) == SYN_RECEIVED)
+        )
+        t = t.replace(fin_pending=_s(t.fin_pending, ok, slot,
+                                     jnp.ones((self.num_hosts,), bool)))
+        t = self._arm_out(t, emitter, ok, slot, now)
+        return state.with_sub(SUB, t)
+
+    # ---- segment processing (tcp.c:1870 _tcp_processPacket) ----
+
+    def _emit_timer(self, emitter, mask, slot, tkind, gen, time):
+        H = self.num_hosts
+        pl = jnp.zeros((H, PAYLOAD_WORDS), jnp.int32)
+        pl = pl.at[:, EV_SLOT].set(slot.astype(jnp.int32))
+        pl = pl.at[:, EV_TKIND].set(jnp.broadcast_to(
+            jnp.asarray(tkind, jnp.int32), (H,)))
+        pl = pl.at[:, EV_GEN].set(gen.astype(jnp.int32))
+        emitter.emit(mask, jnp.where(mask, time, 0).astype(jnp.int64),
+                     self._hosts(), jnp.int32(self.KIND_TIMER), pl)
+
+    def on_segment(self, state, mask, src, payload, emitter, now, params):
+        """Process one incoming segment per host (vectorized over hosts)."""
+        H = self.num_hosts
+        t = state.subs[SUB]
+        fl = payload[:, pkt.W_FLAGS]
+        has_syn = (fl & SYN) != 0
+        has_ack = (fl & ACK) != 0
+        has_fin = (fl & FIN) != 0
+        has_rst = (fl & RST) != 0
+        seg_seq = payload[:, pkt.W_SEQ]
+        seg_ack = payload[:, pkt.W_ACK]
+        seg_wnd = payload[:, pkt.W_WND]
+        seg_len = payload[:, pkt.W_LEN]
+        sport = payload[:, pkt.W_SRC_PORT]
+        dport = payload[:, pkt.W_DST_PORT]
+        src = src.astype(jnp.int32)
+        now64 = now.astype(jnp.int64)
+
+        z32 = jnp.zeros((H,), jnp.int32)
+        one32 = jnp.ones((H,), jnp.int32)
+        fb = jnp.zeros((H,), bool)
+        tb = jnp.ones((H,), bool)
+        z64 = jnp.zeros((H,), jnp.int64)
+
+        slot, found, is_listener = demux(t, mask, payload, src)
+        t = t.replace(
+            drop_no_socket=t.drop_no_socket
+            + jnp.sum(mask & ~found, dtype=jnp.int64)
+        )
+
+        # ---------- passive open: SYN to listener → child socket ----------
+        m_syn = found & is_listener & has_syn & ~has_ack
+        free = ~t.used
+        has_free = jnp.any(free, axis=1)
+        child = jnp.argmax(free, axis=1).astype(jnp.int32)
+        mc = m_syn & has_free
+        t = t.replace(
+            accept_overflow=t.accept_overflow
+            + jnp.sum(m_syn & ~has_free, dtype=jnp.int64)
+        )
+        t = t.replace(
+            used=_s(t.used, mc, child, tb),
+            local_port=_s(t.local_port, mc, child, dport),
+            peer_host=_s(t.peer_host, mc, child, src),
+            peer_port=_s(t.peer_port, mc, child, sport),
+            state=_s(t.state, mc, child,
+                     jnp.full((H,), SYN_RECEIVED, jnp.int32)),
+            snd_una=_s(t.snd_una, mc, child, z32),
+            snd_nxt=_s(t.snd_nxt, mc, child, one32),
+            snd_max=_s(t.snd_max, mc, child, one32),
+            snd_wnd=_s(t.snd_wnd, mc, child, seg_wnd),
+            snd_buf_end=_s(t.snd_buf_end, mc, child, one32),
+            fin_pending=_s(t.fin_pending, mc, child, fb),
+            fin_sent=_s(t.fin_sent, mc, child, fb),
+            rcv_nxt=_s(t.rcv_nxt, mc, child, seg_seq + 1),
+            ooo_map=_s(t.ooo_map, mc, child,
+                       jnp.zeros((H, self.ooo_chunks), bool)),
+            fin_rcvd=_s(t.fin_rcvd, mc, child, fb),
+            cwnd=_s(t.cwnd, mc, child,
+                    jnp.full((H,), INIT_CWND_SEGS * MSS, jnp.int32)),
+            ssthresh=_s(t.ssthresh, mc, child,
+                        jnp.full((H,), INIT_SSTHRESH, jnp.int32)),
+            dup_acks=_s(t.dup_acks, mc, child, z32),
+            fast_recovery=_s(t.fast_recovery, mc, child, fb),
+            srtt=_s(t.srtt, mc, child, z64),
+            rttvar=_s(t.rttvar, mc, child, z64),
+            rto=_s(t.rto, mc, child, jnp.full((H,), RTO_INIT_NS, jnp.int64)),
+            rtt_armed=_s(t.rtt_armed, mc, child, tb),
+            rtt_seq=_s(t.rtt_seq, mc, child, one32),
+            rtt_start=_s(t.rtt_start, mc, child, now64),
+            rtx_armed=_s(t.rtx_armed, mc, child, fb),
+            gen=t.gen.at[self._hosts(), jnp.where(mc, child,
+                         self.sockets_per_host)].add(1, mode="drop"),
+            out_pending=_s(t.out_pending, mc, child, fb),
+            bytes_acked=_s(t.bytes_acked, mc, child, z64),
+            bytes_received=_s(t.bytes_received, mc, child, z64),
+        )
+        state = state.with_sub(SUB, t)
+        state = self._tx_segment(
+            state, emitter, mc, now64, src, slot=child, length=0,
+            flags=SYN | ACK, seq=z32, ack=seg_seq + 1,
+            dst_port=sport, src_port=dport,
+        )
+        t = state.subs[SUB]
+        t = self._arm_rtx(t, emitter, mc, child, now64)
+
+        # ---------- active open completes: SYN+ACK in SYN_SENT ----------
+        st = _g(t.state, slot)
+        m_conn = found & ~is_listener
+        m_ss = (
+            m_conn & (st == SYN_SENT) & has_syn & has_ack
+            & (seg_ack == _g(t.snd_nxt, slot))
+        )
+        t = t.replace(
+            state=_s(t.state, m_ss, slot,
+                     jnp.full((H,), ESTABLISHED, jnp.int32)),
+            rcv_nxt=_s(t.rcv_nxt, m_ss, slot, seg_seq + 1),
+            snd_una=_s(t.snd_una, m_ss, slot, seg_ack),
+            snd_wnd=_s(t.snd_wnd, m_ss, slot, seg_wnd),
+        )
+        t = _rtt_update(
+            t, m_ss & seq_leq(_g(t.rtt_seq, slot), seg_ack), slot, now64
+        )
+        state = state.with_sub(SUB, t)
+        state = self._tx_segment(
+            state, emitter, m_ss, now64, src, slot=slot, length=0, flags=ACK,
+            seq=_g(state.subs[SUB].snd_nxt, slot),
+            ack=_g(state.subs[SUB].rcv_nxt, slot),
+        )
+        for hook in self.established_hooks:
+            state = hook(state, m_ss, slot, fb, src, now64, emitter, params)
+        t = state.subs[SUB]
+        # app may have queued data inside the hook — pump if so
+        want_out = m_ss & (
+            seq_lt(_g(t.snd_nxt, slot), _g(t.snd_buf_end, slot))
+            | (_g(t.fin_pending, slot) & ~_g(t.fin_sent, slot))
+        )
+        t = self._arm_out(t, emitter, want_out, slot, now64)
+
+        # ---------- connection-state processing ----------
+        st = _g(t.state, slot)
+        m_proc = m_conn & ~m_ss & (st >= SYN_RECEIVED)
+
+        # RST tears the connection down (tcp.c RST handling, simplified)
+        m_rst = m_proc & has_rst
+        t = t.replace(
+            used=_s(t.used, m_rst, slot, fb),
+            state=_s(t.state, m_rst, slot, z32),
+            gen=t.gen.at[self._hosts(), jnp.where(m_rst, slot,
+                         self.sockets_per_host)].add(1, mode="drop"),
+        )
+        m_proc = m_proc & ~m_rst
+
+        # retransmitted SYN to a SYN_RECEIVED child → re-send SYN+ACK
+        resyn = m_proc & has_syn & ~has_ack & (st == SYN_RECEIVED)
+
+        # ---------- ACK processing (Reno hooks — tcp_cong_reno.c) ----------
+        una = _g(t.snd_una, slot)
+        nxt = _g(t.snd_nxt, slot)
+        smax = _g(t.snd_max, slot)
+        m_ack = m_proc & has_ack
+        acceptable = m_ack & seq_leq(una, seg_ack) & seq_leq(seg_ack, smax)
+        new_acked = acceptable & seq_lt(una, seg_ack)
+
+        # SYN_RECEIVED + ack of our SYN → ESTABLISHED (accept completes)
+        m_sr_est = new_acked & (st == SYN_RECEIVED)
+        t = t.replace(
+            state=_s(t.state, m_sr_est, slot,
+                     jnp.full((H,), ESTABLISHED, jnp.int32))
+        )
+
+        # duplicate-ACK detection (before una moves)
+        outstanding = seq_lt(una, nxt)
+        is_dup = (
+            m_ack & (seg_ack == una) & (seg_len == 0)
+            & ~has_syn & ~has_fin & outstanding
+        )
+        fr = _g(t.fast_recovery, slot)
+        dups0 = _g(t.dup_acks, slot)
+        dups1 = jnp.where(is_dup & ~fr, dups0 + 1, dups0)
+        trigger_fr = is_dup & ~fr & (dups1 == 3)
+        flight = (nxt - una).astype(jnp.int32)
+        ssth_on_loss = jnp.maximum(flight // 2, 2 * MSS)
+        inflate = is_dup & fr
+        cwnd0 = _g(t.cwnd, slot)
+        ssth0 = _g(t.ssthresh, slot)
+        cwnd1 = jnp.where(
+            trigger_fr, ssth_on_loss + 3 * MSS,
+            jnp.where(inflate, cwnd0 + MSS, cwnd0),
+        )
+        ssth1 = jnp.where(trigger_fr, ssth_on_loss, ssth0)
+        fr1 = fr | trigger_fr
+        rec1 = jnp.where(trigger_fr, smax, _g(t.recover, slot))
+
+        # new-ack Reno: full ack exits FR; partial ack retransmits the hole
+        full_ack = new_acked & fr1 & seq_leq(rec1, seg_ack)
+        partial_ack = new_acked & fr1 & ~full_ack
+        cwnd2 = jnp.where(full_ack, ssth1, cwnd1)
+        fr2 = fr1 & ~full_ack
+        dups2 = jnp.where(new_acked, 0, dups1)
+        grow = new_acked & ~fr1
+        acked_bytes = (seg_ack - una).astype(jnp.int32)
+        in_ss = cwnd2 < ssth1
+        cwnd3 = jnp.where(
+            grow & in_ss, cwnd2 + jnp.minimum(acked_bytes, MSS),
+            jnp.where(
+                grow & ~in_ss,
+                cwnd2 + jnp.maximum(1, (MSS * MSS) // jnp.maximum(cwnd2, 1)),
+                cwnd2,
+            ),
+        )
+
+        # bytes_acked accounting: subtract SYN/FIN phantom bytes
+        fin_seq_g = _g(t.fin_seq, slot)
+        fin_sent_g = _g(t.fin_sent, slot)
+        syn_ph = new_acked & (una == 0)
+        fin_acked = (
+            new_acked & fin_sent_g & seq_leq(una, fin_seq_g)
+            & seq_lt(fin_seq_g, seg_ack)
+        )
+        app_bytes = (
+            acked_bytes - syn_ph.astype(jnp.int32) - fin_acked.astype(jnp.int32)
+        )
+        t = t.replace(
+            snd_una=_s(t.snd_una, new_acked, slot, seg_ack),
+            snd_wnd=_s(t.snd_wnd, acceptable, slot, seg_wnd),
+            cwnd=_s(t.cwnd, m_ack, slot, cwnd3),
+            ssthresh=_s(t.ssthresh, m_ack, slot, ssth1),
+            dup_acks=_s(t.dup_acks, m_ack, slot, dups2),
+            fast_recovery=_s(t.fast_recovery, m_ack, slot, fr2),
+            recover=_s(t.recover, m_ack, slot, rec1),
+            bytes_acked=t.bytes_acked.at[
+                self._hosts(),
+                jnp.where(new_acked, slot, self.sockets_per_host),
+            ].add(app_bytes.astype(jnp.int64), mode="drop"),
+        )
+        t = _rtt_update(
+            t, new_acked & seq_leq(_g(t.rtt_seq, slot), seg_ack), slot, now64
+        )
+        t = self._push_back_rtx(t, new_acked, slot, now64)
+
+        # FIN-of-ours acked: FIN_WAIT_1→FIN_WAIT_2, CLOSING→TIME_WAIT,
+        # LAST_ACK→CLOSED
+        st_now = _g(t.state, slot)
+        t = t.replace(
+            state=_s(
+                t.state,
+                fin_acked,
+                slot,
+                jnp.where(
+                    st_now == FIN_WAIT_1, jnp.int32(FIN_WAIT_2),
+                    jnp.where(
+                        st_now == CLOSING, jnp.int32(TIME_WAIT),
+                        jnp.where(st_now == LAST_ACK, jnp.int32(CLOSED),
+                                  st_now),
+                    ),
+                ),
+            )
+        )
+        m_tw_enter = fin_acked & (st_now == CLOSING)
+        m_free = fin_acked & (st_now == LAST_ACK)
+
+        # fast/partial retransmit of the segment at (new) snd_una
+        do_rtx = trigger_fr | partial_ack
+        una2 = _g(t.snd_una, slot)
+        buf = _g(t.snd_buf_end, slot)
+        rtx_len = jnp.minimum(MSS, (buf - una2).astype(jnp.int32))
+        data_rtx = do_rtx & (rtx_len > 0)
+        fin_rtx = do_rtx & (rtx_len <= 0) & fin_sent_g
+        t = t.replace(
+            rtt_armed=_s(t.rtt_armed, do_rtx, slot, fb),  # Karn
+            retransmits=t.retransmits + jnp.sum(do_rtx, dtype=jnp.int64),
+        )
+        state = state.with_sub(SUB, t)
+        state = self._tx_segment(
+            state, emitter, data_rtx, now64, src, slot=slot,
+            length=rtx_len, flags=ACK, seq=una2,
+            ack=_g(state.subs[SUB].rcv_nxt, slot),
+        )
+        state = self._tx_segment(
+            state, emitter, fin_rtx, now64, src, slot=slot,
+            length=0, flags=FIN | ACK, seq=fin_seq_g,
+            ack=_g(state.subs[SUB].rcv_nxt, slot),
+        )
+        t = state.subs[SUB]
+
+        # accept-side established hooks (after accounting so hooks can send)
+        state = state.with_sub(SUB, t)
+        for hook in self.established_hooks:
+            state = hook(state, m_sr_est, slot, tb, src, now64, emitter,
+                         params)
+        t = state.subs[SUB]
+
+        # window may have opened → pump
+        can_more = (
+            (new_acked | inflate)
+            & (
+                seq_lt(_g(t.snd_nxt, slot), _g(t.snd_buf_end, slot))
+                | (_g(t.fin_pending, slot) & ~_g(t.fin_sent, slot))
+            )
+        )
+        t = self._arm_out(t, emitter, can_more, slot, now64)
+
+        # ---------- data receive (reorder scoreboard) ----------
+        st2 = _g(t.state, slot)
+        can_rcv = (
+            (st2 == ESTABLISHED) | (st2 == FIN_WAIT_1) | (st2 == FIN_WAIT_2)
+        )
+        m_data = m_proc & (seg_len > 0) & can_rcv
+        rn = _g(t.rcv_nxt, slot)
+        d = (seg_seq - rn).astype(jnp.int32)
+        in_order = m_data & (d == 0)
+        om = _g(t.ooo_map, slot)  # [H, W] bool
+        W = om.shape[1]
+        # chunk i = [rcv_nxt + i*MSS, +(i+1)*MSS); chunk 0 is by definition
+        # the missing in-order chunk and is never set. An in-order MSS
+        # arrival shifts everything down one chunk, then absorbs the run of
+        # already-received chunks now at the front. A short (final) segment
+        # clears the board (nothing beyond the end of stream).
+        tail = om[:, 1:].astype(jnp.int32)
+        n_absorb = jnp.where(
+            seg_len == MSS,
+            jnp.sum(jnp.cumprod(tail, axis=1), axis=1),
+            0,
+        ).astype(jnp.int32)
+        adv = jnp.where(in_order, seg_len + n_absorb * MSS, 0)
+        rn1 = rn + adv
+        shift = jnp.where(seg_len == MSS, 1 + n_absorb, jnp.int32(W))
+        idx = jnp.arange(W, dtype=jnp.int32)[None, :] + shift[:, None]
+        om_shifted = jnp.take_along_axis(
+            jnp.concatenate([om, jnp.zeros_like(om)], axis=1),
+            jnp.clip(idx, 0, 2 * W - 1),
+            axis=1,
+        )
+        om1 = jnp.where(in_order[:, None], om_shifted, om)
+        # out-of-order: flag the chunk if MSS-aligned and within the board
+        m_ooo = m_data & (d > 0)
+        kchunk = d // MSS
+        aligned = (
+            m_ooo & (d % MSS == 0) & (seg_len == MSS)
+            & (kchunk >= 1) & (kchunk < W)
+        )
+        om2 = om1.at[
+            self._hosts(), jnp.where(aligned, kchunk, W)
+        ].set(True, mode="drop")
+        t = t.replace(
+            rcv_nxt=_s(t.rcv_nxt, in_order, slot, rn1),
+            ooo_map=_s(t.ooo_map, in_order | aligned, slot, om2),
+            drop_ooo=t.drop_ooo + jnp.sum(m_ooo & ~aligned, dtype=jnp.int64),
+            bytes_received=t.bytes_received.at[
+                self._hosts(),
+                jnp.where(in_order, slot, self.sockets_per_host),
+            ].add(adv.astype(jnp.int64), mode="drop"),
+        )
+
+        # ---------- peer FIN ----------
+        m_fin = m_proc & has_fin & (
+            (st2 == ESTABLISHED) | (st2 == FIN_WAIT_1) | (st2 == FIN_WAIT_2)
+        )
+        t = t.replace(
+            fin_rcvd=_s(t.fin_rcvd, m_fin, slot, tb),
+            fin_rcvd_seq=_s(t.fin_rcvd_seq, m_fin, slot, seg_seq + seg_len),
+        )
+        # consume the FIN once all data before it has arrived
+        frs = _g(t.fin_rcvd_seq, slot)
+        frcvd = _g(t.fin_rcvd, slot)
+        rn_now = _g(t.rcv_nxt, slot)
+        st3 = _g(t.state, slot)
+        consume = (
+            m_proc & frcvd & (rn_now == frs)
+            & ((st3 == ESTABLISHED) | (st3 == FIN_WAIT_1)
+               | (st3 == FIN_WAIT_2))
+        )
+        t = t.replace(
+            rcv_nxt=_s(t.rcv_nxt, consume, slot, rn_now + 1),
+            state=_s(
+                t.state, consume, slot,
+                jnp.where(
+                    st3 == ESTABLISHED, jnp.int32(CLOSE_WAIT),
+                    jnp.where(st3 == FIN_WAIT_1, jnp.int32(CLOSING),
+                              jnp.int32(TIME_WAIT)),
+                ),
+            ),
+            fin_rcvd=_s(t.fin_rcvd, consume, slot, fb),
+        )
+        m_tw_enter = m_tw_enter | (consume & (st3 == FIN_WAIT_2))
+        m_eof = consume & (st3 == ESTABLISHED)
+
+        # ---------- TIME_WAIT timer + socket free ----------
+        self._emit_timer(
+            emitter, m_tw_enter, slot, TIMER_TIMEWAIT, _g(t.gen, slot),
+            now64 + TIME_WAIT_NS,
+        )
+        t = t.replace(
+            used=_s(t.used, m_free, slot, fb),
+            state=_s(t.state, m_free, slot, z32),
+            gen=t.gen.at[self._hosts(), jnp.where(m_free, slot,
+                         self.sockets_per_host)].add(1, mode="drop"),
+        )
+
+        # ---------- ACK reply ----------
+        # Reply to anything that consumed sequence space or was a
+        # (re)transmitted SYN; never reply to a pure ACK (no ack loops).
+        need_ack = (m_proc & ((seg_len > 0) | has_fin)) | resyn
+        reply_flags = jnp.where(resyn, jnp.int32(SYN | ACK), jnp.int32(ACK))
+        reply_seq = jnp.where(resyn, z32, _g(t.snd_nxt, slot))
+        state = state.with_sub(SUB, t)
+        state = self._tx_segment(
+            state, emitter, need_ack, now64, src, slot=slot, length=0,
+            flags=reply_flags, seq=reply_seq,
+            ack=_g(state.subs[SUB].rcv_nxt, slot),
+        )
+
+        # ---------- app hooks ----------
+        for hook in self.receive_hooks:
+            state = hook(state, in_order, slot, adv, src, now64, emitter,
+                         params)
+        for hook in self.peer_fin_hooks:
+            state = hook(state, m_eof, slot, now64, emitter, params)
+        return state
+
+    # ---- output pump (tcp.c throttled-output analog) ----
+
+    def on_out(self, state, ev, emitter, params):
+        """Send at most one segment per (host, slot) per micro-step; re-arm
+        while the window and stream allow more."""
+        H = self.num_hosts
+        t = state.subs[SUB]
+        slot = ev.payload[:, EV_SLOT]
+        now64 = ev.time.astype(jnp.int64)
+        fb = jnp.zeros((H,), bool)
+        m = ev.mask
+        t = t.replace(out_pending=_s(t.out_pending, m, slot, fb))
+        m = m & _g(t.used, slot)
+
+        st = _g(t.state, slot)
+        can_send = (
+            (st == ESTABLISHED) | (st == CLOSE_WAIT) | (st == FIN_WAIT_1)
+            | (st == CLOSING) | (st == LAST_ACK)
+        )
+        una = _g(t.snd_una, slot)
+        nxt = _g(t.snd_nxt, slot)
+        smax = _g(t.snd_max, slot)
+        buf = _g(t.snd_buf_end, slot)
+        wnd = jnp.minimum(_g(t.cwnd, slot), _g(t.snd_wnd, slot))
+        avail_win = (una + wnd - nxt).astype(jnp.int32)
+        have_data = seq_lt(nxt, buf)
+        seg_len = jnp.minimum(
+            jnp.minimum(MSS, (buf - nxt).astype(jnp.int32)), avail_win
+        )
+        send_data = m & can_send & have_data & (seg_len > 0)
+        fin_p = _g(t.fin_pending, slot)
+        fin_s = _g(t.fin_sent, slot)
+        send_fin = m & can_send & ~have_data & fin_p & ~fin_s
+
+        rn = _g(t.rcv_nxt, slot)
+        dst = _g(t.peer_host, slot)
+        state = state.with_sub(SUB, t)
+        state = self._tx_segment(
+            state, emitter, send_data, now64, dst, slot=slot,
+            length=jnp.maximum(seg_len, 0), flags=ACK, seq=nxt, ack=rn,
+        )
+        state = self._tx_segment(
+            state, emitter, send_fin, now64, dst, slot=slot,
+            length=0, flags=FIN | ACK, seq=nxt, ack=rn,
+        )
+        t = state.subs[SUB]
+
+        sent_any = send_data | send_fin
+        nxt1 = jnp.where(
+            send_data, nxt + seg_len, jnp.where(send_fin, nxt + 1, nxt)
+        )
+        is_rtx = sent_any & seq_lt(nxt, smax)
+        smax1 = jnp.where(seq_lt(smax, nxt1), nxt1, smax)
+        # first-FIN bookkeeping + state transition
+        t = t.replace(
+            snd_nxt=_s(t.snd_nxt, sent_any, slot, nxt1),
+            snd_max=_s(t.snd_max, sent_any, slot, smax1),
+            fin_seq=_s(t.fin_seq, send_fin, slot, nxt),
+            fin_sent=_s(t.fin_sent, send_fin, slot, jnp.ones((H,), bool)),
+            state=_s(
+                t.state, send_fin, slot,
+                jnp.where(
+                    st == ESTABLISHED, jnp.int32(FIN_WAIT_1),
+                    jnp.where(st == CLOSE_WAIT, jnp.int32(LAST_ACK), st),
+                ),
+            ),
+            retransmits=t.retransmits + jnp.sum(is_rtx, dtype=jnp.int64),
+        )
+        # RTT sample on fresh data
+        arm_rtt = send_data & ~_g(t.rtt_armed, slot) & ~is_rtx
+        t = t.replace(
+            rtt_armed=_s(t.rtt_armed, arm_rtt, slot, jnp.ones((H,), bool)),
+            rtt_seq=_s(t.rtt_seq, arm_rtt, slot, nxt1),
+            rtt_start=_s(t.rtt_start, arm_rtt, slot, now64),
+        )
+        t = self._arm_rtx(t, emitter, sent_any, slot, now64)
+
+        # more to send?
+        avail1 = (una + wnd - nxt1).astype(jnp.int32)
+        more_data = seq_lt(nxt1, buf) & (avail1 > 0)
+        more_fin = fin_p & ~_g(t.fin_sent, slot) & ~seq_lt(nxt1, buf)
+        more = m & can_send & sent_any & (more_data | more_fin)
+        t = self._arm_out(t, emitter, more, slot, now64)
+        return state.with_sub(SUB, t)
+
+    # ---- timers (lazy retransmit + TIME_WAIT) ----
+
+    def on_timer(self, state, ev, emitter, params):
+        H = self.num_hosts
+        t = state.subs[SUB]
+        slot = ev.payload[:, EV_SLOT]
+        tkind = ev.payload[:, EV_TKIND]
+        egen = ev.payload[:, EV_GEN]
+        now64 = ev.time.astype(jnp.int64)
+        fb = jnp.zeros((H,), bool)
+        z32 = jnp.zeros((H,), jnp.int32)
+        m = ev.mask & (_g(t.gen, slot) == egen) & _g(t.used, slot)
+
+        # TIME_WAIT expiry frees the slot (CONFIG_TCPCLOSETIMER_DELAY)
+        m_tw = m & (tkind == TIMER_TIMEWAIT) & (_g(t.state, slot) == TIME_WAIT)
+        t = t.replace(
+            used=_s(t.used, m_tw, slot, fb),
+            state=_s(t.state, m_tw, slot, z32),
+            gen=t.gen.at[self._hosts(), jnp.where(m_tw, slot,
+                         self.sockets_per_host)].add(1, mode="drop"),
+        )
+
+        # retransmit timer
+        m_rtx = m & (tkind == TIMER_RTX)
+        una = _g(t.snd_una, slot)
+        nxt = _g(t.snd_nxt, slot)
+        outstanding = seq_lt(una, nxt)
+        # all acked → quietly disarm
+        dis = m_rtx & ~outstanding
+        t = t.replace(rtx_armed=_s(t.rtx_armed, dis, slot, fb))
+        # deadline was pushed back by ACKs → re-check at the new deadline
+        exp = _g(t.rtx_expire, slot)
+        pushed = m_rtx & outstanding & (now64 < exp)
+        self._emit_timer(emitter, pushed, slot, TIMER_RTX, egen, exp)
+
+        # expired → timeout (tcp_cong_reno timeout hooks + RFC 6298 backoff)
+        fire = m_rtx & outstanding & (now64 >= exp)
+        flight = (nxt - una).astype(jnp.int32)
+        rto2 = jnp.minimum(_g(t.rto, slot) * 2, RTO_MAX_NS)
+        st = _g(t.state, slot)
+        fin_sent_g = _g(t.fin_sent, slot)
+        fin_seq_g = _g(t.fin_seq, slot)
+        # FIN unacked → re-send it via the pump after data
+        fin_rewind = fire & fin_sent_g & seq_leq(una, fin_seq_g)
+        hs = (st == SYN_SENT) | (st == SYN_RECEIVED)
+        t = t.replace(
+            ssthresh=_s(t.ssthresh, fire, slot,
+                        jnp.maximum(flight // 2, 2 * MSS)),
+            cwnd=_s(t.cwnd, fire, slot, jnp.full((H,), MSS, jnp.int32)),
+            dup_acks=_s(t.dup_acks, fire, slot, z32),
+            fast_recovery=_s(t.fast_recovery, fire, slot, fb),
+            rtt_armed=_s(t.rtt_armed, fire, slot, fb),
+            rto=_s(t.rto, fire, slot, rto2),
+            rtx_expire=_s(t.rtx_expire, fire, slot, now64 + rto2),
+            snd_nxt=_s(t.snd_nxt, fire & ~hs, slot, una),
+            fin_sent=_s(t.fin_sent, fin_rewind, slot, fb),
+            timeouts=t.timeouts + jnp.sum(fire, dtype=jnp.int64),
+            retransmits=t.retransmits + jnp.sum(fire, dtype=jnp.int64),
+        )
+        self._emit_timer(emitter, fire, slot, TIMER_RTX, egen, now64 + rto2)
+
+        # handshake retransmits go out directly; data goes via the pump
+        state = state.with_sub(SUB, t)
+        dst = _g(t.peer_host, slot)
+        state = self._tx_segment(
+            state, emitter, fire & (st == SYN_SENT), now64, dst, slot=slot,
+            length=0, flags=SYN, seq=z32, ack=z32,
+        )
+        state = self._tx_segment(
+            state, emitter, fire & (st == SYN_RECEIVED), now64, dst,
+            slot=slot, length=0, flags=SYN | ACK, seq=z32,
+            ack=_g(state.subs[SUB].rcv_nxt, slot),
+        )
+        t = state.subs[SUB]
+        t = self._arm_out(t, emitter, fire & ~hs, slot, now64)
+        return state.with_sub(SUB, t)
+
+    def handlers(self) -> dict:
+        return {self.KIND_OUT: self.on_out, self.KIND_TIMER: self.on_timer}

@@ -1,0 +1,11 @@
+"""CPU-side managed-process plane: run REAL Linux binaries inside the
+simulation via the native LD_PRELOAD shim (native/shim) and a shared-memory
+syscall channel.
+
+Reference parity: src/main/host/process.c / thread_preload.c /
+syscall_handler.c / lib/shim — re-architected per SURVEY.md §7.5: the
+interposition plane stays on CPU; the network hot path the syscalls feed is
+the device-stepped engine.
+"""
+
+from shadow_tpu.procs.driver import ManagedProcess, ProcessDriver  # noqa: F401

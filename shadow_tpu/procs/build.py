@@ -1,0 +1,36 @@
+"""Locate (and lazily build) the native shim library."""
+
+from __future__ import annotations
+
+import pathlib
+import shutil
+import subprocess
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parents[2]
+NATIVE_DIR = REPO_ROOT / "native"
+SHIM_SO = NATIVE_DIR / "build" / "libshadow_tpu_shim.so"
+
+
+def toolchain_available() -> bool:
+    return shutil.which("g++") is not None and shutil.which("make") is not None
+
+
+def shim_path(rebuild: bool = False) -> pathlib.Path:
+    """Return the shim .so path, building it if missing (or on rebuild)."""
+    src_newer = (
+        SHIM_SO.exists()
+        and max(
+            (NATIVE_DIR / "shim" / "shim.cpp").stat().st_mtime,
+            (NATIVE_DIR / "common" / "ipc.h").stat().st_mtime,
+        )
+        > SHIM_SO.stat().st_mtime
+    )
+    if rebuild or not SHIM_SO.exists() or src_newer:
+        if not toolchain_available():
+            raise RuntimeError(
+                "native toolchain (g++/make) unavailable and shim not built"
+            )
+        subprocess.run(
+            ["make", "-s"], cwd=NATIVE_DIR, check=True, capture_output=True
+        )
+    return SHIM_SO

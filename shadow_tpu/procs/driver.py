@@ -1,0 +1,228 @@
+"""Managed-process driver: spawns real binaries under the native shim and
+services their syscalls against the simulated network + clock.
+
+Reference parity map (SURVEY.md §3.3, §3.5):
+  - process launch env injection  -> ManagedProcess.spawn (LD_PRELOAD +
+    SHADOW_TPU_SHM), reference: manager.c:352-432, thread_preload.c:131-179
+  - resume/syscall event loop     -> ProcessDriver._service_one, reference:
+    threadpreload_resume (thread_preload.c:200-291)
+  - syscall dispatch              -> ProcessDriver._dispatch, reference:
+    syscallhandler_make_syscall (syscall_handler.c:247-511)
+  - SYSCALL_BLOCK + condition     -> Parked records + wake events, reference:
+    syscall_condition.c
+  - scheduler determinism         -> strict sequential service order over
+    processes + (time, seq) event heap, reference: event.c:109-152
+
+Execution model: a managed process is either RUNNING (we posted its reply;
+it is executing app code; the driver waits for its next syscall) or PARKED
+(its last syscall blocked; no reply posted yet — the process sits in
+sem_wait). Sim time advances only when every live process is parked, exactly
+the reference's conservative rule that plugin execution happens "inside" an
+event at a fixed sim time.
+
+The network model here is the stage-A CPU backend: latency/loss scheduling
+in a Python heap with a simplified reliable TCP (no cwnd dynamics). It is
+the golden reference for dual-target tests; the device-stepped engine is the
+performance path and the two are bridged at the Router seam (stage B).
+"""
+
+from __future__ import annotations
+
+import errno
+import heapq
+import os
+import random
+import subprocess
+import time as wall_time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable
+
+from shadow_tpu.procs import build as build_mod
+from shadow_tpu.procs import ipc
+
+NS_PER_SEC = 1_000_000_000
+
+# Linux x86-64 syscall numbers the shim forwards
+SYS_read = 0
+SYS_write = 1
+SYS_close = 3
+SYS_poll = 7
+SYS_ioctl = 16
+SYS_nanosleep = 35
+SYS_socket = 41
+SYS_connect = 42
+SYS_accept = 43
+SYS_sendto = 44
+SYS_recvfrom = 45
+SYS_shutdown = 48
+SYS_bind = 49
+SYS_listen = 50
+SYS_getsockname = 51
+SYS_getpeername = 52
+SYS_setsockopt = 54
+SYS_getsockopt = 55
+SYS_fcntl = 72
+SYS_gettimeofday = 96
+SYS_clock_gettime = 228
+SYS_epoll_wait = 232
+SYS_epoll_ctl = 233
+SYS_accept4 = 288
+SYS_epoll_create1 = 291
+
+SOCK_STREAM = 1
+SOCK_DGRAM = 2
+SOCK_NONBLOCK = 0o4000
+O_NONBLOCK = 0o4000
+F_GETFL = 3
+F_SETFL = 4
+FIONREAD = 0x541B
+
+EPOLL_CTL_ADD = 1
+EPOLL_CTL_DEL = 2
+EPOLL_CTL_MOD = 3
+EPOLLIN = 0x1
+EPOLLOUT = 0x4
+EPOLLERR = 0x8
+EPOLLHUP = 0x10
+POLLIN = 0x1
+POLLOUT = 0x4
+POLLERR = 0x8
+POLLHUP = 0x10
+
+
+# ---------------------------------------------------------------------------
+# simulated socket objects (driver side)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Sock:
+    fd: int
+    proto: int  # SOCK_DGRAM | SOCK_STREAM
+    owner: "ManagedProcess"
+    bound: tuple[int, int] | None = None  # (ip, port)
+    peer: tuple[int, int] | None = None
+    nonblock: bool = False
+    # UDP: deque of (src_ip, src_port, bytes)
+    dgrams: deque = field(default_factory=deque)
+    # TCP
+    listening: bool = False
+    accept_q: deque = field(default_factory=deque)  # Conn objects
+    conn: "Conn | None" = None
+    connecting: bool = False
+
+    def readable(self) -> bool:
+        if self.proto == SOCK_DGRAM:
+            return len(self.dgrams) > 0
+        if self.listening:
+            return len(self.accept_q) > 0
+        if self.conn is not None:
+            return len(self.conn.rx) > 0 or self.conn.rx_eof
+        return False
+
+    def writable(self) -> bool:
+        if self.proto == SOCK_DGRAM:
+            return True
+        return self.conn is not None and self.conn.established
+
+
+@dataclass
+class Conn:
+    """One direction-pair of a stage-A TCP connection (per endpoint)."""
+
+    established: bool = False
+    rx: bytearray = field(default_factory=bytearray)
+    rx_eof: bool = False
+    remote: "Conn | None" = None  # the peer endpoint's Conn
+    remote_addr: tuple[int, int] | None = None
+    local_addr: tuple[int, int] | None = None
+
+
+@dataclass
+class Epoll:
+    fd: int
+    owner: "ManagedProcess"
+    interest: dict = field(default_factory=dict)  # fd -> (events, data)
+
+
+@dataclass
+class Parked:
+    """A blocked syscall awaiting a condition (syscall_condition.c analog)."""
+
+    proc: "ManagedProcess"
+    kind: str  # recv|accept|connect|sleep|poll|epoll
+    fd: int = -1
+    want: int = 0
+    deadline: int | None = None  # sim ns; None = no timeout
+    pollset: list = field(default_factory=list)  # [(fd, events)]
+    epfd: int = -1
+    maxevents: int = 0
+
+
+class ManagedProcess:
+    RUNNING = "running"
+    PARKED = "parked"
+    EXITED = "exited"
+
+    def __init__(self, name: str, args: list[str], host: "SimHost",
+                 start_time: int = 0, env: dict | None = None,
+                 cwd: str | None = None):
+        self.name = name
+        self.args = args
+        self.host = host
+        self.start_time = start_time
+        self.extra_env = env or {}
+        self.cwd = cwd
+        self.channel: ipc.Channel | None = None
+        self.popen: subprocess.Popen | None = None
+        self.state = ManagedProcess.PARKED  # not yet spawned
+        self.fds: dict[int, object] = {}
+        self.next_fd = ipc.FD_BASE
+        self.parked: Parked | None = None
+        self.exit_code: int | None = None
+
+    def spawn(self, spin: int = 4096) -> None:
+        self.channel = ipc.Channel()
+        env = dict(os.environ)
+        env["LD_PRELOAD"] = str(build_mod.shim_path())
+        env[ipc.ENV_SHM] = self.channel.path
+        env[ipc.ENV_SPIN] = str(spin)
+        env.update(self.extra_env)
+        self.popen = subprocess.Popen(
+            self.args, env=env, cwd=self.cwd,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        )
+        self.state = ManagedProcess.RUNNING  # executing until HELLO arrives
+
+    def alloc_fd(self) -> int:
+        fd = self.next_fd
+        self.next_fd += 1
+        return fd
+
+    def alive(self) -> bool:
+        return self.state != ManagedProcess.EXITED
+
+    def finish(self) -> tuple[bytes, bytes]:
+        out, err = b"", b""
+        if self.popen:
+            try:
+                out, err = self.popen.communicate(timeout=10)
+            except subprocess.TimeoutExpired:
+                self.popen.kill()
+                out, err = self.popen.communicate()
+            self.exit_code = self.popen.returncode
+        if self.channel:
+            self.channel.close()
+            self.channel = None
+        self.state = ManagedProcess.EXITED
+        return out, err
+
+
+@dataclass
+class SimHost:
+    """A simulated host that owns managed processes (host.c analog)."""
+
+    name: str
+    ip: int  # ipv4 host-order
+    procs: list = field(default_factory=list)

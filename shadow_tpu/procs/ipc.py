@@ -1,0 +1,163 @@
+"""ctypes view of the native IPC channel (native/common/ipc.h).
+
+The struct layout is pinned by static_asserts in the header; offsets here
+must match. Semaphores are glibc process-shared sem_t operated directly in
+the mapped file via ctypes calls into libpthread — the driver-side half of
+the reference's spinning-sem channel (binary_spinning_sem.h), with the spin
+loop living on the C++ side only (Python parks straight away; its reply
+latency is dominated by handler work, not the futex).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import ctypes.util
+import mmap
+import os
+import struct
+import tempfile
+
+IPC_MAGIC = 0x53545031
+IPC_DATA_MAX = 1 << 16
+
+MSG_NONE = 0
+MSG_HELLO = 1
+MSG_SYSCALL = 2
+MSG_RESULT = 3
+MSG_DO_NATIVE = 4
+MSG_STOP = 5
+
+PSYS_RESOLVE_NAME = -100
+PSYS_YIELD = -101
+PSYS_GETHOSTNAME = -102
+
+FD_BASE = 1000
+
+# field offsets (pinned in ipc.h)
+OFF_MAGIC = 0
+OFF_SHIM_PID = 4
+OFF_SEM_TO_DRIVER = 8
+OFF_SEM_TO_SHIM = 40
+OFF_TYPE = 72
+OFF_SYSNO = 80
+OFF_ARGS = 88
+OFF_RET = 136
+OFF_SIM_TIME = 144
+OFF_DATA_LEN = 152
+OFF_DATA = 160
+CHANNEL_SIZE = OFF_DATA + IPC_DATA_MAX
+
+ENV_SHM = "SHADOW_TPU_SHM"
+ENV_SPIN = "SHADOW_TPU_SPIN"
+ENV_DEBUG = "SHADOW_TPU_SHIM_DEBUG"
+
+_libpthread = ctypes.CDLL(None, use_errno=True)  # glibc hosts sem_* now
+
+
+class _timespec(ctypes.Structure):
+    _fields_ = [("tv_sec", ctypes.c_long), ("tv_nsec", ctypes.c_long)]
+
+
+_libpthread.sem_init.argtypes = [ctypes.c_void_p, ctypes.c_int, ctypes.c_uint]
+_libpthread.sem_post.argtypes = [ctypes.c_void_p]
+_libpthread.sem_wait.argtypes = [ctypes.c_void_p]
+_libpthread.sem_trywait.argtypes = [ctypes.c_void_p]
+_libpthread.sem_timedwait.argtypes = [ctypes.c_void_p,
+                                      ctypes.POINTER(_timespec)]
+
+
+class Channel:
+    """Driver-side handle on one managed process's channel."""
+
+    def __init__(self, path: str | None = None):
+        if path is None:
+            fd, path = tempfile.mkstemp(prefix="shadow_tpu_ch_",
+                                        dir="/dev/shm")
+            os.ftruncate(fd, CHANNEL_SIZE)
+        else:
+            fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o600)
+            os.ftruncate(fd, CHANNEL_SIZE)
+        self.path = path
+        self._mm = mmap.mmap(fd, CHANNEL_SIZE)
+        os.close(fd)
+        self._buf = (ctypes.c_char * CHANNEL_SIZE).from_buffer(self._mm)
+        self._base = ctypes.addressof(self._buf)
+        # init semaphores (pshared=1, value=0), then the magic
+        for off in (OFF_SEM_TO_DRIVER, OFF_SEM_TO_SHIM):
+            if _libpthread.sem_init(self._base + off, 1, 0) != 0:
+                raise OSError("sem_init failed")
+        self._mm[OFF_MAGIC:OFF_MAGIC + 4] = struct.pack("<I", IPC_MAGIC)
+
+    # --- raw field access ---
+
+    def _i32(self, off) -> int:
+        return struct.unpack_from("<i", self._mm, off)[0]
+
+    def _i64(self, off) -> int:
+        return struct.unpack_from("<q", self._mm, off)[0]
+
+    @property
+    def shim_pid(self) -> int:
+        return self._i32(OFF_SHIM_PID)
+
+    @property
+    def msg_type(self) -> int:
+        return self._i32(OFF_TYPE)
+
+    @property
+    def sysno(self) -> int:
+        return self._i64(OFF_SYSNO)
+
+    @property
+    def args(self) -> list[int]:
+        return list(struct.unpack_from("<6q", self._mm, OFF_ARGS))
+
+    @property
+    def data(self) -> bytes:
+        n = self._i32(OFF_DATA_LEN)
+        n = max(0, min(n, IPC_DATA_MAX))
+        return self._mm[OFF_DATA:OFF_DATA + n]
+
+    def reply(self, ret: int, *, sim_time_ns: int, data: bytes = b"",
+              msg_type: int = MSG_RESULT) -> None:
+        """Write the response and wake the shim."""
+        if len(data) > IPC_DATA_MAX:
+            raise ValueError("reply data too large")
+        struct.pack_into("<i", self._mm, OFF_TYPE, msg_type)
+        struct.pack_into("<q", self._mm, OFF_RET, ret)
+        struct.pack_into("<q", self._mm, OFF_SIM_TIME, sim_time_ns)
+        struct.pack_into("<i", self._mm, OFF_DATA_LEN, len(data))
+        if data:
+            self._mm[OFF_DATA:OFF_DATA + len(data)] = data
+        _libpthread.sem_post(self._base + OFF_SEM_TO_SHIM)
+
+    def wait_request(self, timeout_s: float | None = None) -> bool:
+        """Block until the shim posts a request. Returns False on timeout."""
+        if timeout_s is None:
+            while _libpthread.sem_wait(self._base + OFF_SEM_TO_DRIVER) != 0:
+                pass
+            return True
+        now = os.times().elapsed  # unused; use clock_gettime for abs time
+        ts = _timespec()
+        import time as _time
+
+        deadline = _time.clock_gettime(_time.CLOCK_REALTIME) + timeout_s
+        ts.tv_sec = int(deadline)
+        ts.tv_nsec = int((deadline - int(deadline)) * 1e9)
+        r = _libpthread.sem_timedwait(self._base + OFF_SEM_TO_DRIVER,
+                                      ctypes.byref(ts))
+        return r == 0
+
+    def try_request(self) -> bool:
+        return _libpthread.sem_trywait(self._base + OFF_SEM_TO_DRIVER) == 0
+
+    def close(self) -> None:
+        try:
+            del self._buf
+            self._mm.close()
+        except BufferError:
+            pass
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass

@@ -15,7 +15,7 @@ from shadow_tpu.core import simtime, units
 from shadow_tpu.core.config import Config, load_config
 from shadow_tpu.core.engine import Simulation
 from shadow_tpu.core.state import NetParams
-from shadow_tpu.net.apps import PholdApp, UdpEchoApp, UdpFloodApp
+from shadow_tpu.net.apps import PholdApp, TcpBulkApp, UdpEchoApp, UdpFloodApp
 from shadow_tpu.net.stack import NetStack
 from shadow_tpu.routing.dns import Dns
 from shadow_tpu.routing.topology import BakedPaths, Topology
@@ -94,7 +94,7 @@ def build_simulation(source) -> Simulation:
         subs[PholdApp.SUB] = app.init_sub()
         initial_events.extend(app.initial_events())
 
-    stack_apps = app_names & {"udp_flood", "udp_echo"}
+    stack_apps = app_names & {"udp_flood", "udp_echo", "tcp_bulk"}
     if stack_apps:
         if len(stack_apps) > 1 or "phold" in app_names:
             raise BuildError("only one app model per simulation for now")
@@ -152,6 +152,12 @@ def build_simulation(source) -> Simulation:
                 size_bytes=int(client_opts.get("size", 1024)),
                 start_time=start, stop_sending=stop_send,
             )
+        elif name == "tcp_bulk":
+            app = TcpBulkApp(
+                H, servers,
+                total_bytes=units.parse_bytes(client_opts.get("total", "1 MiB")),
+                start_time=start,
+            )
         else:
             if len(servers) != 1:
                 raise BuildError("udp_echo supports exactly one server host")
@@ -161,14 +167,15 @@ def build_simulation(source) -> Simulation:
                 start_time=start, stop_sending=stop_send,
             )
         app.attach(stack)
-        stack.on_receive(app.on_receive)
+        if hasattr(app, "on_receive"):
+            stack.on_receive(app.on_receive)
         handlers.update(stack.handlers())
         handlers.update(app.handlers())
         subs.update(stack.init_subs())
         subs[app.SUB] = app.init_sub()
         initial_events.extend(app.initial_events())
 
-    unknown = app_names - {"phold", "udp_flood", "udp_echo"}
+    unknown = app_names - {"phold", "udp_flood", "udp_echo", "tcp_bulk"}
     if unknown:
         raise BuildError(f"unknown app model(s): {sorted(unknown)}")
 
