@@ -82,9 +82,10 @@ class NetStack:
     # ---- generic transmit path (all protocols) ----
 
     def _tx(self, state: SimState, emitter: Emitter, mask, now, dst_host,
-            payload) -> SimState:
+            payload):
         """Queue an assembled packet on the sender's NIC ring and arm the
-        send pump (networkinterface_wantsSend analog)."""
+        send pump (networkinterface_wantsSend analog). Returns
+        (state, ok) where ok marks hosts whose packet was admitted."""
         H = self.num_hosts
         hosts = jnp.arange(H, dtype=jnp.int32)
         n = state.subs[nic.SUB]
@@ -95,7 +96,7 @@ class NetStack:
             jnp.int32(KIND_NIC_SEND), jnp.zeros_like(payload),
         )
         n = n.replace(send_pending=n.send_pending | need)
-        return state.with_sub(nic.SUB, n)
+        return state.with_sub(nic.SUB, n), ok
 
     # ---- runtime API (called from app handlers) ----
 
@@ -129,14 +130,11 @@ class NetStack:
                     jnp.asarray(socket_slot, jnp.int32), (H,)
                 ),
             )
-        n0 = state.subs[nic.SUB]
-        room = (n0.q_tail - n0.q_head) < n0.q_dst.shape[1]
-        ok = mask & room
+        state, ok = self._tx(state, emitter, mask, now, dst_host, payload)
         u = udp.count_sent(
             state.subs[udp.SUB], ok,
             jnp.broadcast_to(jnp.asarray(socket_slot, jnp.int32), (H,)), payload,
         )
-        state = self._tx(state, emitter, mask, now, dst_host, payload)
         return state.with_sub(udp.SUB, u)
 
     # ---- engine handlers ----

@@ -426,7 +426,8 @@ class Tcp:
             wnd=jnp.full((self.num_hosts,), RECV_WND, jnp.int32),
             src_host=self._hosts(), socket_slot=slot,
         )
-        return self.stack._tx(state, emitter, mask, now, dst_host, seg)
+        state, _ok = self.stack._tx(state, emitter, mask, now, dst_host, seg)
+        return state
 
     # ---- runtime app API ----
 

@@ -111,6 +111,7 @@ class Sock:
     accept_q: deque = field(default_factory=deque)  # Conn objects
     conn: "Conn | None" = None
     connecting: bool = False
+    conn_refused: bool = False
 
     def readable(self) -> bool:
         if self.proto == SOCK_DGRAM:
@@ -137,6 +138,7 @@ class Conn:
     remote: "Conn | None" = None  # the peer endpoint's Conn
     remote_addr: tuple[int, int] | None = None
     local_addr: tuple[int, int] | None = None
+    sock: "Sock | None" = None  # owning endpoint socket (None until accepted)
 
 
 @dataclass
@@ -226,3 +228,781 @@ class SimHost:
     name: str
     ip: int  # ipv4 host-order
     procs: list = field(default_factory=list)
+    next_port: int = 10000  # ephemeral port allocator (deterministic)
+
+
+def ip_from_str(s: str) -> int:
+    parts = [int(p) for p in s.split(".")]
+    return (parts[0] << 24) | (parts[1] << 16) | (parts[2] << 8) | parts[3]
+
+
+def ip_to_str(ip: int) -> str:
+    return f"{(ip >> 24) & 255}.{(ip >> 16) & 255}.{(ip >> 8) & 255}.{ip & 255}"
+
+
+class DriverError(RuntimeError):
+    pass
+
+
+class ProcessDriver:
+    """Sequential syscall service loop over all managed processes.
+
+    Determinism by construction (reference analog: event.c:109-152 total
+    order + one-worker-per-host rounds): processes are serviced one at a
+    time in registration order; a process runs until its syscall BLOCKs;
+    sim time advances only when every live process is parked; network
+    events fire from a (time, seq) heap; loss rolls come from one seeded
+    RNG consumed in event order.
+
+    The network model is the stage-A CPU backend (latency + loss + byte
+    streams); the device-stepped engine is the performance path, bridged at
+    the Router seam in stage B.
+    """
+
+    def __init__(
+        self,
+        *,
+        stop_time: int = 60 * NS_PER_SEC,
+        latency_ns: int = 10_000_000,
+        loss: float = 0.0,
+        seed: int = 1,
+        spin: int = 4096,
+        service_timeout_s: float = 10.0,
+    ):
+        self.stop_time = int(stop_time)
+        self.latency_ns = int(latency_ns)
+        self.loss = float(loss)
+        self.seed = seed
+        self.spin = spin
+        self.service_timeout_s = service_timeout_s
+        self.now = 0
+        self.hosts: list[SimHost] = []
+        self.procs: list[ManagedProcess] = []
+        self._heap: list = []  # (time, seq, callback)
+        self._seq = 0
+        self._rng = random.Random(seed)
+        # (ip, port) -> Sock, per protocol
+        self._udp_binds: dict[tuple[int, int], Sock] = {}
+        self._tcp_binds: dict[tuple[int, int], Sock] = {}
+        self._latency_fn: Callable[[int, int], int] | None = None
+        self.counters = {
+            "syscalls": 0,
+            "packets_sent": 0,
+            "packets_dropped": 0,
+            "bytes_sent": 0,
+        }
+
+    # ------------------------------------------------------------------
+    # build API
+    # ------------------------------------------------------------------
+
+    def add_host(self, name: str, ip: str | int) -> SimHost:
+        h = SimHost(name=name, ip=ip if isinstance(ip, int) else ip_from_str(ip))
+        self.hosts.append(h)
+        return h
+
+    def add_process(
+        self, host: SimHost, args: list[str], start_time: int = 0,
+        env: dict | None = None, cwd: str | None = None,
+    ) -> ManagedProcess:
+        p = ManagedProcess(
+            name=f"{host.name}.{len(host.procs)}", args=args, host=host,
+            start_time=start_time, env=env, cwd=cwd,
+        )
+        host.procs.append(p)
+        self.procs.append(p)
+        return p
+
+    def set_latency_fn(self, fn: Callable[[int, int], int]) -> None:
+        """fn(src_ip, dst_ip) -> one-way latency ns (topology hook)."""
+        self._latency_fn = fn
+
+    # ------------------------------------------------------------------
+    # event heap
+    # ------------------------------------------------------------------
+
+    def _schedule(self, t: int, cb: Callable[[], None]) -> None:
+        self._seq += 1
+        heapq.heappush(self._heap, (t, self._seq, cb))
+
+    def _latency(self, src_ip: int, dst_ip: int) -> int:
+        if src_ip == dst_ip:
+            return 0  # loopback: same-timestamp delivery (netif loopback path)
+        if self._latency_fn is not None:
+            return self._latency_fn(src_ip, dst_ip)
+        return self.latency_ns
+
+    def _drop_roll(self, src_ip: int, dst_ip: int, control: bool) -> bool:
+        """True if the packet is dropped (reference: worker.c:539-545;
+        zero-length control packets are never dropped)."""
+        if control or self.loss <= 0.0 or src_ip == dst_ip:
+            return False
+        return self._rng.random() < self.loss
+
+    def _host_by_ip(self, ip: int) -> SimHost | None:
+        for h in self.hosts:
+            if h.ip == ip:
+                return h
+        return None
+
+    def _host_by_name(self, name: str) -> SimHost | None:
+        for h in self.hosts:
+            if h.name == name:
+                return h
+        return None
+
+    # ------------------------------------------------------------------
+    # readiness + wakeups (status_listener.c / syscall_condition.c analog)
+    # ------------------------------------------------------------------
+
+    def _poll_revents(self, proc: ManagedProcess, fd: int, events: int) -> int:
+        rev = 0
+        obj = proc.fds.get(fd)
+        if obj is None:
+            return POLLERR if fd >= ipc.FD_BASE else 0
+        if isinstance(obj, Sock):
+            if (events & POLLIN) and obj.readable():
+                rev |= POLLIN
+            if (events & POLLOUT) and obj.writable():
+                rev |= POLLOUT
+            if obj.conn_refused:
+                rev |= POLLERR  # reported regardless of requested events
+            if obj.conn is not None and obj.conn.rx_eof and not obj.conn.rx:
+                rev |= POLLHUP if (events & (POLLIN | POLLHUP)) else 0
+        return rev
+
+    def _epoll_ready(self, proc: ManagedProcess, ep: Epoll) -> list[tuple[int, int]]:
+        out = []
+        for fd, (events, data) in sorted(ep.interest.items()):
+            rev = 0
+            obj = proc.fds.get(fd)
+            if isinstance(obj, Sock):
+                if (events & EPOLLIN) and obj.readable():
+                    rev |= EPOLLIN
+                if (events & EPOLLOUT) and obj.writable():
+                    rev |= EPOLLOUT
+                if obj.conn_refused:
+                    rev |= EPOLLERR  # reported regardless of interest
+                if obj.conn is not None and obj.conn.rx_eof and not obj.conn.rx:
+                    rev |= EPOLLHUP & events | (EPOLLIN & events)
+            if rev:
+                out.append((rev, data))
+        return out
+
+    def _try_wake(self, proc: ManagedProcess) -> None:
+        """If proc's parked condition is now satisfied, complete the syscall
+        and resume it (condition wakeup -> process_continue analog)."""
+        if proc.state != ManagedProcess.PARKED or proc.parked is None:
+            return
+        pk = proc.parked
+        if pk.kind == "recv":
+            sock = proc.fds.get(pk.fd)
+            if isinstance(sock, Sock) and sock.readable():
+                proc.parked = None
+                self._complete_recv(proc, sock, pk.want)
+        elif pk.kind == "accept":
+            sock = proc.fds.get(pk.fd)
+            if isinstance(sock, Sock) and sock.accept_q:
+                proc.parked = None
+                self._complete_accept(proc, sock, bool(pk.want & SOCK_NONBLOCK))
+        elif pk.kind == "connect":
+            sock = proc.fds.get(pk.fd)
+            if isinstance(sock, Sock) and sock.conn and sock.conn.established:
+                proc.parked = None
+                self._resume(proc, 0)
+        elif pk.kind == "poll":
+            results = [
+                self._poll_revents(proc, fd, ev) for fd, ev in pk.pollset
+            ]
+            n = sum(1 for r in results if r)
+            if n > 0:
+                proc.parked = None
+                data = b"".join(
+                    int(r).to_bytes(2, "little", signed=True) for r in results
+                )
+                self._resume(proc, n, data=data)
+        elif pk.kind == "epoll":
+            ep = proc.fds.get(pk.epfd)
+            if isinstance(ep, Epoll):
+                ready = self._epoll_ready(proc, ep)
+                if ready:
+                    ready = ready[: pk.maxevents]
+                    data = b"".join(
+                        int(ev).to_bytes(4, "little")
+                        + int(d).to_bytes(8, "little")
+                        for ev, d in ready
+                    )
+                    proc.parked = None
+                    self._resume(proc, len(ready), data=data)
+
+    def _fire_deadline(self, proc: ManagedProcess, pk: Parked) -> None:
+        """Timeout event for a parked syscall (Timer trigger analog)."""
+        if proc.state != ManagedProcess.PARKED or proc.parked is not pk:
+            return  # already woken by data
+        proc.parked = None
+        if pk.kind == "sleep":
+            self._resume(proc, 0)
+        elif pk.kind == "poll":
+            data = b"\x00\x00" * len(pk.pollset)
+            self._resume(proc, 0, data=data)
+        elif pk.kind == "epoll":
+            self._resume(proc, 0)
+        elif pk.kind in ("recv", "accept", "connect"):
+            self._resume(proc, -errno.ETIMEDOUT)
+
+    def _resume(self, proc: ManagedProcess, ret: int, data: bytes = b"") -> None:
+        """Post the reply for a previously-blocked syscall; proc runs again."""
+        proc.channel.reply(ret, sim_time_ns=self.now, data=data)
+        proc.state = ManagedProcess.RUNNING
+
+    def _wake_sock_waiters(self, sock: Sock) -> None:
+        self._try_wake(sock.owner)
+        # epoll/poll parked on this socket's owner handled above; other
+        # processes can't hold this fd (no fd passing in v1)
+
+    # ------------------------------------------------------------------
+    # network delivery (stage-A model)
+    # ------------------------------------------------------------------
+
+    def _deliver_dgram(self, src_addr, dst_addr, payload: bytes) -> None:
+        sock = self._udp_binds.get(dst_addr)
+        if sock is None or not sock.owner.alive():
+            return  # no listener: datagram vanishes (no ICMP in v1)
+        if sock.peer is not None and sock.peer != src_addr:
+            return
+        sock.dgrams.append((src_addr[0], src_addr[1], payload))
+        self._wake_sock_waiters(sock)
+
+    def _deliver_syn(self, src_sock: Sock, src_addr, dst_addr) -> None:
+        listener = self._tcp_binds.get(dst_addr)
+        if listener is None or not listener.listening or not listener.owner.alive():
+            # RST path: fail the connect after another RTT
+            lat = self._latency(dst_addr[0], src_addr[0])
+            self._schedule(
+                self.now + lat, lambda: self._fail_connect(src_sock)
+            )
+            return
+        # create the child endpoint on the listener side
+        child = Conn(
+            established=True,
+            remote=src_sock.conn,
+            remote_addr=src_addr,
+            local_addr=dst_addr,
+        )
+        if src_sock.conn is not None:
+            src_sock.conn.remote = child
+        listener.accept_q.append(child)
+        self._wake_sock_waiters(listener)
+        # SYN-ACK back
+        lat = self._latency(dst_addr[0], src_addr[0])
+        self._schedule(
+            self.now + lat, lambda: self._complete_connect(src_sock)
+        )
+
+    def _fail_connect(self, sock: Sock) -> None:
+        if sock.conn is not None:
+            sock.conn.rx_eof = True
+        sock.connecting = False
+        sock.conn_refused = True
+        p = sock.owner
+        if (
+            p.state == ManagedProcess.PARKED
+            and p.parked is not None
+            and p.parked.kind == "connect"
+            and p.parked.fd == sock.fd
+        ):
+            p.parked = None
+            self._resume(p, -errno.ECONNREFUSED)
+        else:
+            # nonblocking connect: surface POLLERR/EPOLLERR to pollers
+            self._wake_sock_waiters(sock)
+
+    def _complete_connect(self, sock: Sock) -> None:
+        if sock.conn is None:
+            return
+        sock.conn.established = True
+        sock.connecting = False
+        self._wake_sock_waiters(sock)
+
+    def _deliver_stream(self, conn: Conn, payload: bytes) -> None:
+        conn.rx += payload
+        if conn.sock is not None:
+            self._wake_sock_waiters(conn.sock)
+        # conn.sock is None while the endpoint sits un-accepted in the
+        # accept queue: bytes buffer silently until accept() wraps it
+
+    def _deliver_eof(self, conn: Conn) -> None:
+        conn.rx_eof = True
+        if conn.sock is not None:
+            self._wake_sock_waiters(conn.sock)
+
+
+    # ------------------------------------------------------------------
+    # syscall dispatch (syscallhandler_make_syscall analog)
+    # ------------------------------------------------------------------
+
+    def _ephemeral_port(self, host: SimHost) -> int:
+        # skip ports already bound on this host (either protocol) so an
+        # ephemeral allocation never clobbers an explicit bind
+        while (
+            (host.ip, host.next_port) in self._udp_binds
+            or (host.ip, host.next_port) in self._tcp_binds
+        ):
+            host.next_port += 1
+        port = host.next_port
+        host.next_port += 1
+        return port
+
+    def _ensure_bound(self, proc: ManagedProcess, sock: Sock) -> None:
+        if sock.bound is None:
+            port = self._ephemeral_port(proc.host)
+            sock.bound = (proc.host.ip, port)
+            binds = self._udp_binds if sock.proto == SOCK_DGRAM else self._tcp_binds
+            binds[sock.bound] = sock
+
+    def _dispatch(self, proc: ManagedProcess) -> None:
+        """Handle one MSG_SYSCALL from proc. Either replies (proc keeps
+        running) or parks it (reply deferred until a condition fires)."""
+        ch = proc.channel
+        sysno = ch.sysno
+        a = ch.args
+        self.counters["syscalls"] += 1
+
+        def done(ret: int, data: bytes = b"") -> None:
+            ch.reply(ret, sim_time_ns=self.now, data=data)
+
+        def park(pk: Parked) -> None:
+            proc.parked = pk
+            proc.state = ManagedProcess.PARKED
+            if pk.deadline is not None:
+                self._schedule(
+                    pk.deadline, lambda: self._fire_deadline(proc, pk)
+                )
+
+        # ---- time ----
+        if sysno == SYS_clock_gettime:
+            done(self.now)
+        elif sysno == SYS_nanosleep:
+            dur = max(0, a[0])
+            park(Parked(proc, "sleep", deadline=self.now + dur))
+        # ---- socket lifecycle ----
+        elif sysno == SYS_socket:
+            stype = a[1] & 0xFF
+            if stype not in (SOCK_STREAM, SOCK_DGRAM):
+                done(-errno.EPROTONOSUPPORT)
+                return
+            fd = proc.alloc_fd()
+            sock = Sock(fd=fd, proto=stype, owner=proc,
+                        nonblock=bool(a[1] & SOCK_NONBLOCK))
+            proc.fds[fd] = sock
+            done(fd)
+        elif sysno == SYS_bind:
+            sock = proc.fds.get(a[0])
+            if not isinstance(sock, Sock):
+                done(-errno.EBADF)
+                return
+            ip, port = a[1], a[2]
+            if ip == 0:  # INADDR_ANY -> this host's address
+                ip = proc.host.ip
+            if ip == 0x7F000001:  # loopback binds resolve to host ip in v1
+                ip = proc.host.ip
+            if port == 0:
+                port = self._ephemeral_port(proc.host)
+            binds = self._udp_binds if sock.proto == SOCK_DGRAM else self._tcp_binds
+            if (ip, port) in binds:
+                done(-errno.EADDRINUSE)
+                return
+            sock.bound = (ip, port)
+            binds[(ip, port)] = sock
+            done(0)
+        elif sysno == SYS_listen:
+            sock = proc.fds.get(a[0])
+            if not isinstance(sock, Sock) or sock.proto != SOCK_STREAM:
+                done(-errno.EBADF)
+                return
+            self._ensure_bound(proc, sock)
+            sock.listening = True
+            done(0)
+        elif sysno == SYS_connect:
+            sock = proc.fds.get(a[0])
+            if not isinstance(sock, Sock):
+                done(-errno.EBADF)
+                return
+            ip, port = a[1], a[2]
+            if ip == 0x7F000001:
+                ip = proc.host.ip
+            if sock.proto == SOCK_DGRAM:
+                sock.peer = (ip, port)
+                self._ensure_bound(proc, sock)
+                done(0)
+                return
+            if sock.conn is not None or sock.connecting:
+                done(-errno.EISCONN)
+                return
+            self._ensure_bound(proc, sock)
+            sock.conn = Conn(local_addr=sock.bound, remote_addr=(ip, port),
+                             sock=sock)
+            sock.connecting = True
+            lat = self._latency(proc.host.ip, ip)
+            dst = (ip, port)
+            src = sock.bound
+            if self._drop_roll(proc.host.ip, ip, control=True):
+                pass  # control packets never dropped; kept for symmetry
+            self._schedule(
+                self.now + lat, lambda: self._deliver_syn(sock, src, dst)
+            )
+            if sock.nonblock:
+                done(-errno.EINPROGRESS)
+            else:
+                park(Parked(proc, "connect", fd=sock.fd))
+        elif sysno in (SYS_accept, SYS_accept4):
+            sock = proc.fds.get(a[0])
+            if not isinstance(sock, Sock) or not sock.listening:
+                done(-errno.EINVAL)
+                return
+            child_nonblock = bool(a[1] & SOCK_NONBLOCK)
+            if sock.accept_q:
+                self._complete_accept(proc, sock, child_nonblock)
+            elif sock.nonblock:
+                done(-errno.EAGAIN)
+            else:
+                park(Parked(proc, "accept", fd=sock.fd, want=a[1]))
+        elif sysno == SYS_close:
+            obj = proc.fds.pop(a[0], None)
+            if obj is None:
+                done(-errno.EBADF)
+                return
+            self._close_obj(obj)
+            done(0)
+        elif sysno == SYS_shutdown:
+            sock = proc.fds.get(a[0])
+            if isinstance(sock, Sock) and sock.conn is not None:
+                self._send_eof(proc, sock)
+            done(0)
+        # ---- data plane ----
+        elif sysno == SYS_sendto:
+            self._handle_sendto(proc, a, ch.data)
+        elif sysno == SYS_recvfrom:
+            sock = proc.fds.get(a[0])
+            if not isinstance(sock, Sock):
+                done(-errno.EBADF)
+                return
+            if sock.proto == SOCK_STREAM and (
+                sock.listening or sock.conn is None
+            ):
+                done(-errno.ENOTCONN)
+                return
+            if sock.readable():
+                self._complete_recv(proc, sock, a[1])
+            elif sock.conn is not None and sock.conn.rx_eof:
+                done(0)
+            elif sock.nonblock:
+                done(-errno.EAGAIN)
+            else:
+                park(Parked(proc, "recv", fd=sock.fd, want=a[1]))
+        # ---- metadata ----
+        elif sysno == SYS_getsockname:
+            sock = proc.fds.get(a[0])
+            if not isinstance(sock, Sock):
+                done(-errno.EBADF)
+                return
+            ip, port = sock.bound or (proc.host.ip, 0)
+            done(0, data=ip.to_bytes(4, "little") + port.to_bytes(2, "little"))
+        elif sysno == SYS_getpeername:
+            sock = proc.fds.get(a[0])
+            if not isinstance(sock, Sock):
+                done(-errno.EBADF)
+                return
+            addr = None
+            if sock.conn is not None:
+                addr = sock.conn.remote_addr
+            elif sock.peer is not None:
+                addr = sock.peer
+            if addr is None:
+                done(-errno.ENOTCONN)
+                return
+            done(0, data=addr[0].to_bytes(4, "little")
+                 + addr[1].to_bytes(2, "little"))
+        elif sysno == SYS_setsockopt:
+            done(0)  # buffer-size etc. accepted and ignored in v1
+        elif sysno == SYS_getsockopt:
+            sock = proc.fds.get(a[0])
+            refused = isinstance(sock, Sock) and sock.conn_refused
+            done(errno.ECONNREFUSED if refused else 0)  # SO_ERROR
+        elif sysno == SYS_fcntl:
+            sock = proc.fds.get(a[0])
+            if not isinstance(sock, Sock):
+                done(-errno.EBADF)
+                return
+            cmd, arg = a[1], a[2]
+            if cmd == F_GETFL:
+                done(O_NONBLOCK if sock.nonblock else 0)
+            elif cmd == F_SETFL:
+                sock.nonblock = bool(arg & O_NONBLOCK)
+                done(0)
+            else:
+                done(0)
+        elif sysno == SYS_ioctl:
+            sock = proc.fds.get(a[0])
+            if not isinstance(sock, Sock):
+                done(-errno.EBADF)
+                return
+            if a[1] == FIONREAD:
+                n = 0
+                if sock.proto == SOCK_DGRAM and sock.dgrams:
+                    n = len(sock.dgrams[0][2])
+                elif sock.conn is not None:
+                    n = len(sock.conn.rx)
+                done(n)
+            else:
+                done(-errno.EINVAL)
+        # ---- readiness ----
+        elif sysno == SYS_epoll_create1:
+            fd = proc.alloc_fd()
+            proc.fds[fd] = Epoll(fd=fd, owner=proc)
+            done(fd)
+        elif sysno == SYS_epoll_ctl:
+            ep = proc.fds.get(a[0])
+            if not isinstance(ep, Epoll):
+                done(-errno.EBADF)
+                return
+            op, fd, events, data = a[1], a[2], a[3], a[4]
+            if op == EPOLL_CTL_ADD or op == EPOLL_CTL_MOD:
+                ep.interest[fd] = (events, data)
+                done(0)
+            elif op == EPOLL_CTL_DEL:
+                ep.interest.pop(fd, None)
+                done(0)
+            else:
+                done(-errno.EINVAL)
+        elif sysno == SYS_epoll_wait:
+            ep = proc.fds.get(a[0])
+            if not isinstance(ep, Epoll):
+                done(-errno.EBADF)
+                return
+            maxevents, timeout_ms = a[1], a[2]
+            ready = self._epoll_ready(proc, ep)[:maxevents]
+            if ready:
+                data = b"".join(
+                    int(ev).to_bytes(4, "little") + int(d).to_bytes(8, "little")
+                    for ev, d in ready
+                )
+                done(len(ready), data=data)
+            elif timeout_ms == 0:
+                done(0)
+            else:
+                deadline = (
+                    None if timeout_ms < 0
+                    else self.now + timeout_ms * 1_000_000
+                )
+                park(Parked(proc, "epoll", epfd=a[0], maxevents=maxevents,
+                            deadline=deadline))
+        elif sysno == SYS_poll:
+            nfds, timeout_ms = a[0], a[1]
+            raw = ch.data
+            pollset = []
+            for i in range(nfds):
+                fd = int.from_bytes(raw[i * 6:i * 6 + 4], "little", signed=True)
+                ev = int.from_bytes(raw[i * 6 + 4:i * 6 + 6], "little",
+                                    signed=True)
+                pollset.append((fd, ev))
+            results = [self._poll_revents(proc, fd, ev) for fd, ev in pollset]
+            n = sum(1 for r in results if r)
+            if n > 0:
+                data = b"".join(
+                    int(r).to_bytes(2, "little", signed=True) for r in results
+                )
+                done(n, data=data)
+            elif timeout_ms == 0:
+                done(0, data=b"\x00\x00" * nfds)
+            else:
+                deadline = (
+                    None if timeout_ms < 0
+                    else self.now + timeout_ms * 1_000_000
+                )
+                park(Parked(proc, "poll", pollset=pollset, deadline=deadline))
+        # ---- pseudo-syscalls ----
+        elif sysno == ipc.PSYS_RESOLVE_NAME:
+            name = ch.data.decode("utf-8", "replace")
+            h = self._host_by_name(name)
+            done(h.ip if h is not None else -errno.ENOENT)
+        elif sysno == ipc.PSYS_GETHOSTNAME:
+            done(0, data=proc.host.name.encode())
+        else:
+            done(-errno.ENOSYS)
+
+    def _handle_sendto(self, proc: ManagedProcess, a: list[int],
+                       payload: bytes) -> None:
+        ch = proc.channel
+        sock = proc.fds.get(a[0])
+        if not isinstance(sock, Sock):
+            ch.reply(-errno.EBADF, sim_time_ns=self.now)
+            return
+        n, has_addr, ip, port = a[1], a[3], a[4], a[5]
+        payload = payload[:n]
+        if sock.proto == SOCK_DGRAM:
+            if has_addr:
+                dst = (ip if ip != 0x7F000001 else proc.host.ip, port)
+            elif sock.peer is not None:
+                dst = sock.peer
+            else:
+                ch.reply(-errno.EDESTADDRREQ, sim_time_ns=self.now)
+                return
+            self._ensure_bound(proc, sock)
+            src = sock.bound
+            self.counters["packets_sent"] += 1
+            self.counters["bytes_sent"] += len(payload)
+            if self._drop_roll(proc.host.ip, dst[0], control=len(payload) == 0):
+                self.counters["packets_dropped"] += 1
+            else:
+                lat = self._latency(proc.host.ip, dst[0])
+                data = bytes(payload)
+                self._schedule(
+                    self.now + lat,
+                    lambda: self._deliver_dgram(src, dst, data),
+                )
+            ch.reply(len(payload), sim_time_ns=self.now)
+        else:
+            conn = sock.conn
+            if conn is None or not conn.established:
+                ch.reply(-errno.ENOTCONN, sim_time_ns=self.now)
+                return
+            remote = conn.remote
+            self.counters["packets_sent"] += 1
+            self.counters["bytes_sent"] += len(payload)
+            if remote is not None:
+                lat = self._latency(proc.host.ip, conn.remote_addr[0])
+                data = bytes(payload)
+                self._schedule(
+                    self.now + lat,
+                    lambda: self._deliver_stream(remote, data),
+                )
+            ch.reply(len(payload), sim_time_ns=self.now)
+
+    def _complete_recv(self, proc: ManagedProcess, sock: Sock, want: int) -> None:
+        if sock.proto == SOCK_DGRAM:
+            src_ip, src_port, data = sock.dgrams.popleft()
+            data = data[:want]
+            hdr = src_ip.to_bytes(4, "little") + src_port.to_bytes(2, "little")
+            self._resume(proc, len(data), data=hdr + data)
+        else:
+            conn = sock.conn
+            take = min(want, len(conn.rx))
+            data = bytes(conn.rx[:take])
+            del conn.rx[:take]
+            ra = conn.remote_addr or (0, 0)
+            hdr = ra[0].to_bytes(4, "little") + ra[1].to_bytes(2, "little")
+            self._resume(proc, take, data=hdr + data)
+
+    def _complete_accept(self, proc: ManagedProcess, listener: Sock,
+                         nonblock: bool = False) -> None:
+        conn = listener.accept_q.popleft()
+        fd = proc.alloc_fd()
+        child = Sock(fd=fd, proto=SOCK_STREAM, owner=proc,
+                     bound=conn.local_addr, conn=conn, nonblock=nonblock)
+        conn.sock = child
+        proc.fds[fd] = child
+        ra = conn.remote_addr or (0, 0)
+        data = ra[0].to_bytes(4, "little") + ra[1].to_bytes(2, "little")
+        self._resume(proc, fd, data=data)
+
+    def _send_eof(self, proc: ManagedProcess, sock: Sock) -> None:
+        conn = sock.conn
+        if conn is None or conn.remote is None:
+            return
+        remote = conn.remote
+        lat = self._latency(
+            proc.host.ip,
+            conn.remote_addr[0] if conn.remote_addr else proc.host.ip,
+        )
+        self._schedule(self.now + lat, lambda: self._deliver_eof(remote))
+
+    def _close_obj(self, obj) -> None:
+        if isinstance(obj, Sock):
+            if obj.bound is not None:
+                binds = (
+                    self._udp_binds if obj.proto == SOCK_DGRAM
+                    else self._tcp_binds
+                )
+                if binds.get(obj.bound) is obj:
+                    del binds[obj.bound]
+            if obj.conn is not None:
+                self._send_eof(obj.owner, obj)
+
+    # ------------------------------------------------------------------
+    # the service loop (manager_run / scheduler round analog)
+    # ------------------------------------------------------------------
+
+    def _service_one(self, proc: ManagedProcess) -> bool:
+        """Wait for proc's next message and handle it. Returns False if the
+        process exited instead of posting a message."""
+        deadline = wall_time.monotonic() + self.service_timeout_s
+        while True:
+            if proc.channel.wait_request(timeout_s=0.05):
+                break
+            if proc.popen is not None and proc.popen.poll() is not None:
+                # drain any message raced in just before exit
+                if not proc.channel.try_request():
+                    proc.state = ManagedProcess.EXITED
+                    proc.exit_code = proc.popen.returncode
+                    return False
+                break
+            if wall_time.monotonic() > deadline:
+                raise DriverError(
+                    f"{proc.name}: no syscall within "
+                    f"{self.service_timeout_s}s (wedged managed process?)"
+                )
+        mtype = proc.channel.msg_type
+        if mtype == ipc.MSG_HELLO:
+            proc.channel.reply(0, sim_time_ns=self.now)
+        elif mtype == ipc.MSG_SYSCALL:
+            self._dispatch(proc)
+        else:
+            raise DriverError(f"{proc.name}: unexpected message {mtype}")
+        return True
+
+    def _spawn(self, proc: ManagedProcess) -> None:
+        proc.spawn(spin=self.spin)
+
+    def run(self) -> None:
+        """Run the simulation until stop_time or all processes exit."""
+        for p in self.procs:
+            self._schedule(p.start_time, lambda p=p: self._spawn(p))
+
+        while True:
+            # 1. service running processes to quiescence (deterministic order)
+            progressed = True
+            while progressed:
+                progressed = False
+                for p in self.procs:
+                    while p.state == ManagedProcess.RUNNING and p.channel:
+                        progressed = True
+                        if not self._service_one(p):
+                            break
+
+            # 2. all quiescent: advance to the next event
+            if not self._heap:
+                break
+            t, _, cb = heapq.heappop(self._heap)
+            if t >= self.stop_time:
+                break
+            self.now = max(self.now, t)
+            cb()
+            # coalesce same-timestamp events before re-servicing
+            while self._heap and self._heap[0][0] <= self.now:
+                t2, _, cb2 = heapq.heappop(self._heap)
+                cb2()
+
+            live = [p for p in self.procs if p.alive() and p.channel]
+            if not live and not self._heap:
+                break
+
+        # teardown: stop anything still alive, collect output
+        for p in self.procs:
+            if p.state == ManagedProcess.PARKED and p.channel:
+                p.channel.reply(0, sim_time_ns=self.now,
+                                msg_type=ipc.MSG_STOP)
+            if p.channel:
+                p.stdout, p.stderr = p.finish()
+            elif not hasattr(p, "stdout"):
+                p.stdout, p.stderr = b"", b""

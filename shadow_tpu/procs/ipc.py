@@ -137,10 +137,9 @@ class Channel:
             while _libpthread.sem_wait(self._base + OFF_SEM_TO_DRIVER) != 0:
                 pass
             return True
-        now = os.times().elapsed  # unused; use clock_gettime for abs time
-        ts = _timespec()
         import time as _time
 
+        ts = _timespec()
         deadline = _time.clock_gettime(_time.CLOCK_REALTIME) + timeout_s
         ts.tv_sec = int(deadline)
         ts.tv_nsec = int((deadline - int(deadline)) * 1e9)

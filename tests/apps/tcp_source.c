@@ -1,0 +1,45 @@
+/* TCP source: connects to <server>:<port>, sends <bytes> bytes, closes.
+ * Usage: tcp_source <server> <port> <bytes> */
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+int main(int argc, char** argv) {
+  const char* server = argc > 1 ? argv[1] : "server";
+  const char* port = argc > 2 ? argv[2] : "9001";
+  long long total = argc > 3 ? atoll(argv[3]) : 65536;
+
+  struct addrinfo hints, *res = NULL;
+  memset(&hints, 0, sizeof(hints));
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  if (getaddrinfo(server, port, &hints, &res) != 0 || !res) {
+    fprintf(stderr, "resolve failed\n");
+    return 1;
+  }
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) { perror("socket"); return 1; }
+  if (connect(fd, res->ai_addr, res->ai_addrlen) != 0) {
+    perror("connect");
+    return 1;
+  }
+  char buf[8192];
+  memset(buf, 'x', sizeof(buf));
+  long long sent = 0;
+  while (sent < total) {
+    size_t chunk = sizeof(buf);
+    if ((long long)chunk > total - sent) chunk = (size_t)(total - sent);
+    ssize_t n = send(fd, buf, chunk, 0);
+    if (n <= 0) { perror("send"); return 1; }
+    sent += n;
+  }
+  printf("sent %lld bytes\n", sent);
+  close(fd);
+  freeaddrinfo(res);
+  return 0;
+}

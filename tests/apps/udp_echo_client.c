@@ -1,0 +1,56 @@
+/* Minimal UDP echo client: resolves SERVER by name (exercises the DNS
+ * pseudo-syscall), sends N pings, prints each round-trip time measured with
+ * the VIRTUAL clock. Usage: udp_echo_client <server> <port> <count> */
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <time.h>
+#include <unistd.h>
+
+static long long now_ns(void) {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (long long)ts.tv_sec * 1000000000LL + ts.tv_nsec;
+}
+
+int main(int argc, char** argv) {
+  const char* server = argc > 1 ? argv[1] : "server";
+  const char* port = argc > 2 ? argv[2] : "9000";
+  int count = argc > 3 ? atoi(argv[3]) : 1;
+
+  struct addrinfo hints, *res = NULL;
+  memset(&hints, 0, sizeof(hints));
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_DGRAM;
+  if (getaddrinfo(server, port, &hints, &res) != 0 || !res) {
+    fprintf(stderr, "resolve failed\n");
+    return 1;
+  }
+  int fd = socket(AF_INET, SOCK_DGRAM, 0);
+  if (fd < 0) { perror("socket"); return 1; }
+
+  char msg[64], buf[2048];
+  for (int i = 0; i < count; i++) {
+    int n = snprintf(msg, sizeof(msg), "ping %d", i);
+    long long t0 = now_ns();
+    if (sendto(fd, msg, n, 0, res->ai_addr, res->ai_addrlen) != n) {
+      perror("sendto");
+      return 1;
+    }
+    ssize_t r = recvfrom(fd, buf, sizeof(buf), 0, NULL, NULL);
+    long long t1 = now_ns();
+    if (r != n || memcmp(buf, msg, n) != 0) {
+      fprintf(stderr, "bad echo\n");
+      return 1;
+    }
+    printf("rtt %lld ns\n", t1 - t0);
+  }
+  freeaddrinfo(res);
+  close(fd);
+  printf("client done\n");
+  return 0;
+}

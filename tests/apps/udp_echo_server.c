@@ -1,0 +1,41 @@
+/* Minimal UDP echo server: binds PORT, echoes N datagrams, exits.
+ * Run as a REAL process under the shadow_tpu shim (dual-target: also runs
+ * natively). Usage: udp_echo_server <port> <count> */
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+int main(int argc, char** argv) {
+  int port = argc > 1 ? atoi(argv[1]) : 9000;
+  int count = argc > 2 ? atoi(argv[2]) : 1;
+  int fd = socket(AF_INET, SOCK_DGRAM, 0);
+  if (fd < 0) { perror("socket"); return 1; }
+  struct sockaddr_in addr;
+  memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(port);
+  if (bind(fd, (struct sockaddr*)&addr, sizeof(addr)) != 0) {
+    perror("bind");
+    return 1;
+  }
+  char buf[2048];
+  for (int i = 0; i < count; i++) {
+    struct sockaddr_in src;
+    socklen_t slen = sizeof(src);
+    ssize_t n = recvfrom(fd, buf, sizeof(buf), 0, (struct sockaddr*)&src, &slen);
+    if (n < 0) { perror("recvfrom"); return 1; }
+    if (sendto(fd, buf, n, 0, (struct sockaddr*)&src, slen) != n) {
+      perror("sendto");
+      return 1;
+    }
+    printf("echoed %zd bytes\n", n);
+  }
+  close(fd);
+  printf("server done\n");
+  return 0;
+}
