@@ -1,0 +1,262 @@
+"""The simulator CLI: ``python -m shadow_tpu config.yaml``.
+
+The reference's entry path (src/main/main.c:10 → core/main.c:121
+main_runShadow) parses CLI + YAML, merges CLI overrides over the file config,
+sets up the data directory, and runs the controller. This module is that
+surface for both execution planes:
+
+- hosts with ``processes``  → the managed-process plane (real binaries under
+  the LD_PRELOAD shim, serviced by ProcessDriver against the topology);
+- hosts with ``app_model``  → the device plane (workload models compiled into
+  the batched TPU window kernel).
+
+Exit status is nonzero when any managed process fails, like the reference's
+plugin-error accounting (manager.c:255-257,579-584).
+"""
+
+from __future__ import annotations
+
+import argparse
+import pathlib
+import shutil
+import sys
+import time
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="shadow_tpu",
+        description="TPU-native discrete-event network simulator",
+    )
+    p.add_argument("config", help="YAML experiment configuration file")
+    p.add_argument(
+        "--show-config", action="store_true",
+        help="print the merged configuration and exit (core/main.c:207-213)",
+    )
+    p.add_argument("--seed", type=int, help="override general.seed")
+    p.add_argument(
+        "--stop-time", help="override general.stop_time (e.g. '10 s')"
+    )
+    p.add_argument(
+        "--data-directory", "-d",
+        help="override general.data_directory (default shadow.data)",
+    )
+    p.add_argument(
+        "--template-directory", "-e",
+        help="override general.template_directory: copied to the data "
+             "directory before the simulation runs",
+    )
+    p.add_argument("--log-level", "-l", help="override general.log_level")
+    p.add_argument(
+        "--parallelism", "-p", type=int, help="override general.parallelism"
+    )
+    p.add_argument(
+        "--progress", action="store_true", help="log round progress"
+    )
+    return p
+
+
+def _apply_overrides(cfg, args) -> None:
+    """CLI flags override file values field-wise (configuration.rs:92-117)."""
+    from shadow_tpu.core import units
+
+    if args.seed is not None:
+        cfg.general.seed = args.seed
+    if args.stop_time is not None:
+        cfg.general.stop_time = units.parse_time_ns(args.stop_time)
+    if args.data_directory is not None:
+        cfg.general.data_directory = args.data_directory
+    if args.template_directory is not None:
+        cfg.general.template_directory = args.template_directory
+    if args.log_level is not None:
+        cfg.general.log_level = args.log_level
+    if args.parallelism is not None:
+        cfg.general.parallelism = args.parallelism
+    if args.progress:
+        cfg.general.progress = True
+
+
+def _dump_config(cfg) -> str:
+    import dataclasses
+
+    import yaml
+
+    def clean(x):
+        if dataclasses.is_dataclass(x):
+            return {k: clean(v) for k, v in dataclasses.asdict(x).items()}
+        if isinstance(x, dict):
+            return {k: clean(v) for k, v in x.items()}
+        if isinstance(x, list):
+            return [clean(v) for v in x]
+        return x
+
+    return yaml.safe_dump(
+        {
+            "general": clean(cfg.general),
+            "network": clean(cfg.network),
+            "experimental": clean(cfg.experimental),
+            "hosts": {h.name: clean(h) for h in cfg.hosts},
+        },
+        sort_keys=False,
+    )
+
+
+def _prepare_data_dir(cfg) -> pathlib.Path:
+    """Create the data directory; refuse to clobber an existing one, exactly
+    like the reference (manager.c:177-190 errors out if the path exists)."""
+    data_dir = pathlib.Path(cfg.general.data_directory)
+    if data_dir.exists():
+        raise SystemExit(
+            f"error: data directory '{data_dir}' already exists; remove it "
+            f"or pass --data-directory"
+        )
+    if cfg.general.template_directory:
+        template = pathlib.Path(cfg.general.template_directory)
+        if not template.is_dir():
+            raise SystemExit(
+                f"error: template directory '{template}' does not exist"
+            )
+        shutil.copytree(template, data_dir)
+    else:
+        data_dir.mkdir(parents=True)
+    return data_dir
+
+
+def _run_process_plane(cfg, driver, progress: bool) -> int:
+    t0 = time.monotonic()
+    if progress:
+        driver.heartbeat_interval = cfg.general.heartbeat_interval
+
+        def beat(d):
+            c = d.counters
+            print(
+                f"heartbeat: sim {d.now / 1e9:.3f}s, "
+                f"{c['syscalls']} syscalls, {c['packets_sent']} packets, "
+                f"wall {time.monotonic() - t0:.1f}s",
+                flush=True,
+            )
+
+        driver.heartbeat_fn = beat
+    driver.run()
+    wall = time.monotonic() - t0
+    errors = 0
+    for p in driver.procs:
+        if p.stopped_by_sim:
+            continue  # stopped at its stop_time, not an app failure
+        if p.exit_code not in (0, None):
+            errors += 1
+            print(
+                f"process {p.name} exited with {p.exit_code}",
+                file=sys.stderr,
+            )
+    c = driver.counters
+    print(
+        f"done: {len(driver.hosts)} hosts, {len(driver.procs)} processes, "
+        f"{c['syscalls']} syscalls, {c['packets_sent']} packets "
+        f"({c['packets_dropped']} dropped), sim {driver.now / 1e9:.3f}s "
+        f"in wall {wall:.3f}s"
+    )
+    if errors:
+        print(f"{errors} managed process(es) failed", file=sys.stderr)
+        return 1
+    return 0
+
+
+def _run_device_plane(cfg, sim, progress: bool) -> int:
+    t0 = time.monotonic()
+    if progress:
+        import jax
+
+        stop = sim.stop_time
+        hb = max(cfg.general.heartbeat_interval, sim.runahead)
+        next_hb = hb
+        while True:
+            sim.run(until=next_hb)
+            jax.block_until_ready(sim.state.pool.time)
+            now = min(next_hb, stop)
+            c = sim.counters()
+            print(
+                f"heartbeat: sim {now / 1e9:.3f}s / {stop / 1e9:.3f}s, "
+                f"{c['events_committed']} events committed, "
+                f"wall {time.monotonic() - t0:.1f}s",
+                flush=True,
+            )
+            if now >= stop:
+                break
+            next_hb += hb
+    else:
+        sim.run()
+    wall = time.monotonic() - t0
+    c = sim.counters()
+    print(
+        f"done: {sim.num_hosts} hosts, {c['events_committed']} events, "
+        f"sim {sim.stop_time / 1e9:.3f}s in wall {wall:.3f}s"
+    )
+    dropped = c.get("pool_overflow_dropped", 0)
+    if dropped:
+        print(
+            f"warning: {dropped} events dropped on pool overflow "
+            f"(raise experimental.event_capacity)",
+            file=sys.stderr,
+        )
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = _build_parser().parse_args(argv)
+    from shadow_tpu.core.config import ConfigError, load_config
+
+    try:
+        cfg = load_config(args.config)
+        _apply_overrides(cfg, args)
+    except (ConfigError, FileNotFoundError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    if args.show_config:
+        print(_dump_config(cfg), end="")
+        return 0
+
+    has_procs = any(h.processes for h in cfg.hosts)
+    has_apps = any(h.app_model for h in cfg.hosts)
+    if has_procs and has_apps:
+        print(
+            "error: mixing hosts with `processes` and hosts with `app_model` "
+            "in one simulation is not supported yet",
+            file=sys.stderr,
+        )
+        return 2
+    if not has_procs and not has_apps:
+        print(
+            "error: no hosts define `processes` or `app_model`; nothing to "
+            "simulate",
+            file=sys.stderr,
+        )
+        return 2
+
+    data_dir = _prepare_data_dir(cfg)
+    try:
+        if has_procs:
+            from shadow_tpu.procs.builder import build_process_driver
+
+            built = build_process_driver(cfg, data_root=data_dir)
+        else:
+            from shadow_tpu.sim import build_simulation
+
+            built = build_simulation(cfg)
+    except ValueError as e:
+        # BuildError / ProcessBuildError / TopologyError / DnsError all
+        # derive from ValueError: configuration-shaped failures, not bugs.
+        # Remove the data dir we just created so the corrected re-run
+        # isn't refused with "already exists".
+        shutil.rmtree(data_dir, ignore_errors=True)
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    if has_procs:
+        return _run_process_plane(cfg, built, cfg.general.progress)
+    return _run_device_plane(cfg, built, cfg.general.progress)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -221,7 +221,7 @@ class ProcessOptions:
         env = d.get("environment", {}) or {}
         if isinstance(env, str):
             env = dict(kv.split("=", 1) for kv in env.split(";") if kv)
-        return cls(
+        out = cls(
             path=str(d["path"]),
             args=[str(a) for a in args],
             environment={str(k): str(v) for k, v in env.items()},
@@ -233,6 +233,11 @@ class ProcessOptions:
                 else None
             ),
         )
+        if out.stop_time is not None and out.stop_time <= out.start_time:
+            raise ConfigError(
+                f"process {out.path}: stop_time must be after start_time"
+            )
+        return out
 
 
 @dataclasses.dataclass

@@ -1,0 +1,118 @@
+"""Config → ProcessDriver: the managed-process plane wired to the topology.
+
+Plays the reference's controller host-registration sequence
+(src/main/core/controller.c:227-336: for each configured host, register with
+DNS, attach to a topology vertex, then add its processes) for simulations
+whose hosts run real binaries. Path latency/reliability lookups come from the
+baked topology matrices — the same arrays the device engine uses — so both
+planes see one network model (topology.c:1995,2007 analogs).
+"""
+
+from __future__ import annotations
+
+import pathlib
+
+from shadow_tpu.core.config import Config, load_config
+from shadow_tpu.procs.driver import ProcessDriver
+from shadow_tpu.routing.dns import Dns
+from shadow_tpu.routing.topology import Topology
+
+
+class ProcessBuildError(ValueError):
+    pass
+
+
+def build_process_driver(
+    source, data_root: str | pathlib.Path | None = None
+) -> ProcessDriver:
+    """Build a ProcessDriver from a Config (or YAML path/string/dict).
+
+    If ``data_root`` is given, per-host working directories are created under
+    ``<data_root>/hosts/<hostname>/`` and process stdout/stderr are written to
+    ``<exe>.<n>.stdout`` / ``.stderr`` files there, mirroring the reference's
+    shadow.data layout (manager.c:352-432, process.c:468-481).
+    """
+    cfg = source if isinstance(source, Config) else load_config(source)
+    if not cfg.hosts:
+        raise ProcessBuildError("no hosts with processes configured")
+    bad = [h.name for h in cfg.hosts if not h.processes]
+    if bad:
+        raise ProcessBuildError(f"hosts without processes: {bad}")
+    hosts = cfg.hosts
+
+    topo = Topology.from_gml(cfg.graph_gml(), cfg.network.use_shortest_path)
+    dns = Dns()
+    for i, h in enumerate(hosts):
+        topo.attach_host(
+            i,
+            ip_address_hint=h.ip_address_hint,
+            city_code_hint=h.city_code_hint,
+            country_code_hint=h.country_code_hint,
+            network_node_id=h.network_node_id,
+        )
+    baked = topo.bake()
+
+    driver = ProcessDriver(
+        stop_time=cfg.general.stop_time,
+        seed=cfg.general.seed,
+    )
+    driver.dns = dns
+    driver.bootstrap_end = cfg.general.bootstrap_end_time
+
+    ip_to_vertex: dict[int, int] = {}
+    for i, h in enumerate(hosts):
+        ip = dns.register(i, h.name, h.ip_address_hint)
+        sim_host = driver.add_host(h.name, ip)
+        ip_to_vertex[ip] = int(baked.host_vertex[i])
+
+        host_dir = None
+        if data_root is not None:
+            host_dir = pathlib.Path(data_root) / "hosts" / h.name
+            host_dir.mkdir(parents=True, exist_ok=True)
+
+        n = 0
+        for popt in h.processes:
+            for _ in range(max(1, popt.quantity)):
+                out_path = err_path = None
+                if host_dir is not None:
+                    stem = f"{pathlib.Path(popt.path).name}.{n}"
+                    out_path = str(host_dir / f"{stem}.stdout")
+                    err_path = str(host_dir / f"{stem}.stderr")
+                driver.add_process(
+                    sim_host,
+                    [popt.path, *popt.args],
+                    start_time=popt.start_time,
+                    stop_time=popt.stop_time,
+                    env=dict(popt.environment),
+                    cwd=str(host_dir) if host_dir is not None else None,
+                    stdout_path=out_path,
+                    stderr_path=err_path,
+                )
+                n += 1
+
+    lat = baked.latency_vv
+    rel = baked.reliability_vv
+
+    # Unknown destination IPs (apps sending to addresses that are not sim
+    # hosts) fall back to defaults; the packet then vanishes at delivery
+    # time like any datagram with no listener.
+    def latency_fn(src_ip: int, dst_ip: int) -> int:
+        sv = ip_to_vertex.get(src_ip)
+        dv = ip_to_vertex.get(dst_ip)
+        if sv is None or dv is None:
+            return driver.latency_ns
+        return int(lat[sv, dv])
+
+    def reliability_fn(src_ip: int, dst_ip: int) -> float:
+        sv = ip_to_vertex.get(src_ip)
+        dv = ip_to_vertex.get(dst_ip)
+        if sv is None or dv is None:
+            return 1.0
+        return float(rel[sv, dv])
+
+    driver.set_latency_fn(latency_fn)
+    driver.set_reliability_fn(reliability_fn)
+    driver.config = cfg
+    driver.topology = topo
+    driver.baked = baked
+    return driver

@@ -169,13 +169,26 @@ class ManagedProcess:
 
     def __init__(self, name: str, args: list[str], host: "SimHost",
                  start_time: int = 0, env: dict | None = None,
-                 cwd: str | None = None):
+                 cwd: str | None = None, stop_time: int | None = None,
+                 stdout_path: str | None = None,
+                 stderr_path: str | None = None):
         self.name = name
         self.args = args
         self.host = host
         self.start_time = start_time
+        self.stop_time = stop_time  # sim ns; None = run until exit/sim end
         self.extra_env = env or {}
         self.cwd = cwd
+        # When set, process output goes to these files (the reference writes
+        # shadow.data/hosts/<host>/<exe>.<n>.stdout — process.c:468-481);
+        # contents are still loaded into .stdout/.stderr at finish().
+        if stderr_path is None and stdout_path is not None:
+            stderr_path = stdout_path + ".err"
+        if stderr_path is not None and stdout_path is None:
+            raise ValueError("stderr_path requires stdout_path")
+        self.stdout_path = stdout_path
+        self.stderr_path = stderr_path
+        self.stopped_by_sim = False  # stopped at stop_time, not app exit
         self.channel: ipc.Channel | None = None
         self.popen: subprocess.Popen | None = None
         self.state = ManagedProcess.PARKED  # not yet spawned
@@ -191,10 +204,17 @@ class ManagedProcess:
         env[ipc.ENV_SHM] = self.channel.path
         env[ipc.ENV_SPIN] = str(spin)
         env.update(self.extra_env)
+        if self.stdout_path is not None:
+            out_f = open(self.stdout_path, "wb")
+            err_f = open(self.stderr_path, "wb")
+        else:
+            out_f = err_f = subprocess.PIPE
         self.popen = subprocess.Popen(
-            self.args, env=env, cwd=self.cwd,
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            self.args, env=env, cwd=self.cwd, stdout=out_f, stderr=err_f,
         )
+        if self.stdout_path is not None:
+            out_f.close()
+            err_f.close()
         self.state = ManagedProcess.RUNNING  # executing until HELLO arrives
 
     def alloc_fd(self) -> int:
@@ -214,6 +234,11 @@ class ManagedProcess:
                 self.popen.kill()
                 out, err = self.popen.communicate()
             self.exit_code = self.popen.returncode
+        if self.stdout_path is not None:
+            with open(self.stdout_path, "rb") as f:
+                out = f.read()
+            with open(self.stderr_path, "rb") as f:
+                err = f.read()
         if self.channel:
             self.channel.close()
             self.channel = None
@@ -232,12 +257,18 @@ class SimHost:
 
 
 def ip_from_str(s: str) -> int:
-    parts = [int(p) for p in s.split(".")]
-    return (parts[0] << 24) | (parts[1] << 16) | (parts[2] << 8) | parts[3]
+    import ipaddress
+
+    return int(ipaddress.IPv4Address(s))  # v4 only: wire format is 4 bytes
 
 
-def ip_to_str(ip: int) -> str:
-    return f"{(ip >> 24) & 255}.{(ip >> 16) & 255}.{(ip >> 8) & 255}.{ip & 255}"
+def _pack_epoll_event(events: int, data: int) -> bytes:
+    """Wire format for one epoll_event: u32 events + u64 data. The shim
+    hands us epoll_data as a signed register value, so mask to u64 —
+    apps legitimately store sentinels like -1 there."""
+    return (events & 0xFFFFFFFF).to_bytes(4, "little") + (
+        data & 0xFFFFFFFFFFFFFFFF
+    ).to_bytes(8, "little")
 
 
 class DriverError(RuntimeError):
@@ -285,6 +316,12 @@ class ProcessDriver:
         self._udp_binds: dict[tuple[int, int], Sock] = {}
         self._tcp_binds: dict[tuple[int, int], Sock] = {}
         self._latency_fn: Callable[[int, int], int] | None = None
+        self._reliability_fn: Callable[[int, int], float] | None = None
+        self.bootstrap_end = 0  # sim ns: no drops before this (worker.c:536)
+        self.dns = None  # optional routing.dns.Dns for name resolution
+        # heartbeat (manager.c:515-541 analog): period ns + callback(driver)
+        self.heartbeat_interval: int | None = None
+        self.heartbeat_fn: Callable[["ProcessDriver"], None] | None = None
         self.counters = {
             "syscalls": 0,
             "packets_sent": 0,
@@ -304,10 +341,13 @@ class ProcessDriver:
     def add_process(
         self, host: SimHost, args: list[str], start_time: int = 0,
         env: dict | None = None, cwd: str | None = None,
+        stop_time: int | None = None, stdout_path: str | None = None,
+        stderr_path: str | None = None,
     ) -> ManagedProcess:
         p = ManagedProcess(
             name=f"{host.name}.{len(host.procs)}", args=args, host=host,
-            start_time=start_time, env=env, cwd=cwd,
+            start_time=start_time, env=env, cwd=cwd, stop_time=stop_time,
+            stdout_path=stdout_path, stderr_path=stderr_path,
         )
         host.procs.append(p)
         self.procs.append(p)
@@ -316,6 +356,11 @@ class ProcessDriver:
     def set_latency_fn(self, fn: Callable[[int, int], int]) -> None:
         """fn(src_ip, dst_ip) -> one-way latency ns (topology hook)."""
         self._latency_fn = fn
+
+    def set_reliability_fn(self, fn: Callable[[int, int], float]) -> None:
+        """fn(src_ip, dst_ip) -> path reliability in [0,1] (topology hook:
+        reference topology_getReliability, topology.c:2007)."""
+        self._reliability_fn = fn
 
     # ------------------------------------------------------------------
     # event heap
@@ -333,9 +378,17 @@ class ProcessDriver:
         return self.latency_ns
 
     def _drop_roll(self, src_ip: int, dst_ip: int, control: bool) -> bool:
-        """True if the packet is dropped (reference: worker.c:539-545;
-        zero-length control packets are never dropped)."""
-        if control or self.loss <= 0.0 or src_ip == dst_ip:
+        """True if the packet is dropped (reference: worker.c:536-545;
+        zero-length control packets are never dropped, and nothing drops
+        during the bootstrap warm-up phase)."""
+        if control or src_ip == dst_ip or self.now < self.bootstrap_end:
+            return False
+        if self._reliability_fn is not None:
+            rel = self._reliability_fn(src_ip, dst_ip)
+            if rel >= 1.0:
+                return False
+            return self._rng.random() > rel
+        if self.loss <= 0.0:
             return False
         return self._rng.random() < self.loss
 
@@ -427,11 +480,7 @@ class ProcessDriver:
                 ready = self._epoll_ready(proc, ep)
                 if ready:
                     ready = ready[: pk.maxevents]
-                    data = b"".join(
-                        int(ev).to_bytes(4, "little")
-                        + int(d).to_bytes(8, "little")
-                        for ev, d in ready
-                    )
+                    data = b"".join(_pack_epoll_event(ev, d) for ev, d in ready)
                     proc.parked = None
                     self._resume(proc, len(ready), data=data)
 
@@ -646,8 +695,6 @@ class ProcessDriver:
             lat = self._latency(proc.host.ip, ip)
             dst = (ip, port)
             src = sock.bound
-            if self._drop_roll(proc.host.ip, ip, control=True):
-                pass  # control packets never dropped; kept for symmetry
             self._schedule(
                 self.now + lat, lambda: self._deliver_syn(sock, src, dst)
             )
@@ -693,9 +740,8 @@ class ProcessDriver:
                 done(-errno.ENOTCONN)
                 return
             if sock.readable():
+                # covers rx_eof too: _complete_recv returns 0 on drained+EOF
                 self._complete_recv(proc, sock, a[1])
-            elif sock.conn is not None and sock.conn.rx_eof:
-                done(0)
             elif sock.nonblock:
                 done(-errno.EAGAIN)
             else:
@@ -783,10 +829,7 @@ class ProcessDriver:
             maxevents, timeout_ms = a[1], a[2]
             ready = self._epoll_ready(proc, ep)[:maxevents]
             if ready:
-                data = b"".join(
-                    int(ev).to_bytes(4, "little") + int(d).to_bytes(8, "little")
-                    for ev, d in ready
-                )
+                data = b"".join(_pack_epoll_event(ev, d) for ev, d in ready)
                 done(len(ready), data=data)
             elif timeout_ms == 0:
                 done(0)
@@ -824,8 +867,12 @@ class ProcessDriver:
         # ---- pseudo-syscalls ----
         elif sysno == ipc.PSYS_RESOLVE_NAME:
             name = ch.data.decode("utf-8", "replace")
-            h = self._host_by_name(name)
-            done(h.ip if h is not None else -errno.ENOENT)
+            if self.dns is not None:
+                ip = self.dns.resolve_name(name)
+                done(ip if ip is not None else -errno.ENOENT)
+            else:
+                h = self._host_by_name(name)
+                done(h.ip if h is not None else -errno.ENOENT)
         elif sysno == ipc.PSYS_GETHOSTNAME:
             done(0, data=proc.host.name.encode())
         else:
@@ -880,6 +927,10 @@ class ProcessDriver:
             ch.reply(len(payload), sim_time_ns=self.now)
 
     def _complete_recv(self, proc: ManagedProcess, sock: Sock, want: int) -> None:
+        # The reply carries a 6-byte source-address header before the payload;
+        # cap so header+payload always fits the IPC data area (the shim asks
+        # for up to IPC_DATA_MAX bytes).
+        want = min(want, ipc.IPC_DATA_MAX - 6)
         if sock.proto == SOCK_DGRAM:
             src_ip, src_port, data = sock.dgrams.popleft()
             data = data[:want]
@@ -962,12 +1013,54 @@ class ProcessDriver:
         return True
 
     def _spawn(self, proc: ManagedProcess) -> None:
+        if not proc.alive():
+            return  # already stopped (e.g. stop event preceded the spawn)
         proc.spawn(spin=self.spin)
+
+    def _stop_process(self, p: ManagedProcess) -> None:
+        """Scheduled per-process stop (process.c:655-677 stop task analog):
+        release a parked process with a STOP reply, then terminate it."""
+        if not p.alive():
+            return
+        p.stopped_by_sim = True
+        # Release this process's network footprint: unregister port bindings
+        # and send EOF to stream peers (so blocked remotes wake), like the
+        # reference's descriptor-table teardown on process stop.
+        for fd in list(p.fds):
+            self._close_obj(p.fds.pop(fd))
+        if p.popen is None:
+            # never spawned (stop scheduled before start); just mark dead
+            p.state = ManagedProcess.EXITED
+            p.stdout, p.stderr = b"", b""
+            return
+        if p.state == ManagedProcess.PARKED and p.channel and p.parked:
+            # The shim's STOP handler _exit(0)s; wait for that so the exit
+            # code is deterministic rather than racing a SIGTERM.
+            p.channel.reply(0, sim_time_ns=self.now, msg_type=ipc.MSG_STOP)
+            p.parked = None
+            if p.popen is not None:
+                try:
+                    p.popen.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    pass
+        if p.popen is not None and p.popen.poll() is None:
+            p.popen.terminate()
+        p.stdout, p.stderr = p.finish()
 
     def run(self) -> None:
         """Run the simulation until stop_time or all processes exit."""
         for p in self.procs:
             self._schedule(p.start_time, lambda p=p: self._spawn(p))
+            if p.stop_time is not None:
+                self._schedule(p.stop_time, lambda p=p: self._stop_process(p))
+        if self.heartbeat_interval and self.heartbeat_fn:
+
+            def beat():
+                self.heartbeat_fn(self)
+                if any(p.alive() for p in self.procs):
+                    self._schedule(self.now + self.heartbeat_interval, beat)
+
+            self._schedule(self.heartbeat_interval, beat)
 
         while True:
             # 1. service running processes to quiescence (deterministic order)

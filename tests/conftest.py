@@ -20,9 +20,41 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent compilation cache: the suite's dominant cost is XLA compiles of
+# the big window-step program (one per distinct sim shape, ~1-2 min each on
+# CPU). Cache them on disk so repeat runs are seconds, not minutes.
+_CACHE_DIR = os.path.join(os.path.dirname(__file__), "..", ".jax_cache")
+jax.config.update("jax_compilation_cache_dir", os.path.abspath(_CACHE_DIR))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+
+import pathlib  # noqa: E402
+import shutil  # noqa: E402
+import subprocess  # noqa: E402
+
 import pytest  # noqa: E402
+
+APPS_SRC = pathlib.Path(__file__).parent / "apps"
 
 
 @pytest.fixture(scope="session")
 def devices():
     return jax.devices()
+
+
+@pytest.fixture(scope="session")
+def apps(tmp_path_factory):
+    """Compile the tiny C workload programs once per session."""
+    out = tmp_path_factory.mktemp("apps")
+    cc = shutil.which("cc") or shutil.which("gcc")
+    if cc is None:
+        pytest.skip("no C compiler available")
+    bins = {}
+    for src in APPS_SRC.glob("*.c"):
+        exe = out / src.stem
+        subprocess.run(
+            [cc, "-O1", "-o", str(exe), str(src)], check=True,
+            capture_output=True,
+        )
+        bins[src.stem] = str(exe)
+    return bins

@@ -1,0 +1,168 @@
+"""CLI + config→driver builder tests.
+
+The CLI is the reference's `shadow config.yaml` surface (core/main.c:121);
+these tests run it in-process via main(argv). The managed-process plane tests
+verify the topology wiring end to end: RTTs observed by REAL processes equal
+the GML edge latency exactly on the virtual clock.
+"""
+
+import pathlib
+
+import pytest
+
+from shadow_tpu.__main__ import main
+from shadow_tpu.procs import build as build_mod
+
+NS_PER_MS = 1_000_000
+
+PHOLD_YAML = """
+general:
+  stop_time: 2
+  seed: 5
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        node [ id 0 bandwidth_down "81920 Kibit" bandwidth_up "81920 Kibit" ]
+        edge [ source 0 target 0 latency "50 ms" packet_loss 0.0 ]
+      ]
+experimental:
+  event_capacity: 512
+  events_per_host_per_window: 8
+hosts:
+  peer:
+    quantity: 4
+    app_model: phold
+    app_options: {msgload: 1, runtime: 1}
+"""
+
+
+def _procs_yaml(apps, lat_ms=30):
+    return f"""
+general:
+  stop_time: 30 s
+  seed: 3
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        node [ id 0 bandwidth_down "100 Mbit" bandwidth_up "100 Mbit" ]
+        edge [ source 0 target 0 latency "{lat_ms} ms" packet_loss 0.0 ]
+      ]
+hosts:
+  server:
+    processes:
+      - path: {apps['udp_echo_server']}
+        args: 9000 2
+  client:
+    processes:
+      - path: {apps['udp_echo_client']}
+        args: server 9000 2
+        start_time: 1 s
+"""
+
+
+def test_show_config(tmp_path, capsys):
+    cfg = tmp_path / "c.yaml"
+    cfg.write_text(PHOLD_YAML)
+    assert main([str(cfg), "--show-config", "--seed", "99"]) == 0
+    out = capsys.readouterr().out
+    assert "seed: 99" in out
+    assert "peer1" in out
+
+
+def test_bad_config_errors(tmp_path, capsys):
+    cfg = tmp_path / "c.yaml"
+    cfg.write_text("general: {stop_time: 1}\nnetwork: {graph: {type: gml}}\n"
+                   "bogus_section: {}\n")
+    assert main([str(cfg)]) == 2
+    assert "bogus_section" in capsys.readouterr().err
+
+
+def test_device_plane_runs(tmp_path, capsys, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    cfg = tmp_path / "c.yaml"
+    cfg.write_text(PHOLD_YAML)
+    assert main([str(cfg)]) == 0
+    out = capsys.readouterr().out
+    assert "4 hosts" in out
+    assert (tmp_path / "shadow.data").is_dir()
+
+
+def test_existing_data_dir_refused(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    (tmp_path / "shadow.data").mkdir()
+    cfg = tmp_path / "c.yaml"
+    cfg.write_text(PHOLD_YAML)
+    with pytest.raises(SystemExit, match="already exists"):
+        main([str(cfg)])
+
+
+@pytest.mark.skipif(
+    not build_mod.toolchain_available(), reason="no native toolchain"
+)
+def test_process_plane_e2e(tmp_path, apps, capsys):
+    """Full CLI run of the managed-process plane: real binaries, topology
+    latency from the GML edge, stdout captured into shadow.data files."""
+    cfg = tmp_path / "c.yaml"
+    cfg.write_text(_procs_yaml(apps, lat_ms=30))
+    data = tmp_path / "data"
+    assert main([str(cfg), "--data-directory", str(data)]) == 0
+    out = capsys.readouterr().out
+    assert "2 processes" in out
+
+    client_out = next((data / "hosts" / "client").glob("*.stdout"))
+    lines = client_out.read_text().strip().splitlines()
+    rtts = [int(l.split()[1]) for l in lines if l.startswith("rtt")]
+    assert len(rtts) == 2
+    # virtual clock: RTT is exactly 2 × the GML edge latency
+    assert all(r == 2 * 30 * NS_PER_MS for r in rtts), rtts
+    server_out = next((data / "hosts" / "server").glob("*.stdout"))
+    assert "server done" in server_out.read_text()
+
+
+@pytest.mark.skipif(
+    not build_mod.toolchain_available(), reason="no native toolchain"
+)
+def test_process_plane_deterministic(tmp_path, apps):
+    """determinism1 analog (SURVEY §4): two identical CLI runs produce
+    byte-identical per-host stdout files."""
+    cfg = tmp_path / "c.yaml"
+    cfg.write_text(_procs_yaml(apps, lat_ms=10))
+
+    def run_once(tag):
+        data = tmp_path / f"data{tag}"
+        assert main([str(cfg), "--data-directory", str(data)]) == 0
+        return sorted(
+            (p.relative_to(data), p.read_bytes())
+            for p in data.rglob("*.stdout")
+        )
+
+    assert run_once("a") == run_once("b")
+
+
+@pytest.mark.skipif(
+    not build_mod.toolchain_available(), reason="no native toolchain"
+)
+def test_failing_process_nonzero_exit(tmp_path, apps, capsys):
+    """Plugin-error accounting (manager.c:579-584): a failing managed
+    process makes the CLI exit nonzero."""
+    cfg = tmp_path / "c.yaml"
+    # client with a bad server name resolves nothing and exits nonzero
+    cfg.write_text(f"""
+general:
+  stop_time: 5 s
+network:
+  graph:
+    type: 1_gbit_switch
+hosts:
+  solo:
+    processes:
+      - path: {apps['udp_echo_client']}
+        args: nosuchhost 9000 1
+""")
+    data = tmp_path / "data"
+    assert main([str(cfg), "--data-directory", str(data)]) == 1
+    assert "failed" in capsys.readouterr().err

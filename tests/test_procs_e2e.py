@@ -8,8 +8,6 @@ round-trip times must equal the CONFIGURED topology latency exactly
 (virtual time), which no native run could produce.
 """
 
-import pathlib
-import shutil
 import subprocess
 
 import pytest
@@ -17,27 +15,9 @@ import pytest
 from shadow_tpu.procs import build as build_mod
 from shadow_tpu.procs.driver import NS_PER_SEC, ProcessDriver
 
-APPS = pathlib.Path(__file__).parent / "apps"
-
 pytestmark = pytest.mark.skipif(
     not build_mod.toolchain_available(), reason="no native toolchain"
 )
-
-
-@pytest.fixture(scope="session")
-def apps(tmp_path_factory):
-    """Compile the tiny C workloads once per session."""
-    out = tmp_path_factory.mktemp("apps")
-    cc = shutil.which("cc") or shutil.which("gcc")
-    bins = {}
-    for src in APPS.glob("*.c"):
-        exe = out / src.stem
-        subprocess.run(
-            [cc, "-O1", "-o", str(exe), str(src)], check=True,
-            capture_output=True,
-        )
-        bins[src.stem] = str(exe)
-    return bins
 
 
 def test_udp_echo_virtual_rtt(apps):
@@ -124,3 +104,26 @@ def test_udp_native_vs_simulated(apps):
     assert client.returncode == 0, client.stderr
     assert b"client done" in client.stdout
     assert b"server done" in out
+
+
+def test_stopped_process_releases_port(apps):
+    """A process stopped at its stop_time releases its port bindings so a
+    later process can rebind (descriptor teardown on stop)."""
+    lat = 5_000_000
+    d = ProcessDriver(stop_time=30 * NS_PER_SEC, latency_ns=lat)
+    hs = d.add_host("server", "11.0.0.1")
+    hc = d.add_host("client", "11.0.0.2")
+    # first server parks forever (asks for 99 echoes), stopped at t=2s
+    d.add_process(hs, [apps["udp_echo_server"], "9000", "99"],
+                  stop_time=2 * NS_PER_SEC)
+    # second server takes over the same port at t=3s
+    d.add_process(hs, [apps["udp_echo_server"], "9000", "1"],
+                  start_time=3 * NS_PER_SEC)
+    d.add_process(hc, [apps["udp_echo_client"], "server", "9000", "1"],
+                  start_time=4 * NS_PER_SEC)
+    d.run()
+    stopped, server2, client = d.procs
+    assert stopped.stopped_by_sim
+    assert server2.exit_code == 0, server2.stderr
+    assert client.exit_code == 0, client.stderr
+    assert b"client done" in client.stdout
