@@ -68,7 +68,7 @@ def cpu_phold_baseline(num_hosts: int, msgload: int, stop_s: int):
 
 
 def main():
-    num_hosts, msgload, stop_s = 1024, 4, 10
+    num_hosts, msgload, stop_s = 8192, 8, 10
     dev_events, dev_wall, sim_per_wall = device_phold(num_hosts, msgload, stop_s)
     dev_rate = dev_events / dev_wall if dev_wall > 0 else 0.0
 

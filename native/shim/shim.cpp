@@ -37,12 +37,16 @@
 #include <stdlib.h>
 #include <string.h>
 #include <sys/epoll.h>
+#include <sys/eventfd.h>
 #include <sys/mman.h>
+#include <sys/random.h>
 #include <sys/select.h>
 #include <sys/socket.h>
 #include <sys/syscall.h>
 #include <sys/time.h>
+#include <sys/timerfd.h>
 #include <sys/types.h>
+#include <sys/uio.h>
 #include <time.h>
 #include <unistd.h>
 
@@ -290,17 +294,204 @@ ssize_t recv(int fd, void* buf, size_t n, int flags) {
 
 ssize_t read(int fd, void* buf, size_t n) {
   if (!is_managed_fd(fd)) return syscall(SYS_read, fd, buf, n);
-  return recvfrom(fd, buf, n, 0, nullptr, nullptr);
+  // generic read (sockets, pipes, eventfds, timerfds); reply data = payload
+  size_t want = n > IPC_DATA_MAX ? IPC_DATA_MAX : n;
+  int64_t args[6] = {fd, (int64_t)want, 0, 0, 0, 0};
+  uint32_t out_len = 0;
+  int64_t r = ipc_call(SYS_read, args, nullptr, 0, buf, (uint32_t)want,
+                       &out_len);
+  return (ssize_t)r;
 }
 
 ssize_t write(int fd, const void* buf, size_t n) {
   if (!is_managed_fd(fd)) return syscall(SYS_write, fd, buf, n);
-  return sendto(fd, buf, n, 0, nullptr, 0);
+  if (n > IPC_DATA_MAX) n = IPC_DATA_MAX;  // caller loops for the rest
+  int64_t args[6] = {fd, (int64_t)n, 0, 0, 0, 0};
+  return (ssize_t)ipc_call(SYS_write, args, buf, (uint32_t)n, nullptr, 0,
+                           nullptr);
+}
+
+ssize_t readv(int fd, const struct iovec* iov, int iovcnt) {
+  if (!is_managed_fd(fd)) return syscall(SYS_readv, fd, iov, iovcnt);
+  // gather into one bounded read, then scatter across the iovecs
+  static thread_local uint8_t tmp[IPC_DATA_MAX];
+  size_t want = 0;
+  for (int i = 0; i < iovcnt; i++) want += iov[i].iov_len;
+  if (want > IPC_DATA_MAX) want = IPC_DATA_MAX;
+  ssize_t r = read(fd, tmp, want);
+  if (r <= 0) return r;
+  size_t off = 0;
+  for (int i = 0; i < iovcnt && off < (size_t)r; i++) {
+    size_t take = iov[i].iov_len;
+    if (take > (size_t)r - off) take = (size_t)r - off;
+    memcpy(iov[i].iov_base, tmp + off, take);
+    off += take;
+  }
+  return r;
+}
+
+ssize_t writev(int fd, const struct iovec* iov, int iovcnt) {
+  if (!is_managed_fd(fd)) return syscall(SYS_writev, fd, iov, iovcnt);
+  static thread_local uint8_t tmp[IPC_DATA_MAX];
+  size_t n = 0;
+  for (int i = 0; i < iovcnt; i++) {
+    size_t take = iov[i].iov_len;
+    if (take > IPC_DATA_MAX - n) take = IPC_DATA_MAX - n;
+    memcpy(tmp + n, iov[i].iov_base, take);
+    n += take;
+    if (n == IPC_DATA_MAX) break;
+  }
+  return write(fd, tmp, n);
+}
+
+ssize_t sendmsg(int fd, const struct msghdr* msg, int flags) {
+  if (!is_managed_fd(fd)) return syscall(SYS_sendmsg, fd, msg, flags);
+  static thread_local uint8_t tmp[IPC_DATA_MAX];
+  size_t n = 0;
+  for (size_t i = 0; i < msg->msg_iovlen; i++) {
+    size_t take = msg->msg_iov[i].iov_len;
+    if (take > IPC_DATA_MAX - n) take = IPC_DATA_MAX - n;
+    memcpy(tmp + n, msg->msg_iov[i].iov_base, take);
+    n += take;
+    if (n == IPC_DATA_MAX) break;
+  }
+  return sendto(fd, tmp, n, flags, (const struct sockaddr*)msg->msg_name,
+                (socklen_t)msg->msg_namelen);
+}
+
+ssize_t recvmsg(int fd, struct msghdr* msg, int flags) {
+  if (!is_managed_fd(fd)) return syscall(SYS_recvmsg, fd, msg, flags);
+  static thread_local uint8_t tmp[IPC_DATA_MAX];
+  size_t want = 0;
+  for (size_t i = 0; i < msg->msg_iovlen; i++) want += msg->msg_iov[i].iov_len;
+  if (want > IPC_DATA_MAX) want = IPC_DATA_MAX;
+  socklen_t alen = (socklen_t)msg->msg_namelen;
+  ssize_t r = recvfrom(fd, tmp, want, flags,
+                       (struct sockaddr*)msg->msg_name,
+                       msg->msg_name ? &alen : nullptr);
+  if (r <= 0) return r;
+  if (msg->msg_name) msg->msg_namelen = alen;
+  size_t off = 0;
+  for (size_t i = 0; i < msg->msg_iovlen && off < (size_t)r; i++) {
+    size_t take = msg->msg_iov[i].iov_len;
+    if (take > (size_t)r - off) take = (size_t)r - off;
+    memcpy(msg->msg_iov[i].iov_base, tmp + off, take);
+    off += take;
+  }
+  msg->msg_flags = 0;
+  if (msg->msg_control) msg->msg_controllen = 0;
+  return r;
 }
 
 int close(int fd) {
   if (!is_managed_fd(fd)) return (int)syscall(SYS_close, fd);
   return (int)ipc_call6(SYS_close, fd);
+}
+
+int dup(int fd) {
+  if (!is_managed_fd(fd)) return (int)syscall(SYS_dup, fd);
+  return (int)ipc_call6(SYS_dup, fd);
+}
+
+int dup2(int oldfd, int newfd) {
+  if (!is_managed_fd(oldfd)) return (int)syscall(SYS_dup2, oldfd, newfd);
+  return (int)ipc_call6(SYS_dup2, oldfd, newfd);
+}
+
+int dup3(int oldfd, int newfd, int flags) {
+  if (!is_managed_fd(oldfd)) return (int)syscall(SYS_dup3, oldfd, newfd, flags);
+  return (int)ipc_call6(SYS_dup3, oldfd, newfd, flags);
+}
+
+int pipe2(int fds[2], int flags) {
+  if (!g_ch) return (int)syscall(SYS_pipe2, fds, flags);
+  // reply data = [i32 read_fd, i32 write_fd]
+  int64_t args[6] = {flags, 0, 0, 0, 0, 0};
+  uint8_t out[8];
+  uint32_t out_len = 0;
+  int64_t r = ipc_call(SYS_pipe2, args, nullptr, 0, out, sizeof(out), &out_len);
+  if (r < 0) return -1;
+  if (out_len >= 8) {
+    memcpy(&fds[0], out, 4);
+    memcpy(&fds[1], out + 4, 4);
+  }
+  return 0;
+}
+
+int pipe(int fds[2]) { return pipe2(fds, 0); }
+
+int eventfd(unsigned int initval, int flags) {
+  if (!g_ch) return (int)syscall(SYS_eventfd2, initval, flags);
+  return (int)ipc_call6(SYS_eventfd2, initval, flags);
+}
+
+int timerfd_create(int clockid, int flags) {
+  if (!g_ch) return (int)syscall(SYS_timerfd_create, clockid, flags);
+  return (int)ipc_call6(SYS_timerfd_create, clockid, flags);
+}
+
+static int64_t ts_to_ns(const struct timespec* ts) {
+  return (int64_t)ts->tv_sec * 1000000000LL + ts->tv_nsec;
+}
+
+static void ns_to_ts(int64_t ns, struct timespec* ts) {
+  ts->tv_sec = ns / 1000000000LL;
+  ts->tv_nsec = ns % 1000000000LL;
+}
+
+int timerfd_settime(int fd, int flags, const struct itimerspec* new_value,
+                    struct itimerspec* old_value) {
+  if (!is_managed_fd(fd))
+    return (int)syscall(SYS_timerfd_settime, fd, flags, new_value, old_value);
+  // request data = [i64 value_ns, i64 interval_ns]; reply data = old pair
+  uint8_t in[16], out[16];
+  int64_t v = ts_to_ns(&new_value->it_value);
+  int64_t iv = ts_to_ns(&new_value->it_interval);
+  memcpy(in, &v, 8);
+  memcpy(in + 8, &iv, 8);
+  int64_t args[6] = {fd, flags, 0, 0, 0, 0};
+  uint32_t out_len = 0;
+  int64_t r = ipc_call(SYS_timerfd_settime, args, in, sizeof(in), out,
+                       sizeof(out), &out_len);
+  if (r < 0) return -1;
+  if (old_value && out_len >= 16) {
+    int64_t ov, oiv;
+    memcpy(&ov, out, 8);
+    memcpy(&oiv, out + 8, 8);
+    ns_to_ts(ov, &old_value->it_value);
+    ns_to_ts(oiv, &old_value->it_interval);
+  }
+  return 0;
+}
+
+int timerfd_gettime(int fd, struct itimerspec* curr) {
+  if (!is_managed_fd(fd))
+    return (int)syscall(SYS_timerfd_gettime, fd, curr);
+  uint8_t out[16];
+  uint32_t out_len = 0;
+  int64_t args[6] = {fd, 0, 0, 0, 0, 0};
+  int64_t r = ipc_call(SYS_timerfd_gettime, args, nullptr, 0, out, sizeof(out),
+                       &out_len);
+  if (r < 0) return -1;
+  if (curr && out_len >= 16) {
+    int64_t v, iv;
+    memcpy(&v, out, 8);
+    memcpy(&iv, out + 8, 8);
+    ns_to_ts(v, &curr->it_value);
+    ns_to_ts(iv, &curr->it_interval);
+  }
+  return 0;
+}
+
+ssize_t getrandom(void* buf, size_t buflen, unsigned int flags) {
+  if (!g_ch) return syscall(SYS_getrandom, buf, buflen, flags);
+  // deterministic per-host stream from the simulator's seeded RNG tree
+  size_t want = buflen > IPC_DATA_MAX ? IPC_DATA_MAX : buflen;
+  int64_t args[6] = {(int64_t)want, flags, 0, 0, 0, 0};
+  uint32_t out_len = 0;
+  int64_t r = ipc_call(SYS_getrandom, args, nullptr, 0, buf, (uint32_t)want,
+                       &out_len);
+  return (ssize_t)r;
 }
 
 int shutdown(int fd, int how) {

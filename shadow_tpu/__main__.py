@@ -82,13 +82,8 @@ def _dump_config(cfg) -> str:
     import yaml
 
     def clean(x):
-        if dataclasses.is_dataclass(x):
-            return {k: clean(v) for k, v in dataclasses.asdict(x).items()}
-        if isinstance(x, dict):
-            return {k: clean(v) for k, v in x.items()}
-        if isinstance(x, list):
-            return [clean(v) for v in x]
-        return x
+        # asdict() already recurses through nested dataclasses/dicts/lists
+        return dataclasses.asdict(x) if dataclasses.is_dataclass(x) else x
 
     return yaml.safe_dump(
         {
@@ -123,6 +118,8 @@ def _prepare_data_dir(cfg) -> pathlib.Path:
 
 
 def _run_process_plane(cfg, driver, progress: bool) -> int:
+    from shadow_tpu.utils import log
+
     t0 = time.monotonic()
     if progress:
         driver.heartbeat_interval = cfg.general.heartbeat_interval
@@ -135,6 +132,15 @@ def _run_process_plane(cfg, driver, progress: bool) -> int:
                 f"wall {time.monotonic() - t0:.1f}s",
                 flush=True,
             )
+            # per-host tracker heartbeat (tracker.c:128-143 analog)
+            for name, t in d.host_trackers().items():
+                log.logger.debug(
+                    "tracker: tx %d pkts / %d B, rx %d pkts / %d B, "
+                    "%d dropped",
+                    t["tx_packets"], t["tx_bytes"],
+                    t["rx_packets"], t["rx_bytes"], t["dropped_packets"],
+                    host=name,
+                )
 
         driver.heartbeat_fn = beat
     driver.run()
@@ -209,6 +215,9 @@ def main(argv: list[str] | None = None) -> int:
     try:
         cfg = load_config(args.config)
         _apply_overrides(cfg, args)
+        from shadow_tpu.utils import log
+
+        log.logger.set_level(cfg.general.log_level)
     except (ConfigError, FileNotFoundError, ValueError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 2

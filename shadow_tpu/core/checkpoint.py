@@ -1,0 +1,100 @@
+"""Checkpoint/resume of device simulation state.
+
+The reference has none (SURVEY.md §5.4): simulation state lives partly in
+native process memory of managed plugins, which makes snapshots hard. Here
+the device-plane state is a pure pytree of arrays, so a checkpoint is just
+those arrays on disk — resume is bit-exact because a window step is a pure
+function of (state, params, window).
+
+Format: one .npz whose keys are the pytree key-paths of SimState leaves,
+plus a `__meta__` JSON blob (host count, sim time, version) for validation.
+Restoring requires a Simulation built from the SAME config (the kernel and
+state structure are compile-time artifacts; only the array contents travel).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+
+import jax
+import numpy as np
+
+FORMAT_VERSION = 1
+
+
+class CheckpointError(ValueError):
+    pass
+
+
+def _leaf_paths(state):
+    leaves, treedef = jax.tree_util.tree_flatten_with_path(state)
+    return [(jax.tree_util.keystr(path), leaf) for path, leaf in leaves], treedef
+
+
+def save(sim, path: str) -> None:
+    """Write sim.state (and metadata) to `path` as an .npz archive."""
+    pairs, _ = _leaf_paths(sim.state)
+    arrays = {}
+    for key, leaf in pairs:
+        arrays[key] = np.asarray(jax.device_get(leaf))
+    meta = {
+        "version": FORMAT_VERSION,
+        "num_hosts": sim.num_hosts,
+        "stop_time": sim.stop_time,
+        "runahead": sim.runahead,
+        "now": int(jax.device_get(sim.state.now)),
+        "leaves": sorted(arrays),
+    }
+    arrays["__meta__"] = np.frombuffer(
+        json.dumps(meta).encode(), dtype=np.uint8
+    )
+    buf = io.BytesIO()
+    np.savez_compressed(buf, **arrays)
+    with open(path, "wb") as f:
+        f.write(buf.getvalue())
+
+
+def load_meta(path: str) -> dict:
+    with np.load(path) as z:
+        return json.loads(bytes(z["__meta__"]).decode())
+
+
+def restore(sim, path: str) -> None:
+    """Replace sim.state with the checkpointed arrays (in place).
+
+    The Simulation must be built from the same config: every state leaf must
+    exist in the checkpoint with identical shape and dtype.
+    """
+    meta = load_meta(path)
+    if meta["version"] != FORMAT_VERSION:
+        raise CheckpointError(
+            f"checkpoint version {meta['version']} != {FORMAT_VERSION}"
+        )
+    if meta["num_hosts"] != sim.num_hosts:
+        raise CheckpointError(
+            f"checkpoint has {meta['num_hosts']} hosts, sim has "
+            f"{sim.num_hosts} (must be built from the same config)"
+        )
+    pairs, treedef = _leaf_paths(sim.state)
+    with np.load(path) as z:
+        want = {k for k, _ in pairs}
+        have = set(meta["leaves"])
+        if want != have:
+            missing = sorted(want - have)
+            extra = sorted(have - want)
+            raise CheckpointError(
+                f"state structure mismatch: missing {missing[:5]}, "
+                f"unexpected {extra[:5]} (sim config differs from the one "
+                f"checkpointed)"
+            )
+        new_leaves = []
+        for key, leaf in pairs:
+            arr = z[key]
+            if arr.shape != leaf.shape or arr.dtype != np.asarray(leaf).dtype:
+                raise CheckpointError(
+                    f"leaf {key}: checkpoint {arr.shape}/{arr.dtype} vs sim "
+                    f"{leaf.shape}/{np.asarray(leaf).dtype}"
+                )
+            new_leaves.append(jax.numpy.asarray(arr))
+    sim.state = jax.tree_util.tree_unflatten(treedef, new_leaves)

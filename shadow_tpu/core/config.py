@@ -151,6 +151,8 @@ class ExperimentalOptions:
     sockets_per_host: int = 8
     router_queue_slots: int = 64  # per-host CoDel ring capacity
     devices: int = 1  # mesh size over the host axis
+    inbox_slots: int = 8  # B: per-host intra-window self-event slots
+    outbox_slots: int = 64  # O: per-host emission slots per window
 
     @classmethod
     def from_dict(cls, d: dict) -> "ExperimentalOptions":
@@ -180,7 +182,7 @@ class ExperimentalOptions:
                 setattr(out, name, bool(d[name]))
         for name in (
             "event_capacity", "events_per_host_per_window", "sockets_per_host",
-            "router_queue_slots", "devices",
+            "router_queue_slots", "devices", "inbox_slots", "outbox_slots",
         ):
             if name in d:
                 setattr(out, name, int(d[name]))

@@ -180,7 +180,14 @@ def _extract_window(pool: EventPool, win_end, H: int, K: int):
     the deferred leftover. (Known tie edge: a leftover and an extracted event
     at the exact same nanosecond can still invert against a same-time
     self-emission; requires K overflow + an exact time tie, and K is
-    configurable — tracked for an exact re-extraction fix.)"""
+    configurable — tracked for an exact re-extraction fix.)
+
+    TPU note: everything here is sorts and gathers by construction — XLA
+    scatters serialize element-by-element on TPU (~0.5 µs each), so a single
+    [C]-row scatter would cost more than the entire window step. After the
+    sort, each host's events are CONSECUTIVE rows, so the matrix is a gather
+    at starts[h]+k, and the pool-slot clearing flag is mapped back through
+    the inverse permutation (computed with a second small sort)."""
     C = pool.capacity
     inwin = pool.time < win_end
     sort_dst = jnp.where(inwin, pool.dst, jnp.int32(H))
@@ -188,56 +195,91 @@ def _extract_window(pool: EventPool, win_end, H: int, K: int):
     s_dst, s_time, s_src, s_seq, s_idx = jax.lax.sort(
         [sort_dst, pool.time, pool.src, pool.seq, idx], num_keys=4, is_stable=True
     )
-    starts = jnp.searchsorted(s_dst, jnp.arange(H, dtype=jnp.int32)).astype(jnp.int32)
-    pos = jnp.arange(C, dtype=jnp.int32)
-    rank = pos - starts[jnp.clip(s_dst, 0, H - 1)]
-    valid = s_dst < H
-    extract = valid & (rank < K)
-    # Scatter into the matrix; invalid rows target index H → dropped.
-    mrow = jnp.where(extract, s_dst, jnp.int32(H))
-    mcol = jnp.where(extract, rank, 0)
-    gathered_kind = pool.kind[s_idx]
-    gathered_payload = pool.payload[s_idx]
-
-    def scat(init, vals):
-        return init.at[mrow, mcol].set(vals, mode="drop")
-
+    hostsr = jnp.arange(H, dtype=jnp.int32)
+    starts = jnp.searchsorted(s_dst, hostsr).astype(jnp.int32)
+    ends = jnp.searchsorted(s_dst, hostsr + 1).astype(jnp.int32)
+    # mat[h, k] = sorted row starts[h]+k (valid while < ends[h])
+    take = starts[:, None] + jnp.arange(K, dtype=jnp.int32)[None, :]
+    valid_mat = take < ends[:, None]
+    gpos = jnp.where(valid_mat, take, 0)
+    pool_idx = s_idx[gpos]  # [H, K] original pool slots
     mat = _Matrix(
-        time=scat(jnp.full((H, K), NEVER, dtype=jnp.int64), s_time),
-        src=scat(jnp.zeros((H, K), dtype=jnp.int32), s_src),
-        seq=scat(jnp.zeros((H, K), dtype=jnp.int32), s_seq),
-        kind=scat(jnp.zeros((H, K), dtype=jnp.int32), gathered_kind),
-        payload=jnp.zeros((H, K, PAYLOAD_WORDS), dtype=jnp.int32)
-        .at[mrow, mcol]
-        .set(gathered_payload, mode="drop"),
+        time=jnp.where(valid_mat, s_time[gpos], NEVER),
+        src=jnp.where(valid_mat, s_src[gpos], 0),
+        seq=jnp.where(valid_mat, s_seq[gpos], 0),
+        kind=jnp.where(valid_mat, pool.kind[pool_idx], 0),
+        payload=jnp.where(
+            valid_mat[:, :, None], pool.payload[pool_idx], 0
+        ),
     )
-    # Earliest leftover (rank == K) per host; NEVER if the host fit in K.
-    defer_row = jnp.where(valid & (rank == K), s_dst, jnp.int32(H))
-    defer_time = (
-        jnp.full((H,), NEVER, dtype=jnp.int64)
-        .at[defer_row]
-        .set(s_time, mode="drop")
+    # Earliest leftover per host: sorted row starts[h]+K if still this host's.
+    has_defer = (starts + K) < ends
+    defer_time = jnp.where(
+        has_defer, s_time[jnp.where(has_defer, starts + K, 0)], NEVER
     )
-    # Free the extracted slots in the pool.
-    clear_idx = jnp.where(extract, s_idx, jnp.int32(C))
-    new_time = pool.time.at[clear_idx].set(NEVER, mode="drop")
+    # Clear extracted pool slots WITHOUT a scatter: flag rows in sorted
+    # order, then permute the flags back to pool order via the inverse
+    # permutation (argsort of s_idx — a cheap 2-operand sort).
+    spos = jnp.arange(C, dtype=jnp.int32)
+    rank = spos - starts[jnp.clip(s_dst, 0, H - 1)]
+    extracted_sorted = (s_dst < H) & (rank < K)
+    _, inv = jax.lax.sort([s_idx, spos], num_keys=1, is_stable=True)
+    extracted_pool = extracted_sorted[inv]
+    new_time = jnp.where(extracted_pool, NEVER, pool.time)
     return mat, pool.replace(time=new_time), defer_time
 
 
 def _inbox_min(inbox: _Inbox):
     """Per-host lexicographic min of the inbox by (time, src, seq).
-    Returns (time, src, seq, slot) each [H]."""
-    B = inbox.time.shape[1]
-    slot = jnp.broadcast_to(jnp.arange(B, dtype=jnp.int32), inbox.time.shape)
-    t, s, q, i = jax.lax.sort(
-        [inbox.time, inbox.src, inbox.seq, slot], num_keys=3, is_stable=True, dimension=1
-    )
-    return t[:, 0], s[:, 0], q[:, 0], i[:, 0]
+    Returns (time, src, seq, slot) each [H].
+
+    Tournament reduction (log2 B rounds of elementwise compares) instead of
+    a lax.sort: B is tiny and TPU's bitonic sort costs ~ms at H=8k where
+    this costs microseconds."""
+    t, s, q = inbox.time, inbox.src, inbox.seq
+    B = t.shape[1]
+    slot = jnp.broadcast_to(jnp.arange(B, dtype=jnp.int32), t.shape)
+    while B > 1:
+        half = (B + 1) // 2
+        t1, s1, q1, i1 = t[:, :half], s[:, :half], q[:, :half], slot[:, :half]
+        t2 = t[:, half:]
+        pad = half - t2.shape[1]
+        if pad:
+            t2 = jnp.pad(t2, ((0, 0), (0, pad)), constant_values=NEVER)
+            s2 = jnp.pad(s[:, half:], ((0, 0), (0, pad)))
+            q2 = jnp.pad(q[:, half:], ((0, 0), (0, pad)))
+            i2 = jnp.pad(slot[:, half:], ((0, 0), (0, pad)))
+        else:
+            s2, q2, i2 = s[:, half:], q[:, half:], slot[:, half:]
+        take2 = _key_lt(t2, s2, q2, t1, s1, q1)
+        t = jnp.where(take2, t2, t1)
+        s = jnp.where(take2, s2, s1)
+        q = jnp.where(take2, q2, q1)
+        slot = jnp.where(take2, i2, i1)
+        B = half
+    return t[:, 0], s[:, 0], q[:, 0], slot[:, 0]
 
 
 def _key_lt(t1, s1, q1, t2, s2, q2):
     """(t1,s1,q1) < (t2,s2,q2) lexicographically (same dst implied)."""
     return (t1 < t2) | ((t1 == t2) & ((s1 < s2) | ((s1 == s2) & (q1 < q2))))
+
+
+def _set_col(arr, col, mask, val):
+    """arr[h, col[h]] = val[h] for masked hosts, as a pure elementwise
+    select over [H, B(, P)] — avoids XLA scatter, which serializes on TPU.
+    `val` may be scalar, [H], or [H, P] matching arr's trailing dims."""
+    B = arr.shape[1]
+    cols = jnp.arange(B, dtype=jnp.int32)
+    hit = mask[:, None] & (cols[None, :] == col[:, None])  # [H, B]
+    val = jnp.asarray(val, arr.dtype)
+    if arr.ndim == 3:
+        if val.ndim == 2:
+            val = val[:, None, :]
+        return jnp.where(hit[:, :, None], val, arr)
+    if val.ndim == 1:
+        val = val[:, None]
+    return jnp.where(hit, val, arr)
 
 
 # ---------------------------------------------------------------------------
@@ -315,10 +357,14 @@ def make_window_step(
             )
 
             # --- consume the chosen event ---
+            state = state.replace(
+                host=state.host.replace(
+                    done_t=jnp.where(valid, ev_time, state.host.done_t)
+                )
+            )
             ptr = jnp.where(valid & ~use_inbox, ptr + 1, ptr)
-            clear_slot = jnp.where(valid & use_inbox, i_slot, jnp.int32(B))
             inbox = inbox.replace(
-                time=inbox.time.at[hosts, clear_slot].set(NEVER, mode="drop")
+                time=_set_col(inbox.time, i_slot, valid & use_inbox, NEVER)
             )
 
             # --- run handlers (ascending kind; masked SoA updates) ---
@@ -359,30 +405,24 @@ def make_window_step(
                 # next window, late but never lost — a lost NIC pump event
                 # would wedge its queue); the counter records the deferral.
                 to_out = em.mask & ~ins
-                ins_slot = jnp.where(ins, ff, jnp.int32(B))
                 inbox = inbox.replace(
-                    time=inbox.time.at[hosts, ins_slot].set(em.time, mode="drop"),
-                    src=inbox.src.at[hosts, ins_slot].set(hosts, mode="drop"),
-                    seq=inbox.seq.at[hosts, ins_slot].set(seq, mode="drop"),
-                    kind=inbox.kind.at[hosts, ins_slot].set(em.kind, mode="drop"),
-                    payload=inbox.payload.at[hosts, ins_slot].set(
-                        em.payload, mode="drop"
-                    ),
+                    time=_set_col(inbox.time, ff, ins, em.time),
+                    src=_set_col(inbox.src, ff, ins, hosts),
+                    seq=_set_col(inbox.seq, ff, ins, seq),
+                    kind=_set_col(inbox.kind, ff, ins, em.kind),
+                    payload=_set_col(inbox.payload, ff, ins, em.payload),
                 )
 
-                oslot = jnp.where(
-                    to_out & (outbox.count < O), outbox.count, jnp.int32(O)
-                )
+                ocol = outbox.count  # next free outbox column per host
+                put = to_out & (ocol < O)
                 outbox = outbox.replace(
-                    time=outbox.time.at[hosts, oslot].set(em.time, mode="drop"),
-                    dst=outbox.dst.at[hosts, oslot].set(em.dst, mode="drop"),
-                    src=outbox.src.at[hosts, oslot].set(hosts, mode="drop"),
-                    seq=outbox.seq.at[hosts, oslot].set(seq, mode="drop"),
-                    kind=outbox.kind.at[hosts, oslot].set(em.kind, mode="drop"),
-                    payload=outbox.payload.at[hosts, oslot].set(
-                        em.payload, mode="drop"
-                    ),
-                    count=outbox.count + (oslot < O).astype(jnp.int32),
+                    time=_set_col(outbox.time, ocol, put, em.time),
+                    dst=_set_col(outbox.dst, ocol, put, em.dst),
+                    src=_set_col(outbox.src, ocol, put, hosts),
+                    seq=_set_col(outbox.seq, ocol, put, seq),
+                    kind=_set_col(outbox.kind, ocol, put, em.kind),
+                    payload=_set_col(outbox.payload, ocol, put, em.payload),
+                    count=outbox.count + put.astype(jnp.int32),
                 )
                 state = state.replace(
                     counters=state.counters.replace(
@@ -391,8 +431,7 @@ def make_window_step(
                         inbox_overflow_deferred=state.counters.inbox_overflow_deferred
                         + jnp.sum(is_self & ~has_free, dtype=jnp.int64),
                         outbox_overflow_dropped=state.counters.outbox_overflow_dropped
-                        + jnp.sum(to_out & (outbox.count >= O) & (oslot >= O),
-                                  dtype=jnp.int64),
+                        + jnp.sum(to_out & ~put, dtype=jnp.int64),
                     )
                 )
 
@@ -403,22 +442,23 @@ def make_window_step(
             cond, body, carry0
         )
 
-        # --- merge: pool ∪ outbox ∪ spilled leftovers (inbox/matrix) ---
-        # Leftovers are only non-empty if max_iters capped the loop; their
-        # keys exceed everything processed, so deferring them is still a
-        # correct (if slower) schedule.
+        # --- merge: pool ∪ outbox ∪ spilled leftovers (inbox/matrix) with
+        # one sort by time (gathers only — no scatters, which serialize on
+        # TPU). Leftovers are only non-empty if max_iters capped the loop;
+        # their keys exceed everything processed, so deferring them is still
+        # a correct (if slower) schedule.
         pool = state.pool
         C = pool.capacity
         col = jnp.broadcast_to(jnp.arange(K, dtype=jnp.int32), (H, K))
         mat_left = col >= ptr[:, None]
         mat_time_left = jnp.where(mat_left, mat.time, NEVER)
 
+        hostsK = jnp.broadcast_to(hosts[:, None], (H, K)).reshape(-1)
+        hostsB = jnp.broadcast_to(hosts[:, None], inbox.time.shape).reshape(-1)
         all_time = jnp.concatenate(
             [pool.time, outbox.time.reshape(-1), inbox.time.reshape(-1),
              mat_time_left.reshape(-1)]
         )
-        hostsK = jnp.broadcast_to(hosts[:, None], (H, K)).reshape(-1)
-        hostsB = jnp.broadcast_to(hosts[:, None], inbox.time.shape).reshape(-1)
         all_dst = jnp.concatenate(
             [pool.dst, outbox.dst.reshape(-1), hostsB, hostsK]
         )
@@ -451,8 +491,21 @@ def make_window_step(
             kind=all_kind[keep],
             payload=all_payload[keep],
         )
+        # Speculation-violation signal for the optimistic synchronizer: a
+        # cross-host emission targeting time t is a violation iff its
+        # DESTINATION host already processed an event at time >= t since the
+        # synchronizer's window began (host.done_t, reset by run_optimistic
+        # per window) — the delivery should have interleaved before that
+        # event. With a conservative window this is impossible
+        # (t >= now + min_latency >= window end > every processed time), so
+        # xmit_min stays NEVER there.
+        cross = (outbox.dst != hosts[:, None]) & (outbox.time != NEVER)
+        dst_last = state.host.done_t[jnp.clip(outbox.dst, 0, H - 1)]
+        violates = cross & (outbox.time <= dst_last)
+        xmit_min = jnp.min(jnp.where(violates, outbox.time, NEVER))
         state = state.replace(
             pool=new_pool,
+            xmit_min=xmit_min,
             counters=state.counters.replace(
                 pool_overflow_dropped=state.counters.pool_overflow_dropped + dropped
             ),
@@ -533,6 +586,8 @@ class Simulation:
         else:
             seq_init = np.zeros(num_hosts, dtype=np.int32)
 
+        self.handlers = handlers
+        self.K, self.B, self.O = K, B, O
         host = make_host_state(num_hosts, host_vertex)
         host = host.replace(seq_next=jnp.asarray(seq_init))
         self.state = SimState(
@@ -589,6 +644,67 @@ class Simulation:
             windows += 1
         return windows
 
+    # -- optimistic synchronization: speculate long windows, roll back on
+    # violation (SURVEY §7.6). Pure-array state makes rollback free: the
+    # pre-window state is just the previous pytree. --
+    def run_optimistic(
+        self,
+        until: int | None = None,
+        window_factor: int = 8,
+    ) -> tuple[int, int]:
+        """Advance with speculative windows of window_factor × runahead.
+
+        A window [ws, we) is processed to completion by repeated sub-steps
+        (each processes all pool events < we in per-host key order; newly
+        generated cross-host deliveries inside the window are picked up by
+        the following sub-step). `host.done_t` tracks each host's processed
+        progress across sub-steps; a sub-step reports a violation
+        (state.xmit_min < NEVER) when it emitted a delivery behind its
+        destination's progress clock. On violation the WHOLE window rolls
+        back to the snapshot (pure arrays — rollback is just dropping the
+        speculated pytree) and retries with the window shrunk to the
+        violation time, never below the conservative runahead, which is
+        violation-free by construction (emission time >= now + min_latency
+        >= ws + runahead >= any processed time).
+
+        Returns (windows_committed, rollbacks). Produces the conservative
+        schedule's results; wins when the pool holds work spanning many
+        runaheads (fewer barriers/dispatches per simulated second).
+        """
+        stop = self.stop_time if until is None else min(until, self.stop_time)
+        cons = self.runahead
+        windows = rollbacks = 0
+        neg1 = jnp.full((self.num_hosts,), -1, dtype=jnp.int64)
+        self.state = self.state.replace(
+            host=self.state.host.replace(done_t=neg1)
+        )
+        min_next = int(jnp.min(self.state.pool.time))
+        while min_next < stop:
+            ws = min_next
+            we = min(ws + window_factor * cons, stop)
+            base = self.state  # rollback snapshot (done_t already reset)
+            while True:  # attempt [ws, we), shrinking on violation
+                st = base
+                cur = ws
+                viol = None
+                while cur < we:
+                    st, mn = self._step(st, self.params, cur, we)
+                    v = int(st.xmit_min)
+                    if v < int(simtime.NEVER) and we > ws + cons:
+                        viol = v
+                        break
+                    cur = int(mn)
+                if viol is None:
+                    break  # window complete (or conservative-size: commit)
+                rollbacks += 1
+                we = max(viol, ws + cons)
+            self.state = st.replace(
+                host=st.host.replace(done_t=neg1)
+            )
+            min_next = int(jnp.min(st.pool.time))
+            windows += 1
+        return windows, rollbacks
+
     # -- fused run: windows execute in on-device while_loop chunks --
     def run(
         self, until: int | None = None, windows_per_dispatch: int = 64
@@ -604,3 +720,27 @@ class Simulation:
     def counters(self) -> dict[str, int]:
         c = jax.device_get(self.state.counters)
         return {k: int(v) for k, v in c.__dict__.items()}
+
+    def save_checkpoint(self, path: str) -> None:
+        """Snapshot the full device state to disk (resume is bit-exact)."""
+        from shadow_tpu.core import checkpoint
+
+        checkpoint.save(self, path)
+
+    def load_checkpoint(self, path: str) -> None:
+        """Restore state saved by save_checkpoint; this Simulation must be
+        built from the same config."""
+        from shadow_tpu.core import checkpoint
+
+        checkpoint.restore(self, path)
+
+    def host_trackers(self) -> dict[str, "np.ndarray"]:
+        """Per-host byte/packet counters from the device NIC state
+        (tracker.c analog); empty if the sim has no network stack."""
+        sub = self.state.subs.get("nic")
+        if sub is None:
+            return {}
+        return {
+            k: np.asarray(jax.device_get(getattr(sub, k)))
+            for k in ("tx_packets", "tx_bytes", "rx_packets", "rx_bytes")
+        }

@@ -106,6 +106,10 @@ class HostState:
     seq_next: jnp.ndarray  # i32: next event sequence number for emissions
     rng_counter: jnp.ndarray  # u32: per-host RNG draw counter
     vertex: jnp.ndarray  # i32: used-vertex index in the baked topology
+    # Max event time processed since the optimistic synchronizer last reset
+    # it (-1 = none): the per-host progress clock that speculation
+    # violations are judged against. Unused by conservative runs.
+    done_t: jnp.ndarray  # i64
 
 
 @struct.dataclass
@@ -127,6 +131,13 @@ class SimState:
     host: HostState
     counters: Counters
     rng_keys: jnp.ndarray  # [H] per-host PRNG key array (core.rng.host_keys)
+    # Earliest cross-host emission time of the LAST window stepped (NEVER if
+    # none). The optimistic synchronizer compares it against the window end
+    # to detect speculation violations (SURVEY §7.6); conservative windows
+    # satisfy xmit_min >= window end by construction.
+    xmit_min: jnp.ndarray = struct.field(
+        default_factory=lambda: jnp.asarray(simtime.NEVER, jnp.int64)
+    )
     # Subsystem states keyed by name ("nic", "udp", "tcp", app models...).
     # A plain dict is a pytree node; handlers look up their own slice.
     subs: dict[str, Any] = struct.field(default_factory=dict)
@@ -144,4 +155,5 @@ def make_host_state(num_hosts: int, host_vertex: np.ndarray) -> HostState:
         seq_next=jnp.zeros((num_hosts,), dtype=jnp.int32),
         rng_counter=jnp.zeros((num_hosts,), dtype=jnp.uint32),
         vertex=jnp.asarray(host_vertex, dtype=jnp.int32),
+        done_t=jnp.full((num_hosts,), -1, dtype=jnp.int64),
     )

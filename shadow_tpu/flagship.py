@@ -30,9 +30,16 @@ def build_phold_flagship(
     if runtime_s is None:
         runtime_s = max(stop_s - 2, 1)
     if event_capacity is None:
-        event_capacity = max(4 * num_hosts * msgload, 4096)
+        # PHOLD's live population is num_hosts × msgload messages plus one
+        # window of in-flight emissions; 2× covers it. Sort cost per window
+        # scales with the pool, so a tight pool is a direct speedup.
+        event_capacity = max(2 * num_hosts * msgload, 4096)
     if K is None:
-        K = max(2 * msgload + 4, 8)
+        # Random destinations make per-host wave occupancy Poisson(msgload);
+        # K must cover the max over ALL hosts or tail hosts defer into an
+        # extra window per wave (correct but ~2× slower). 2·msgload+16
+        # covers the tail beyond 100k hosts.
+        K = 2 * msgload + 16
     return build_simulation(
         {
             "general": {"stop_time": stop_s, "seed": seed},
@@ -40,6 +47,11 @@ def build_phold_flagship(
             "experimental": {
                 "event_capacity": event_capacity,
                 "events_per_host_per_window": K,
+                # PHOLD emits exactly one event per handled event, so K
+                # outbox slots per host can never overflow; small boxes keep
+                # the per-window merge sort lean (the hot cost at scale).
+                "outbox_slots": K,
+                "inbox_slots": 4,
             },
             "hosts": {
                 "peer": {

@@ -58,6 +58,11 @@ class NicState:
     recv_pending: jnp.ndarray  # [H] bool
     # drop counter for send-ring overflow
     sendq_dropped: jnp.ndarray  # [] i64
+    # per-host byte/packet tracker (tracker.c:215-247 analog)
+    tx_packets: jnp.ndarray  # [H] i64
+    tx_bytes: jnp.ndarray  # [H] i64
+    rx_packets: jnp.ndarray  # [H] i64
+    rx_bytes: jnp.ndarray  # [H] i64
 
 
 def init(bw_up_bits, bw_down_bits, queue_slots: int = 64) -> NicState:
@@ -90,6 +95,24 @@ def init(bw_up_bits, bw_down_bits, queue_slots: int = 64) -> NicState:
         send_pending=jnp.zeros((H,), bool),
         recv_pending=jnp.zeros((H,), bool),
         sendq_dropped=jnp.zeros((), jnp.int64),
+        tx_packets=jnp.zeros((H,), jnp.int64),
+        tx_bytes=jnp.zeros((H,), jnp.int64),
+        rx_packets=jnp.zeros((H,), jnp.int64),
+        rx_bytes=jnp.zeros((H,), jnp.int64),
+    )
+
+
+def count_tx(nic: NicState, mask, size) -> NicState:
+    return nic.replace(
+        tx_packets=nic.tx_packets + mask.astype(jnp.int64),
+        tx_bytes=nic.tx_bytes + jnp.where(mask, size.astype(jnp.int64), 0),
+    )
+
+
+def count_rx(nic: NicState, mask, size) -> NicState:
+    return nic.replace(
+        rx_packets=nic.rx_packets + mask.astype(jnp.int64),
+        rx_bytes=nic.rx_bytes + jnp.where(mask, size.astype(jnp.int64), 0),
     )
 
 

@@ -158,6 +158,12 @@ class NetStack:
                 ),
             )
         )
+        state = state.with_sub(
+            nic.SUB,
+            nic.count_rx(
+                state.subs[nic.SUB], mask, pkt.total_bytes(payload)
+            ),
+        )
         state = state.with_sub(udp.SUB, u)
         for hook in self.recv_hooks:
             state = hook(state, found, slot, src, payload, emitter, now, params)
@@ -225,6 +231,7 @@ class NetStack:
             tx_rem=jnp.where(do & ~bootstrap, n.tx_rem - size, n.tx_rem)
         )
         n = nic.pop_send(n, do)
+        n = nic.count_tx(n, do, size)
         state = state.with_sub(nic.SUB, n)
 
         remote = do & (dst != hosts)

@@ -69,6 +69,13 @@ def build_process_driver(
         if data_root is not None:
             host_dir = pathlib.Path(data_root) / "hosts" / h.name
             host_dir.mkdir(parents=True, exist_ok=True)
+        if h.pcap_directory is not None:
+            # relative paths land under the host's data dir, like the
+            # reference (configuration.rs:412-415)
+            p = pathlib.Path(h.pcap_directory)
+            if not p.is_absolute():
+                p = (host_dir or pathlib.Path(".")) / p
+            sim_host.pcap_dir = str(p)
 
         n = 0
         for popt in h.processes:

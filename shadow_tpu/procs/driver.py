@@ -40,6 +40,7 @@ from typing import Callable
 
 from shadow_tpu.procs import build as build_mod
 from shadow_tpu.procs import ipc
+from shadow_tpu.utils import log
 
 NS_PER_SEC = 1_000_000_000
 
@@ -49,6 +50,8 @@ SYS_write = 1
 SYS_close = 3
 SYS_poll = 7
 SYS_ioctl = 16
+SYS_dup = 32
+SYS_dup2 = 33
 SYS_nanosleep = 35
 SYS_socket = 41
 SYS_connect = 42
@@ -67,8 +70,19 @@ SYS_gettimeofday = 96
 SYS_clock_gettime = 228
 SYS_epoll_wait = 232
 SYS_epoll_ctl = 233
+SYS_timerfd_create = 283
+SYS_timerfd_settime = 286
+SYS_timerfd_gettime = 287
 SYS_accept4 = 288
+SYS_eventfd2 = 290
 SYS_epoll_create1 = 291
+SYS_dup3 = 292
+SYS_pipe2 = 293
+SYS_getrandom = 318
+
+EFD_SEMAPHORE = 0x1
+TFD_TIMER_ABSTIME = 0x1
+O_NONBLOCK_FLAG = 0o4000
 
 SOCK_STREAM = 1
 SOCK_DGRAM = 2
@@ -149,17 +163,80 @@ class Epoll:
 
 
 @dataclass
+class PipeBuf:
+    """Shared byte queue between a pipe's two ends (reference: the Rust
+    descriptor/pipe.rs over utility/byte_queue.rs)."""
+
+    data: bytearray = field(default_factory=bytearray)
+    read_closed: bool = False
+    write_closed: bool = False
+
+
+@dataclass
+class PipeEnd:
+    fd: int
+    owner: "ManagedProcess"
+    buf: PipeBuf
+    is_read: bool
+    nonblock: bool = False
+
+    def readable(self) -> bool:
+        return self.is_read and (len(self.buf.data) > 0 or self.buf.write_closed)
+
+    def writable(self) -> bool:
+        return not self.is_read  # unbounded buffer: writes never block
+
+
+@dataclass
+class EventFd:
+    """eventfd emulation (reference: descriptor/eventd.c)."""
+
+    fd: int
+    owner: "ManagedProcess"
+    value: int = 0
+    semaphore: bool = False
+    nonblock: bool = False
+
+    def readable(self) -> bool:
+        return self.value > 0
+
+    def writable(self) -> bool:
+        return self.value < (1 << 64) - 2
+
+
+@dataclass
+class TimerFd:
+    """timerfd emulation driving scheduled wake events (reference:
+    descriptor/timer.c timerfd-backed Timer objects)."""
+
+    fd: int
+    owner: "ManagedProcess"
+    nonblock: bool = False
+    expirations: int = 0
+    interval_ns: int = 0
+    next_expiry: int | None = None  # absolute sim ns; None = disarmed
+    gen: int = 0  # invalidates stale scheduled callbacks after settime
+
+    def readable(self) -> bool:
+        return self.expirations > 0
+
+    def writable(self) -> bool:
+        return False
+
+
+@dataclass
 class Parked:
     """A blocked syscall awaiting a condition (syscall_condition.c analog)."""
 
     proc: "ManagedProcess"
-    kind: str  # recv|accept|connect|sleep|poll|epoll
+    kind: str  # recv|read|accept|connect|sleep|poll|epoll
     fd: int = -1
     want: int = 0
     deadline: int | None = None  # sim ns; None = no timeout
     pollset: list = field(default_factory=list)  # [(fd, events)]
     epfd: int = -1
     maxevents: int = 0
+    hdr: bool = True  # recv: prepend the 6-byte source-address header
 
 
 class ManagedProcess:
@@ -246,6 +323,14 @@ class ManagedProcess:
         return out, err
 
 
+def _new_tracker() -> dict:
+    return {
+        "tx_packets": 0, "tx_bytes": 0,
+        "rx_packets": 0, "rx_bytes": 0,
+        "dropped_packets": 0,
+    }
+
+
 @dataclass
 class SimHost:
     """A simulated host that owns managed processes (host.c analog)."""
@@ -254,6 +339,12 @@ class SimHost:
     ip: int  # ipv4 host-order
     procs: list = field(default_factory=list)
     next_port: int = 10000  # ephemeral port allocator (deterministic)
+    # per-host byte/packet accounting (tracker.c:215-247 analog)
+    tracker: dict = field(default_factory=_new_tracker)
+    pcap_dir: str | None = None  # capture rx/tx packets when set
+    # deterministic per-host random stream (getrandom; reference: per-host
+    # nodeSeed from the controller's master RNG, random.c:15-51)
+    rand: random.Random = field(default_factory=random.Random)
 
 
 def ip_from_str(s: str) -> int:
@@ -322,6 +413,7 @@ class ProcessDriver:
         # heartbeat (manager.c:515-541 analog): period ns + callback(driver)
         self.heartbeat_interval: int | None = None
         self.heartbeat_fn: Callable[["ProcessDriver"], None] | None = None
+        self._pcaps: dict[str, object] = {}  # host name -> PcapWriter
         self.counters = {
             "syscalls": 0,
             "packets_sent": 0,
@@ -335,6 +427,7 @@ class ProcessDriver:
 
     def add_host(self, name: str, ip: str | int) -> SimHost:
         h = SimHost(name=name, ip=ip if isinstance(ip, int) else ip_from_str(ip))
+        h.rand.seed(f"{self.seed}:{name}")
         self.hosts.append(h)
         return h
 
@@ -409,35 +502,35 @@ class ProcessDriver:
     # ------------------------------------------------------------------
 
     def _poll_revents(self, proc: ManagedProcess, fd: int, events: int) -> int:
+        # POLLIN/POLLOUT/POLLERR/POLLHUP share values with their EPOLL*
+        # counterparts, so one readiness routine serves both interfaces.
         rev = 0
         obj = proc.fds.get(fd)
         if obj is None:
             return POLLERR if fd >= ipc.FD_BASE else 0
-        if isinstance(obj, Sock):
+        if hasattr(obj, "readable"):
             if (events & POLLIN) and obj.readable():
                 rev |= POLLIN
             if (events & POLLOUT) and obj.writable():
                 rev |= POLLOUT
+        if isinstance(obj, Sock):
             if obj.conn_refused:
                 rev |= POLLERR  # reported regardless of requested events
             if obj.conn is not None and obj.conn.rx_eof and not obj.conn.rx:
                 rev |= POLLHUP if (events & (POLLIN | POLLHUP)) else 0
+        elif isinstance(obj, PipeEnd):
+            if obj.is_read and obj.buf.write_closed and not obj.buf.data:
+                rev |= POLLHUP
+            if not obj.is_read and obj.buf.read_closed:
+                rev |= POLLERR
         return rev
 
     def _epoll_ready(self, proc: ManagedProcess, ep: Epoll) -> list[tuple[int, int]]:
         out = []
         for fd, (events, data) in sorted(ep.interest.items()):
-            rev = 0
-            obj = proc.fds.get(fd)
-            if isinstance(obj, Sock):
-                if (events & EPOLLIN) and obj.readable():
-                    rev |= EPOLLIN
-                if (events & EPOLLOUT) and obj.writable():
-                    rev |= EPOLLOUT
-                if obj.conn_refused:
-                    rev |= EPOLLERR  # reported regardless of interest
-                if obj.conn is not None and obj.conn.rx_eof and not obj.conn.rx:
-                    rev |= EPOLLHUP & events | (EPOLLIN & events)
+            if fd not in proc.fds:
+                continue  # closed fds silently leave the interest set
+            rev = self._poll_revents(proc, fd, events)
             if rev:
                 out.append((rev, data))
         return out
@@ -452,7 +545,12 @@ class ProcessDriver:
             sock = proc.fds.get(pk.fd)
             if isinstance(sock, Sock) and sock.readable():
                 proc.parked = None
-                self._complete_recv(proc, sock, pk.want)
+                self._complete_recv(proc, sock, pk.want, hdr=pk.hdr)
+        elif pk.kind == "read":
+            obj = proc.fds.get(pk.fd)
+            if obj is not None and hasattr(obj, "readable") and obj.readable():
+                proc.parked = None
+                self._complete_read(proc, obj, pk.want)
         elif pk.kind == "accept":
             sock = proc.fds.get(pk.fd)
             if isinstance(sock, Sock) and sock.accept_q:
@@ -510,6 +608,57 @@ class ProcessDriver:
         # processes can't hold this fd (no fd passing in v1)
 
     # ------------------------------------------------------------------
+    # per-host tracking + pcap (tracker.c / pcap_writer.c analogs)
+    # ------------------------------------------------------------------
+
+    def _pcap_writer(self, host: SimHost):
+        if host.pcap_dir is None:
+            return None
+        w = self._pcaps.get(host.name)
+        if w is None:
+            from shadow_tpu.utils.pcap import PcapWriter
+
+            os.makedirs(host.pcap_dir, exist_ok=True)
+            w = PcapWriter(os.path.join(host.pcap_dir, f"{host.name}.pcap"))
+            self._pcaps[host.name] = w
+        return w
+
+    def _track_tx(self, host: SimHost, proto: str, src_addr, dst_addr,
+                  payload: bytes, dropped: bool) -> None:
+        t = host.tracker
+        if dropped:
+            t["dropped_packets"] += 1
+        else:
+            t["tx_packets"] += 1
+            t["tx_bytes"] += len(payload)
+        w = self._pcap_writer(host)
+        if w is not None and not dropped:
+            w.write_packet(
+                self.now, proto=proto,
+                src_ip=src_addr[0], src_port=src_addr[1],
+                dst_ip=dst_addr[0], dst_port=dst_addr[1], payload=payload,
+            )
+
+    def _track_rx(self, dst_ip: int, proto: str, src_addr, dst_addr,
+                  payload: bytes) -> None:
+        host = self._host_by_ip(dst_ip)
+        if host is None:
+            return
+        t = host.tracker
+        t["rx_packets"] += 1
+        t["rx_bytes"] += len(payload)
+        w = self._pcap_writer(host)
+        if w is not None:
+            w.write_packet(
+                self.now, proto=proto,
+                src_ip=src_addr[0], src_port=src_addr[1],
+                dst_ip=dst_addr[0], dst_port=dst_addr[1], payload=payload,
+            )
+
+    def host_trackers(self) -> dict[str, dict]:
+        return {h.name: dict(h.tracker) for h in self.hosts}
+
+    # ------------------------------------------------------------------
     # network delivery (stage-A model)
     # ------------------------------------------------------------------
 
@@ -519,6 +668,7 @@ class ProcessDriver:
             return  # no listener: datagram vanishes (no ICMP in v1)
         if sock.peer is not None and sock.peer != src_addr:
             return
+        self._track_rx(dst_addr[0], "udp", src_addr, dst_addr, payload)
         sock.dgrams.append((src_addr[0], src_addr[1], payload))
         self._wake_sock_waiters(sock)
 
@@ -574,6 +724,11 @@ class ProcessDriver:
         self._wake_sock_waiters(sock)
 
     def _deliver_stream(self, conn: Conn, payload: bytes) -> None:
+        if conn.local_addr is not None:
+            self._track_rx(
+                conn.local_addr[0], "tcp",
+                conn.remote_addr or (0, 0), conn.local_addr, payload,
+            )
         conn.rx += payload
         if conn.sock is not None:
             self._wake_sock_waiters(conn.sock)
@@ -719,8 +874,35 @@ class ProcessDriver:
             if obj is None:
                 done(-errno.EBADF)
                 return
-            self._close_obj(obj)
+            # dup aliases: only tear the object down when the LAST fd
+            # referencing it closes
+            if not any(o is obj for o in proc.fds.values()):
+                self._close_obj(obj)
             done(0)
+        elif sysno in (SYS_dup, SYS_dup2, SYS_dup3):
+            obj = proc.fds.get(a[0])
+            if obj is None:
+                done(-errno.EBADF)
+                return
+            if sysno == SYS_dup:
+                newfd = proc.alloc_fd()
+            else:
+                newfd = a[1]
+                if newfd == a[0]:
+                    done(newfd if sysno == SYS_dup2 else -errno.EINVAL)
+                    return
+                if newfd < ipc.FD_BASE:
+                    # aliasing into native fd space would escape the shim's
+                    # managed-fd routing; refuse loudly rather than misroute
+                    done(-errno.EINVAL)
+                    return
+                old = proc.fds.pop(newfd, None)
+                if old is not None and not any(
+                    o is old for o in proc.fds.values()
+                ):
+                    self._close_obj(old)
+            proc.fds[newfd] = obj
+            done(newfd)
         elif sysno == SYS_shutdown:
             sock = proc.fds.get(a[0])
             if isinstance(sock, Sock) and sock.conn is not None:
@@ -864,6 +1046,123 @@ class ProcessDriver:
                     else self.now + timeout_ms * 1_000_000
                 )
                 park(Parked(proc, "poll", pollset=pollset, deadline=deadline))
+        # ---- generic fd read/write (pipes, eventfds, timerfds, sockets) ----
+        elif sysno == SYS_read:
+            obj = proc.fds.get(a[0])
+            want = a[1]
+            if obj is None:
+                done(-errno.EBADF)
+            elif isinstance(obj, Sock):
+                if obj.proto == SOCK_STREAM and (obj.listening or obj.conn is None):
+                    done(-errno.ENOTCONN)
+                elif obj.readable():
+                    self._complete_recv(proc, obj, want, hdr=False)
+                elif obj.nonblock:
+                    done(-errno.EAGAIN)
+                else:
+                    park(Parked(proc, "recv", fd=a[0], want=want, hdr=False))
+            elif isinstance(obj, PipeEnd) and not obj.is_read:
+                done(-errno.EBADF)
+            elif isinstance(obj, (EventFd, TimerFd)) and want < 8:
+                done(-errno.EINVAL)  # Linux: 8-byte counter reads only
+            elif hasattr(obj, "readable"):
+                if obj.readable():
+                    self._complete_read(proc, obj, want)
+                elif obj.nonblock:
+                    done(-errno.EAGAIN)
+                else:
+                    park(Parked(proc, "read", fd=a[0], want=want))
+            else:
+                done(-errno.EBADF)
+        elif sysno == SYS_write:
+            obj = proc.fds.get(a[0])
+            data = ch.data[: a[1]]
+            if obj is None:
+                done(-errno.EBADF)
+            elif isinstance(obj, Sock):
+                self._handle_sendto(proc, [a[0], a[1], 0, 0, 0, 0], data)
+            elif isinstance(obj, PipeEnd):
+                if obj.is_read:
+                    done(-errno.EBADF)
+                elif obj.buf.read_closed:
+                    done(-errno.EPIPE)
+                else:
+                    obj.buf.data += data
+                    done(len(data))
+                    self._try_wake(proc)  # same-process reader may be parked
+            elif isinstance(obj, EventFd):
+                if len(data) < 8:
+                    done(-errno.EINVAL)
+                else:
+                    add = int.from_bytes(data[:8], "little")
+                    if add == (1 << 64) - 1:
+                        done(-errno.EINVAL)  # Linux: 0xffffffffffffffff
+                    elif obj.value + add > (1 << 64) - 2:
+                        # counter would overflow; Linux blocks — we report
+                        # EAGAIN (blocking eventfd writes are not supported)
+                        done(-errno.EAGAIN)
+                    else:
+                        obj.value += add
+                        done(8)
+                        self._try_wake(proc)
+            else:
+                done(-errno.EBADF)
+        # ---- pipes / eventfd / timerfd / randomness ----
+        elif sysno == SYS_pipe2:
+            nb = bool(a[0] & O_NONBLOCK_FLAG)
+            buf = PipeBuf()
+            rfd = proc.alloc_fd()
+            wfd = proc.alloc_fd()
+            proc.fds[rfd] = PipeEnd(rfd, proc, buf, is_read=True, nonblock=nb)
+            proc.fds[wfd] = PipeEnd(wfd, proc, buf, is_read=False, nonblock=nb)
+            done(0, data=rfd.to_bytes(4, "little") + wfd.to_bytes(4, "little"))
+        elif sysno == SYS_eventfd2:
+            fd = proc.alloc_fd()
+            proc.fds[fd] = EventFd(
+                fd, proc, value=a[0],
+                semaphore=bool(a[1] & EFD_SEMAPHORE),
+                nonblock=bool(a[1] & O_NONBLOCK_FLAG),
+            )
+            done(fd)
+        elif sysno == SYS_timerfd_create:
+            fd = proc.alloc_fd()
+            proc.fds[fd] = TimerFd(
+                fd, proc, nonblock=bool(a[1] & O_NONBLOCK_FLAG)
+            )
+            done(fd)
+        elif sysno == SYS_timerfd_settime:
+            tf = proc.fds.get(a[0])
+            if not isinstance(tf, TimerFd):
+                done(-errno.EBADF)
+                return
+            raw = ch.data
+            value_ns = int.from_bytes(raw[0:8], "little", signed=True)
+            interval_ns = int.from_bytes(raw[8:16], "little", signed=True)
+            old = self._timerfd_remaining(tf)
+            tf.gen += 1
+            tf.expirations = 0
+            if value_ns == 0:
+                tf.next_expiry = None
+                tf.interval_ns = 0
+            else:
+                expiry = (
+                    value_ns if (a[1] & TFD_TIMER_ABSTIME)
+                    else self.now + value_ns
+                )
+                tf.next_expiry = expiry
+                tf.interval_ns = interval_ns
+                gen = tf.gen
+                self._schedule(expiry, lambda: self._timer_fire(proc, tf, gen))
+            done(0, data=old)
+        elif sysno == SYS_timerfd_gettime:
+            tf = proc.fds.get(a[0])
+            if not isinstance(tf, TimerFd):
+                done(-errno.EBADF)
+                return
+            done(0, data=self._timerfd_remaining(tf))
+        elif sysno == SYS_getrandom:
+            n = min(a[0], ipc.IPC_DATA_MAX)
+            done(n, data=proc.host.rand.randbytes(n))
         # ---- pseudo-syscalls ----
         elif sysno == ipc.PSYS_RESOLVE_NAME:
             name = ch.data.decode("utf-8", "replace")
@@ -899,7 +1198,11 @@ class ProcessDriver:
             src = sock.bound
             self.counters["packets_sent"] += 1
             self.counters["bytes_sent"] += len(payload)
-            if self._drop_roll(proc.host.ip, dst[0], control=len(payload) == 0):
+            dropped = self._drop_roll(
+                proc.host.ip, dst[0], control=len(payload) == 0
+            )
+            self._track_tx(proc.host, "udp", src, dst, payload, dropped)
+            if dropped:
                 self.counters["packets_dropped"] += 1
             else:
                 lat = self._latency(proc.host.ip, dst[0])
@@ -917,6 +1220,10 @@ class ProcessDriver:
             remote = conn.remote
             self.counters["packets_sent"] += 1
             self.counters["bytes_sent"] += len(payload)
+            self._track_tx(
+                proc.host, "tcp", conn.local_addr or (proc.host.ip, 0),
+                conn.remote_addr or (0, 0), payload, dropped=False,
+            )
             if remote is not None:
                 lat = self._latency(proc.host.ip, conn.remote_addr[0])
                 data = bytes(payload)
@@ -926,24 +1233,46 @@ class ProcessDriver:
                 )
             ch.reply(len(payload), sim_time_ns=self.now)
 
-    def _complete_recv(self, proc: ManagedProcess, sock: Sock, want: int) -> None:
-        # The reply carries a 6-byte source-address header before the payload;
-        # cap so header+payload always fits the IPC data area (the shim asks
-        # for up to IPC_DATA_MAX bytes).
-        want = min(want, ipc.IPC_DATA_MAX - 6)
+    def _complete_recv(self, proc: ManagedProcess, sock: Sock, want: int,
+                       hdr: bool = True) -> None:
+        # recvfrom replies carry a 6-byte source-address header before the
+        # payload (read() replies don't); cap so header+payload always fits
+        # the IPC data area (the shim asks for up to IPC_DATA_MAX bytes).
+        hn = 6 if hdr else 0
+        want = min(want, ipc.IPC_DATA_MAX - hn)
         if sock.proto == SOCK_DGRAM:
             src_ip, src_port, data = sock.dgrams.popleft()
             data = data[:want]
-            hdr = src_ip.to_bytes(4, "little") + src_port.to_bytes(2, "little")
-            self._resume(proc, len(data), data=hdr + data)
+            addr = src_ip.to_bytes(4, "little") + src_port.to_bytes(2, "little")
+            self._resume(proc, len(data), data=(addr if hdr else b"") + data)
         else:
             conn = sock.conn
             take = min(want, len(conn.rx))
             data = bytes(conn.rx[:take])
             del conn.rx[:take]
             ra = conn.remote_addr or (0, 0)
-            hdr = ra[0].to_bytes(4, "little") + ra[1].to_bytes(2, "little")
-            self._resume(proc, take, data=hdr + data)
+            addr = ra[0].to_bytes(4, "little") + ra[1].to_bytes(2, "little")
+            self._resume(proc, take, data=(addr if hdr else b"") + data)
+
+    def _complete_read(self, proc: ManagedProcess, obj, want: int) -> None:
+        """Finish a read() on a non-socket readable object (pipe/eventfd/
+        timerfd); caller guarantees obj.readable()."""
+        if isinstance(obj, PipeEnd):
+            want = min(want, ipc.IPC_DATA_MAX)
+            take = min(want, len(obj.buf.data))
+            data = bytes(obj.buf.data[:take])
+            del obj.buf.data[:take]
+            self._resume(proc, take, data=data)  # 0 == EOF (write end closed)
+        elif isinstance(obj, EventFd):
+            val = 1 if obj.semaphore else obj.value
+            obj.value -= val
+            self._resume(proc, 8, data=val.to_bytes(8, "little"))
+        elif isinstance(obj, TimerFd):
+            n = obj.expirations
+            obj.expirations = 0
+            self._resume(proc, 8, data=n.to_bytes(8, "little"))
+        else:
+            self._resume(proc, -errno.EBADF)
 
     def _complete_accept(self, proc: ManagedProcess, listener: Sock,
                          nonblock: bool = False) -> None:
@@ -968,6 +1297,28 @@ class ProcessDriver:
         )
         self._schedule(self.now + lat, lambda: self._deliver_eof(remote))
 
+    def _timerfd_remaining(self, tf: TimerFd) -> bytes:
+        """Pack (remaining_ns, interval_ns) as the gettime/settime-old reply."""
+        rem = 0 if tf.next_expiry is None else max(0, tf.next_expiry - self.now)
+        return rem.to_bytes(8, "little") + tf.interval_ns.to_bytes(8, "little")
+
+    def _timer_fire(self, proc: ManagedProcess, tf: TimerFd, gen: int) -> None:
+        if tf.gen != gen or tf.next_expiry is None:
+            return  # re-armed or disarmed since this was scheduled
+        if proc.fds.get(tf.fd) is not tf and not any(
+            o is tf for o in proc.fds.values()
+        ):
+            return  # closed
+        tf.expirations += 1
+        if tf.interval_ns > 0:
+            tf.next_expiry += tf.interval_ns
+            self._schedule(
+                tf.next_expiry, lambda: self._timer_fire(proc, tf, gen)
+            )
+        else:
+            tf.next_expiry = None
+        self._try_wake(proc)
+
     def _close_obj(self, obj) -> None:
         if isinstance(obj, Sock):
             if obj.bound is not None:
@@ -979,6 +1330,15 @@ class ProcessDriver:
                     del binds[obj.bound]
             if obj.conn is not None:
                 self._send_eof(obj.owner, obj)
+        elif isinstance(obj, PipeEnd):
+            if obj.is_read:
+                obj.buf.read_closed = True
+            else:
+                obj.buf.write_closed = True
+                self._try_wake(obj.owner)  # reader sees EOF
+        elif isinstance(obj, TimerFd):
+            obj.gen += 1  # cancel any scheduled fire
+            obj.next_expiry = None
 
     # ------------------------------------------------------------------
     # the service loop (manager_run / scheduler round analog)
@@ -1015,6 +1375,10 @@ class ProcessDriver:
     def _spawn(self, proc: ManagedProcess) -> None:
         if not proc.alive():
             return  # already stopped (e.g. stop event preceded the spawn)
+        log.logger.debug(
+            "starting process %s: %s", proc.name, " ".join(proc.args),
+            host=proc.host.name,
+        )
         proc.spawn(spin=self.spin)
 
     def _stop_process(self, p: ManagedProcess) -> None:
@@ -1049,6 +1413,16 @@ class ProcessDriver:
 
     def run(self) -> None:
         """Run the simulation until stop_time or all processes exit."""
+        # Point the global logger's sim clock at this driver for the run
+        # (restored after, so stacked/sequential drivers don't leak).
+        prev_now_fn = log.logger.sim_now_fn
+        log.logger.sim_now_fn = lambda: self.now
+        try:
+            self._run()
+        finally:
+            log.logger.sim_now_fn = prev_now_fn
+
+    def _run(self) -> None:
         for p in self.procs:
             self._schedule(p.start_time, lambda p=p: self._spawn(p))
             if p.stop_time is not None:
@@ -1099,3 +1473,9 @@ class ProcessDriver:
                 p.stdout, p.stderr = p.finish()
             elif not hasattr(p, "stdout"):
                 p.stdout, p.stderr = b"", b""
+            log.logger.debug(
+                "process %s exited with %s", p.name, p.exit_code,
+                host=p.host.name,
+            )
+        for w in self._pcaps.values():
+            w.close()

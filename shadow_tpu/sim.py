@@ -189,6 +189,8 @@ def build_simulation(source) -> Simulation:
         runahead=runahead,
         event_capacity=cfg.experimental.event_capacity,
         K=cfg.experimental.events_per_host_per_window,
+        B=cfg.experimental.inbox_slots,
+        O=cfg.experimental.outbox_slots,
         subs=subs,
         initial_events=initial_events,
     )

@@ -1,0 +1,1 @@
+"""Support utilities: logging, tracking, pcap capture."""

@@ -1,0 +1,110 @@
+"""Sim-time-stamped structured logging.
+
+Reference: the C macro API panic/error/warning/info/debug/trace
+(src/lib/logger/logger.h:24-33) backed by the Rust ShadowLogger whose records
+carry wall time, sim time, and host/process context from the thread-local
+Worker (src/main/core/logger/shadow_logger.rs:109,184; worker.rs:40-50).
+Log line shape follows docs/log_format.md:
+
+    00:00:10.000001 [worker] 00:00:05.000000 [info] [hostname] message
+
+Here there is one process and one logger; "context" is set around handler
+execution (host name, process name) rather than read from a thread-local.
+"""
+
+from __future__ import annotations
+
+import sys
+import time as wall_time
+from typing import IO
+
+TRACE = 10
+DEBUG = 20
+INFO = 30
+WARNING = 40
+ERROR = 50
+PANIC = 60
+
+_LEVELS = {
+    "trace": TRACE,
+    "debug": DEBUG,
+    "info": INFO,
+    "warning": WARNING,
+    "error": ERROR,
+    "panic": PANIC,
+}
+_NAMES = {v: k for k, v in _LEVELS.items()}
+
+
+def parse_level(name: str) -> int:
+    try:
+        return _LEVELS[name.lower()]
+    except KeyError:
+        raise ValueError(
+            f"unknown log level {name!r} (expected one of {sorted(_LEVELS)})"
+        ) from None
+
+
+def _fmt_time(ns: int) -> str:
+    """ns → HH:MM:SS.micros (log_format.md sim-time shape)."""
+    us, _ = divmod(int(ns), 1_000)
+    s, us = divmod(us, 1_000_000)
+    m, s = divmod(s, 60)
+    h, m = divmod(m, 60)
+    return f"{h:02d}:{m:02d}:{s:02d}.{us:06d}"
+
+
+class SimLogger:
+    """Level-filtered logger stamping wall time, sim time, and host context."""
+
+    def __init__(self, stream: IO[str] | None = None, level: int = INFO):
+        self.stream = stream if stream is not None else sys.stderr
+        self.level = level
+        self._t0 = wall_time.monotonic()
+        # current context, set by the driver around handler execution
+        self.sim_now_fn = lambda: 0  # returns current sim ns
+        self.host: str | None = None
+
+    def set_level(self, level: int | str) -> None:
+        self.level = parse_level(level) if isinstance(level, str) else level
+
+    def log(self, level: int, msg: str, *args, host: str | None = None) -> None:
+        if level < self.level:
+            return
+        if args:
+            msg = msg % args
+        wall = wall_time.monotonic() - self._t0
+        sim = self.sim_now_fn()
+        ctx = host or self.host
+        parts = [
+            _fmt_time(int(wall * 1e9)),
+            _fmt_time(sim),
+            f"[{_NAMES.get(level, level)}]",
+        ]
+        if ctx:
+            parts.append(f"[{ctx}]")
+        parts.append(msg)
+        print(" ".join(parts), file=self.stream, flush=level >= WARNING)
+
+    def trace(self, msg, *a, **kw):
+        self.log(TRACE, msg, *a, **kw)
+
+    def debug(self, msg, *a, **kw):
+        self.log(DEBUG, msg, *a, **kw)
+
+    def info(self, msg, *a, **kw):
+        self.log(INFO, msg, *a, **kw)
+
+    def warning(self, msg, *a, **kw):
+        self.log(WARNING, msg, *a, **kw)
+
+    def error(self, msg, *a, **kw):
+        self.log(ERROR, msg, *a, **kw)
+
+    def panic(self, msg, *a, **kw):
+        self.log(PANIC, msg, *a, **kw)
+        raise RuntimeError(msg % a if a else msg)
+
+
+# module-level default logger (the reference's single global logger)
+logger = SimLogger()

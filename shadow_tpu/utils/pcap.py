@@ -1,0 +1,88 @@
+"""Wireshark-readable pcap capture of simulated traffic.
+
+Reference: src/main/utility/pcap_writer.c — each host's NIC writes every
+rx/tx packet when `pcap_directory` is configured
+(network_interface.c:438-440). Simulated packets have no real wire bytes, so
+like the reference we synthesize minimal IPv4 + UDP/TCP headers around the
+payload (the reference stores header fields and emits them the same way).
+"""
+
+from __future__ import annotations
+
+import struct
+
+LINKTYPE_RAW = 101  # packets start with the IPv4 header
+
+_PROTO_UDP = 17
+_PROTO_TCP = 6
+
+
+class PcapWriter:
+    """One capture file (classic pcap format, microsecond timestamps)."""
+
+    def __init__(self, path: str):
+        self._f = open(path, "wb")
+        # magic, v2.4, thiszone=0, sigfigs=0, snaplen, linktype
+        self._f.write(
+            struct.pack("<IHHiIII", 0xA1B2C3D4, 2, 4, 0, 0, 65535, LINKTYPE_RAW)
+        )
+
+    def _record(self, time_ns: int, data: bytes) -> None:
+        sec, ns = divmod(int(time_ns), 1_000_000_000)
+        self._f.write(
+            struct.pack("<IIII", sec, ns // 1000, len(data), len(data))
+        )
+        self._f.write(data)
+
+    def write_packet(
+        self,
+        time_ns: int,
+        *,
+        proto: str,  # "udp" | "tcp"
+        src_ip: int,
+        src_port: int,
+        dst_ip: int,
+        dst_port: int,
+        payload: bytes = b"",
+        payload_len: int | None = None,
+        seq: int = 0,
+        ack: int = 0,
+        flags: int = 0x10,  # TCP flags byte; default ACK
+        window: int = 65535,
+    ) -> None:
+        """Write one packet with synthesized IPv4+L4 headers.
+
+        ``payload_len`` supports device-plane packets where only the length
+        is known: that many zero bytes stand in for the app data.
+        """
+        if payload_len is not None and not payload:
+            payload = bytes(min(payload_len, 65000))
+        if proto == "udp":
+            l4 = struct.pack(
+                ">HHHH", src_port, dst_port, 8 + len(payload), 0
+            ) + payload
+            pnum = _PROTO_UDP
+        else:
+            l4 = struct.pack(
+                ">HHIIBBHHH",
+                src_port, dst_port, seq & 0xFFFFFFFF, ack & 0xFFFFFFFF,
+                5 << 4, flags & 0xFF, window & 0xFFFF, 0, 0,
+            ) + payload
+            pnum = _PROTO_TCP
+        total = 20 + len(l4)
+        ip = struct.pack(
+            ">BBHHHBBHII",
+            0x45, 0, total, 0, 0, 64, pnum, 0,
+            src_ip & 0xFFFFFFFF, dst_ip & 0xFFFFFFFF,
+        )
+        self._record(time_ns, ip + l4)
+
+    def close(self) -> None:
+        if not self._f.closed:
+            self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,89 @@
+/* Exercises the non-socket descriptor kit under the simulator: pipes,
+ * eventfd, timerfd, dup, getrandom, readv/writev. Prints a deterministic
+ * transcript; exits nonzero on any misbehavior. */
+#include <poll.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <string.h>
+#include <sys/epoll.h>
+#include <sys/eventfd.h>
+#include <sys/random.h>
+#include <sys/timerfd.h>
+#include <sys/uio.h>
+#include <time.h>
+#include <unistd.h>
+
+static long long now_ns(void) {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (long long)ts.tv_sec * 1000000000LL + ts.tv_nsec;
+}
+
+int main(void) {
+  /* ---- pipe + poll + dup ---- */
+  int p[2];
+  if (pipe(p)) { perror("pipe"); return 1; }
+  struct pollfd pf = {p[0], POLLIN, 0};
+  if (poll(&pf, 1, 0) != 0) { fprintf(stderr, "pipe early ready\n"); return 1; }
+  struct iovec iov[2] = {{(void*)"hel", 3}, {(void*)"lo", 2}};
+  if (writev(p[1], iov, 2) != 5) { perror("writev"); return 1; }
+  if (poll(&pf, 1, 1000) != 1 || !(pf.revents & POLLIN)) {
+    fprintf(stderr, "pipe not readable\n");
+    return 1;
+  }
+  int pdup = dup(p[0]);
+  char b0[3], b1[4];
+  struct iovec riov[2] = {{b0, 3}, {b1, 2}};
+  if (readv(pdup, riov, 2) != 5 || memcmp(b0, "hel", 3) || memcmp(b1, "lo", 2)) {
+    fprintf(stderr, "readv mismatch\n");
+    return 1;
+  }
+  close(pdup);
+  if (write(p[1], "x", 1) != 1) { perror("pipe write after dup close"); return 1; }
+  char c;
+  if (read(p[0], &c, 1) != 1 || c != 'x') { fprintf(stderr, "bad pipe byte\n"); return 1; }
+  close(p[1]);
+  if (read(p[0], &c, 1) != 0) { fprintf(stderr, "no EOF after close\n"); return 1; }
+  close(p[0]);
+  printf("pipe ok\n");
+
+  /* ---- eventfd ---- */
+  int ev = eventfd(2, 0);
+  uint64_t v = 0;
+  if (read(ev, &v, 8) != 8 || v != 2) { fprintf(stderr, "eventfd v=%llu\n", (unsigned long long)v); return 1; }
+  v = 5;
+  if (write(ev, &v, 8) != 8) { perror("eventfd write"); return 1; }
+  v = 3;
+  if (write(ev, &v, 8) != 8) { perror("eventfd write2"); return 1; }
+  if (read(ev, &v, 8) != 8 || v != 8) { fprintf(stderr, "eventfd sum=%llu\n", (unsigned long long)v); return 1; }
+  close(ev);
+  printf("eventfd ok\n");
+
+  /* ---- timerfd: 3 ticks of exactly 50 ms on the virtual clock ---- */
+  int tf = timerfd_create(CLOCK_MONOTONIC, 0);
+  struct itimerspec its = {{0, 50000000}, {0, 50000000}};
+  if (timerfd_settime(tf, 0, &its, NULL)) { perror("settime"); return 1; }
+  int ep = epoll_create1(0);
+  struct epoll_event e = {EPOLLIN, {.fd = tf}};
+  epoll_ctl(ep, EPOLL_CTL_ADD, tf, &e);
+  long long t_prev = now_ns();
+  for (int i = 0; i < 3; i++) {
+    struct epoll_event out;
+    if (epoll_wait(ep, &out, 1, 2000) != 1) { fprintf(stderr, "timer wait\n"); return 1; }
+    uint64_t ticks;
+    if (read(tf, &ticks, 8) != 8 || ticks != 1) { fprintf(stderr, "ticks=%llu\n", (unsigned long long)ticks); return 1; }
+    long long t = now_ns();
+    printf("tick %d dt %lld ns\n", i, t - t_prev);
+    t_prev = t;
+  }
+  close(tf);
+  close(ep);
+
+  /* ---- getrandom: deterministic under the simulator ---- */
+  unsigned char rnd[8];
+  if (getrandom(rnd, 8, 0) != 8) { perror("getrandom"); return 1; }
+  printf("rand ");
+  for (int i = 0; i < 8; i++) printf("%02x", rnd[i]);
+  printf("\nfd kit done\n");
+  return 0;
+}

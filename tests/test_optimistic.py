@@ -1,0 +1,133 @@
+"""Optimistic window synchronization: speculative long windows + rollback
+must produce results equivalent to the conservative schedule (SURVEY §7.6;
+BASELINE staged config 4 calls for optimistic PDES windows)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from shadow_tpu.core import simtime
+from shadow_tpu.core.engine import Simulation
+from shadow_tpu.core.state import KIND_APP_TIMER, NetParams
+from shadow_tpu.sim import build_simulation
+
+MS = simtime.NS_PER_MS
+
+# Two-vertex graph with asymmetric latencies: the runahead is the 10ms
+# edge, so 50ms-path deliveries land mid-window during speculation and
+# force rollbacks.
+MIXED_YAML = """
+general:
+  stop_time: 2
+  seed: 9
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        node [ id 0 bandwidth_down "100 Mbit" bandwidth_up "100 Mbit" ]
+        node [ id 1 bandwidth_down "100 Mbit" bandwidth_up "100 Mbit" ]
+        edge [ source 0 target 0 latency "10 ms" packet_loss 0.0 ]
+        edge [ source 0 target 1 latency "50 ms" packet_loss 0.0 ]
+        edge [ source 1 target 1 latency "10 ms" packet_loss 0.0 ]
+      ]
+experimental:
+  event_capacity: 4096
+  events_per_host_per_window: 16
+hosts:
+  near:
+    quantity: 6
+    network_node_id: 0
+    app_model: phold
+    app_options: {msgload: 2, runtime: 1}
+  far:
+    quantity: 2
+    network_node_id: 1
+    app_model: phold
+    app_options: {msgload: 2, runtime: 1}
+"""
+
+
+def _final_fingerprint(sim):
+    c = sim.counters()
+    c.pop("pool_overflow_dropped", None)
+    subs = jax.device_get(sim.state.subs)
+    return c, jax.tree.map(lambda x: np.asarray(x), subs)
+
+
+def _assert_equivalent(a, b):
+    ca, sa = _final_fingerprint(a)
+    cb, sb = _final_fingerprint(b)
+    assert ca == cb
+    for key in sa:
+        for leaf_a, leaf_b in zip(
+            jax.tree.leaves(sa[key]), jax.tree.leaves(sb[key])
+        ):
+            assert np.array_equal(leaf_a, leaf_b), key
+
+
+def test_mixed_latency_rollback_and_equivalence():
+    """Asymmetric path latencies force speculation violations; after
+    rollbacks the results still match the conservative schedule."""
+    cons = build_simulation(MIXED_YAML)
+    assert cons.runahead == 10 * MS
+    cons.run_stepwise()
+
+    opt = build_simulation(MIXED_YAML)
+    windows, rollbacks = opt.run_optimistic(window_factor=8)
+    assert rollbacks > 0  # speculation actually violated and rolled back
+    _assert_equivalent(cons, opt)
+
+
+def test_uniform_latency_no_rollbacks():
+    """With one uniform latency every delivery lands exactly one sub-step
+    ahead of its destination's progress clock: speculation always holds."""
+    yaml = MIXED_YAML.replace('latency "50 ms"', 'latency "10 ms"')
+    cons = build_simulation(yaml)
+    cons.run_stepwise()
+
+    opt = build_simulation(yaml)
+    _, rollbacks = opt.run_optimistic(window_factor=8)
+    assert rollbacks == 0
+    _assert_equivalent(cons, opt)
+
+
+def _noop_sim():
+    """8 hosts, no-op timer handler, 200 pre-scheduled events spread over
+    200 runaheads — the schedule shape where speculation pays: one long
+    window absorbs work that costs conservative one barrier per runahead."""
+    H = 8
+    initial = []
+    for i in range(200):
+        t = (i + 1) * MS
+        initial.append((t, i % H, (i + 3) % H, KIND_APP_TIMER, [0]))
+    params = NetParams(
+        latency_vv=jnp.full((1, 1), MS, dtype=jnp.int64),
+        reliability_vv=jnp.ones((1, 1), jnp.float32),
+        bootstrap_end=jnp.int64(0),
+    )
+    return Simulation(
+        num_hosts=H,
+        handlers={KIND_APP_TIMER: lambda state, ev, em, p: state},
+        params=params,
+        host_vertex=np.zeros(H, np.int32),
+        seed=1,
+        stop_time=300 * MS,
+        runahead=MS,
+        event_capacity=512,
+        K=32,
+        initial_events=initial,
+    )
+
+
+def test_prescheduled_work_commits_long_windows():
+    cons = _noop_sim()
+    cons_windows = cons.run_stepwise()
+    assert cons_windows >= 200  # one barrier per 1ms runahead
+
+    opt = _noop_sim()
+    opt_windows, rollbacks = opt.run_optimistic(window_factor=64)
+    assert rollbacks == 0
+    assert opt_windows <= cons_windows / 8
+    assert cons.counters()["events_committed"] == 200
+    assert opt.counters()["events_committed"] == 200

@@ -1,0 +1,88 @@
+"""Multi-device sharding: the window step runs sharded over an 8-device CPU
+mesh (the driver's dryrun_multichip contract) — host-dimension data
+parallelism, GSPMD-inserted collectives (SURVEY.md §2.5 P1/P2).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from shadow_tpu.core import simtime
+from shadow_tpu.flagship import build_phold_flagship
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs 8 virtual devices (conftest sets this up)")
+    return Mesh(np.array(devs[:8]), ("hosts",))
+
+
+def _shard_sim_state(sim, mesh):
+    shard = NamedSharding(mesh, P("hosts"))
+    shard2 = NamedSharding(mesh, P("hosts", None))
+    repl = NamedSharding(mesh, P())
+    put = jax.device_put
+    state = sim.state
+    pool = state.pool.replace(
+        time=put(state.pool.time, shard),
+        dst=put(state.pool.dst, shard),
+        src=put(state.pool.src, shard),
+        seq=put(state.pool.seq, shard),
+        kind=put(state.pool.kind, shard),
+        payload=put(state.pool.payload, shard2),
+    )
+    host = jax.tree.map(lambda x: put(x, shard), state.host)
+    subs = jax.tree.map(lambda x: put(x, shard), state.subs)
+    return state.replace(
+        pool=pool,
+        host=host,
+        rng_keys=put(state.rng_keys, shard2),
+        subs=subs,
+        now=put(state.now, repl),
+        counters=jax.tree.map(lambda x: put(x, repl), state.counters),
+    )
+
+
+def test_sharded_step_matches_single_device(mesh):
+    """One window stepped sharded over 8 devices produces the same counters
+    and pool as the unsharded step (GSPMD must not change semantics)."""
+    H, C, K = 64, 1024, 8
+    sim = build_phold_flagship(H, msgload=2, stop_s=10, runtime_s=8,
+                               event_capacity=C, K=K)
+    ws = simtime.NS_PER_SEC
+    we = ws + sim.runahead
+
+    ref_state, ref_min = sim._step(sim.state, sim.params, ws, we)
+    jax.block_until_ready(ref_min)
+
+    state = _shard_sim_state(sim, mesh)
+    params = jax.tree.map(
+        lambda x: jax.device_put(x, NamedSharding(mesh, P())), sim.params
+    )
+    with mesh:
+        out_state, out_min = sim._step(
+            state, params, jnp.int64(ws), jnp.int64(we)
+        )
+        jax.block_until_ready(out_min)
+
+    assert int(out_min) == int(ref_min)
+    ref_c = jax.device_get(ref_state.counters)
+    out_c = jax.device_get(out_state.counters)
+    assert ref_c == out_c
+    # event pools match as multisets (sort order may differ only in free
+    # slots, which all carry NEVER)
+    for field in ("time", "dst", "src", "seq", "kind"):
+        a = np.sort(np.asarray(jax.device_get(getattr(ref_state.pool, field))))
+        b = np.sort(np.asarray(jax.device_get(getattr(out_state.pool, field))))
+        assert np.array_equal(a, b), field
+
+
+def test_graft_dryrun_entrypoint_runs(mesh):
+    """The driver's dryrun contract stays green from inside the suite."""
+    import __graft_entry__ as g
+
+    g.dryrun_multichip(8)
