@@ -16,8 +16,23 @@ from __future__ import annotations
 
 import heapq
 import json
+import os
 import random
 import time
+
+
+def _enable_compile_cache():
+    """Persistent XLA compile cache: the staged configs compile multi-minute
+    programs; cache them next to the repo so reruns start in seconds."""
+    import jax
+
+    cache = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
+    jax.config.update("jax_compilation_cache_dir", cache)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+
+
+_enable_compile_cache()
 
 
 def device_phold(num_hosts: int, msgload: int, stop_s: int):
@@ -67,8 +82,90 @@ def cpu_phold_baseline(num_hosts: int, msgload: int, stop_s: int):
     return committed, wall
 
 
+def _run_stage(stage: str, app_model: str, loss: float, app_options: dict,
+               extra_counters: tuple = (), num_hosts: int = 10240,
+               stop_s: int = 4):
+    """Build, warm up (compile + bootstrap), then time the remaining sim
+    span. Warm-up-committed events are subtracted so the reported rate and
+    sim/wall ratio cover only the timed segment."""
+    import jax
+
+    from shadow_tpu.sim import build_simulation
+
+    warmup_ns = 1_500_000_000
+    n_servers = num_hosts // 8
+    cfg = {
+        "general": {"stop_time": stop_s, "seed": 7},
+        "network": {"graph": {"type": "gml", "inline": (
+            'graph [\n'
+            '  node [ id 0 bandwidth_down "1 Gbit" bandwidth_up "1 Gbit" ]\n'
+            f'  edge [ source 0 target 0 latency "10 ms" packet_loss {loss} ]\n'
+            ']\n')}},
+        "experimental": {
+            "event_capacity": 1 << 18,
+            "events_per_host_per_window": 16,
+            "outbox_slots": 16,
+        },
+        "hosts": {
+            "server": {"quantity": n_servers, "app_model": app_model,
+                       "app_options": {"role": "server"}},
+            "client": {"quantity": num_hosts - n_servers,
+                       "app_model": app_model, "app_options": app_options},
+        },
+    }
+    sim = build_simulation(cfg)
+    sim.run(until=warmup_ns)
+    jax.block_until_ready(sim.state.pool.time)
+    warm_events = sim.counters()["events_committed"]
+    t0 = time.perf_counter()
+    sim.run()
+    jax.block_until_ready(sim.state.pool.time)
+    wall = time.perf_counter() - t0
+    c = sim.counters()
+    timed_events = c["events_committed"] - warm_events
+    timed_sim_s = stop_s - warmup_ns / 1e9
+    out = {
+        "stage": stage,
+        "hosts": num_hosts,
+        "events_per_sec": round(timed_events / wall, 1),
+        "packets_delivered": c["packets_delivered"],
+        "sim_sec_per_wall_sec": round(timed_sim_s / wall, 2),
+    }
+    for k in extra_counters:
+        out[k] = c[k]
+    return out
+
+
+def stage_udp_flood(num_hosts: int = 10240, stop_s: int = 4):
+    """BASELINE staged config 2: 10k-host UDP flood through the full device
+    network stack (NIC token buckets, CoDel router, UDP sockets)."""
+    return _run_stage(
+        "udp_flood_10k", "udp_flood", 0.001,
+        {"interval": "20 ms", "size": 1024, "runtime": stop_s - 1},
+        num_hosts=num_hosts, stop_s=stop_s,
+    )
+
+
+def stage_tcp_bulk(num_hosts: int = 10240, stop_s: int = 4):
+    """BASELINE staged config 3: 10k-host TCP bulk transfer (vmap'd
+    handshake + seq/ack + Reno congestion state machines)."""
+    return _run_stage(
+        "tcp_bulk_10k", "tcp_bulk", 0.0005, {"total": "64 KiB"},
+        extra_counters=("bytes_delivered",),
+        num_hosts=num_hosts, stop_s=stop_s,
+    )
+
+
 def main():
-    num_hosts, msgload, stop_s = 8192, 8, 10
+    import sys
+
+    if "--stages" in sys.argv:
+        # staged measurement configs (BASELINE.md 2-3); one JSON line each
+        print(json.dumps(stage_udp_flood()))
+        print(json.dumps(stage_tcp_bulk()))
+        return
+
+    num_hosts, msgload, stop_s = 16384, 8, 10
     dev_events, dev_wall, sim_per_wall = device_phold(num_hosts, msgload, stop_s)
     dev_rate = dev_events / dev_wall if dev_wall > 0 else 0.0
 

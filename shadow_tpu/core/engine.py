@@ -9,24 +9,28 @@ min-next-event-time reduction ends the round.
 
 TPU-first re-architecture (one jitted pure function per window):
 
-1. EXTRACT — one sort of the event pool by (dst, time, src, seq) builds a
-   per-host ordered matrix [H, K] of this window's events. This replaces all
+1. SORT — one sort of the event pool by (dst, time, src, seq) groups this
+   window's events into consecutive per-host runs. This replaces all
    per-host priority queues and their locks.
 2. MICRO-STEP LOOP — a `lax.while_loop` whose body processes AT MOST ONE
    event per host, fully vectorized across all hosts: candidate = key-min of
-   (matrix head, self-inbox); handlers apply masked SoA updates. Per-host
-   event order is preserved exactly; hosts are data-parallel, which is the
-   same parallelism the reference exploits with worker threads (P1 in
-   SURVEY.md §2.5) — but over lanes instead of pthreads.
+   (run head at a per-host cursor, self-inbox); handlers apply masked SoA
+   updates. Per-host event order is preserved exactly; hosts are
+   data-parallel, which is the same parallelism the reference exploits with
+   worker threads (P1 in SURVEY.md §2.5) — but over lanes instead of
+   pthreads.
 3. The conservative-window invariant (window length ≤ min path latency,
    controller.c:125-153) guarantees cross-host emissions land at or after
    window end, so only SELF-emissions (short timers, NIC refills) can need
    intra-window processing — they go to a small per-host inbox. Everything
    else accumulates in a per-host outbox (no scatter collisions).
-4. MERGE — outbox + any spilled leftovers are merged into the pool with one
-   sort by time, truncating to capacity (drops counted). The next window
-   start is the min pool time — the reference's min-reduce barrier
-   (worker.c:332-363) becomes a jnp.min.
+4. MERGE — unconsumed sorted rows + outbox + inbox leftovers merge into the
+   next pool with one sort by time, truncating to capacity (drops counted).
+   The next window start is the min pool time — the reference's min-reduce
+   barrier (worker.c:332-363) becomes a jnp.min.
+
+Everything is sorts, gathers, and elementwise selects: XLA scatters
+serialize element-by-element on TPU and are banned from this module.
 
 The whole multi-window run can itself be a `lax.while_loop` on device
 (`Simulation.run_compiled`), so a complete simulation is ONE XLA program.
@@ -119,15 +123,6 @@ def draw_uniform(state: SimState, mask):
 
 
 @struct.dataclass
-class _Matrix:
-    time: jnp.ndarray  # [H, K] i64 (NEVER padded)
-    src: jnp.ndarray  # [H, K] i32
-    seq: jnp.ndarray  # [H, K] i32
-    kind: jnp.ndarray  # [H, K] i32
-    payload: jnp.ndarray  # [H, K, P] i32
-
-
-@struct.dataclass
 class _Inbox:
     time: jnp.ndarray  # [H, B] i64
     src: jnp.ndarray
@@ -169,64 +164,72 @@ class _Outbox:
         )
 
 
-def _extract_window(pool: EventPool, win_end, H: int, K: int):
-    """One sort by (dst, time, src, seq) → per-host ordered [H, K] matrix.
+@struct.dataclass
+class _SortedWindow:
+    """The pool sorted by (dst, time, src, seq) for one window.
 
-    Events beyond K per host stay in the pool; their keys are strictly larger
-    than every extracted event's, so deferring them to the next window keeps
-    per-host order. Also returns defer_time[H]: the earliest LEFTOVER event
-    time per host (NEVER if none) — self-emissions at or past it must bypass
-    the inbox and go to the pool, otherwise they could be processed ahead of
-    the deferred leftover. (Known tie edge: a leftover and an extracted event
-    at the exact same nanosecond can still invert against a same-time
+    In-window events of host h occupy consecutive rows [starts[h], ends[h]);
+    out-of-window rows sort to the end (dst key = H sentinel). The loop
+    consumes rows via per-host cursors — no [H, K] matrix is materialized;
+    per-iteration [H]-gathers read the head rows directly, and unconsumed
+    rows flow straight into the merge."""
+
+    dst: jnp.ndarray  # [C] i32 original dst (sentinel-free)
+    time: jnp.ndarray  # [C] i64
+    src: jnp.ndarray  # [C] i32
+    seq: jnp.ndarray  # [C] i32
+    kind: jnp.ndarray  # [C] i32
+    idx: jnp.ndarray  # [C] i32 original pool slot (payload indirection)
+    starts: jnp.ndarray  # [H] i32
+    ends: jnp.ndarray  # [H] i32
+
+
+def _sort_window(pool: EventPool, win_end, H: int, K: int):
+    """Sort the pool by (dst, time, src, seq) and locate per-host runs.
+
+    Events beyond K per host are deferred to the next window (their keys are
+    strictly larger than every extracted event's, so per-host order holds).
+    Also returns defer_time[H]: the earliest DEFERRED event time per host
+    (NEVER if none) — self-emissions at or past it must bypass the inbox and
+    go to the pool, otherwise they could be processed ahead of the deferred
+    leftover. (Known tie edge: a leftover and an extracted event at the
+    exact same nanosecond can still invert against a same-time
     self-emission; requires K overflow + an exact time tie, and K is
     configurable — tracked for an exact re-extraction fix.)
 
-    TPU note: everything here is sorts and gathers by construction — XLA
-    scatters serialize element-by-element on TPU (~0.5 µs each), so a single
-    [C]-row scatter would cost more than the entire window step. After the
-    sort, each host's events are CONSECUTIVE rows, so the matrix is a gather
-    at starts[h]+k, and the pool-slot clearing flag is mapped back through
-    the inverse permutation (computed with a second small sort)."""
+    TPU note: sorts and gathers only — XLA scatters serialize
+    element-by-element on TPU (~0.5 µs each), so a single [C]-row scatter
+    would cost more than the entire window step."""
     C = pool.capacity
     inwin = pool.time < win_end
     sort_dst = jnp.where(inwin, pool.dst, jnp.int32(H))
     idx = jnp.arange(C, dtype=jnp.int32)
-    s_dst, s_time, s_src, s_seq, s_idx = jax.lax.sort(
-        [sort_dst, pool.time, pool.src, pool.seq, idx], num_keys=4, is_stable=True
+    s_key, s_time, s_src, s_seq, s_idx = jax.lax.sort(
+        [sort_dst, pool.time, pool.src, pool.seq, idx], num_keys=4,
+        is_stable=True,
     )
-    hostsr = jnp.arange(H, dtype=jnp.int32)
-    starts = jnp.searchsorted(s_dst, hostsr).astype(jnp.int32)
-    ends = jnp.searchsorted(s_dst, hostsr + 1).astype(jnp.int32)
-    # mat[h, k] = sorted row starts[h]+k (valid while < ends[h])
-    take = starts[:, None] + jnp.arange(K, dtype=jnp.int32)[None, :]
-    valid_mat = take < ends[:, None]
-    gpos = jnp.where(valid_mat, take, 0)
-    pool_idx = s_idx[gpos]  # [H, K] original pool slots
-    mat = _Matrix(
-        time=jnp.where(valid_mat, s_time[gpos], NEVER),
-        src=jnp.where(valid_mat, s_src[gpos], 0),
-        seq=jnp.where(valid_mat, s_seq[gpos], 0),
-        kind=jnp.where(valid_mat, pool.kind[pool_idx], 0),
-        payload=jnp.where(
-            valid_mat[:, :, None], pool.payload[pool_idx], 0
-        ),
+    # One sort-method searchsorted over H+1 boundaries (the default binary
+    # scan costs ~3x more here).
+    bounds = jnp.searchsorted(
+        s_key, jnp.arange(H + 1, dtype=jnp.int32), method="sort"
+    ).astype(jnp.int32)
+    starts, ends = bounds[:H], bounds[1:]
+    sw = _SortedWindow(
+        dst=pool.dst[s_idx],
+        time=s_time,
+        src=s_src,
+        seq=s_seq,
+        kind=pool.kind[s_idx],
+        idx=s_idx,
+        starts=starts,
+        ends=ends,
     )
-    # Earliest leftover per host: sorted row starts[h]+K if still this host's.
+    # Earliest deferred (rank >= K) per host; NEVER if the host fit in K.
     has_defer = (starts + K) < ends
     defer_time = jnp.where(
         has_defer, s_time[jnp.where(has_defer, starts + K, 0)], NEVER
     )
-    # Clear extracted pool slots WITHOUT a scatter: flag rows in sorted
-    # order, then permute the flags back to pool order via the inverse
-    # permutation (argsort of s_idx — a cheap 2-operand sort).
-    spos = jnp.arange(C, dtype=jnp.int32)
-    rank = spos - starts[jnp.clip(s_dst, 0, H - 1)]
-    extracted_sorted = (s_dst < H) & (rank < K)
-    _, inv = jax.lax.sort([s_idx, spos], num_keys=1, is_stable=True)
-    extracted_pool = extracted_sorted[inv]
-    new_time = jnp.where(extracted_pool, NEVER, pool.time)
-    return mat, pool.replace(time=new_time), defer_time
+    return sw, defer_time
 
 
 def _inbox_min(inbox: _Inbox):
@@ -309,12 +312,12 @@ def make_window_step(
     def step(state: SimState, params: NetParams, win_start, win_end):
         win_start = jnp.asarray(win_start, jnp.int64)
         win_end = jnp.asarray(win_end, jnp.int64)
-        mat, pool, defer_time = _extract_window(state.pool, win_end, H, K)
-        state = state.replace(pool=pool, now=win_start)
+        sw, defer_time = _sort_window(state.pool, win_end, H, K)
+        pool_payload = state.pool.payload
+        state = state.replace(now=win_start)
         carry0 = (
             state,
-            mat,
-            jnp.zeros((H,), dtype=jnp.int32),  # ptr
+            jnp.zeros((H,), dtype=jnp.int32),  # ptr (consumed per host)
             _Inbox.empty(H, B),
             _Outbox.empty(H, O),
             jnp.int32(0),  # iteration counter
@@ -322,27 +325,25 @@ def make_window_step(
         )
 
         def cond(carry):
-            _, _, _, _, _, it, work = carry
+            _, _, _, _, it, work = carry
             return work & (it < max_iters)
 
         def body(carry):
-            state, mat, ptr, inbox, outbox, it, _ = carry
+            state, ptr, inbox, outbox, it, _ = carry
 
-            # --- candidate per host: matrix head vs inbox min ---
-            p = jnp.clip(ptr, 0, K - 1)
-            m_time = jnp.take_along_axis(mat.time, p[:, None], axis=1)[:, 0]
-            m_time = jnp.where(ptr < K, m_time, NEVER)
-            m_src = jnp.take_along_axis(mat.src, p[:, None], axis=1)[:, 0]
-            m_seq = jnp.take_along_axis(mat.seq, p[:, None], axis=1)[:, 0]
+            # --- candidate per host: sorted-run head vs inbox min ---
+            hp = jnp.clip(sw.starts + ptr, 0, sw.time.shape[0] - 1)
+            in_run = (ptr < K) & ((sw.starts + ptr) < sw.ends)
+            m_time = jnp.where(in_run, sw.time[hp], NEVER)
+            m_src = sw.src[hp]
+            m_seq = sw.seq[hp]
             i_time, i_src, i_seq, i_slot = _inbox_min(inbox)
             use_inbox = _key_lt(i_time, i_src, i_seq, m_time, m_src, m_seq)
             ev_time = jnp.where(use_inbox, i_time, m_time)
             valid = ev_time < win_end
 
-            m_kind = jnp.take_along_axis(mat.kind, p[:, None], axis=1)[:, 0]
-            m_payload = jnp.take_along_axis(mat.payload, p[:, None, None], axis=1)[
-                :, 0, :
-            ]
+            m_kind = sw.kind[hp]
+            m_payload = pool_payload[sw.idx[hp]]
             i_kind = jnp.take_along_axis(inbox.kind, i_slot[:, None], axis=1)[:, 0]
             i_payload = jnp.take_along_axis(
                 inbox.payload, i_slot[:, None, None], axis=1
@@ -436,60 +437,64 @@ def make_window_step(
                 )
 
             work = jnp.any(valid)
-            return (state, mat, ptr, inbox, outbox, it + 1, work)
+            return (state, ptr, inbox, outbox, it + 1, work)
 
-        state, mat, ptr, inbox, outbox, _, _ = jax.lax.while_loop(
+        state, ptr, inbox, outbox, _, _ = jax.lax.while_loop(
             cond, body, carry0
         )
 
-        # --- merge: pool ∪ outbox ∪ spilled leftovers (inbox/matrix) with
+        # --- merge: unconsumed sorted rows ∪ outbox ∪ inbox leftovers with
         # one sort by time (gathers only — no scatters, which serialize on
-        # TPU). Leftovers are only non-empty if max_iters capped the loop;
-        # their keys exceed everything processed, so deferring them is still
-        # a correct (if slower) schedule.
+        # TPU). A sorted row is consumed iff its rank within its host's run
+        # is below that host's final cursor — pure elementwise, no inverse
+        # permutation needed. Inbox leftovers only exist if max_iters capped
+        # the loop; deferring them is a correct (if slower) schedule.
         pool = state.pool
         C = pool.capacity
-        col = jnp.broadcast_to(jnp.arange(K, dtype=jnp.int32), (H, K))
-        mat_left = col >= ptr[:, None]
-        mat_time_left = jnp.where(mat_left, mat.time, NEVER)
+        spos = jnp.arange(C, dtype=jnp.int32)
+        run_host = jnp.clip(sw.dst, 0, H - 1)
+        rank = spos - sw.starts[run_host]
+        in_run_row = (spos >= sw.starts[run_host]) & (spos < sw.ends[run_host])
+        consumed = in_run_row & (rank < ptr[run_host])
+        left_time = jnp.where(consumed, NEVER, sw.time)
 
-        hostsK = jnp.broadcast_to(hosts[:, None], (H, K)).reshape(-1)
         hostsB = jnp.broadcast_to(hosts[:, None], inbox.time.shape).reshape(-1)
         all_time = jnp.concatenate(
-            [pool.time, outbox.time.reshape(-1), inbox.time.reshape(-1),
-             mat_time_left.reshape(-1)]
+            [left_time, outbox.time.reshape(-1), inbox.time.reshape(-1)]
         )
-        all_dst = jnp.concatenate(
-            [pool.dst, outbox.dst.reshape(-1), hostsB, hostsK]
-        )
+        all_dst = jnp.concatenate([sw.dst, outbox.dst.reshape(-1), hostsB])
         all_src = jnp.concatenate(
-            [pool.src, outbox.src.reshape(-1), inbox.src.reshape(-1),
-             mat.src.reshape(-1)]
+            [sw.src, outbox.src.reshape(-1), inbox.src.reshape(-1)]
         )
         all_seq = jnp.concatenate(
-            [pool.seq, outbox.seq.reshape(-1), inbox.seq.reshape(-1),
-             mat.seq.reshape(-1)]
+            [sw.seq, outbox.seq.reshape(-1), inbox.seq.reshape(-1)]
         )
         all_kind = jnp.concatenate(
-            [pool.kind, outbox.kind.reshape(-1), inbox.kind.reshape(-1),
-             mat.kind.reshape(-1)]
-        )
-        all_payload = jnp.concatenate(
-            [pool.payload, outbox.payload.reshape(-1, PAYLOAD_WORDS),
-             inbox.payload.reshape(-1, PAYLOAD_WORDS),
-             mat.payload.reshape(-1, PAYLOAD_WORDS)]
+            [sw.kind, outbox.kind.reshape(-1), inbox.kind.reshape(-1)]
         )
         idx = jnp.arange(all_time.shape[0], dtype=jnp.int32)
         s_time, s_idx = jax.lax.sort([all_time, idx], num_keys=1, is_stable=True)
         keep = s_idx[:C]
         dropped = jnp.sum(s_time[C:] != NEVER, dtype=jnp.int64)
+        # Payload indirection: rows from the sorted window read the ORIGINAL
+        # pool payload via sw.idx; box rows read the box buffers.
+        box_payload = jnp.concatenate(
+            [outbox.payload.reshape(-1, PAYLOAD_WORDS),
+             inbox.payload.reshape(-1, PAYLOAD_WORDS)]
+        )
+        from_pool = keep < C
+        ppidx = sw.idx[jnp.where(from_pool, keep, 0)]
+        bidx = jnp.clip(keep - C, 0, box_payload.shape[0] - 1)
+        new_payload = jnp.where(
+            from_pool[:, None], pool.payload[ppidx], box_payload[bidx]
+        )
         new_pool = EventPool(
             time=s_time[:C],
             dst=all_dst[keep],
             src=all_src[keep],
             seq=all_seq[keep],
             kind=all_kind[keep],
-            payload=all_payload[keep],
+            payload=new_payload,
         )
         # Speculation-violation signal for the optimistic synchronizer: a
         # cross-host emission targeting time t is a violation iff its
@@ -601,6 +606,7 @@ class Simulation:
         step = make_window_step(handlers, num_hosts, K=K, B=B, O=O)
         self._step = jax.jit(step)
         self._run_to = jax.jit(self._make_run_to(step))
+        self._attempt = jax.jit(self._make_attempt(step))
 
     def _make_run_to(self, step):
         runahead = jnp.int64(self.runahead)
@@ -644,6 +650,30 @@ class Simulation:
             windows += 1
         return windows
 
+    def _make_attempt(self, step):
+        def attempt(state: SimState, params: NetParams, ws, we):
+            """Process the window [ws, we) to completion ON DEVICE: sub-step
+            until no pool events remain below we, or a speculation violation
+            surfaces (state.xmit_min != NEVER). One dispatch per attempt."""
+            ws = jnp.asarray(ws, jnp.int64)
+            we = jnp.asarray(we, jnp.int64)
+
+            def cond(c):
+                _, mn, v = c
+                return (mn < we) & (v == simtime.NEVER)
+
+            def body(c):
+                st, mn, _ = c
+                st2, mn2 = step(st, params, jnp.maximum(mn, ws), we)
+                return st2, mn2, st2.xmit_min
+
+            mn0 = jnp.min(state.pool.time)
+            return jax.lax.while_loop(
+                cond, body, (state, mn0, jnp.asarray(simtime.NEVER, jnp.int64))
+            )
+
+        return attempt
+
     # -- optimistic synchronization: speculate long windows, roll back on
     # violation (SURVEY §7.6). Pure-array state makes rollback free: the
     # pre-window state is just the previous pytree. --
@@ -683,25 +713,15 @@ class Simulation:
             ws = min_next
             we = min(ws + window_factor * cons, stop)
             base = self.state  # rollback snapshot (done_t already reset)
-            while True:  # attempt [ws, we), shrinking on violation
-                st = base
-                cur = ws
-                viol = None
-                while cur < we:
-                    st, mn = self._step(st, self.params, cur, we)
-                    v = int(st.xmit_min)
-                    if v < int(simtime.NEVER) and we > ws + cons:
-                        viol = v
-                        break
-                    cur = int(mn)
-                if viol is None:
-                    break  # window complete (or conservative-size: commit)
+            while True:  # attempt [ws, we) in ONE dispatch; shrink on violation
+                st, mn, viol = self._attempt(base, self.params, ws, we)
+                viol = int(viol)
+                if viol >= int(simtime.NEVER) or we <= ws + cons:
+                    break
                 rollbacks += 1
                 we = max(viol, ws + cons)
-            self.state = st.replace(
-                host=st.host.replace(done_t=neg1)
-            )
-            min_next = int(jnp.min(st.pool.time))
+            self.state = st.replace(host=st.host.replace(done_t=neg1))
+            min_next = int(mn)
             windows += 1
         return windows, rollbacks
 

@@ -48,13 +48,21 @@ class NetStack:
         router_queue_slots: int = 64,
         nic_queue_slots: int = 64,
         tcp_ooo_chunks: int = tcp_mod.OOO_CHUNKS,
+        with_tcp: bool = True,
     ):
         self.num_hosts = num_hosts
         self._init_nic = nic.init(bw_up_bits, bw_down_bits, nic_queue_slots)
         self._init_router = codel.init(num_hosts, router_queue_slots)
         self._init_udp = udp.init(num_hosts, sockets_per_host)
-        self.tcp = tcp_mod.Tcp(num_hosts, sockets_per_host, tcp_ooo_chunks)
-        self.tcp.attach(self)
+        # UDP-only sims skip the TCP state machine entirely: its handlers
+        # otherwise run (masked) every micro-step and dominate both compile
+        # time and per-iteration cost.
+        self.tcp = (
+            tcp_mod.Tcp(num_hosts, sockets_per_host, tcp_ooo_chunks)
+            if with_tcp else None
+        )
+        if self.tcp is not None:
+            self.tcp.attach(self)
         self.recv_hooks: list[RecvHook] = []
 
     # ---- build-time API ----
@@ -66,18 +74,22 @@ class NetStack:
         )
 
     def tcp_listen(self, host: int, slot: int, port: int):
+        if self.tcp is None:
+            raise ValueError("stack built with with_tcp=False")
         self.tcp.listen(host, slot, port)
 
     def on_receive(self, hook: RecvHook):
         self.recv_hooks.append(hook)
 
     def init_subs(self) -> dict:
-        return {
+        subs = {
             nic.SUB: self._init_nic,
             codel.SUB: self._init_router,
             udp.SUB: self._init_udp,
-            tcp_mod.SUB: self.tcp.init_sub(),
         }
+        if self.tcp is not None:
+            subs[tcp_mod.SUB] = self.tcp.init_sub()
+        return subs
 
     # ---- generic transmit path (all protocols) ----
 
@@ -167,10 +179,11 @@ class NetStack:
         state = state.with_sub(udp.SUB, u)
         for hook in self.recv_hooks:
             state = hook(state, found, slot, src, payload, emitter, now, params)
-        is_tcp = mask & (payload[:, pkt.W_PROTO] == pkt.PROTO_TCP)
-        state = self.tcp.on_segment(
-            state, is_tcp, src, payload, emitter, now, params
-        )
+        if self.tcp is not None:
+            is_tcp = mask & (payload[:, pkt.W_PROTO] == pkt.PROTO_TCP)
+            state = self.tcp.on_segment(
+                state, is_tcp, src, payload, emitter, now, params
+            )
         return state
 
     def on_pkt_deliver(
@@ -299,9 +312,11 @@ class NetStack:
         return state.with_sub(nic.SUB, n)
 
     def handlers(self) -> dict:
-        return {
+        h = {
             KIND_PKT_DELIVER: self.on_pkt_deliver,
             KIND_NIC_SEND: self.on_nic_send,
             KIND_NIC_RECV: self.on_nic_recv,
-            **self.tcp.handlers(),
         }
+        if self.tcp is not None:
+            h.update(self.tcp.handlers())
+        return h

@@ -84,6 +84,26 @@ EFD_SEMAPHORE = 0x1
 TFD_TIMER_ABSTIME = 0x1
 O_NONBLOCK_FLAG = 0o4000
 
+# sysno -> name for syscall-count reporting (built from the SYS_* constants
+# above plus the pseudo-syscalls)
+SYSCALL_NAMES = {
+    v: k[4:] for k, v in list(globals().items())
+    if k.startswith("SYS_") and isinstance(v, int)
+}
+SYSCALL_NAMES.update({
+    ipc.PSYS_RESOLVE_NAME: "resolve_name",
+    ipc.PSYS_YIELD: "yield",
+    ipc.PSYS_GETHOSTNAME: "gethostname",
+})
+
+
+def format_syscall_counts(counts: dict[int, int]) -> str:
+    parts = [
+        f"{SYSCALL_NAMES.get(n, n)}:{c}"
+        for n, c in sorted(counts.items(), key=lambda kv: -kv[1])
+    ]
+    return " ".join(parts)
+
 SOCK_STREAM = 1
 SOCK_DGRAM = 2
 SOCK_NONBLOCK = 0o4000
@@ -295,6 +315,10 @@ class ManagedProcess:
         self.state = ManagedProcess.RUNNING  # executing until HELLO arrives
 
     def alloc_fd(self) -> int:
+        # skip occupied slots: dup2/dup3 can park an alias ahead of the
+        # counter, and allocating over it would silently drop the alias
+        while self.next_fd in self.fds:
+            self.next_fd += 1
         fd = self.next_fd
         self.next_fd += 1
         return fd
@@ -399,6 +423,7 @@ class ProcessDriver:
         self.service_timeout_s = service_timeout_s
         self.now = 0
         self.hosts: list[SimHost] = []
+        self._hosts_by_ip: dict[int, SimHost] = {}
         self.procs: list[ManagedProcess] = []
         self._heap: list = []  # (time, seq, callback)
         self._seq = 0
@@ -420,6 +445,9 @@ class ProcessDriver:
             "packets_dropped": 0,
             "bytes_sent": 0,
         }
+        # per-syscall tallies (use_syscall_counters analog: counter.rs
+        # aggregation logged at exit, syscall_handler.c:109-121)
+        self.syscall_counts: dict[int, int] = {}
 
     # ------------------------------------------------------------------
     # build API
@@ -429,6 +457,7 @@ class ProcessDriver:
         h = SimHost(name=name, ip=ip if isinstance(ip, int) else ip_from_str(ip))
         h.rand.seed(f"{self.seed}:{name}")
         self.hosts.append(h)
+        self._hosts_by_ip[h.ip] = h
         return h
 
     def add_process(
@@ -486,10 +515,7 @@ class ProcessDriver:
         return self._rng.random() < self.loss
 
     def _host_by_ip(self, ip: int) -> SimHost | None:
-        for h in self.hosts:
-            if h.ip == ip:
-                return h
-        return None
+        return self._hosts_by_ip.get(ip)
 
     def _host_by_name(self, name: str) -> SimHost | None:
         for h in self.hosts:
@@ -771,6 +797,7 @@ class ProcessDriver:
         sysno = ch.sysno
         a = ch.args
         self.counters["syscalls"] += 1
+        self.syscall_counts[sysno] = self.syscall_counts.get(sysno, 0) + 1
 
         def done(ret: int, data: bytes = b"") -> None:
             ch.reply(ret, sim_time_ns=self.now, data=data)
@@ -1479,3 +1506,8 @@ class ProcessDriver:
             )
         for w in self._pcaps.values():
             w.close()
+        if self.syscall_counts:
+            # per-syscall tally at exit (manager.c:269-274 analog)
+            log.logger.debug(
+                "syscall counts: %s", format_syscall_counts(self.syscall_counts)
+            )

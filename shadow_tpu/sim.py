@@ -136,6 +136,7 @@ def build_simulation(source) -> Simulation:
             jnp.asarray(bw_down),
             sockets_per_host=cfg.experimental.sockets_per_host,
             router_queue_slots=cfg.experimental.router_queue_slots,
+            with_tcp=(name == "tcp_bulk"),
         )
         interval = units.parse_time_ns(
             client_opts.get("interval", "100 ms"), default_unit="ms"
