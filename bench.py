@@ -114,11 +114,13 @@ def _run_stage(stage: str, app_model: str, loss: float, app_options: dict,
         },
     }
     sim = build_simulation(cfg)
-    sim.run(until=warmup_ns)
+    # Short dispatch chunks: minutes-long single dispatches can crash the
+    # accelerator runtime's watchdog at this scale.
+    sim.run(until=warmup_ns, windows_per_dispatch=8)
     jax.block_until_ready(sim.state.pool.time)
     warm_events = sim.counters()["events_committed"]
     t0 = time.perf_counter()
-    sim.run()
+    sim.run(windows_per_dispatch=8)
     jax.block_until_ready(sim.state.pool.time)
     wall = time.perf_counter() - t0
     c = sim.counters()

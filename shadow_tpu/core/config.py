@@ -153,6 +153,11 @@ class ExperimentalOptions:
     devices: int = 1  # mesh size over the host axis
     inbox_slots: int = 8  # B: per-host intra-window self-event slots
     outbox_slots: int = 64  # O: per-host emission slots per window
+    # CPU model (host/cpu.c analog): simulated processing cost per syscall
+    # on the managed-process plane; accumulated delay is applied to the
+    # virtual clock once it exceeds max_unapplied_cpu_latency.
+    cpu_ns_per_syscall: int = 0  # 0 = CPU model off
+    max_unapplied_cpu_latency: int = units.parse_time_ns("1 us")
 
     @classmethod
     def from_dict(cls, d: dict) -> "ExperimentalOptions":
@@ -163,8 +168,7 @@ class ExperimentalOptions:
             "use_cpu_pinning", "use_sched_fifo", "scheduler_policy",
             "preload_spin_max", "use_explicit_block_message",
             "use_shim_syscall_handler", "use_o_n_waitpid_workarounds",
-            "use_legacy_working_dir", "max_unapplied_cpu_latency",
-            "host_heartbeat_interval",
+            "use_legacy_working_dir", "host_heartbeat_interval",
         }
         _check_fields("experimental", d, fields | ignored)
         out = cls()
@@ -180,6 +184,15 @@ class ExperimentalOptions:
         ):
             if name in d:
                 setattr(out, name, bool(d[name]))
+        if d.get("cpu_ns_per_syscall") is not None:
+            # bare numbers are NANOSECONDS here (the field name says so)
+            out.cpu_ns_per_syscall = units.parse_time_ns(
+                d["cpu_ns_per_syscall"], default_unit="ns"
+            )
+        if d.get("max_unapplied_cpu_latency") is not None:
+            out.max_unapplied_cpu_latency = units.parse_time_ns(
+                d["max_unapplied_cpu_latency"], default_unit="ns"
+            )
         for name in (
             "event_capacity", "events_per_host_per_window", "sockets_per_host",
             "router_queue_slots", "devices", "inbox_slots", "outbox_slots",

@@ -19,8 +19,9 @@ TPU-first differences:
   timestamp while work remains. All hosts pump in parallel each micro-step,
   so per-window cost is max-packets-per-host, not total packets.
 - The send queue is a single per-host ring ordered FIFO-by-priority
-  (the reference's default fifo qdisc selects by packet app priority);
-  round-robin-over-sockets qdisc is a planned variant.
+  (the reference's default fifo qdisc selects by packet app priority).
+  The round-robin-over-sockets qdisc variant selects mid-ring via the
+  helpers at the bottom of this module.
 """
 
 from __future__ import annotations
@@ -56,6 +57,10 @@ class NicState:
     # pump-pending flags (reference isRefillPending analog for pump events)
     send_pending: jnp.ndarray  # [H] bool
     recv_pending: jnp.ndarray  # [H] bool
+    # round-robin qdisc state (network_queuing_disciplines.c): which socket
+    # was served last, and which ring slots were consumed out of order
+    last_socket: jnp.ndarray  # [H] i32 (-1 = none yet)
+    q_taken: jnp.ndarray  # [H, NQ] bool
     # drop counter for send-ring overflow
     sendq_dropped: jnp.ndarray  # [] i64
     # per-host byte/packet tracker (tracker.c:215-247 analog)
@@ -94,6 +99,8 @@ def init(bw_up_bits, bw_down_bits, queue_slots: int = 64) -> NicState:
         q_tail=jnp.zeros((H,), jnp.int32),
         send_pending=jnp.zeros((H,), bool),
         recv_pending=jnp.zeros((H,), bool),
+        last_socket=jnp.full((H,), -1, jnp.int32),
+        q_taken=jnp.zeros((H, NQ), bool),
         sendq_dropped=jnp.zeros((), jnp.int64),
         tx_packets=jnp.zeros((H,), jnp.int64),
         tx_bytes=jnp.zeros((H,), jnp.int64),
@@ -173,3 +180,77 @@ def peek_send(nic: NicState):
 
 def pop_send(nic: NicState, mask) -> NicState:
     return nic.replace(q_head=nic.q_head + mask.astype(jnp.int32))
+
+
+# ---------------------------------------------------------------------------
+# round-robin-over-sockets qdisc (network_queuing_disciplines.c RR variant):
+# the next non-empty socket after the last-served one sends its OLDEST
+# queued packet. Mid-ring consumption marks slots taken; the head advances
+# lazily past taken slots.
+# ---------------------------------------------------------------------------
+
+
+def _rr_order(nic: NicState, sockets_per_host: int):
+    """Per ring position j (age order): (selectable, rr_key, slot index)."""
+    H, NQ = nic.q_dst.shape
+    j = jnp.arange(NQ, dtype=jnp.int32)[None, :]  # [1, NQ] age rank
+    slot = (nic.q_head[:, None] + j) % NQ
+    hosts = jnp.arange(H, dtype=jnp.int32)[:, None]
+    present = (j < (nic.q_tail - nic.q_head)[:, None]) & ~nic.q_taken[
+        hosts, slot
+    ]
+    sock = nic.q_payload[hosts, slot, pkt.W_SOCKET]
+    S = sockets_per_host
+    cycle = (sock - nic.last_socket[:, None] - 1) % S
+    key = jnp.where(present, cycle * NQ + j, jnp.int32(S * NQ + NQ))
+    return present, key, slot
+
+
+def peek_send_rr(nic: NicState, sockets_per_host: int):
+    """RR head packet per host: (payload [H,P], dst [H], nonempty [H],
+    slot [H])."""
+    H, NQ = nic.q_dst.shape
+    hosts = jnp.arange(H, dtype=jnp.int32)
+    present, key, slot = _rr_order(nic, sockets_per_host)
+    pick = jnp.argmin(key, axis=1).astype(jnp.int32)
+    nonempty = jnp.any(present, axis=1)
+    sel = slot[hosts, pick]
+    return nic.q_payload[hosts, sel], nic.q_dst[hosts, sel], nonempty, sel
+
+
+def pop_send_rr(nic: NicState, mask, slot) -> NicState:
+    """Consume the RR-selected slot, remember its socket, advance the head
+    past any leading taken slots."""
+    H, NQ = nic.q_dst.shape
+    hosts = jnp.arange(H, dtype=jnp.int32)
+    cols = jnp.arange(NQ, dtype=jnp.int32)
+    hit = mask[:, None] & (cols[None, :] == slot[:, None])
+    taken = nic.q_taken | hit
+    sock = nic.q_payload[hosts, slot, pkt.W_SOCKET]
+    last = jnp.where(mask, sock, nic.last_socket)
+    # first age-rank that is present and not taken → head advance count
+    j = jnp.arange(NQ, dtype=jnp.int32)[None, :]
+    ring_slot = (nic.q_head[:, None] + j) % NQ
+    live = (j < (nic.q_tail - nic.q_head)[:, None]) & ~taken[
+        hosts[:, None], ring_slot
+    ]
+    first_live = jnp.where(
+        jnp.any(live, axis=1),
+        jnp.argmax(live, axis=1).astype(jnp.int32),
+        (nic.q_tail - nic.q_head),
+    )
+    # clear taken flags for slots the head passes over
+    taken = taken & ~_ring_mask(taken.shape, nic.q_head, first_live)
+    return nic.replace(
+        q_head=nic.q_head + first_live,
+        q_taken=taken,
+        last_socket=last,
+    )
+
+
+def _ring_mask(shape, head, count):
+    """[H, NQ] bool: True for ring slots head..head+count (mod NQ)."""
+    H, NQ = shape
+    cols = jnp.arange(NQ, dtype=jnp.int32)[None, :]
+    rel = (cols - (head[:, None] % NQ)) % NQ
+    return rel < count[:, None]

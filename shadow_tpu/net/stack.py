@@ -49,7 +49,12 @@ class NetStack:
         nic_queue_slots: int = 64,
         tcp_ooo_chunks: int = tcp_mod.OOO_CHUNKS,
         with_tcp: bool = True,
+        qdisc: str = "fifo",
     ):
+        if qdisc not in ("fifo", "roundrobin"):
+            raise ValueError(f"unknown qdisc {qdisc!r}")
+        self.qdisc = qdisc
+        self.sockets_per_host = sockets_per_host
         self.num_hosts = num_hosts
         self._init_nic = nic.init(bw_up_bits, bw_down_bits, nic_queue_slots)
         self._init_router = codel.init(num_hosts, router_queue_slots)
@@ -64,6 +69,11 @@ class NetStack:
         if self.tcp is not None:
             self.tcp.attach(self)
         self.recv_hooks: list[RecvHook] = []
+        # Receive-pump batching unrolls _deliver_local (the full demux +
+        # hooks + TCP suite) per drained packet; with TCP compiled in, the
+        # unroll multiplies XLA compile time for little win, so batch only
+        # the UDP-only build.
+        self.recv_batch = 1 if with_tcp else self.PUMP_BATCH
 
     # ---- build-time API ----
 
@@ -213,11 +223,20 @@ class NetStack:
         n = n.replace(recv_pending=n.recv_pending | need)
         return state.with_sub(nic.SUB, n)
 
+    # Packets drained per pump invocation. The reference's send loop drains
+    # the qdisc while tokens allow within ONE task (network_interface.c:
+    # 497-539); unrolling the same loop here keeps micro-step counts (and
+    # thus full handler-suite invocations) ~BATCH× lower for bursty
+    # traffic. 2 balances that against XLA compile time, which grows with
+    # the unroll (the accelerator backend has no persistent compile cache).
+    PUMP_BATCH = 2
+
     def on_nic_send(
         self, state: SimState, ev: EventView, emitter: Emitter, params: NetParams
     ) -> SimState:
-        """Send pump: one packet per invocation while tokens allow; re-arms
-        itself at `now` (more tokens) or the next refill tick (exhausted)."""
+        """Send pump: up to PUMP_BATCH packets per invocation while tokens
+        allow; re-arms itself at `now` (more queued) or the next refill tick
+        (tokens exhausted)."""
         H = self.num_hosts
         hosts = jnp.arange(H, dtype=jnp.int32)
         now = ev.time
@@ -229,41 +248,49 @@ class NetStack:
             n.tx_rem, n.tx_tick, n.tx_refill, n.tx_cap, now, mask
         )
         n = n.replace(tx_rem=tx_rem, tx_tick=tx_tick)
-
-        payload, dst, has_pkt = nic.peek_send(n)
         bootstrap = now < params.bootstrap_end
-        can = bootstrap | (n.tx_rem >= pkt.MTU)
-        do = mask & has_pkt & can
 
-        # Charge the FULL wire size (may go negative — token debt). For
-        # MTU-conformant packets this is identical to the reference's
-        # clamp-at-zero (rem ≥ MTU ≥ size when the gate passes); for
-        # oversize packets debt prevents exceeding configured bandwidth.
-        size = pkt.total_bytes(payload).astype(jnp.int64)
-        n = n.replace(
-            tx_rem=jnp.where(do & ~bootstrap, n.tx_rem - size, n.tx_rem)
-        )
-        n = nic.pop_send(n, do)
-        n = nic.count_tx(n, do, size)
-        state = state.with_sub(nic.SUB, n)
+        rr = self.qdisc == "roundrobin"
+        for _ in range(self.PUMP_BATCH):
+            if rr:
+                payload, dst, has_pkt, rr_slot = nic.peek_send_rr(
+                    n, self.sockets_per_host
+                )
+            else:
+                payload, dst, has_pkt = nic.peek_send(n)
+            can = bootstrap | (n.tx_rem >= pkt.MTU)
+            do = mask & has_pkt & can
 
-        remote = do & (dst != hosts)
-        state = link.send(
-            state, emitter, remote, dst, now, KIND_PKT_DELIVER, payload, params,
-            jnp.where(remote, size, 0),
-            control_mask=payload[:, pkt.W_LEN] == 0,
-        )
-        # loopback: deliver at the same timestamp, no transit
-        lb = do & (dst == hosts)
-        emitter.emit(lb, now, hosts, jnp.int32(KIND_PKT_DELIVER), payload)
+            # Charge the FULL wire size (may go negative — token debt). For
+            # MTU-conformant packets this is identical to the reference's
+            # clamp-at-zero (rem ≥ MTU ≥ size when the gate passes); for
+            # oversize packets debt prevents exceeding configured bandwidth.
+            size = pkt.total_bytes(payload).astype(jnp.int64)
+            n = n.replace(
+                tx_rem=jnp.where(do & ~bootstrap, n.tx_rem - size, n.tx_rem)
+            )
+            n = nic.pop_send_rr(n, do, rr_slot) if rr else nic.pop_send(n, do)
+            n = nic.count_tx(n, do, size)
+            state = state.with_sub(nic.SUB, n)
 
-        n = state.subs[nic.SUB]
+            remote = do & (dst != hosts)
+            state = link.send(
+                state, emitter, remote, dst, now, KIND_PKT_DELIVER, payload,
+                params, jnp.where(remote, size, 0),
+                control_mask=payload[:, pkt.W_LEN] == 0,
+            )
+            # loopback: deliver at the same timestamp, no transit
+            lb = do & (dst == hosts)
+            emitter.emit(lb, now, hosts, jnp.int32(KIND_PKT_DELIVER), payload)
+            n = state.subs[nic.SUB]
+
         still = n.q_head < n.q_tail
         need = mask & still
         can_next = bootstrap | (n.tx_rem >= pkt.MTU)
         t_next = jnp.where(can_next, now, nic.next_refill_time(now))
         emitter.emit(
-            need, t_next, hosts, jnp.int32(KIND_NIC_SEND), jnp.zeros_like(payload)
+            need, t_next, hosts, jnp.int32(KIND_NIC_SEND),
+            jnp.zeros_like(ev.payload),
         )
         n = n.replace(send_pending=n.send_pending | need)
         return state.with_sub(nic.SUB, n)
@@ -271,8 +298,9 @@ class NetStack:
     def on_nic_recv(
         self, state: SimState, ev: EventView, emitter: Emitter, params: NetParams
     ) -> SimState:
-        """Receive pump: CoDel-dequeue one packet per invocation while rx
-        tokens allow; re-arms while the router queue is non-empty."""
+        """Receive pump: CoDel-dequeue up to PUMP_BATCH packets per
+        invocation while rx tokens allow; re-arms while the router queue is
+        non-empty (network_interface.c:448-485 drains in one task too)."""
         H = self.num_hosts
         hosts = jnp.arange(H, dtype=jnp.int32)
         now = ev.time
@@ -284,22 +312,25 @@ class NetStack:
             n.rx_rem, n.rx_tick, n.rx_refill, n.rx_cap, now, mask
         )
         n = n.replace(rx_rem=rx_rem, rx_tick=rx_tick)
-
         bootstrap = now < params.bootstrap_end
-        can = bootstrap | (n.rx_rem >= pkt.MTU)
-        want = mask & can
 
-        r = state.subs[codel.SUB]
-        r, have, payload, src = codel.dequeue(r, now, want)
-        size = pkt.total_bytes(payload).astype(jnp.int64)
-        n = n.replace(
-            rx_rem=jnp.where(have & ~bootstrap, n.rx_rem - size, n.rx_rem)
-        )
-        state = state.with_sub(codel.SUB, r).with_sub(nic.SUB, n)
+        for _ in range(self.recv_batch):
+            can = bootstrap | (n.rx_rem >= pkt.MTU)
+            want = mask & can
 
-        state = self._deliver_local(state, have, src, payload, emitter, now, params)
+            r = state.subs[codel.SUB]
+            r, have, payload, src = codel.dequeue(r, now, want)
+            size = pkt.total_bytes(payload).astype(jnp.int64)
+            n = n.replace(
+                rx_rem=jnp.where(have & ~bootstrap, n.rx_rem - size, n.rx_rem)
+            )
+            state = state.with_sub(codel.SUB, r).with_sub(nic.SUB, n)
 
-        n = state.subs[nic.SUB]
+            state = self._deliver_local(
+                state, have, src, payload, emitter, now, params
+            )
+            n = state.subs[nic.SUB]
+
         r = state.subs[codel.SUB]
         still = codel.nonempty(r)
         need = mask & still

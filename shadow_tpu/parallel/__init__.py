@@ -1,0 +1,24 @@
+"""Multi-device scale-out: host-dimension data parallelism over a mesh.
+
+The reference scales by sharding hosts across worker threads with per-host
+locks (SURVEY §2.5 P1) and a barriered round window (P2); its cross-worker
+"communication backend" is a push into the destination's locked queue
+(scheduler.c:232). Here the same structure maps onto a `jax.sharding.Mesh`:
+
+- host state and event pool shard over the ``hosts`` mesh axis;
+- the baked topology matrices and scalar clocks replicate;
+- GSPMD inserts the collectives the reference does by hand: the per-window
+  destination-sharded event exchange is an all-to-all over ICI, and the
+  min-next-event-time barrier reduction is a global min.
+
+Multi-host (DCN) runs use the same annotations over a multi-process mesh —
+the window kernel is oblivious to where the collectives ride.
+"""
+
+from shadow_tpu.parallel.mesh import (  # noqa: F401
+    host_mesh,
+    replicate,
+    shard_params,
+    shard_sim,
+    shard_state,
+)

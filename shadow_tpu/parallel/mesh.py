@@ -1,0 +1,83 @@
+"""Mesh construction + SimState sharding rules.
+
+One axis — ``hosts`` — because host-parallelism is the simulator's only
+data-parallel dimension (SURVEY §2.5: no tensor/pipeline analogs exist; the
+reference's work stealing (P3) becomes re-sharding between windows, and CPU
+pinning (P5) is owned by XLA).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXIS = "hosts"
+
+
+def host_mesh(n_devices: int | None = None) -> Mesh:
+    """A 1-D mesh over the first n devices (all by default)."""
+    devs = jax.devices()
+    if n_devices is not None:
+        if len(devs) < n_devices:
+            raise ValueError(
+                f"need {n_devices} devices, have {len(devs)} "
+                f"(tests virtualize with xla_force_host_platform_device_count)"
+            )
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (AXIS,))
+
+
+def replicate(mesh: Mesh):
+    return NamedSharding(mesh, P())
+
+
+def _row_sharding(mesh: Mesh, ndim: int) -> NamedSharding:
+    return NamedSharding(mesh, P(AXIS, *([None] * (ndim - 1))))
+
+
+def shard_state(state, mesh: Mesh):
+    """Place a SimState on the mesh: host-indexed arrays shard over their
+    leading axis, scalars replicate.
+
+    Every pool/host/subs leaf is [H]- or [C]-leading (the engine's SoA
+    contract), so the rule is uniform; counters and clocks replicate.
+    """
+    repl = replicate(mesh)
+
+    def row(x):
+        x = jax.numpy.asarray(x)
+        return jax.device_put(x, _row_sharding(mesh, x.ndim))
+
+    pool = jax.tree.map(row, state.pool)
+    host = jax.tree.map(row, state.host)
+    subs = jax.tree.map(row, state.subs)
+    return state.replace(
+        pool=pool,
+        host=host,
+        subs=subs,
+        rng_keys=row(state.rng_keys),
+        now=jax.device_put(state.now, repl),
+        xmit_min=jax.device_put(state.xmit_min, repl),
+        counters=jax.tree.map(lambda x: jax.device_put(x, repl), state.counters),
+    )
+
+
+def shard_params(params, mesh: Mesh):
+    """Baked topology matrices + scalars replicate (they are read-only and
+    small relative to state; sharding them would turn every latency lookup
+    into a collective)."""
+    repl = replicate(mesh)
+    return jax.tree.map(lambda x: jax.device_put(x, repl), params)
+
+
+def shard_sim(sim, mesh: Mesh):
+    """Shard a built Simulation's state/params in place and return it.
+
+    The jitted window kernels are sharding-oblivious: GSPMD propagates the
+    input shardings and inserts the cross-shard event exchange + min-time
+    reduction. Host counts should divide the mesh size for an even split.
+    """
+    sim.state = shard_state(sim.state, mesh)
+    sim.params = shard_params(sim.params, mesh)
+    return sim

@@ -58,6 +58,8 @@ def build_process_driver(
     )
     driver.dns = dns
     driver.bootstrap_end = cfg.general.bootstrap_end_time
+    driver.cpu_ns_per_syscall = cfg.experimental.cpu_ns_per_syscall
+    driver.cpu_threshold_ns = cfg.experimental.max_unapplied_cpu_latency
 
     ip_to_vertex: dict[int, int] = {}
     for i, h in enumerate(hosts):

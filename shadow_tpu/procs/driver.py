@@ -369,6 +369,9 @@ class SimHost:
     # deterministic per-host random stream (getrandom; reference: per-host
     # nodeSeed from the controller's master RNG, random.c:15-51)
     rand: random.Random = field(default_factory=random.Random)
+    # CPU model (host/cpu.c): simulated processing time not yet applied to
+    # the virtual clock
+    cpu_unapplied: int = 0
 
 
 def ip_from_str(s: str) -> int:
@@ -435,6 +438,12 @@ class ProcessDriver:
         self._reliability_fn: Callable[[int, int], float] | None = None
         self.bootstrap_end = 0  # sim ns: no drops before this (worker.c:536)
         self.dns = None  # optional routing.dns.Dns for name resolution
+        # CPU model (host/cpu.c analog): each serviced syscall costs the
+        # host simulated processing time; once the accumulated delay
+        # exceeds the threshold, the process's next completion is deferred
+        # by it on the virtual clock (event.c:64-92 delay-blocking analog).
+        self.cpu_ns_per_syscall = 0  # 0 = model off
+        self.cpu_threshold_ns = 1_000
         # heartbeat (manager.c:515-541 analog): period ns + callback(driver)
         self.heartbeat_interval: int | None = None
         self.heartbeat_fn: Callable[["ProcessDriver"], None] | None = None
@@ -799,7 +808,24 @@ class ProcessDriver:
         self.counters["syscalls"] += 1
         self.syscall_counts[sysno] = self.syscall_counts.get(sysno, 0) + 1
 
+        if self.cpu_ns_per_syscall:
+            proc.host.cpu_unapplied += self.cpu_ns_per_syscall
+
         def done(ret: int, data: bytes = b"") -> None:
+            host = proc.host
+            if self.cpu_ns_per_syscall and (
+                host.cpu_unapplied > self.cpu_threshold_ns
+            ):
+                # apply the accumulated CPU delay: defer this completion on
+                # the virtual clock (the process "computes" meanwhile)
+                delay = host.cpu_unapplied
+                host.cpu_unapplied = 0
+                proc.state = ManagedProcess.PARKED
+                self._schedule(
+                    self.now + delay,
+                    lambda: self._resume(proc, ret, data=data),
+                )
+                return
             ch.reply(ret, sim_time_ns=self.now, data=data)
 
         def park(pk: Parked) -> None:

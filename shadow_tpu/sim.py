@@ -137,6 +137,7 @@ def build_simulation(source) -> Simulation:
             sockets_per_host=cfg.experimental.sockets_per_host,
             router_queue_slots=cfg.experimental.router_queue_slots,
             with_tcp=(name == "tcp_bulk"),
+            qdisc=cfg.experimental.interface_qdisc,
         )
         interval = units.parse_time_ns(
             client_opts.get("interval", "100 ms"), default_unit="ms"

@@ -155,3 +155,36 @@ hosts:
     assert all(int(x) == 0 for x in t["rx_packets"][:3])
     assert int(t["tx_packets"][3]) == 0
     assert t["rx_bytes"][3] > 0
+
+
+def test_parse_sim_log_tool():
+    """tools/parse_sim_log.py digests logger output into structured JSON
+    (reference analog: src/tools/parse-shadow.py)."""
+    import importlib.util
+    import pathlib
+
+    spec = importlib.util.spec_from_file_location(
+        "parse_sim_log",
+        pathlib.Path(__file__).parent.parent / "tools" / "parse_sim_log.py",
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    lines = [
+        "heartbeat: sim 2.000s, 53 syscalls, 4 packets, wall 0.2s",
+        "00:00:01.324576 00:00:02.000000 [debug] [client] tracker: "
+        "tx 2 pkts / 12 B, rx 3 pkts / 14 B, 1 dropped",
+        "00:00:01.324824 00:00:02.100000 [debug] [client] process client.0 "
+        "exited with 0",
+        "00:00:00.725606 00:00:02.100000 [debug] syscall counts: read:8 "
+        "resolve_name:1",
+        "00:00:00.8 00:00:02.2 [warning] [srv] something odd",
+    ]
+    doc = mod.parse(lines)
+    assert doc["heartbeats"] == [{"sim_s": 2.0, "count": 53}]
+    t = doc["trackers"]["client"][0]
+    assert (t["tx_packets"], t["rx_packets"], t["dropped_packets"]) == (2, 3, 1)
+    assert t["sim_s"] == 2.0
+    assert doc["process_exits"][0]["exit_code"] == 0
+    assert doc["syscall_counts"] == {"read": 8, "resolve_name": 1}
+    assert doc["warnings"][0]["level"] == "warning"

@@ -152,3 +152,30 @@ def test_fd_kit(apps):
     assert "fd kit done" in out
     # deterministic getrandom: identical across runs
     assert run_once().stdout == p.stdout
+
+
+def test_cpu_model_delays_virtual_clock(apps):
+    """CPU model (host/cpu.c analog): charging simulated processing time
+    per syscall stretches observed RTTs on the virtual clock, and stays
+    deterministic."""
+    def run(cpu_ns):
+        d = ProcessDriver(stop_time=30 * NS_PER_SEC, latency_ns=10_000_000)
+        d.cpu_ns_per_syscall = cpu_ns
+        d.cpu_threshold_ns = 1_000
+        hs = d.add_host("server", "11.0.0.1")
+        hc = d.add_host("client", "11.0.0.2")
+        d.add_process(hs, [apps["udp_echo_server"], "9000", "2"])
+        d.add_process(hc, [apps["udp_echo_client"], "server", "9000", "2"],
+                      start_time=NS_PER_SEC)
+        d.run()
+        assert d.procs[1].exit_code == 0, d.procs[1].stderr
+        out = d.procs[1].stdout.decode()
+        return [int(l.split()[1]) for l in out.splitlines()
+                if l.startswith("rtt")]
+
+    plain = run(0)
+    loaded = run(500_000)  # 0.5 ms of CPU per syscall
+    assert all(r == 2 * 10_000_000 for r in plain)
+    # CPU cost inflates the observed RTT beyond pure network latency
+    assert all(r > 2 * 10_000_000 for r in loaded), loaded
+    assert loaded == run(500_000)  # deterministic

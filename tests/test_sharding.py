@@ -7,44 +7,17 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from shadow_tpu.core import simtime
 from shadow_tpu.flagship import build_phold_flagship
+from shadow_tpu.parallel import host_mesh, shard_params, shard_state
 
 
 @pytest.fixture(scope="module")
 def mesh():
-    devs = jax.devices()
-    if len(devs) < 8:
+    if len(jax.devices()) < 8:
         pytest.skip("needs 8 virtual devices (conftest sets this up)")
-    return Mesh(np.array(devs[:8]), ("hosts",))
-
-
-def _shard_sim_state(sim, mesh):
-    shard = NamedSharding(mesh, P("hosts"))
-    shard2 = NamedSharding(mesh, P("hosts", None))
-    repl = NamedSharding(mesh, P())
-    put = jax.device_put
-    state = sim.state
-    pool = state.pool.replace(
-        time=put(state.pool.time, shard),
-        dst=put(state.pool.dst, shard),
-        src=put(state.pool.src, shard),
-        seq=put(state.pool.seq, shard),
-        kind=put(state.pool.kind, shard),
-        payload=put(state.pool.payload, shard2),
-    )
-    host = jax.tree.map(lambda x: put(x, shard), state.host)
-    subs = jax.tree.map(lambda x: put(x, shard), state.subs)
-    return state.replace(
-        pool=pool,
-        host=host,
-        rng_keys=put(state.rng_keys, shard2),
-        subs=subs,
-        now=put(state.now, repl),
-        counters=jax.tree.map(lambda x: put(x, repl), state.counters),
-    )
+    return host_mesh(8)
 
 
 def test_sharded_step_matches_single_device(mesh):
@@ -59,10 +32,8 @@ def test_sharded_step_matches_single_device(mesh):
     ref_state, ref_min = sim._step(sim.state, sim.params, ws, we)
     jax.block_until_ready(ref_min)
 
-    state = _shard_sim_state(sim, mesh)
-    params = jax.tree.map(
-        lambda x: jax.device_put(x, NamedSharding(mesh, P())), sim.params
-    )
+    state = shard_state(sim.state, mesh)
+    params = shard_params(sim.params, mesh)
     with mesh:
         out_state, out_min = sim._step(
             state, params, jnp.int64(ws), jnp.int64(we)

@@ -1,0 +1,104 @@
+#!/usr/bin/env python3
+"""Parse simulator log output into structured JSON (reference analog:
+src/tools/parse-shadow.py, which digests the reference's log format for
+plotting).
+
+Input: lines from the CLI's stderr log (SimLogger format,
+shadow_tpu/utils/log.py):
+
+    WALL SIM [level] [host] message
+
+plus `heartbeat: ...` progress lines and per-host `tracker: ...` lines.
+Output: one JSON document with heartbeats, per-host tracker series, and
+process exit records — feed it to your plotting tool of choice.
+
+Usage:  python -m shadow_tpu ... 2>&1 | python tools/parse_sim_log.py
+        python tools/parse_sim_log.py < sim.log > sim.json
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import sys
+
+_TS = r"(\d+:\d+:\d+\.\d+)"
+LOG_RE = re.compile(
+    rf"^{_TS} {_TS} \[(\w+)\](?: \[([^\]]+)\])? (.*)$"
+)
+HEARTBEAT_RE = re.compile(
+    r"heartbeat: sim ([\d.]+)s(?: / [\d.]+s)?, (\d+) (?:syscalls|events)"
+)
+TRACKER_RE = re.compile(
+    r"tracker: tx (\d+) pkts / (\d+) B, rx (\d+) pkts / (\d+) B, (\d+) dropped"
+)
+EXIT_RE = re.compile(r"process (\S+) exited with (\S+)")
+COUNTS_RE = re.compile(r"syscall counts: (.*)")
+
+
+def _ts_to_seconds(ts: str) -> float:
+    h, m, s = ts.split(":")
+    return int(h) * 3600 + int(m) * 60 + float(s)
+
+
+def parse(lines) -> dict:
+    out = {
+        "heartbeats": [],
+        "trackers": {},  # host -> [{sim_s, tx_packets, ...}]
+        "process_exits": [],
+        "syscall_counts": {},
+        "warnings": [],
+    }
+    for line in lines:
+        line = line.rstrip("\n")
+        m = LOG_RE.match(line)
+        if not m:
+            hb = HEARTBEAT_RE.search(line)
+            if hb:
+                out["heartbeats"].append(
+                    {"sim_s": float(hb.group(1)), "count": int(hb.group(2))}
+                )
+            continue
+        wall, sim, level, host, msg = m.groups()
+        rec_time = {"wall_s": _ts_to_seconds(wall), "sim_s": _ts_to_seconds(sim)}
+        tm = TRACKER_RE.match(msg)
+        if tm and host:
+            out["trackers"].setdefault(host, []).append(
+                {
+                    **rec_time,
+                    "tx_packets": int(tm.group(1)),
+                    "tx_bytes": int(tm.group(2)),
+                    "rx_packets": int(tm.group(3)),
+                    "rx_bytes": int(tm.group(4)),
+                    "dropped_packets": int(tm.group(5)),
+                }
+            )
+            continue
+        em = EXIT_RE.match(msg)
+        if em:
+            out["process_exits"].append(
+                {**rec_time, "process": em.group(1),
+                 "exit_code": None if em.group(2) == "None"
+                 else int(em.group(2))}
+            )
+            continue
+        cm = COUNTS_RE.match(msg)
+        if cm:
+            for part in cm.group(1).split():
+                name, _, count = part.rpartition(":")
+                out["syscall_counts"][name] = int(count)
+            continue
+        if level in ("warning", "error", "panic"):
+            out["warnings"].append({**rec_time, "level": level, "msg": msg})
+    return out
+
+
+def main() -> int:
+    doc = parse(sys.stdin)
+    json.dump(doc, sys.stdout, indent=1)
+    sys.stdout.write("\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
