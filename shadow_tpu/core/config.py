@@ -158,6 +158,9 @@ class ExperimentalOptions:
     # virtual clock once it exceeds max_unapplied_cpu_latency.
     cpu_ns_per_syscall: int = 0  # 0 = CPU model off
     max_unapplied_cpu_latency: int = units.parse_time_ns("1 us")
+    # CPU↔TPU seam: route managed-process UDP through the device-stepped
+    # network (procs/bridge.py). The BASELINE north-star path.
+    use_device_network: bool = False
 
     @classmethod
     def from_dict(cls, d: dict) -> "ExperimentalOptions":
@@ -179,6 +182,7 @@ class ExperimentalOptions:
             if name in d:
                 setattr(out, name, units.parse_bytes(d[name]))
         for name in (
+            "use_device_network",
             "socket_recv_autotune", "socket_send_autotune", "use_memory_manager",
             "use_seccomp", "use_syscall_counters", "use_object_counters",
         ):

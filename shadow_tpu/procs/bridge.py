@@ -1,0 +1,325 @@
+"""The CPU↔TPU seam: managed-process traffic through the device network.
+
+This is the BASELINE north star (SURVEY.md header): keep syscall-emulated
+host processes on the CPU, but lift the network hot path — NIC token
+buckets, CoDel router queues, port demux, latency/loss path model — onto
+the device engine, with the Router/Topology boundary as the handoff.
+
+Protocol (conservative, deadlock-free):
+
+- Managed sendto() calls append send records host-side; payload BYTES stay
+  in a host-side handle table — the device moves 12-word packet headers
+  only (W_HANDLE carries the claim ticket).
+- When every process is parked, the driver syncs: pending sends are
+  injected into the device event pool as KIND_PROC_SYSCALL events at their
+  send times, and the device steps conservative windows until the first
+  batch of deliveries lands (or its pool drains past the driver's next
+  local event). Delivered rows (time, addressing, handle) drain from a
+  per-host ring and become ordinary driver wakeups at their device-computed
+  delivery times.
+- Injections that land behind the device's completed window are processed
+  one window late with their true timestamps — the engine's documented
+  deferral semantics; their deliveries still land at t + latency ≥ the
+  next window, so causality holds (window length ≤ min path latency).
+
+Port binds/unbinds from syscalls update the device UDP socket table
+host-side between dispatches (bind is rare; the hot path stays compiled).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from shadow_tpu.core import simtime
+from shadow_tpu.core.engine import Simulation, _set_col
+from shadow_tpu.core.state import KIND_PROC_SYSCALL, NetParams
+from shadow_tpu.net import packet as pkt, udp
+from shadow_tpu.net.stack import NetStack
+
+NEVER = simtime.NEVER
+
+BRIDGE_SUB = "bridge"
+
+
+@dataclass
+class Delivery:
+    time: int
+    dst_host: int
+    src_host: int
+    src_port: int
+    dst_port: int
+    length: int
+    handle: int
+
+
+class DeviceNetBridge:
+    """Owns the device Simulation that carries managed-process datagrams."""
+
+    def __init__(
+        self,
+        *,
+        baked,
+        bw_up_bits,
+        bw_down_bits,
+        host_vertex,
+        seed: int,
+        stop_time: int,
+        bootstrap_end: int = 0,
+        sockets_per_host: int = 16,
+        event_capacity: int = 4096,
+        K: int = 16,
+        ring_slots: int | None = None,
+    ):
+        H = len(host_vertex)
+        if ring_slots is None:
+            # a window can deliver up to K datagrams per host
+            ring_slots = max(32, 2 * K)
+        self.H = H
+        self.S = sockets_per_host
+        self.R = ring_slots
+        stack = NetStack(
+            H,
+            jnp.asarray(bw_up_bits),
+            jnp.asarray(bw_down_bits),
+            sockets_per_host=sockets_per_host,
+            with_tcp=False,
+        )
+        self.stack = stack
+        stack.on_receive(self._on_recv)
+        handlers = dict(stack.handlers())
+        handlers[KIND_PROC_SYSCALL] = self._on_inject
+        subs = stack.init_subs()
+        subs[BRIDGE_SUB] = {
+            "time": jnp.full((H, ring_slots), NEVER, jnp.int64),
+            "src_host": jnp.zeros((H, ring_slots), jnp.int32),
+            "src_port": jnp.zeros((H, ring_slots), jnp.int32),
+            "dst_port": jnp.zeros((H, ring_slots), jnp.int32),
+            "length": jnp.zeros((H, ring_slots), jnp.int32),
+            "handle": jnp.zeros((H, ring_slots), jnp.int32),
+            "count": jnp.zeros((H,), jnp.int32),
+            "overflow": jnp.zeros((), jnp.int64),
+        }
+        params = NetParams(
+            latency_vv=jnp.asarray(baked.latency_vv),
+            reliability_vv=jnp.asarray(baked.reliability_vv),
+            bootstrap_end=jnp.int64(bootstrap_end),
+        )
+        self.sim = Simulation(
+            num_hosts=H,
+            handlers=handlers,
+            params=params,
+            host_vertex=np.asarray(host_vertex),
+            seed=seed,
+            stop_time=stop_time,
+            runahead=baked.min_latency_ns,
+            event_capacity=event_capacity,
+            K=K,
+            subs=subs,
+        )
+        self._pending: list[tuple] = []
+        self._handles: dict[int, bytes] = {}
+        self._next_handle = 1
+        self._port_slot: dict[tuple[int, int], int] = {}
+        self._inflight = 0  # injected minus delivered (drops reconciled
+        # when the device drains — see sync())
+        self._overflow_seen = 0
+
+    # ------------------------------------------------------------------
+    # device-side handlers
+    # ------------------------------------------------------------------
+
+    def _on_inject(self, state, ev, emitter, params):
+        """A managed send enters the device network: the event payload IS
+        the UDP packet row; the destination host rides in W_SEQ."""
+        dst = ev.payload[:, pkt.W_SEQ]
+        payload = ev.payload.at[:, pkt.W_SEQ].set(0)
+        return self.stack.udp_sendto(
+            state, emitter, ev.mask, ev.time, dst,
+            dst_port=0, src_port=0, size_bytes=0,
+            socket_slot=ev.payload[:, pkt.W_SOCKET],
+            payload=payload,
+        )
+
+    def _on_recv(self, state, found, slot, src, payload, emitter, now, params):
+        """A datagram reached a bound socket: record it in the delivered
+        ring for the CPU plane to drain."""
+        br = state.subs[BRIDGE_SUB]
+        cnt = br["count"]
+        fits = found & (cnt < self.R)
+        col = jnp.clip(cnt, 0, self.R - 1)
+        nowv = jnp.broadcast_to(now, cnt.shape).astype(jnp.int64)
+        new = {
+            "time": _set_col(br["time"], col, fits, nowv),
+            "src_host": _set_col(br["src_host"], col, fits, src.astype(jnp.int32)),
+            "src_port": _set_col(br["src_port"], col, fits,
+                                 payload[:, pkt.W_SRC_PORT]),
+            "dst_port": _set_col(br["dst_port"], col, fits,
+                                 payload[:, pkt.W_DST_PORT]),
+            "length": _set_col(br["length"], col, fits, payload[:, pkt.W_LEN]),
+            "handle": _set_col(br["handle"], col, fits,
+                               payload[:, pkt.W_HANDLE]),
+            "count": cnt + fits.astype(jnp.int32),
+            "overflow": br["overflow"]
+            + jnp.sum(found & ~fits, dtype=jnp.int64),
+        }
+        return state.with_sub(BRIDGE_SUB, new)
+
+    # ------------------------------------------------------------------
+    # host-side API (called by ProcessDriver)
+    # ------------------------------------------------------------------
+
+    def bind(self, host: int, port: int) -> bool:
+        """Bind (host, port) in the device socket table (host-side array
+        update; runs between device dispatches)."""
+        if (host, port) in self._port_slot:
+            return True
+        used = np.asarray(jax.device_get(self.sim.state.subs[udp.SUB].used[host]))
+        free = np.where(~used)[0]
+        if len(free) == 0:
+            return False
+        slot = int(free[0])
+        self._port_slot[(host, port)] = slot
+        self.sim.state = self.sim.state.with_sub(
+            udp.SUB,
+            udp.bind_static(self.sim.state.subs[udp.SUB], host, slot, port),
+        )
+        return True
+
+    def unbind(self, host: int, port: int) -> None:
+        slot = self._port_slot.pop((host, port), None)
+        if slot is None:
+            return
+        u = self.sim.state.subs[udp.SUB]
+        self.sim.state = self.sim.state.with_sub(
+            udp.SUB, u.replace(used=u.used.at[host, slot].set(False))
+        )
+
+    def send(self, t: int, src_host: int, dst_host: int, src_port: int,
+             dst_port: int, data: bytes) -> None:
+        handle = self._next_handle
+        self._next_handle += 1
+        self._handles[handle] = data
+        self._inflight += 1
+        self._pending.append(
+            (t, src_host, dst_host, src_port, dst_port, len(data), handle)
+        )
+
+    def take_payload(self, handle: int) -> bytes:
+        return self._handles.pop(handle, b"")
+
+    def _inject_pending(self) -> None:
+        if not self._pending:
+            return
+        rows = self._pending
+        self._pending = []
+        pool = self.sim.state.pool
+        time_np = np.asarray(jax.device_get(pool.time))
+        free = np.where(time_np == NEVER)[0]
+        if len(free) < len(rows):
+            raise RuntimeError(
+                "bridge event pool full (raise event_capacity)"
+            )
+        idx = jnp.asarray(free[: len(rows)], jnp.int32)
+        t = jnp.asarray([r[0] for r in rows], jnp.int64)
+        src = jnp.asarray([r[1] for r in rows], jnp.int32)
+        payload_rows = np.zeros((len(rows), pkt.PAYLOAD_WORDS), np.int32)
+        for i, (_, s, d, sp, dp, ln, h) in enumerate(rows):
+            payload_rows[i, pkt.W_PROTO] = pkt.PROTO_UDP
+            payload_rows[i, pkt.W_SRC_PORT] = sp
+            payload_rows[i, pkt.W_DST_PORT] = dp
+            payload_rows[i, pkt.W_LEN] = ln
+            payload_rows[i, pkt.W_SRC_HOST] = s
+            payload_rows[i, pkt.W_SOCKET] = self._port_slot.get((s, sp), 0)
+            payload_rows[i, pkt.W_SEQ] = d  # dst host rides in the seq word
+            payload_rows[i, pkt.W_HANDLE] = h
+        seq0 = self.sim.state.host.seq_next  # per-src sequence numbers
+        seqs = []
+        seq_np = np.array(jax.device_get(seq0))  # writable copy
+        for (_, s, *_rest) in rows:
+            seqs.append(int(seq_np[s]))
+            seq_np[s] += 1
+        self.sim.state = self.sim.state.replace(
+            pool=pool.replace(
+                time=pool.time.at[idx].set(t),
+                dst=pool.dst.at[idx].set(src),  # inject AT the sender
+                src=pool.src.at[idx].set(src),
+                seq=pool.seq.at[idx].set(jnp.asarray(seqs, jnp.int32)),
+                kind=pool.kind.at[idx].set(KIND_PROC_SYSCALL),
+                payload=pool.payload.at[idx].set(jnp.asarray(payload_rows)),
+            ),
+            host=self.sim.state.host.replace(
+                seq_next=jnp.asarray(seq_np)
+            ),
+        )
+
+    def _drain_ring(self) -> list[Delivery]:
+        br = jax.device_get(self.sim.state.subs[BRIDGE_SUB])
+        counts = np.asarray(br["count"])
+        if not counts.any():
+            return []
+        out = []
+        for h in np.where(counts > 0)[0]:
+            for c in range(counts[h]):
+                out.append(Delivery(
+                    time=int(br["time"][h, c]),
+                    dst_host=int(h),
+                    src_host=int(br["src_host"][h, c]),
+                    src_port=int(br["src_port"][h, c]),
+                    dst_port=int(br["dst_port"][h, c]),
+                    length=int(br["length"][h, c]),
+                    handle=int(br["handle"][h, c]),
+                ))
+        H, R = self.H, self.R
+        reset = {
+            **{k: self.sim.state.subs[BRIDGE_SUB][k] for k in br},
+            "time": jnp.full((H, R), NEVER, jnp.int64),
+            "count": jnp.zeros((H,), jnp.int32),
+        }
+        self.sim.state = self.sim.state.with_sub(BRIDGE_SUB, reset)
+        self._inflight = max(0, self._inflight - len(out))
+        overflow = int(np.asarray(br["overflow"]))
+        if overflow > self._overflow_seen:
+            from shadow_tpu.utils import log
+
+            log.logger.warning(
+                "device delivery ring overflowed %d datagram(s); raise the "
+                "bridge ring_slots / lower events_per_host_per_window",
+                overflow - self._overflow_seen,
+            )
+            self._overflow_seen = overflow
+        out.sort(key=lambda d: (d.time, d.dst_host, d.src_host, d.handle))
+        return out
+
+    def sync(self, horizon: int) -> list[Delivery]:
+        """Flush pending sends and advance the device until the first
+        deliveries land or its pool drains up to `horizon`. Returns the
+        deliveries (possibly empty)."""
+        if not self._pending and self._inflight == 0:
+            return []  # nothing injected and nothing in flight: no sync
+        self._inject_pending()
+        dels = self._drain_ring()
+        if dels:
+            return dels
+        while True:
+            min_next = int(jnp.min(self.sim.state.pool.time))
+            if min_next >= NEVER:
+                # device fully drained: anything still unaccounted was
+                # dropped on-device (loss/CoDel/no-socket) — reclaim its
+                # payload bytes and the in-flight count
+                self._inflight = 0
+                self._handles.clear()
+                return []
+            if min_next >= min(horizon, self.sim.stop_time):
+                return []
+            ws = min_next
+            we = min(ws + self.sim.runahead, horizon, self.sim.stop_time)
+            self.sim.state, _ = self.sim._step(
+                self.sim.state, self.sim.params, ws, we
+            )
+            dels = self._drain_ring()
+            if dels:
+                return dels

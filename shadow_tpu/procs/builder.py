@@ -121,6 +121,35 @@ def build_process_driver(
 
     driver.set_latency_fn(latency_fn)
     driver.set_reliability_fn(reliability_fn)
+
+    if cfg.experimental.use_device_network:
+        # the CPU↔TPU seam: UDP rides the device-stepped network
+        import numpy as np
+
+        from shadow_tpu.procs.bridge import DeviceNetBridge
+
+        H = len(hosts)
+        bw_up = np.zeros(H, dtype=np.int64)
+        bw_down = np.zeros(H, dtype=np.int64)
+        for i, h in enumerate(hosts):
+            v = baked.host_vertex[i]
+            bw_up[i] = h.bandwidth_up or baked.vertex_bw_up_bits[v] or 10**9
+            bw_down[i] = (
+                h.bandwidth_down or baked.vertex_bw_down_bits[v] or 10**9
+            )
+        driver.bridge = DeviceNetBridge(
+            baked=baked,
+            bw_up_bits=bw_up,
+            bw_down_bits=bw_down,
+            host_vertex=baked.host_vertex,
+            seed=cfg.general.seed,
+            stop_time=cfg.general.stop_time,
+            bootstrap_end=cfg.general.bootstrap_end_time,
+            sockets_per_host=cfg.experimental.sockets_per_host,
+            event_capacity=cfg.experimental.event_capacity,
+            K=cfg.experimental.events_per_host_per_window,
+        )
+
     driver.config = cfg
     driver.topology = topo
     driver.baked = baked

@@ -361,6 +361,7 @@ class SimHost:
 
     name: str
     ip: int  # ipv4 host-order
+    index: int = 0  # device host id (registration order)
     procs: list = field(default_factory=list)
     next_port: int = 10000  # ephemeral port allocator (deterministic)
     # per-host byte/packet accounting (tracker.c:215-247 analog)
@@ -444,6 +445,9 @@ class ProcessDriver:
         # by it on the virtual clock (event.c:64-92 delay-blocking analog).
         self.cpu_ns_per_syscall = 0  # 0 = model off
         self.cpu_threshold_ns = 1_000
+        # CPU↔TPU seam (procs/bridge.py): when set, non-loopback UDP rides
+        # the device-stepped network (NIC/CoDel/latency/loss on device)
+        self.bridge = None
         # heartbeat (manager.c:515-541 analog): period ns + callback(driver)
         self.heartbeat_interval: int | None = None
         self.heartbeat_fn: Callable[["ProcessDriver"], None] | None = None
@@ -463,7 +467,11 @@ class ProcessDriver:
     # ------------------------------------------------------------------
 
     def add_host(self, name: str, ip: str | int) -> SimHost:
-        h = SimHost(name=name, ip=ip if isinstance(ip, int) else ip_from_str(ip))
+        h = SimHost(
+            name=name,
+            ip=ip if isinstance(ip, int) else ip_from_str(ip),
+            index=len(self.hosts),
+        )
         h.rand.seed(f"{self.seed}:{name}")
         self.hosts.append(h)
         self._hosts_by_ip[h.ip] = h
@@ -634,6 +642,8 @@ class ProcessDriver:
 
     def _resume(self, proc: ManagedProcess, ret: int, data: bytes = b"") -> None:
         """Post the reply for a previously-blocked syscall; proc runs again."""
+        if not proc.alive() or proc.channel is None:
+            return  # stopped/exited while the completion was in flight
         proc.channel.reply(ret, sim_time_ns=self.now, data=data)
         proc.state = ManagedProcess.RUNNING
 
@@ -798,6 +808,12 @@ class ProcessDriver:
             sock.bound = (proc.host.ip, port)
             binds = self._udp_binds if sock.proto == SOCK_DGRAM else self._tcp_binds
             binds[sock.bound] = sock
+            if self.bridge is not None and sock.proto == SOCK_DGRAM:
+                if not self.bridge.bind(proc.host.index, port):
+                    raise DriverError(
+                        f"{proc.host.name}: device UDP socket table full "
+                        f"(raise experimental.sockets_per_host)"
+                    )
 
     def _dispatch(self, proc: ManagedProcess) -> None:
         """Handle one MSG_SYSCALL from proc. Either replies (proc keeps
@@ -869,6 +885,12 @@ class ProcessDriver:
             if (ip, port) in binds:
                 done(-errno.EADDRINUSE)
                 return
+            if self.bridge is not None and sock.proto == SOCK_DGRAM:
+                if not self.bridge.bind(proc.host.index, port):
+                    # device socket table full: refuse loudly rather than
+                    # silently blackholing inbound traffic
+                    done(-errno.ENOBUFS)
+                    return
             sock.bound = (ip, port)
             binds[(ip, port)] = sock
             done(0)
@@ -1251,6 +1273,22 @@ class ProcessDriver:
             src = sock.bound
             self.counters["packets_sent"] += 1
             self.counters["bytes_sent"] += len(payload)
+            dst_host = self._host_by_ip(dst[0])
+            if (
+                self.bridge is not None
+                and dst[0] != proc.host.ip
+                and dst_host is not None
+            ):
+                # the device network carries it: NIC pacing, CoDel, path
+                # latency and loss all happen on-device (loopback and
+                # unknown destinations stay local)
+                self._track_tx(proc.host, "udp", src, dst, payload, False)
+                self.bridge.send(
+                    self.now, proc.host.index, dst_host.index,
+                    src[1], dst[1], bytes(payload),
+                )
+                ch.reply(len(payload), sim_time_ns=self.now)
+                return
             dropped = self._drop_roll(
                 proc.host.ip, dst[0], control=len(payload) == 0
             )
@@ -1381,6 +1419,8 @@ class ProcessDriver:
                 )
                 if binds.get(obj.bound) is obj:
                     del binds[obj.bound]
+                    if self.bridge is not None and obj.proto == SOCK_DGRAM:
+                        self.bridge.unbind(obj.owner.host.index, obj.bound[1])
             if obj.conn is not None:
                 self._send_eof(obj.owner, obj)
         elif isinstance(obj, PipeEnd):
@@ -1500,7 +1540,21 @@ class ProcessDriver:
                         if not self._service_one(p):
                             break
 
-            # 2. all quiescent: advance to the next event
+            # 2. all quiescent: let the device network advance first — its
+            # deliveries may precede our next local event (the CPU↔TPU sync
+            # point; reference analog: the round barrier)
+            if self.bridge is not None:
+                horizon = self._heap[0][0] if self._heap else self.stop_time
+                for d in self.bridge.sync(horizon):
+                    data = self.bridge.take_payload(d.handle)
+                    src_addr = (self.hosts[d.src_host].ip, d.src_port)
+                    dst_addr = (self.hosts[d.dst_host].ip, d.dst_port)
+                    self._schedule(
+                        d.time,
+                        lambda s=src_addr, a=dst_addr, dt=data:
+                        self._deliver_dgram(s, a, dt),
+                    )
+
             if not self._heap:
                 break
             t, _, cb = heapq.heappop(self._heap)

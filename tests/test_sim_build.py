@@ -54,3 +54,20 @@ def test_graft_dryrun_multichip():
     import __graft_entry__ as g
 
     g.dryrun_multichip(8)
+
+
+def test_device_plane_deterministic():
+    """determinism1 analog for the device plane: two identical runs produce
+    bit-identical final state (SURVEY §4 flagship property)."""
+    import jax
+    import numpy as np
+
+    def run_once():
+        sim = build_simulation(PHOLD_YAML)
+        sim.run()
+        return jax.device_get((sim.state.pool, sim.state.host,
+                               sim.state.counters, sim.state.subs))
+
+    a, b = run_once(), run_once()
+    for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        assert np.array_equal(np.asarray(la), np.asarray(lb))
