@@ -161,6 +161,10 @@ class ExperimentalOptions:
     # CPU↔TPU seam: route managed-process UDP through the device-stepped
     # network (procs/bridge.py). The BASELINE north-star path.
     use_device_network: bool = False
+    # Also carry managed TCP connections on the device TCP state machine
+    # (net/tcp.py): handshake, Reno, retransmission and delivery timing all
+    # computed by the window kernel. Requires use_device_network.
+    use_device_tcp: bool = False
 
     @classmethod
     def from_dict(cls, d: dict) -> "ExperimentalOptions":
@@ -182,12 +186,16 @@ class ExperimentalOptions:
             if name in d:
                 setattr(out, name, units.parse_bytes(d[name]))
         for name in (
-            "use_device_network",
+            "use_device_network", "use_device_tcp",
             "socket_recv_autotune", "socket_send_autotune", "use_memory_manager",
             "use_seccomp", "use_syscall_counters", "use_object_counters",
         ):
             if name in d:
                 setattr(out, name, bool(d[name]))
+        if out.use_device_tcp and not out.use_device_network:
+            raise ConfigError(
+                "experimental.use_device_tcp requires use_device_network"
+            )
         if d.get("cpu_ns_per_syscall") is not None:
             # bare numbers are NANOSECONDS here (the field name says so)
             out.cpu_ns_per_syscall = units.parse_time_ns(

@@ -49,6 +49,7 @@ class NetStack:
         nic_queue_slots: int = 64,
         tcp_ooo_chunks: int = tcp_mod.OOO_CHUNKS,
         with_tcp: bool = True,
+        tcp_child_base: int = 0,
         qdisc: str = "fifo",
     ):
         if qdisc not in ("fifo", "roundrobin"):
@@ -63,7 +64,8 @@ class NetStack:
         # otherwise run (masked) every micro-step and dominate both compile
         # time and per-iteration cost.
         self.tcp = (
-            tcp_mod.Tcp(num_hosts, sockets_per_host, tcp_ooo_chunks)
+            tcp_mod.Tcp(num_hosts, sockets_per_host, tcp_ooo_chunks,
+                        child_base=tcp_child_base)
             if with_tcp else None
         )
         if self.tcp is not None:

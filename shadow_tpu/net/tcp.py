@@ -332,14 +332,22 @@ class Tcp:
     KIND_TIMER = 102  # retransmit / timewait timer event
 
     def __init__(self, num_hosts: int, sockets_per_host: int = 8,
-                 ooo_chunks: int = OOO_CHUNKS):
+                 ooo_chunks: int = OOO_CHUNKS, child_base: int = 0):
+        """child_base partitions the slot space when an external (CPU) plane
+        allocates active-open slots: device-accepted children only use slots
+        >= child_base, so a pending host-side connect injection can never
+        collide with a device-side accept."""
         self.num_hosts = num_hosts
         self.sockets_per_host = sockets_per_host
         self.ooo_chunks = ooo_chunks
+        self.child_base = child_base
         self._init = init(num_hosts, sockets_per_host, ooo_chunks)
         self.established_hooks = []
         self.receive_hooks = []
         self.peer_fin_hooks = []
+        # (state, mask, slot, now, emitter, params) -> state
+        self.reset_hooks = []  # connection torn down by RST (incl. refused)
+        self.closed_hooks = []  # slot freed after orderly close/TIME_WAIT
 
     def attach(self, stack):
         self.stack = stack
@@ -360,6 +368,12 @@ class Tcp:
 
     def on_peer_fin(self, hook):
         self.peer_fin_hooks.append(hook)
+
+    def on_reset(self, hook):
+        self.reset_hooks.append(hook)
+
+    def on_closed(self, hook):
+        self.closed_hooks.append(hook)
 
     # ---- internal helpers ----
 
@@ -478,6 +492,15 @@ class Tcp:
             rtt_seq=_s(t.rtt_seq, m, slot, one32),
             rtt_start=_s(t.rtt_start, m, slot,
                          jnp.broadcast_to(now, (H,)).astype(jnp.int64)),
+            # a reused slot may carry stale timer state from a previous
+            # connection (e.g. TIME_WAIT expiry): disarm and invalidate
+            rtx_armed=_s(t.rtx_armed, m, slot, fb),
+            rtx_expire=_s(t.rtx_expire, m, slot,
+                          jnp.full((H,), simtime.NEVER, jnp.int64)),
+            gen=t.gen.at[
+                jnp.arange(H, dtype=jnp.int32),
+                jnp.where(m, slot, self.sockets_per_host),
+            ].add(1, mode="drop"),
             out_pending=_s(t.out_pending, m, slot, fb),
             bytes_acked=_s(t.bytes_acked, m, slot, jnp.zeros((H,), jnp.int64)),
             bytes_received=_s(t.bytes_received, m, slot,
@@ -567,9 +590,27 @@ class Tcp:
             + jnp.sum(mask & ~found, dtype=jnp.int64)
         )
 
+        # ---------- RST for segments matching no socket ----------
+        # (tcp.c replies RST to closed ports so active opens fail fast
+        # instead of retrying SYN into the void; never RST a RST)
+        no_sock = mask & ~found & ~has_rst
+        rst_seq = jnp.where(has_ack, seg_ack, z32)
+        rst_ack = (
+            seg_seq + seg_len
+            + has_syn.astype(jnp.int32) + has_fin.astype(jnp.int32)
+        )
+        state = state.with_sub(SUB, t)
+        state = self._tx_segment(
+            state, emitter, no_sock, now64, src, slot=jnp.zeros_like(slot),
+            length=0, flags=RST | ACK, seq=rst_seq, ack=rst_ack,
+            dst_port=sport, src_port=dport,
+        )
+        t = state.subs[SUB]
+
         # ---------- passive open: SYN to listener → child socket ----------
         m_syn = found & is_listener & has_syn & ~has_ack
-        free = ~t.used
+        slots_row = jnp.arange(t.used.shape[1], dtype=jnp.int32)[None, :]
+        free = ~t.used & (slots_row >= self.child_base)
         has_free = jnp.any(free, axis=1)
         child = jnp.argmax(free, axis=1).astype(jnp.int32)
         mc = m_syn & has_free
@@ -660,14 +701,22 @@ class Tcp:
         st = _g(t.state, slot)
         m_proc = m_conn & ~m_ss & (st >= SYN_RECEIVED)
 
-        # RST tears the connection down (tcp.c RST handling, simplified)
-        m_rst = m_proc & has_rst
+        # RST tears the connection down (tcp.c RST handling, simplified);
+        # a RST in SYN_SENT is connection-refused (reply to our SYN from a
+        # closed port) and must also tear down + notify.
+        m_rst = (
+            m_proc | (m_conn & ~m_ss & (st == SYN_SENT))
+        ) & has_rst
         t = t.replace(
             used=_s(t.used, m_rst, slot, fb),
             state=_s(t.state, m_rst, slot, z32),
             gen=t.gen.at[self._hosts(), jnp.where(m_rst, slot,
                          self.sockets_per_host)].add(1, mode="drop"),
         )
+        state = state.with_sub(SUB, t)
+        for hook in self.reset_hooks:
+            state = hook(state, m_rst, slot, now64, emitter, params)
+        t = state.subs[SUB]
         m_proc = m_proc & ~m_rst
 
         # retransmitted SYN to a SYN_RECEIVED child → re-send SYN+ACK
@@ -903,7 +952,9 @@ class Tcp:
             fin_rcvd=_s(t.fin_rcvd, consume, slot, fb),
         )
         m_tw_enter = m_tw_enter | (consume & (st3 == FIN_WAIT_2))
-        m_eof = consume & (st3 == ESTABLISHED)
+        # EOF surfaces to the app in every state that consumes a peer FIN —
+        # a half-closed endpoint (FIN_WAIT_*) still needs its EOF.
+        m_eof = consume
 
         # ---------- TIME_WAIT timer + socket free ----------
         self._emit_timer(
@@ -916,11 +967,18 @@ class Tcp:
             gen=t.gen.at[self._hosts(), jnp.where(m_free, slot,
                          self.sockets_per_host)].add(1, mode="drop"),
         )
+        state = state.with_sub(SUB, t)
+        for hook in self.closed_hooks:
+            state = hook(state, m_free, slot, now64, emitter, params)
+        t = state.subs[SUB]
 
         # ---------- ACK reply ----------
         # Reply to anything that consumed sequence space or was a
         # (re)transmitted SYN; never reply to a pure ACK (no ack loops).
-        need_ack = (m_proc & ((seg_len > 0) | has_fin)) | resyn
+        # A retransmitted SYN+ACK seen in ESTABLISHED means our handshake
+        # ACK was lost — re-ACK or the peer child stays in SYN_RECEIVED.
+        resynack = m_proc & has_syn & has_ack & (st == ESTABLISHED)
+        need_ack = (m_proc & ((seg_len > 0) | has_fin)) | resyn | resynack
         reply_flags = jnp.where(resyn, jnp.int32(SYN | ACK), jnp.int32(ACK))
         reply_seq = jnp.where(resyn, z32, _g(t.snd_nxt, slot))
         state = state.with_sub(SUB, t)
@@ -1044,6 +1102,10 @@ class Tcp:
             gen=t.gen.at[self._hosts(), jnp.where(m_tw, slot,
                          self.sockets_per_host)].add(1, mode="drop"),
         )
+        state = state.with_sub(SUB, t)
+        for hook in self.closed_hooks:
+            state = hook(state, m_tw, slot, now64, emitter, params)
+        t = state.subs[SUB]
 
         # retransmit timer
         m_rtx = m & (tkind == TIMER_RTX)

@@ -148,6 +148,7 @@ def build_process_driver(
             sockets_per_host=cfg.experimental.sockets_per_host,
             event_capacity=cfg.experimental.event_capacity,
             K=cfg.experimental.events_per_host_per_window,
+            with_tcp=cfg.experimental.use_device_tcp,
         )
 
     driver.config = cfg

@@ -40,6 +40,13 @@ from typing import Callable
 
 from shadow_tpu.procs import build as build_mod
 from shadow_tpu.procs import ipc
+from shadow_tpu.procs.bridge import (
+    Delivery,
+    TcpBytes,
+    TcpClosed,
+    TcpEstablished,
+    TcpFin,
+)
 from shadow_tpu.utils import log
 
 NS_PER_SEC = 1_000_000_000
@@ -142,8 +149,10 @@ class Sock:
     dgrams: deque = field(default_factory=deque)
     # TCP
     listening: bool = False
-    accept_q: deque = field(default_factory=deque)  # Conn objects
+    accept_q: deque = field(default_factory=deque)  # Conn | BridgeEnd
     conn: "Conn | None" = None
+    bend: "BridgeEnd | None" = None  # device-carried TCP endpoint
+    dev_listen_slot: int | None = None  # device listener slot (bridge mode)
     connecting: bool = False
     conn_refused: bool = False
 
@@ -152,6 +161,8 @@ class Sock:
             return len(self.dgrams) > 0
         if self.listening:
             return len(self.accept_q) > 0
+        if self.bend is not None:
+            return len(self.bend.rx) > 0 or self.bend.rx_eof
         if self.conn is not None:
             return len(self.conn.rx) > 0 or self.conn.rx_eof
         return False
@@ -159,6 +170,8 @@ class Sock:
     def writable(self) -> bool:
         if self.proto == SOCK_DGRAM:
             return True
+        if self.bend is not None:
+            return self.bend.established and not self.bend.closed
         return self.conn is not None and self.conn.established
 
 
@@ -173,6 +186,32 @@ class Conn:
     remote_addr: tuple[int, int] | None = None
     local_addr: tuple[int, int] | None = None
     sock: "Sock | None" = None  # owning endpoint socket (None until accepted)
+
+
+@dataclass
+class BridgeEnd:
+    """One endpoint of a TCP connection carried by the device network.
+
+    The device TCP machine (net/tcp.py) moves sequence space; actual bytes
+    stay host-side: a sender appends to its `tx_queue`, and the receiver
+    claims the device-reported in-order advance from the PEER's tx_queue
+    (sound because TCP delivers in order by construction). Maps to the
+    reference's split between tcp.c seq/ack state and socket byte buffers.
+    """
+
+    host: "SimHost"
+    slot: int  # device socket slot on `host`
+    local_addr: tuple[int, int]
+    remote_addr: tuple[int, int]
+    sock: "Sock | None" = None  # None while un-accepted in the accept queue
+    peer: "BridgeEnd | None" = None
+    established: bool = False
+    rx: bytearray = field(default_factory=bytearray)
+    rx_eof: bool = False
+    tx_queue: bytearray = field(default_factory=bytearray)
+    closed: bool = False  # we injected a close (FIN) for this end
+    recycled: bool = False  # slot returned to the mirror (end is finished)
+    born_t: int = 0  # sim time this end claimed the slot (staleness guard)
 
 
 @dataclass
@@ -446,8 +485,14 @@ class ProcessDriver:
         self.cpu_ns_per_syscall = 0  # 0 = model off
         self.cpu_threshold_ns = 1_000
         # CPU↔TPU seam (procs/bridge.py): when set, non-loopback UDP rides
-        # the device-stepped network (NIC/CoDel/latency/loss on device)
+        # the device-stepped network (NIC/CoDel/latency/loss on device);
+        # with bridge.with_tcp, TCP connections ride the device TCP machine
         self.bridge = None
+        self._dev_tcp: dict[tuple[int, int], BridgeEnd] = {}
+        # connect-side ends awaiting their accept-side twin, keyed by
+        # (host index, local port) — the accept-side establishment event
+        # carries exactly that pair as (peer_host, peer_port)
+        self._tcp_pending_conn: dict[tuple[int, int], BridgeEnd] = {}
         # heartbeat (manager.c:515-541 analog): period ns + callback(driver)
         self.heartbeat_interval: int | None = None
         self.heartbeat_fn: Callable[["ProcessDriver"], None] | None = None
@@ -534,6 +579,9 @@ class ProcessDriver:
     def _host_by_ip(self, ip: int) -> SimHost | None:
         return self._hosts_by_ip.get(ip)
 
+    def _bridge_tcp(self) -> bool:
+        return self.bridge is not None and self.bridge.with_tcp
+
     def _host_by_name(self, name: str) -> SimHost | None:
         for h in self.hosts:
             if h.name == name:
@@ -560,6 +608,8 @@ class ProcessDriver:
             if obj.conn_refused:
                 rev |= POLLERR  # reported regardless of requested events
             if obj.conn is not None and obj.conn.rx_eof and not obj.conn.rx:
+                rev |= POLLHUP if (events & (POLLIN | POLLHUP)) else 0
+            if obj.bend is not None and obj.bend.rx_eof and not obj.bend.rx:
                 rev |= POLLHUP if (events & (POLLIN | POLLHUP)) else 0
         elif isinstance(obj, PipeEnd):
             if obj.is_read and obj.buf.write_closed and not obj.buf.data:
@@ -601,7 +651,10 @@ class ProcessDriver:
                 self._complete_accept(proc, sock, bool(pk.want & SOCK_NONBLOCK))
         elif pk.kind == "connect":
             sock = proc.fds.get(pk.fd)
-            if isinstance(sock, Sock) and sock.conn and sock.conn.established:
+            if isinstance(sock, Sock) and (
+                (sock.conn and sock.conn.established)
+                or (sock.bend and sock.bend.established)
+            ):
                 proc.parked = None
                 self._resume(proc, 0)
         elif pk.kind == "poll":
@@ -900,6 +953,13 @@ class ProcessDriver:
                 done(-errno.EBADF)
                 return
             self._ensure_bound(proc, sock)
+            if self._bridge_tcp() and sock.dev_listen_slot is None:
+                # install the device-side listener so remote SYNs demux
+                lslot = self.bridge.tcp_listen(proc.host.index, sock.bound[1])
+                if lslot is None:
+                    done(-errno.ENOBUFS)
+                    return
+                sock.dev_listen_slot = lslot
             sock.listening = True
             done(0)
         elif sysno == SYS_connect:
@@ -915,10 +975,45 @@ class ProcessDriver:
                 self._ensure_bound(proc, sock)
                 done(0)
                 return
-            if sock.conn is not None or sock.connecting:
+            if sock.conn is not None or sock.bend is not None or sock.connecting:
                 done(-errno.EISCONN)
                 return
             self._ensure_bound(proc, sock)
+            dst_sim = self._host_by_ip(ip)
+            if (
+                self._bridge_tcp()
+                and ip != proc.host.ip
+                and dst_sim is not None
+            ):
+                # the device TCP machine carries this connection: handshake,
+                # pacing, loss recovery and delivery timing all on-device
+                hidx = proc.host.index
+                slot = self.bridge.tcp_alloc_slot(hidx)
+                if slot is None:
+                    log.logger.warning(
+                        "%s: no free device TCP slot (listeners + "
+                        "connections in TIME_WAIT hold them); raise "
+                        "experimental.sockets_per_host", proc.host.name,
+                    )
+                    done(-errno.ENOBUFS)
+                    return
+                end = BridgeEnd(
+                    host=proc.host, slot=slot, sock=sock,
+                    local_addr=sock.bound, remote_addr=(ip, port),
+                    born_t=self.now,
+                )
+                sock.bend = end
+                sock.connecting = True
+                self._dev_tcp[(hidx, slot)] = end
+                self._tcp_pending_conn[(hidx, sock.bound[1])] = end
+                self.bridge.tcp_connect(
+                    self.now, hidx, slot, dst_sim.index, port, sock.bound[1]
+                )
+                if sock.nonblock:
+                    done(-errno.EINPROGRESS)
+                else:
+                    park(Parked(proc, "connect", fd=sock.fd))
+                return
             sock.conn = Conn(local_addr=sock.bound, remote_addr=(ip, port),
                              sock=sock)
             sock.connecting = True
@@ -980,8 +1075,11 @@ class ProcessDriver:
             done(newfd)
         elif sysno == SYS_shutdown:
             sock = proc.fds.get(a[0])
-            if isinstance(sock, Sock) and sock.conn is not None:
-                self._send_eof(proc, sock)
+            if isinstance(sock, Sock):
+                if sock.bend is not None:
+                    self._bridge_close_end(sock.bend)
+                elif sock.conn is not None:
+                    self._send_eof(proc, sock)
             done(0)
         # ---- data plane ----
         elif sysno == SYS_sendto:
@@ -992,7 +1090,7 @@ class ProcessDriver:
                 done(-errno.EBADF)
                 return
             if sock.proto == SOCK_STREAM and (
-                sock.listening or sock.conn is None
+                sock.listening or (sock.conn is None and sock.bend is None)
             ):
                 done(-errno.ENOTCONN)
                 return
@@ -1017,7 +1115,9 @@ class ProcessDriver:
                 done(-errno.EBADF)
                 return
             addr = None
-            if sock.conn is not None:
+            if sock.bend is not None:
+                addr = sock.bend.remote_addr
+            elif sock.conn is not None:
                 addr = sock.conn.remote_addr
             elif sock.peer is not None:
                 addr = sock.peer
@@ -1054,6 +1154,8 @@ class ProcessDriver:
                 n = 0
                 if sock.proto == SOCK_DGRAM and sock.dgrams:
                     n = len(sock.dgrams[0][2])
+                elif sock.bend is not None:
+                    n = len(sock.bend.rx)
                 elif sock.conn is not None:
                     n = len(sock.conn.rx)
                 done(n)
@@ -1128,7 +1230,9 @@ class ProcessDriver:
             if obj is None:
                 done(-errno.EBADF)
             elif isinstance(obj, Sock):
-                if obj.proto == SOCK_STREAM and (obj.listening or obj.conn is None):
+                if obj.proto == SOCK_STREAM and (
+                    obj.listening or (obj.conn is None and obj.bend is None)
+                ):
                     done(-errno.ENOTCONN)
                 elif obj.readable():
                     self._complete_recv(proc, obj, want, hdr=False)
@@ -1304,6 +1408,25 @@ class ProcessDriver:
                 )
             ch.reply(len(payload), sim_time_ns=self.now)
         else:
+            end = sock.bend
+            if end is not None:
+                # device-carried stream: bytes wait host-side; the device
+                # moves sequence space and reports in-order advances
+                if not end.established or end.closed:
+                    ch.reply(-errno.ENOTCONN, sim_time_ns=self.now)
+                    return
+                self.counters["packets_sent"] += 1
+                self.counters["bytes_sent"] += len(payload)
+                self._track_tx(
+                    proc.host, "tcp", end.local_addr, end.remote_addr,
+                    payload, dropped=False,
+                )
+                end.tx_queue += payload
+                self.bridge.tcp_send(
+                    self.now, proc.host.index, end.slot, len(payload)
+                )
+                ch.reply(len(payload), sim_time_ns=self.now)
+                return
             conn = sock.conn
             if conn is None or not conn.established:
                 ch.reply(-errno.ENOTCONN, sim_time_ns=self.now)
@@ -1336,6 +1459,14 @@ class ProcessDriver:
             data = data[:want]
             addr = src_ip.to_bytes(4, "little") + src_port.to_bytes(2, "little")
             self._resume(proc, len(data), data=(addr if hdr else b"") + data)
+        elif sock.bend is not None:
+            end = sock.bend
+            take = min(want, len(end.rx))
+            data = bytes(end.rx[:take])
+            del end.rx[:take]
+            ra = end.remote_addr
+            addr = ra[0].to_bytes(4, "little") + ra[1].to_bytes(2, "little")
+            self._resume(proc, take, data=(addr if hdr else b"") + data)
         else:
             conn = sock.conn
             take = min(want, len(conn.rx))
@@ -1369,8 +1500,12 @@ class ProcessDriver:
                          nonblock: bool = False) -> None:
         conn = listener.accept_q.popleft()
         fd = proc.alloc_fd()
-        child = Sock(fd=fd, proto=SOCK_STREAM, owner=proc,
-                     bound=conn.local_addr, conn=conn, nonblock=nonblock)
+        if isinstance(conn, BridgeEnd):
+            child = Sock(fd=fd, proto=SOCK_STREAM, owner=proc,
+                         bound=conn.local_addr, bend=conn, nonblock=nonblock)
+        else:
+            child = Sock(fd=fd, proto=SOCK_STREAM, owner=proc,
+                         bound=conn.local_addr, conn=conn, nonblock=nonblock)
         conn.sock = child
         proc.fds[fd] = child
         ra = conn.remote_addr or (0, 0)
@@ -1387,6 +1522,106 @@ class ProcessDriver:
             conn.remote_addr[0] if conn.remote_addr else proc.host.ip,
         )
         self._schedule(self.now + lat, lambda: self._deliver_eof(remote))
+
+    # ------------------------------------------------------------------
+    # device-carried TCP event handlers (bridge drain → driver wakeups)
+    # ------------------------------------------------------------------
+
+    def _bridge_close_end(self, end: BridgeEnd) -> None:
+        """Inject an app close (FIN after queued data) for a device end."""
+        if end.closed or end.recycled:
+            return
+        end.closed = True
+        self.bridge.tcp_close(self.now, end.host.index, end.slot)
+
+    def _recycle_end(self, end: BridgeEnd) -> None:
+        """The connection behind this end is finished on device: release
+        the slot for reuse and drop the CPU-side mappings (idempotent)."""
+        if end.recycled:
+            return
+        end.recycled = True
+        key = (end.host.index, end.slot)
+        self.bridge.tcp_release(*key)
+        if self._dev_tcp.get(key) is end:
+            del self._dev_tcp[key]
+        pkey = (end.host.index, end.local_addr[1])
+        if self._tcp_pending_conn.get(pkey) is end:
+            del self._tcp_pending_conn[pkey]
+
+    def _bridge_accepted(self, d, child: BridgeEnd) -> None:
+        """A device child reached ESTABLISHED: hand it to the listener."""
+        host = self.hosts[d.host]
+        listener = self._tcp_binds.get((host.ip, d.local_port))
+        if listener is not None and listener.listening:
+            listener.accept_q.append(child)
+            self._wake_sock_waiters(listener)
+        else:
+            # listener went away while the handshake was in flight:
+            # close the orphan so the peer sees EOF
+            self._bridge_close_end(child)
+
+    def _bridge_established(self, end: BridgeEnd | None) -> None:
+        """A connect-side device end reached ESTABLISHED."""
+        if end is None:
+            return
+        end.established = True
+        if end.sock is not None:
+            end.sock.connecting = False
+            self._wake_sock_waiters(end.sock)
+
+    def _bridge_bytes(self, d, end: BridgeEnd | None) -> None:
+        """In-order stream bytes arrived at a device end: claim them from
+        the peer's host-side tx queue (TCP delivers in order)."""
+        if end is None or end.peer is None:
+            # establishment row lost (ring overflow) or pairing failed —
+            # the sequence space is consumed on device, so these bytes are
+            # unrecoverable: make it loud
+            log.logger.error(
+                "device TCP advance for host %d slot %d has no paired "
+                "endpoint; %d stream byte(s) lost (raise bridge ring_slots)",
+                d.host, d.slot, d.nbytes,
+            )
+            return
+        n = min(d.nbytes, len(end.peer.tx_queue))
+        data = bytes(end.peer.tx_queue[:n])
+        del end.peer.tx_queue[:n]
+        end.rx += data
+        self._track_rx(
+            end.local_addr[0], "tcp", end.remote_addr, end.local_addr, data
+        )
+        if end.sock is not None:
+            self._wake_sock_waiters(end.sock)
+        # un-accepted child: bytes buffer silently until accept() wraps it
+
+    def _bridge_fin(self, end: BridgeEnd | None) -> None:
+        if end is None:
+            return
+        end.rx_eof = True
+        if end.sock is not None:
+            self._wake_sock_waiters(end.sock)
+
+    def _bridge_closed(self, d, end: BridgeEnd | None) -> None:
+        """The device freed (host, slot): orderly close completion, or a
+        RST/refused teardown (d.reset) that must error the app side."""
+        if end is None or not d.reset:
+            return
+        end.rx_eof = True
+        sock = end.sock
+        if sock is None:
+            return
+        if not end.established:
+            sock.conn_refused = True  # connect() failed: RST to our SYN
+        p = sock.owner
+        if (
+            p.state == ManagedProcess.PARKED
+            and p.parked is not None
+            and p.parked.kind == "connect"
+            and p.parked.fd == sock.fd
+        ):
+            p.parked = None
+            self._resume(p, -errno.ECONNREFUSED)
+        else:
+            self._wake_sock_waiters(sock)
 
     def _timerfd_remaining(self, tf: TimerFd) -> bytes:
         """Pack (remaining_ns, interval_ns) as the gettime/settime-old reply."""
@@ -1421,7 +1656,14 @@ class ProcessDriver:
                     del binds[obj.bound]
                     if self.bridge is not None and obj.proto == SOCK_DGRAM:
                         self.bridge.unbind(obj.owner.host.index, obj.bound[1])
-            if obj.conn is not None:
+            if obj.dev_listen_slot is not None:
+                self.bridge.tcp_unlisten(
+                    obj.owner.host.index, obj.dev_listen_slot
+                )
+                obj.dev_listen_slot = None
+            if obj.bend is not None:
+                self._bridge_close_end(obj.bend)
+            elif obj.conn is not None:
                 self._send_eof(obj.owner, obj)
         elif isinstance(obj, PipeEnd):
             if obj.is_read:
@@ -1545,15 +1787,74 @@ class ProcessDriver:
             # point; reference analog: the round barrier)
             if self.bridge is not None:
                 horizon = self._heap[0][0] if self._heap else self.stop_time
+                # Endpoint-map bookkeeping happens HERE, in device-event
+                # order, so a freed-and-reused (host, slot) key can never
+                # cross-wire events of the old and new connection; only the
+                # app-visible effects are deferred to the events' times.
                 for d in self.bridge.sync(horizon):
-                    data = self.bridge.take_payload(d.handle)
-                    src_addr = (self.hosts[d.src_host].ip, d.src_port)
-                    dst_addr = (self.hosts[d.dst_host].ip, d.dst_port)
-                    self._schedule(
-                        d.time,
-                        lambda s=src_addr, a=dst_addr, dt=data:
-                        self._deliver_dgram(s, a, dt),
-                    )
+                    if isinstance(d, Delivery):
+                        data = self.bridge.take_payload(d.handle)
+                        src_addr = (self.hosts[d.src_host].ip, d.src_port)
+                        dst_addr = (self.hosts[d.dst_host].ip, d.dst_port)
+                        self._schedule(
+                            d.time,
+                            lambda s=src_addr, a=dst_addr, dt=data:
+                            self._deliver_dgram(s, a, dt),
+                        )
+                    elif isinstance(d, TcpEstablished):
+                        if d.is_accept:
+                            host = self.hosts[d.host]
+                            child = BridgeEnd(
+                                host=host, slot=d.slot,
+                                local_addr=(host.ip, d.local_port),
+                                remote_addr=(
+                                    self.hosts[d.peer_host].ip, d.peer_port
+                                ),
+                                established=True,
+                            )
+                            self._dev_tcp[(d.host, d.slot)] = child
+                            mate = self._tcp_pending_conn.pop(
+                                (d.peer_host, d.peer_port), None
+                            )
+                            if mate is not None:
+                                child.peer = mate
+                                mate.peer = child
+                            self._schedule(
+                                d.time,
+                                lambda d=d, e=child:
+                                self._bridge_accepted(d, e),
+                            )
+                        else:
+                            end = self._dev_tcp.get((d.host, d.slot))
+                            self._schedule(
+                                d.time,
+                                lambda e=end: self._bridge_established(e),
+                            )
+                    elif isinstance(d, TcpBytes):
+                        end = self._dev_tcp.get((d.host, d.slot))
+                        self._schedule(
+                            d.time, lambda d=d, e=end: self._bridge_bytes(d, e)
+                        )
+                    elif isinstance(d, TcpFin):
+                        end = self._dev_tcp.get((d.host, d.slot))
+                        if d.time_wait and end is not None:
+                            # both FINs exchanged and acked: recycle now
+                            # rather than waiting out the 60 s device
+                            # TIME_WAIT timer (whose closed row, if it ever
+                            # fires pre-reuse, is de-duplicated by born_t)
+                            self._recycle_end(end)
+                        self._schedule(
+                            d.time, lambda e=end: self._bridge_fin(e)
+                        )
+                    elif isinstance(d, TcpClosed):
+                        end = self._dev_tcp.get((d.host, d.slot))
+                        if end is not None and d.time < end.born_t:
+                            end = None  # stale row for a prior occupant
+                        if end is not None:
+                            self._recycle_end(end)
+                        self._schedule(
+                            d.time, lambda d=d, e=end: self._bridge_closed(d, e)
+                        )
 
             if not self._heap:
                 break

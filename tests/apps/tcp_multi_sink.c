@@ -1,0 +1,45 @@
+/* Accepts <count> connections sequentially; reads each to EOF and closes.
+ * Usage: tcp_multi_sink <port> <count> */
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+int main(int argc, char** argv) {
+  int port = argc > 1 ? atoi(argv[1]) : 9001;
+  int count = argc > 2 ? atoi(argv[2]) : 6;
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) { perror("socket"); return 1; }
+  struct sockaddr_in addr;
+  memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(port);
+  if (bind(fd, (struct sockaddr*)&addr, sizeof(addr)) != 0) {
+    perror("bind");
+    return 1;
+  }
+  if (listen(fd, 8) != 0) { perror("listen"); return 1; }
+  long long grand = 0;
+  for (int i = 0; i < count; i++) {
+    int cfd = accept(fd, NULL, NULL);
+    if (cfd < 0) { perror("accept"); return 1; }
+    char buf[8192];
+    long long total = 0;
+    for (;;) {
+      ssize_t n = recv(cfd, buf, sizeof(buf), 0);
+      if (n < 0) { perror("recv"); return 1; }
+      if (n == 0) break;
+      total += n;
+    }
+    close(cfd);
+    grand += total;
+    printf("conn %d received %lld\n", i, total);
+  }
+  printf("total %lld bytes over %d connections\n", grand, count);
+  close(fd);
+  return 0;
+}

@@ -1,0 +1,37 @@
+/* Connects to <server>:<port>; expects ECONNREFUSED; prints the result.
+ * Usage: tcp_refused <server> <port> */
+#include <arpa/inet.h>
+#include <errno.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+int main(int argc, char** argv) {
+  const char* server = argc > 1 ? argv[1] : "server";
+  const char* port = argc > 2 ? argv[2] : "9999";
+  struct addrinfo hints, *res = NULL;
+  memset(&hints, 0, sizeof(hints));
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  if (getaddrinfo(server, port, &hints, &res) != 0 || !res) {
+    fprintf(stderr, "resolve failed\n");
+    return 1;
+  }
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) { perror("socket"); return 1; }
+  int r = connect(fd, res->ai_addr, res->ai_addrlen);
+  if (r == 0) {
+    printf("connected\n");
+  } else if (errno == ECONNREFUSED) {
+    printf("refused\n");
+  } else {
+    printf("error %d\n", errno);
+  }
+  close(fd);
+  freeaddrinfo(res);
+  return 0;
+}

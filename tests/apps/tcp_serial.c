@@ -1,0 +1,55 @@
+/* Makes <count> sequential TCP connections, sending <bytes> on each and
+ * reading the peer's close before the next. Exercises connection slot
+ * recycling. Usage: tcp_serial <server> <port> <count> <bytes> */
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+int main(int argc, char** argv) {
+  const char* server = argc > 1 ? argv[1] : "server";
+  const char* port = argc > 2 ? argv[2] : "9001";
+  int count = argc > 3 ? atoi(argv[3]) : 6;
+  long long nbytes = argc > 4 ? atoll(argv[4]) : 4000;
+
+  struct addrinfo hints, *res = NULL;
+  memset(&hints, 0, sizeof(hints));
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  if (getaddrinfo(server, port, &hints, &res) != 0 || !res) {
+    fprintf(stderr, "resolve failed\n");
+    return 1;
+  }
+  char buf[4096];
+  memset(buf, 'y', sizeof(buf));
+  for (int i = 0; i < count; i++) {
+    int fd = socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) { perror("socket"); return 1; }
+    if (connect(fd, res->ai_addr, res->ai_addrlen) != 0) {
+      perror("connect");
+      return 1;
+    }
+    long long sent = 0;
+    while (sent < nbytes) {
+      size_t chunk = sizeof(buf);
+      if ((long long)chunk > nbytes - sent) chunk = (size_t)(nbytes - sent);
+      ssize_t n = send(fd, buf, chunk, 0);
+      if (n <= 0) { perror("send"); return 1; }
+      sent += n;
+    }
+    shutdown(fd, SHUT_WR);
+    /* wait for the peer to drain + close so the connection fully finishes
+     * (client enters TIME_WAIT) before the next round */
+    ssize_t n = recv(fd, buf, sizeof(buf), 0);
+    if (n != 0) { fprintf(stderr, "conn %d: expected EOF\n", i); return 1; }
+    close(fd);
+    printf("conn %d done\n", i);
+  }
+  printf("all %d connections done\n", count);
+  freeaddrinfo(res);
+  return 0;
+}

@@ -1,0 +1,178 @@
+"""TCP over the CPU↔TPU seam (procs/bridge.py + net/tcp.py): REAL processes
+carry TCP connections through the device TCP state machine — handshake,
+Reno congestion control, retransmission and delivery timing all computed by
+the window kernel; payload bytes stay host-side and are matched to
+device-reported in-order advances.
+"""
+
+import pytest
+
+from shadow_tpu.procs import build as build_mod
+from shadow_tpu.procs.builder import build_process_driver
+
+pytestmark = pytest.mark.skipif(
+    not build_mod.toolchain_available(), reason="no native toolchain"
+)
+
+NS_PER_MS = 1_000_000
+
+
+def _yaml(apps, lat_ms, loss=0.0, nbytes=65536, stop="60 s", seed=7):
+    return f"""
+general:
+  stop_time: {stop}
+  seed: {seed}
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        node [ id 0 bandwidth_down "100 Mbit" bandwidth_up "100 Mbit" ]
+        edge [ source 0 target 0 latency "{lat_ms} ms" packet_loss {loss} ]
+      ]
+experimental:
+  use_device_network: true
+  use_device_tcp: true
+  event_capacity: 4096
+  events_per_host_per_window: 8
+hosts:
+  server:
+    processes:
+      - path: {apps['tcp_sink']}
+        args: "9001"
+  client:
+    processes:
+      - path: {apps['tcp_source']}
+        args: server 9001 {nbytes}
+        start_time: 1 s
+"""
+
+
+def test_tcp_bulk_through_device_network(apps):
+    """A real tcp_source/tcp_sink pair moves a bulk stream through the
+    device TCP machine; every byte arrives, and the device carried the
+    segments (handshake + data are visible in device counters)."""
+    d = build_process_driver(_yaml(apps, lat_ms=20, nbytes=65536))
+    assert d.bridge is not None and d.bridge.with_tcp
+    d.run()
+    client, server = d.procs  # hosts are name-sorted: client before server
+    assert client.exit_code == 0, client.stderr
+    assert server.exit_code == 0, server.stderr
+    assert b"sent 65536 bytes" in client.stdout
+    assert b"received 65536 bytes" in server.stdout
+    c = d.bridge.sim.counters()
+    # >= 45 MSS-sized data segments plus handshake/teardown control
+    assert c["packets_delivered"] > 45
+    trk = d.host_trackers()
+    assert trk["server"]["rx_bytes"] == 65536
+
+
+def test_tcp_bridge_deterministic(apps):
+    """Byte-identical reruns with the device TCP machine in the loop."""
+    def run_once():
+        d = build_process_driver(_yaml(apps, lat_ms=10, nbytes=20000))
+        d.run()
+        return [p.stdout for p in d.procs]
+
+    assert run_once() == run_once()
+
+
+def test_tcp_bridge_lossy_stream_is_reliable(apps):
+    """With a lossy edge, device Reno retransmissions still deliver every
+    byte in order — loss shows up in device counters, not in the stream."""
+    # seed 42: seed 7's host-0 draw stream happens to contain no value
+    # above 0.85 in its first ~46 draws (a 1-in-1000 outlier), so it would
+    # see no drops at 15% loss
+    d = build_process_driver(
+        _yaml(apps, lat_ms=5, loss=0.15, nbytes=60000, stop="120 s", seed=42)
+    )
+    d.run()
+    client, server = d.procs
+    assert client.exit_code == 0, client.stderr
+    assert server.exit_code == 0, server.stderr
+    assert b"received 60000 bytes" in server.stdout
+    c = d.bridge.sim.counters()
+    assert c["packets_dropped_loss"] > 0
+    tcp = d.bridge.sim.state.subs["tcp"]
+    assert int(tcp.retransmits) > 0
+
+
+def test_tcp_bridge_connect_refused(apps):
+    """A connect to a port with no listener gets an on-device RST and the
+    managed process sees ECONNREFUSED (not a forever-parked connect)."""
+    yaml = f"""
+general:
+  stop_time: 30 s
+  seed: 5
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        node [ id 0 bandwidth_down "100 Mbit" bandwidth_up "100 Mbit" ]
+        edge [ source 0 target 0 latency "10 ms" ]
+      ]
+experimental:
+  use_device_network: true
+  use_device_tcp: true
+  event_capacity: 1024
+  events_per_host_per_window: 8
+hosts:
+  server:
+    processes:
+      - path: {apps['tcp_sink']}
+        args: "8000"
+  client:
+    processes:
+      - path: {apps['tcp_refused']}
+        args: server 9999
+        start_time: 1 s
+"""
+    d = build_process_driver(yaml)
+    d.run()
+    client = next(p for p in d.procs if "tcp_refused" in p.args[0])
+    assert client.exit_code == 0, client.stderr
+    assert b"refused" in client.stdout
+    # the mirror slot was recycled after the RST teardown
+    free = d.bridge._tcp_free[client.host.index]
+    assert len(free) == d.bridge.child_base
+
+
+def test_tcp_bridge_serial_connections_recycle_slots(apps):
+    """More sequential connections than CPU-owned slots (child_base=4 at
+    sockets_per_host=8): TIME_WAIT recycling must return slots early or the
+    5th connect would fail with ENOBUFS."""
+    yaml = f"""
+general:
+  stop_time: 120 s
+  seed: 11
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        node [ id 0 bandwidth_down "100 Mbit" bandwidth_up "100 Mbit" ]
+        edge [ source 0 target 0 latency "10 ms" ]
+      ]
+experimental:
+  use_device_network: true
+  use_device_tcp: true
+  event_capacity: 4096
+  events_per_host_per_window: 8
+hosts:
+  server:
+    processes:
+      - path: {apps['tcp_multi_sink']}
+        args: 9001 6
+  client:
+    processes:
+      - path: {apps['tcp_serial']}
+        args: server 9001 6 4000
+        start_time: 1 s
+"""
+    d = build_process_driver(yaml)
+    d.run()
+    client, server = d.procs
+    assert client.exit_code == 0, (client.stdout, client.stderr)
+    assert b"all 6 connections done" in client.stdout
+    assert b"total 24000 bytes over 6 connections" in server.stdout
