@@ -93,6 +93,7 @@ inline void sem_wait_spinning(sem_t* sem, long spin_max) {
 constexpr const char* ENV_SHM = "SHADOW_TPU_SHM";     // shm file name
 constexpr const char* ENV_SPIN = "SHADOW_TPU_SPIN";   // spin iterations
 constexpr const char* ENV_DEBUG = "SHADOW_TPU_SHIM_DEBUG";
+constexpr const char* ENV_SECCOMP = "SHADOW_TPU_SECCOMP";  // "0" disables
 
 // emulated fd space starts here; lower fds (stdio, real files the process
 // opens itself) stay native. The reference instead virtualizes the entire

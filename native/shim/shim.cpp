@@ -7,10 +7,18 @@
 // executes them against the device-stepped network and the simulated clock.
 //
 // Interposition model (differences from the reference, all deliberate):
-//   * libc-symbol interposition only (no seccomp/SIGSYS backstop and no
-//     ptrace mode yet): raw inline syscalls bypass the shim. Fine for the
-//     workload classes the framework targets first (sockets-and-time apps);
-//     the seccomp backstop is a planned hardening step.
+//   * libc-symbol interposition is the fast path; a seccomp/SIGSYS
+//     backstop (reference analog: shim.c:399-463 seccomp filter + SIGSYS
+//     trampoline) catches raw syscall instructions that bypass the PLT —
+//     statically-linked binaries, libc internals, inline `syscall(2)`.
+//     The BPF filter traps only the emulated syscall numbers and allows
+//     everything issued from the shim's own gate function, so shim-internal
+//     native calls never pay the signal round trip. Disable with
+//     SHADOW_TPU_SECCOMP=0.
+//     KNOWN LIMIT: a child exec'd by a managed process inherits the filter
+//     but not the SIGSYS handler, so it dies at its first trapped syscall
+//     (during ld.so startup) — loud failure rather than silent sim escape.
+//     Proper fork/exec support arrives with driver-side clone handling.
 //   * fd space is PARTITIONED: emulated sockets/epolls live at
 //     fd >= FD_BASE; anything below is passed through natively. Real-file
 //     IO therefore costs zero simulator traffic (the reference instead
@@ -28,7 +36,13 @@
 #include <arpa/inet.h>
 #include <errno.h>
 #include <fcntl.h>
+#include <linux/audit.h>
+#include <linux/filter.h>
+#include <linux/seccomp.h>
 #include <netdb.h>
+#include <signal.h>
+#include <sys/prctl.h>
+#include <ucontext.h>
 #include <netinet/in.h>
 #include <poll.h>
 #include <pthread.h>
@@ -47,12 +61,58 @@
 #include <sys/timerfd.h>
 #include <sys/types.h>
 #include <sys/uio.h>
+#include <sys/utsname.h>
 #include <time.h>
 #include <unistd.h>
 
 using namespace shadow_tpu;
 
+// ---------------------------------------------------------------------------
+// the syscall gate: the ONE code location the seccomp filter whitelists by
+// instruction pointer (reference analog: the shim's designated trampoline
+// that the BPF allows, shim.c seccomp install). Every native syscall the
+// shim itself makes goes through here, so shim-internal work never traps.
+// Raw kernel convention: returns -errno on failure.
+// ---------------------------------------------------------------------------
+
+extern "C" __attribute__((noinline, aligned(256), section(".shim_gate")))
+long shim_gate_syscall(long n, long a0, long a1, long a2, long a3, long a4,
+                       long a5) {
+#if defined(__x86_64__)
+  long ret;
+  register long r10 __asm__("r10") = a3;
+  register long r8 __asm__("r8") = a4;
+  register long r9 __asm__("r9") = a5;
+  __asm__ volatile("syscall"
+                   : "=a"(ret)
+                   : "0"(n), "D"(a0), "S"(a1), "d"(a2), "r"(r10), "r"(r8),
+                     "r"(r9)
+                   : "rcx", "r11", "memory");
+  return ret;
+#else
+  long r = ::syscall(n, a0, a1, a2, a3, a4, a5);
+  return r < 0 ? -(long)errno : r;
+#endif
+}
+
 namespace {
+
+// size of the IP window the BPF whitelists around shim_gate_syscall
+constexpr uint32_t GATE_WINDOW = 256;
+
+// libc-convention wrapper over the gate: errno + -1 on failure. Variadic
+// like syscall(2) so pointer args pass without explicit casts.
+template <typename... Args>
+long sys_native(long n, Args... args) {
+  long vals[] = {(long)(args)..., 0, 0, 0, 0, 0, 0};
+  long r = shim_gate_syscall(n, vals[0], vals[1], vals[2], vals[3], vals[4],
+                             vals[5]);
+  if (r < 0 && r > -4096) {
+    errno = (int)-r;
+    return -1;
+  }
+  return r;
+}
 
 Channel* g_ch = nullptr;
 long g_spin = 8192;
@@ -69,6 +129,8 @@ pthread_mutex_t g_lock = PTHREAD_MUTEX_INITIALIZER;
   } while (0)
 
 bool is_managed_fd(int fd) { return g_ch != nullptr && fd >= FD_BASE; }
+
+void shim_install_seccomp();  // defined at the bottom (needs the wrappers)
 
 // One request/response round trip. data_in/data_in_len ride to the driver;
 // the reply's inline data is copied to data_out (up to data_out_cap).
@@ -106,8 +168,8 @@ int64_t ipc_call(int64_t sysno, const int64_t args[6], const void* data_in,
     _exit((int)ret);
   }
   if (mtype == MSG_DO_NATIVE) {
-    return syscall((long)sysno, args[0], args[1], args[2], args[3], args[4],
-                   args[5]);
+    return sys_native((long)sysno, args[0], args[1], args[2], args[3],
+                      args[4], args[5]);
   }
   if (ret < 0) {
     errno = (int)-ret;
@@ -177,6 +239,8 @@ __attribute__((constructor)) void shim_init() {
   sem_post(&g_ch->to_driver);
   sem_wait_spinning(&g_ch->to_shim, g_spin);
   pthread_mutex_unlock(&g_lock);
+  const char* sec = getenv(ENV_SECCOMP);
+  if (!sec || strcmp(sec, "0") != 0) shim_install_seccomp();
 }
 
 }  // namespace
@@ -189,12 +253,12 @@ extern "C" {
 
 int socket(int domain, int type, int protocol) {
   if (!g_ch || domain != AF_INET)
-    return (int)syscall(SYS_socket, domain, type, protocol);
+    return (int)sys_native(SYS_socket, domain, type, protocol);
   return (int)ipc_call6(SYS_socket, domain, type, protocol);
 }
 
 int bind(int fd, const struct sockaddr* addr, socklen_t len) {
-  if (!is_managed_fd(fd)) return (int)syscall(SYS_bind, fd, addr, len);
+  if (!is_managed_fd(fd)) return (int)sys_native(SYS_bind, fd, addr, len);
   uint32_t ip = 0;
   uint16_t port = 0;
   if (!parse_inet(addr, len, &ip, &port)) {
@@ -205,12 +269,12 @@ int bind(int fd, const struct sockaddr* addr, socklen_t len) {
 }
 
 int listen(int fd, int backlog) {
-  if (!is_managed_fd(fd)) return (int)syscall(SYS_listen, fd, backlog);
+  if (!is_managed_fd(fd)) return (int)sys_native(SYS_listen, fd, backlog);
   return (int)ipc_call6(SYS_listen, fd, backlog);
 }
 
 int connect(int fd, const struct sockaddr* addr, socklen_t len) {
-  if (!is_managed_fd(fd)) return (int)syscall(SYS_connect, fd, addr, len);
+  if (!is_managed_fd(fd)) return (int)sys_native(SYS_connect, fd, addr, len);
   uint32_t ip = 0;
   uint16_t port = 0;
   if (!parse_inet(addr, len, &ip, &port)) {
@@ -222,7 +286,7 @@ int connect(int fd, const struct sockaddr* addr, socklen_t len) {
 
 int accept4(int fd, struct sockaddr* addr, socklen_t* alen, int flags) {
   if (!is_managed_fd(fd))
-    return (int)syscall(SYS_accept4, fd, addr, alen, flags);
+    return (int)sys_native(SYS_accept4, fd, addr, alen, flags);
   // reply data = [u32 peer_ip, u16 peer_port] packed in ret-adjacent words
   int64_t args[6] = {fd, flags, 0, 0, 0, 0};
   uint8_t out[8];
@@ -246,7 +310,7 @@ int accept(int fd, struct sockaddr* addr, socklen_t* alen) {
 ssize_t sendto(int fd, const void* buf, size_t n, int flags,
                const struct sockaddr* addr, socklen_t alen) {
   if (!is_managed_fd(fd))
-    return syscall(SYS_sendto, fd, buf, n, flags, addr, alen);
+    return sys_native(SYS_sendto, fd, buf, n, flags, addr, alen);
   uint32_t ip = 0;
   uint16_t port = 0;
   int has_addr = parse_inet(addr, alen, &ip, &port) ? 1 : 0;
@@ -257,14 +321,14 @@ ssize_t sendto(int fd, const void* buf, size_t n, int flags,
 }
 
 ssize_t send(int fd, const void* buf, size_t n, int flags) {
-  if (!is_managed_fd(fd)) return syscall(SYS_sendto, fd, buf, n, flags, 0, 0);
+  if (!is_managed_fd(fd)) return sys_native(SYS_sendto, fd, buf, n, flags, 0, 0);
   return sendto(fd, buf, n, flags, nullptr, 0);
 }
 
 ssize_t recvfrom(int fd, void* buf, size_t n, int flags,
                  struct sockaddr* addr, socklen_t* alen) {
   if (!is_managed_fd(fd))
-    return syscall(SYS_recvfrom, fd, buf, n, flags, addr, alen);
+    return sys_native(SYS_recvfrom, fd, buf, n, flags, addr, alen);
   size_t want = n > IPC_DATA_MAX ? IPC_DATA_MAX : n;
   int64_t args[6] = {fd, (int64_t)want, flags, addr ? 1 : 0, 0, 0};
   // reply: data = [u32 src_ip, u16 src_port, payload...]
@@ -288,12 +352,12 @@ ssize_t recvfrom(int fd, void* buf, size_t n, int flags,
 }
 
 ssize_t recv(int fd, void* buf, size_t n, int flags) {
-  if (!is_managed_fd(fd)) return syscall(SYS_recvfrom, fd, buf, n, flags, 0, 0);
+  if (!is_managed_fd(fd)) return sys_native(SYS_recvfrom, fd, buf, n, flags, 0, 0);
   return recvfrom(fd, buf, n, flags, nullptr, nullptr);
 }
 
 ssize_t read(int fd, void* buf, size_t n) {
-  if (!is_managed_fd(fd)) return syscall(SYS_read, fd, buf, n);
+  if (!is_managed_fd(fd)) return sys_native(SYS_read, fd, buf, n);
   // generic read (sockets, pipes, eventfds, timerfds); reply data = payload
   size_t want = n > IPC_DATA_MAX ? IPC_DATA_MAX : n;
   int64_t args[6] = {fd, (int64_t)want, 0, 0, 0, 0};
@@ -304,7 +368,7 @@ ssize_t read(int fd, void* buf, size_t n) {
 }
 
 ssize_t write(int fd, const void* buf, size_t n) {
-  if (!is_managed_fd(fd)) return syscall(SYS_write, fd, buf, n);
+  if (!is_managed_fd(fd)) return sys_native(SYS_write, fd, buf, n);
   if (n > IPC_DATA_MAX) n = IPC_DATA_MAX;  // caller loops for the rest
   int64_t args[6] = {fd, (int64_t)n, 0, 0, 0, 0};
   return (ssize_t)ipc_call(SYS_write, args, buf, (uint32_t)n, nullptr, 0,
@@ -312,7 +376,7 @@ ssize_t write(int fd, const void* buf, size_t n) {
 }
 
 ssize_t readv(int fd, const struct iovec* iov, int iovcnt) {
-  if (!is_managed_fd(fd)) return syscall(SYS_readv, fd, iov, iovcnt);
+  if (!is_managed_fd(fd)) return sys_native(SYS_readv, fd, iov, iovcnt);
   // gather into one bounded read, then scatter across the iovecs
   static thread_local uint8_t tmp[IPC_DATA_MAX];
   size_t want = 0;
@@ -331,7 +395,7 @@ ssize_t readv(int fd, const struct iovec* iov, int iovcnt) {
 }
 
 ssize_t writev(int fd, const struct iovec* iov, int iovcnt) {
-  if (!is_managed_fd(fd)) return syscall(SYS_writev, fd, iov, iovcnt);
+  if (!is_managed_fd(fd)) return sys_native(SYS_writev, fd, iov, iovcnt);
   static thread_local uint8_t tmp[IPC_DATA_MAX];
   size_t n = 0;
   for (int i = 0; i < iovcnt; i++) {
@@ -345,7 +409,7 @@ ssize_t writev(int fd, const struct iovec* iov, int iovcnt) {
 }
 
 ssize_t sendmsg(int fd, const struct msghdr* msg, int flags) {
-  if (!is_managed_fd(fd)) return syscall(SYS_sendmsg, fd, msg, flags);
+  if (!is_managed_fd(fd)) return sys_native(SYS_sendmsg, fd, msg, flags);
   static thread_local uint8_t tmp[IPC_DATA_MAX];
   size_t n = 0;
   for (size_t i = 0; i < msg->msg_iovlen; i++) {
@@ -360,7 +424,7 @@ ssize_t sendmsg(int fd, const struct msghdr* msg, int flags) {
 }
 
 ssize_t recvmsg(int fd, struct msghdr* msg, int flags) {
-  if (!is_managed_fd(fd)) return syscall(SYS_recvmsg, fd, msg, flags);
+  if (!is_managed_fd(fd)) return sys_native(SYS_recvmsg, fd, msg, flags);
   static thread_local uint8_t tmp[IPC_DATA_MAX];
   size_t want = 0;
   for (size_t i = 0; i < msg->msg_iovlen; i++) want += msg->msg_iov[i].iov_len;
@@ -384,27 +448,27 @@ ssize_t recvmsg(int fd, struct msghdr* msg, int flags) {
 }
 
 int close(int fd) {
-  if (!is_managed_fd(fd)) return (int)syscall(SYS_close, fd);
+  if (!is_managed_fd(fd)) return (int)sys_native(SYS_close, fd);
   return (int)ipc_call6(SYS_close, fd);
 }
 
 int dup(int fd) {
-  if (!is_managed_fd(fd)) return (int)syscall(SYS_dup, fd);
+  if (!is_managed_fd(fd)) return (int)sys_native(SYS_dup, fd);
   return (int)ipc_call6(SYS_dup, fd);
 }
 
 int dup2(int oldfd, int newfd) {
-  if (!is_managed_fd(oldfd)) return (int)syscall(SYS_dup2, oldfd, newfd);
+  if (!is_managed_fd(oldfd)) return (int)sys_native(SYS_dup2, oldfd, newfd);
   return (int)ipc_call6(SYS_dup2, oldfd, newfd);
 }
 
 int dup3(int oldfd, int newfd, int flags) {
-  if (!is_managed_fd(oldfd)) return (int)syscall(SYS_dup3, oldfd, newfd, flags);
+  if (!is_managed_fd(oldfd)) return (int)sys_native(SYS_dup3, oldfd, newfd, flags);
   return (int)ipc_call6(SYS_dup3, oldfd, newfd, flags);
 }
 
 int pipe2(int fds[2], int flags) {
-  if (!g_ch) return (int)syscall(SYS_pipe2, fds, flags);
+  if (!g_ch) return (int)sys_native(SYS_pipe2, fds, flags);
   // reply data = [i32 read_fd, i32 write_fd]
   int64_t args[6] = {flags, 0, 0, 0, 0, 0};
   uint8_t out[8];
@@ -421,12 +485,12 @@ int pipe2(int fds[2], int flags) {
 int pipe(int fds[2]) { return pipe2(fds, 0); }
 
 int eventfd(unsigned int initval, int flags) {
-  if (!g_ch) return (int)syscall(SYS_eventfd2, initval, flags);
+  if (!g_ch) return (int)sys_native(SYS_eventfd2, initval, flags);
   return (int)ipc_call6(SYS_eventfd2, initval, flags);
 }
 
 int timerfd_create(int clockid, int flags) {
-  if (!g_ch) return (int)syscall(SYS_timerfd_create, clockid, flags);
+  if (!g_ch) return (int)sys_native(SYS_timerfd_create, clockid, flags);
   return (int)ipc_call6(SYS_timerfd_create, clockid, flags);
 }
 
@@ -442,7 +506,7 @@ static void ns_to_ts(int64_t ns, struct timespec* ts) {
 int timerfd_settime(int fd, int flags, const struct itimerspec* new_value,
                     struct itimerspec* old_value) {
   if (!is_managed_fd(fd))
-    return (int)syscall(SYS_timerfd_settime, fd, flags, new_value, old_value);
+    return (int)sys_native(SYS_timerfd_settime, fd, flags, new_value, old_value);
   // request data = [i64 value_ns, i64 interval_ns]; reply data = old pair
   uint8_t in[16], out[16];
   int64_t v = ts_to_ns(&new_value->it_value);
@@ -466,7 +530,7 @@ int timerfd_settime(int fd, int flags, const struct itimerspec* new_value,
 
 int timerfd_gettime(int fd, struct itimerspec* curr) {
   if (!is_managed_fd(fd))
-    return (int)syscall(SYS_timerfd_gettime, fd, curr);
+    return (int)sys_native(SYS_timerfd_gettime, fd, curr);
   uint8_t out[16];
   uint32_t out_len = 0;
   int64_t args[6] = {fd, 0, 0, 0, 0, 0};
@@ -484,7 +548,7 @@ int timerfd_gettime(int fd, struct itimerspec* curr) {
 }
 
 ssize_t getrandom(void* buf, size_t buflen, unsigned int flags) {
-  if (!g_ch) return syscall(SYS_getrandom, buf, buflen, flags);
+  if (!g_ch) return sys_native(SYS_getrandom, buf, buflen, flags);
   // deterministic per-host stream from the simulator's seeded RNG tree
   size_t want = buflen > IPC_DATA_MAX ? IPC_DATA_MAX : buflen;
   int64_t args[6] = {(int64_t)want, flags, 0, 0, 0, 0};
@@ -495,14 +559,14 @@ ssize_t getrandom(void* buf, size_t buflen, unsigned int flags) {
 }
 
 int shutdown(int fd, int how) {
-  if (!is_managed_fd(fd)) return (int)syscall(SYS_shutdown, fd, how);
+  if (!is_managed_fd(fd)) return (int)sys_native(SYS_shutdown, fd, how);
   return (int)ipc_call6(SYS_shutdown, fd, how);
 }
 
 int setsockopt(int fd, int level, int optname, const void* optval,
                socklen_t optlen) {
   if (!is_managed_fd(fd))
-    return (int)syscall(SYS_setsockopt, fd, level, optname, optval, optlen);
+    return (int)sys_native(SYS_setsockopt, fd, level, optname, optval, optlen);
   int64_t v = 0;
   if (optval && optlen >= sizeof(int)) v = *(const int*)optval;
   return (int)ipc_call6(SYS_setsockopt, fd, level, optname, v);
@@ -511,7 +575,7 @@ int setsockopt(int fd, int level, int optname, const void* optval,
 int getsockopt(int fd, int level, int optname, void* optval,
                socklen_t* optlen) {
   if (!is_managed_fd(fd))
-    return (int)syscall(SYS_getsockopt, fd, level, optname, optval, optlen);
+    return (int)sys_native(SYS_getsockopt, fd, level, optname, optval, optlen);
   int64_t r = ipc_call6(SYS_getsockopt, fd, level, optname);
   if (r < 0) return -1;
   if (optval && optlen && *optlen >= sizeof(int)) {
@@ -522,7 +586,7 @@ int getsockopt(int fd, int level, int optname, void* optval,
 }
 
 int getsockname(int fd, struct sockaddr* addr, socklen_t* alen) {
-  if (!is_managed_fd(fd)) return (int)syscall(SYS_getsockname, fd, addr, alen);
+  if (!is_managed_fd(fd)) return (int)sys_native(SYS_getsockname, fd, addr, alen);
   uint8_t out[8];
   uint32_t out_len = 0;
   int64_t args[6] = {fd, 0, 0, 0, 0, 0};
@@ -540,7 +604,7 @@ int getsockname(int fd, struct sockaddr* addr, socklen_t* alen) {
 }
 
 int getpeername(int fd, struct sockaddr* addr, socklen_t* alen) {
-  if (!is_managed_fd(fd)) return (int)syscall(SYS_getpeername, fd, addr, alen);
+  if (!is_managed_fd(fd)) return (int)sys_native(SYS_getpeername, fd, addr, alen);
   uint8_t out[8];
   uint32_t out_len = 0;
   int64_t args[6] = {fd, 0, 0, 0, 0, 0};
@@ -562,7 +626,7 @@ int fcntl(int fd, int cmd, ...) {
   va_start(ap, cmd);
   long arg = va_arg(ap, long);
   va_end(ap);
-  if (!is_managed_fd(fd)) return (int)syscall(SYS_fcntl, fd, cmd, arg);
+  if (!is_managed_fd(fd)) return (int)sys_native(SYS_fcntl, fd, cmd, arg);
   return (int)ipc_call6(SYS_fcntl, fd, cmd, arg);
 }
 
@@ -571,7 +635,7 @@ int ioctl(int fd, unsigned long req, ...) {
   va_start(ap, req);
   void* argp = va_arg(ap, void*);
   va_end(ap);
-  if (!is_managed_fd(fd)) return (int)syscall(SYS_ioctl, fd, req, argp);
+  if (!is_managed_fd(fd)) return (int)sys_native(SYS_ioctl, fd, req, argp);
   // FIONREAD is the one sockets commonly use
   int64_t r = ipc_call6(SYS_ioctl, fd, (int64_t)req);
   if (r < 0) return -1;
@@ -589,7 +653,7 @@ int ioctl(int fd, unsigned long req, ...) {
 extern "C" {
 
 int clock_gettime(clockid_t clk, struct timespec* tp) {
-  if (!g_ch) return (int)syscall(SYS_clock_gettime, clk, tp);
+  if (!g_ch) return (int)sys_native(SYS_clock_gettime, clk, tp);
   int64_t r = ipc_call6(SYS_clock_gettime, clk);
   if (r < 0) return -1;
   if (tp) {
@@ -601,7 +665,7 @@ int clock_gettime(clockid_t clk, struct timespec* tp) {
 
 int gettimeofday(struct timeval* tv, void* tz) {
   (void)tz;
-  if (!g_ch) return (int)syscall(SYS_gettimeofday, tv, tz);
+  if (!g_ch) return (int)sys_native(SYS_gettimeofday, tv, tz);
   struct timespec ts;
   if (clock_gettime(CLOCK_REALTIME, &ts) != 0) return -1;
   if (tv) {
@@ -614,7 +678,7 @@ int gettimeofday(struct timeval* tv, void* tz) {
 time_t time(time_t* t) {
   if (!g_ch) {
     struct timespec ts;
-    syscall(SYS_clock_gettime, CLOCK_REALTIME, &ts);
+    sys_native(SYS_clock_gettime, CLOCK_REALTIME, &ts);
     if (t) *t = ts.tv_sec;
     return ts.tv_sec;
   }
@@ -625,7 +689,7 @@ time_t time(time_t* t) {
 }
 
 int nanosleep(const struct timespec* req, struct timespec* rem) {
-  if (!g_ch) return (int)syscall(SYS_nanosleep, req, rem);
+  if (!g_ch) return (int)sys_native(SYS_nanosleep, req, rem);
   if (!req) {
     errno = EFAULT;
     return -1;
@@ -656,7 +720,7 @@ int usleep(useconds_t usec) {
 // ---------------------------------------------------------------------------
 
 int epoll_create1(int flags) {
-  if (!g_ch) return (int)syscall(SYS_epoll_create1, flags);
+  if (!g_ch) return (int)sys_native(SYS_epoll_create1, flags);
   return (int)ipc_call6(SYS_epoll_create1, flags);
 }
 
@@ -667,7 +731,7 @@ int epoll_create(int size) {
 
 int epoll_ctl(int epfd, int op, int fd, struct epoll_event* ev) {
   if (!is_managed_fd(epfd))
-    return (int)syscall(SYS_epoll_ctl, epfd, op, fd, ev);
+    return (int)sys_native(SYS_epoll_ctl, epfd, op, fd, ev);
   int64_t events = ev ? (int64_t)ev->events : 0;
   int64_t data = ev ? (int64_t)ev->data.u64 : 0;
   return (int)ipc_call6(SYS_epoll_ctl, epfd, op, fd, events, data);
@@ -676,7 +740,7 @@ int epoll_ctl(int epfd, int op, int fd, struct epoll_event* ev) {
 int epoll_wait(int epfd, struct epoll_event* evs, int maxevents,
                int timeout_ms) {
   if (!is_managed_fd(epfd))
-    return (int)syscall(SYS_epoll_wait, epfd, evs, maxevents, timeout_ms);
+    return (int)sys_native(SYS_epoll_wait, epfd, evs, maxevents, timeout_ms);
   // reply data = maxevents × {u32 events, u64 data} packed (12 bytes each)
   int want = maxevents;
   if (want > (int)(IPC_DATA_MAX / 12)) want = IPC_DATA_MAX / 12;
@@ -702,7 +766,7 @@ int poll(struct pollfd* fds, nfds_t nfds, int timeout_ms) {
   bool any_managed = false;
   for (nfds_t i = 0; i < nfds; i++)
     if (is_managed_fd(fds[i].fd)) any_managed = true;
-  if (!any_managed) return (int)syscall(SYS_poll, fds, nfds, timeout_ms);
+  if (!any_managed) return (int)sys_native(SYS_poll, fds, nfds, timeout_ms);
   // request data = nfds × {i32 fd, i16 events} (6 bytes); native fds in a
   // mixed set are reported to the driver too (it treats them as never
   // ready — a documented v1 simplification).
@@ -738,7 +802,7 @@ int select(int nfds, fd_set* rd, fd_set* wr, fd_set* ex,
       any_managed = true;
   }
   if (!g_ch || !any_managed)
-    return (int)syscall(SYS_select, nfds, rd, wr, ex, timeout);
+    return (int)sys_native(SYS_select, nfds, rd, wr, ex, timeout);
   // convert to a pollfd set over the managed fds, forward as poll
   struct pollfd pfds[64];
   int n = 0;
@@ -837,7 +901,15 @@ void freeaddrinfo(struct addrinfo* res) {
 }
 
 int gethostname(char* name, size_t len) {
-  if (!g_ch) return (int)syscall(SYS_uname, 0) ? -1 : 0;
+  if (!g_ch) {
+    struct utsname u;
+    if (sys_native(SYS_uname, &u) != 0) return -1;
+    size_t want = strlen(u.nodename);
+    size_t m = want < len - 1 ? want : len - 1;
+    memcpy(name, u.nodename, m);
+    name[m] = 0;
+    return 0;
+  }
   static thread_local char tmp[256];
   uint32_t out_len = 0;
   int64_t args[6] = {0, 0, 0, 0, 0, 0};
@@ -850,4 +922,280 @@ int gethostname(char* name, size_t len) {
   return 0;
 }
 
+int clock_nanosleep(clockid_t clk, int flags, const struct timespec* req,
+                    struct timespec* rem) {
+  if (!g_ch) {
+    // clock_nanosleep returns the error value directly (no errno)
+    long r = shim_gate_syscall(SYS_clock_nanosleep, clk, flags, (long)req,
+                               (long)rem, 0, 0);
+    return r < 0 ? (int)-r : 0;
+  }
+  if (!req) return EFAULT;  // clock_nanosleep returns the error directly
+  if (flags & TIMER_ABSTIME) {
+    struct timespec now;
+    clock_gettime(clk, &now);
+    int64_t d = ts_to_ns(req) - ts_to_ns(&now);
+    if (d <= 0) return 0;
+    struct timespec rel;
+    ns_to_ts(d, &rel);
+    return nanosleep(&rel, rem) < 0 ? errno : 0;
+  }
+  return nanosleep(req, rem) < 0 ? errno : 0;
+}
+
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// seccomp/SIGSYS backstop (reference analog: shim.c:399-463): raw syscall
+// instructions that bypass the interposed libc symbols trap to SIGSYS and
+// are routed through the same wrappers. Only the emulated syscall numbers
+// trap; everything else — and anything issued from the gate — is allowed.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+// libc-convention wrapper result → raw-kernel convention (-errno)
+#define RAWRET(call)                        \
+  ({                                        \
+    long _r = (long)(call);                 \
+    _r < 0 ? -(long)errno : _r;             \
+  })
+
+long route_raw_syscall(long nr, long a0, long a1, long a2, long a3, long a4,
+                       long a5) {
+  switch (nr) {
+    case SYS_socket:
+      return RAWRET(socket((int)a0, (int)a1, (int)a2));
+    case SYS_bind:
+      return RAWRET(bind((int)a0, (const struct sockaddr*)a1, (socklen_t)a2));
+    case SYS_listen:
+      return RAWRET(listen((int)a0, (int)a1));
+    case SYS_connect:
+      return RAWRET(
+          connect((int)a0, (const struct sockaddr*)a1, (socklen_t)a2));
+    case SYS_accept:
+      return RAWRET(
+          accept4((int)a0, (struct sockaddr*)a1, (socklen_t*)a2, 0));
+    case SYS_accept4:
+      return RAWRET(
+          accept4((int)a0, (struct sockaddr*)a1, (socklen_t*)a2, (int)a3));
+    case SYS_sendto:
+      return RAWRET(sendto((int)a0, (const void*)a1, (size_t)a2, (int)a3,
+                           (const struct sockaddr*)a4, (socklen_t)a5));
+    case SYS_recvfrom:
+      return RAWRET(recvfrom((int)a0, (void*)a1, (size_t)a2, (int)a3,
+                             (struct sockaddr*)a4, (socklen_t*)a5));
+    case SYS_sendmsg:
+      return RAWRET(sendmsg((int)a0, (const struct msghdr*)a1, (int)a2));
+    case SYS_recvmsg:
+      return RAWRET(recvmsg((int)a0, (struct msghdr*)a1, (int)a2));
+    case SYS_shutdown:
+      return RAWRET(shutdown((int)a0, (int)a1));
+    case SYS_getsockname:
+      return RAWRET(
+          getsockname((int)a0, (struct sockaddr*)a1, (socklen_t*)a2));
+    case SYS_getpeername:
+      return RAWRET(
+          getpeername((int)a0, (struct sockaddr*)a1, (socklen_t*)a2));
+    case SYS_setsockopt:
+      return RAWRET(setsockopt((int)a0, (int)a1, (int)a2, (const void*)a3,
+                               (socklen_t)a4));
+    case SYS_getsockopt:
+      return RAWRET(
+          getsockopt((int)a0, (int)a1, (int)a2, (void*)a3, (socklen_t*)a4));
+    case SYS_read:
+      return RAWRET(read((int)a0, (void*)a1, (size_t)a2));
+    case SYS_write:
+      return RAWRET(write((int)a0, (const void*)a1, (size_t)a2));
+    case SYS_readv:
+      return RAWRET(readv((int)a0, (const struct iovec*)a1, (int)a2));
+    case SYS_writev:
+      return RAWRET(writev((int)a0, (const struct iovec*)a1, (int)a2));
+    case SYS_close:
+      return RAWRET(close((int)a0));
+    case SYS_dup:
+      return RAWRET(dup((int)a0));
+    case SYS_dup2:
+      return RAWRET(dup2((int)a0, (int)a1));
+    case SYS_dup3:
+      return RAWRET(dup3((int)a0, (int)a1, (int)a2));
+    case SYS_fcntl:
+      return RAWRET(fcntl((int)a0, (int)a1, a2));
+    case SYS_ioctl:
+      return RAWRET(ioctl((int)a0, (unsigned long)a1, (void*)a2));
+    case SYS_pipe: {
+      return RAWRET(pipe2((int*)a0, 0));
+    }
+    case SYS_pipe2:
+      return RAWRET(pipe2((int*)a0, (int)a1));
+    case SYS_eventfd:
+      return RAWRET(eventfd((unsigned int)a0, 0));
+    case SYS_eventfd2:
+      return RAWRET(eventfd((unsigned int)a0, (int)a1));
+    case SYS_timerfd_create:
+      return RAWRET(timerfd_create((int)a0, (int)a1));
+    case SYS_timerfd_settime:
+      return RAWRET(timerfd_settime((int)a0, (int)a1,
+                                    (const struct itimerspec*)a2,
+                                    (struct itimerspec*)a3));
+    case SYS_timerfd_gettime:
+      return RAWRET(timerfd_gettime((int)a0, (struct itimerspec*)a1));
+    case SYS_epoll_create:
+    case SYS_epoll_create1:
+      return RAWRET(epoll_create1(nr == SYS_epoll_create ? 0 : (int)a0));
+    case SYS_epoll_ctl:
+      return RAWRET(
+          epoll_ctl((int)a0, (int)a1, (int)a2, (struct epoll_event*)a3));
+    case SYS_epoll_wait:
+    case SYS_epoll_pwait:  // sigmask ignored (no signal emulation yet)
+      return RAWRET(
+          epoll_wait((int)a0, (struct epoll_event*)a1, (int)a2, (int)a3));
+    case SYS_poll:
+      return RAWRET(poll((struct pollfd*)a0, (nfds_t)a1, (int)a2));
+    case SYS_select:
+      return RAWRET(select((int)a0, (fd_set*)a1, (fd_set*)a2, (fd_set*)a3,
+                           (struct timeval*)a4));
+    case SYS_pselect6: {
+      const struct timespec* ts = (const struct timespec*)a4;
+      struct timeval tv, *tvp = nullptr;
+      if (ts) {
+        tv.tv_sec = ts->tv_sec;
+        tv.tv_usec = ts->tv_nsec / 1000;
+        tvp = &tv;
+      }
+      return RAWRET(
+          select((int)a0, (fd_set*)a1, (fd_set*)a2, (fd_set*)a3, tvp));
+    }
+    case SYS_clock_gettime:
+      return RAWRET(clock_gettime((clockid_t)a0, (struct timespec*)a1));
+    case SYS_gettimeofday:
+      return RAWRET(gettimeofday((struct timeval*)a0, (void*)a1));
+    case SYS_time: {
+      time_t t = time((time_t*)a0);
+      return (long)t;
+    }
+    case SYS_nanosleep:
+      return RAWRET(
+          nanosleep((const struct timespec*)a0, (struct timespec*)a1));
+    case SYS_clock_nanosleep: {
+      int e = clock_nanosleep((clockid_t)a0, (int)a1,
+                              (const struct timespec*)a2,
+                              (struct timespec*)a3);
+      return -(long)e;  // clock_nanosleep returns the errno directly
+    }
+    case SYS_getrandom:
+      return RAWRET(getrandom((void*)a0, (size_t)a1, (unsigned int)a2));
+    default:
+      return shim_gate_syscall(nr, a0, a1, a2, a3, a4, a5);
+  }
+}
+
+void on_sigsys(int sig, siginfo_t* info, void* vctx) {
+  (void)sig;
+#if defined(__x86_64__)
+  ucontext_t* uc = (ucontext_t*)vctx;
+  greg_t* g = uc->uc_mcontext.gregs;
+  long nr = (long)info->si_syscall;
+  long r = route_raw_syscall(nr, g[REG_RDI], g[REG_RSI], g[REG_RDX],
+                             g[REG_R10], g[REG_R8], g[REG_R9]);
+  g[REG_RAX] = (greg_t)r;
+#else
+  (void)info;
+  (void)vctx;
+#endif
+}
+
+// syscall numbers the backstop traps (the emulated surface; everything
+// else — memory, threads, files, process control — passes through)
+const int kTrappedSyscalls[] = {
+    SYS_read,          SYS_write,          SYS_close,
+    SYS_poll,          SYS_ioctl,          SYS_readv,
+    SYS_writev,        SYS_select,         SYS_dup,
+    SYS_dup2,          SYS_dup3,           SYS_nanosleep,
+    SYS_socket,        SYS_connect,        SYS_accept,
+    SYS_accept4,       SYS_sendto,         SYS_recvfrom,
+    SYS_sendmsg,       SYS_recvmsg,        SYS_shutdown,
+    SYS_bind,          SYS_listen,         SYS_getsockname,
+    SYS_getpeername,   SYS_setsockopt,     SYS_getsockopt,
+    SYS_fcntl,         SYS_gettimeofday,   SYS_time,
+    SYS_clock_gettime, SYS_clock_nanosleep, SYS_epoll_create,
+    SYS_epoll_create1, SYS_epoll_ctl,      SYS_epoll_wait,
+    SYS_epoll_pwait,   SYS_timerfd_create, SYS_timerfd_settime,
+    SYS_timerfd_gettime, SYS_eventfd,      SYS_eventfd2,
+    SYS_pipe,          SYS_pipe2,          SYS_getrandom,
+    SYS_pselect6,
+};
+
+void shim_install_seccomp() {
+#if defined(__x86_64__)
+  uintptr_t gate = (uintptr_t)&shim_gate_syscall;
+  uint32_t gate_lo = (uint32_t)gate;
+  uint32_t gate_hi = (uint32_t)(gate >> 32);
+  if (gate_lo > UINT32_MAX - GATE_WINDOW) {
+    SHIM_LOG("seccomp: gate straddles a 4 GiB boundary; backstop off");
+    return;
+  }
+
+  struct sigaction sa;
+  memset(&sa, 0, sizeof(sa));
+  sa.sa_sigaction = on_sigsys;
+  // SA_NODEFER: a trapped syscall inside the handler (libc internals) must
+  // re-enter it — a blocked SIGSYS under seccomp kills the process
+  sa.sa_flags = SA_SIGINFO | SA_NODEFER;
+  if (sigaction(SIGSYS, &sa, nullptr) != 0) {
+    SHIM_LOG("seccomp: sigaction failed: %s", strerror(errno));
+    return;
+  }
+
+  constexpr int K = (int)(sizeof(kTrappedSyscalls) / sizeof(int));
+  // layout: 0 ld arch / 1 jeq x86_64 (else KILL) / 2 ld ip_hi / 3 jeq hi /
+  //         4 ld ip_lo / 5 jge lo / 6 jge lo+W / 7 ld nr /
+  //         8..8+K-1 jeq nr → TRAP / ALLOW at 8+K / TRAP at 9+K /
+  //         KILL at 10+K
+  const uint8_t NR = 7, ALLOW = 8 + K, TRAP = 9 + K;
+  struct sock_filter prog[11 + K];
+  int i = 0;
+  // non-x86-64 audit arch (e.g. int 0x80 compat syscalls) would bypass
+  // virtualization with wrong syscall numbering: kill loudly instead
+  const uint8_t KILL = TRAP + 1;
+  prog[i++] = BPF_STMT(BPF_LD | BPF_W | BPF_ABS,
+                       offsetof(struct seccomp_data, arch));
+  prog[i++] = BPF_JUMP(BPF_JMP | BPF_JEQ | BPF_K, AUDIT_ARCH_X86_64, 0,
+                       (uint8_t)(KILL - 2));
+  prog[i++] = BPF_STMT(BPF_LD | BPF_W | BPF_ABS,
+                       offsetof(struct seccomp_data, instruction_pointer) + 4);
+  prog[i++] = BPF_JUMP(BPF_JMP | BPF_JEQ | BPF_K, gate_hi, 0,
+                       (uint8_t)(NR - 4));
+  prog[i++] = BPF_STMT(BPF_LD | BPF_W | BPF_ABS,
+                       offsetof(struct seccomp_data, instruction_pointer));
+  prog[i++] = BPF_JUMP(BPF_JMP | BPF_JGE | BPF_K, gate_lo, 0,
+                       (uint8_t)(NR - 6));
+  prog[i++] = BPF_JUMP(BPF_JMP | BPF_JGE | BPF_K, gate_lo + GATE_WINDOW,
+                       (uint8_t)(NR - 7), (uint8_t)(ALLOW - 7));
+  prog[i++] = BPF_STMT(BPF_LD | BPF_W | BPF_ABS,
+                       offsetof(struct seccomp_data, nr));
+  for (int k = 0; k < K; k++) {
+    prog[i] = BPF_JUMP(BPF_JMP | BPF_JEQ | BPF_K,
+                       (uint32_t)kTrappedSyscalls[k],
+                       (uint8_t)(TRAP - (i + 1)), 0);
+    i++;
+  }
+  prog[i++] = BPF_STMT(BPF_RET | BPF_K, SECCOMP_RET_ALLOW);
+  prog[i++] = BPF_STMT(BPF_RET | BPF_K, SECCOMP_RET_TRAP);
+#ifdef SECCOMP_RET_KILL_PROCESS
+  prog[i++] = BPF_STMT(BPF_RET | BPF_K, SECCOMP_RET_KILL_PROCESS);
+#else
+  prog[i++] = BPF_STMT(BPF_RET | BPF_K, SECCOMP_RET_KILL);
+#endif
+
+  struct sock_fprog fprog = {(unsigned short)i, prog};
+  if (prctl(PR_SET_NO_NEW_PRIVS, 1, 0, 0, 0) != 0 ||
+      prctl(PR_SET_SECCOMP, SECCOMP_MODE_FILTER, &fprog) != 0) {
+    SHIM_LOG("seccomp: install failed: %s", strerror(errno));
+    return;
+  }
+  SHIM_LOG("seccomp backstop installed (%d trapped syscalls)", K);
+#endif
+}
+
+}  // namespace

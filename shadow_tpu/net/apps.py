@@ -180,6 +180,7 @@ class UdpFloodApp:
         state = self.stack.udp_sendto(
             state, emitter, send, ev.time, self._target, SERVER_PORT,
             CLIENT_PORT_BASE, self.size_bytes, 0,
+            params=params,
         )
         hosts = jnp.arange(self.num_hosts, dtype=jnp.int32)
         emitter.emit(
@@ -279,6 +280,7 @@ class UdpEchoApp:
             state, emitter, send, ev.time,
             jnp.full((H,), self.server_host, jnp.int32),
             SERVER_PORT, CLIENT_PORT_BASE, self.size_bytes, 0, payload=req,
+            params=params,
         )
         emitter.emit(
             send, ev.time + self.interval_ns, hosts,
@@ -306,6 +308,7 @@ class UdpEchoApp:
         state = self.stack.udp_sendto(
             state, emitter, server_got, now, src,
             None, None, None, 0, payload=reply,
+            params=params,
         )
         return state
 
@@ -378,6 +381,7 @@ class TcpBulkApp:
         state = self.stack.tcp.connect(
             state, emitter, go, jnp.zeros((self.num_hosts,), jnp.int32),
             self._target, SERVER_PORT, CLIENT_PORT_BASE, ev.time,
+            params=params,
         )
         return state
 

@@ -26,7 +26,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 from flax import struct
 
-from shadow_tpu.core import simtime
+from shadow_tpu.core import simtime, soa
 from shadow_tpu.core.state import PAYLOAD_WORDS
 from shadow_tpu.net import packet as pkt
 
@@ -81,16 +81,16 @@ def init(num_hosts: int, queue_slots: int = 64) -> RouterState:
 def enqueue(router: RouterState, mask, payload, src, now) -> RouterState:
     """router_enqueue (router.c:103-121): append with enqueue timestamp."""
     H, Q = router.q_src.shape
-    hosts = jnp.arange(H, dtype=jnp.int32)
     room = (router.q_tail - router.q_head) < Q
     ok = mask & room
-    slot = jnp.where(ok, router.q_tail % Q, Q)
+    slot = router.q_tail % Q
     size = pkt.total_bytes(payload).astype(jnp.int64)
     return router.replace(
-        q_payload=router.q_payload.at[hosts, slot].set(payload, mode="drop"),
-        q_src=router.q_src.at[hosts, slot].set(src.astype(jnp.int32), mode="drop"),
-        q_enq_ts=router.q_enq_ts.at[hosts, slot].set(
-            jnp.broadcast_to(now, (H,)).astype(jnp.int64), mode="drop"
+        q_payload=soa.set_at(router.q_payload, ok, slot, payload),
+        q_src=soa.set_at(router.q_src, ok, slot, src.astype(jnp.int32)),
+        q_enq_ts=soa.set_at(
+            router.q_enq_ts, ok, slot,
+            jnp.broadcast_to(now, (H,)).astype(jnp.int64),
         ),
         q_tail=router.q_tail + ok.astype(jnp.int32),
         total_size=router.total_size + jnp.where(ok, size, 0),

@@ -29,7 +29,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 from flax import struct
 
-from shadow_tpu.core import simtime
+from shadow_tpu.core import simtime, soa
 from shadow_tpu.core.state import PAYLOAD_WORDS
 from shadow_tpu.net import packet as pkt
 
@@ -154,13 +154,12 @@ def enqueue_send(nic: NicState, mask, dst_host, payload) -> tuple[NicState, jnp.
     sequence), matching the reference's fifo qdisc selection by app priority.
     """
     H, NQ = nic.q_dst.shape
-    hosts = jnp.arange(H, dtype=jnp.int32)
     room = (nic.q_tail - nic.q_head) < NQ
     ok = mask & room
-    slot = jnp.where(ok, nic.q_tail % NQ, NQ)
+    slot = nic.q_tail % NQ
     nic = nic.replace(
-        q_payload=nic.q_payload.at[hosts, slot].set(payload, mode="drop"),
-        q_dst=nic.q_dst.at[hosts, slot].set(dst_host.astype(jnp.int32), mode="drop"),
+        q_payload=soa.set_at(nic.q_payload, ok, slot, payload),
+        q_dst=soa.set_at(nic.q_dst, ok, slot, dst_host.astype(jnp.int32)),
         q_tail=nic.q_tail + ok.astype(jnp.int32),
         sendq_dropped=nic.sendq_dropped + jnp.sum(mask & ~room, dtype=jnp.int64),
     )

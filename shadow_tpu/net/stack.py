@@ -106,21 +106,69 @@ class NetStack:
     # ---- generic transmit path (all protocols) ----
 
     def _tx(self, state: SimState, emitter: Emitter, mask, now, dst_host,
-            payload):
-        """Queue an assembled packet on the sender's NIC ring and arm the
-        send pump (networkinterface_wantsSend analog). Returns
-        (state, ok) where ok marks hosts whose packet was admitted."""
+            payload, params: NetParams | None = None):
+        """Transmit an assembled packet (networkinterface_wantsSend analog).
+
+        Uncontended fast path (requires ``params`` for the latency/loss
+        lookup): empty send queue + tokens in the bucket → the packet goes
+        onto the wire inside THIS micro-step, exactly like the reference's
+        send loop which transmits immediately when tokens allow
+        (network_interface.c:633-661) — the pump event exists only for the
+        throttled/queued case. This halves the per-packet event chain.
+
+        Returns (state, ok) where ok marks hosts whose packet was admitted.
+        """
         H = self.num_hosts
         hosts = jnp.arange(H, dtype=jnp.int32)
         n = state.subs[nic.SUB]
-        n, ok = nic.enqueue_send(n, mask, dst_host.astype(jnp.int32), payload)
+        now64 = jnp.broadcast_to(now, (H,)).astype(jnp.int64)
+        direct = jnp.zeros((H,), bool)
+        if params is not None:
+            tx_rem, tx_tick = nic.lazy_refill(
+                n.tx_rem, n.tx_tick, n.tx_refill, n.tx_cap, now64, mask
+            )
+            n = n.replace(tx_rem=tx_rem, tx_tick=tx_tick)
+            size = pkt.total_bytes(payload).astype(jnp.int64)
+            bootstrap = now64 < params.bootstrap_end
+            # same admission gate as the send pump (rem >= MTU, full size
+            # charged, debt allowed) so a packet's timing never depends on
+            # which path carried it
+            direct = mask & (n.q_head == n.q_tail) & (
+                bootstrap | (n.tx_rem >= pkt.MTU)
+            )
+            # bootstrap sends are free, exactly like the pump path
+            n = n.replace(
+                tx_rem=jnp.where(direct & ~bootstrap, n.tx_rem - size,
+                                 n.tx_rem)
+            )
+            n = nic.count_tx(n, direct, size)
+            if self.qdisc == "roundrobin":
+                n = n.replace(last_socket=jnp.where(
+                    direct, payload[:, pkt.W_SOCKET], n.last_socket
+                ))
+            state = state.with_sub(nic.SUB, n)
+            remote = direct & (dst_host != hosts)
+            state = link.send(
+                state, emitter, remote, dst_host.astype(jnp.int32), now64,
+                KIND_PKT_DELIVER, payload, params,
+                jnp.where(remote, size, 0),
+                control_mask=payload[:, pkt.W_LEN] == 0,
+            )
+            lb = direct & (dst_host == hosts)
+            emitter.emit(lb, now64, hosts, jnp.int32(KIND_PKT_DELIVER),
+                         payload)
+            n = state.subs[nic.SUB]
+
+        n, ok = nic.enqueue_send(
+            n, mask & ~direct, dst_host.astype(jnp.int32), payload
+        )
         need = ok & ~n.send_pending
         emitter.emit(
-            need, jnp.broadcast_to(now, (H,)).astype(jnp.int64), hosts,
+            need, now64, hosts,
             jnp.int32(KIND_NIC_SEND), jnp.zeros_like(payload),
         )
         n = n.replace(send_pending=n.send_pending | need)
-        return state.with_sub(nic.SUB, n), ok
+        return state.with_sub(nic.SUB, n), ok | direct
 
     # ---- runtime API (called from app handlers) ----
 
@@ -136,6 +184,7 @@ class NetStack:
         size_bytes,
         socket_slot,
         payload=None,
+        params: NetParams | None = None,
     ) -> SimState:
         """Queue a datagram on the sender's NIC and arm the send pump
         (transport_sendUserData → socket buffer → networkinterface_wantsSend).
@@ -154,7 +203,8 @@ class NetStack:
                     jnp.asarray(socket_slot, jnp.int32), (H,)
                 ),
             )
-        state, ok = self._tx(state, emitter, mask, now, dst_host, payload)
+        state, ok = self._tx(state, emitter, mask, now, dst_host, payload,
+                             params=params)
         u = udp.count_sent(
             state.subs[udp.SUB], ok,
             jnp.broadcast_to(jnp.asarray(socket_slot, jnp.int32), (H,)), payload,
@@ -202,22 +252,51 @@ class NetStack:
         self, state: SimState, ev: EventView, emitter: Emitter, params: NetParams
     ) -> SimState:
         """Packet arrives at the destination: remote traffic enters the
-        upstream router (CoDel); loopback skips straight to the socket."""
+        upstream router (CoDel); loopback skips straight to the socket.
+
+        Uncontended fast path: empty router queue + rx tokens → the packet
+        is delivered inside THIS micro-step (the reference's receive loop
+        drains arrivals immediately when tokens allow,
+        network_interface.c:448-485); the CoDel state updates applied are
+        exactly those of dequeueing a zero-sojourn ("good") packet."""
         H = self.num_hosts
         hosts = jnp.arange(H, dtype=jnp.int32)
         now = ev.time
         loopback = ev.mask & (ev.src == hosts)
         remote = ev.mask & (ev.src != hosts)
 
-        r = codel.enqueue(state.subs[codel.SUB], remote, ev.payload, ev.src, now)
-        state = state.with_sub(codel.SUB, r)
+        n = state.subs[nic.SUB]
+        r = state.subs[codel.SUB]
+        rx_rem, rx_tick = nic.lazy_refill(
+            n.rx_rem, n.rx_tick, n.rx_refill, n.rx_cap, now, remote
+        )
+        n = n.replace(rx_rem=rx_rem, rx_tick=rx_tick)
+        bootstrap = now < params.bootstrap_end
+        size = pkt.total_bytes(ev.payload).astype(jnp.int64)
+        direct = (
+            remote & ~codel.nonempty(r)
+            & (bootstrap | (n.rx_rem >= pkt.MTU))
+        )
+        n = n.replace(
+            rx_rem=jnp.where(direct & ~bootstrap, n.rx_rem - size, n.rx_rem)
+        )
+        # zero-sojourn dequeue semantics: good packet → interval reset,
+        # drop-mode exit (codel.dequeue with sojourn 0 does exactly this)
+        r = r.replace(
+            interval_expire=jnp.where(direct, 0, r.interval_expire),
+            drop_mode=jnp.where(direct, False, r.drop_mode),
+        )
+
+        queued = remote & ~direct
+        r = codel.enqueue(r, queued, ev.payload, ev.src, now)
+        state = state.with_sub(codel.SUB, r).with_sub(nic.SUB, n)
 
         state = self._deliver_local(
-            state, loopback, ev.src, ev.payload, emitter, now, params
+            state, loopback | direct, ev.src, ev.payload, emitter, now, params
         )
 
         n = state.subs[nic.SUB]
-        need = remote & ~n.recv_pending
+        need = queued & ~n.recv_pending
         emitter.emit(
             need, now, hosts, jnp.int32(KIND_NIC_RECV),
             jnp.zeros_like(ev.payload),

@@ -42,7 +42,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 from flax import struct
 
-from shadow_tpu.core import simtime
+from shadow_tpu.core import simtime, soa
 from shadow_tpu.core.state import PAYLOAD_WORDS
 from shadow_tpu.net import packet as pkt
 
@@ -206,11 +206,9 @@ def _g(arr, slot):
 
 
 def _s(arr, mask, slot, val):
-    """Masked per-host scatter: arr[h, slot[h]] = val[h] where mask."""
-    H, S = arr.shape[:2]
-    hosts = jnp.arange(H, dtype=jnp.int32)
-    sl = jnp.where(mask, slot, S)
-    return arr.at[hosts, sl].set(val, mode="drop")
+    """Masked per-host slot write: arr[h, slot[h]] = val[h] where mask.
+    Select-based (core.soa) — XLA scatters serialize on TPU."""
+    return soa.set_at(arr, mask, slot, val)
 
 
 # ---------------------------------------------------------------------------
@@ -425,8 +423,10 @@ class Tcp:
         )
 
     def _tx_segment(self, state, emitter, mask, now, dst_host, *, slot,
-                    length, flags, seq, ack, dst_port=None, src_port=None):
-        """Assemble + hand a segment to the NIC ring (stack transmit path)."""
+                    length, flags, seq, ack, dst_port=None, src_port=None,
+                    params=None):
+        """Assemble + hand a segment to the NIC (stack transmit path);
+        with ``params`` the stack's uncontended fast path applies."""
         t = state.subs[SUB]
         sp = src_port if src_port is not None else _g(t.local_port, slot)
         dp = dst_port if dst_port is not None else _g(t.peer_port, slot)
@@ -440,13 +440,15 @@ class Tcp:
             wnd=jnp.full((self.num_hosts,), RECV_WND, jnp.int32),
             src_host=self._hosts(), socket_slot=slot,
         )
-        state, _ok = self.stack._tx(state, emitter, mask, now, dst_host, seg)
+        state, _ok = self.stack._tx(
+            state, emitter, mask, now, dst_host, seg, params=params
+        )
         return state
 
     # ---- runtime app API ----
 
     def connect(self, state, emitter, mask, slot, dst_host, dst_port,
-                local_port, now):
+                local_port, now, params=None):
         """Active open: full slot re-init + SYN + retransmit timer.
 
         Reference: tcp.c connect path; ISS is 0 (deterministic) — the
@@ -497,10 +499,7 @@ class Tcp:
             rtx_armed=_s(t.rtx_armed, m, slot, fb),
             rtx_expire=_s(t.rtx_expire, m, slot,
                           jnp.full((H,), simtime.NEVER, jnp.int64)),
-            gen=t.gen.at[
-                jnp.arange(H, dtype=jnp.int32),
-                jnp.where(m, slot, self.sockets_per_host),
-            ].add(1, mode="drop"),
+            gen=soa.add_at(t.gen, m, slot, 1),
             out_pending=_s(t.out_pending, m, slot, fb),
             bytes_acked=_s(t.bytes_acked, m, slot, jnp.zeros((H,), jnp.int64)),
             bytes_received=_s(t.bytes_received, m, slot,
@@ -511,6 +510,7 @@ class Tcp:
         state = self._tx_segment(
             state, emitter, m, now, dst_host, slot=slot, length=0, flags=SYN,
             seq=z32, ack=z32, dst_port=dst_port, src_port=local_port,
+            params=params,
         )
         t = state.subs[SUB]
         t = self._arm_rtx(t, emitter, m, slot, now)
@@ -604,6 +604,7 @@ class Tcp:
             state, emitter, no_sock, now64, src, slot=jnp.zeros_like(slot),
             length=0, flags=RST | ACK, seq=rst_seq, ack=rst_ack,
             dst_port=sport, src_port=dport,
+            params=params,
         )
         t = state.subs[SUB]
 
@@ -649,8 +650,7 @@ class Tcp:
             rtt_seq=_s(t.rtt_seq, mc, child, one32),
             rtt_start=_s(t.rtt_start, mc, child, now64),
             rtx_armed=_s(t.rtx_armed, mc, child, fb),
-            gen=t.gen.at[self._hosts(), jnp.where(mc, child,
-                         self.sockets_per_host)].add(1, mode="drop"),
+            gen=soa.add_at(t.gen, mc, child, 1),
             out_pending=_s(t.out_pending, mc, child, fb),
             bytes_acked=_s(t.bytes_acked, mc, child, z64),
             bytes_received=_s(t.bytes_received, mc, child, z64),
@@ -660,6 +660,7 @@ class Tcp:
             state, emitter, mc, now64, src, slot=child, length=0,
             flags=SYN | ACK, seq=z32, ack=seg_seq + 1,
             dst_port=sport, src_port=dport,
+            params=params,
         )
         t = state.subs[SUB]
         t = self._arm_rtx(t, emitter, mc, child, now64)
@@ -686,6 +687,7 @@ class Tcp:
             state, emitter, m_ss, now64, src, slot=slot, length=0, flags=ACK,
             seq=_g(state.subs[SUB].snd_nxt, slot),
             ack=_g(state.subs[SUB].rcv_nxt, slot),
+            params=params,
         )
         for hook in self.established_hooks:
             state = hook(state, m_ss, slot, fb, src, now64, emitter, params)
@@ -710,8 +712,7 @@ class Tcp:
         t = t.replace(
             used=_s(t.used, m_rst, slot, fb),
             state=_s(t.state, m_rst, slot, z32),
-            gen=t.gen.at[self._hosts(), jnp.where(m_rst, slot,
-                         self.sockets_per_host)].add(1, mode="drop"),
+            gen=soa.add_at(t.gen, m_rst, slot, 1),
         )
         state = state.with_sub(SUB, t)
         for hook in self.reset_hooks:
@@ -797,10 +798,8 @@ class Tcp:
             dup_acks=_s(t.dup_acks, m_ack, slot, dups2),
             fast_recovery=_s(t.fast_recovery, m_ack, slot, fr2),
             recover=_s(t.recover, m_ack, slot, rec1),
-            bytes_acked=t.bytes_acked.at[
-                self._hosts(),
-                jnp.where(new_acked, slot, self.sockets_per_host),
-            ].add(app_bytes.astype(jnp.int64), mode="drop"),
+            bytes_acked=soa.add_at(t.bytes_acked, new_acked, slot,
+                                   app_bytes.astype(jnp.int64)),
         )
         t = _rtt_update(
             t, new_acked & seq_leq(_g(t.rtt_seq, slot), seg_ack), slot, now64
@@ -844,11 +843,13 @@ class Tcp:
             state, emitter, data_rtx, now64, src, slot=slot,
             length=rtx_len, flags=ACK, seq=una2,
             ack=_g(state.subs[SUB].rcv_nxt, slot),
+            params=params,
         )
         state = self._tx_segment(
             state, emitter, fin_rtx, now64, src, slot=slot,
             length=0, flags=FIN | ACK, seq=fin_seq_g,
             ack=_g(state.subs[SUB].rcv_nxt, slot),
+            params=params,
         )
         t = state.subs[SUB]
 
@@ -908,17 +909,13 @@ class Tcp:
             m_ooo & (d % MSS == 0) & (seg_len == MSS)
             & (kchunk >= 1) & (kchunk < W)
         )
-        om2 = om1.at[
-            self._hosts(), jnp.where(aligned, kchunk, W)
-        ].set(True, mode="drop")
+        om2 = soa.set_at(om1, aligned, kchunk, True)
         t = t.replace(
             rcv_nxt=_s(t.rcv_nxt, in_order, slot, rn1),
             ooo_map=_s(t.ooo_map, in_order | aligned, slot, om2),
             drop_ooo=t.drop_ooo + jnp.sum(m_ooo & ~aligned, dtype=jnp.int64),
-            bytes_received=t.bytes_received.at[
-                self._hosts(),
-                jnp.where(in_order, slot, self.sockets_per_host),
-            ].add(adv.astype(jnp.int64), mode="drop"),
+            bytes_received=soa.add_at(t.bytes_received, in_order, slot,
+                                      adv.astype(jnp.int64)),
         )
 
         # ---------- peer FIN ----------
@@ -964,8 +961,7 @@ class Tcp:
         t = t.replace(
             used=_s(t.used, m_free, slot, fb),
             state=_s(t.state, m_free, slot, z32),
-            gen=t.gen.at[self._hosts(), jnp.where(m_free, slot,
-                         self.sockets_per_host)].add(1, mode="drop"),
+            gen=soa.add_at(t.gen, m_free, slot, 1),
         )
         state = state.with_sub(SUB, t)
         for hook in self.closed_hooks:
@@ -986,6 +982,7 @@ class Tcp:
             state, emitter, need_ack, now64, src, slot=slot, length=0,
             flags=reply_flags, seq=reply_seq,
             ack=_g(state.subs[SUB].rcv_nxt, slot),
+            params=params,
         )
 
         # ---------- app hooks ----------
@@ -1036,10 +1033,12 @@ class Tcp:
         state = self._tx_segment(
             state, emitter, send_data, now64, dst, slot=slot,
             length=jnp.maximum(seg_len, 0), flags=ACK, seq=nxt, ack=rn,
+            params=params,
         )
         state = self._tx_segment(
             state, emitter, send_fin, now64, dst, slot=slot,
             length=0, flags=FIN | ACK, seq=nxt, ack=rn,
+            params=params,
         )
         t = state.subs[SUB]
 
@@ -1099,8 +1098,7 @@ class Tcp:
         t = t.replace(
             used=_s(t.used, m_tw, slot, fb),
             state=_s(t.state, m_tw, slot, z32),
-            gen=t.gen.at[self._hosts(), jnp.where(m_tw, slot,
-                         self.sockets_per_host)].add(1, mode="drop"),
+            gen=soa.add_at(t.gen, m_tw, slot, 1),
         )
         state = state.with_sub(SUB, t)
         for hook in self.closed_hooks:
@@ -1152,11 +1150,13 @@ class Tcp:
         state = self._tx_segment(
             state, emitter, fire & (st == SYN_SENT), now64, dst, slot=slot,
             length=0, flags=SYN, seq=z32, ack=z32,
+            params=params,
         )
         state = self._tx_segment(
             state, emitter, fire & (st == SYN_RECEIVED), now64, dst,
             slot=slot, length=0, flags=SYN | ACK, seq=z32,
             ack=_g(state.subs[SUB].rcv_nxt, slot),
+            params=params,
         )
         t = state.subs[SUB]
         t = self._arm_out(t, emitter, fire & ~hs, slot, now64)

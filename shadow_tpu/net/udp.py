@@ -17,6 +17,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 from flax import struct
 
+from shadow_tpu.core import soa
 from shadow_tpu.net import packet as pkt
 
 SUB = "udp"
@@ -87,22 +88,16 @@ def demux(udp: UdpState, mask, payload, src_host):
 
 def deliver(udp: UdpState, mask, slot, payload) -> UdpState:
     """Count a datagram into its socket (the app hook runs separately)."""
-    H, S = udp.used.shape
-    hosts = jnp.arange(H, dtype=jnp.int32)
-    sl = jnp.where(mask, slot, S)
     nbytes = payload[:, pkt.W_LEN].astype(jnp.int64)
     return udp.replace(
-        recv_pkts=udp.recv_pkts.at[hosts, sl].add(1, mode="drop"),
-        recv_bytes=udp.recv_bytes.at[hosts, sl].add(nbytes, mode="drop"),
+        recv_pkts=soa.add_at(udp.recv_pkts, mask, slot, 1),
+        recv_bytes=soa.add_at(udp.recv_bytes, mask, slot, nbytes),
     )
 
 
 def count_sent(udp: UdpState, mask, slot, payload) -> UdpState:
-    H, S = udp.used.shape
-    hosts = jnp.arange(H, dtype=jnp.int32)
-    sl = jnp.where(mask, slot, S)
     nbytes = payload[:, pkt.W_LEN].astype(jnp.int64)
     return udp.replace(
-        sent_pkts=udp.sent_pkts.at[hosts, sl].add(1, mode="drop"),
-        sent_bytes=udp.sent_bytes.at[hosts, sl].add(nbytes, mode="drop"),
+        sent_pkts=soa.add_at(udp.sent_pkts, mask, slot, 1),
+        sent_bytes=soa.add_at(udp.sent_bytes, mask, slot, nbytes),
     )

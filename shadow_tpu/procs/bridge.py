@@ -233,6 +233,7 @@ class DeviceNetBridge:
             subs=subs,
         )
         self._pending: list[tuple[int, int, np.ndarray]] = []  # (t, src, row)
+        self._drained = False  # device pool empty since the last injection
         self._handles: dict[int, bytes] = {}
         self._next_handle = 1
         self._port_slot: dict[tuple[int, int], int] = {}
@@ -264,7 +265,7 @@ class DeviceNetBridge:
             state, emitter, m_udp, ev.time, dst,
             dst_port=0, src_port=0, size_bytes=0,
             socket_slot=ev.payload[:, pkt.W_SOCKET],
-            payload=payload,
+            payload=payload, params=params,
         )
         if self.with_tcp:
             tcp = self.stack.tcp
@@ -275,7 +276,7 @@ class DeviceNetBridge:
                 dst_host=ev.payload[:, pkt.W_SEQ],
                 dst_port=ev.payload[:, pkt.W_DST_PORT],
                 local_port=ev.payload[:, pkt.W_SRC_PORT],
-                now=ev.time,
+                now=ev.time, params=params,
             )
             m_send = ev.mask & (op == OP_TCP_SEND)
             state = tcp.send_app(
@@ -493,6 +494,7 @@ class DeviceNetBridge:
     def _inject_pending(self) -> None:
         if not self._pending:
             return
+        self._drained = False
         rows = self._pending
         self._pending = []
         pool = self.sim.state.pool
@@ -620,8 +622,12 @@ class DeviceNetBridge:
         """Flush pending injections and advance the device until the first
         outputs land or its pool drains up to `horizon`. Returns the output
         events (possibly empty)."""
-        if not self._pending and self._inflight == 0 and not self._tcp_live:
-            return []  # nothing injected and nothing in flight: no sync
+        if not self._pending and (
+            self._drained or (self._inflight == 0 and not self._tcp_live)
+        ):
+            # nothing new injected and the device pool was already observed
+            # empty (or nothing is in flight at all): skip the round trip
+            return []
         self._inject_pending()
         evs = self._drain_ring()
         if evs:
@@ -634,6 +640,7 @@ class DeviceNetBridge:
                 # payload bytes and the in-flight count
                 self._inflight = 0
                 self._handles.clear()
+                self._drained = True
                 return []
             if min_next >= min(horizon, self.sim.stop_time):
                 return []

@@ -58,12 +58,25 @@ def build_process_driver(
     )
     driver.dns = dns
     driver.bootstrap_end = cfg.general.bootstrap_end_time
+    driver.use_seccomp = cfg.experimental.use_seccomp
     driver.cpu_ns_per_syscall = cfg.experimental.cpu_ns_per_syscall
     driver.cpu_threshold_ns = cfg.experimental.max_unapplied_cpu_latency
 
+    # Register hinted hosts first so a sequential allocation for an
+    # unhinted host can never claim another host's requested address
+    # (the sequential allocator starts at 11.0.0.1 — exactly the range
+    # users pick hints from).
+    for i, h in enumerate(hosts):
+        if h.ip_address_hint is not None:
+            dns.register(i, h.name, h.ip_address_hint)
+
     ip_to_vertex: dict[int, int] = {}
     for i, h in enumerate(hosts):
-        ip = dns.register(i, h.name, h.ip_address_hint)
+        ip = (
+            dns.resolve_name(h.name)
+            if h.ip_address_hint is not None
+            else dns.register(i, h.name)
+        )
         sim_host = driver.add_host(h.name, ip)
         ip_to_vertex[ip] = int(baked.host_vertex[i])
 

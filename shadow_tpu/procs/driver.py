@@ -333,12 +333,13 @@ class ManagedProcess:
         self.parked: Parked | None = None
         self.exit_code: int | None = None
 
-    def spawn(self, spin: int = 4096) -> None:
+    def spawn(self, spin: int = 4096, seccomp: bool = True) -> None:
         self.channel = ipc.Channel()
         env = dict(os.environ)
         env["LD_PRELOAD"] = str(build_mod.shim_path())
         env[ipc.ENV_SHM] = self.channel.path
         env[ipc.ENV_SPIN] = str(spin)
+        env[ipc.ENV_SECCOMP] = "1" if seccomp else "0"
         env.update(self.extra_env)
         if self.stdout_path is not None:
             out_f = open(self.stdout_path, "wb")
@@ -463,6 +464,10 @@ class ProcessDriver:
         self.loss = float(loss)
         self.seed = seed
         self.spin = spin
+        # seccomp/SIGSYS backstop in the shim (use_seccomp flag;
+        # configuration.rs:247-250 analog): catches raw syscall
+        # instructions that bypass the interposed libc symbols
+        self.use_seccomp = True
         self.service_timeout_s = service_timeout_s
         self.now = 0
         self.hosts: list[SimHost] = []
@@ -1714,7 +1719,7 @@ class ProcessDriver:
             "starting process %s: %s", proc.name, " ".join(proc.args),
             host=proc.host.name,
         )
-        proc.spawn(spin=self.spin)
+        proc.spawn(spin=self.spin, seccomp=self.use_seccomp)
 
     def _stop_process(self, p: ManagedProcess) -> None:
         """Scheduled per-process stop (process.c:655-677 stop task analog):

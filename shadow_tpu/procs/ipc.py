@@ -50,6 +50,7 @@ CHANNEL_SIZE = OFF_DATA + IPC_DATA_MAX
 ENV_SHM = "SHADOW_TPU_SHM"
 ENV_SPIN = "SHADOW_TPU_SPIN"
 ENV_DEBUG = "SHADOW_TPU_SHIM_DEBUG"
+ENV_SECCOMP = "SHADOW_TPU_SECCOMP"  # "0" disables the SIGSYS backstop
 
 _libpthread = ctypes.CDLL(None, use_errno=True)  # glibc hosts sem_* now
 

@@ -1,0 +1,76 @@
+/* Exercises RAW syscall instructions (via libc syscall(2), which issues the
+ * instruction from libc — NOT the shim's interposed symbols). Without the
+ * seccomp/SIGSYS backstop these would hit the real kernel and see real
+ * time / real sockets; with it they are trapped and routed to the
+ * simulator. Prints the virtual clock and echoes a datagram.
+ * Usage: raw_syscalls <server-ip> <port> <count>   (client)
+ *        raw_syscalls --server <port> <count>      (server) */
+#define _GNU_SOURCE
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/syscall.h>
+#include <time.h>
+#include <unistd.h>
+
+static long raw(long n, long a, long b, long c, long d, long e, long f) {
+  return syscall(n, a, b, c, d, e, f);
+}
+
+int main(int argc, char** argv) {
+  int server = argc > 1 && strcmp(argv[1], "--server") == 0;
+  int port = argc > 2 ? atoi(argv[2]) : 9000;
+  int count = argc > 3 ? atoi(argv[3]) : 2;
+
+  struct timespec ts;
+  raw(SYS_clock_gettime, CLOCK_REALTIME, (long)&ts, 0, 0, 0, 0);
+  printf("t0 %lld\n", (long long)ts.tv_sec * 1000000000LL + ts.tv_nsec);
+
+  int fd = (int)raw(SYS_socket, AF_INET, SOCK_DGRAM, 0, 0, 0, 0);
+  if (fd < 0) { perror("raw socket"); return 1; }
+
+  struct sockaddr_in addr;
+  memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(port);
+
+  char buf[512];
+  if (server) {
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    if (raw(SYS_bind, fd, (long)&addr, sizeof(addr), 0, 0, 0) != 0) {
+      perror("raw bind");
+      return 1;
+    }
+    for (int i = 0; i < count; i++) {
+      struct sockaddr_in src;
+      socklen_t slen = sizeof(src);
+      long n = raw(SYS_recvfrom, fd, (long)buf, sizeof(buf), 0, (long)&src,
+                   (long)&slen);
+      if (n < 0) { perror("raw recvfrom"); return 1; }
+      raw(SYS_sendto, fd, (long)buf, n, 0, (long)&src, slen);
+    }
+    printf("served %d\n", count);
+  } else {
+    inet_aton(argv[1], &addr.sin_addr);
+    /* raw nanosleep so send times are deterministic on the virtual clock */
+    struct timespec d = {0, 250000000};
+    for (int i = 0; i < count; i++) {
+      raw(SYS_nanosleep, (long)&d, 0, 0, 0, 0, 0);
+      snprintf(buf, sizeof(buf), "ping %d", i);
+      if (raw(SYS_sendto, fd, (long)buf, strlen(buf), 0, (long)&addr,
+              sizeof(addr)) < 0) {
+        perror("raw sendto");
+        return 1;
+      }
+      long n = raw(SYS_recvfrom, fd, (long)buf, sizeof(buf), 0, 0, 0);
+      if (n < 0) { perror("raw recvfrom"); return 1; }
+      raw(SYS_clock_gettime, CLOCK_REALTIME, (long)&ts, 0, 0, 0, 0);
+      printf("echo %d at %lld\n", i,
+             (long long)ts.tv_sec * 1000000000LL + ts.tv_nsec);
+    }
+  }
+  raw(SYS_close, fd, 0, 0, 0, 0, 0);
+  return 0;
+}
