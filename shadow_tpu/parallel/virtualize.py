@@ -1,0 +1,50 @@
+"""Force a virtual n-device CPU platform.
+
+Multi-chip TPU hardware is not available in this environment; the sharding
+layer is validated on a virtual CPU mesh instead
+(``--xla_force_host_platform_device_count``). The axon site hook pins
+JAX_PLATFORMS=axon, so the env var alone is not enough — the jax config
+value must be overridden too, before any backend initializes. Both the
+test suite (tests/conftest.py) and the driver gate
+(__graft_entry__.dryrun_multichip) go through this helper.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def force_cpu_devices(n_devices: int, cache_dir: str | None = None):
+    """Virtualize n CPU devices; must run before the JAX backend
+    initializes (importing jax is fine — first device use is not).
+
+    Returns the jax module. Raises RuntimeError if virtualization did not
+    take (e.g. a backend was already initialized on another platform).
+    """
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = " ".join(
+        f for f in os.environ.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    )
+    os.environ["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={n_devices}"
+    ).strip()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    if cache_dir is not None:
+        # Persistent compilation cache: the dominant cost everywhere is XLA
+        # compiles of the window-step program (one per distinct sim shape).
+        jax.config.update(
+            "jax_compilation_cache_dir", os.path.abspath(cache_dir)
+        )
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+
+    ndev = len(jax.devices())
+    if ndev < n_devices:
+        raise RuntimeError(
+            f"virtualization failed: need {n_devices} devices, have {ndev}"
+        )
+    return jax

@@ -9,24 +9,11 @@ var AND the jax config value before any backend initializes.
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+from shadow_tpu.parallel.virtualize import force_cpu_devices
 
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
-
-# Persistent compilation cache: the suite's dominant cost is XLA compiles of
-# the big window-step program (one per distinct sim shape, ~1-2 min each on
-# CPU). Cache them on disk so repeat runs are seconds, not minutes.
-_CACHE_DIR = os.path.join(os.path.dirname(__file__), "..", ".jax_cache")
-jax.config.update("jax_compilation_cache_dir", os.path.abspath(_CACHE_DIR))
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
-jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+jax = force_cpu_devices(
+    8, cache_dir=os.path.join(os.path.dirname(__file__), "..", ".jax_cache")
+)
 
 import pathlib  # noqa: E402
 import shutil  # noqa: E402
