@@ -94,6 +94,7 @@ constexpr const char* ENV_SHM = "SHADOW_TPU_SHM";     // shm file name
 constexpr const char* ENV_SPIN = "SHADOW_TPU_SPIN";   // spin iterations
 constexpr const char* ENV_DEBUG = "SHADOW_TPU_SHIM_DEBUG";
 constexpr const char* ENV_SECCOMP = "SHADOW_TPU_SECCOMP";  // "0" disables
+constexpr const char* ENV_VDSO = "SHADOW_TPU_VDSO";        // "0" disables patch
 
 // emulated fd space starts here; lower fds (stdio, real files the process
 // opens itself) stay native. The reference instead virtualizes the entire

@@ -19,6 +19,15 @@
 //     but not the SIGSYS handler, so it dies at its first trapped syscall
 //     (during ld.so startup) — loud failure rather than silent sim escape.
 //     Proper fork/exec support arrives with driver-side clone handling.
+//     KNOWN LIMIT: vDSO-backed calls (clock_gettime/gettimeofday/time)
+//     never enter the kernel, so seccomp cannot see them. shim_patch_vdso
+//     neutralizes this at init by rewriting the vDSO entry points to real
+//     `syscall` instructions (written through /proc/self/mem, which
+//     bypasses page protections), so they fall into the trapped path. If
+//     the patch fails (exotic kernel/vDSO layout) the gap REMAINS for
+//     statically-linked binaries whose libc calls the vDSO directly —
+//     the failure is logged loudly; dynamically-linked binaries are still
+//     covered by libc-symbol interposition either way.
 //   * fd space is PARTITIONED: emulated sockets/epolls live at
 //     fd >= FD_BASE; anything below is passed through natively. Real-file
 //     IO therefore costs zero simulator traffic (the reference instead
@@ -34,8 +43,10 @@
 #include "../common/ipc.h"
 
 #include <arpa/inet.h>
+#include <elf.h>
 #include <errno.h>
 #include <fcntl.h>
+#include <sys/auxv.h>
 #include <linux/audit.h>
 #include <linux/filter.h>
 #include <linux/seccomp.h>
@@ -131,6 +142,7 @@ pthread_mutex_t g_lock = PTHREAD_MUTEX_INITIALIZER;
 bool is_managed_fd(int fd) { return g_ch != nullptr && fd >= FD_BASE; }
 
 void shim_install_seccomp();  // defined at the bottom (needs the wrappers)
+void shim_patch_vdso();       // defined at the bottom
 
 // One request/response round trip. data_in/data_in_len ride to the driver;
 // the reply's inline data is copied to data_out (up to data_out_cap).
@@ -240,7 +252,10 @@ __attribute__((constructor)) void shim_init() {
   sem_wait_spinning(&g_ch->to_shim, g_spin);
   pthread_mutex_unlock(&g_lock);
   const char* sec = getenv(ENV_SECCOMP);
-  if (!sec || strcmp(sec, "0") != 0) shim_install_seccomp();
+  if (!sec || strcmp(sec, "0") != 0) {
+    shim_patch_vdso();  // before the filter: time must reach the kernel
+    shim_install_seccomp();
+  }
 }
 
 }  // namespace
@@ -901,6 +916,11 @@ void freeaddrinfo(struct addrinfo* res) {
 }
 
 int gethostname(char* name, size_t len) {
+  if (len == 0) {
+    // len-1 below would underflow to SIZE_MAX and overrun a 0-byte buffer
+    errno = EINVAL;
+    return -1;
+  }
   if (!g_ch) {
     struct utsname u;
     if (sys_native(SYS_uname, &u) != 0) return -1;
@@ -1126,6 +1146,136 @@ const int kTrappedSyscalls[] = {
     SYS_pselect6,
 };
 
+// ---------------------------------------------------------------------------
+// vDSO neutralization. The vDSO serves clock_gettime/gettimeofday/time as
+// plain userspace reads of kernel-exported data — no kernel entry, so the
+// seccomp backstop below never sees them, and a statically-linked binary's
+// libc would read real wall-clock time, silently breaking determinism
+// (ADVICE r1). Fix: locate the vDSO's exported time symbols and overwrite
+// each entry point with `mov eax, <nr>; syscall; ret`. The syscall
+// instruction now lives OUTSIDE the shim gate window, so the BPF traps it
+// and the SIGSYS handler routes it to the emulated clock. Writes go through
+// /proc/self/mem, whose FOLL_FORCE semantics bypass the vDSO VMA's write
+// protection (the same trick rr uses for its vDSO monkeypatching).
+// ---------------------------------------------------------------------------
+
+struct VdsoTarget {
+  const char* name;
+  uint32_t nr;
+};
+
+void shim_patch_vdso() {
+#if defined(__x86_64__)
+  const char* opt = getenv(ENV_VDSO);
+  if (opt && strcmp(opt, "0") == 0) return;
+  uintptr_t base = (uintptr_t)getauxval(AT_SYSINFO_EHDR);
+  if (!base) return;  // no vDSO mapped: nothing to neutralize
+  const Elf64_Ehdr* eh = (const Elf64_Ehdr*)base;
+  if (memcmp(eh->e_ident, ELFMAG, SELFMAG) != 0) {
+    SHIM_LOG("vdso: bad ELF magic; time determinism gap remains");
+    return;
+  }
+  const Elf64_Phdr* ph = (const Elf64_Phdr*)(base + eh->e_phoff);
+  uintptr_t dyn_vaddr = 0;
+  uintptr_t load_vaddr = UINTPTR_MAX;
+  for (int i = 0; i < eh->e_phnum; i++) {
+    if (ph[i].p_type == PT_DYNAMIC) dyn_vaddr = ph[i].p_vaddr;
+    if (ph[i].p_type == PT_LOAD && ph[i].p_vaddr < load_vaddr)
+      load_vaddr = ph[i].p_vaddr;
+  }
+  if (!dyn_vaddr || load_vaddr == UINTPTR_MAX) {
+    SHIM_LOG("vdso: no PT_DYNAMIC/PT_LOAD; gap remains");
+    return;
+  }
+  uintptr_t slide = base - load_vaddr;
+  const Elf64_Sym* symtab = nullptr;
+  const char* strtab = nullptr;
+  for (const Elf64_Dyn* d = (const Elf64_Dyn*)(slide + dyn_vaddr);
+       d->d_tag != DT_NULL; d++) {
+    uintptr_t p = (uintptr_t)d->d_un.d_ptr;
+    if (p < base) p += slide;  // vDSO d_ptr values are usually unrelocated
+    if (d->d_tag == DT_SYMTAB) symtab = (const Elf64_Sym*)p;
+    if (d->d_tag == DT_STRTAB) strtab = (const char*)p;
+  }
+  if (!symtab || !strtab || (uintptr_t)strtab <= (uintptr_t)symtab) {
+    SHIM_LOG("vdso: no dynsym/dynstr; gap remains");
+    return;
+  }
+  // .dynsym is immediately followed by .dynstr in the vDSO image; the gap
+  // between them bounds the symbol count (standard in-memory ELF trick —
+  // there is no reliable DT_HASH on all kernels).
+  size_t nsyms =
+      ((uintptr_t)strtab - (uintptr_t)symtab) / sizeof(Elf64_Sym);
+  if (nsyms == 0 || nsyms > 4096) {
+    SHIM_LOG("vdso: implausible symbol count %zu; gap remains", nsyms);
+    return;
+  }
+  const VdsoTarget targets[] = {
+      {"__vdso_clock_gettime", SYS_clock_gettime},
+      {"__vdso_gettimeofday", SYS_gettimeofday},
+      {"__vdso_time", SYS_time},
+      {"clock_gettime", SYS_clock_gettime},
+      {"gettimeofday", SYS_gettimeofday},
+      {"time", SYS_time},
+  };
+  int memfd = (int)sys_native(SYS_open, "/proc/self/mem", O_RDWR, 0);
+  if (memfd < 0) {
+    SHIM_LOG("vdso: open /proc/self/mem failed: %s; gap remains",
+             strerror(errno));
+    return;
+  }
+  int patched = 0, failed = 0;
+  // Track patched addresses: aliased names (clock_gettime aliases
+  // __vdso_clock_gettime) share one entry point — patch once.
+  uintptr_t done[sizeof(targets) / sizeof(targets[0])] = {0};
+  for (size_t s = 0; s < nsyms; s++) {
+    const Elf64_Sym* sym = &symtab[s];
+    if (sym->st_value == 0 || sym->st_name == 0) continue;
+    const char* nm = strtab + sym->st_name;
+    for (size_t t = 0; t < sizeof(targets) / sizeof(targets[0]); t++) {
+      if (strcmp(nm, targets[t].name) != 0) continue;
+      uintptr_t addr = slide + sym->st_value;
+      bool seen = false;
+      for (uintptr_t a : done) seen |= (a == addr);
+      if (seen) break;
+      uint32_t nr = targets[t].nr;
+      // mov eax, imm32; syscall; ret
+      uint8_t stub[8] = {0xb8, (uint8_t)nr, (uint8_t)(nr >> 8),
+                         (uint8_t)(nr >> 16), (uint8_t)(nr >> 24),
+                         0x0f, 0x05, 0xc3};
+      long w = sys_native(SYS_pwrite64, memfd, stub, sizeof(stub), addr);
+      if (w == (long)sizeof(stub) &&
+          memcmp((void*)addr, stub, sizeof(stub)) == 0) {
+        for (uintptr_t& a : done) {
+          if (a == 0) { a = addr; break; }
+        }
+        patched++;
+      } else {
+        failed++;
+        if (w != (long)sizeof(stub)) {
+          SHIM_LOG("vdso: pwrite of %s @%#lx failed (%s); gap remains", nm,
+                   (unsigned long)addr, strerror(errno));
+        } else {
+          SHIM_LOG("vdso: write to %s @%#lx did not take (readback "
+                   "mismatch); gap remains", nm, (unsigned long)addr);
+        }
+      }
+      break;
+    }
+  }
+  sys_native(SYS_close, memfd);
+  SHIM_LOG("vdso: neutralized %d time entry points (%d failed)", patched,
+           failed);
+#endif
+}
+
+#ifndef SECCOMP_SET_MODE_FILTER
+#define SECCOMP_SET_MODE_FILTER 1
+#endif
+#ifndef SECCOMP_FILTER_FLAG_SPEC_ALLOW
+#define SECCOMP_FILTER_FLAG_SPEC_ALLOW (1UL << 2)
+#endif
+
 void shim_install_seccomp() {
 #if defined(__x86_64__)
   uintptr_t gate = (uintptr_t)&shim_gate_syscall;
@@ -1146,6 +1296,12 @@ void shim_install_seccomp() {
     SHIM_LOG("seccomp: sigaction failed: %s", strerror(errno));
     return;
   }
+  // An inherited mask with SIGSYS blocked would turn every trap into a
+  // forced kill (reference analog: shim.c:452-458 unblocks it explicitly).
+  sigset_t unblock;
+  sigemptyset(&unblock);
+  sigaddset(&unblock, SIGSYS);
+  sigprocmask(SIG_UNBLOCK, &unblock, nullptr);
 
   constexpr int K = (int)(sizeof(kTrappedSyscalls) / sizeof(int));
   // layout: 0 ld arch / 1 jeq x86_64 (else KILL) / 2 ld ip_hi / 3 jeq hi /
@@ -1189,7 +1345,15 @@ void shim_install_seccomp() {
 #endif
 
   struct sock_fprog fprog = {(unsigned short)i, prog};
-  if (prctl(PR_SET_NO_NEW_PRIVS, 1, 0, 0, 0) != 0 ||
+  if (prctl(PR_SET_NO_NEW_PRIVS, 1, 0, 0, 0) != 0) {
+    SHIM_LOG("seccomp: no_new_privs failed: %s", strerror(errno));
+    return;
+  }
+  // Prefer seccomp(2) with SPEC_ALLOW: plain PR_SET_SECCOMP implies
+  // PR_SPEC_FORCE_DISABLE, permanently disabling speculation in every
+  // managed process (reference avoids this the same way, shim.c:535-541).
+  if (sys_native(SYS_seccomp, SECCOMP_SET_MODE_FILTER,
+                 SECCOMP_FILTER_FLAG_SPEC_ALLOW, &fprog) != 0 &&
       prctl(PR_SET_SECCOMP, SECCOMP_MODE_FILTER, &fprog) != 0) {
     SHIM_LOG("seccomp: install failed: %s", strerror(errno));
     return;

@@ -59,6 +59,7 @@ def build_process_driver(
     driver.dns = dns
     driver.bootstrap_end = cfg.general.bootstrap_end_time
     driver.use_seccomp = cfg.experimental.use_seccomp
+    driver.socket_send_buffer = cfg.experimental.socket_send_buffer
     driver.cpu_ns_per_syscall = cfg.experimental.cpu_ns_per_syscall
     driver.cpu_threshold_ns = cfg.experimental.max_unapplied_cpu_latency
 

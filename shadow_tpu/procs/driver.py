@@ -171,7 +171,11 @@ class Sock:
         if self.proto == SOCK_DGRAM:
             return True
         if self.bend is not None:
-            return self.bend.established and not self.bend.closed
+            return (
+                self.bend.established
+                and not self.bend.closed
+                and self.bend.send_space() > 0
+            )
         return self.conn is not None and self.conn.established
 
 
@@ -209,9 +213,16 @@ class BridgeEnd:
     rx: bytearray = field(default_factory=bytearray)
     rx_eof: bool = False
     tx_queue: bytearray = field(default_factory=bytearray)
+    # send-buffer byte cap (reference: bounded tcp.c send buffer backed by
+    # socket_send_buffer): a writer that outruns the path parks/EAGAINs
+    # instead of buffering the whole stream host-side
+    sndbuf: int = 131072
     closed: bool = False  # we injected a close (FIN) for this end
     recycled: bool = False  # slot returned to the mirror (end is finished)
     born_t: int = 0  # sim time this end claimed the slot (staleness guard)
+
+    def send_space(self) -> int:
+        return max(0, self.sndbuf - len(self.tx_queue))
 
 
 @dataclass
@@ -288,7 +299,7 @@ class Parked:
     """A blocked syscall awaiting a condition (syscall_condition.c analog)."""
 
     proc: "ManagedProcess"
-    kind: str  # recv|read|accept|connect|sleep|poll|epoll
+    kind: str  # recv|read|accept|connect|sleep|poll|epoll|send
     fd: int = -1
     want: int = 0
     deadline: int | None = None  # sim ns; None = no timeout
@@ -296,6 +307,7 @@ class Parked:
     epfd: int = -1
     maxevents: int = 0
     hdr: bool = True  # recv: prepend the 6-byte source-address header
+    data: bytes = b""  # send: payload awaiting send-buffer space
 
 
 class ManagedProcess:
@@ -493,6 +505,9 @@ class ProcessDriver:
         # the device-stepped network (NIC/CoDel/latency/loss on device);
         # with bridge.with_tcp, TCP connections ride the device TCP machine
         self.bridge = None
+        # per-connection send-buffer cap for device-carried TCP ends
+        # (experimental.socket_send_buffer analog)
+        self.socket_send_buffer = 131072
         self._dev_tcp: dict[tuple[int, int], BridgeEnd] = {}
         # connect-side ends awaiting their accept-side twin, keyed by
         # (host index, local port) — the accept-side establishment event
@@ -633,6 +648,28 @@ class ProcessDriver:
                 out.append((rev, data))
         return out
 
+    def _park(self, proc: ManagedProcess, pk: Parked) -> None:
+        """Park proc's in-flight syscall on pk (no reply is sent until a
+        wake or deadline; syscall_condition.c analog)."""
+        proc.parked = pk
+        proc.state = ManagedProcess.PARKED
+        if pk.deadline is not None:
+            self._schedule(pk.deadline, lambda: self._fire_deadline(proc, pk))
+
+    def _bend_send(self, proc: ManagedProcess, end: "BridgeEnd",
+                   chunk: bytes) -> int:
+        """Queue chunk on a device-carried TCP end (space already checked)
+        and notify the device machine; returns the byte count accepted."""
+        self.counters["packets_sent"] += 1
+        self.counters["bytes_sent"] += len(chunk)
+        self._track_tx(
+            proc.host, "tcp", end.local_addr, end.remote_addr, chunk,
+            dropped=False,
+        )
+        end.tx_queue += chunk
+        self.bridge.tcp_send(self.now, proc.host.index, end.slot, len(chunk))
+        return len(chunk)
+
     def _try_wake(self, proc: ManagedProcess) -> None:
         """If proc's parked condition is now satisfied, complete the syscall
         and resume it (condition wakeup -> process_continue analog)."""
@@ -662,6 +699,21 @@ class ProcessDriver:
             ):
                 proc.parked = None
                 self._resume(proc, 0)
+        elif pk.kind == "send":
+            sock = proc.fds.get(pk.fd)
+            if isinstance(sock, Sock) and sock.bend is not None:
+                end = sock.bend
+                if end.closed or not end.established:
+                    # connection torn down while the writer was blocked
+                    proc.parked = None
+                    self._resume(proc, -errno.EPIPE)
+                    return
+                space = end.send_space()
+                if space > 0:
+                    chunk = pk.data[:space]
+                    proc.parked = None
+                    n = self._bend_send(proc, end, chunk)
+                    self._resume(proc, n)
         elif pk.kind == "poll":
             results = [
                 self._poll_revents(proc, fd, ev) for fd, ev in pk.pollset
@@ -903,12 +955,7 @@ class ProcessDriver:
             ch.reply(ret, sim_time_ns=self.now, data=data)
 
         def park(pk: Parked) -> None:
-            proc.parked = pk
-            proc.state = ManagedProcess.PARKED
-            if pk.deadline is not None:
-                self._schedule(
-                    pk.deadline, lambda: self._fire_deadline(proc, pk)
-                )
+            self._park(proc, pk)
 
         # ---- time ----
         if sysno == SYS_clock_gettime:
@@ -1005,7 +1052,7 @@ class ProcessDriver:
                 end = BridgeEnd(
                     host=proc.host, slot=slot, sock=sock,
                     local_addr=sock.bound, remote_addr=(ip, port),
-                    born_t=self.now,
+                    sndbuf=self.socket_send_buffer, born_t=self.now,
                 )
                 sock.bend = end
                 sock.connecting = True
@@ -1420,17 +1467,23 @@ class ProcessDriver:
                 if not end.established or end.closed:
                     ch.reply(-errno.ENOTCONN, sim_time_ns=self.now)
                     return
-                self.counters["packets_sent"] += 1
-                self.counters["bytes_sent"] += len(payload)
-                self._track_tx(
-                    proc.host, "tcp", end.local_addr, end.remote_addr,
-                    payload, dropped=False,
-                )
-                end.tx_queue += payload
-                self.bridge.tcp_send(
-                    self.now, proc.host.index, end.slot, len(payload)
-                )
-                ch.reply(len(payload), sim_time_ns=self.now)
+                space = end.send_space()
+                if space == 0:
+                    # bounded send buffer: a writer outrunning the path
+                    # blocks (parks) or EAGAINs instead of buffering the
+                    # whole stream host-side; drains as the device reports
+                    # in-order advances (_bridge_bytes)
+                    if sock.nonblock:
+                        ch.reply(-errno.EAGAIN, sim_time_ns=self.now)
+                    else:
+                        self._park(
+                            proc,
+                            Parked(proc, "send", fd=sock.fd,
+                                   data=bytes(payload)),
+                        )
+                    return
+                n = self._bend_send(proc, end, payload[:space])
+                ch.reply(n, sim_time_ns=self.now)
                 return
             conn = sock.conn
             if conn is None or not conn.established:
@@ -1590,6 +1643,10 @@ class ProcessDriver:
         n = min(d.nbytes, len(end.peer.tx_queue))
         data = bytes(end.peer.tx_queue[:n])
         del end.peer.tx_queue[:n]
+        # freed send-buffer space: a writer parked (or polling POLLOUT)
+        # on the peer end can proceed
+        if n > 0 and end.peer.sock is not None:
+            self._try_wake(end.peer.sock.owner)
         end.rx += data
         self._track_rx(
             end.local_addr[0], "tcp", end.remote_addr, end.local_addr, data
@@ -1611,6 +1668,11 @@ class ProcessDriver:
         if end is None or not d.reset:
             return
         end.rx_eof = True
+        # The device already freed the slot: no further sends may reach it
+        # (a later tcp_send would cross-wire into whoever reuses the slot),
+        # and a writer parked on a full send buffer must error out now —
+        # no TcpBytes advance will ever free space again.
+        end.closed = True
         sock = end.sock
         if sock is None:
             return
@@ -1815,6 +1877,7 @@ class ProcessDriver:
                                 remote_addr=(
                                     self.hosts[d.peer_host].ip, d.peer_port
                                 ),
+                                sndbuf=self.socket_send_buffer,
                                 established=True,
                             )
                             self._dev_tcp[(d.host, d.slot)] = child

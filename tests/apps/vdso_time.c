@@ -1,0 +1,72 @@
+/* Calls clock_gettime THROUGH THE vDSO ENTRY POINT directly — the one time
+ * path neither libc interposition nor seccomp can see (vDSO calls never
+ * enter the kernel). The shim neutralizes it at init by patching the vDSO
+ * entry points into real syscall instructions; this program proves that by
+ * resolving __vdso_clock_gettime from the auxv ELF image and calling it.
+ * With the patch the printed value is the virtual clock (= process start
+ * time); without it, real wall-clock epoch time.
+ * Prints: "vdso t0 <ns>" and "vdso dt <ns>" (after a 100ms nanosleep). */
+#define _GNU_SOURCE
+#include <elf.h>
+#include <stdio.h>
+#include <string.h>
+#include <sys/auxv.h>
+#include <time.h>
+
+typedef int (*cg_fn)(clockid_t, struct timespec*);
+
+static cg_fn find_vdso_clock_gettime(void) {
+  unsigned long base = getauxval(AT_SYSINFO_EHDR);
+  if (!base) return 0;
+  const Elf64_Ehdr* eh = (const Elf64_Ehdr*)base;
+  const Elf64_Phdr* ph = (const Elf64_Phdr*)(base + eh->e_phoff);
+  unsigned long dyn = 0, load = (unsigned long)-1;
+  for (int i = 0; i < eh->e_phnum; i++) {
+    if (ph[i].p_type == PT_DYNAMIC) dyn = ph[i].p_vaddr;
+    if (ph[i].p_type == PT_LOAD && ph[i].p_vaddr < load) load = ph[i].p_vaddr;
+  }
+  if (!dyn || load == (unsigned long)-1) return 0;
+  unsigned long slide = base - load;
+  const Elf64_Sym* symtab = 0;
+  const char* strtab = 0;
+  for (const Elf64_Dyn* d = (const Elf64_Dyn*)(slide + dyn);
+       d->d_tag != DT_NULL; d++) {
+    unsigned long p = (unsigned long)d->d_un.d_ptr;
+    if (p < base) p += slide;
+    if (d->d_tag == DT_SYMTAB) symtab = (const Elf64_Sym*)p;
+    if (d->d_tag == DT_STRTAB) strtab = (const char*)p;
+  }
+  if (!symtab || !strtab || (unsigned long)strtab <= (unsigned long)symtab)
+    return 0;
+  unsigned long n = ((unsigned long)strtab - (unsigned long)symtab) /
+                    sizeof(Elf64_Sym);
+  for (unsigned long s = 0; s < n && s < 4096; s++) {
+    if (!symtab[s].st_value || !symtab[s].st_name) continue;
+    const char* nm = strtab + symtab[s].st_name;
+    if (strcmp(nm, "__vdso_clock_gettime") == 0 ||
+        strcmp(nm, "clock_gettime") == 0)
+      return (cg_fn)(slide + symtab[s].st_value);
+  }
+  return 0;
+}
+
+int main(void) {
+  cg_fn vcg = find_vdso_clock_gettime();
+  if (!vcg) {
+    printf("vdso absent\n");
+    return 2;
+  }
+  struct timespec ts;
+  if (vcg(CLOCK_REALTIME, &ts) != 0) {
+    printf("vdso call failed\n");
+    return 3;
+  }
+  long long t0 = (long long)ts.tv_sec * 1000000000LL + ts.tv_nsec;
+  printf("vdso t0 %lld\n", t0);
+  struct timespec req = {0, 100000000};
+  nanosleep(&req, 0);
+  vcg(CLOCK_REALTIME, &ts);
+  long long t1 = (long long)ts.tv_sec * 1000000000LL + ts.tv_nsec;
+  printf("vdso dt %lld\n", t1 - t0);
+  return 0;
+}

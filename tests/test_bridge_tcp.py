@@ -176,3 +176,34 @@ hosts:
     assert client.exit_code == 0, (client.stdout, client.stderr)
     assert b"all 6 connections done" in client.stdout
     assert b"total 24000 bytes over 6 connections" in server.stdout
+
+
+def test_tcp_send_backpressure_bounded_buffer(apps):
+    """ADVICE r1: device-carried sends must not buffer the whole stream
+    host-side. With a small socket_send_buffer the blocking writer parks at
+    the cap and drains as the device reports in-order advances: the
+    host-side tx_queue never exceeds the cap, and the transfer still
+    completes (reference analog: tcp.c bounded send buffer blocking the
+    writer)."""
+    yaml = _yaml(apps, lat_ms=20, nbytes=200000).replace(
+        "use_device_tcp: true",
+        "use_device_tcp: true\n  socket_send_buffer: 8192",
+    )
+    d = build_process_driver(yaml)
+    assert d.socket_send_buffer == 8192
+    peak = 0
+
+    def hb(drv):
+        nonlocal peak
+        for end in drv._dev_tcp.values():
+            peak = max(peak, len(end.tx_queue))
+
+    d.heartbeat_interval = 20 * NS_PER_MS
+    d.heartbeat_fn = hb
+    d.run()
+    client, server = d.procs
+    assert client.exit_code == 0, client.stderr
+    assert server.exit_code == 0, server.stderr
+    assert b"sent 200000 bytes" in client.stdout
+    assert b"received 200000 bytes" in server.stdout
+    assert 0 < peak <= 8192, f"host-side buffering exceeded sndbuf: {peak}"

@@ -64,3 +64,41 @@ def test_raw_syscalls_are_virtualized(apps):
     assert lines[1] == f"echo 0 at {int(1.31 * NS)}"
     assert lines[2] == f"echo 1 at {int(1.62 * NS)}"
     assert b"served 2" in server.stdout
+
+
+def _single_host_yaml(path):
+    return f"""
+general:
+  stop_time: 10 s
+  seed: 3
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        node [ id 0 bandwidth_down "100 Mbit" bandwidth_up "100 Mbit" ]
+        edge [ source 0 target 0 latency "30 ms" ]
+      ]
+hosts:
+  solo:
+    processes:
+      - path: {path}
+        start_time: 1 s
+"""
+
+
+def test_vdso_clock_is_neutralized(apps):
+    """A direct call into the vDSO's __vdso_clock_gettime — which never
+    enters the kernel and so is invisible to both libc interposition and
+    seccomp — must still read the VIRTUAL clock. The shim patches the vDSO
+    entry points into real syscall instructions at init (shim_patch_vdso);
+    this is the regression test for the ADVICE r1 vDSO determinism gap."""
+    d = build_process_driver(_single_host_yaml(apps["vdso_time"]))
+    d.run()
+    p = d.procs[0]
+    assert p.exit_code == 0, (p.stdout, p.stderr)
+    lines = p.stdout.decode().splitlines()
+    # virtual clock at process start (1 s), not wall-clock epoch time
+    assert lines[0] == f"vdso t0 {1 * NS}"
+    # the 100ms nanosleep advances the vDSO-read clock by exactly 100ms
+    assert lines[1] == f"vdso dt {100_000_000}"
