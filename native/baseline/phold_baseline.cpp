@@ -1,0 +1,229 @@
+// Reference-replica PHOLD baseline: the Shadow CPU scheduler's hot path,
+// re-implemented faithfully in C++ so the TPU engine has a real
+// reference-class number to beat on this machine.
+//
+// Why a replica and not the reference itself: this image has no cargo/rustc
+// (Shadow's config/worker layer is a Rust staticlib), no glib, no igraph,
+// and zero network egress to fetch them — the reference cannot build here.
+// This program replicates the exact structures its PHOLD benchmark
+// exercises (citations into /root/reference):
+//   * per-host event priority queues, each behind a lock
+//     (src/main/core/scheduler/scheduler_policy_host_single.c:18-54)
+//   * hosts sharded round-robin across worker pthreads
+//     (src/main/core/scheduler/scheduler.c:329-353)
+//   * conservative windows bounded by the min path latency, with a
+//     barrier + min-next-event-time reduction between rounds
+//     (src/main/core/controller.c:390-422, core/worker.c:332-363)
+//   * deterministic total order (time, dst, src, seq)
+//     (src/main/core/work/event.c:109-152)
+//   * cross-host sends: reliability roll, latency add, push to the
+//     destination host's locked queue (src/main/core/worker.c:517-576)
+//   * per-host seeded rand_r streams (src/main/utility/random.c:15-51)
+// The PHOLD workload itself mirrors src/test/phold: msgload initial
+// messages per host, each handled event forwards to a uniform-random
+// destination at now + latency while now < stop_send.
+//
+// Usage: phold_baseline <hosts> <msgload> <latency_ms> <runtime_s> <stop_s>
+//                       <workers> <seed>
+// Prints one JSON line with committed events, wall seconds, events/sec and
+// simulated-seconds per wall-second.
+
+#include <pthread.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <queue>
+#include <vector>
+
+namespace {
+
+struct Event {
+  int64_t time;
+  int32_t dst;
+  int32_t src;
+  int64_t seq;
+};
+
+// event.c:109-152 total order: time, then dst, then src, then sequence
+struct EventGreater {
+  bool operator()(const Event& a, const Event& b) const {
+    if (a.time != b.time) return a.time > b.time;
+    if (a.dst != b.dst) return a.dst > b.dst;
+    if (a.src != b.src) return a.src > b.src;
+    return a.seq > b.seq;
+  }
+};
+
+struct Host {
+  pthread_mutex_t lock;
+  std::priority_queue<Event, std::vector<Event>, EventGreater> q;
+  unsigned int rng;    // rand_r state (random.c analog)
+  int64_t seq_next;
+  int64_t committed;
+};
+
+struct Shared {
+  std::vector<Host>* hosts;
+  int64_t barrier_end;     // current window end (exclusive)
+  int64_t stop_send;
+  int64_t stop;
+  int64_t latency;
+  int nworkers;
+  pthread_barrier_t round_barrier;
+  std::vector<int64_t>* min_next;  // per-worker min next event time
+  std::atomic<bool> done;
+};
+
+constexpr int64_t NEVER = INT64_MAX;
+
+struct WorkerArg {
+  Shared* sh;
+  int id;
+};
+
+void* worker_main(void* vp) {
+  WorkerArg* wa = (WorkerArg*)vp;
+  Shared* sh = wa->sh;
+  std::vector<Host>& hosts = *sh->hosts;
+  const int H = (int)hosts.size();
+  const int W = sh->nworkers;
+  const int id = wa->id;
+
+  while (true) {
+    pthread_barrier_wait(&sh->round_barrier);  // round begin
+    if (sh->done.load(std::memory_order_relaxed)) return nullptr;
+    const int64_t wend = sh->barrier_end;
+    int64_t my_min = NEVER;
+    // _scheduler_runEventsWorkerTaskFn analog: each worker drains its
+    // hosts' queues up to the barrier (scheduler.c:77-94)
+    for (int h = id; h < H; h += W) {
+      Host& host = hosts[h];
+      while (true) {
+        pthread_mutex_lock(&host.lock);
+        if (host.q.empty() || host.q.top().time >= wend) {
+          if (!host.q.empty())
+            my_min = std::min(my_min, host.q.top().time);
+          pthread_mutex_unlock(&host.lock);
+          break;
+        }
+        Event ev = host.q.top();
+        host.q.pop();
+        pthread_mutex_unlock(&host.lock);
+        host.committed++;
+        if (ev.time < sh->stop_send) {
+          // forward to a uniform random other host (test_phold.c analog)
+          unsigned int r = rand_r(&host.rng);
+          int dst = (int)((uint64_t)r * (uint64_t)(H - 1) / ((uint64_t)RAND_MAX + 1));
+          if (dst >= h) dst++;
+          // reliability roll placeholder (loss 0 in the PHOLD graph, but
+          // the reference still rolls: worker.c:539-545)
+          (void)rand_r(&host.rng);
+          Event ne{ev.time + sh->latency, dst, h, 0};
+          Host& dh = hosts[dst];
+          // scheduler_push analog: lock the DESTINATION queue
+          pthread_mutex_lock(&dh.lock);
+          ne.seq = dh.seq_next++;
+          dh.q.push(ne);
+          pthread_mutex_unlock(&dh.lock);
+          // The PUSHER records the new event's time: the destination's
+          // owner may already have swept past an empty queue this round,
+          // so relying on per-queue observation alone could reduce to
+          // NEVER with live events still queued (worker.c:332-363 has the
+          // same push-side min update).
+          my_min = std::min(my_min, ne.time);
+        }
+      }
+    }
+    (*sh->min_next)[id] = my_min;
+    pthread_barrier_wait(&sh->round_barrier);  // round end
+  }
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  const int H = argc > 1 ? atoi(argv[1]) : 1024;
+  const int msgload = argc > 2 ? atoi(argv[2]) : 2;
+  const int64_t latency_ms = argc > 3 ? atoll(argv[3]) : 50;
+  const int64_t runtime_s = argc > 4 ? atoll(argv[4]) : 8;
+  const int64_t stop_s = argc > 5 ? atoll(argv[5]) : 10;
+  int nworkers = argc > 6 ? atoi(argv[6]) : 0;
+  const unsigned seed = argc > 7 ? (unsigned)atoi(argv[7]) : 42;
+  if (nworkers <= 0) {
+    long n = sysconf(_SC_NPROCESSORS_ONLN);
+    nworkers = n > 0 ? (int)n : 1;
+  }
+  if (nworkers > H) nworkers = H;
+
+  const int64_t NS = 1000000000LL;
+  const int64_t latency = latency_ms * 1000000LL;
+  const int64_t start = NS;  // processes start at 1s like the flagship
+  const int64_t stop_send = start + runtime_s * NS;
+  const int64_t stop = stop_s * NS;
+
+  std::vector<Host> hosts(H);
+  for (int h = 0; h < H; h++) {
+    pthread_mutex_init(&hosts[h].lock, nullptr);
+    hosts[h].rng = seed * 2654435761u + (unsigned)h;  // per-host stream
+    hosts[h].seq_next = 0;
+    hosts[h].committed = 0;
+    for (int m = 0; m < msgload; m++)
+      hosts[h].q.push(Event{start, h, h, hosts[h].seq_next++});
+  }
+
+  Shared sh;
+  sh.hosts = &hosts;
+  sh.stop_send = stop_send;
+  sh.stop = stop;
+  sh.latency = latency;
+  sh.nworkers = nworkers;
+  sh.done.store(false);
+  std::vector<int64_t> min_next(nworkers, NEVER);
+  sh.min_next = &min_next;
+  pthread_barrier_init(&sh.round_barrier, nullptr, nworkers + 1);
+
+  std::vector<pthread_t> tids(nworkers);
+  std::vector<WorkerArg> args(nworkers);
+  for (int w = 0; w < nworkers; w++) {
+    args[w] = WorkerArg{&sh, w};
+    pthread_create(&tids[w], nullptr, worker_main, &args[w]);
+  }
+
+  auto t0 = std::chrono::steady_clock::now();
+  int64_t window_start = start;
+  int64_t windows = 0;
+  // controller_managerFinishedCurrentRound analog (controller.c:390-422):
+  // window = [minNextEventTime, minNextEventTime + runahead)
+  while (window_start < stop) {
+    sh.barrier_end = std::min(window_start + latency, stop);
+    pthread_barrier_wait(&sh.round_barrier);  // release workers
+    pthread_barrier_wait(&sh.round_barrier);  // wait for round end
+    windows++;
+    int64_t mn = NEVER;
+    for (int w = 0; w < nworkers; w++) mn = std::min(mn, min_next[w]);
+    if (mn == NEVER) break;
+    window_start = mn;
+  }
+  sh.done.store(true);
+  pthread_barrier_wait(&sh.round_barrier);
+  for (int w = 0; w < nworkers; w++) pthread_join(tids[w], nullptr);
+  auto t1 = std::chrono::steady_clock::now();
+
+  int64_t committed = 0;
+  for (int h = 0; h < H; h++) committed += hosts[h].committed;
+  double wall = std::chrono::duration<double>(t1 - t0).count();
+  double sim_s = (double)(stop - start) / 1e9;
+  printf(
+      "{\"baseline\": \"shadow-replica-cpp\", \"hosts\": %d, "
+      "\"msgload\": %d, \"workers\": %d, \"windows\": %lld, "
+      "\"events_committed\": %lld, \"wall_s\": %.3f, "
+      "\"events_per_sec\": %.0f, \"sim_per_wall\": %.3f}\n",
+      H, msgload, nworkers, (long long)windows, (long long)committed, wall,
+      (double)committed / wall, sim_s / wall);
+  return 0;
+}

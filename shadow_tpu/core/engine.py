@@ -189,13 +189,15 @@ def _sort_window(pool: EventPool, win_end, H: int, K: int):
 
     Events beyond K per host are deferred to the next window (their keys are
     strictly larger than every extracted event's, so per-host order holds).
-    Also returns defer_time[H]: the earliest DEFERRED event time per host
-    (NEVER if none) — self-emissions at or past it must bypass the inbox and
-    go to the pool, otherwise they could be processed ahead of the deferred
-    leftover. (Known tie edge: a leftover and an extracted event at the
-    exact same nanosecond can still invert against a same-time
-    self-emission; requires K overflow + an exact time tie, and K is
-    configurable — tracked for an exact re-extraction fix.)
+    Also returns the FULL key (time, src, seq), each [H], of the earliest
+    DEFERRED event per host (time NEVER if none): a self-emission whose own
+    key (time, emitting host, seq) is >= that deferred key must bypass the
+    inbox and go to the pool, otherwise it could be processed ahead of the
+    deferred leftover. Comparing the full key (not just the time) makes the
+    routing exact under nanosecond ties: an emission tied on time with the
+    deferred leftover still interleaves correctly against the extracted
+    same-time events via the (src, seq) tiebreak — the order the pool sort
+    would produce.
 
     TPU note: sorts and gathers only — XLA scatters serialize
     element-by-element on TPU (~0.5 µs each), so a single [C]-row scatter
@@ -224,12 +226,13 @@ def _sort_window(pool: EventPool, win_end, H: int, K: int):
         starts=starts,
         ends=ends,
     )
-    # Earliest deferred (rank >= K) per host; NEVER if the host fit in K.
+    # Earliest deferred (rank >= K) per host; time NEVER if the host fit.
     has_defer = (starts + K) < ends
-    defer_time = jnp.where(
-        has_defer, s_time[jnp.where(has_defer, starts + K, 0)], NEVER
-    )
-    return sw, defer_time
+    didx = jnp.where(has_defer, starts + K, 0)
+    defer_time = jnp.where(has_defer, s_time[didx], NEVER)
+    defer_src = jnp.where(has_defer, s_src[didx], 0)
+    defer_seq = jnp.where(has_defer, s_seq[didx], 0)
+    return sw, (defer_time, defer_src, defer_seq)
 
 
 def _inbox_min(inbox: _Inbox):
@@ -312,9 +315,43 @@ def make_window_step(
     def step(state: SimState, params: NetParams, win_start, win_end):
         win_start = jnp.asarray(win_start, jnp.int64)
         win_end = jnp.asarray(win_end, jnp.int64)
-        sw, defer_time = _sort_window(state.pool, win_end, H, K)
+        sw, (defer_time, defer_src, defer_seq) = _sort_window(
+            state.pool, win_end, H, K
+        )
         pool_payload = state.pool.payload
         state = state.replace(now=win_start)
+
+        # Static per-kind emission bound: probe the handlers once at trace
+        # time with an all-masked-off event and count emit() calls per
+        # kind. A host processes exactly ONE event (of one kind) per
+        # iteration, so its worst-case outbox demand is the emit-call count
+        # of THAT kind's handler. The backpressure below stalls a host
+        # whose outbox can't absorb that demand — nothing is ever dropped.
+        # The probe's state/ops are discarded (XLA dead-code-eliminates).
+        probe = Emitter()
+        pv = EventView(
+            mask=jnp.zeros((H,), jnp.bool_),
+            time=jnp.zeros((H,), jnp.int64),
+            src=jnp.zeros((H,), jnp.int32),
+            seq=jnp.zeros((H,), jnp.int32),
+            kind=jnp.zeros((H,), jnp.int32),
+            payload=jnp.zeros((H, PAYLOAD_WORDS), jnp.int32),
+        )
+        E_by_kind = np.zeros(max(kinds) + 1 if kinds else 1, dtype=np.int32)
+        pstate = state
+        for k in kinds:
+            before = len(probe.records)
+            pstate = handlers[k](pstate, pv, probe, params)
+            E_by_kind[k] = len(probe.records) - before
+        del pstate
+        if int(E_by_kind.max()) > O:
+            worst = int(E_by_kind.argmax())
+            raise ValueError(
+                f"outbox_slots O={O} cannot absorb kind {worst}'s worst-"
+                f"case emissions E={int(E_by_kind.max())}; raise "
+                f"experimental.outbox_slots"
+            )
+        E_arr = jnp.asarray(E_by_kind, jnp.int32)
         carry0 = (
             state,
             jnp.zeros((H,), dtype=jnp.int32),  # ptr (consumed per host)
@@ -340,11 +377,20 @@ def make_window_step(
             i_time, i_src, i_seq, i_slot = _inbox_min(inbox)
             use_inbox = _key_lt(i_time, i_src, i_seq, m_time, m_src, m_seq)
             ev_time = jnp.where(use_inbox, i_time, m_time)
-            valid = ev_time < win_end
 
             m_kind = sw.kind[hp]
-            m_payload = pool_payload[sw.idx[hp]]
             i_kind = jnp.take_along_axis(inbox.kind, i_slot[:, None], axis=1)[:, 0]
+            ev_kind = jnp.where(use_inbox, i_kind, m_kind)
+            # Outbox backpressure: a host whose outbox cannot absorb this
+            # event-kind's worst-case emissions stalls — its events stay
+            # queued and defer to the next window via the merge (never
+            # dropped).
+            need = E_arr[jnp.clip(ev_kind, 0, E_arr.shape[0] - 1)]
+            room = (outbox.count + need) <= O
+            valid = (ev_time < win_end) & room
+            stalled = (ev_time < win_end) & ~room
+
+            m_payload = pool_payload[sw.idx[hp]]
             i_payload = jnp.take_along_axis(
                 inbox.payload, i_slot[:, None, None], axis=1
             )[:, 0, :]
@@ -353,7 +399,7 @@ def make_window_step(
                 time=ev_time,
                 src=jnp.where(use_inbox, i_src, m_src),
                 seq=jnp.where(use_inbox, i_seq, m_seq),
-                kind=jnp.where(use_inbox, i_kind, m_kind),
+                kind=ev_kind,
                 payload=jnp.where(use_inbox[:, None], i_payload, m_payload),
             )
 
@@ -377,7 +423,9 @@ def make_window_step(
             state = state.replace(
                 counters=state.counters.replace(
                     events_committed=state.counters.events_committed
-                    + jnp.sum(valid, dtype=jnp.int64)
+                    + jnp.sum(valid, dtype=jnp.int64),
+                    outbox_stall_deferred=state.counters.outbox_stall_deferred
+                    + jnp.sum(stalled, dtype=jnp.int64),
                 )
             )
 
@@ -389,13 +437,15 @@ def make_window_step(
                         seq_next=jnp.where(em.mask, seq + 1, seq)
                     )
                 )
-                # Self-emissions past the host's earliest deferred leftover
-                # must not jump the queue: route them through the pool.
+                # Self-emissions at or past the host's earliest deferred
+                # leftover (full-key compare: exact under time ties) must
+                # not jump the queue: route them through the pool.
                 is_self = (
                     em.mask
                     & (em.dst == hosts)
                     & (em.time < win_end)
-                    & (em.time < defer_time)
+                    & _key_lt(em.time, hosts, seq,
+                              defer_time, defer_src, defer_seq)
                 )
 
                 free = inbox.time == NEVER  # [H, B]
@@ -447,8 +497,9 @@ def make_window_step(
         # one sort by time (gathers only — no scatters, which serialize on
         # TPU). A sorted row is consumed iff its rank within its host's run
         # is below that host's final cursor — pure elementwise, no inverse
-        # permutation needed. Inbox leftovers only exist if max_iters capped
-        # the loop; deferring them is a correct (if slower) schedule.
+        # permutation needed. Inbox leftovers exist if max_iters capped the
+        # loop or a host stalled on outbox backpressure; deferring them is a
+        # correct (if slower) schedule.
         pool = state.pool
         C = pool.capacity
         spos = jnp.arange(C, dtype=jnp.int32)

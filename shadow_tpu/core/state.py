@@ -13,8 +13,10 @@ Capacities are static (compiled into the kernel):
     B  self-inbox slots (intra-window self-emitted events, e.g. short timers)
     O  outbox slots per host per window (emissions buffered until merge)
     P  payload words per event (packet header fields)
-Overflow never corrupts the sim: it drops the latest-keyed work and counts it
-in `Counters`, mirroring the reference's drop-and-count philosophy.
+Overflow never corrupts the sim: inbox/outbox pressure DEFERS work to later
+windows (backpressure stalls the host, nothing is lost); only event-pool
+capacity overflow drops, and that is counted in `Counters` and asserted
+zero by the benchmarks.
 """
 
 from __future__ import annotations
@@ -88,8 +90,11 @@ class Counters:
     packets_dropped_loss: jnp.ndarray  # reliability roll failures (worker.c:539)
     packets_dropped_unreachable: jnp.ndarray
     pool_overflow_dropped: jnp.ndarray
-    outbox_overflow_dropped: jnp.ndarray
+    outbox_overflow_dropped: jnp.ndarray  # structurally 0 (backpressure)
     inbox_overflow_deferred: jnp.ndarray
+    # iterations a host sat out because its outbox couldn't absorb one
+    # iteration's worst-case emissions; the work defers, never drops
+    outbox_stall_deferred: jnp.ndarray
     bytes_sent: jnp.ndarray
     bytes_delivered: jnp.ndarray
 

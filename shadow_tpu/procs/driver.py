@@ -704,16 +704,24 @@ class ProcessDriver:
             if isinstance(sock, Sock) and sock.bend is not None:
                 end = sock.bend
                 if end.closed or not end.established:
-                    # connection torn down while the writer was blocked
+                    # connection torn down while the writer was blocked:
+                    # report bytes already accepted, else the error
                     proc.parked = None
-                    self._resume(proc, -errno.EPIPE)
+                    self._resume(
+                        proc, pk.want if pk.want > 0 else -errno.EPIPE
+                    )
                     return
                 space = end.send_space()
                 if space > 0:
                     chunk = pk.data[:space]
-                    proc.parked = None
-                    n = self._bend_send(proc, end, chunk)
-                    self._resume(proc, n)
+                    self._bend_send(proc, end, chunk)
+                    pk.want += len(chunk)
+                    pk.data = pk.data[len(chunk):]
+                    if not pk.data:
+                        # whole payload buffered: blocking send completes
+                        # with the full count (Linux stream semantics)
+                        proc.parked = None
+                        self._resume(proc, pk.want)
         elif pk.kind == "poll":
             results = [
                 self._poll_revents(proc, fd, ev) for fd, ev in pk.pollset
@@ -1468,22 +1476,32 @@ class ProcessDriver:
                     ch.reply(-errno.ENOTCONN, sim_time_ns=self.now)
                     return
                 space = end.send_space()
-                if space == 0:
-                    # bounded send buffer: a writer outrunning the path
-                    # blocks (parks) or EAGAINs instead of buffering the
-                    # whole stream host-side; drains as the device reports
-                    # in-order advances (_bridge_bytes)
-                    if sock.nonblock:
-                        ch.reply(-errno.EAGAIN, sim_time_ns=self.now)
-                    else:
-                        self._park(
-                            proc,
-                            Parked(proc, "send", fd=sock.fd,
-                                   data=bytes(payload)),
-                        )
+                if space >= len(payload):
+                    n = self._bend_send(proc, end, payload)
+                    ch.reply(n, sim_time_ns=self.now)
                     return
-                n = self._bend_send(proc, end, payload[:space])
-                ch.reply(n, sim_time_ns=self.now)
+                # Bounded send buffer (reference: tcp.c blocks the writer).
+                # Nonblocking: partial accept or EAGAIN. Blocking: Linux
+                # stream semantics — queue what fits now, park with the
+                # remainder, and reply with the FULL count only once
+                # everything is buffered (drains as the device reports
+                # in-order advances, _bridge_bytes -> _try_wake).
+                if sock.nonblock:
+                    if space > 0:
+                        n = self._bend_send(proc, end, payload[:space])
+                        ch.reply(n, sim_time_ns=self.now)
+                    else:
+                        ch.reply(-errno.EAGAIN, sim_time_ns=self.now)
+                    return
+                accepted = (
+                    self._bend_send(proc, end, payload[:space])
+                    if space > 0 else 0
+                )
+                self._park(
+                    proc,
+                    Parked(proc, "send", fd=sock.fd,
+                           data=bytes(payload[space:]), want=accepted),
+                )
                 return
             conn = sock.conn
             if conn is None or not conn.established:

@@ -292,3 +292,116 @@ def test_intra_window_self_events_processed_in_order():
     assert list(trace["times"][0][:3]) == [1 * MS, 3 * MS, 5 * MS]
     assert trace["n"][0] == 3
     assert list(trace["times"][1][:1]) == [5 * MS]
+
+
+def test_k_overflow_time_tie_exact_order():
+    """The exact-tie edge the round-1 kernel documented as unfixed: a
+    self-emission landing at EXACTLY the earliest deferred leftover's
+    nanosecond must still interleave correctly against extracted same-time
+    events — the full-key (time, src, seq) compare routes it through the
+    inbox iff it precedes the deferred leftover."""
+    H = 4
+    T = 8
+    TIE = 20 * MS
+
+    def record(state, ev, emitter, params):
+        sub = dict(state.subs["trace"])
+        n = sub["n"]
+        hosts = jnp.arange(H, dtype=jnp.int32)
+        slot = jnp.where(ev.mask, jnp.clip(n, 0, T - 1), T)
+        sub["srcs"] = sub["srcs"].at[hosts, slot].set(ev.src, mode="drop")
+        sub["n"] = n + ev.mask.astype(jnp.int32)
+        subs = dict(state.subs)
+        subs["trace"] = sub
+        return state.replace(subs=subs)
+
+    def timer_then_emit(state, ev, emitter, params):
+        state = record(state, ev, emitter, params)
+        hosts = jnp.arange(H, dtype=jnp.int32)
+        # lands at exactly the deferred leftover's time (10ms + 10ms = TIE)
+        emitter.emit(
+            ev.mask, ev.time + 10 * MS, hosts, jnp.int32(KIND_APP_MSG),
+            ev.payload,
+        )
+        return state
+
+    params = make_params(H, 50 * MS)
+    sim = Simulation(
+        num_hosts=H,
+        handlers={KIND_APP_TIMER: timer_then_emit, KIND_APP_MSG: record},
+        params=params,
+        host_vertex=np.zeros(H, dtype=np.int32),
+        seed=1,
+        stop_time=SEC,
+        runahead=50 * MS,
+        event_capacity=64,
+        K=2,  # extracts (10ms,src1), (TIE,src2); defers (TIE,src3)
+        B=4,
+        O=8,
+        subs={
+            "trace": {
+                "srcs": jnp.full((H, T), -1, dtype=jnp.int32),
+                "n": jnp.zeros((H,), dtype=jnp.int32),
+            }
+        },
+        initial_events=[
+            (10 * MS, 0, 1, KIND_APP_TIMER, []),  # emits MSG at TIE, src=0
+            (TIE, 0, 2, KIND_APP_MSG, []),
+            (TIE, 0, 3, KIND_APP_MSG, []),  # deferred leftover (rank K)
+        ],
+    )
+    sim.run_stepwise()
+    trace = jax.device_get(sim.state.subs["trace"])
+    # Correct total order at host 0 among the TIE-time events is by src:
+    # the self-emission (src 0) BEFORE src 2 and src 3.
+    assert list(trace["srcs"][0][:4]) == [1, 0, 2, 3]
+    assert trace["n"][0] == 4
+
+
+def test_outbox_overflow_defers_never_drops():
+    """Outbox pressure must stall the host (deferring its remaining events
+    to later windows), not drop emissions: every message is delivered and
+    outbox_overflow_dropped stays zero (round-1 verdict hole #6b)."""
+    H = 2
+    N = 10  # events on host 0, each emitting one cross-host message
+
+    def count_rx(state, ev, emitter, params):
+        sub = dict(state.subs["trace"])
+        sub["rx"] = sub["rx"] + ev.mask.astype(jnp.int32)
+        subs = dict(state.subs)
+        subs["trace"] = sub
+        return state.replace(subs=subs)
+
+    def emit_cross(state, ev, emitter, params):
+        hosts = jnp.arange(H, dtype=jnp.int32)
+        emitter.emit(
+            ev.mask, ev.time + 60 * MS, (hosts + 1) % H,
+            jnp.int32(KIND_APP_MSG), ev.payload,
+        )
+        return state
+
+    params = make_params(H, 50 * MS)
+    sim = Simulation(
+        num_hosts=H,
+        handlers={KIND_APP_TIMER: emit_cross, KIND_APP_MSG: count_rx},
+        params=params,
+        host_vertex=np.zeros(H, dtype=np.int32),
+        seed=1,
+        stop_time=SEC,
+        runahead=50 * MS,
+        event_capacity=64,
+        K=16,
+        B=4,
+        O=4,  # absorbs 4 emissions per window, then backpressure
+        subs={"trace": {"rx": jnp.zeros((H,), dtype=jnp.int32)}},
+        initial_events=[
+            (i * MS, 0, 0, KIND_APP_TIMER, []) for i in range(1, N + 1)
+        ],
+    )
+    sim.run_stepwise()
+    trace = jax.device_get(sim.state.subs["trace"])
+    c = sim.counters()
+    assert int(trace["rx"][1]) == N, (trace, c)
+    assert c["outbox_overflow_dropped"] == 0
+    assert c["outbox_stall_deferred"] > 0  # the path was actually forced
+    assert c["pool_overflow_dropped"] == 0
