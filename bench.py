@@ -88,7 +88,8 @@ def cpp_phold_baseline(num_hosts: int, msgload: int, stop_s: int,
 
 def _run_stage(stage: str, app_model: str, loss: float, app_options: dict,
                extra_counters: tuple = (), num_hosts: int = 10240,
-               stop_s: int = 4, event_capacity: int = 1 << 15):
+               stop_s: int = 4, event_capacity: int = 1 << 15,
+               extra_experimental: dict | None = None):
     """Build, warm up (compile + bootstrap), then time the remaining sim
     span. Warm-up-committed events are subtracted so the reported rate and
     sim/wall ratio cover only the timed segment."""
@@ -112,6 +113,12 @@ def _run_stage(stage: str, app_model: str, loss: float, app_options: dict,
             "event_capacity": event_capacity,
             "events_per_host_per_window": 16,
             "outbox_slots": 16,
+            # ring/inbox capacities sized to the stage's queue depths:
+            # every slot is a full [H, slots, P] write per update, so
+            # oversizing is pure memory traffic
+            "router_queue_slots": 16,
+            "inbox_slots": 4,
+            **(extra_experimental or {}),
         },
         "hosts": {
             "server": {"quantity": n_servers, "app_model": app_model,
@@ -166,6 +173,9 @@ def stage_tcp_bulk(num_hosts: int = 10240, stop_s: int = 4):
         # in-flight population ~25 events/client (cwnd segments + ACKs +
         # pump/timer events): 1 << 16 measurably overflows, 1 << 18 does not
         num_hosts=num_hosts, stop_s=stop_s, event_capacity=1 << 18,
+        # TCP self-events (timers + pumps) need more inbox headroom than
+        # the UDP stage
+        extra_experimental={"inbox_slots": 8},
     )
 
 

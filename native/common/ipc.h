@@ -44,6 +44,17 @@ enum PseudoSys : int64_t {
   PSYS_RESOLVE_NAME = -100,  // data = hostname; ret = ipv4 (host order)
   PSYS_YIELD = -101,         // report-in; lets the driver advance sim time
   PSYS_GETHOSTNAME = -102,   // reply data = this host's simulated name
+  // threads / processes (reference analogs: thread_preload.c:358-400 clone
+  // bootstrap, process.c:460-531 fork/exec)
+  PSYS_THREAD_NEW = -103,   // reply data = new thread's channel shm name
+  PSYS_THREAD_EXIT = -104,  // this thread is done (no reply expected data)
+  PSYS_FORK = -105,         // reply data = child process's channel shm name
+  PSYS_EXEC = -106,         // args[0]=0; caller is about to execve natively
+  // futex-class blocking (reference: futex.c:19-30, syscall/futex.c); the
+  // shim reads the futex word itself (same address space), the driver only
+  // parks/wakes threads keyed by (process, uaddr)
+  PSYS_FUTEX_WAIT = -107,  // args: uaddr, timeout_ns (-1 none); ret 0/ETIMEDOUT
+  PSYS_FUTEX_WAKE = -108,  // args: uaddr, n; ret = number woken
 };
 
 #pragma pack(push, 8)

@@ -43,6 +43,8 @@
 #include "../common/ipc.h"
 
 #include <arpa/inet.h>
+#include <atomic>
+#include <dlfcn.h>
 #include <elf.h>
 #include <errno.h>
 #include <fcntl.h>
@@ -125,10 +127,27 @@ long sys_native(long n, Args... args) {
   return r;
 }
 
-Channel* g_ch = nullptr;
+Channel* g_ch = nullptr;  // process-primary channel (thread 0's)
 long g_spin = 8192;
 int g_debug = 0;
-pthread_mutex_t g_lock = PTHREAD_MUTEX_INITIALIZER;
+// Thread-local channel: every pthread_create'd thread gets its OWN shm
+// channel from the driver (reference analog: per-thread IPC blocks,
+// thread_preload.c:131-179). Threads without one (e.g. raw clone) share
+// g_ch under a raw spinlock — NOT a pthread mutex, because pthread mutexes
+// are interposed below and their contended path relays through ipc_call.
+__thread Channel* t_ch = nullptr;
+std::atomic_flag g_ch_lock = ATOMIC_FLAG_INIT;
+
+inline Channel* cur_channel() { return t_ch ? t_ch : g_ch; }
+
+void raw_lock(std::atomic_flag* f) {
+  while (f->test_and_set(std::memory_order_acquire)) {
+#if defined(__x86_64__)
+    __builtin_ia32_pause();
+#endif
+  }
+}
+void raw_unlock(std::atomic_flag* f) { f->clear(std::memory_order_release); }
 
 #define SHIM_LOG(...)                                 \
   do {                                                \
@@ -150,30 +169,32 @@ void shim_patch_vdso();       // defined at the bottom
 int64_t ipc_call(int64_t sysno, const int64_t args[6], const void* data_in,
                  uint32_t data_in_len, void* data_out, uint32_t data_out_cap,
                  uint32_t* data_out_len) {
-  if (!g_ch) {
+  Channel* ch = cur_channel();
+  if (!ch) {
     errno = ENOSYS;
     return -1;
   }
-  pthread_mutex_lock(&g_lock);
-  g_ch->type = MSG_SYSCALL;
-  g_ch->sysno = sysno;
-  for (int i = 0; i < 6; i++) g_ch->args[i] = args ? args[i] : 0;
+  const bool shared = (ch == g_ch) && (t_ch != g_ch);
+  if (shared) raw_lock(&g_ch_lock);
+  ch->type = MSG_SYSCALL;
+  ch->sysno = sysno;
+  for (int i = 0; i < 6; i++) ch->args[i] = args ? args[i] : 0;
   uint32_t n = data_in_len > IPC_DATA_MAX ? IPC_DATA_MAX : data_in_len;
-  g_ch->data_len = (int32_t)n;
-  if (n && data_in) memcpy(g_ch->data, data_in, n);
-  sem_post(&g_ch->to_driver);
-  sem_wait_spinning(&g_ch->to_shim, g_spin);
+  ch->data_len = (int32_t)n;
+  if (n && data_in) memcpy(ch->data, data_in, n);
+  sem_post(&ch->to_driver);
+  sem_wait_spinning(&ch->to_shim, g_spin);
 
-  int64_t ret = g_ch->ret;
-  int32_t mtype = g_ch->type;
+  int64_t ret = ch->ret;
+  int32_t mtype = ch->type;
   uint32_t out_n = 0;
-  if (data_out && g_ch->data_len > 0) {
-    out_n = (uint32_t)g_ch->data_len;
+  if (data_out && ch->data_len > 0) {
+    out_n = (uint32_t)ch->data_len;
     if (out_n > data_out_cap) out_n = data_out_cap;
-    memcpy(data_out, g_ch->data, out_n);
+    memcpy(data_out, ch->data, out_n);
   }
   if (data_out_len) *data_out_len = out_n;
-  pthread_mutex_unlock(&g_lock);
+  if (shared) raw_unlock(&g_ch_lock);
 
   if (mtype == MSG_STOP) {
     SHIM_LOG("driver requested stop");
@@ -241,16 +262,15 @@ __attribute__((constructor)) void shim_init() {
     return;
   }
   g_ch = (Channel*)p;
+  t_ch = g_ch;  // the main thread owns the primary channel
   g_ch->shim_pid = getpid();
   SHIM_LOG("attached, channel=%s", path);
   // HELLO round trip: driver replies with the current sim time
-  pthread_mutex_lock(&g_lock);
   g_ch->type = MSG_HELLO;
   g_ch->ret = getpid();
   g_ch->data_len = 0;
   sem_post(&g_ch->to_driver);
   sem_wait_spinning(&g_ch->to_shim, g_spin);
-  pthread_mutex_unlock(&g_lock);
   const char* sec = getenv(ENV_SECCOMP);
   if (!sec || strcmp(sec, "0") != 0) {
     shim_patch_vdso();  // before the filter: time must reach the kernel

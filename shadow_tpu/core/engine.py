@@ -107,6 +107,45 @@ class Emitter:
 Handler = Callable[[SimState, EventView, Emitter, NetParams], SimState]
 
 
+@struct.dataclass
+class MatrixEventView:
+    """A whole window of same-kind events per host, [H, K]-shaped, for the
+    vectorized fast path (engine `run_matrix`). Column order is per-host
+    key order; mask marks real events."""
+
+    mask: jnp.ndarray  # [H, K] bool
+    time: jnp.ndarray  # [H, K] i64
+    src: jnp.ndarray  # [H, K] i32
+    seq: jnp.ndarray  # [H, K] i32
+    payload: jnp.ndarray  # [H, K, P] i32
+
+
+class MatrixRecord(NamedTuple):
+    mask: jnp.ndarray  # [H, K] bool
+    time: jnp.ndarray  # [H, K] i64
+    dst: jnp.ndarray  # [H, K] i32
+    kind: jnp.ndarray  # [H, K] i32
+    payload: jnp.ndarray  # [H, K, P] i32
+
+
+class MatrixEmitter:
+    """Collects [H, K]-shaped emissions from a matrix handler; the engine
+    assigns per-source sequence numbers in (column-major, record-minor)
+    order — identical to the loop path's per-event emission order."""
+
+    def __init__(self):
+        self.records: list[MatrixRecord] = []
+
+    def emit(self, mask, time, dst, kind, payload):
+        kind = jnp.broadcast_to(jnp.asarray(kind, jnp.int32), mask.shape)
+        self.records.append(
+            MatrixRecord(
+                mask, time.astype(jnp.int64), dst.astype(jnp.int32), kind,
+                payload,
+            )
+        )
+
+
 def draw_uniform(state: SimState, mask):
     """One deterministic uniform draw per masked host; bumps draw counters
     only where masked (so inactive hosts' streams don't advance — matching a
@@ -131,13 +170,13 @@ class _Inbox:
     payload: jnp.ndarray  # [H, B, P]
 
     @classmethod
-    def empty(cls, H, B):
+    def empty(cls, H, B, P=PAYLOAD_WORDS):
         return cls(
             time=jnp.full((H, B), NEVER, dtype=jnp.int64),
             src=jnp.zeros((H, B), dtype=jnp.int32),
             seq=jnp.zeros((H, B), dtype=jnp.int32),
             kind=jnp.zeros((H, B), dtype=jnp.int32),
-            payload=jnp.zeros((H, B, PAYLOAD_WORDS), dtype=jnp.int32),
+            payload=jnp.zeros((H, B, P), dtype=jnp.int32),
         )
 
 
@@ -152,14 +191,14 @@ class _Outbox:
     count: jnp.ndarray  # [H] i32
 
     @classmethod
-    def empty(cls, H, O):
+    def empty(cls, H, O, P=PAYLOAD_WORDS):
         return cls(
             time=jnp.full((H, O), NEVER, dtype=jnp.int64),
             dst=jnp.zeros((H, O), dtype=jnp.int32),
             src=jnp.zeros((H, O), dtype=jnp.int32),
             seq=jnp.zeros((H, O), dtype=jnp.int32),
             kind=jnp.zeros((H, O), dtype=jnp.int32),
-            payload=jnp.zeros((H, O, PAYLOAD_WORDS), dtype=jnp.int32),
+            payload=jnp.zeros((H, O, P), dtype=jnp.int32),
             count=jnp.zeros((H,), dtype=jnp.int32),
         )
 
@@ -300,24 +339,44 @@ def make_window_step(
     B: int = 8,
     O: int = 64,
     max_iters: int | None = None,
+    bulk_kinds: dict[int, int] | None = None,
+    matrix_handlers: dict[int, Callable] | None = None,
+    _force_path: str | None = None,  # "matrix"|"loop": testing/profiling only
 ):
     """Build step(state, params, win_start, win_end) -> (state, min_next).
 
     ``handlers`` maps event kind → handler; handler order within a micro-step
     follows ascending kind (fixed, deterministic).
+
+    ``bulk_kinds`` maps kind → G: a host whose candidate event has that kind
+    may consume up to G CONSECUTIVE same-kind run events in one iteration
+    (the handler is invoked once per taken column, in key order), dividing
+    the iteration count for kinds that dominate a host's window. SAFETY
+    CONTRACT: a bulk kind's handler must never emit a SELF event with
+    time < win_end — such an emission could carry a key between two bulked
+    events and would deserve to interleave, which the batch forecloses.
+    (Cross-host emissions always land >= win_end under conservative
+    windows; PHOLD's message kind satisfies this by construction.)
+    At most one bulk kind is supported currently.
     """
     H = num_hosts
     if max_iters is None:
         max_iters = K + 4 * B + 16
     hosts = jnp.arange(H, dtype=jnp.int32)
     kinds = sorted(handlers)
+    if bulk_kinds and len(bulk_kinds) > 1:
+        raise ValueError("at most one bulk kind is supported")
+    bulk_kind, G = (
+        next(iter(bulk_kinds.items())) if bulk_kinds else (None, 1)
+    )
+    if bulk_kind is not None and bulk_kind not in handlers:
+        raise ValueError(f"bulk kind {bulk_kind} has no handler")
+    matrix_handlers = matrix_handlers or {}
 
     def step(state: SimState, params: NetParams, win_start, win_end):
+        P = state.pool.payload.shape[1]  # payload words (per-sim sized)
         win_start = jnp.asarray(win_start, jnp.int64)
         win_end = jnp.asarray(win_end, jnp.int64)
-        sw, (defer_time, defer_src, defer_seq) = _sort_window(
-            state.pool, win_end, H, K
-        )
         pool_payload = state.pool.payload
         state = state.replace(now=win_start)
 
@@ -335,7 +394,7 @@ def make_window_step(
             src=jnp.zeros((H,), jnp.int32),
             seq=jnp.zeros((H,), jnp.int32),
             kind=jnp.zeros((H,), jnp.int32),
-            payload=jnp.zeros((H, PAYLOAD_WORDS), jnp.int32),
+            payload=jnp.zeros((H, P), jnp.int32),
         )
         E_by_kind = np.zeros(max(kinds) + 1 if kinds else 1, dtype=np.int32)
         pstate = state
@@ -351,223 +410,505 @@ def make_window_step(
                 f"case emissions E={int(E_by_kind.max())}; raise "
                 f"experimental.outbox_slots"
             )
+        if bulk_kind is not None and int(E_by_kind[bulk_kind]) * G > O:
+            raise ValueError(
+                f"outbox_slots O={O} cannot absorb a full bulk batch "
+                f"(kind {bulk_kind}: {int(E_by_kind[bulk_kind])} emissions "
+                f"x G={G}); raise outbox_slots or lower the bulk width"
+            )
         E_arr = jnp.asarray(E_by_kind, jnp.int32)
-        carry0 = (
-            state,
-            jnp.zeros((H,), dtype=jnp.int32),  # ptr (consumed per host)
-            _Inbox.empty(H, B),
-            _Outbox.empty(H, O),
-            jnp.int32(0),  # iteration counter
-            jnp.bool_(True),  # work remaining
-        )
 
-        def cond(carry):
-            _, _, _, _, it, work = carry
-            return work & (it < max_iters)
-
-        def body(carry):
-            state, ptr, inbox, outbox, it, _ = carry
-
-            # --- candidate per host: sorted-run head vs inbox min ---
-            hp = jnp.clip(sw.starts + ptr, 0, sw.time.shape[0] - 1)
-            in_run = (ptr < K) & ((sw.starts + ptr) < sw.ends)
-            m_time = jnp.where(in_run, sw.time[hp], NEVER)
-            m_src = sw.src[hp]
-            m_seq = sw.seq[hp]
-            i_time, i_src, i_seq, i_slot = _inbox_min(inbox)
-            use_inbox = _key_lt(i_time, i_src, i_seq, m_time, m_src, m_seq)
-            ev_time = jnp.where(use_inbox, i_time, m_time)
-
-            m_kind = sw.kind[hp]
-            i_kind = jnp.take_along_axis(inbox.kind, i_slot[:, None], axis=1)[:, 0]
-            ev_kind = jnp.where(use_inbox, i_kind, m_kind)
-            # Outbox backpressure: a host whose outbox cannot absorb this
-            # event-kind's worst-case emissions stalls — its events stay
-            # queued and defer to the next window via the merge (never
-            # dropped).
-            need = E_arr[jnp.clip(ev_kind, 0, E_arr.shape[0] - 1)]
-            room = (outbox.count + need) <= O
-            valid = (ev_time < win_end) & room
-            stalled = (ev_time < win_end) & ~room
-
-            m_payload = pool_payload[sw.idx[hp]]
-            i_payload = jnp.take_along_axis(
-                inbox.payload, i_slot[:, None, None], axis=1
-            )[:, 0, :]
-            ev = EventView(
-                mask=valid,
-                time=ev_time,
-                src=jnp.where(use_inbox, i_src, m_src),
-                seq=jnp.where(use_inbox, i_seq, m_seq),
-                kind=ev_kind,
-                payload=jnp.where(use_inbox[:, None], i_payload, m_payload),
+        # The loop path's machinery closes over the window sort's outputs;
+        # building it in a factory keeps the sort INSIDE the run_loop cond
+        # branch, so the matrix fast path never pays for it (the
+        # searchsorted in _sort_window lowers to a scatter, ~1.7 ms/window
+        # on v5e — pure waste when every window takes the matrix branch).
+        def make_loop_fns(sw, defer_time, defer_src, defer_seq):
+            carry0 = (
+                jnp.zeros((H,), dtype=jnp.int32),  # ptr (consumed per host)
+                _Inbox.empty(H, B, P),
+                _Outbox.empty(H, O, P),
+                jnp.int32(0),  # iteration counter
+                jnp.bool_(True),  # work remaining
             )
 
-            # --- consume the chosen event ---
+            def cond(carry):
+                _, _, _, _, it, work = carry
+                return work & (it < max_iters)
+
+            def body(carry):
+                state, ptr, inbox, outbox, it, _ = carry
+
+                # --- candidate per host: sorted-run head vs inbox min ---
+                hp = jnp.clip(sw.starts + ptr, 0, sw.time.shape[0] - 1)
+                in_run = (ptr < K) & ((sw.starts + ptr) < sw.ends)
+                m_time = jnp.where(in_run, sw.time[hp], NEVER)
+                m_src = sw.src[hp]
+                m_seq = sw.seq[hp]
+                i_time, i_src, i_seq, i_slot = _inbox_min(inbox)
+                use_inbox = _key_lt(i_time, i_src, i_seq, m_time, m_src, m_seq)
+                ev_time = jnp.where(use_inbox, i_time, m_time)
+
+                m_kind = sw.kind[hp]
+                i_kind = jnp.take_along_axis(inbox.kind, i_slot[:, None], axis=1)[:, 0]
+                ev_kind = jnp.where(use_inbox, i_kind, m_kind)
+
+                # --- bulk batch planning (before the room check, which must
+                # cover the whole batch's emissions): extend the run take with
+                # up to G-1 further CONSECUTIVE events of the bulk kind, each
+                # required to precede the inbox head in key order so nothing
+                # that deserves to interleave is foreclosed. ---
+                C_len = sw.time.shape[0]
+                bulk_t, bulk_s, bulk_q, bulk_hp, bulk_m = [], [], [], [], []
+                if bulk_kind is not None and G > 1:
+                    prev = (
+                        (ev_time < win_end) & ~use_inbox & (ev_kind == bulk_kind)
+                    )
+                    for g in range(1, G):
+                        hpg = jnp.clip(sw.starts + ptr + g, 0, C_len - 1)
+                        ing = (ptr + g < K) & ((sw.starts + ptr + g) < sw.ends)
+                        tg = jnp.where(ing, sw.time[hpg], NEVER)
+                        sg = sw.src[hpg]
+                        qg = sw.seq[hpg]
+                        kg = sw.kind[hpg]
+                        okg = (
+                            prev & ing & (kg == bulk_kind) & (tg < win_end)
+                            & _key_lt(tg, sg, qg, i_time, i_src, i_seq)
+                        )
+                        bulk_t.append(tg)
+                        bulk_s.append(sg)
+                        bulk_q.append(qg)
+                        bulk_hp.append(hpg)
+                        bulk_m.append(okg)
+                        prev = okg
+                    g_extra = jnp.sum(
+                        jnp.stack(bulk_m, axis=1), axis=1, dtype=jnp.int32
+                    )
+                else:
+                    g_extra = jnp.zeros((H,), dtype=jnp.int32)
+
+                # Outbox backpressure: a host whose outbox cannot absorb this
+                # event-kind's worst-case emissions (times the batch width)
+                # stalls — its events stay queued and defer to the next window
+                # via the merge (never dropped).
+                need = E_arr[jnp.clip(ev_kind, 0, E_arr.shape[0] - 1)] * (
+                    1 + g_extra
+                )
+                room = (outbox.count + need) <= O
+                valid = (ev_time < win_end) & room
+                stalled = (ev_time < win_end) & ~room
+
+                m_payload = pool_payload[sw.idx[hp]]
+                i_payload = jnp.take_along_axis(
+                    inbox.payload, i_slot[:, None, None], axis=1
+                )[:, 0, :]
+                ev = EventView(
+                    mask=valid,
+                    time=ev_time,
+                    src=jnp.where(use_inbox, i_src, m_src),
+                    seq=jnp.where(use_inbox, i_seq, m_seq),
+                    kind=ev_kind,
+                    payload=jnp.where(use_inbox[:, None], i_payload, m_payload),
+                )
+
+                # --- consume the chosen event(s) ---
+                bulk_valid = [bm & valid for bm in bulk_m]  # [H] per extra col
+                taken_extra = (
+                    jnp.sum(jnp.stack(bulk_valid, axis=1), axis=1,
+                            dtype=jnp.int32)
+                    if bulk_valid else jnp.zeros((H,), dtype=jnp.int32)
+                )
+                last_t = ev_time
+                for bt, bv in zip(bulk_t, bulk_valid):
+                    last_t = jnp.where(bv, bt, last_t)
+                state = state.replace(
+                    host=state.host.replace(
+                        done_t=jnp.where(valid, last_t, state.host.done_t)
+                    )
+                )
+                ptr = jnp.where(valid & ~use_inbox, ptr + 1 + taken_extra, ptr)
+                inbox = inbox.replace(
+                    time=_set_col(inbox.time, i_slot, valid & use_inbox, NEVER)
+                )
+
+                # --- run handlers (ascending kind; masked SoA updates); the
+                # bulk kind's handler runs once per taken column, in key order
+                emitter = Emitter()
+                for k in kinds:
+                    hev = ev.replace(mask=valid & (ev.kind == k))
+                    state = handlers[k](state, hev, emitter, params)
+                    if k == bulk_kind:
+                        for g in range(len(bulk_valid)):
+                            gev = EventView(
+                                mask=bulk_valid[g],
+                                time=bulk_t[g],
+                                src=bulk_s[g],
+                                seq=bulk_q[g],
+                                kind=jnp.full((H,), k, dtype=jnp.int32),
+                                payload=pool_payload[sw.idx[bulk_hp[g]]],
+                            )
+                            state = handlers[k](state, gev, emitter, params)
+
+                state = state.replace(
+                    counters=state.counters.replace(
+                        events_committed=state.counters.events_committed
+                        + jnp.sum(valid, dtype=jnp.int64)
+                        + jnp.sum(taken_extra, dtype=jnp.int64),
+                        outbox_stall_deferred=state.counters.outbox_stall_deferred
+                        + jnp.sum(stalled, dtype=jnp.int64),
+                        micro_steps=state.counters.micro_steps + 1,
+                    )
+                )
+
+                # --- route emissions (order fixes per-source seq numbers) ---
+                for em in emitter.records:
+                    seq = state.host.seq_next
+                    state = state.replace(
+                        host=state.host.replace(
+                            seq_next=jnp.where(em.mask, seq + 1, seq)
+                        )
+                    )
+                    # Self-emissions at or past the host's earliest deferred
+                    # leftover (full-key compare: exact under time ties) must
+                    # not jump the queue: route them through the pool.
+                    is_self = (
+                        em.mask
+                        & (em.dst == hosts)
+                        & (em.time < win_end)
+                        & _key_lt(em.time, hosts, seq,
+                                  defer_time, defer_src, defer_seq)
+                    )
+
+                    free = inbox.time == NEVER  # [H, B]
+                    ff = jnp.argmax(free, axis=1).astype(jnp.int32)
+                    has_free = jnp.any(free, axis=1)
+                    ins = is_self & has_free
+                    # Inbox overflow DEFERS to the pool via the outbox (processed
+                    # next window, late but never lost — a lost NIC pump event
+                    # would wedge its queue); the counter records the deferral.
+                    to_out = em.mask & ~ins
+                    inbox = inbox.replace(
+                        time=_set_col(inbox.time, ff, ins, em.time),
+                        src=_set_col(inbox.src, ff, ins, hosts),
+                        seq=_set_col(inbox.seq, ff, ins, seq),
+                        kind=_set_col(inbox.kind, ff, ins, em.kind),
+                        payload=_set_col(inbox.payload, ff, ins, em.payload),
+                    )
+
+                    ocol = outbox.count  # next free outbox column per host
+                    put = to_out & (ocol < O)
+                    outbox = outbox.replace(
+                        time=_set_col(outbox.time, ocol, put, em.time),
+                        dst=_set_col(outbox.dst, ocol, put, em.dst),
+                        src=_set_col(outbox.src, ocol, put, hosts),
+                        seq=_set_col(outbox.seq, ocol, put, seq),
+                        kind=_set_col(outbox.kind, ocol, put, em.kind),
+                        payload=_set_col(outbox.payload, ocol, put, em.payload),
+                        count=outbox.count + put.astype(jnp.int32),
+                    )
+                    state = state.replace(
+                        counters=state.counters.replace(
+                            events_emitted=state.counters.events_emitted
+                            + jnp.sum(em.mask, dtype=jnp.int64),
+                            inbox_overflow_deferred=state.counters.inbox_overflow_deferred
+                            + jnp.sum(is_self & ~has_free, dtype=jnp.int64),
+                            outbox_overflow_dropped=state.counters.outbox_overflow_dropped
+                            + jnp.sum(to_out & ~put, dtype=jnp.int64),
+                        )
+                    )
+
+                work = jnp.any(valid)
+                return (state, ptr, inbox, outbox, it + 1, work)
+
+            def finish(state, ptr, bt, bd, bs, bq, bk, bp):
+                """Merge: unconsumed sorted rows ∪ box rows (flattened outbox,
+                inbox leftovers, or matrix emissions) with one sort by time
+                (gathers only — no scatters, which serialize on TPU). A sorted
+                row is consumed iff its rank within its host's run is below that
+                host's final cursor — pure elementwise, no inverse permutation.
+                Also derives the speculation-violation signal: a cross-host box
+                emission targeting time t violates iff its DESTINATION host
+                already processed an event at time >= t since the optimistic
+                synchronizer's window began (host.done_t) — impossible under
+                conservative windows, so xmit_min stays NEVER there."""
+                pool = state.pool
+                C = pool.capacity
+                spos = jnp.arange(C, dtype=jnp.int32)
+                run_host = jnp.clip(sw.dst, 0, H - 1)
+                rank = spos - sw.starts[run_host]
+                in_run_row = (
+                    (spos >= sw.starts[run_host]) & (spos < sw.ends[run_host])
+                )
+                consumed = in_run_row & (rank < ptr[run_host])
+                left_time = jnp.where(consumed, NEVER, sw.time)
+
+                all_time = jnp.concatenate([left_time, bt])
+                all_dst = jnp.concatenate([sw.dst, bd])
+                all_src = jnp.concatenate([sw.src, bs])
+                all_seq = jnp.concatenate([sw.seq, bq])
+                all_kind = jnp.concatenate([sw.kind, bk])
+                idx = jnp.arange(all_time.shape[0], dtype=jnp.int32)
+                s_time, s_idx = jax.lax.sort(
+                    [all_time, idx], num_keys=1, is_stable=True
+                )
+                keep = s_idx[:C]
+                dropped = jnp.sum(s_time[C:] != NEVER, dtype=jnp.int64)
+                # Payload indirection: rows from the sorted window read the
+                # ORIGINAL pool payload via sw.idx; box rows read bp.
+                if bp.shape[0] == 0:  # no box rows (e.g. emission-free window)
+                    bp = jnp.zeros((1, P), bp.dtype)
+                from_pool = keep < C
+                ppidx = sw.idx[jnp.where(from_pool, keep, 0)]
+                bidx = jnp.clip(keep - C, 0, bp.shape[0] - 1)
+                new_payload = jnp.where(
+                    from_pool[:, None], pool.payload[ppidx], bp[bidx]
+                )
+                new_pool = EventPool(
+                    time=s_time[:C],
+                    dst=all_dst[keep],
+                    src=all_src[keep],
+                    seq=all_seq[keep],
+                    kind=all_kind[keep],
+                    payload=new_payload,
+                )
+                if bt.shape[0]:
+                    cross = (bd != bs) & (bt != NEVER)
+                    dst_last = state.host.done_t[jnp.clip(bd, 0, H - 1)]
+                    violates = cross & (bt <= dst_last)
+                    xmit_min = jnp.min(jnp.where(violates, bt, NEVER))
+                else:
+                    xmit_min = jnp.asarray(NEVER, jnp.int64)
+                state = state.replace(
+                    pool=new_pool,
+                    xmit_min=xmit_min,
+                    counters=state.counters.replace(
+                        pool_overflow_dropped=state.counters.pool_overflow_dropped
+                        + dropped
+                    ),
+                )
+                return state, jnp.min(new_pool.time)
+
+            return carry0, cond, body, finish
+
+        def run_loop(state):
+            sw, (defer_time, defer_src, defer_seq) = _sort_window(
+                state.pool, win_end, H, K
+            )
+            carry0, cond, body, finish = make_loop_fns(
+                sw, defer_time, defer_src, defer_seq
+            )
+            state, ptr, inbox, outbox, _, _ = jax.lax.while_loop(
+                cond, body, (state,) + carry0
+            )
+            hostsB = jnp.broadcast_to(
+                hosts[:, None], inbox.time.shape
+            ).reshape(-1)
+            return finish(
+                state, ptr,
+                jnp.concatenate(
+                    [outbox.time.reshape(-1), inbox.time.reshape(-1)]
+                ),
+                jnp.concatenate([outbox.dst.reshape(-1), hostsB]),
+                jnp.concatenate(
+                    [outbox.src.reshape(-1), inbox.src.reshape(-1)]
+                ),
+                jnp.concatenate(
+                    [outbox.seq.reshape(-1), inbox.seq.reshape(-1)]
+                ),
+                jnp.concatenate(
+                    [outbox.kind.reshape(-1), inbox.kind.reshape(-1)]
+                ),
+                jnp.concatenate(
+                    [outbox.payload.reshape(-1, P),
+                     inbox.payload.reshape(-1, P)]
+                ),
+            )
+
+        def run_matrix(state):
+            """Whole-window vectorized path: when EVERY in-window event has
+            the bulk kind, there is no intra-window feedback (the bulk
+            safety contract forbids self-emissions below win_end), so the
+            full [H, K] window matrix is processed in ONE handler pass —
+            no micro-step loop at all. PHOLD-class models hit this every
+            window; it is the PDES "superstep" optimization.
+
+            TPU note (profiled on v5e): large GATHERS serialize (~9 ns per
+            element) while multi-operand sorts and scans run at memory
+            bandwidth, so this path is built from sorts, cumulative scans,
+            and reshapes ONLY. Dense [H, K] extraction works by sorting K
+            filler rows per host together with the pool (sort 1), deriving
+            each row's rank within its host run with a cummax scan (no
+            searchsorted — its method="sort" lowers to a scatter), and
+            re-sorting by dense slot id (sort 2) so the window matrix is a
+            plain reshape. Event columns and payload words ride every sort
+            as extra operands instead of being gathered afterwards."""
+            pool = state.pool
+            C = pool.capacity
+            HK = H * K
+            N = C + HK
+            # --- sort 1: (key, time, src, seq) over pool rows + fillers.
+            # Fillers (time NEVER) sort after every real in-window row of
+            # their host; out-of-window rows carry key H and sort last.
+            inwin = pool.time < win_end
+            key_r = jnp.where(inwin, pool.dst, jnp.int32(H))
+            key_f = jnp.repeat(hosts, K)  # [HK] filler keys
+            cat_key = jnp.concatenate([key_r, key_f])
+            cat_t = jnp.concatenate(
+                [pool.time, jnp.full((HK,), NEVER, jnp.int64)]
+            )
+            zf = jnp.zeros((HK,), jnp.int32)
+            cat_d = jnp.concatenate([pool.dst, key_f])  # TRUE dst rides along
+            cat_s = jnp.concatenate([pool.src, zf])
+            cat_q = jnp.concatenate([pool.seq, zf])
+            cat_k = jnp.concatenate([pool.kind, zf])
+            pcols = [
+                jnp.concatenate([pool.payload[:, w], zf])
+                for w in range(P)
+            ]
+            ops = jax.lax.sort(
+                [cat_key, cat_t, cat_s, cat_q, cat_k, cat_d] + pcols,
+                num_keys=4, is_stable=True,
+            )
+            s_key, s_t, s_s, s_q, s_k, s_d = ops[:6]
+            s_p = ops[6:]
+            # --- rank within host run via scan (gather/scatter-free) ---
+            iota = jnp.arange(N, dtype=jnp.int32)
+            boundary = jnp.concatenate(
+                [jnp.ones((1,), bool), s_key[1:] != s_key[:-1]]
+            )
+            run_start = jax.lax.cummax(jnp.where(boundary, iota, -1))
+            rank = iota - run_start
+            # --- sort 2: dense slot id; extracted rows land at h*K + rank,
+            # everything else (rank >= K, key == H) keeps relative order at
+            # the tail and becomes the merge leftovers ---
+            extract = (s_key < H) & (rank < K)
+            slot = jnp.where(extract, s_key * K + rank, jnp.int32(N))
+            ops2 = jax.lax.sort(
+                [slot, s_t, s_s, s_q, s_k, s_d] + list(s_p),
+                num_keys=1, is_stable=True,
+            )
+            d_t, d_s, d_q, d_k = (o[:HK].reshape(H, K) for o in ops2[1:5])
+            d_p = jnp.stack([o[:HK].reshape(H, K) for o in ops2[6:]], axis=-1)
+            # tail rows = deferred + out-of-window + leftover fillers (time
+            # NEVER, sort away in the merge)
+            tl_t, tl_s, tl_q, tl_k, tl_d = (o[HK:] for o in ops2[1:6])
+            tl_p = [o[HK:] for o in ops2[6:]]
+            # fillers interleave with real same-host rows only at time
+            # NEVER, so a dense cell is real iff its time is set
+            valid = d_t != NEVER
+            mv = MatrixEventView(
+                mask=valid, time=d_t, src=d_s, seq=d_q, payload=d_p
+            )
+            memit = MatrixEmitter()
+            state = matrix_handlers[bulk_kind](state, mv, memit, params)
+            nvalid = jnp.sum(valid, axis=1, dtype=jnp.int32)
+            last_t = jnp.max(jnp.where(valid, d_t, jnp.int64(-1)), axis=1)
             state = state.replace(
                 host=state.host.replace(
-                    done_t=jnp.where(valid, ev_time, state.host.done_t)
+                    done_t=jnp.where(nvalid > 0, last_t, state.host.done_t)
                 )
             )
-            ptr = jnp.where(valid & ~use_inbox, ptr + 1, ptr)
-            inbox = inbox.replace(
-                time=_set_col(inbox.time, i_slot, valid & use_inbox, NEVER)
+            # per-source sequence numbers: per host, emissions are ordered
+            # column-major (event order), record-minor within a column —
+            # identical to the loop path's per-event record order
+            base = state.host.seq_next
+            masks = [r.mask.astype(jnp.int32) for r in memit.records]
+            per_col = sum(masks) if masks else jnp.zeros((H, K), jnp.int32)
+            col_excl = jnp.cumsum(per_col, axis=1) - per_col
+            seen = jnp.zeros((H, K), dtype=jnp.int32)
+            em_rows = []  # per record: (time, dst, src, seq, kind, pcols)
+            hostsK = jnp.broadcast_to(hosts[:, None], (H, K))
+            for j, r in enumerate(memit.records):
+                seqj = base[:, None] + col_excl + seen
+                seen = seen + masks[j]
+                em_rows.append((
+                    jnp.where(r.mask, r.time, NEVER).reshape(-1),
+                    r.dst.reshape(-1),
+                    hostsK.reshape(-1),
+                    seqj.reshape(-1),
+                    r.kind.reshape(-1),
+                    [r.payload[:, :, w].reshape(-1) for w in range(P)],
+                ))
+            total = jnp.sum(per_col, axis=1, dtype=jnp.int32)
+            state = state.replace(
+                host=state.host.replace(seq_next=base + total)
             )
-
-            # --- run handlers (ascending kind; masked SoA updates) ---
-            emitter = Emitter()
-            for k in kinds:
-                hev = ev.replace(mask=valid & (ev.kind == k))
-                state = handlers[k](state, hev, emitter, params)
-
             state = state.replace(
                 counters=state.counters.replace(
                     events_committed=state.counters.events_committed
                     + jnp.sum(valid, dtype=jnp.int64),
-                    outbox_stall_deferred=state.counters.outbox_stall_deferred
-                    + jnp.sum(stalled, dtype=jnp.int64),
+                    events_emitted=state.counters.events_emitted
+                    + jnp.sum(per_col, dtype=jnp.int64),
+                    micro_steps=state.counters.micro_steps + 1,
                 )
             )
+            # --- merge (sort 3): tail leftovers ∪ emissions, ONE 1-key
+            # stable sort by time carrying every column; no payload
+            # indirection gathers. Output truncates to pool capacity
+            # (fillers sit at time NEVER and fall off first). ---
+            m_t = jnp.concatenate([tl_t] + [e[0] for e in em_rows])
+            m_d = jnp.concatenate([tl_d] + [e[1] for e in em_rows])
+            m_s = jnp.concatenate([tl_s] + [e[2] for e in em_rows])
+            m_q = jnp.concatenate([tl_q] + [e[3] for e in em_rows])
+            m_k = jnp.concatenate([tl_k] + [e[4] for e in em_rows])
+            m_p = [
+                jnp.concatenate([tl_p[w]] + [e[5][w] for e in em_rows])
+                for w in range(P)
+            ]
+            ops3 = jax.lax.sort(
+                [m_t, m_d, m_s, m_q, m_k] + m_p, num_keys=1, is_stable=True
+            )
+            n_t, n_d, n_s, n_q, n_k = (o[:C] for o in ops3[:5])
+            n_p = jnp.stack([o[:C] for o in ops3[5:]], axis=-1)
+            dropped = jnp.sum(ops3[0][C:] != NEVER, dtype=jnp.int64)
+            new_pool = EventPool(
+                time=n_t, dst=n_d, src=n_s, seq=n_q, kind=n_k, payload=n_p
+            )
+            # speculation-violation signal (optimistic synchronizer): the
+            # one place a by-dst lookup is unavoidable; emissions are the
+            # only candidate violators (leftovers already lived in the pool)
+            if em_rows:
+                e_t = jnp.concatenate([e[0] for e in em_rows])
+                e_d = jnp.concatenate([e[1] for e in em_rows])
+                e_s = jnp.concatenate([e[2] for e in em_rows])
 
-            # --- route emissions (order fixes per-source seq numbers) ---
-            for em in emitter.records:
-                seq = state.host.seq_next
-                state = state.replace(
-                    host=state.host.replace(
-                        seq_next=jnp.where(em.mask, seq + 1, seq)
+                def _exact(_):
+                    # the one unavoidable by-dst lookup (a serialized
+                    # gather on TPU) — only reached when a violation is
+                    # even possible, i.e. under optimistic long windows
+                    dst_last = state.host.done_t[jnp.clip(e_d, 0, H - 1)]
+                    viol = (
+                        (e_d != e_s) & (e_t != NEVER) & (e_t <= dst_last)
                     )
+                    return jnp.min(jnp.where(viol, e_t, NEVER))
+
+                possible = jnp.min(e_t) <= jnp.max(state.host.done_t)
+                xmit_min = jax.lax.cond(
+                    possible, _exact,
+                    lambda _: jnp.asarray(NEVER, jnp.int64), 0,
                 )
-                # Self-emissions at or past the host's earliest deferred
-                # leftover (full-key compare: exact under time ties) must
-                # not jump the queue: route them through the pool.
-                is_self = (
-                    em.mask
-                    & (em.dst == hosts)
-                    & (em.time < win_end)
-                    & _key_lt(em.time, hosts, seq,
-                              defer_time, defer_src, defer_seq)
-                )
+            else:
+                xmit_min = jnp.asarray(NEVER, jnp.int64)
+            state = state.replace(
+                pool=new_pool,
+                xmit_min=xmit_min,
+                counters=state.counters.replace(
+                    pool_overflow_dropped=state.counters.pool_overflow_dropped
+                    + dropped
+                ),
+            )
+            return state, jnp.min(new_pool.time)
 
-                free = inbox.time == NEVER  # [H, B]
-                ff = jnp.argmax(free, axis=1).astype(jnp.int32)
-                has_free = jnp.any(free, axis=1)
-                ins = is_self & has_free
-                # Inbox overflow DEFERS to the pool via the outbox (processed
-                # next window, late but never lost — a lost NIC pump event
-                # would wedge its queue); the counter records the deferral.
-                to_out = em.mask & ~ins
-                inbox = inbox.replace(
-                    time=_set_col(inbox.time, ff, ins, em.time),
-                    src=_set_col(inbox.src, ff, ins, hosts),
-                    seq=_set_col(inbox.seq, ff, ins, seq),
-                    kind=_set_col(inbox.kind, ff, ins, em.kind),
-                    payload=_set_col(inbox.payload, ff, ins, em.payload),
-                )
-
-                ocol = outbox.count  # next free outbox column per host
-                put = to_out & (ocol < O)
-                outbox = outbox.replace(
-                    time=_set_col(outbox.time, ocol, put, em.time),
-                    dst=_set_col(outbox.dst, ocol, put, em.dst),
-                    src=_set_col(outbox.src, ocol, put, hosts),
-                    seq=_set_col(outbox.seq, ocol, put, seq),
-                    kind=_set_col(outbox.kind, ocol, put, em.kind),
-                    payload=_set_col(outbox.payload, ocol, put, em.payload),
-                    count=outbox.count + put.astype(jnp.int32),
-                )
-                state = state.replace(
-                    counters=state.counters.replace(
-                        events_emitted=state.counters.events_emitted
-                        + jnp.sum(em.mask, dtype=jnp.int64),
-                        inbox_overflow_deferred=state.counters.inbox_overflow_deferred
-                        + jnp.sum(is_self & ~has_free, dtype=jnp.int64),
-                        outbox_overflow_dropped=state.counters.outbox_overflow_dropped
-                        + jnp.sum(to_out & ~put, dtype=jnp.int64),
-                    )
-                )
-
-            work = jnp.any(valid)
-            return (state, ptr, inbox, outbox, it + 1, work)
-
-        state, ptr, inbox, outbox, _, _ = jax.lax.while_loop(
-            cond, body, carry0
-        )
-
-        # --- merge: unconsumed sorted rows ∪ outbox ∪ inbox leftovers with
-        # one sort by time (gathers only — no scatters, which serialize on
-        # TPU). A sorted row is consumed iff its rank within its host's run
-        # is below that host's final cursor — pure elementwise, no inverse
-        # permutation needed. Inbox leftovers exist if max_iters capped the
-        # loop or a host stalled on outbox backpressure; deferring them is a
-        # correct (if slower) schedule.
-        pool = state.pool
-        C = pool.capacity
-        spos = jnp.arange(C, dtype=jnp.int32)
-        run_host = jnp.clip(sw.dst, 0, H - 1)
-        rank = spos - sw.starts[run_host]
-        in_run_row = (spos >= sw.starts[run_host]) & (spos < sw.ends[run_host])
-        consumed = in_run_row & (rank < ptr[run_host])
-        left_time = jnp.where(consumed, NEVER, sw.time)
-
-        hostsB = jnp.broadcast_to(hosts[:, None], inbox.time.shape).reshape(-1)
-        all_time = jnp.concatenate(
-            [left_time, outbox.time.reshape(-1), inbox.time.reshape(-1)]
-        )
-        all_dst = jnp.concatenate([sw.dst, outbox.dst.reshape(-1), hostsB])
-        all_src = jnp.concatenate(
-            [sw.src, outbox.src.reshape(-1), inbox.src.reshape(-1)]
-        )
-        all_seq = jnp.concatenate(
-            [sw.seq, outbox.seq.reshape(-1), inbox.seq.reshape(-1)]
-        )
-        all_kind = jnp.concatenate(
-            [sw.kind, outbox.kind.reshape(-1), inbox.kind.reshape(-1)]
-        )
-        idx = jnp.arange(all_time.shape[0], dtype=jnp.int32)
-        s_time, s_idx = jax.lax.sort([all_time, idx], num_keys=1, is_stable=True)
-        keep = s_idx[:C]
-        dropped = jnp.sum(s_time[C:] != NEVER, dtype=jnp.int64)
-        # Payload indirection: rows from the sorted window read the ORIGINAL
-        # pool payload via sw.idx; box rows read the box buffers.
-        box_payload = jnp.concatenate(
-            [outbox.payload.reshape(-1, PAYLOAD_WORDS),
-             inbox.payload.reshape(-1, PAYLOAD_WORDS)]
-        )
-        from_pool = keep < C
-        ppidx = sw.idx[jnp.where(from_pool, keep, 0)]
-        bidx = jnp.clip(keep - C, 0, box_payload.shape[0] - 1)
-        new_payload = jnp.where(
-            from_pool[:, None], pool.payload[ppidx], box_payload[bidx]
-        )
-        new_pool = EventPool(
-            time=s_time[:C],
-            dst=all_dst[keep],
-            src=all_src[keep],
-            seq=all_seq[keep],
-            kind=all_kind[keep],
-            payload=new_payload,
-        )
-        # Speculation-violation signal for the optimistic synchronizer: a
-        # cross-host emission targeting time t is a violation iff its
-        # DESTINATION host already processed an event at time >= t since the
-        # synchronizer's window began (host.done_t, reset by run_optimistic
-        # per window) — the delivery should have interleaved before that
-        # event. With a conservative window this is impossible
-        # (t >= now + min_latency >= window end > every processed time), so
-        # xmit_min stays NEVER there.
-        cross = (outbox.dst != hosts[:, None]) & (outbox.time != NEVER)
-        dst_last = state.host.done_t[jnp.clip(outbox.dst, 0, H - 1)]
-        violates = cross & (outbox.time <= dst_last)
-        xmit_min = jnp.min(jnp.where(violates, outbox.time, NEVER))
-        state = state.replace(
-            pool=new_pool,
-            xmit_min=xmit_min,
-            counters=state.counters.replace(
-                pool_overflow_dropped=state.counters.pool_overflow_dropped + dropped
-            ),
-        )
-        min_next = jnp.min(new_pool.time)
-        return state, min_next
+        if bulk_kind is None or bulk_kind not in matrix_handlers:
+            return run_loop(state)
+        if _force_path == "matrix":
+            return run_matrix(state)
+        if _force_path == "loop":
+            return run_loop(state)
+        pool0 = state.pool
+        inwin = pool0.time < win_end
+        all_bulk = jnp.all(~inwin | (pool0.kind == bulk_kind))
+        return jax.lax.cond(all_bulk, run_matrix, run_loop, state)
 
     return step
 
@@ -600,6 +941,9 @@ class Simulation:
         O: int = 64,
         subs: dict | None = None,
         initial_events: list[tuple[int, int, int, int, list[int]]] | None = None,
+        bulk_kinds: dict[int, int] | None = None,
+        matrix_handlers: dict[int, Callable] | None = None,
+        payload_words: int = PAYLOAD_WORDS,
     ):
         # initial_events: (time, dst, src, kind, payload words)
         self.num_hosts = num_hosts
@@ -608,7 +952,7 @@ class Simulation:
         if self.runahead <= 0:
             raise ValueError("runahead must be > 0 (min topology latency)")
         self.params = params
-        pool = EventPool.empty(event_capacity)
+        pool = EventPool.empty(event_capacity, payload_words)
         n0 = len(initial_events or [])
         if n0 > event_capacity:
             raise ValueError("initial events exceed event pool capacity")
@@ -625,8 +969,8 @@ class Simulation:
                 srcs.append(s)
                 seqs.append(q)
                 kinds_.append(k)
-                row = list(pl) + [0] * (PAYLOAD_WORDS - len(pl))
-                pls.append(row[:PAYLOAD_WORDS])
+                row = list(pl) + [0] * (payload_words - len(pl))
+                pls.append(row[:payload_words])
             sl = slice(0, n0)
             pool = pool.replace(
                 time=pool.time.at[sl].set(jnp.asarray(times, jnp.int64)),
@@ -654,7 +998,10 @@ class Simulation:
             rng_keys=rng_mod.host_keys(seed, num_hosts),
             subs=subs or {},
         )
-        step = make_window_step(handlers, num_hosts, K=K, B=B, O=O)
+        step = make_window_step(
+            handlers, num_hosts, K=K, B=B, O=O, bulk_kinds=bulk_kinds,
+            matrix_handlers=matrix_handlers,
+        )
         self._step = jax.jit(step)
         self._run_to = jax.jit(self._make_run_to(step))
         self._attempt = jax.jit(self._make_attempt(step))

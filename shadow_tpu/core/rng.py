@@ -47,6 +47,17 @@ def uniform_per_host(hkeys, counters):
     return jax.vmap(draw)(hkeys, counters)
 
 
+def uniform_matrix(hkeys, counters):
+    """[H, K] uniform draws: element (h, k) is the draw host h's stream
+    produces at counter counters[h, k] — the same pure function of
+    (key, counter) as uniform_per_host, so matrix-path draws reproduce the
+    sequential schedule bit-for-bit when given the same counters."""
+    def draw(k, c):
+        return jax.random.uniform(jax.random.fold_in(k, c), dtype=jnp.float32)
+
+    return jax.vmap(jax.vmap(draw, in_axes=(None, 0)))(hkeys, counters)
+
+
 def bits_per_host(hkeys, counters):
     """One uint32 draw per host at the given draw counters."""
     def draw(k, c):

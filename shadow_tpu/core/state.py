@@ -61,14 +61,19 @@ class EventPool:
     payload: jnp.ndarray  # [C, P] i32
 
     @classmethod
-    def empty(cls, capacity: int) -> "EventPool":
+    def empty(cls, capacity: int,
+              payload_words: int = PAYLOAD_WORDS) -> "EventPool":
+        # payload_words is sizable per simulation: network sims need the
+        # full packet-header layout (12 words, net/packet.py); pure-PDES
+        # models like PHOLD carry 2 — payload row gathers are a dominant
+        # per-window cost on TPU, so right-sizing is a direct speedup.
         return cls(
             time=jnp.full((capacity,), simtime.NEVER, dtype=jnp.int64),
             dst=jnp.zeros((capacity,), dtype=jnp.int32),
             src=jnp.zeros((capacity,), dtype=jnp.int32),
             seq=jnp.zeros((capacity,), dtype=jnp.int32),
             kind=jnp.zeros((capacity,), dtype=jnp.int32),
-            payload=jnp.zeros((capacity, PAYLOAD_WORDS), dtype=jnp.int32),
+            payload=jnp.zeros((capacity, payload_words), dtype=jnp.int32),
         )
 
     @property
@@ -95,6 +100,10 @@ class Counters:
     # iterations a host sat out because its outbox couldn't absorb one
     # iteration's worst-case emissions; the work defers, never drops
     outbox_stall_deferred: jnp.ndarray
+    # engine-loop iterations executed (profiling: events_committed /
+    # (micro_steps * H) = lane utilization; the per-iteration fixed cost
+    # of the full handler suite is the throughput ceiling)
+    micro_steps: jnp.ndarray
     bytes_sent: jnp.ndarray
     bytes_delivered: jnp.ndarray
 

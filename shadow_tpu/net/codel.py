@@ -32,7 +32,7 @@ from shadow_tpu.net import packet as pkt
 
 TARGET_NS = 10 * simtime.NS_PER_MS
 INTERVAL_NS = 100 * simtime.NS_PER_MS
-DROP_UNROLL = 2
+DROP_UNROLL = 1
 
 SUB = "router"
 

@@ -310,7 +310,7 @@ class NetStack:
     # thus full handler-suite invocations) ~BATCH× lower for bursty
     # traffic. 2 balances that against XLA compile time, which grows with
     # the unroll (the accelerator backend has no persistent compile cache).
-    PUMP_BATCH = 2
+    PUMP_BATCH = 1
 
     def on_nic_send(
         self, state: SimState, ev: EventView, emitter: Emitter, params: NetParams

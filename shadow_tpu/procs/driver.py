@@ -310,6 +310,47 @@ class Parked:
     data: bytes = b""  # send: payload awaiting send-buffer space
 
 
+class ManagedThread:
+    """One schedulable execution stream of a managed process: its own
+    channel, run state, and parked record (reference analog: the per-thread
+    IPC block + resume loop, thread_preload.c:200-291).
+
+    The syscall dispatch code addresses this object as `proc` everywhere —
+    attribute access for process-level state (fds, host, name, popen, …)
+    delegates to the owning ManagedProcess, while the scheduling trio
+    (channel/state/parked) is per-thread. Exactly one thread of a process
+    runs app code at a time (the driver withholds wake replies until the
+    running thread blocks), which is what makes multithreaded apps
+    deterministic — the reference's one-thread-at-a-time resume model.
+    """
+
+    RUNNING = "running"
+    PARKED = "parked"
+    READY = "ready"  # woken; reply deferred until the run token is free
+    EXITED = "exited"
+
+    def __init__(self, proc: "ManagedProcess", tid: int,
+                 channel: "ipc.Channel | None" = None):
+        self.proc = proc
+        self.tid = tid
+        self.channel = channel
+        self.state = ManagedThread.PARKED
+        self.parked: Parked | None = None
+        self.pending: tuple[int, bytes] | None = None  # deferred reply
+
+    def __getattr__(self, name):
+        # only called for attributes NOT found on the thread itself
+        return getattr(self.proc, name)
+
+    def alive(self) -> bool:
+        return (
+            self.state != ManagedThread.EXITED and self.proc.alive()
+        )
+
+    def __repr__(self):
+        return f"<ManagedThread {self.proc.name}:{self.tid} {self.state}>"
+
+
 class ManagedProcess:
     RUNNING = "running"
     PARKED = "parked"
@@ -337,19 +378,47 @@ class ManagedProcess:
         self.stdout_path = stdout_path
         self.stderr_path = stderr_path
         self.stopped_by_sim = False  # stopped at stop_time, not app exit
-        self.channel: ipc.Channel | None = None
         self.popen: subprocess.Popen | None = None
-        self.state = ManagedProcess.PARKED  # not yet spawned
+        self.exited = False  # process-level liveness (threads track their own)
         self.fds: dict[int, object] = {}
         self.next_fd = ipc.FD_BASE
-        self.parked: Parked | None = None
         self.exit_code: int | None = None
+        self.threads: list[ManagedThread] = [ManagedThread(self, 0)]
+        # per-process futex table: uaddr -> list of parked ManagedThread in
+        # park order (futex_table.c analog)
+        self.futexes: dict[int, list] = {}
+
+    # --- main-thread delegation (single-thread call sites and tests) ---
+
+    @property
+    def main(self) -> ManagedThread:
+        return self.threads[0]
+
+    @property
+    def channel(self):
+        return self.main.channel
+
+    @property
+    def state(self):
+        return self.main.state
+
+    @state.setter
+    def state(self, v):
+        self.main.state = v
+
+    @property
+    def parked(self):
+        return self.main.parked
+
+    @parked.setter
+    def parked(self, v):
+        self.main.parked = v
 
     def spawn(self, spin: int = 4096, seccomp: bool = True) -> None:
-        self.channel = ipc.Channel()
+        self.main.channel = ipc.Channel()
         env = dict(os.environ)
         env["LD_PRELOAD"] = str(build_mod.shim_path())
-        env[ipc.ENV_SHM] = self.channel.path
+        env[ipc.ENV_SHM] = self.main.channel.path
         env[ipc.ENV_SPIN] = str(spin)
         env[ipc.ENV_SECCOMP] = "1" if seccomp else "0"
         env.update(self.extra_env)
@@ -376,7 +445,7 @@ class ManagedProcess:
         return fd
 
     def alive(self) -> bool:
-        return self.state != ManagedProcess.EXITED
+        return not self.exited
 
     def finish(self) -> tuple[bytes, bytes]:
         out, err = b"", b""
@@ -392,10 +461,12 @@ class ManagedProcess:
                 out = f.read()
             with open(self.stderr_path, "rb") as f:
                 err = f.read()
-        if self.channel:
-            self.channel.close()
-            self.channel = None
-        self.state = ManagedProcess.EXITED
+        for t in self.threads:
+            if t.channel:
+                t.channel.close()
+                t.channel = None
+            t.state = ManagedThread.EXITED
+        self.exited = True
         return out, err
 
 
@@ -670,10 +741,19 @@ class ProcessDriver:
         self.bridge.tcp_send(self.now, proc.host.index, end.slot, len(chunk))
         return len(chunk)
 
-    def _try_wake(self, proc: ManagedProcess) -> None:
-        """If proc's parked condition is now satisfied, complete the syscall
-        and resume it (condition wakeup -> process_continue analog)."""
-        if proc.state != ManagedProcess.PARKED or proc.parked is None:
+    def _try_wake(self, obj) -> None:
+        """If a parked condition is now satisfied, complete the syscall and
+        resume its thread (condition wakeup -> process_continue analog).
+        Accepts a thread or a process; always scans every thread of the
+        process, because any of them may be the one parked on the
+        now-satisfied condition (e.g. a reader thread on a socket another
+        thread wrote to)."""
+        owner = obj.proc if isinstance(obj, ManagedThread) else obj
+        for t in owner.threads:
+            self._try_wake_thread(t)
+
+    def _try_wake_thread(self, proc: ManagedThread) -> None:
+        if proc.state != ManagedThread.PARKED or proc.parked is None:
             return
         pk = proc.parked
         if pk.kind == "recv":
@@ -758,12 +838,43 @@ class ProcessDriver:
         elif pk.kind in ("recv", "accept", "connect"):
             self._resume(proc, -errno.ETIMEDOUT)
 
-    def _resume(self, proc: ManagedProcess, ret: int, data: bytes = b"") -> None:
-        """Post the reply for a previously-blocked syscall; proc runs again."""
+    def _resume(self, proc: ManagedThread, ret: int, data: bytes = b"") -> None:
+        """Complete a previously-blocked syscall. If no other thread of the
+        process is running app code, reply immediately (the thread runs);
+        otherwise defer the reply (state READY) until the running thread
+        blocks — at most one thread of a process executes between syscalls,
+        which is what keeps multithreaded apps deterministic."""
         if not proc.alive() or proc.channel is None:
             return  # stopped/exited while the completion was in flight
+        owner = proc.proc if isinstance(proc, ManagedThread) else proc
+        running = any(
+            t is not proc and t.state == ManagedThread.RUNNING
+            for t in owner.threads
+        )
+        if running:
+            proc.pending = (ret, data)
+            proc.state = ManagedThread.READY
+            return
         proc.channel.reply(ret, sim_time_ns=self.now, data=data)
-        proc.state = ManagedProcess.RUNNING
+        proc.state = ManagedThread.RUNNING
+
+    def _release_ready(self, p: ManagedProcess) -> ManagedThread | None:
+        """If no thread of p is running, hand the run token to the lowest-
+        tid READY thread (deterministic choice) by posting its deferred
+        reply. Returns the released thread, or None."""
+        if any(t.state == ManagedThread.RUNNING for t in p.threads):
+            return None
+        for t in p.threads:
+            if t.state == ManagedThread.READY and t.pending is not None:
+                ret, data = t.pending
+                t.pending = None
+                if t.channel is None:
+                    t.state = ManagedThread.EXITED
+                    continue
+                t.channel.reply(ret, sim_time_ns=self.now, data=data)
+                t.state = ManagedThread.RUNNING
+                return t
+        return None
 
     def _wake_sock_waiters(self, sock: Sock) -> None:
         self._try_wake(sock.owner)
@@ -1764,9 +1875,9 @@ class ProcessDriver:
     # the service loop (manager_run / scheduler round analog)
     # ------------------------------------------------------------------
 
-    def _service_one(self, proc: ManagedProcess) -> bool:
-        """Wait for proc's next message and handle it. Returns False if the
-        process exited instead of posting a message."""
+    def _service_one(self, proc: ManagedThread) -> bool:
+        """Wait for the thread's next message and handle it. Returns False
+        if the process exited instead of posting a message."""
         deadline = wall_time.monotonic() + self.service_timeout_s
         while True:
             if proc.channel.wait_request(timeout_s=0.05):
@@ -1774,8 +1885,10 @@ class ProcessDriver:
             if proc.popen is not None and proc.popen.poll() is not None:
                 # drain any message raced in just before exit
                 if not proc.channel.try_request():
-                    proc.state = ManagedProcess.EXITED
-                    proc.exit_code = proc.popen.returncode
+                    proc.proc.exit_code = proc.popen.returncode
+                    for t in proc.proc.threads:
+                        t.state = ManagedThread.EXITED
+                    proc.proc.exited = True
                     return False
                 break
             if wall_time.monotonic() > deadline:
@@ -1817,16 +1930,22 @@ class ProcessDriver:
             p.state = ManagedProcess.EXITED
             p.stdout, p.stderr = b"", b""
             return
-        if p.state == ManagedProcess.PARKED and p.channel and p.parked:
-            # The shim's STOP handler _exit(0)s; wait for that so the exit
-            # code is deterministic rather than racing a SIGTERM.
-            p.channel.reply(0, sim_time_ns=self.now, msg_type=ipc.MSG_STOP)
-            p.parked = None
-            if p.popen is not None:
-                try:
-                    p.popen.wait(timeout=5)
-                except subprocess.TimeoutExpired:
-                    pass
+        stopped = False
+        for t in p.threads:
+            if t.state == ManagedThread.PARKED and t.channel and t.parked:
+                # The shim's STOP handler _exit(0)s the whole process; wait
+                # for that so the exit code is deterministic rather than
+                # racing a SIGTERM. One STOP suffices.
+                t.channel.reply(0, sim_time_ns=self.now,
+                                msg_type=ipc.MSG_STOP)
+                t.parked = None
+                stopped = True
+                break
+        if stopped and p.popen is not None:
+            try:
+                p.popen.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                pass
         if p.popen is not None and p.popen.poll() is None:
             p.popen.terminate()
         p.stdout, p.stderr = p.finish()
@@ -1857,15 +1976,20 @@ class ProcessDriver:
             self._schedule(self.heartbeat_interval, beat)
 
         while True:
-            # 1. service running processes to quiescence (deterministic order)
+            # 1. service running threads to quiescence (deterministic order:
+            # processes in registration order, threads by tid; deferred
+            # wakes release one thread per process at a time)
             progressed = True
             while progressed:
                 progressed = False
                 for p in self.procs:
-                    while p.state == ManagedProcess.RUNNING and p.channel:
+                    for t in p.threads:
+                        while t.state == ManagedThread.RUNNING and t.channel:
+                            progressed = True
+                            if not self._service_one(t):
+                                break
+                    if self._release_ready(p) is not None:
                         progressed = True
-                        if not self._service_one(p):
-                            break
 
             # 2. all quiescent: let the device network advance first — its
             # deliveries may precede our next local event (the CPU↔TPU sync
@@ -1960,9 +2084,11 @@ class ProcessDriver:
 
         # teardown: stop anything still alive, collect output
         for p in self.procs:
-            if p.state == ManagedProcess.PARKED and p.channel:
-                p.channel.reply(0, sim_time_ns=self.now,
-                                msg_type=ipc.MSG_STOP)
+            for t in p.threads:
+                if t.state == ManagedThread.PARKED and t.channel:
+                    t.channel.reply(0, sim_time_ns=self.now,
+                                    msg_type=ipc.MSG_STOP)
+                    break
             if p.channel:
                 p.stdout, p.stderr = p.finish()
             elif not hasattr(p, "stdout"):

@@ -68,6 +68,9 @@ def build_simulation(source) -> Simulation:
     handlers: dict = {}
     subs: dict = {}
     initial_events: list = []
+    bulk_kinds: dict | None = None
+    matrix_handlers: dict | None = None
+    payload_words = 12  # net/packet.py layout; pure-PDES apps shrink it
     H = len(cfg.hosts)
     app_names = {h.app_model for h in cfg.hosts if h.app_model}
     if "phold" in app_names:
@@ -93,6 +96,13 @@ def build_simulation(source) -> Simulation:
         handlers.update(app.handlers())
         subs[PholdApp.SUB] = app.init_sub()
         initial_events.extend(app.initial_events())
+        bulk_kinds = app.bulk_kinds()
+        payload_words = PholdApp.PAYLOAD_WORDS
+        # The matrix fast path's draw-offset arithmetic assumes every
+        # destination is reachable (two draws per send, see
+        # PholdApp.handle_msg_matrix); register it only when that holds.
+        if not np.any(np.asarray(baked.latency_vv) == simtime.NEVER):
+            matrix_handlers = app.matrix_handlers()
 
     stack_apps = app_names & {"udp_flood", "udp_echo", "tcp_bulk"}
     if stack_apps:
@@ -195,6 +205,9 @@ def build_simulation(source) -> Simulation:
         O=cfg.experimental.outbox_slots,
         subs=subs,
         initial_events=initial_events,
+        bulk_kinds=bulk_kinds,
+        matrix_handlers=matrix_handlers,
+        payload_words=payload_words,
     )
     # attach build artifacts for inspection/observability
     sim.config = cfg

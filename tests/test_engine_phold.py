@@ -85,7 +85,8 @@ def phold_oracle(H, seed, latency_ns, reliability, msgload, start, stop_send, st
     }
 
 
-def build_phold_sim(H, seed, latency_ns, reliability, msgload, runtime, stop):
+def build_phold_sim(H, seed, latency_ns, reliability, msgload, runtime, stop,
+                    bulk=False):
     app = PholdApp(
         H,
         msgload=msgload,
@@ -109,6 +110,8 @@ def build_phold_sim(H, seed, latency_ns, reliability, msgload, runtime, stop):
             O=16,
             subs={PholdApp.SUB: app.init_sub()},
             initial_events=app.initial_events(),
+            bulk_kinds=app.bulk_kinds() if bulk else None,
+            matrix_handlers=app.matrix_handlers() if bulk == "matrix" else None,
         ),
         app,
     )
@@ -405,3 +408,52 @@ def test_outbox_overflow_defers_never_drops():
     assert c["outbox_overflow_dropped"] == 0
     assert c["outbox_stall_deferred"] > 0  # the path was actually forced
     assert c["pool_overflow_dropped"] == 0
+
+
+def test_phold_bulk_matches_oracle():
+    """The engine's bulk same-kind batch (G-way consecutive pop) must be
+    result-invariant: identical received/forwarded/drop/RNG counters vs the
+    sequential oracle, with far fewer micro-steps."""
+    H, seed = 5, 12345
+    latency, rel, msgload = 50 * MS, 0.9, 4
+    runtime, stop = 5 * SEC, 10 * SEC
+    sim, app = build_phold_sim(H, seed, latency, rel, msgload, runtime, stop,
+                               bulk=True)
+    sim.run_stepwise()
+    plain, _ = build_phold_sim(H, seed, latency, rel, msgload, runtime, stop)
+    plain.run_stepwise()
+    oracle = phold_oracle(H, seed, latency, rel, msgload, SEC, SEC + runtime, stop)
+    sub = jax.device_get(sim.state.subs[PholdApp.SUB])
+    assert list(sub["received"]) == oracle["received"]
+    assert list(sub["forwarded"]) == oracle["forwarded"]
+    cb, cp = sim.counters(), plain.counters()
+    assert cb["events_committed"] == cp["events_committed"]
+    assert cb["packets_dropped_loss"] == cp["packets_dropped_loss"]
+    assert cb["micro_steps"] < cp["micro_steps"]  # the batch actually bit
+    rng_c = jax.device_get(sim.state.host.rng_counter)
+    assert list(rng_c) == oracle["rng_counters"]
+
+
+def test_phold_matrix_path_matches_oracle():
+    """The whole-window matrix fast path (engine run_matrix) must be
+    bit-identical to the sequential oracle: same received/forwarded, same
+    drop counts, same RNG counters — and must actually take one micro-step
+    per window."""
+    H, seed = 5, 12345
+    latency, rel, msgload = 50 * MS, 0.8, 3
+    runtime, stop = 5 * SEC, 10 * SEC
+    sim, app = build_phold_sim(H, seed, latency, rel, msgload, runtime, stop,
+                               bulk="matrix")
+    windows = sim.run_stepwise()
+    oracle = phold_oracle(H, seed, latency, rel, msgload, SEC, SEC + runtime, stop)
+    sub = jax.device_get(sim.state.subs[PholdApp.SUB])
+    assert list(sub["received"]) == oracle["received"]
+    assert list(sub["forwarded"]) == oracle["forwarded"]
+    c = sim.counters()
+    assert c["packets_sent"] == oracle["sent"]
+    assert c["packets_dropped_loss"] == oracle["dropped"]
+    assert c["pool_overflow_dropped"] == 0
+    rng_c = jax.device_get(sim.state.host.rng_counter)
+    assert list(rng_c) == oracle["rng_counters"]
+    # one micro-step per window: the loop path never ran
+    assert c["micro_steps"] == windows

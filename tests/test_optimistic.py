@@ -51,6 +51,10 @@ hosts:
 def _final_fingerprint(sim):
     c = sim.counters()
     c.pop("pool_overflow_dropped", None)
+    # schedule metrics, not results: optimistic windows legitimately take a
+    # different number of engine iterations than the conservative schedule
+    c.pop("micro_steps", None)
+    c.pop("outbox_stall_deferred", None)
     subs = jax.device_get(sim.state.subs)
     return c, jax.tree.map(lambda x: np.asarray(x), subs)
 
