@@ -55,6 +55,7 @@ enum PseudoSys : int64_t {
   // parks/wakes threads keyed by (process, uaddr)
   PSYS_FUTEX_WAIT = -107,  // args: uaddr, timeout_ns (-1 none); ret 0/ETIMEDOUT
   PSYS_FUTEX_WAKE = -108,  // args: uaddr, n; ret = number woken
+  PSYS_WAITPID = -109,     // args: pid (-1 any); ret = pid, data = i32 status
 };
 
 #pragma pack(push, 8)

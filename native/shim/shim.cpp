@@ -72,6 +72,7 @@
 #include <sys/syscall.h>
 #include <sys/time.h>
 #include <sys/timerfd.h>
+#include <sys/wait.h>
 #include <sys/types.h>
 #include <sys/uio.h>
 #include <sys/utsname.h>
@@ -162,6 +163,7 @@ bool is_managed_fd(int fd) { return g_ch != nullptr && fd >= FD_BASE; }
 
 void shim_install_seccomp();  // defined at the bottom (needs the wrappers)
 void shim_patch_vdso();       // defined at the bottom
+void shim_notify_exit(int status, void*);  // defined with the thread plane
 
 // One request/response round trip. data_in/data_in_len ride to the driver;
 // the reply's inline data is copied to data_out (up to data_out_cap).
@@ -174,7 +176,10 @@ int64_t ipc_call(int64_t sysno, const int64_t args[6], const void* data_in,
     errno = ENOSYS;
     return -1;
   }
-  const bool shared = (ch == g_ch) && (t_ch != g_ch);
+  // Every g_ch user — including the main thread — takes the spinlock:
+  // a thread whose own channel failed to map (or a raw-clone thread)
+  // falls back to g_ch and would otherwise race the main thread on it.
+  const bool shared = (ch == g_ch);
   if (shared) raw_lock(&g_ch_lock);
   ch->type = MSG_SYSCALL;
   ch->sysno = sysno;
@@ -271,6 +276,9 @@ __attribute__((constructor)) void shim_init() {
   g_ch->data_len = 0;
   sem_post(&g_ch->to_driver);
   sem_wait_spinning(&g_ch->to_shim, g_spin);
+  // deterministic process-done notification (fork children inherit this
+  // registration and notify on their own channel)
+  on_exit(shim_notify_exit, nullptr);
   const char* sec = getenv(ENV_SECCOMP);
   if (!sec || strcmp(sec, "0") != 0) {
     shim_patch_vdso();  // before the filter: time must reach the kernel
@@ -1165,6 +1173,358 @@ const int kTrappedSyscalls[] = {
     SYS_pipe,          SYS_pipe2,          SYS_getrandom,
     SYS_pselect6,
 };
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// threads, futexes, fork (reference analogs: thread_preload.c:358-400 clone
+// bootstrap, futex.c/syscall/futex.c, process.c:460-531). Execution model:
+// the driver runs AT MOST ONE thread of a process between syscalls (it
+// withholds wake replies until the running thread blocks), which makes
+// multithreaded apps deterministic. Blocking synchronization therefore must
+// never block NATIVELY (a native futex wait would wedge the whole process):
+// the pthread mutex/cond surface is interposed here and parks threads in
+// the DRIVER, keyed by futex word address. The shim reads/writes the words
+// directly — same address space, no remote memory manager needed.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+Channel* map_channel(const char* path) {
+  int fd = open(path, O_RDWR);
+  if (fd < 0) return nullptr;
+  void* p = mmap(nullptr, sizeof(Channel), PROT_READ | PROT_WRITE, MAP_SHARED,
+                 fd, 0);
+  close(fd);
+  if (p == MAP_FAILED || ((Channel*)p)->magic != IPC_MAGIC) return nullptr;
+  return (Channel*)p;
+}
+
+// Registered via on_exit in shim_init: catches BOTH explicit exit() and
+// return-from-main (glibc calls exit internally, bypassing any interposed
+// exit symbol). The driver needs this DETERMINISTIC, sim-time-stamped
+// process-done signal — fork children have no popen handle to poll, and a
+// parent parked in waitpid must wake at a well-defined virtual instant.
+// (_exit/_Exit bypass atexit and so skip this — the driver's STOP path
+// uses _exit precisely to avoid a re-entrant notification.)
+void shim_notify_exit(int status, void*) {
+  if (!g_ch) return;
+  int64_t a[6] = {status, 1 /* process-level */, 0, 0, 0, 0};
+  ipc_call(PSYS_THREAD_EXIT, a, nullptr, 0, nullptr, 0, nullptr);
+  g_ch = nullptr;
+  t_ch = nullptr;
+}
+
+int futex_wait_driver(const void* uaddr, int64_t timeout_ns) {
+  int64_t a[6] = {(int64_t)(uintptr_t)uaddr, timeout_ns, 0, 0, 0, 0};
+  int64_t r = ipc_call(PSYS_FUTEX_WAIT, a, nullptr, 0, nullptr, 0, nullptr);
+  return r < 0 ? (int)errno : 0;
+}
+
+void futex_wake_driver(const void* uaddr, int n) {
+  int64_t a[6] = {(int64_t)(uintptr_t)uaddr, n, 0, 0, 0, 0};
+  ipc_call(PSYS_FUTEX_WAKE, a, nullptr, 0, nullptr, 0, nullptr);
+}
+
+struct ThreadReg {
+  ThreadReg* next;
+  pthread_t handle;
+  Channel* ch;
+  void* (*fn)(void*);
+  void* arg;
+  char shm[160];
+  std::atomic<int> done;
+};
+ThreadReg* g_threads = nullptr;
+std::atomic_flag g_threads_lock = ATOMIC_FLAG_INIT;
+
+void* thread_tramp(void* vp) {
+  ThreadReg* r = (ThreadReg*)vp;
+  Channel* ch = map_channel(r->shm);
+  if (ch) {
+    t_ch = ch;
+    r->ch = ch;
+    ch->shim_pid = (int32_t)sys_native(SYS_gettid);
+    // HELLO on the thread's own channel; the driver admits this thread
+    // (replies) only once the spawner blocks — one-at-a-time execution
+    ch->type = MSG_HELLO;
+    ch->ret = ch->shim_pid;
+    ch->data_len = 0;
+    sem_post(&ch->to_driver);
+    sem_wait_spinning(&ch->to_shim, g_spin);
+  } else {
+    SHIM_LOG("thread channel %s failed to map; thread runs unmanaged",
+             r->shm);
+  }
+  void* rv = r->fn(r->arg);
+  r->done.store(1, std::memory_order_release);
+  futex_wake_driver(&r->done, INT32_MAX);  // joiners
+  int64_t a[6] = {0, 0, 0, 0, 0, 0};
+  ipc_call(PSYS_THREAD_EXIT, a, nullptr, 0, nullptr, 0, nullptr);
+  t_ch = nullptr;
+  return rv;
+}
+
+ThreadReg* find_thread(pthread_t h) {
+  raw_lock(&g_threads_lock);
+  ThreadReg* r = g_threads;
+  while (r && !pthread_equal(r->handle, h)) r = r->next;
+  raw_unlock(&g_threads_lock);
+  return r;
+}
+
+// glibc struct __pthread_mutex_s prefix (x86-64): the interposed mutex
+// surface owns the semantics, reusing the same fields
+struct MutexView {
+  int lock;        // futex word: 0 free, 1 locked, 2 locked+waiters
+  unsigned count;  // recursion count
+  int owner;       // tid
+  unsigned nusers;
+  int kind;        // PTHREAD_MUTEX_* from pthread_mutex_init (glibc's)
+};
+
+int my_tid() {
+  static __thread int tid = 0;
+  if (!tid) tid = (int)sys_native(SYS_gettid);
+  return tid;
+}
+
+}  // namespace
+
+extern "C" {
+
+int pthread_create(pthread_t* out, const pthread_attr_t* attr,
+                   void* (*fn)(void*), void* arg) {
+  static auto real = (int (*)(pthread_t*, const pthread_attr_t*,
+                              void* (*)(void*), void*))
+      dlsym(RTLD_NEXT, "pthread_create");
+  if (!g_ch) return real(out, attr, fn, arg);
+  ThreadReg* r = (ThreadReg*)calloc(1, sizeof(ThreadReg));
+  r->fn = fn;
+  r->arg = arg;
+  uint32_t out_len = 0;
+  int64_t a[6] = {0, 0, 0, 0, 0, 0};
+  int64_t rc = ipc_call(PSYS_THREAD_NEW, a, nullptr, 0, r->shm,
+                        sizeof(r->shm) - 1, &out_len);
+  if (rc < 0) {
+    free(r);
+    return EAGAIN;
+  }
+  r->shm[out_len < sizeof(r->shm) - 1 ? out_len : sizeof(r->shm) - 1] = 0;
+  int ret = real(out, attr, thread_tramp, r);
+  if (ret != 0) {
+    free(r);  // driver-side channel leaks until process end; harmless
+    return ret;
+  }
+  r->handle = *out;
+  raw_lock(&g_threads_lock);
+  r->next = g_threads;
+  g_threads = r;
+  raw_unlock(&g_threads_lock);
+  return 0;
+}
+
+int pthread_join(pthread_t th, void** retval) {
+  static auto real = (int (*)(pthread_t, void**))
+      dlsym(RTLD_NEXT, "pthread_join");
+  ThreadReg* r = g_ch ? find_thread(th) : nullptr;
+  if (!r) return real(th, retval);
+  // park in the driver until the trampoline flips done (the native join
+  // below then returns ~immediately — the thread has left app code)
+  while (r->done.load(std::memory_order_acquire) == 0)
+    futex_wait_driver(&r->done, -1);
+  int ret = real(th, retval);
+  raw_lock(&g_threads_lock);
+  ThreadReg** pp = &g_threads;
+  while (*pp && *pp != r) pp = &(*pp)->next;
+  if (*pp) *pp = r->next;
+  raw_unlock(&g_threads_lock);
+  free(r);
+  return ret;
+}
+
+int pthread_mutex_lock(pthread_mutex_t* m) {
+  static auto real = (int (*)(pthread_mutex_t*))
+      dlsym(RTLD_NEXT, "pthread_mutex_lock");
+  if (!g_ch) return real(m);
+  MutexView* v = (MutexView*)m;
+  int tid = my_tid();
+  if ((v->kind & 3) == PTHREAD_MUTEX_RECURSIVE && v->owner == tid) {
+    v->count++;
+    return 0;
+  }
+  auto* w = (std::atomic<int>*)&v->lock;
+  int expected = 0;
+  if (!w->compare_exchange_strong(expected, 1)) {
+    // contended: classic two-state futex mutex, waits parked in-driver
+    while (w->exchange(2) != 0) futex_wait_driver(w, -1);
+  }
+  v->owner = tid;
+  v->count = 1;
+  return 0;
+}
+
+int pthread_mutex_trylock(pthread_mutex_t* m) {
+  static auto real = (int (*)(pthread_mutex_t*))
+      dlsym(RTLD_NEXT, "pthread_mutex_trylock");
+  if (!g_ch) return real(m);
+  MutexView* v = (MutexView*)m;
+  int tid = my_tid();
+  if ((v->kind & 3) == PTHREAD_MUTEX_RECURSIVE && v->owner == tid) {
+    v->count++;
+    return 0;
+  }
+  auto* w = (std::atomic<int>*)&v->lock;
+  int expected = 0;
+  if (w->compare_exchange_strong(expected, 1)) {
+    v->owner = tid;
+    v->count = 1;
+    return 0;
+  }
+  return EBUSY;
+}
+
+int pthread_mutex_unlock(pthread_mutex_t* m) {
+  static auto real = (int (*)(pthread_mutex_t*))
+      dlsym(RTLD_NEXT, "pthread_mutex_unlock");
+  if (!g_ch) return real(m);
+  MutexView* v = (MutexView*)m;
+  if ((v->kind & 3) == PTHREAD_MUTEX_RECURSIVE && v->count > 1) {
+    v->count--;
+    return 0;
+  }
+  v->owner = 0;
+  v->count = 0;
+  auto* w = (std::atomic<int>*)&v->lock;
+  if (w->exchange(0) == 2) futex_wake_driver(w, 1);
+  return 0;
+}
+
+// Condition variables: our representation is a bare sequence counter in
+// the (zero-initialized) pthread_cond_t; wait parks in the driver until a
+// signal/broadcast bumps the sequence. The driver's one-at-a-time
+// scheduling means check-then-park has no lost-wakeup race: the potential
+// waker cannot run between our sequence read and our park.
+int pthread_cond_wait(pthread_cond_t* c, pthread_mutex_t* m) {
+  static auto real = (int (*)(pthread_cond_t*, pthread_mutex_t*))
+      dlsym(RTLD_NEXT, "pthread_cond_wait");
+  if (!g_ch) return real(c, m);
+  auto* seq = (std::atomic<unsigned>*)c;
+  unsigned s = seq->load(std::memory_order_acquire);
+  pthread_mutex_unlock(m);
+  while (seq->load(std::memory_order_acquire) == s)
+    futex_wait_driver(seq, -1);
+  pthread_mutex_lock(m);
+  return 0;
+}
+
+int pthread_cond_timedwait(pthread_cond_t* c, pthread_mutex_t* m,
+                           const struct timespec* abstime) {
+  static auto real = (int (*)(pthread_cond_t*, pthread_mutex_t*,
+                              const struct timespec*))
+      dlsym(RTLD_NEXT, "pthread_cond_timedwait");
+  if (!g_ch) return real(c, m, abstime);
+  auto* seq = (std::atomic<unsigned>*)c;
+  unsigned s = seq->load(std::memory_order_acquire);
+  pthread_mutex_unlock(m);
+  int err = 0;
+  while (seq->load(std::memory_order_acquire) == s) {
+    // remaining virtual time until the absolute (sim-clock) deadline
+    int64_t now = ipc_call6(SYS_clock_gettime, CLOCK_REALTIME);
+    int64_t dl =
+        (int64_t)abstime->tv_sec * 1000000000LL + abstime->tv_nsec;
+    if (now >= dl) {
+      err = ETIMEDOUT;
+      break;
+    }
+    if (futex_wait_driver(seq, dl - now) == ETIMEDOUT &&
+        seq->load(std::memory_order_acquire) == s) {
+      err = ETIMEDOUT;
+      break;
+    }
+  }
+  pthread_mutex_lock(m);
+  return err;
+}
+
+int pthread_cond_signal(pthread_cond_t* c) {
+  static auto real = (int (*)(pthread_cond_t*))
+      dlsym(RTLD_NEXT, "pthread_cond_signal");
+  if (!g_ch) return real(c);
+  auto* seq = (std::atomic<unsigned>*)c;
+  seq->fetch_add(1, std::memory_order_acq_rel);
+  futex_wake_driver(seq, 1);
+  return 0;
+}
+
+int pthread_cond_broadcast(pthread_cond_t* c) {
+  static auto real = (int (*)(pthread_cond_t*))
+      dlsym(RTLD_NEXT, "pthread_cond_broadcast");
+  if (!g_ch) return real(c);
+  auto* seq = (std::atomic<unsigned>*)c;
+  seq->fetch_add(1, std::memory_order_acq_rel);
+  futex_wake_driver(seq, INT32_MAX);
+  return 0;
+}
+
+pid_t fork(void) {
+  static auto real = (pid_t (*)(void))dlsym(RTLD_NEXT, "fork");
+  if (!g_ch) return real();
+  char shm[160] = {0};
+  uint32_t out_len = 0;
+  int64_t a[6] = {0, 0, 0, 0, 0, 0};
+  int64_t rc = ipc_call(PSYS_FORK, a, nullptr, 0, shm, sizeof(shm) - 1,
+                        &out_len);
+  if (rc < 0) {
+    errno = EAGAIN;
+    return -1;
+  }
+  shm[out_len < sizeof(shm) - 1 ? out_len : sizeof(shm) - 1] = 0;
+  pid_t p = real();
+  if (p == 0) {
+    // child: single-threaded; adopt the pre-created channel (the parent's
+    // mapping is inherited but belongs to the parent)
+    Channel* ch = map_channel(shm);
+    if (!ch) _exit(127);
+    g_ch = ch;
+    t_ch = ch;
+    g_threads = nullptr;
+    ch->shim_pid = getpid();
+    ch->type = MSG_HELLO;
+    ch->ret = getpid();
+    ch->data_len = 0;
+    sem_post(&ch->to_driver);
+    sem_wait_spinning(&ch->to_shim, g_spin);
+  }
+  return p;
+}
+
+pid_t waitpid(pid_t pid, int* wstatus, int options) {
+  static auto real = (pid_t (*)(pid_t, int*, int))
+      dlsym(RTLD_NEXT, "waitpid");
+  if (!g_ch) return real(pid, wstatus, options);
+  // Fully driver-emulated for managed fork children: the driver knows the
+  // child's (deterministic, sim-time-stamped) exit and parks us until
+  // then — never block natively, which would wedge the whole process.
+  // WNOHANG also goes through the driver (args[1]=1): polling the NATIVE
+  // child state would leak wall-clock timing into the simulation.
+  int64_t a[6] = {pid, (options & WNOHANG) ? 1 : 0, 0, 0, 0, 0};
+  int32_t status = 0;
+  uint32_t out_len = 0;
+  int64_t rc = ipc_call(PSYS_WAITPID, a, nullptr, 0, &status,
+                        sizeof(status), &out_len);
+  if (rc < 0) return -1;  // errno set (ECHILD)
+  if (rc == 0) return 0;  // WNOHANG: no managed child done yet
+  if (wstatus) *wstatus = (status & 0xFF) << 8;  // normal-exit encoding
+  real((pid_t)rc, nullptr, WNOHANG);  // opportunistic zombie reap
+  return (pid_t)rc;
+}
+
+pid_t wait(int* wstatus) { return waitpid(-1, wstatus, 0); }
+
+}  // extern "C"
+
+namespace {
 
 // ---------------------------------------------------------------------------
 // vDSO neutralization. The vDSO serves clock_gettime/gettimeofday/time as

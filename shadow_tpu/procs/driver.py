@@ -387,6 +387,11 @@ class ManagedProcess:
         # per-process futex table: uaddr -> list of parked ManagedThread in
         # park order (futex_table.c analog)
         self.futexes: dict[int, list] = {}
+        # fork lineage (process.c:460-531 analog): parent process, the
+        # child's real pid (recorded at HELLO), and waitpid bookkeeping
+        self.parent: "ManagedProcess | None" = None
+        self.native_pid: int | None = None
+        self.wait_reported = False
 
     # --- main-thread delegation (single-thread call sites and tests) ---
 
@@ -719,6 +724,71 @@ class ProcessDriver:
                 out.append((rev, data))
         return out
 
+    def _futex_wake(self, p: ManagedProcess, uaddr: int, n: int) -> int:
+        """Wake up to n threads parked on (process, uaddr), in park order
+        (futex.c FIFO wake semantics)."""
+        q = p.futexes.get(uaddr)
+        woken = 0
+        while q and woken < n:
+            t = q.pop(0)
+            if (
+                t.state == ManagedThread.PARKED
+                and t.parked is not None
+                and t.parked.kind == "futex"
+            ):
+                t.parked = None
+                self._resume(t, 0)
+                woken += 1
+        if q is not None and not q:
+            p.futexes.pop(uaddr, None)
+        return woken
+
+    def _waitpid(self, thread: "ManagedThread", target: int, nohang: bool,
+                 park, done) -> None:
+        """PSYS_WAITPID: emulated wait for a managed fork child (the shim
+        never blocks — or polls — natively; both would leak wall-clock
+        state into the simulation)."""
+        p = thread.proc
+        kids = [q for q in self.procs if q.parent is p]
+
+        def match(q):
+            return target in (-1, 0) or q.native_pid == target
+
+        dead = [
+            q for q in kids if match(q) and q.exited and not q.wait_reported
+        ]
+        if dead:
+            q = dead[0]
+            q.wait_reported = True
+            st = int(q.exit_code or 0) & 0xFF
+            done(q.native_pid or 0, data=st.to_bytes(4, "little"))
+        elif any(match(q) and q.alive() for q in kids):
+            if nohang:
+                done(0)
+            else:
+                park(Parked(thread, "waitpid", want=target))
+        else:
+            done(-errno.ECHILD)
+
+    def _try_complete_waitpid(self, t: "ManagedThread") -> None:
+        if (
+            t.state != ManagedThread.PARKED
+            or t.parked is None
+            or t.parked.kind != "waitpid"
+        ):
+            return
+        target = t.parked.want
+        kids = [q for q in self.procs if q.parent is t.proc]
+        for q in kids:
+            if (target in (-1, 0) or q.native_pid == target) and q.exited \
+                    and not q.wait_reported:
+                q.wait_reported = True
+                st = int(q.exit_code or 0) & 0xFF
+                t.parked = None
+                self._resume(t, q.native_pid or 0,
+                             data=st.to_bytes(4, "little"))
+                return
+
     def _park(self, proc: ManagedProcess, pk: Parked) -> None:
         """Park proc's in-flight syscall on pk (no reply is sent until a
         wake or deadline; syscall_condition.c analog)."""
@@ -835,6 +905,11 @@ class ProcessDriver:
             self._resume(proc, 0, data=data)
         elif pk.kind == "epoll":
             self._resume(proc, 0)
+        elif pk.kind == "futex":
+            q = proc.proc.futexes.get(pk.want)
+            if q is not None and proc in q:
+                q.remove(proc)
+            self._resume(proc, -errno.ETIMEDOUT)
         elif pk.kind in ("recv", "accept", "connect"):
             self._resume(proc, -errno.ETIMEDOUT)
 
@@ -877,9 +952,22 @@ class ProcessDriver:
         return None
 
     def _wake_sock_waiters(self, sock: Sock) -> None:
-        self._try_wake(sock.owner)
-        # epoll/poll parked on this socket's owner handled above; other
-        # processes can't hold this fd (no fd passing in v1)
+        self._wake_fd_waiters(sock)
+
+    def _wake_fd_waiters(self, obj) -> None:
+        """Wake any thread parked on obj — fork children share open
+        descriptions with their parent, so EVERY process whose fd table
+        references the object must be scanned, not just the creator's."""
+        owner = getattr(obj, "owner", None)
+        if owner is not None:
+            self._try_wake(owner)
+        for q in self.procs:
+            if not q.alive():
+                continue
+            if owner is not None and q is getattr(owner, "proc", owner):
+                continue
+            if any(o is obj for o in q.fds.values()):
+                self._try_wake(q)
 
     # ------------------------------------------------------------------
     # per-host tracking + pcap (tracker.c / pcap_writer.c analogs)
@@ -1215,9 +1303,15 @@ class ProcessDriver:
             if obj is None:
                 done(-errno.EBADF)
                 return
-            # dup aliases: only tear the object down when the LAST fd
-            # referencing it closes
-            if not any(o is obj for o in proc.fds.values()):
+            # dup aliases AND fork sharing: only tear the object down when
+            # NO live process's fd table still references it (fork children
+            # share open descriptions across arbitrary generations)
+            still = any(
+                o is obj
+                for q in self.procs if q.alive()
+                for o in q.fds.values()
+            )
+            if not still:
                 self._close_obj(obj)
             done(0)
         elif sysno in (SYS_dup, SYS_dup2, SYS_dup3):
@@ -1524,6 +1618,61 @@ class ProcessDriver:
                 done(h.ip if h is not None else -errno.ENOENT)
         elif sysno == ipc.PSYS_GETHOSTNAME:
             done(0, data=proc.host.name.encode())
+        # ---- threads / processes (multiproc_design.md) ----
+        elif sysno == ipc.PSYS_THREAD_NEW:
+            ch_new = ipc.Channel()
+            t_new = ManagedThread(proc.proc, len(proc.proc.threads), ch_new)
+            # will HELLO on its own channel; serviced once the spawner blocks
+            t_new.state = ManagedThread.RUNNING
+            proc.proc.threads.append(t_new)
+            done(0, data=ch_new.path.encode())
+        elif sysno == ipc.PSYS_THREAD_EXIT:
+            if a[1]:  # process-level exit (on_exit notification)
+                p = proc.proc
+                p.exit_code = a[0]
+                # reply DIRECTLY (never via the CPU-delay deferral: the
+                # threads are marked exited below, so a deferred reply
+                # would be dropped and the process would hang in exit())
+                ch.reply(0, sim_time_ns=self.now)
+                for t in p.threads:
+                    t.state = ManagedThread.EXITED
+                p.exited = True
+                # a parent parked in waitpid wakes NOW, at this sim time
+                if p.parent is not None:
+                    for t in p.parent.threads:
+                        self._try_complete_waitpid(t)
+            else:
+                # reply directly (same deferred-reply hazard as above)
+                ch.reply(0, sim_time_ns=self.now)
+                proc.state = ManagedThread.EXITED
+        elif sysno == ipc.PSYS_FORK:
+            p = proc.proc
+            child = ManagedProcess(
+                name=f"{p.name}+{len(self.procs)}", args=p.args,
+                host=proc.host, start_time=self.now,
+            )
+            child.parent = p
+            # fork shares open descriptions: same objects, both tables.
+            # close() only tears the object down from its owning process
+            # (the other side just unlinks its fd) — see _dispatch close.
+            child.fds = dict(p.fds)
+            child.next_fd = p.next_fd
+            ch_new = ipc.Channel()
+            child.main.channel = ch_new
+            child.main.state = ManagedThread.RUNNING  # HELLO incoming
+            self.procs.append(child)
+            done(0, data=ch_new.path.encode())
+        elif sysno == ipc.PSYS_EXEC:
+            done(0)  # the fresh image re-HELLOs on the same channel
+        elif sysno == ipc.PSYS_FUTEX_WAIT:
+            uaddr, timeout_ns = a[0], a[1]
+            proc.proc.futexes.setdefault(uaddr, []).append(proc)
+            dl = None if timeout_ns < 0 else self.now + max(0, timeout_ns)
+            park(Parked(proc, "futex", want=uaddr, deadline=dl))
+        elif sysno == ipc.PSYS_FUTEX_WAKE:
+            done(self._futex_wake(proc.proc, a[0], a[1]))
+        elif sysno == ipc.PSYS_WAITPID:
+            self._waitpid(proc, a[0], bool(a[1]), park, done)
         else:
             done(-errno.ENOSYS)
 
@@ -1898,6 +2047,8 @@ class ProcessDriver:
                 )
         mtype = proc.channel.msg_type
         if mtype == ipc.MSG_HELLO:
+            if proc.tid == 0 and proc.proc.native_pid is None:
+                proc.proc.native_pid = proc.channel.shim_pid
             proc.channel.reply(0, sim_time_ns=self.now)
         elif mtype == ipc.MSG_SYSCALL:
             self._dispatch(proc)

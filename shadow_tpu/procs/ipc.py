@@ -30,6 +30,13 @@ MSG_STOP = 5
 PSYS_RESOLVE_NAME = -100
 PSYS_YIELD = -101
 PSYS_GETHOSTNAME = -102
+PSYS_THREAD_NEW = -103
+PSYS_THREAD_EXIT = -104
+PSYS_FORK = -105
+PSYS_EXEC = -106
+PSYS_FUTEX_WAIT = -107
+PSYS_FUTEX_WAKE = -108
+PSYS_WAITPID = -109
 
 FD_BASE = 1000
 

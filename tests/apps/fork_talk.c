@@ -1,0 +1,72 @@
+/* fork() under the simulator: the child gets its own driver channel
+ * (PSYS_FORK pre-creates it; the shim's fork interposition adopts it in
+ * the child), opens a UDP socket on the SAME simulated host, and talks to
+ * the parent over the simulated loopback path. The parent waits for the
+ * child via the driver-emulated waitpid. */
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <stdio.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <sys/wait.h>
+#include <time.h>
+#include <unistd.h>
+
+static long long now_ns(void) {
+  struct timespec ts;
+  clock_gettime(CLOCK_REALTIME, &ts);
+  return (long long)ts.tv_sec * 1000000000LL + ts.tv_nsec;
+}
+
+int main(void) {
+  int parent_sock = socket(AF_INET, SOCK_DGRAM, 0);
+  struct sockaddr_in addr;
+  memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(7100);
+  if (bind(parent_sock, (struct sockaddr*)&addr, sizeof(addr)) != 0) {
+    perror("bind");
+    return 1;
+  }
+
+  pid_t pid = fork();
+  if (pid < 0) {
+    perror("fork");
+    return 1;
+  }
+  if (pid == 0) {
+    // child: send two datagrams to the parent, then exit 7
+    int s = socket(AF_INET, SOCK_DGRAM, 0);
+    struct sockaddr_in dst;
+    memset(&dst, 0, sizeof(dst));
+    dst.sin_family = AF_INET;
+    dst.sin_addr.s_addr = htonl(0x7F000001);
+    dst.sin_port = htons(7100);
+    for (int i = 0; i < 2; i++) {
+      char msg[32];
+      int n = snprintf(msg, sizeof(msg), "child msg %d", i);
+      sendto(s, msg, n, 0, (struct sockaddr*)&dst, sizeof(dst));
+      struct timespec d = {0, 5000000};
+      nanosleep(&d, 0);
+    }
+    printf("child done at %lld\n", now_ns());
+    return 7;
+  }
+  // parent: receive both, then reap the child
+  for (int i = 0; i < 2; i++) {
+    char buf[64];
+    ssize_t n = recvfrom(parent_sock, buf, sizeof(buf) - 1, 0, 0, 0);
+    if (n < 0) {
+      perror("recvfrom");
+      return 1;
+    }
+    buf[n] = 0;
+    printf("parent got '%s' at %lld\n", buf, now_ns());
+  }
+  int st = 0;
+  pid_t r = waitpid(pid, &st, 0);
+  printf("reaped pid %s status %d at %lld\n", r == pid ? "ok" : "BAD",
+         WEXITSTATUS(st), now_ns());
+  return 0;
+}

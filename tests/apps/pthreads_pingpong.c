@@ -1,0 +1,54 @@
+/* Multithreaded managed app: N worker threads pass a token around with a
+ * mutex + condvar (interposed by the shim; contended waits park in the
+ * driver), each holder sleeps 10ms on the VIRTUAL clock, and the main
+ * thread joins everyone. Deterministic output: the token order is fixed by
+ * the driver's one-thread-at-a-time scheduling, and the printed timestamps
+ * are exact virtual-clock values.
+ * Usage: pthreads_pingpong <nthreads> <rounds> */
+#include <pthread.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <time.h>
+
+static pthread_mutex_t lock = PTHREAD_MUTEX_INITIALIZER;
+static pthread_cond_t cv = PTHREAD_COND_INITIALIZER;
+static int token = 0;
+static int nthreads = 3;
+static int rounds = 2;
+
+static long long now_ns(void) {
+  struct timespec ts;
+  clock_gettime(CLOCK_REALTIME, &ts);
+  return (long long)ts.tv_sec * 1000000000LL + ts.tv_nsec;
+}
+
+static void* worker(void* vp) {
+  int id = (int)(long)vp;
+  for (int r = 0; r < rounds; r++) {
+    pthread_mutex_lock(&lock);
+    while (token % nthreads != id) pthread_cond_wait(&cv, &lock);
+    printf("t%d round %d at %lld\n", id, r, now_ns());
+    struct timespec d = {0, 10000000};
+    nanosleep(&d, 0);
+    token++;
+    pthread_cond_broadcast(&cv);
+    pthread_mutex_unlock(&lock);
+  }
+  return (void*)(long)(id * 100);
+}
+
+int main(int argc, char** argv) {
+  if (argc > 1) nthreads = atoi(argv[1]);
+  if (argc > 2) rounds = atoi(argv[2]);
+  pthread_t th[16];
+  for (int i = 0; i < nthreads && i < 16; i++)
+    pthread_create(&th[i], 0, worker, (void*)(long)i);
+  long sum = 0;
+  for (int i = 0; i < nthreads && i < 16; i++) {
+    void* rv = 0;
+    pthread_join(th[i], &rv);
+    sum += (long)rv;
+  }
+  printf("joined sum %ld token %d at %lld\n", sum, token, now_ns());
+  return 0;
+}

@@ -1,0 +1,94 @@
+"""Multithreaded and multi-process managed apps (docs/multiproc_design.md;
+reference analogs: thread_preload.c:358-400 clone bootstrap, futex.c,
+process.c fork). Each pthread gets its own driver channel; at most one
+thread of a process runs app code between syscalls, making the schedule —
+and therefore output — deterministic. Contended pthread mutex/cond waits
+park in the DRIVER (never natively), and fork children adopt pre-created
+channels and are reaped through the driver-emulated waitpid."""
+
+import pytest
+
+from shadow_tpu.procs import build as build_mod
+from shadow_tpu.procs.builder import build_process_driver
+
+pytestmark = pytest.mark.skipif(
+    not build_mod.toolchain_available(), reason="no native toolchain"
+)
+
+NS = 1_000_000_000
+
+
+def _yaml(path, args=""):
+    arg_line = f"\n        args: {args}" if args else ""
+    return f"""
+general:
+  stop_time: 30 s
+  seed: 5
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        node [ id 0 bandwidth_down "100 Mbit" bandwidth_up "100 Mbit" ]
+        edge [ source 0 target 0 latency "10 ms" ]
+      ]
+hosts:
+  solo:
+    processes:
+      - path: {path}{arg_line}
+        start_time: 1 s
+"""
+
+
+def test_pthreads_pingpong_deterministic(apps):
+    """3 threads pass a token via interposed mutex+cond, each sleeping
+    10ms on the virtual clock; join returns every thread's value."""
+    def run_once():
+        d = build_process_driver(_yaml(apps["pthreads_pingpong"], "3 2"))
+        d.run()
+        p = d.procs[0]
+        assert p.exit_code == 0, (p.stdout, p.stderr)
+        return p.stdout
+
+    out = run_once()
+    lines = out.decode().splitlines()
+    # token order is fixed: t0 r0, t1 r0, t2 r0, t0 r1, t1 r1, t2 r1
+    order = [ln.split(" at ")[0] for ln in lines[:-1]]
+    assert order == [
+        "t0 round 0", "t1 round 0", "t2 round 0",
+        "t0 round 1", "t1 round 1", "t2 round 1",
+    ], lines
+    # each holder sleeps 10ms of VIRTUAL time before passing the token on:
+    # consecutive grabs are exactly 10ms apart starting at 1s
+    times = [int(ln.split(" at ")[1]) for ln in lines[:-1]]
+    assert times[0] == 1 * NS
+    assert [t - times[0] for t in times] == [
+        i * 10_000_000 for i in range(6)
+    ], times
+    assert lines[-1].startswith("joined sum 300 token 6")
+    # byte-identical rerun (determinism gate)
+    assert run_once() == out
+
+
+def test_fork_child_talks_over_sim_network(apps):
+    """fork(): the child adopts its own pre-created channel, sends UDP to
+    the parent through the simulated loopback, exits 7; the parent reaps
+    it via the driver-emulated waitpid."""
+    d = build_process_driver(_yaml(apps["fork_talk"]))
+    d.run()
+    p = d.procs[0]
+    assert p.exit_code == 0, (p.stdout, p.stderr)
+    out = p.stdout.decode()
+    assert "parent got 'child msg 0'" in out
+    assert "parent got 'child msg 1'" in out
+    assert "reaped pid ok status 7" in out
+
+    # Deterministic rerun: identical lines (the parent and child share one
+    # native stdout pipe in this harness, so INTERLEAVING of same-virtual-
+    # instant lines is not defined — the CLI runner gives each process its
+    # own stdout file, like the reference's shadow.data layout)
+    d2 = build_process_driver(_yaml(apps["fork_talk"]))
+    d2.run()
+    assert sorted(d2.procs[0].stdout.splitlines()) == sorted(
+        p.stdout.splitlines()
+    )
