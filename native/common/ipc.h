@@ -49,7 +49,14 @@ enum PseudoSys : int64_t {
   PSYS_THREAD_NEW = -103,   // reply data = new thread's channel shm name
   PSYS_THREAD_EXIT = -104,  // this thread is done (no reply expected data)
   PSYS_FORK = -105,         // reply data = child process's channel shm name
-  PSYS_EXEC = -106,         // args[0]=0; caller is about to execve natively
+  PSYS_EXEC = -106,  // data = argv NUL-list "\0" envp NUL-list; the driver
+                     // RESPAWNS the image as a fresh managed process that
+                     // keeps this process's virtual identity (fds, pid
+                     // bookkeeping, parent/waitpid linkage) and the caller
+                     // _exits — native execve under the inherited seccomp
+                     // filter is unsurvivable (no SIGSYS handler until the
+                     // new shim constructor, but glibc startup already
+                     // hits trapped syscalls)
   // futex-class blocking (reference: futex.c:19-30, syscall/futex.c); the
   // shim reads the futex word itself (same address space), the driver only
   // parks/wakes threads keyed by (process, uaddr)

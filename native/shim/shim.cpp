@@ -1144,8 +1144,19 @@ void on_sigsys(int sig, siginfo_t* info, void* vctx) {
   ucontext_t* uc = (ucontext_t*)vctx;
   greg_t* g = uc->uc_mcontext.gregs;
   long nr = (long)info->si_syscall;
+  // Recursion guard for exec'd images: an INHERITED filter from the
+  // pre-exec image traps even this image's gate (different address), so a
+  // native-fallback path would re-trap forever. Depth >= 2 on the same
+  // thread means exactly that — fail the syscall loudly instead.
+  static __thread int depth = 0;
+  if (depth >= 2) {
+    g[REG_RAX] = (greg_t)(-ENOSYS);
+    return;
+  }
+  depth++;
   long r = route_raw_syscall(nr, g[REG_RDI], g[REG_RSI], g[REG_RDX],
                              g[REG_R10], g[REG_R8], g[REG_R9]);
+  depth--;
   g[REG_RAX] = (greg_t)r;
 #else
   (void)info;
@@ -1155,23 +1166,40 @@ void on_sigsys(int sig, siginfo_t* info, void* vctx) {
 
 // syscall numbers the backstop traps (the emulated surface; everything
 // else — memory, threads, files, process control — passes through)
-const int kTrappedSyscalls[] = {
-    SYS_read,          SYS_write,          SYS_close,
-    SYS_poll,          SYS_ioctl,          SYS_readv,
-    SYS_writev,        SYS_select,         SYS_dup,
-    SYS_dup2,          SYS_dup3,           SYS_nanosleep,
-    SYS_socket,        SYS_connect,        SYS_accept,
-    SYS_accept4,       SYS_sendto,         SYS_recvfrom,
-    SYS_sendmsg,       SYS_recvmsg,        SYS_shutdown,
-    SYS_bind,          SYS_listen,         SYS_getsockname,
-    SYS_getpeername,   SYS_setsockopt,     SYS_getsockopt,
-    SYS_fcntl,         SYS_gettimeofday,   SYS_time,
-    SYS_clock_gettime, SYS_clock_nanosleep, SYS_epoll_create,
-    SYS_epoll_create1, SYS_epoll_ctl,      SYS_epoll_wait,
-    SYS_epoll_pwait,   SYS_timerfd_create, SYS_timerfd_settime,
-    SYS_timerfd_gettime, SYS_eventfd,      SYS_eventfd2,
-    SYS_pipe,          SYS_pipe2,          SYS_getrandom,
-    SYS_pselect6,
+// Trap classification. FD0/FD01 syscalls trap ONLY when the fd argument
+// is in the emulated range (>= FD_BASE): low/real-fd operations run native
+// with zero filter cost, and — crucially — an EXEC'D image (which inherits
+// this filter but starts with no SIGSYS handler until its own shim
+// constructor runs) can boot: ld.so/libc startup only touches low fds.
+enum TrapAct { ACT_TRAP, ACT_FD0, ACT_FD01 };
+struct TrapEntry {
+  int nr;
+  TrapAct act;
+};
+const TrapEntry kTrapped[] = {
+    {SYS_read, ACT_FD0},          {SYS_write, ACT_FD0},
+    {SYS_close, ACT_FD0},         {SYS_poll, ACT_TRAP},
+    {SYS_ioctl, ACT_FD0},         {SYS_readv, ACT_FD0},
+    {SYS_writev, ACT_FD0},        {SYS_select, ACT_TRAP},
+    {SYS_dup, ACT_FD0},           {SYS_dup2, ACT_FD01},
+    {SYS_dup3, ACT_FD01},         {SYS_nanosleep, ACT_TRAP},
+    {SYS_socket, ACT_TRAP},       {SYS_connect, ACT_FD0},
+    {SYS_accept, ACT_FD0},        {SYS_accept4, ACT_FD0},
+    {SYS_sendto, ACT_FD0},        {SYS_recvfrom, ACT_FD0},
+    {SYS_sendmsg, ACT_FD0},       {SYS_recvmsg, ACT_FD0},
+    {SYS_shutdown, ACT_FD0},      {SYS_bind, ACT_FD0},
+    {SYS_listen, ACT_FD0},        {SYS_getsockname, ACT_FD0},
+    {SYS_getpeername, ACT_FD0},   {SYS_setsockopt, ACT_FD0},
+    {SYS_getsockopt, ACT_FD0},    {SYS_fcntl, ACT_FD0},
+    {SYS_gettimeofday, ACT_TRAP}, {SYS_time, ACT_TRAP},
+    {SYS_clock_gettime, ACT_TRAP}, {SYS_clock_nanosleep, ACT_TRAP},
+    {SYS_epoll_create, ACT_TRAP}, {SYS_epoll_create1, ACT_TRAP},
+    {SYS_epoll_ctl, ACT_FD0},     {SYS_epoll_wait, ACT_FD0},
+    {SYS_epoll_pwait, ACT_FD0},   {SYS_timerfd_create, ACT_TRAP},
+    {SYS_timerfd_settime, ACT_FD0}, {SYS_timerfd_gettime, ACT_FD0},
+    {SYS_eventfd, ACT_TRAP},      {SYS_eventfd2, ACT_TRAP},
+    {SYS_pipe, ACT_TRAP},         {SYS_pipe2, ACT_TRAP},
+    {SYS_getrandom, ACT_TRAP},    {SYS_pselect6, ACT_TRAP},
 };
 
 }  // namespace
@@ -1237,6 +1265,21 @@ struct ThreadReg {
 };
 ThreadReg* g_threads = nullptr;
 std::atomic_flag g_threads_lock = ATOMIC_FLAG_INIT;
+__thread ThreadReg* t_reg = nullptr;
+
+void thread_epilogue() {
+  // done-flag + joiner wake + driver notification; runs exactly once per
+  // managed thread, whether it returns from its start routine or calls
+  // pthread_exit (which is interposed to come through here)
+  ThreadReg* r = t_reg;
+  if (!r) return;
+  t_reg = nullptr;
+  r->done.store(1, std::memory_order_release);
+  futex_wake_driver(&r->done, INT32_MAX);  // joiners
+  int64_t a[6] = {0, 0, 0, 0, 0, 0};
+  ipc_call(PSYS_THREAD_EXIT, a, nullptr, 0, nullptr, 0, nullptr);
+  t_ch = nullptr;
+}
 
 void* thread_tramp(void* vp) {
   ThreadReg* r = (ThreadReg*)vp;
@@ -1256,12 +1299,9 @@ void* thread_tramp(void* vp) {
     SHIM_LOG("thread channel %s failed to map; thread runs unmanaged",
              r->shm);
   }
+  t_reg = r;
   void* rv = r->fn(r->arg);
-  r->done.store(1, std::memory_order_release);
-  futex_wake_driver(&r->done, INT32_MAX);  // joiners
-  int64_t a[6] = {0, 0, 0, 0, 0, 0};
-  ipc_call(PSYS_THREAD_EXIT, a, nullptr, 0, nullptr, 0, nullptr);
-  t_ch = nullptr;
+  thread_epilogue();
   return rv;
 }
 
@@ -1322,6 +1362,13 @@ int pthread_create(pthread_t* out, const pthread_attr_t* attr,
   g_threads = r;
   raw_unlock(&g_threads_lock);
   return 0;
+}
+
+void pthread_exit(void* retval) {
+  static auto real = (void (*)(void*))dlsym(RTLD_NEXT, "pthread_exit");
+  thread_epilogue();  // no-op for unmanaged/main threads (t_reg unset)
+  real(retval);
+  _exit(0);  // not reached; placates noreturn
 }
 
 int pthread_join(pthread_t th, void** retval) {
@@ -1481,6 +1528,15 @@ pid_t fork(void) {
   }
   shm[out_len < sizeof(shm) - 1 ? out_len : sizeof(shm) - 1] = 0;
   pid_t p = real();
+  if (p < 0) {
+    // native fork failed AFTER the driver registered a child: retract it
+    // (a[1]=2) or the driver would wait forever for its HELLO
+    int saved = errno;
+    int64_t r2[6] = {0, 2, 0, 0, 0, 0};
+    ipc_call(PSYS_THREAD_EXIT, r2, nullptr, 0, nullptr, 0, nullptr);
+    errno = saved;
+    return -1;
+  }
   if (p == 0) {
     // child: single-threaded; adopt the pre-created channel (the parent's
     // mapping is inherited but belongs to the parent)
@@ -1489,6 +1545,9 @@ pid_t fork(void) {
     g_ch = ch;
     t_ch = ch;
     g_threads = nullptr;
+    // a later execve must hand the CHILD's channel to the fresh image,
+    // not the inherited parent path
+    setenv(ENV_SHM, shm, 1);
     ch->shim_pid = getpid();
     ch->type = MSG_HELLO;
     ch->ret = getpid();
@@ -1521,6 +1580,54 @@ pid_t waitpid(pid_t pid, int* wstatus, int options) {
 }
 
 pid_t wait(int* wstatus) { return waitpid(-1, wstatus, 0); }
+
+extern char** environ;
+
+int execv(const char* path, char* const argv[]) {
+  // glibc's execv calls execve internally (not via the PLT), so interpose
+  // it explicitly and funnel into the managed execve below
+  return execve(path, argv, environ);
+}
+
+int execve(const char* path, char* const argv[], char* const envp[]) {
+  static auto real = (int (*)(const char*, char* const[], char* const[]))
+      dlsym(RTLD_NEXT, "execve");
+  if (!g_ch) return real(path, argv, envp);
+  // The driver RESPAWNS the image as a fresh managed process (clean
+  // seccomp state, same virtual identity) and this process exits — see
+  // PSYS_EXEC in ipc.h for why native execve cannot work here. Wire
+  // format: path NUL, then the FULL argv (argv[0] included — multicall
+  // binaries dispatch on it) as NUL-terminated strings, then envp; argc
+  // rides in args[0] so empty argv strings cannot confuse the framing.
+  char buf[IPC_DATA_MAX];
+  uint32_t off = 0;
+  auto put = [&](const char* s) {
+    size_t len = strlen(s) + 1;
+    if (off + len > sizeof(buf)) return false;
+    memcpy(buf + off, s, len);
+    off += (uint32_t)len;
+    return true;
+  };
+  if (!put(path)) {
+    errno = E2BIG;
+    return -1;
+  }
+  int64_t argc = 0;
+  for (int j = 0; argv && argv[j]; j++, argc++)
+    if (!put(argv[j])) {
+      errno = E2BIG;
+      return -1;
+    }
+  for (int j = 0; envp && envp[j]; j++)
+    if (!put(envp[j])) {
+      errno = E2BIG;
+      return -1;
+    }
+  int64_t a[6] = {argc, 0, 0, 0, 0, 0};
+  int64_t rc = ipc_call(PSYS_EXEC, a, buf, off, nullptr, 0, nullptr);
+  if (rc < 0) return -1;  // errno set (e.g. ENOENT)
+  _exit(0);  // replaced by the respawned image; never returns
+}
 
 }  // extern "C"
 
@@ -1683,17 +1790,25 @@ void shim_install_seccomp() {
   sigaddset(&unblock, SIGSYS);
   sigprocmask(SIG_UNBLOCK, &unblock, nullptr);
 
-  constexpr int K = (int)(sizeof(kTrappedSyscalls) / sizeof(int));
-  // layout: 0 ld arch / 1 jeq x86_64 (else KILL) / 2 ld ip_hi / 3 jeq hi /
-  //         4 ld ip_lo / 5 jge lo / 6 jge lo+W / 7 ld nr /
-  //         8..8+K-1 jeq nr → TRAP / ALLOW at 8+K / TRAP at 9+K /
-  //         KILL at 10+K
-  const uint8_t NR = 7, ALLOW = 8 + K, TRAP = 9 + K;
-  struct sock_filter prog[11 + K];
+  constexpr int K = (int)(sizeof(kTrapped) / sizeof(kTrapped[0]));
+  // layout: [arch check][gate IP window check][ld nr]
+  //         [K dispatch jeqs → TRAP / FD0 / FD01] [fallthrough ALLOW]
+  //         FD0: ld args[0]; >= FD_BASE ? TRAP : ALLOW
+  //         FD01: ld args[0]; >= FD_BASE ? TRAP : ld args[1]; ...
+  //         ALLOW / TRAP / KILL returns
+  const int NR = 7;
+  const int DISPATCH0 = 8;
+  const int FD0 = DISPATCH0 + K + 1;   // after dispatch + fallthrough ALLOW
+  const int FD01 = FD0 + 2;
+  const int ALLOW = FD01 + 4;
+  const int TRAP = ALLOW + 1;
+  const int KILL = TRAP + 1;
+  struct sock_filter prog[KILL + 1];
+  const uint32_t ARG0_LO = offsetof(struct seccomp_data, args);
+  const uint32_t ARG1_LO = ARG0_LO + 8;
   int i = 0;
   // non-x86-64 audit arch (e.g. int 0x80 compat syscalls) would bypass
   // virtualization with wrong syscall numbering: kill loudly instead
-  const uint8_t KILL = TRAP + 1;
   prog[i++] = BPF_STMT(BPF_LD | BPF_W | BPF_ABS,
                        offsetof(struct seccomp_data, arch));
   prog[i++] = BPF_JUMP(BPF_JMP | BPF_JEQ | BPF_K, AUDIT_ARCH_X86_64, 0,
@@ -1711,11 +1826,33 @@ void shim_install_seccomp() {
   prog[i++] = BPF_STMT(BPF_LD | BPF_W | BPF_ABS,
                        offsetof(struct seccomp_data, nr));
   for (int k = 0; k < K; k++) {
+    int target = kTrapped[k].act == ACT_TRAP   ? TRAP
+                 : kTrapped[k].act == ACT_FD0  ? FD0
+                                               : FD01;
     prog[i] = BPF_JUMP(BPF_JMP | BPF_JEQ | BPF_K,
-                       (uint32_t)kTrappedSyscalls[k],
-                       (uint8_t)(TRAP - (i + 1)), 0);
+                       (uint32_t)kTrapped[k].nr,
+                       (uint8_t)(target - (i + 1)), 0);
     i++;
   }
+  prog[i++] = BPF_STMT(BPF_RET | BPF_K, SECCOMP_RET_ALLOW);  // fallthrough
+  // Offsets computed from explicit positions (never `i` inside a
+  // `prog[i++] = ...` expression — that miscompiled to wild jumps).
+  // FD0: trap iff args[0] (the fd) is in the emulated range
+  prog[i++] = BPF_STMT(BPF_LD | BPF_W | BPF_ABS, ARG0_LO);
+  prog[i] = BPF_JUMP(BPF_JMP | BPF_JGE | BPF_K, (uint32_t)FD_BASE,
+                     (uint8_t)(TRAP - (FD0 + 2)),
+                     (uint8_t)(ALLOW - (FD0 + 2)));
+  i++;
+  // FD01 (dup2/dup3): trap iff either fd argument is emulated
+  prog[i++] = BPF_STMT(BPF_LD | BPF_W | BPF_ABS, ARG0_LO);
+  prog[i] = BPF_JUMP(BPF_JMP | BPF_JGE | BPF_K, (uint32_t)FD_BASE,
+                     (uint8_t)(TRAP - (FD01 + 2)), 0);
+  i++;
+  prog[i++] = BPF_STMT(BPF_LD | BPF_W | BPF_ABS, ARG1_LO);
+  prog[i] = BPF_JUMP(BPF_JMP | BPF_JGE | BPF_K, (uint32_t)FD_BASE,
+                     (uint8_t)(TRAP - (FD01 + 4)),
+                     (uint8_t)(ALLOW - (FD01 + 4)));
+  i++;
   prog[i++] = BPF_STMT(BPF_RET | BPF_K, SECCOMP_RET_ALLOW);
   prog[i++] = BPF_STMT(BPF_RET | BPF_K, SECCOMP_RET_TRAP);
 #ifdef SECCOMP_RET_KILL_PROCESS

@@ -145,6 +145,7 @@ class Sock:
     bound: tuple[int, int] | None = None  # (ip, port)
     peer: tuple[int, int] | None = None
     nonblock: bool = False
+    cloexec: bool = False  # FD_CLOEXEC/SOCK_CLOEXEC: dropped at exec respawn
     # UDP: deque of (src_ip, src_port, bytes)
     dgrams: deque = field(default_factory=deque)
     # TCP
@@ -249,6 +250,7 @@ class PipeEnd:
     buf: PipeBuf
     is_read: bool
     nonblock: bool = False
+    cloexec: bool = False
 
     def readable(self) -> bool:
         return self.is_read and (len(self.buf.data) > 0 or self.buf.write_closed)
@@ -392,6 +394,9 @@ class ManagedProcess:
         self.parent: "ManagedProcess | None" = None
         self.native_pid: int | None = None
         self.wait_reported = False
+        # prior native images retired by exec respawns (outputs are
+        # concatenated in finish(), preserving stdio continuity)
+        self.old_popens: list = []
 
     # --- main-thread delegation (single-thread call sites and tests) ---
 
@@ -454,12 +459,22 @@ class ManagedProcess:
 
     def finish(self) -> tuple[bytes, bytes]:
         out, err = b"", b""
+        for op in self.old_popens:
+            try:
+                o2, e2 = op.communicate(timeout=5)
+            except subprocess.TimeoutExpired:
+                op.kill()
+                o2, e2 = op.communicate()
+            out += o2 or b""
+            err += e2 or b""
         if self.popen:
             try:
-                out, err = self.popen.communicate(timeout=10)
+                o2, e2 = self.popen.communicate(timeout=10)
             except subprocess.TimeoutExpired:
                 self.popen.kill()
-                out, err = self.popen.communicate()
+                o2, e2 = self.popen.communicate()
+            out += o2 or b""
+            err += e2 or b""
             self.exit_code = self.popen.returncode
         if self.stdout_path is not None:
             with open(self.stdout_path, "rb") as f:
@@ -769,6 +784,107 @@ class ProcessDriver:
                 park(Parked(thread, "waitpid", want=target))
         else:
             done(-errno.ECHILD)
+
+    def _release_fds(self, p: ManagedProcess) -> None:
+        """Drop p's fd table, tearing down objects no other live process
+        still references (fork shares open descriptions)."""
+        for fd in list(p.fds):
+            obj = p.fds.pop(fd)
+            still = any(
+                o is obj
+                for q in self.procs if q.alive()
+                for o in q.fds.values()
+            )
+            if not still:
+                self._close_obj(obj)
+
+    def _exec_respawn(self, thread: "ManagedThread", data: bytes,
+                      argc: int) -> None:
+        """PSYS_EXEC: replace the process image by spawning the target as a
+        FRESH managed process that keeps this ManagedProcess's virtual
+        identity — fd table, native-pid bookkeeping, fork/waitpid linkage.
+        Native execve is unsurvivable under the inherited seccomp filter
+        (glibc startup hits trapped syscalls before any SIGSYS handler can
+        exist), so exec is emulated at the driver, like everything else
+        about process lifecycle (reference analog: process.c:460-531 spawns
+        every image fresh too)."""
+        p = thread.proc
+        parts = data.split(b"\0")
+        if len(parts) < 1 + argc:
+            thread.channel.reply(-errno.EINVAL, sim_time_ns=self.now)
+            return
+        path = parts[0].decode("utf-8", "replace")
+        argv = [
+            x.decode("utf-8", "replace") for x in parts[1:1 + argc]
+        ]
+        envl = [
+            x.decode("utf-8", "replace") for x in parts[1 + argc:] if x
+        ]
+        # resolve relative to the PROCESS's cwd, not the driver's
+        full = path if os.path.isabs(path) else os.path.join(
+            p.cwd or os.getcwd(), path
+        )
+        if not os.path.isfile(full) or not os.access(full, os.X_OK):
+            thread.channel.reply(-errno.ENOENT, sim_time_ns=self.now)
+            return
+        # reply DIRECTLY (not via the CPU-delay deferral: the old threads
+        # are retired below) — the old image _exits on receipt
+        thread.channel.reply(0, sim_time_ns=self.now)
+        if p.popen is not None:
+            p.old_popens.append(p.popen)
+            p.popen = None
+        for t in p.threads:
+            t.state = ManagedThread.EXITED
+            if t.channel:
+                t.channel.close()
+                t.channel = None
+        # close-on-exec: descriptors flagged cloexec do not survive
+        for fd in [
+            f for f, o in p.fds.items() if getattr(o, "cloexec", False)
+        ]:
+            obj = p.fds.pop(fd)
+            still = any(
+                o is obj
+                for q in self.procs if q.alive()
+                for o in q.fds.values()
+            )
+            if not still:
+                self._close_obj(obj)
+        new_ch = ipc.Channel()
+        nt = ManagedThread(p, 0, new_ch)
+        nt.state = ManagedThread.RUNNING  # HELLO incoming from the spawn
+        p.threads = [nt]
+        # exec semantics: the caller's envp REPLACES the environment; the
+        # shim's own vars are forced on top so the new image is managed
+        env = dict(kv.split("=", 1) for kv in envl if "=" in kv)
+        env["LD_PRELOAD"] = str(build_mod.shim_path())
+        env[ipc.ENV_SHM] = new_ch.path
+        env.setdefault(ipc.ENV_SPIN, str(self.spin))
+        env[ipc.ENV_SECCOMP] = "1" if self.use_seccomp else "0"
+        if p.stdout_path is not None:
+            out_f = open(p.stdout_path, "ab")
+            err_f = open(p.stderr_path, "ab")
+        else:
+            out_f = err_f = subprocess.PIPE
+        p.args = argv or [full]
+        try:
+            p.popen = subprocess.Popen(
+                p.args, executable=full, env=env, cwd=p.cwd,
+                stdout=out_f, stderr=err_f,
+            )
+        except OSError as e:
+            # the old image already exited on our 0-reply; record the
+            # failure instead of crashing the whole simulation
+            log.logger.error(
+                "exec respawn of %s failed: %s", full, e, host=p.host.name
+            )
+            p.exit_code = 127
+            nt.state = ManagedThread.EXITED
+            p.exited = True
+            self._release_fds(p)
+        if p.stdout_path is not None:
+            out_f.close()
+            err_f.close()
 
     def _try_complete_waitpid(self, t: "ManagedThread") -> None:
         if (
@@ -1178,7 +1294,8 @@ class ProcessDriver:
                 return
             fd = proc.alloc_fd()
             sock = Sock(fd=fd, proto=stype, owner=proc,
-                        nonblock=bool(a[1] & SOCK_NONBLOCK))
+                        nonblock=bool(a[1] & SOCK_NONBLOCK),
+                        cloexec=bool(a[1] & 0o2000000))  # SOCK_CLOEXEC
             proc.fds[fd] = sock
             done(fd)
         elif sysno == SYS_bind:
@@ -1408,6 +1525,11 @@ class ProcessDriver:
             elif cmd == F_SETFL:
                 sock.nonblock = bool(arg & O_NONBLOCK)
                 done(0)
+            elif cmd == 1:  # F_GETFD
+                done(1 if sock.cloexec else 0)
+            elif cmd == 2:  # F_SETFD
+                sock.cloexec = bool(arg & 1)  # FD_CLOEXEC
+                done(0)
             else:
                 done(0)
         elif sysno == SYS_ioctl:
@@ -1557,8 +1679,11 @@ class ProcessDriver:
             buf = PipeBuf()
             rfd = proc.alloc_fd()
             wfd = proc.alloc_fd()
-            proc.fds[rfd] = PipeEnd(rfd, proc, buf, is_read=True, nonblock=nb)
-            proc.fds[wfd] = PipeEnd(wfd, proc, buf, is_read=False, nonblock=nb)
+            ce = bool(a[1] & 0o2000000)  # O_CLOEXEC
+            proc.fds[rfd] = PipeEnd(rfd, proc, buf, is_read=True, nonblock=nb,
+                                    cloexec=ce)
+            proc.fds[wfd] = PipeEnd(wfd, proc, buf, is_read=False,
+                                    nonblock=nb, cloexec=ce)
             done(0, data=rfd.to_bytes(4, "little") + wfd.to_bytes(4, "little"))
         elif sysno == SYS_eventfd2:
             fd = proc.alloc_fd()
@@ -1627,7 +1752,22 @@ class ProcessDriver:
             proc.proc.threads.append(t_new)
             done(0, data=ch_new.path.encode())
         elif sysno == ipc.PSYS_THREAD_EXIT:
-            if a[1]:  # process-level exit (on_exit notification)
+            if a[1] == 2:
+                # fork retraction: native fork failed after PSYS_FORK
+                # registered a child — drop the ghost record
+                ch.reply(0, sim_time_ns=self.now)
+                for q in self.procs:
+                    if q.parent is proc.proc and q.native_pid is None \
+                            and not q.exited and q.popen is None:
+                        for t in q.threads:
+                            if t.channel:
+                                t.channel.close()
+                                t.channel = None
+                            t.state = ManagedThread.EXITED
+                        q.exited = True
+                        q.wait_reported = True
+                        break
+            elif a[1]:  # process-level exit (on_exit notification)
                 p = proc.proc
                 p.exit_code = a[0]
                 # reply DIRECTLY (never via the CPU-delay deferral: the
@@ -1637,6 +1777,10 @@ class ProcessDriver:
                 for t in p.threads:
                     t.state = ManagedThread.EXITED
                 p.exited = True
+                # release the fd footprint (unbind ports, EOF peers) like
+                # _stop_process does — an exiting child must not leak its
+                # sockets for the rest of the run
+                self._release_fds(p)
                 # a parent parked in waitpid wakes NOW, at this sim time
                 if p.parent is not None:
                     for t in p.parent.threads:
@@ -1663,7 +1807,7 @@ class ProcessDriver:
             self.procs.append(child)
             done(0, data=ch_new.path.encode())
         elif sysno == ipc.PSYS_EXEC:
-            done(0)  # the fresh image re-HELLOs on the same channel
+            self._exec_respawn(proc, ch.data, a[0])
         elif sysno == ipc.PSYS_FUTEX_WAIT:
             uaddr, timeout_ns = a[0], a[1]
             proc.proc.futexes.setdefault(uaddr, []).append(proc)
@@ -2074,8 +2218,7 @@ class ProcessDriver:
         # Release this process's network footprint: unregister port bindings
         # and send EOF to stream peers (so blocked remotes wake), like the
         # reference's descriptor-table teardown on process stop.
-        for fd in list(p.fds):
-            self._close_obj(p.fds.pop(fd))
+        self._release_fds(p)
         if p.popen is None:
             # never spawned (stop scheduled before start); just mark dead
             p.state = ManagedProcess.EXITED

@@ -1,0 +1,28 @@
+/* Helper exec'd by exec_parent: proves a fork+exec'd image stays managed —
+ * its fresh shim attaches on the inherited channel, so the clock it reads
+ * is the VIRTUAL clock and its UDP datagram rides the simulated network. */
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <time.h>
+#include <unistd.h>
+
+int main(int argc, char** argv) {
+  struct timespec ts;
+  clock_gettime(CLOCK_REALTIME, &ts);
+  printf("exec_child t %lld\n",
+         (long long)ts.tv_sec * 1000000000LL + ts.tv_nsec);
+  int port = argc > 1 ? atoi(argv[1]) : 7200;
+  int s = socket(AF_INET, SOCK_DGRAM, 0);
+  struct sockaddr_in dst;
+  memset(&dst, 0, sizeof(dst));
+  dst.sin_family = AF_INET;
+  dst.sin_addr.s_addr = htonl(0x7F000001);
+  dst.sin_port = htons(port);
+  const char* msg = "hello from exec";
+  sendto(s, msg, strlen(msg), 0, (struct sockaddr*)&dst, sizeof(dst));
+  return 0;
+}

@@ -1,0 +1,51 @@
+/* fork + execve under the simulator: the parent binds a UDP port, forks,
+ * and the child execs exec_child (path passed as argv[1]), which must run
+ * MANAGED (virtual clock, simulated network) despite the inherited seccomp
+ * filter — the fd-argument BPF tests let the fresh ld.so boot, and the
+ * re-LD_PRELOADed shim re-attaches on the inherited channel. */
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <stdio.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <sys/wait.h>
+#include <time.h>
+#include <unistd.h>
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    fprintf(stderr, "usage: exec_parent <exec_child path>\n");
+    return 2;
+  }
+  int s = socket(AF_INET, SOCK_DGRAM, 0);
+  struct sockaddr_in addr;
+  memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(7200);
+  if (bind(s, (struct sockaddr*)&addr, sizeof(addr)) != 0) {
+    perror("bind");
+    return 1;
+  }
+  pid_t pid = fork();
+  if (pid == 0) {
+    char* cargv[] = {argv[1], (char*)"7200", 0};
+    execv(argv[1], cargv);
+    perror("execv");
+    _exit(127);
+  }
+  char buf[64];
+  ssize_t n = recvfrom(s, buf, sizeof(buf) - 1, 0, 0, 0);
+  if (n < 0) {
+    perror("recvfrom");
+    return 1;
+  }
+  buf[n] = 0;
+  struct timespec ts;
+  clock_gettime(CLOCK_REALTIME, &ts);
+  printf("parent got '%s' at %lld\n", buf,
+         (long long)ts.tv_sec * 1000000000LL + ts.tv_nsec);
+  waitpid(pid, 0, 0);
+  printf("parent done\n");
+  return 0;
+}

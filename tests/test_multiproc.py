@@ -92,3 +92,33 @@ def test_fork_child_talks_over_sim_network(apps):
     assert sorted(d2.procs[0].stdout.splitlines()) == sorted(
         p.stdout.splitlines()
     )
+
+
+def test_fork_exec_child_stays_managed(apps):
+    """fork + execv: the exec'd image inherits the parent's seccomp filter
+    (whose fd-argument tests let its fresh ld.so boot on low fds) and the
+    channel; its re-LD_PRELOADed shim re-attaches, so it reads the VIRTUAL
+    clock and its datagram rides the simulated loopback to the parent."""
+    d = build_process_driver(
+        _yaml(apps["exec_parent"], apps["exec_child"])
+    )
+    d.run()
+    p = d.procs[0]
+    assert p.exit_code == 0, (p.stdout, p.stderr)
+    out = p.stdout.decode()
+    assert "parent got 'hello from exec'" in out
+    assert "parent done" in out
+    # the exec'd child's clock read is the virtual clock (>= 1s start,
+    # < 2s — wall-clock epoch would be ~1.7e9 seconds). The respawned
+    # image has its own capture pipes, recorded on the fork child's
+    # process record.
+    all_out = b"\n".join(
+        getattr(q, "stdout", b"") or b"" for q in d.procs
+    ).decode()
+    for ln in all_out.splitlines():
+        if ln.startswith("exec_child t "):
+            t = int(ln.split()[-1])
+            assert 1_000_000_000 <= t < 2_000_000_000, ln
+            break
+    else:
+        raise AssertionError(f"no exec_child line in {all_out!r}")
