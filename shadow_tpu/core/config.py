@@ -150,6 +150,10 @@ class ExperimentalOptions:
     events_per_host_per_window: int = 32  # K: scan depth of the window kernel
     sockets_per_host: int = 8
     router_queue_slots: int = 64  # per-host CoDel ring capacity
+    # router vtable variant (router.c:49-57): codel | static | single
+    router_queue_variant: str = "codel"
+    # per-syscall-handler wall timing (-DUSE_PERF_TIMERS analog, setup:76-79)
+    use_perf_timers: bool = False
     devices: int = 1  # mesh size over the host axis
     inbox_slots: int = 8  # B: per-host intra-window self-event slots
     outbox_slots: int = 64  # O: per-host emission slots per window
@@ -211,6 +215,13 @@ class ExperimentalOptions:
         ):
             if name in d:
                 setattr(out, name, int(d[name]))
+        if "use_perf_timers" in d:
+            out.use_perf_timers = bool(d["use_perf_timers"])
+        if "router_queue_variant" in d:
+            v = str(d["router_queue_variant"]).lower()
+            if v not in ("codel", "static", "single"):
+                raise ConfigError(f"unknown router_queue_variant {v!r}")
+            out.router_queue_variant = v
         if "worker_threads" in d and d["worker_threads"] is not None:
             out.worker_threads = int(d["worker_threads"])
         if "interface_qdisc" in d:

@@ -148,9 +148,17 @@ def _pop_helper(router: RouterState, now, want):
     return router, have, payload, src, ok_to_drop
 
 
-def dequeue(router: RouterState, now, mask):
+def dequeue(router: RouterState, now, mask, aqm: bool = True):
     """CoDel dequeue (_routerqueuecodel_dequeue), one deliverable packet per
-    masked host. Returns (router, have, payload, src)."""
+    masked host. Returns (router, have, payload, src).
+
+    aqm=False gives the reference's non-AQM router variants
+    (router_queue_static.c / router_queue_single.c): a plain drop-tail
+    FIFO pop with no control law — "single" is this with a 1-slot ring.
+    """
+    if not aqm:
+        router, have, payload, src, _ok = _pop_helper(router, now, mask)
+        return router, have, payload, src
     router, have, payload, src, ok = _pop_helper(router, now, mask)
 
     # empty → store mode

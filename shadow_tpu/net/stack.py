@@ -51,10 +51,19 @@ class NetStack:
         with_tcp: bool = True,
         tcp_child_base: int = 0,
         qdisc: str = "fifo",
+        router_variant: str = "codel",
     ):
         if qdisc not in ("fifo", "roundrobin"):
             raise ValueError(f"unknown qdisc {qdisc!r}")
+        if router_variant not in ("codel", "static", "single"):
+            raise ValueError(f"unknown router variant {router_variant!r}")
         self.qdisc = qdisc
+        # router_queue_codel.c / _static.c / _single.c vtable analog:
+        # "static" = drop-tail FIFO without the AQM control law;
+        # "single" = the same with a one-packet ring
+        self.router_aqm = router_variant == "codel"
+        if router_variant == "single":
+            router_queue_slots = 1
         self.sockets_per_host = sockets_per_host
         self.num_hosts = num_hosts
         self._init_nic = nic.init(bw_up_bits, bw_down_bits, nic_queue_slots)
@@ -400,7 +409,9 @@ class NetStack:
             want = mask & can
 
             r = state.subs[codel.SUB]
-            r, have, payload, src = codel.dequeue(r, now, want)
+            r, have, payload, src = codel.dequeue(
+                r, now, want, aqm=self.router_aqm
+            )
             size = pkt.total_bytes(payload).astype(jnp.int64)
             n = n.replace(
                 rx_rem=jnp.where(have & ~bootstrap, n.rx_rem - size, n.rx_rem)

@@ -147,6 +147,8 @@ class DeviceNetBridge:
         K: int = 16,
         ring_slots: int | None = None,
         with_tcp: bool = False,
+        router_queue_slots: int = 64,
+        router_variant: str = "codel",
     ):
         H = len(host_vertex)
         if ring_slots is None:
@@ -162,6 +164,8 @@ class DeviceNetBridge:
             jnp.asarray(bw_up_bits),
             jnp.asarray(bw_down_bits),
             sockets_per_host=sockets_per_host,
+            router_queue_slots=router_queue_slots,
+            router_variant=router_variant,
             with_tcp=with_tcp,
             tcp_child_base=self.child_base,
         )

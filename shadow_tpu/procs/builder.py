@@ -60,6 +60,7 @@ def build_process_driver(
     driver.bootstrap_end = cfg.general.bootstrap_end_time
     driver.use_seccomp = cfg.experimental.use_seccomp
     driver.socket_send_buffer = cfg.experimental.socket_send_buffer
+    driver.use_perf_timers = cfg.experimental.use_perf_timers
     driver.cpu_ns_per_syscall = cfg.experimental.cpu_ns_per_syscall
     driver.cpu_threshold_ns = cfg.experimental.max_unapplied_cpu_latency
 
@@ -162,6 +163,8 @@ def build_process_driver(
             sockets_per_host=cfg.experimental.sockets_per_host,
             event_capacity=cfg.experimental.event_capacity,
             K=cfg.experimental.events_per_host_per_window,
+            router_queue_slots=cfg.experimental.router_queue_slots,
+            router_variant=cfg.experimental.router_queue_variant,
             with_tcp=cfg.experimental.use_device_tcp,
         )
 

@@ -231,6 +231,10 @@ class Epoll:
     fd: int
     owner: "ManagedProcess"
     interest: dict = field(default_factory=dict)  # fd -> (events, data)
+    # EPOLLET bookkeeping: fd -> the watched object's wake_seq at the last
+    # report; an edge-triggered fd re-reports only after new data/readiness
+    # arrived (every wake path bumps the object's wake_seq)
+    reported_seq: dict = field(default_factory=dict)
 
 
 @dataclass
@@ -617,6 +621,11 @@ class ProcessDriver:
         # per-syscall tallies (use_syscall_counters analog: counter.rs
         # aggregation logged at exit, syscall_handler.c:109-121)
         self.syscall_counts: dict[int, int] = {}
+        # per-handler wall-time accumulation (reference: -DUSE_PERF_TIMERS
+        # GTimers around each syscall handler, syscall_handler.c:80-83);
+        # enabled via use_perf_timers, reported at exit with the counts
+        self.use_perf_timers = False
+        self.syscall_times: dict[int, float] = {}
 
     # ------------------------------------------------------------------
     # build API
@@ -729,14 +738,30 @@ class ProcessDriver:
                 rev |= POLLERR
         return rev
 
-    def _epoll_ready(self, proc: ManagedProcess, ep: Epoll) -> list[tuple[int, int]]:
+    EPOLLET = 1 << 31
+
+    def _epoll_ready(self, proc: ManagedProcess, ep: Epoll,
+                     maxevents: int | None = None) -> list[tuple[int, int]]:
         out = []
         for fd, (events, data) in sorted(ep.interest.items()):
-            if fd not in proc.fds:
+            if maxevents is not None and len(out) >= maxevents:
+                # stop BEFORE consuming further edges: an ET fd must not be
+                # marked reported unless its event is actually delivered
+                break
+            obj = proc.fds.get(fd)
+            if obj is None:
                 continue  # closed fds silently leave the interest set
             rev = self._poll_revents(proc, fd, events)
-            if rev:
-                out.append((rev, data))
+            if not rev:
+                continue
+            if events & self.EPOLLET:
+                # edge semantics (epoll.c:162-227 edge/level): report only
+                # if new data/readiness arrived since the last report
+                seq = getattr(obj, "wake_seq", 0)
+                if ep.reported_seq.get(fd) == seq:
+                    continue
+                ep.reported_seq[fd] = seq
+            out.append((rev, data))
         return out
 
     def _futex_wake(self, p: ManagedProcess, uaddr: int, n: int) -> int:
@@ -1002,9 +1027,8 @@ class ProcessDriver:
         elif pk.kind == "epoll":
             ep = proc.fds.get(pk.epfd)
             if isinstance(ep, Epoll):
-                ready = self._epoll_ready(proc, ep)
+                ready = self._epoll_ready(proc, ep, pk.maxevents)
                 if ready:
-                    ready = ready[: pk.maxevents]
                     data = b"".join(_pack_epoll_event(ev, d) for ev, d in ready)
                     proc.parked = None
                     self._resume(proc, len(ready), data=data)
@@ -1070,10 +1094,22 @@ class ProcessDriver:
     def _wake_sock_waiters(self, sock: Sock) -> None:
         self._wake_fd_waiters(sock)
 
+    def _wake_pipe_readers(self, buf) -> None:
+        for q in self.procs:
+            if not q.alive():
+                continue
+            for o in q.fds.values():
+                if isinstance(o, PipeEnd) and o.buf is buf and o.is_read:
+                    self._wake_fd_waiters(o)
+
     def _wake_fd_waiters(self, obj) -> None:
         """Wake any thread parked on obj — fork children share open
         descriptions with their parent, so EVERY process whose fd table
         references the object must be scanned, not just the creator's."""
+        try:
+            obj.wake_seq = getattr(obj, "wake_seq", 0) + 1  # EPOLLET edges
+        except AttributeError:
+            pass  # slotted/frozen objects: stay level-triggered
         owner = getattr(obj, "owner", None)
         if owner is not None:
             self._try_wake(owner)
@@ -1249,6 +1285,20 @@ class ProcessDriver:
                     )
 
     def _dispatch(self, proc: ManagedProcess) -> None:
+        """Handle one MSG_SYSCALL from proc (with optional per-handler wall
+        timing — the USE_PERF_TIMERS analog, syscall_handler.c:80-83)."""
+        if not self.use_perf_timers:
+            return self._dispatch_inner(proc)
+        sysno = proc.channel.sysno
+        t0 = wall_time.perf_counter()
+        try:
+            return self._dispatch_inner(proc)
+        finally:
+            self.syscall_times[sysno] = self.syscall_times.get(
+                sysno, 0.0
+            ) + (wall_time.perf_counter() - t0)
+
+    def _dispatch_inner(self, proc: ManagedProcess) -> None:
         """Handle one MSG_SYSCALL from proc. Either replies (proc keeps
         running) or parks it (reply deferred until a condition fires)."""
         ch = proc.channel
@@ -1561,9 +1611,11 @@ class ProcessDriver:
             op, fd, events, data = a[1], a[2], a[3], a[4]
             if op == EPOLL_CTL_ADD or op == EPOLL_CTL_MOD:
                 ep.interest[fd] = (events, data)
+                ep.reported_seq.pop(fd, None)
                 done(0)
             elif op == EPOLL_CTL_DEL:
                 ep.interest.pop(fd, None)
+                ep.reported_seq.pop(fd, None)
                 done(0)
             else:
                 done(-errno.EINVAL)
@@ -1573,7 +1625,7 @@ class ProcessDriver:
                 done(-errno.EBADF)
                 return
             maxevents, timeout_ms = a[1], a[2]
-            ready = self._epoll_ready(proc, ep)[:maxevents]
+            ready = self._epoll_ready(proc, ep, maxevents)
             if ready:
                 data = b"".join(_pack_epoll_event(ev, d) for ev, d in ready)
                 done(len(ready), data=data)
@@ -1655,7 +1707,7 @@ class ProcessDriver:
                 else:
                     obj.buf.data += data
                     done(len(data))
-                    self._try_wake(proc)  # same-process reader may be parked
+                    self._wake_pipe_readers(obj.buf)
             elif isinstance(obj, EventFd):
                 if len(data) < 8:
                     done(-errno.EINVAL)
@@ -1670,7 +1722,7 @@ class ProcessDriver:
                     else:
                         obj.value += add
                         done(8)
-                        self._try_wake(proc)
+                        self._wake_fd_waiters(obj)
             else:
                 done(-errno.EBADF)
         # ---- pipes / eventfd / timerfd / randomness ----
@@ -2068,7 +2120,7 @@ class ProcessDriver:
         # freed send-buffer space: a writer parked (or polling POLLOUT)
         # on the peer end can proceed
         if n > 0 and end.peer.sock is not None:
-            self._try_wake(end.peer.sock.owner)
+            self._wake_fd_waiters(end.peer.sock)
         end.rx += data
         self._track_rx(
             end.local_addr[0], "tcp", end.remote_addr, end.local_addr, data
@@ -2132,7 +2184,7 @@ class ProcessDriver:
             )
         else:
             tf.next_expiry = None
-        self._try_wake(proc)
+        self._wake_fd_waiters(tf)
 
     def _close_obj(self, obj) -> None:
         if isinstance(obj, Sock):
@@ -2159,7 +2211,7 @@ class ProcessDriver:
                 obj.buf.read_closed = True
             else:
                 obj.buf.write_closed = True
-                self._try_wake(obj.owner)  # reader sees EOF
+                self._wake_pipe_readers(obj.buf)  # reader sees EOF
         elif isinstance(obj, TimerFd):
             obj.gen += 1  # cancel any scheduled fire
             obj.next_expiry = None
@@ -2398,3 +2450,25 @@ class ProcessDriver:
             log.logger.debug(
                 "syscall counts: %s", format_syscall_counts(self.syscall_counts)
             )
+        if self.use_perf_timers and self.syscall_times:
+            top = sorted(
+                self.syscall_times.items(), key=lambda kv: -kv[1]
+            )[:12]
+            log.logger.info(
+                "perf timers (handler wall seconds): %s",
+                ", ".join(f"{k}={v:.4f}" for k, v in top),
+            )
+        # leak-style check (reference: alloc/dealloc counter mismatch
+        # warning, manager.c:276-292): device TCP slots still held after
+        # every process's fds are released indicate a recycling leak —
+        # release force-stopped processes' fds first so normal still-open
+        # connections at stop_time don't read as leaks
+        for p in self.procs:
+            self._release_fds(p)
+        if self.bridge is not None:
+            held = sum(1 for e in self._dev_tcp.values() if not e.recycled)
+            if held:
+                log.logger.warning(
+                    "leak check: %d device TCP slot(s) still held at "
+                    "shutdown (connections neither closed nor reset)", held,
+                )

@@ -146,6 +146,7 @@ def build_simulation(source) -> Simulation:
             jnp.asarray(bw_down),
             sockets_per_host=cfg.experimental.sockets_per_host,
             router_queue_slots=cfg.experimental.router_queue_slots,
+            router_variant=cfg.experimental.router_queue_variant,
             with_tcp=(name == "tcp_bulk"),
             qdisc=cfg.experimental.interface_qdisc,
         )

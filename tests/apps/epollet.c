@@ -1,0 +1,78 @@
+/* Edge-triggered epoll semantics (reference: epoll.c:162-227 edge/level):
+ * arm EPOLLIN|EPOLLET on a UDP socket, let TWO datagrams arrive while NOT
+ * draining between waits. Level-triggered would report readiness again on
+ * the second wait without new data; edge-triggered must NOT — and must
+ * report again after a THIRD datagram (a fresh edge).
+ * Usage: epollet <port>   (peer sends 2 datagrams, pause, then 1 more) */
+#define _GNU_SOURCE
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/epoll.h>
+#include <sys/socket.h>
+#include <fcntl.h>
+#include <time.h>
+#include <unistd.h>
+
+static int send_mode(const char* ip, int port) {
+  int s = socket(AF_INET, SOCK_DGRAM, 0);
+  struct sockaddr_in dst;
+  memset(&dst, 0, sizeof(dst));
+  dst.sin_family = AF_INET;
+  inet_pton(AF_INET, ip, &dst.sin_addr);
+  dst.sin_port = htons(port);
+  sendto(s, "a", 1, 0, (struct sockaddr*)&dst, sizeof(dst));
+  struct timespec d = {0, 200000000};
+  nanosleep(&d, 0);  // let wait1 report the first edge
+  sendto(s, "b", 1, 0, (struct sockaddr*)&dst, sizeof(dst));
+  struct timespec d2 = {2, 0};
+  nanosleep(&d2, 0);
+  sendto(s, "c", 1, 0, (struct sockaddr*)&dst, sizeof(dst));
+  return 0;
+}
+
+int main(int argc, char** argv) {
+  setvbuf(stdout, 0, _IOLBF, 0);
+  if (argc >= 4 && strcmp(argv[1], "--send") == 0)
+    return send_mode(argv[2], atoi(argv[3]));
+  int port = argc > 1 ? atoi(argv[1]) : 7300;
+  int s = socket(AF_INET, SOCK_DGRAM, 0);
+  struct sockaddr_in addr;
+  memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(port);
+  if (bind(s, (struct sockaddr*)&addr, sizeof(addr)) != 0) {
+    perror("bind");
+    return 1;
+  }
+  int ep = epoll_create1(0);
+  struct epoll_event ev;
+  ev.events = EPOLLIN | EPOLLET;
+  ev.data.fd = s;
+  epoll_ctl(ep, EPOLL_CTL_ADD, s, &ev);
+
+  // wait #1: first datagram arrives -> edge, reported
+  int n1 = epoll_wait(ep, &ev, 4, 5000);
+  printf("wait1 %d\n", n1);
+  // do NOT drain; wait #2 with a short timeout: a second datagram arrived
+  // by now, which IS a new edge -> reported once
+  int n2 = epoll_wait(ep, &ev, 4, 1000);
+  printf("wait2 %d\n", n2);
+  // wait #3 without new data since wait2's report: must time out (0)
+  int n3 = epoll_wait(ep, &ev, 4, 300);
+  printf("wait3 %d\n", n3);
+  // drain both datagrams (nonblocking via fcntl)
+  fcntl(s, F_SETFL, O_NONBLOCK);
+  char buf[512];
+  while (recv(s, buf, sizeof(buf), 0) > 0) {
+  }
+  fcntl(s, F_SETFL, 0);
+  // wait #4: the peer's third datagram (sent after a 2s pause) is a fresh
+  // edge -> reported
+  int n4 = epoll_wait(ep, &ev, 4, 5000);
+  printf("wait4 %d\n", n4);
+  return 0;
+}

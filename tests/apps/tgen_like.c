@@ -1,0 +1,217 @@
+/* tgen-like multi-stream transfer workload (reference analog: the tor
+ * integration test's tgen client/server pairs, src/test/tor/minimal:
+ * verify.sh greps for "stream-success" counts). Runs as a real managed
+ * process over the simulated network (device TCP when use_device_tcp).
+ *
+ * server: tgen_like --server <port> <nstreams>
+ *   accepts nstreams connections; per connection reads "SEND <n>\n" and
+ *   writes n bytes back, then closes; prints "stream-served <n>".
+ * client: tgen_like <server-base> <server-count> <port> <streams> <bytes>
+ *   picks a server deterministically from its own (simulated) hostname,
+ *   then runs <streams> sequential downloads of <bytes> each; prints
+ *   "stream-success <i> <bytes> at <virtual ns>" per completed stream and
+ *   "transfers-complete <streams>" at the end. */
+#define _GNU_SOURCE
+#include <arpa/inet.h>
+#include <fcntl.h>
+#include <netdb.h>
+#include <sys/epoll.h>
+#include <netinet/in.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <time.h>
+#include <unistd.h>
+
+static long long now_ns(void) {
+  struct timespec ts;
+  clock_gettime(CLOCK_REALTIME, &ts);
+  return (long long)ts.tv_sec * 1000000000LL + ts.tv_nsec;
+}
+
+static int read_n(int fd, char* buf, long long n) {
+  long long got = 0;
+  while (got < n) {
+    ssize_t r = recv(fd, buf, (size_t)((n - got) > 4096 ? 4096 : n - got), 0);
+    if (r <= 0) return -1;
+    got += r;
+  }
+  return 0;
+}
+
+/* Event-driven concurrent server (tgen/tor are libevent-style: many
+ * simultaneous streams multiplex over one epoll loop). */
+#define MAXCONN 256
+
+struct conn {
+  int fd;
+  int phase;  /* 0 = reading request, 1 = sending */
+  int roff;
+  char req[64];
+  long long want, sent;
+};
+
+static int run_server(int port, int nstreams) {
+  int ls = socket(AF_INET, SOCK_STREAM, 0);
+  struct sockaddr_in addr;
+  memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(port);
+  if (bind(ls, (struct sockaddr*)&addr, sizeof(addr)) != 0) {
+    perror("bind");
+    return 1;
+  }
+  if (listen(ls, 128) != 0) {
+    perror("listen");
+    return 1;
+  }
+  fcntl(ls, F_SETFL, O_NONBLOCK);
+  int ep = epoll_create1(0);
+  struct epoll_event ev;
+  ev.events = EPOLLIN;
+  ev.data.u64 = 0;  /* listener */
+  epoll_ctl(ep, EPOLL_CTL_ADD, ls, &ev);
+
+  static struct conn conns[MAXCONN];
+  char buf[4096];
+  memset(buf, 'd', sizeof(buf));
+  int served = 0;
+  struct epoll_event evs[32];
+  while (nstreams <= 0 || served < nstreams) {
+    int n = epoll_wait(ep, evs, 32, 30000);
+    if (n <= 0) break;
+    for (int e = 0; e < n; e++) {
+      if (evs[e].data.u64 == 0) {
+        for (;;) {
+          int c = accept(ls, 0, 0);
+          if (c < 0) break;
+          fcntl(c, F_SETFL, O_NONBLOCK);
+          int slot = -1;
+          for (int j = 0; j < MAXCONN; j++)
+            if (conns[j].fd == 0) {
+              slot = j;
+              break;
+            }
+          if (slot < 0) {
+            close(c);
+            continue;
+          }
+          memset(&conns[slot], 0, sizeof(struct conn));
+          conns[slot].fd = c;
+          struct epoll_event cev;
+          cev.events = EPOLLIN;
+          cev.data.u64 = (unsigned)slot + 1;
+          epoll_ctl(ep, EPOLL_CTL_ADD, c, &cev);
+        }
+        continue;
+      }
+      struct conn* cn = &conns[evs[e].data.u64 - 1];
+      if (cn->fd == 0) continue;
+      if (cn->phase == 0) {
+        for (;;) {
+          ssize_t r = recv(cn->fd, cn->req + cn->roff, 1, 0);
+          if (r <= 0) break;
+          if (cn->req[cn->roff] == '\n' ||
+              cn->roff >= (int)sizeof(cn->req) - 2) {
+            cn->req[cn->roff] = 0;
+            sscanf(cn->req, "SEND %lld", &cn->want);
+            cn->phase = 1;
+            struct epoll_event cev;
+            cev.events = EPOLLOUT;
+            cev.data.u64 = evs[e].data.u64;
+            epoll_ctl(ep, EPOLL_CTL_MOD, cn->fd, &cev);
+            break;
+          }
+          cn->roff++;
+        }
+      }
+      if (cn->phase == 1 && (evs[e].events & EPOLLOUT)) {
+        while (cn->sent < cn->want) {
+          size_t chunk = (size_t)((cn->want - cn->sent) >
+                                          (long long)sizeof(buf)
+                                      ? (long long)sizeof(buf)
+                                      : cn->want - cn->sent);
+          ssize_t r = send(cn->fd, buf, chunk, 0);
+          if (r <= 0) break;  /* EAGAIN: wait for the next EPOLLOUT */
+          cn->sent += r;
+        }
+        if (cn->sent >= cn->want) {
+          epoll_ctl(ep, EPOLL_CTL_DEL, cn->fd, 0);
+          close(cn->fd);
+          printf("stream-served %lld\n", cn->sent);
+          cn->fd = 0;
+          served++;
+        }
+      }
+    }
+  }
+  printf("server-done %d\n", served);
+  return 0;
+}
+
+int main(int argc, char** argv) {
+  // line-buffer stdout even when piped: a sim-stop ends us via _exit,
+  // which would discard block-buffered progress lines
+  setvbuf(stdout, 0, _IOLBF, 0);
+  if (argc >= 2 && strcmp(argv[1], "--server") == 0) {
+    return run_server(argc > 2 ? atoi(argv[2]) : 9100,
+                      argc > 3 ? atoi(argv[3]) : 1);
+  }
+  if (argc < 6) {
+    fprintf(stderr,
+            "usage: tgen_like <srv-base> <srv-count> <port> <streams> "
+            "<bytes>\n");
+    return 2;
+  }
+  const char* base = argv[1];
+  int nsrv = atoi(argv[2]);
+  const char* port = argv[3];
+  int streams = atoi(argv[4]);
+  long long nbytes = atoll(argv[5]);
+
+  // deterministic server choice from the SIMULATED hostname
+  char hn[128] = {0};
+  gethostname(hn, sizeof(hn) - 1);
+  unsigned h = 2166136261u;
+  for (char* p = hn; *p; p++) h = (h ^ (unsigned char)*p) * 16777619u;
+  char srv[160];
+  snprintf(srv, sizeof(srv), "%s%u", base, 1 + h % (unsigned)nsrv);
+
+  struct addrinfo hints, *res = 0;
+  memset(&hints, 0, sizeof(hints));
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  if (getaddrinfo(srv, port, &hints, &res) != 0 || !res) {
+    fprintf(stderr, "resolve %s failed\n", srv);
+    return 1;
+  }
+  char* buf = malloc(65536);
+  int ok = 0;
+  for (int i = 0; i < streams; i++) {
+    int fd = socket(AF_INET, SOCK_STREAM, 0);
+    if (connect(fd, res->ai_addr, res->ai_addrlen) != 0) {
+      perror("connect");
+      close(fd);
+      continue;
+    }
+    char req[64];
+    int n = snprintf(req, sizeof(req), "SEND %lld\n", nbytes);
+    if (send(fd, req, n, 0) != n) {
+      close(fd);
+      continue;
+    }
+    if (read_n(fd, buf, nbytes) == 0) {
+      printf("stream-success %d %lld at %lld\n", i, nbytes, now_ns());
+      ok++;
+    } else {
+      printf("stream-error %d\n", i);
+    }
+    close(fd);
+  }
+  printf("transfers-complete %d\n", ok);
+  free(buf);
+  freeaddrinfo(res);
+  return ok == streams ? 0 : 1;
+}

@@ -356,3 +356,40 @@ hosts:
     rr = run("roundrobin")
     assert rr["packets_delivered"] == fifo["packets_delivered"] > 0
     assert rr["bytes_delivered"] == fifo["bytes_delivered"]
+
+
+import pytest as _pytest
+
+
+@_pytest.mark.parametrize("variant", ["static", "single"])
+def test_router_queue_variants(variant):
+    """The non-AQM router variants (router_queue_static.c /
+    router_queue_single.c analogs): a drop-tail FIFO (1-slot ring for
+    "single") still delivers traffic end to end; CoDel's control law is
+    bypassed."""
+    from shadow_tpu.sim import build_simulation
+
+    sim = build_simulation({
+        "general": {"stop_time": 3, "seed": 9},
+        "network": {"graph": {"type": "gml", "inline": (
+            'graph [\n'
+            '  node [ id 0 bandwidth_down "10 Mbit" bandwidth_up "10 Mbit" ]\n'
+            '  edge [ source 0 target 0 latency "10 ms" ]\n]\n')}},
+        "experimental": {
+            "event_capacity": 2048,
+            "events_per_host_per_window": 8,
+            "router_queue_variant": variant,
+            "router_queue_slots": 8,
+        },
+        "hosts": {
+            "server": {"quantity": 1, "app_model": "udp_flood",
+                       "app_options": {"role": "server"}},
+            "client": {"quantity": 7, "app_model": "udp_flood",
+                       "app_options": {"interval": "50 ms", "size": 512,
+                                       "runtime": 2}},
+        },
+    })
+    sim.run_stepwise()
+    c = sim.counters()
+    assert c["packets_delivered"] > 100
+    assert c["pool_overflow_dropped"] == 0

@@ -13,6 +13,7 @@ import subprocess
 import pytest
 
 from shadow_tpu.procs import build as build_mod
+from shadow_tpu.procs.builder import build_process_driver
 from shadow_tpu.procs.driver import NS_PER_SEC, ProcessDriver
 
 pytestmark = pytest.mark.skipif(
@@ -179,3 +180,40 @@ def test_cpu_model_delays_virtual_clock(apps):
     # CPU cost inflates the observed RTT beyond pure network latency
     assert all(r > 2 * 10_000_000 for r in loaded), loaded
     assert loaded == run(500_000)  # deterministic
+
+
+def test_epoll_edge_triggered(apps):
+    """EPOLLET semantics (reference: epoll.c edge/level): readiness is
+    reported once per new-data edge — a wait with no new arrivals since
+    the last report times out even though the buffer is non-empty."""
+    d = build_process_driver(f"""
+general:
+  stop_time: 20 s
+  seed: 4
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        node [ id 0 bandwidth_down "100 Mbit" bandwidth_up "100 Mbit" ]
+        edge [ source 0 target 0 latency "10 ms" ]
+      ]
+hosts:
+  rx:
+    ip_address_hint: 11.0.0.1
+    processes:
+      - path: {apps['epollet']}
+        args: "7300"
+  tx:
+    processes:
+      - path: {apps['epollet']}
+        args: --send 11.0.0.1 7300
+        start_time: 1 s
+""")
+    d.run()
+    rx = next(p for p in d.procs if "--send" not in p.args)
+    assert rx.exit_code == 0, (rx.stdout, rx.stderr)
+    lines = rx.stdout.decode().splitlines()
+    # edge on first datagram; edge on second; NO report without new data;
+    # fresh edge after drain + third datagram
+    assert lines == ["wait1 1", "wait2 1", "wait3 0", "wait4 1"], lines

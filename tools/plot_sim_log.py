@@ -1,0 +1,83 @@
+#!/usr/bin/env python3
+"""Plot simulator progress and per-host traffic from a parsed log
+(reference analog: src/tools/plot-shadow.py, the companion to
+parse-shadow.py).
+
+Input: the JSON emitted by tools/parse_sim_log.py. Output: a PNG with
+(1) simulated-time progress vs wall time (the headline PDES speed curve)
+and (2) per-host rx/tx byte series from tracker heartbeats.
+
+Usage:
+    python -m shadow_tpu cfg.yaml 2>&1 | python tools/parse_sim_log.py \
+        > sim.json
+    python tools/plot_sim_log.py sim.json -o sim.png
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("json", nargs="?", default="-")
+    ap.add_argument("-o", "--output", default="sim.png")
+    args = ap.parse_args()
+
+    data = json.load(
+        sys.stdin if args.json == "-" else open(args.json)
+    )
+
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    hb = data.get("heartbeats", [])
+    trackers = data.get("trackers", {})
+
+    n_plots = (1 if hb else 0) + (1 if trackers else 0)
+    if n_plots == 0:
+        print("nothing to plot (no heartbeats/trackers in input)")
+        return 1
+    fig, axes = plt.subplots(n_plots, 1, figsize=(8, 4 * n_plots))
+    if n_plots == 1:
+        axes = [axes]
+    ax_i = 0
+
+    if hb:
+        ax = axes[ax_i]
+        ax_i += 1
+        sim_s = [h["sim_s"] for h in hb]
+        xs = list(range(len(sim_s)))
+        ax.plot(xs, sim_s, marker="o", ms=3)
+        ax.set_xlabel("heartbeat #")
+        ax.set_ylabel("simulated seconds")
+        ax.set_title("simulation progress")
+        ax.grid(True, alpha=0.3)
+
+    if trackers:
+        ax = axes[ax_i]
+        for host, series in sorted(trackers.items()):
+            xs = [p.get("sim_s", i) for i, p in enumerate(series)]
+            rx = [p.get("rx_bytes", 0) for p in series]
+            tx = [p.get("tx_bytes", 0) for p in series]
+            ax.plot(xs, rx, label=f"{host} rx")
+            ax.plot(xs, tx, label=f"{host} tx", linestyle="--")
+        ax.set_xlabel("simulated seconds")
+        ax.set_ylabel("bytes")
+        ax.set_title("per-host traffic (tracker heartbeats)")
+        if len(trackers) <= 12:
+            ax.legend(fontsize=7)
+        ax.grid(True, alpha=0.3)
+
+    fig.tight_layout()
+    fig.savefig(args.output, dpi=120)
+    print(f"wrote {args.output}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
