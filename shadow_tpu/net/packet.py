@@ -27,6 +27,11 @@ W_WND = 8  # TCP advertised window
 W_SRC_HOST = 9  # global host index of the original sender
 W_SOCKET = 10  # sender-side socket slot (for completions)
 W_HANDLE = 11  # CPU-side payload buffer handle (managed processes)
+# Pure TCP ACKs (len 0, no SYN/FIN) carry a 32-chunk SACK bitmap in the
+# handle word (unused there): bit k = receiver holds chunk
+# [rcv_nxt + k*MSS, +(k+1)*MSS). The bounded form of the reference's SACK
+# ranges (tcp.h:145,171 + tcp_retransmit_tally.cc interval lists).
+W_SACK = W_HANDLE
 
 PROTO_UDP = 17
 PROTO_TCP = 6

@@ -39,6 +39,7 @@ sequence ranges.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 from flax import struct
 
@@ -117,6 +118,11 @@ class TcpState:
     ssthresh: jnp.ndarray  # [H,S] i32 bytes
     dup_acks: jnp.ndarray  # [H,S] i32
     fast_recovery: jnp.ndarray  # [H,S] bool
+    # sender-side SACK scoreboard (tcp_retransmit_tally.cc bounded form):
+    # bit k of sack_bits = peer holds [snd_una + k*MSS, ...); rtx_high =
+    # highest seq already retransmitted this recovery episode
+    sack_bits: jnp.ndarray  # [H,S] i32 (u32 bitmap)
+    rtx_high: jnp.ndarray  # [H,S] i32
     recover: jnp.ndarray  # [H,S] i32 snd_max at FR entry (NewReno)
     # RTT estimation (RFC 6298; tcp.c:205-208)
     srtt: jnp.ndarray  # [H,S] i64 ns (0 = no sample yet)
@@ -157,6 +163,7 @@ def init(num_hosts: int, sockets_per_host: int = 8,
         fin_rcvd_seq=i32(), fin_rcvd=b(),
         cwnd=i32(INIT_CWND_SEGS * MSS), ssthresh=i32(INIT_SSTHRESH),
         dup_acks=i32(), fast_recovery=b(), recover=i32(),
+        sack_bits=i32(), rtx_high=i32(),
         srtt=i64(), rttvar=i64(), rto=i64(RTO_INIT_NS),
         rtt_armed=b(), rtt_seq=i32(), rtt_start=i64(),
         rtx_armed=b(), rtx_expire=i64(simtime.NEVER), gen=i32(),
@@ -244,7 +251,7 @@ def demux(tcp: TcpState, mask, payload, src_host):
 
 
 def make_segment(src_port, dst_port, length, flags, seq, ack, wnd, src_host,
-                 socket_slot):
+                 socket_slot, sack=None):
     H = src_port.shape[0]
     pl = jnp.zeros((H, PAYLOAD_WORDS), dtype=jnp.int32)
     pl = pl.at[:, pkt.W_PROTO].set(pkt.PROTO_TCP)
@@ -257,6 +264,8 @@ def make_segment(src_port, dst_port, length, flags, seq, ack, wnd, src_host,
     pl = pl.at[:, pkt.W_WND].set(wnd.astype(jnp.int32))
     pl = pl.at[:, pkt.W_SRC_HOST].set(src_host.astype(jnp.int32))
     pl = pl.at[:, pkt.W_SOCKET].set(socket_slot.astype(jnp.int32))
+    if sack is not None:
+        pl = pl.at[:, pkt.W_SACK].set(sack.astype(jnp.int32))
     return pl
 
 
@@ -297,6 +306,29 @@ def _popcount(x):
     x = (x & jnp.uint32(0x33333333)) + ((x >> 2) & jnp.uint32(0x33333333))
     x = (x + (x >> 4)) & jnp.uint32(0x0F0F0F0F)
     return ((x * jnp.uint32(0x01010101)) >> 24).astype(jnp.uint32)
+
+
+def _bit_length(x):
+    """Position of the highest set bit + 1 of uint32 x (0 for x == 0)."""
+    x = x.astype(jnp.uint32)
+    n = jnp.zeros_like(x)
+    for sh in (16, 8, 4, 2, 1):
+        gt = x >= (jnp.uint32(1) << sh)
+        n = n + jnp.where(gt, jnp.uint32(sh), jnp.uint32(0))
+        x = jnp.where(gt, x >> sh, x)
+    return (n + (x > 0)).astype(jnp.int32)
+
+
+def _pack_sack(om):
+    """Pack the first 32 reorder-board chunks into a u32 bitmap (int32
+    bit pattern) — the wire form riding pure ACKs (pkt.W_SACK)."""
+    n = min(32, om.shape[1])
+    weights = jnp.uint32(1) << jnp.arange(n, dtype=jnp.uint32)
+    u = jnp.sum(
+        om[:, :n].astype(jnp.uint32) * weights[None, :], axis=1,
+        dtype=jnp.uint32,
+    )
+    return jax.lax.bitcast_convert_type(u, jnp.int32)
 
 
 def _trailing_ones(x):
@@ -424,7 +456,7 @@ class Tcp:
 
     def _tx_segment(self, state, emitter, mask, now, dst_host, *, slot,
                     length, flags, seq, ack, dst_port=None, src_port=None,
-                    params=None):
+                    params=None, sack=None):
         """Assemble + hand a segment to the NIC (stack transmit path);
         with ``params`` the stack's uncontended fast path applies."""
         t = state.subs[SUB]
@@ -438,7 +470,7 @@ class Tcp:
                                    (self.num_hosts,)),
             seq=seq, ack=ack,
             wnd=jnp.full((self.num_hosts,), RECV_WND, jnp.int32),
-            src_host=self._hosts(), socket_slot=slot,
+            src_host=self._hosts(), socket_slot=slot, sack=sack,
         )
         state, _ok = self.stack._tx(
             state, emitter, mask, now, dst_host, seg, params=params
@@ -487,6 +519,8 @@ class Tcp:
                         jnp.full((H,), INIT_SSTHRESH, jnp.int32)),
             dup_acks=_s(t.dup_acks, m, slot, z32),
             fast_recovery=_s(t.fast_recovery, m, slot, fb),
+            sack_bits=_s(t.sack_bits, m, slot, z32),
+            rtx_high=_s(t.rtx_high, m, slot, z32),
             srtt=_s(t.srtt, m, slot, jnp.zeros((H,), jnp.int64)),
             rttvar=_s(t.rttvar, m, slot, jnp.zeros((H,), jnp.int64)),
             rto=_s(t.rto, m, slot, jnp.full((H,), RTO_INIT_NS, jnp.int64)),
@@ -643,6 +677,8 @@ class Tcp:
                         jnp.full((H,), INIT_SSTHRESH, jnp.int32)),
             dup_acks=_s(t.dup_acks, mc, child, z32),
             fast_recovery=_s(t.fast_recovery, mc, child, fb),
+            sack_bits=_s(t.sack_bits, mc, child, z32),
+            rtx_high=_s(t.rtx_high, mc, child, z32),
             srtt=_s(t.srtt, mc, child, z64),
             rttvar=_s(t.rttvar, mc, child, z64),
             rto=_s(t.rto, mc, child, jnp.full((H,), RTO_INIT_NS, jnp.int64)),
@@ -827,21 +863,83 @@ class Tcp:
         m_tw_enter = fin_acked & (st_now == CLOSING)
         m_free = fin_acked & (st_now == LAST_ACK)
 
-        # fast/partial retransmit of the segment at (new) snd_una
-        do_rtx = trigger_fr | partial_ack
+        # ---- sender SACK scoreboard update (bounded tally) ----
+        # Pure ACKs carry the receiver's reorder board relative to seg_ack;
+        # after the snd_una update above, seg_ack == snd_una for every ack
+        # that can drive recovery, so the incoming bitmap is authoritative.
+        # Data-carrying acks just shift the old board by the acked chunks.
+        pure_ack = m_ack & (seg_len == 0) & ~has_syn & ~has_fin
+        sb0 = jax.lax.bitcast_convert_type(_g(t.sack_bits, slot), jnp.uint32)
+        nch = acked_bytes // MSS
+        acked_ch = jnp.clip(nch, 0, 31).astype(jnp.uint32)
+        # a jump of >= 32 chunks clears the board entirely (a clipped
+        # shift would leave old bit 31 aliased onto the new hole)
+        sb_shift = jnp.where(
+            new_acked,
+            jnp.where(nch >= 32, jnp.uint32(0), sb0 >> acked_ch),
+            sb0,
+        )
+        sb_in = jax.lax.bitcast_convert_type(
+            payload[:, pkt.W_SACK], jnp.uint32
+        )
+        sb1 = jnp.where(pure_ack & acceptable, sb_in, sb_shift)
+        t = t.replace(
+            sack_bits=_s(
+                t.sack_bits, m_ack, slot,
+                jax.lax.bitcast_convert_type(sb1, jnp.int32),
+            )
+        )
+
+        # ---- fast/partial/SACK retransmission ----
+        # NewReno: entering recovery or a partial ack retransmits the first
+        # missing chunk. With SACK info, every further dup-ack retransmits
+        # the NEXT unsacked chunk below the highest sacked one — multiple
+        # holes repaired per RTT instead of one (tcp_retransmit_tally.cc's
+        # mark_lost/retransmit walk, in bounded-bitmap form).
         una2 = _g(t.snd_una, slot)
+        rtx_high0 = _g(t.rtx_high, slot)
+        rtx_high_eff = jnp.where(trigger_fr, una2, rtx_high0)
+        done_ch = jnp.clip(
+            (rtx_high_eff - una2).astype(jnp.int32) // MSS, 0, 32
+        ).astype(jnp.uint32)
+        done_mask = jnp.where(
+            done_ch >= 32, jnp.uint32(0xFFFFFFFF),
+            (jnp.uint32(1) << done_ch) - jnp.uint32(1),
+        )
+        v = sb1 | done_mask
+        f = _trailing_ones(v)  # first unsacked chunk at/after rtx_high
+        blen = _bit_length(sb1)
+        have_sack = sb1 != 0
+        newreno_rtx = trigger_fr | partial_ack
+        # a hole is VISIBLE only below the highest sacked chunk; without
+        # that, retransmitting would duplicate the in-flight frontier.
+        # f == 0 with rtx_high at/below una is the classic una-hole case
+        # (covers empty bitmaps: a dup/partial ack implies the hole).
+        hole_visible = have_sack & (f < blen) & (f < 32)
+        una_hole = (f == 0)
+        sack_rtx = inflate & hole_visible
+        do_rtx = (newreno_rtx & (hole_visible | una_hole)) | sack_rtx
+        f_eff = jnp.where(hole_visible, jnp.minimum(f, 31), 0)
+        rtx_seq = una2 + f_eff * MSS
         buf = _g(t.snd_buf_end, slot)
-        rtx_len = jnp.minimum(MSS, (buf - una2).astype(jnp.int32))
+        rtx_len = jnp.minimum(MSS, (buf - rtx_seq).astype(jnp.int32))
         data_rtx = do_rtx & (rtx_len > 0)
-        fin_rtx = do_rtx & (rtx_len <= 0) & fin_sent_g
+        fin_rtx = newreno_rtx & (
+            jnp.minimum(MSS, (buf - una2).astype(jnp.int32)) <= 0
+        ) & fin_sent_g
         t = t.replace(
             rtt_armed=_s(t.rtt_armed, do_rtx, slot, fb),  # Karn
-            retransmits=t.retransmits + jnp.sum(do_rtx, dtype=jnp.int64),
+            rtx_high=_s(
+                t.rtx_high, m_ack, slot,
+                jnp.where(data_rtx, rtx_seq + rtx_len, rtx_high_eff),
+            ),
+            retransmits=t.retransmits + jnp.sum(data_rtx | fin_rtx,
+                                                dtype=jnp.int64),
         )
         state = state.with_sub(SUB, t)
         state = self._tx_segment(
             state, emitter, data_rtx, now64, src, slot=slot,
-            length=rtx_len, flags=ACK, seq=una2,
+            length=rtx_len, flags=ACK, seq=rtx_seq,
             ack=_g(state.subs[SUB].rcv_nxt, slot),
             params=params,
         )
@@ -978,10 +1076,16 @@ class Tcp:
         reply_flags = jnp.where(resyn, jnp.int32(SYN | ACK), jnp.int32(ACK))
         reply_seq = jnp.where(resyn, z32, _g(t.snd_nxt, slot))
         state = state.with_sub(SUB, t)
+        # pure ACKs advertise the reorder board as a bounded SACK bitmap
+        # (relative to rcv_nxt, whose chunk 0 is the missing hole)
         state = self._tx_segment(
             state, emitter, need_ack, now64, src, slot=slot, length=0,
             flags=reply_flags, seq=reply_seq,
             ack=_g(state.subs[SUB].rcv_nxt, slot),
+            sack=jnp.where(
+                resyn | resynack, z32,
+                _pack_sack(_g(state.subs[SUB].ooo_map, slot)),
+            ),
             params=params,
         )
 
@@ -1138,6 +1242,8 @@ class Tcp:
             rto=_s(t.rto, fire, slot, rto2),
             rtx_expire=_s(t.rtx_expire, fire, slot, now64 + rto2),
             snd_nxt=_s(t.snd_nxt, fire & ~hs, slot, una),
+            sack_bits=_s(t.sack_bits, fire, slot, z32),
+            rtx_high=_s(t.rtx_high, fire, slot, z32),
             fin_sent=_s(t.fin_sent, fin_rewind, slot, fb),
             timeouts=t.timeouts + jnp.sum(fire, dtype=jnp.int64),
             retransmits=t.retransmits + jnp.sum(fire, dtype=jnp.int64),

@@ -47,6 +47,9 @@ def shard_state(state, mesh: Mesh):
 
     def row(x):
         x = jax.numpy.asarray(x)
+        if x.ndim == 0:
+            # sub-state scalars (e.g. the TCP machine's counters) replicate
+            return jax.device_put(x, repl)
         return jax.device_put(x, _row_sharding(mesh, x.ndim))
 
     pool = jax.tree.map(row, state.pool)
