@@ -174,8 +174,11 @@ def stage_tcp_bulk(num_hosts: int = 10240, stop_s: int = 4):
         # pump/timer events): 1 << 16 measurably overflows, 1 << 18 does not
         num_hosts=num_hosts, stop_s=stop_s, event_capacity=1 << 18,
         # TCP self-events (timers + pumps) need more inbox headroom than
-        # the UDP stage
-        extra_experimental={"inbox_slots": 8},
+        # the UDP stage; the TCP handler suite's worst-case emission count
+        # per event is 28 (engine probe), so the outbox must cover it —
+        # O=16 fails the build-time probe (this is what blocked the r2
+        # stage-3 recording)
+        extra_experimental={"inbox_slots": 8, "outbox_slots": 32},
     )
 
 

@@ -77,9 +77,17 @@ struct Channel {
   int64_t args[6];       // 88
   int64_t ret;           // 136
   int64_t sim_time_ns;   // 144  driver stamps sim clock on every response
-  int32_t data_len;      // 152
-  int32_t pad1;          // 156
-  uint8_t data[IPC_DATA_MAX];  // 160
+  // Signal delivery plane (reference analog: syscall/signal.c emulation +
+  // process_continue signal checks): the driver piggybacks at most one
+  // pending virtual signal on each reply; the shim invokes the app's
+  // registered handler (address recorded via the interposed sigaction)
+  // before returning from the syscall wrapper.
+  int32_t sig_no;        // 152  0 = none
+  int32_t sig_flags;     // 156  bit 0: SA_SIGINFO-style 3-arg handler
+  uint64_t sig_handler;  // 160  app handler address (in its own space)
+  int32_t data_len;      // 168
+  int32_t pad1;          // 172
+  uint8_t data[IPC_DATA_MAX];  // 176
 };
 #pragma pack(pop)
 
@@ -90,8 +98,10 @@ static_assert(offsetof(Channel, sysno) == 80, "layout pinned for ctypes");
 static_assert(offsetof(Channel, args) == 88, "layout pinned for ctypes");
 static_assert(offsetof(Channel, ret) == 136, "layout pinned for ctypes");
 static_assert(offsetof(Channel, sim_time_ns) == 144, "layout pinned");
-static_assert(offsetof(Channel, data_len) == 152, "layout pinned");
-static_assert(offsetof(Channel, data) == 160, "layout pinned for ctypes");
+static_assert(offsetof(Channel, sig_no) == 152, "layout pinned");
+static_assert(offsetof(Channel, sig_handler) == 160, "layout pinned");
+static_assert(offsetof(Channel, data_len) == 168, "layout pinned");
+static_assert(offsetof(Channel, data) == 176, "layout pinned for ctypes");
 
 // Bounded spin before parking on the semaphore: the driver usually replies
 // within a few microseconds; spinning avoids a futex round trip

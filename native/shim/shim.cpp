@@ -15,10 +15,15 @@
 //     everything issued from the shim's own gate function, so shim-internal
 //     native calls never pay the signal round trip. Disable with
 //     SHADOW_TPU_SECCOMP=0.
-//     KNOWN LIMIT: a child exec'd by a managed process inherits the filter
-//     but not the SIGSYS handler, so it dies at its first trapped syscall
-//     (during ld.so startup) — loud failure rather than silent sim escape.
-//     Proper fork/exec support arrives with driver-side clone handling.
+//     exec is handled as DRIVER RESPAWN: execve relays PSYS_EXEC and the
+//     driver re-spawns the process image on a fresh channel with virtual
+//     identity preserved (fds >= FD_BASE, host, pid) — the exec'd image
+//     loads its own shim copy, so the filter + handler are re-installed
+//     cleanly. fork relays PSYS_FORK onto a pre-created child channel.
+//     KNOWN LIMIT: statically-linked binaries never load the shim at all
+//     (no LD_PRELOAD), so nothing installs the filter — they run
+//     UNSIMULATED. The reference covers them with ptrace
+//     (thread_ptrace.c); this plane does not.
 //     KNOWN LIMIT: vDSO-backed calls (clock_gettime/gettimeofday/time)
 //     never enter the kernel, so seccomp cannot see them. shim_patch_vdso
 //     neutralizes this at init by rewriting the vDSO entry points to real
@@ -36,9 +41,10 @@
 //     (bounded; large transfers chunk at DATA_MAX per call) rather than
 //     read remotely out of plugin memory by the simulator.
 //
-// Thread model: all threads of the process share one channel under a mutex
-// (syscalls serialize; each blocks until its own reply). The driver sees
-// one logical execution stream per process.
+// Thread model: each thread gets its OWN channel (pthread_create relays
+// PSYS_THREAD_NEW; the driver hands back a fresh channel path). The driver
+// enforces one-runnable-thread-per-process between syscalls, which is what
+// keeps multithreaded apps deterministic (docs/multiproc_design.md).
 
 #include "../common/ipc.h"
 
@@ -58,6 +64,7 @@
 #include <ucontext.h>
 #include <netinet/in.h>
 #include <poll.h>
+#include <sys/un.h>
 #include <pthread.h>
 #include <stdarg.h>
 #include <stdio.h>
@@ -161,6 +168,14 @@ void raw_unlock(std::atomic_flag* f) { f->clear(std::memory_order_release); }
 
 bool is_managed_fd(int fd) { return g_ch != nullptr && fd >= FD_BASE; }
 
+// Terminate WITHOUT the driver notification (raw exit_group): used by
+// shim-internal teardown paths where the driver already knows (MSG_STOP,
+// exec respawn) or where notifying would recurse.
+[[noreturn]] void raw_exit(int status) {
+  sys_native(SYS_exit_group, status);
+  __builtin_unreachable();
+}
+
 void shim_install_seccomp();  // defined at the bottom (needs the wrappers)
 void shim_patch_vdso();       // defined at the bottom
 void shim_notify_exit(int status, void*);  // defined with the thread plane
@@ -192,6 +207,9 @@ int64_t ipc_call(int64_t sysno, const int64_t args[6], const void* data_in,
 
   int64_t ret = ch->ret;
   int32_t mtype = ch->type;
+  int32_t sig_no = ch->sig_no;
+  int32_t sig_flags = ch->sig_flags;
+  uint64_t sig_handler = ch->sig_handler;
   uint32_t out_n = 0;
   if (data_out && ch->data_len > 0) {
     out_n = (uint32_t)ch->data_len;
@@ -203,7 +221,22 @@ int64_t ipc_call(int64_t sysno, const int64_t args[6], const void* data_in,
 
   if (mtype == MSG_STOP) {
     SHIM_LOG("driver requested stop");
-    _exit((int)ret);
+    raw_exit((int)ret);
+  }
+  // Virtual signal piggybacked on the reply (driver-side signal.c analog):
+  // invoke the app's registered handler here, at a syscall boundary — the
+  // deterministic delivery point. The transaction above is complete, so
+  // handler-made syscalls recurse safely through the channel.
+  if (sig_no > 0 && sig_handler != 0) {
+    SHIM_LOG("delivering virtual signal %d", sig_no);
+    if (sig_flags & 1) {  // SA_SIGINFO-style handler
+      siginfo_t si;
+      memset(&si, 0, sizeof(si));
+      si.si_signo = sig_no;
+      ((void (*)(int, siginfo_t*, void*))sig_handler)(sig_no, &si, nullptr);
+    } else {
+      ((void (*)(int))sig_handler)(sig_no);
+    }
   }
   if (mtype == MSG_DO_NATIVE) {
     return sys_native((long)sysno, args[0], args[1], args[2], args[3],
@@ -295,13 +328,64 @@ __attribute__((constructor)) void shim_init() {
 extern "C" {
 
 int socket(int domain, int type, int protocol) {
-  if (!g_ch || domain != AF_INET)
+  // AF_INET and AF_UNIX are simulated; everything else stays native
+  if (!g_ch || (domain != AF_INET && domain != AF_UNIX))
     return (int)sys_native(SYS_socket, domain, type, protocol);
   return (int)ipc_call6(SYS_socket, domain, type, protocol);
 }
 
+// Extract a sockaddr_un path ('@' prefix encodes the abstract namespace).
+// Returns the path length (0 on failure).
+static size_t parse_unix_path(const struct sockaddr* addr, socklen_t len,
+                              char* out, size_t cap) {
+  if (!addr || addr->sa_family != AF_UNIX) return 0;
+  const struct sockaddr_un* sun = (const struct sockaddr_un*)addr;
+  size_t off = offsetof(struct sockaddr_un, sun_path);
+  if ((size_t)len <= off) return 0;
+  size_t plen = (size_t)len - off;
+  if (plen > sizeof(sun->sun_path)) plen = sizeof(sun->sun_path);
+  size_t n = 0;
+  if (sun->sun_path[0] == '\0') {  // abstract namespace
+    if (cap < 1) return 0;
+    out[n++] = '@';
+    for (size_t i = 1; i < plen && n < cap; i++) out[n++] = sun->sun_path[i];
+  } else {
+    for (size_t i = 0; i < plen && n < cap && sun->sun_path[i]; i++)
+      out[n++] = sun->sun_path[i];
+  }
+  return n;
+}
+
+int socketpair(int domain, int type, int protocol, int sv[2]) {
+  if (!g_ch || domain != AF_UNIX)
+    return (int)sys_native(SYS_socketpair, domain, type, protocol,
+                           (long)sv);
+  int64_t args[6] = {domain, type, protocol, 0, 0, 0};
+  int32_t out[2] = {0, 0};
+  uint32_t out_len = 0;
+  int64_t r = ipc_call(SYS_socketpair, args, nullptr, 0, out, sizeof(out),
+                       &out_len);
+  if (r < 0) return -1;
+  if (out_len >= 8 && sv) {
+    sv[0] = out[0];
+    sv[1] = out[1];
+  }
+  return 0;
+}
+
 int bind(int fd, const struct sockaddr* addr, socklen_t len) {
   if (!is_managed_fd(fd)) return (int)sys_native(SYS_bind, fd, addr, len);
+  if (addr && addr->sa_family == AF_UNIX) {
+    char path[110];
+    size_t n = parse_unix_path(addr, len, path, sizeof(path));
+    if (!n) {
+      errno = EINVAL;
+      return -1;
+    }
+    int64_t args[6] = {fd, 0, 0, 1 /* AF_UNIX path in data */, 0, 0};
+    return (int)ipc_call(SYS_bind, args, path, (uint32_t)n, nullptr, 0,
+                         nullptr);
+  }
   uint32_t ip = 0;
   uint16_t port = 0;
   if (!parse_inet(addr, len, &ip, &port)) {
@@ -318,6 +402,17 @@ int listen(int fd, int backlog) {
 
 int connect(int fd, const struct sockaddr* addr, socklen_t len) {
   if (!is_managed_fd(fd)) return (int)sys_native(SYS_connect, fd, addr, len);
+  if (addr && addr->sa_family == AF_UNIX) {
+    char path[110];
+    size_t n = parse_unix_path(addr, len, path, sizeof(path));
+    if (!n) {
+      errno = EINVAL;
+      return -1;
+    }
+    int64_t args[6] = {fd, 0, 0, 1, 0, 0};
+    return (int)ipc_call(SYS_connect, args, path, (uint32_t)n, nullptr, 0,
+                         nullptr);
+  }
   uint32_t ip = 0;
   uint16_t port = 0;
   if (!parse_inet(addr, len, &ip, &port)) {
@@ -325,6 +420,136 @@ int connect(int fd, const struct sockaddr* addr, socklen_t len) {
     return -1;
   }
   return (int)ipc_call6(SYS_connect, fd, ip, port);
+}
+
+// ---------------------------------------------------------------------------
+// virtual signals (reference: syscall/signal.c emulation). The driver owns
+// disposition tables, pending queues and per-thread masks; handlers run at
+// syscall boundaries via the reply's sig_* fields (see ipc_call). Only the
+// classic app-level set is virtualized — SIGSYS stays native (the seccomp
+// backstop owns it), as do the fatal fault signals.
+// ---------------------------------------------------------------------------
+
+static constexpr uint64_t VIRT_SIG_MASK =
+    (1ULL << (SIGHUP - 1)) | (1ULL << (SIGINT - 1)) |
+    (1ULL << (SIGQUIT - 1)) | (1ULL << (SIGUSR1 - 1)) |
+    (1ULL << (SIGUSR2 - 1)) | (1ULL << (SIGPIPE - 1)) |
+    (1ULL << (SIGALRM - 1)) | (1ULL << (SIGTERM - 1)) |
+    (1ULL << (SIGCHLD - 1));
+
+static bool is_virt_sig(int sig) {
+  return sig >= 1 && sig <= 64 && ((VIRT_SIG_MASK >> (sig - 1)) & 1);
+}
+
+int sigaction(int sig, const struct sigaction* act, struct sigaction* old) {
+  static auto real_sigaction =
+      (int (*)(int, const struct sigaction*, struct sigaction*))dlsym(
+          RTLD_NEXT, "sigaction");
+  if (!g_ch || !is_virt_sig(sig)) return real_sigaction(sig, act, old);
+  int64_t handler = 0, flags = 0;
+  uint64_t mask = 0;
+  if (act) {
+    handler = (act->sa_flags & SA_SIGINFO) ? (int64_t)act->sa_sigaction
+                                           : (int64_t)act->sa_handler;
+    flags = act->sa_flags;
+    memcpy(&mask, &act->sa_mask, sizeof(mask));
+  }
+  int64_t args[6] = {sig, handler, flags, (int64_t)mask, act ? 1 : 0, 0};
+  uint8_t out[16];
+  uint32_t out_len = 0;
+  int64_t r = ipc_call(SYS_rt_sigaction, args, nullptr, 0, out, sizeof(out),
+                       &out_len);
+  if (r < 0) return -1;
+  if (old && out_len >= 12) {
+    memset(old, 0, sizeof(*old));
+    uint64_t oh;
+    uint32_t of;
+    memcpy(&oh, out, 8);
+    memcpy(&of, out + 8, 4);
+    old->sa_flags = (int)of;
+    if (of & SA_SIGINFO)
+      old->sa_sigaction = (void (*)(int, siginfo_t*, void*))oh;
+    else
+      old->sa_handler = (void (*)(int))oh;
+  }
+  return 0;
+}
+
+sighandler_t signal(int sig, sighandler_t h) {
+  static auto real_signal =
+      (sighandler_t(*)(int, sighandler_t))dlsym(RTLD_NEXT, "signal");
+  if (!g_ch || !is_virt_sig(sig)) return real_signal(sig, h);
+  struct sigaction act, old;
+  memset(&act, 0, sizeof(act));
+  act.sa_handler = h;
+  act.sa_flags = SA_RESTART;
+  if (sigaction(sig, &act, &old) != 0) return SIG_ERR;
+  return old.sa_handler;
+}
+
+int sigprocmask(int how, const sigset_t* set, sigset_t* old) {
+  static auto real_sigprocmask =
+      (int (*)(int, const sigset_t*, sigset_t*))dlsym(RTLD_NEXT,
+                                                      "sigprocmask");
+  if (!g_ch) return real_sigprocmask(how, set, old);
+  // native first, with the virtualized signals removed (they are never
+  // delivered natively; the driver owns their mask)
+  sigset_t nset;
+  sigset_t nold;
+  sigemptyset(&nold);
+  if (set) {
+    nset = *set;
+    for (int s = 1; s <= 64; s++)
+      if (is_virt_sig(s)) sigdelset(&nset, s);
+  }
+  if (real_sigprocmask(how, set ? &nset : nullptr, &nold) != 0) return -1;
+  uint64_t vm = 0;
+  if (set) {
+    memcpy(&vm, set, sizeof(vm));
+    vm &= VIRT_SIG_MASK;
+  }
+  // how: 0 block / 1 unblock / 2 setmask / 3 query-only
+  int64_t vhow = set ? (int64_t)how : 3;
+  int64_t args[6] = {vhow, (int64_t)vm, 0, 0, 0, 0};
+  uint8_t out[8];
+  uint32_t out_len = 0;
+  int64_t r =
+      ipc_call(SYS_rt_sigprocmask, args, nullptr, 0, out, sizeof(out),
+               &out_len);
+  if (old) {
+    uint64_t om = 0;
+    memcpy(&om, &nold, sizeof(om));
+    om &= ~VIRT_SIG_MASK;
+    uint64_t vold = 0;
+    if (r >= 0 && out_len >= 8) memcpy(&vold, out, 8);
+    om |= (vold & VIRT_SIG_MASK);
+    memset(old, 0, sizeof(*old));
+    memcpy(old, &om, sizeof(om));
+  }
+  return 0;
+}
+
+int pthread_sigmask(int how, const sigset_t* set, sigset_t* old) {
+  if (!g_ch) {
+    static auto real = (int (*)(int, const sigset_t*, sigset_t*))dlsym(
+        RTLD_NEXT, "pthread_sigmask");
+    return real(how, set, old);
+  }
+  return sigprocmask(how, set, old) == 0 ? 0 : errno;
+}
+
+int kill(pid_t pid, int sig) {
+  if (!g_ch || pid <= 0 || (sig != 0 && !is_virt_sig(sig)))
+    return (int)sys_native(SYS_kill, pid, sig);
+  return (int)ipc_call6(SYS_kill, pid == getpid() ? 0 : pid, sig);
+}
+
+int raise(int sig) {
+  if (!g_ch || !is_virt_sig(sig)) {
+    static auto real = (int (*)(int))dlsym(RTLD_NEXT, "raise");
+    return real(sig);
+  }
+  return (int)ipc_call6(SYS_kill, 0, sig);
 }
 
 int accept4(int fd, struct sockaddr* addr, socklen_t* alen, int flags) {
@@ -1368,7 +1593,7 @@ void pthread_exit(void* retval) {
   static auto real = (void (*)(void*))dlsym(RTLD_NEXT, "pthread_exit");
   thread_epilogue();  // no-op for unmanaged/main threads (t_reg unset)
   real(retval);
-  _exit(0);  // not reached; placates noreturn
+  raw_exit(0);  // not reached; placates noreturn
 }
 
 int pthread_join(pthread_t th, void** retval) {
@@ -1541,7 +1766,7 @@ pid_t fork(void) {
     // child: single-threaded; adopt the pre-created channel (the parent's
     // mapping is inherited but belongs to the parent)
     Channel* ch = map_channel(shm);
-    if (!ch) _exit(127);
+    if (!ch) raw_exit(127);
     g_ch = ch;
     t_ch = ch;
     g_threads = nullptr;
@@ -1557,6 +1782,16 @@ pid_t fork(void) {
   }
   return p;
 }
+
+// _exit/_Exit bypass atexit/on_exit, so without interposition the driver
+// would never learn the process ended (fork children have no popen handle
+// to poll — they would read as wedged). Notify first, then raw-exit.
+void _exit(int status) {
+  if (g_ch) shim_notify_exit(status, nullptr);
+  raw_exit(status);
+}
+
+void _Exit(int status) { _exit(status); }
 
 pid_t waitpid(pid_t pid, int* wstatus, int options) {
   static auto real = (pid_t (*)(pid_t, int*, int))
@@ -1574,7 +1809,9 @@ pid_t waitpid(pid_t pid, int* wstatus, int options) {
                         sizeof(status), &out_len);
   if (rc < 0) return -1;  // errno set (ECHILD)
   if (rc == 0) return 0;  // WNOHANG: no managed child done yet
-  if (wstatus) *wstatus = (status & 0xFF) << 8;  // normal-exit encoding
+  // the driver composes the full wait-status word (normal exit OR
+  // signaled — see driver._wait_status); pass it through verbatim
+  if (wstatus) *wstatus = status;
   real((pid_t)rc, nullptr, WNOHANG);  // opportunistic zombie reap
   return (pid_t)rc;
 }
@@ -1626,7 +1863,7 @@ int execve(const char* path, char* const argv[], char* const envp[]) {
   int64_t a[6] = {argc, 0, 0, 0, 0, 0};
   int64_t rc = ipc_call(PSYS_EXEC, a, buf, off, nullptr, 0, nullptr);
   if (rc < 0) return -1;  // errno set (e.g. ENOENT)
-  _exit(0);  // replaced by the respawned image; never returns
+  raw_exit(0);  // replaced by the respawned image; never returns
 }
 
 }  // extern "C"

@@ -46,7 +46,7 @@ import numpy as np
 from flax import struct
 
 from shadow_tpu.core import rng as rng_mod
-from shadow_tpu.core import simtime
+from shadow_tpu.core import simtime, soa
 from shadow_tpu.core.state import (
     PAYLOAD_WORDS,
     Counters,
@@ -203,75 +203,105 @@ class _Outbox:
         )
 
 
-@struct.dataclass
-class _SortedWindow:
-    """The pool sorted by (dst, time, src, seq) for one window.
+class _DenseWindow(NamedTuple):
+    """Dense per-host window matrix: column j holds host h's j-th in-window
+    event in (time, src, seq) key order; unused cells carry time NEVER.
+    Shapes [H, Kc] (payload [H, Kc, P])."""
 
-    In-window events of host h occupy consecutive rows [starts[h], ends[h]);
-    out-of-window rows sort to the end (dst key = H sentinel). The loop
-    consumes rows via per-host cursors — no [H, K] matrix is materialized;
-    per-iteration [H]-gathers read the head rows directly, and unconsumed
-    rows flow straight into the merge."""
-
-    dst: jnp.ndarray  # [C] i32 original dst (sentinel-free)
-    time: jnp.ndarray  # [C] i64
-    src: jnp.ndarray  # [C] i32
-    seq: jnp.ndarray  # [C] i32
-    kind: jnp.ndarray  # [C] i32
-    idx: jnp.ndarray  # [C] i32 original pool slot (payload indirection)
-    starts: jnp.ndarray  # [H] i32
-    ends: jnp.ndarray  # [H] i32
+    time: jnp.ndarray
+    src: jnp.ndarray
+    seq: jnp.ndarray
+    kind: jnp.ndarray
+    payload: jnp.ndarray
 
 
-def _sort_window(pool: EventPool, win_end, H: int, K: int):
-    """Sort the pool by (dst, time, src, seq) and locate per-host runs.
+class _Tail(NamedTuple):
+    """Rows not extracted into the dense matrix: out-of-window events,
+    per-host deferred leftovers (rank >= Kc), and spent filler rows (time
+    NEVER). Flat [N - H*Kc] arrays; payload is a list of P word columns so
+    it can ride merge sorts as operands."""
 
-    Events beyond K per host are deferred to the next window (their keys are
-    strictly larger than every extracted event's, so per-host order holds).
-    Also returns the FULL key (time, src, seq), each [H], of the earliest
-    DEFERRED event per host (time NEVER if none): a self-emission whose own
-    key (time, emitting host, seq) is >= that deferred key must bypass the
-    inbox and go to the pool, otherwise it could be processed ahead of the
-    deferred leftover. Comparing the full key (not just the time) makes the
-    routing exact under nanosecond ties: an emission tied on time with the
-    deferred leftover still interleaves correctly against the extracted
-    same-time events via the (src, seq) tiebreak — the order the pool sort
-    would produce.
+    time: jnp.ndarray
+    src: jnp.ndarray
+    seq: jnp.ndarray
+    kind: jnp.ndarray
+    dst: jnp.ndarray
+    payload: list
 
-    TPU note: sorts and gathers only — XLA scatters serialize
-    element-by-element on TPU (~0.5 µs each), so a single [C]-row scatter
-    would cost more than the entire window step."""
+
+def _dense_extract(pool: EventPool, win_end, H: int, Kc: int, P: int):
+    """Extract the window into a dense [H, Kc] matrix with SORTS AND SCANS
+    ONLY (profiled on v5e: large gathers serialize at ~9 ns/element while
+    multi-operand bitonic sorts run at memory bandwidth — so every event
+    column and payload word rides the sorts as an operand).
+
+    Sort 1 keys (dst | H-sentinel, time, src, seq) over pool rows plus Kc
+    filler rows per host (time NEVER — they sort after every real in-window
+    row of their host). A cummax scan derives each row's rank within its
+    host run (no searchsorted — its method="sort" lowers to a scatter).
+    Sort 2 by dense slot id (h*Kc + rank) lands extracted rows so the
+    window matrix is a plain reshape; everything else keeps relative order
+    at the tail and becomes the merge leftovers.
+
+    Replaces per-host priority queues (scheduler_policy_host_single.c:
+    18-54) and their locks with two sorts shared by all hosts."""
     C = pool.capacity
+    HK = H * Kc
+    N = C + HK
+    hosts = jnp.arange(H, dtype=jnp.int32)
     inwin = pool.time < win_end
-    sort_dst = jnp.where(inwin, pool.dst, jnp.int32(H))
-    idx = jnp.arange(C, dtype=jnp.int32)
-    s_key, s_time, s_src, s_seq, s_idx = jax.lax.sort(
-        [sort_dst, pool.time, pool.src, pool.seq, idx], num_keys=4,
-        is_stable=True,
+    key_r = jnp.where(inwin, pool.dst, jnp.int32(H))
+    key_f = jnp.repeat(hosts, Kc)  # [HK] filler keys
+    cat_key = jnp.concatenate([key_r, key_f])
+    cat_t = jnp.concatenate([pool.time, jnp.full((HK,), NEVER, jnp.int64)])
+    zf = jnp.zeros((HK,), jnp.int32)
+    cat_d = jnp.concatenate([pool.dst, key_f])  # TRUE dst rides along
+    cat_s = jnp.concatenate([pool.src, zf])
+    cat_q = jnp.concatenate([pool.seq, zf])
+    cat_k = jnp.concatenate([pool.kind, zf])
+    pcols = [jnp.concatenate([pool.payload[:, w], zf]) for w in range(P)]
+    ops = jax.lax.sort(
+        [cat_key, cat_t, cat_s, cat_q, cat_k, cat_d] + pcols,
+        num_keys=4, is_stable=True,
     )
-    # One sort-method searchsorted over H+1 boundaries (the default binary
-    # scan costs ~3x more here).
-    bounds = jnp.searchsorted(
-        s_key, jnp.arange(H + 1, dtype=jnp.int32), method="sort"
-    ).astype(jnp.int32)
-    starts, ends = bounds[:H], bounds[1:]
-    sw = _SortedWindow(
-        dst=pool.dst[s_idx],
-        time=s_time,
-        src=s_src,
-        seq=s_seq,
-        kind=pool.kind[s_idx],
-        idx=s_idx,
-        starts=starts,
-        ends=ends,
+    s_key, s_t, s_s, s_q, s_k, s_d = ops[:6]
+    s_p = ops[6:]
+    iota = jnp.arange(N, dtype=jnp.int32)
+    boundary = jnp.concatenate(
+        [jnp.ones((1,), bool), s_key[1:] != s_key[:-1]]
     )
-    # Earliest deferred (rank >= K) per host; time NEVER if the host fit.
-    has_defer = (starts + K) < ends
-    didx = jnp.where(has_defer, starts + K, 0)
-    defer_time = jnp.where(has_defer, s_time[didx], NEVER)
-    defer_src = jnp.where(has_defer, s_src[didx], 0)
-    defer_seq = jnp.where(has_defer, s_seq[didx], 0)
-    return sw, (defer_time, defer_src, defer_seq)
+    run_start = jax.lax.cummax(jnp.where(boundary, iota, -1))
+    rank = iota - run_start
+    extract = (s_key < H) & (rank < Kc)
+    slot = jnp.where(extract, s_key * Kc + rank, jnp.int32(N))
+    ops2 = jax.lax.sort(
+        [slot, s_t, s_s, s_q, s_k, s_d] + list(s_p),
+        num_keys=1, is_stable=True,
+    )
+    d_t, d_s, d_q, d_k = (o[:HK].reshape(H, Kc) for o in ops2[1:5])
+    d_p = jnp.stack([o[:HK].reshape(H, Kc) for o in ops2[6:]], axis=-1)
+    dense = _DenseWindow(time=d_t, src=d_s, seq=d_q, kind=d_k, payload=d_p)
+    tail = _Tail(
+        time=ops2[1][HK:], src=ops2[2][HK:], seq=ops2[3][HK:],
+        kind=ops2[4][HK:], dst=ops2[5][HK:],
+        payload=[o[HK:] for o in ops2[6:]],
+    )
+    return dense, tail
+
+
+def _read_col(dense: _DenseWindow, col, Kc: int):
+    """Read event fields at per-host column `col` via one-hot masked
+    reduces (soa.get_at) — NOT take_along_axis, whose gather serializes per
+    element on TPU; the [H, Kc] compare+select runs at full vector
+    bandwidth (XLA CSE merges the repeated hit masks). `col` must lie in
+    [0, Kc). Returns (time, src, seq, kind, payload)."""
+    return (
+        soa.get_at(dense.time, col),
+        soa.get_at(dense.src, col),
+        soa.get_at(dense.seq, col),
+        soa.get_at(dense.kind, col),
+        soa.get_at(dense.payload, col),
+    )
 
 
 def _inbox_min(inbox: _Inbox):
@@ -377,7 +407,6 @@ def make_window_step(
         P = state.pool.payload.shape[1]  # payload words (per-sim sized)
         win_start = jnp.asarray(win_start, jnp.int64)
         win_end = jnp.asarray(win_end, jnp.int64)
-        pool_payload = state.pool.payload
         state = state.replace(now=win_start)
 
         # Static per-kind emission bound: probe the handlers once at trace
@@ -416,14 +445,22 @@ def make_window_step(
                 f"(kind {bulk_kind}: {int(E_by_kind[bulk_kind])} emissions "
                 f"x G={G}); raise outbox_slots or lower the bulk width"
             )
-        E_arr = jnp.asarray(E_by_kind, jnp.int32)
 
-        # The loop path's machinery closes over the window sort's outputs;
-        # building it in a factory keeps the sort INSIDE the run_loop cond
-        # branch, so the matrix fast path never pays for it (the
-        # searchsorted in _sort_window lowers to a scatter, ~1.7 ms/window
-        # on v5e — pure waste when every window takes the matrix branch).
-        def make_loop_fns(sw, defer_time, defer_src, defer_seq):
+        # The loop path's machinery closes over the dense window extraction;
+        # building it in a factory keeps the extraction sorts INSIDE the
+        # run_loop cond branch, so the matrix fast path never pays for them.
+        # Kc = K + 1 columns: column K is never consumed (the cursor gate is
+        # ptr < K) — it exists purely to expose the earliest DEFERRED
+        # event's full key per host. A self-emission whose key (time,
+        # emitting host, seq) is >= that deferred key must bypass the inbox
+        # and go to the pool, otherwise it could be processed ahead of the
+        # deferred leftover; the full-key compare keeps that routing exact
+        # under nanosecond ties.
+        def make_loop_fns(dense: _DenseWindow, tail: _Tail):
+            Kc = K + 1
+            defer_time = dense.time[:, K]
+            defer_src = dense.src[:, K]
+            defer_seq = dense.seq[:, K]
             carry0 = (
                 jnp.zeros((H,), dtype=jnp.int32),  # ptr (consumed per host)
                 _Inbox.empty(H, B, P),
@@ -439,18 +476,18 @@ def make_window_step(
             def body(carry):
                 state, ptr, inbox, outbox, it, _ = carry
 
-                # --- candidate per host: sorted-run head vs inbox min ---
-                hp = jnp.clip(sw.starts + ptr, 0, sw.time.shape[0] - 1)
-                in_run = (ptr < K) & ((sw.starts + ptr) < sw.ends)
-                m_time = jnp.where(in_run, sw.time[hp], NEVER)
-                m_src = sw.src[hp]
-                m_seq = sw.seq[hp]
+                # --- candidate per host: dense-matrix head vs inbox min ---
+                # (one-hot reads; ptr <= K < Kc always in range)
+                m_t_raw, m_src, m_seq, m_kind, m_payload = _read_col(
+                    dense, ptr, Kc
+                )
+                in_run = (ptr < K) & (m_t_raw != NEVER)
+                m_time = jnp.where(in_run, m_t_raw, NEVER)
                 i_time, i_src, i_seq, i_slot = _inbox_min(inbox)
                 use_inbox = _key_lt(i_time, i_src, i_seq, m_time, m_src, m_seq)
                 ev_time = jnp.where(use_inbox, i_time, m_time)
 
-                m_kind = sw.kind[hp]
-                i_kind = jnp.take_along_axis(inbox.kind, i_slot[:, None], axis=1)[:, 0]
+                i_kind = soa.get_at(inbox.kind, i_slot)
                 ev_kind = jnp.where(use_inbox, i_kind, m_kind)
 
                 # --- bulk batch planning (before the room check, which must
@@ -458,19 +495,18 @@ def make_window_step(
                 # up to G-1 further CONSECUTIVE events of the bulk kind, each
                 # required to precede the inbox head in key order so nothing
                 # that deserves to interleave is foreclosed. ---
-                C_len = sw.time.shape[0]
-                bulk_t, bulk_s, bulk_q, bulk_hp, bulk_m = [], [], [], [], []
+                bulk_t, bulk_s, bulk_q, bulk_p, bulk_m = [], [], [], [], []
                 if bulk_kind is not None and G > 1:
                     prev = (
                         (ev_time < win_end) & ~use_inbox & (ev_kind == bulk_kind)
                     )
                     for g in range(1, G):
-                        hpg = jnp.clip(sw.starts + ptr + g, 0, C_len - 1)
-                        ing = (ptr + g < K) & ((sw.starts + ptr + g) < sw.ends)
-                        tg = jnp.where(ing, sw.time[hpg], NEVER)
-                        sg = sw.src[hpg]
-                        qg = sw.seq[hpg]
-                        kg = sw.kind[hpg]
+                        ing = ptr + g < K
+                        tg_r, sg, qg, kg, pg = _read_col(
+                            dense, jnp.where(ing, ptr + g, 0), Kc
+                        )
+                        ing = ing & (tg_r != NEVER)
+                        tg = jnp.where(ing, tg_r, NEVER)
                         okg = (
                             prev & ing & (kg == bulk_kind) & (tg < win_end)
                             & _key_lt(tg, sg, qg, i_time, i_src, i_seq)
@@ -478,7 +514,7 @@ def make_window_step(
                         bulk_t.append(tg)
                         bulk_s.append(sg)
                         bulk_q.append(qg)
-                        bulk_hp.append(hpg)
+                        bulk_p.append(pg)
                         bulk_m.append(okg)
                         prev = okg
                     g_extra = jnp.sum(
@@ -490,18 +526,20 @@ def make_window_step(
                 # Outbox backpressure: a host whose outbox cannot absorb this
                 # event-kind's worst-case emissions (times the batch width)
                 # stalls — its events stay queued and defer to the next window
-                # via the merge (never dropped).
-                need = E_arr[jnp.clip(ev_kind, 0, E_arr.shape[0] - 1)] * (
-                    1 + g_extra
-                )
+                # via the merge (never dropped). Per-kind worst cases are
+                # static python ints, so the lookup is an unrolled select —
+                # not an [H]-gather.
+                need_base = jnp.zeros((H,), dtype=jnp.int32)
+                for k in kinds:
+                    e_k = int(E_by_kind[k])
+                    if e_k:
+                        need_base = jnp.where(ev_kind == k, e_k, need_base)
+                need = need_base * (1 + g_extra)
                 room = (outbox.count + need) <= O
                 valid = (ev_time < win_end) & room
                 stalled = (ev_time < win_end) & ~room
 
-                m_payload = pool_payload[sw.idx[hp]]
-                i_payload = jnp.take_along_axis(
-                    inbox.payload, i_slot[:, None, None], axis=1
-                )[:, 0, :]
+                i_payload = soa.get_at(inbox.payload, i_slot)
                 ev = EventView(
                     mask=valid,
                     time=ev_time,
@@ -545,7 +583,7 @@ def make_window_step(
                                 src=bulk_s[g],
                                 seq=bulk_q[g],
                                 kind=jnp.full((H,), k, dtype=jnp.int32),
-                                payload=pool_payload[sw.idx[bulk_hp[g]]],
+                                payload=bulk_p[g],
                             )
                             state = handlers[k](state, gev, emitter, params)
 
@@ -621,55 +659,49 @@ def make_window_step(
                 return (state, ptr, inbox, outbox, it + 1, work)
 
             def finish(state, ptr, bt, bd, bs, bq, bk, bp):
-                """Merge: unconsumed sorted rows ∪ box rows (flattened outbox,
-                inbox leftovers, or matrix emissions) with one sort by time
-                (gathers only — no scatters, which serialize on TPU). A sorted
-                row is consumed iff its rank within its host's run is below that
-                host's final cursor — pure elementwise, no inverse permutation.
-                Also derives the speculation-violation signal: a cross-host box
-                emission targeting time t violates iff its DESTINATION host
-                already processed an event at time >= t since the optimistic
-                synchronizer's window began (host.done_t) — impossible under
-                conservative windows, so xmit_min stays NEVER there."""
+                """Merge: unconsumed dense cells ∪ tail rows ∪ box rows
+                (flattened outbox + inbox leftovers) with ONE 1-key stable
+                sort by time carrying every event column and payload word as
+                operands — no scatters and no payload-indirection gathers
+                (both serialize on TPU). A dense cell is consumed iff its
+                column is below the host's final cursor — pure elementwise.
+                Also derives the speculation-violation signal: a cross-host
+                box emission targeting time t violates iff its DESTINATION
+                host already processed an event at time >= t since the
+                optimistic synchronizer's window began (host.done_t) —
+                impossible under conservative windows, so xmit_min stays
+                NEVER there."""
                 pool = state.pool
                 C = pool.capacity
-                spos = jnp.arange(C, dtype=jnp.int32)
-                run_host = jnp.clip(sw.dst, 0, H - 1)
-                rank = spos - sw.starts[run_host]
-                in_run_row = (
-                    (spos >= sw.starts[run_host]) & (spos < sw.ends[run_host])
-                )
-                consumed = in_run_row & (rank < ptr[run_host])
-                left_time = jnp.where(consumed, NEVER, sw.time)
+                dcols = jnp.arange(Kc, dtype=jnp.int32)
+                left = dcols[None, :] >= ptr[:, None]  # unconsumed cells
+                l_t = jnp.where(left, dense.time, NEVER).reshape(-1)
+                l_d = jnp.broadcast_to(hosts[:, None], (H, Kc)).reshape(-1)
+                l_s = dense.src.reshape(-1)
+                l_q = dense.seq.reshape(-1)
+                l_k = dense.kind.reshape(-1)
 
-                all_time = jnp.concatenate([left_time, bt])
-                all_dst = jnp.concatenate([sw.dst, bd])
-                all_src = jnp.concatenate([sw.src, bs])
-                all_seq = jnp.concatenate([sw.seq, bq])
-                all_kind = jnp.concatenate([sw.kind, bk])
-                idx = jnp.arange(all_time.shape[0], dtype=jnp.int32)
-                s_time, s_idx = jax.lax.sort(
-                    [all_time, idx], num_keys=1, is_stable=True
+                m_t = jnp.concatenate([l_t, tail.time, bt])
+                m_d = jnp.concatenate([l_d, tail.dst, bd])
+                m_s = jnp.concatenate([l_s, tail.src, bs])
+                m_q = jnp.concatenate([l_q, tail.seq, bq])
+                m_k = jnp.concatenate([l_k, tail.kind, bk])
+                m_p = [
+                    jnp.concatenate(
+                        [dense.payload[:, :, w].reshape(-1), tail.payload[w],
+                         bp[:, w]]
+                    )
+                    for w in range(P)
+                ]
+                ops3 = jax.lax.sort(
+                    [m_t, m_d, m_s, m_q, m_k] + m_p, num_keys=1,
+                    is_stable=True,
                 )
-                keep = s_idx[:C]
-                dropped = jnp.sum(s_time[C:] != NEVER, dtype=jnp.int64)
-                # Payload indirection: rows from the sorted window read the
-                # ORIGINAL pool payload via sw.idx; box rows read bp.
-                if bp.shape[0] == 0:  # no box rows (e.g. emission-free window)
-                    bp = jnp.zeros((1, P), bp.dtype)
-                from_pool = keep < C
-                ppidx = sw.idx[jnp.where(from_pool, keep, 0)]
-                bidx = jnp.clip(keep - C, 0, bp.shape[0] - 1)
-                new_payload = jnp.where(
-                    from_pool[:, None], pool.payload[ppidx], bp[bidx]
-                )
+                dropped = jnp.sum(ops3[0][C:] != NEVER, dtype=jnp.int64)
                 new_pool = EventPool(
-                    time=s_time[:C],
-                    dst=all_dst[keep],
-                    src=all_src[keep],
-                    seq=all_seq[keep],
-                    kind=all_kind[keep],
-                    payload=new_payload,
+                    time=ops3[0][:C], dst=ops3[1][:C], src=ops3[2][:C],
+                    seq=ops3[3][:C], kind=ops3[4][:C],
+                    payload=jnp.stack([o[:C] for o in ops3[5:]], axis=-1),
                 )
                 if bt.shape[0]:
                     cross = (bd != bs) & (bt != NEVER)
@@ -691,12 +723,8 @@ def make_window_step(
             return carry0, cond, body, finish
 
         def run_loop(state):
-            sw, (defer_time, defer_src, defer_seq) = _sort_window(
-                state.pool, win_end, H, K
-            )
-            carry0, cond, body, finish = make_loop_fns(
-                sw, defer_time, defer_src, defer_seq
-            )
+            dense, tail = _dense_extract(state.pool, win_end, H, K + 1, P)
+            carry0, cond, body, finish = make_loop_fns(dense, tail)
             state, ptr, inbox, outbox, _, _ = jax.lax.while_loop(
                 cond, body, (state,) + carry0
             )
@@ -735,64 +763,12 @@ def make_window_step(
             TPU note (profiled on v5e): large GATHERS serialize (~9 ns per
             element) while multi-operand sorts and scans run at memory
             bandwidth, so this path is built from sorts, cumulative scans,
-            and reshapes ONLY. Dense [H, K] extraction works by sorting K
-            filler rows per host together with the pool (sort 1), deriving
-            each row's rank within its host run with a cummax scan (no
-            searchsorted — its method="sort" lowers to a scatter), and
-            re-sorting by dense slot id (sort 2) so the window matrix is a
-            plain reshape. Event columns and payload words ride every sort
-            as extra operands instead of being gathered afterwards."""
+            and reshapes ONLY (_dense_extract)."""
             pool = state.pool
             C = pool.capacity
-            HK = H * K
-            N = C + HK
-            # --- sort 1: (key, time, src, seq) over pool rows + fillers.
-            # Fillers (time NEVER) sort after every real in-window row of
-            # their host; out-of-window rows carry key H and sort last.
-            inwin = pool.time < win_end
-            key_r = jnp.where(inwin, pool.dst, jnp.int32(H))
-            key_f = jnp.repeat(hosts, K)  # [HK] filler keys
-            cat_key = jnp.concatenate([key_r, key_f])
-            cat_t = jnp.concatenate(
-                [pool.time, jnp.full((HK,), NEVER, jnp.int64)]
-            )
-            zf = jnp.zeros((HK,), jnp.int32)
-            cat_d = jnp.concatenate([pool.dst, key_f])  # TRUE dst rides along
-            cat_s = jnp.concatenate([pool.src, zf])
-            cat_q = jnp.concatenate([pool.seq, zf])
-            cat_k = jnp.concatenate([pool.kind, zf])
-            pcols = [
-                jnp.concatenate([pool.payload[:, w], zf])
-                for w in range(P)
-            ]
-            ops = jax.lax.sort(
-                [cat_key, cat_t, cat_s, cat_q, cat_k, cat_d] + pcols,
-                num_keys=4, is_stable=True,
-            )
-            s_key, s_t, s_s, s_q, s_k, s_d = ops[:6]
-            s_p = ops[6:]
-            # --- rank within host run via scan (gather/scatter-free) ---
-            iota = jnp.arange(N, dtype=jnp.int32)
-            boundary = jnp.concatenate(
-                [jnp.ones((1,), bool), s_key[1:] != s_key[:-1]]
-            )
-            run_start = jax.lax.cummax(jnp.where(boundary, iota, -1))
-            rank = iota - run_start
-            # --- sort 2: dense slot id; extracted rows land at h*K + rank,
-            # everything else (rank >= K, key == H) keeps relative order at
-            # the tail and becomes the merge leftovers ---
-            extract = (s_key < H) & (rank < K)
-            slot = jnp.where(extract, s_key * K + rank, jnp.int32(N))
-            ops2 = jax.lax.sort(
-                [slot, s_t, s_s, s_q, s_k, s_d] + list(s_p),
-                num_keys=1, is_stable=True,
-            )
-            d_t, d_s, d_q, d_k = (o[:HK].reshape(H, K) for o in ops2[1:5])
-            d_p = jnp.stack([o[:HK].reshape(H, K) for o in ops2[6:]], axis=-1)
-            # tail rows = deferred + out-of-window + leftover fillers (time
-            # NEVER, sort away in the merge)
-            tl_t, tl_s, tl_q, tl_k, tl_d = (o[HK:] for o in ops2[1:6])
-            tl_p = [o[HK:] for o in ops2[6:]]
+            dense, tail = _dense_extract(pool, win_end, H, K, P)
+            d_t, d_s, d_q = dense.time, dense.src, dense.seq
+            d_p = dense.payload
             # fillers interleave with real same-host rows only at time
             # NEVER, so a dense cell is real iff its time is set
             valid = d_t != NEVER
@@ -833,6 +809,23 @@ def make_window_step(
             state = state.replace(
                 host=state.host.replace(seq_next=base + total)
             )
+            # bulk-contract check (make_window_step docstring): the matrix
+            # path is only sound if no emission targets SELF below win_end —
+            # such an emission would deserve to interleave with this
+            # window's batched events. Count violations loudly.
+            viol = jnp.zeros((), jnp.int64)
+            for r in memit.records:
+                viol = viol + jnp.sum(
+                    r.mask & (r.dst == hostsK) & (r.time < win_end),
+                    dtype=jnp.int64,
+                )
+            state = state.replace(
+                counters=state.counters.replace(
+                    bulk_contract_violations=(
+                        state.counters.bulk_contract_violations + viol
+                    )
+                )
+            )
             state = state.replace(
                 counters=state.counters.replace(
                     events_committed=state.counters.events_committed
@@ -846,13 +839,13 @@ def make_window_step(
             # stable sort by time carrying every column; no payload
             # indirection gathers. Output truncates to pool capacity
             # (fillers sit at time NEVER and fall off first). ---
-            m_t = jnp.concatenate([tl_t] + [e[0] for e in em_rows])
-            m_d = jnp.concatenate([tl_d] + [e[1] for e in em_rows])
-            m_s = jnp.concatenate([tl_s] + [e[2] for e in em_rows])
-            m_q = jnp.concatenate([tl_q] + [e[3] for e in em_rows])
-            m_k = jnp.concatenate([tl_k] + [e[4] for e in em_rows])
+            m_t = jnp.concatenate([tail.time] + [e[0] for e in em_rows])
+            m_d = jnp.concatenate([tail.dst] + [e[1] for e in em_rows])
+            m_s = jnp.concatenate([tail.src] + [e[2] for e in em_rows])
+            m_q = jnp.concatenate([tail.seq] + [e[3] for e in em_rows])
+            m_k = jnp.concatenate([tail.kind] + [e[4] for e in em_rows])
             m_p = [
-                jnp.concatenate([tl_p[w]] + [e[5][w] for e in em_rows])
+                jnp.concatenate([tail.payload[w]] + [e[5][w] for e in em_rows])
                 for w in range(P)
             ]
             ops3 = jax.lax.sort(
@@ -1002,6 +995,9 @@ class Simulation:
             handlers, num_hosts, K=K, B=B, O=O, bulk_kinds=bulk_kinds,
             matrix_handlers=matrix_handlers,
         )
+        # raw (unjitted) step for callers composing their own fused device
+        # loops (e.g. procs.bridge's run-until-output sync loop)
+        self._step_fn = step
         self._step = jax.jit(step)
         self._run_to = jax.jit(self._make_run_to(step))
         self._attempt = jax.jit(self._make_attempt(step))

@@ -35,6 +35,18 @@ def set_at(arr, mask, col, val):
     return jnp.where(hit, val, arr)
 
 
+def get_at(arr, col):
+    """arr[h, col[h]] via a one-hot masked reduce — NOT a gather, which
+    serializes per output element on TPU (~9 ns/element, docs/bench_notes.md
+    round-2 profile). Rows whose col is outside [0, S) return 0."""
+    hit = _hit(arr, jnp.ones(col.shape, bool), col)
+    if arr.ndim == 3:
+        return jnp.sum(
+            jnp.where(hit[:, :, None], arr, 0), axis=1, dtype=arr.dtype
+        )
+    return jnp.sum(jnp.where(hit, arr, 0), axis=1, dtype=arr.dtype)
+
+
 def add_at(arr, mask, col, val):
     """arr[h, col[h]] += val[h] where mask[h]."""
     hit = _hit(arr, mask, col)

@@ -106,6 +106,11 @@ class Counters:
     micro_steps: jnp.ndarray
     bytes_sent: jnp.ndarray
     bytes_delivered: jnp.ndarray
+    # matrix-path safety: count of bulk-kind emissions that targeted SELF
+    # below win_end — forbidden by the bulk contract (engine.make_window_step
+    # docstring); nonzero means the fast path may have corrupted event
+    # order. Asserted zero by tests; always-on (the check is elementwise).
+    bulk_contract_violations: jnp.ndarray
 
     @classmethod
     def zeros(cls) -> "Counters":

@@ -119,9 +119,10 @@ def _pop_helper(router: RouterState, now, want):
     empty_hit = want & ~nonempty
 
     slot = router.q_head % Q
-    payload = router.q_payload[hosts, slot]
-    src = router.q_src[hosts, slot]
-    enq_ts = router.q_enq_ts[hosts, slot]
+    # one-hot ring reads — row gathers serialize on TPU (soa.get_at)
+    payload = soa.get_at(router.q_payload, slot)
+    src = soa.get_at(router.q_src, slot)
+    enq_ts = soa.get_at(router.q_enq_ts, slot)
 
     size = pkt.total_bytes(payload).astype(jnp.int64)
     new_total = jnp.where(have, router.total_size - size, router.total_size)

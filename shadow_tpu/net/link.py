@@ -41,10 +41,19 @@ def send(
     control_mask explicitly.
     Returns updated state (counters + RNG advance).
     """
-    vs = state.host.vertex  # [H]
-    vd = state.host.vertex[dst_host]  # [H]
-    lat = params.latency_vv[vs, vd]
-    rel = params.reliability_vv[vs, vd]
+    U = params.latency_vv.shape[0]
+    if U == 1:
+        # Single-vertex topology (self-loop graphs — every staged bench and
+        # any host-only sim): the path lookup is a broadcast scalar. This
+        # matters because the general case's by-dst table reads are gathers,
+        # which serialize per element on TPU.
+        lat = jnp.broadcast_to(params.latency_vv[0, 0], dst_host.shape)
+        rel = jnp.broadcast_to(params.reliability_vv[0, 0], dst_host.shape)
+    else:
+        vs = state.host.vertex  # [H]
+        vd = state.host.vertex[dst_host]  # [H]
+        lat = params.latency_vv[vs, vd]
+        rel = params.reliability_vv[vs, vd]
     reachable = lat != simtime.NEVER
 
     roll_mask = mask & reachable

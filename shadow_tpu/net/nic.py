@@ -167,13 +167,12 @@ def enqueue_send(nic: NicState, mask, dst_host, payload) -> tuple[NicState, jnp.
 
 
 def peek_send(nic: NicState):
-    """Head packet per host: (payload [H,P], dst [H], nonempty [H])."""
-    H, NQ = nic.q_dst.shape
-    hosts = jnp.arange(H, dtype=jnp.int32)
+    """Head packet per host: (payload [H,P], dst [H], nonempty [H]).
+    One-hot ring reads — row gathers serialize on TPU (soa.get_at)."""
     nonempty = nic.q_head < nic.q_tail
-    slot = nic.q_head % NQ
-    payload = nic.q_payload[hosts, slot]
-    dst = nic.q_dst[hosts, slot]
+    slot = nic.q_head % nic.q_dst.shape[1]
+    payload = soa.get_at(nic.q_payload, slot)
+    dst = soa.get_at(nic.q_dst, slot)
     return payload, dst, nonempty
 
 
@@ -208,13 +207,14 @@ def _rr_order(nic: NicState, sockets_per_host: int):
 def peek_send_rr(nic: NicState, sockets_per_host: int):
     """RR head packet per host: (payload [H,P], dst [H], nonempty [H],
     slot [H])."""
-    H, NQ = nic.q_dst.shape
-    hosts = jnp.arange(H, dtype=jnp.int32)
     present, key, slot = _rr_order(nic, sockets_per_host)
     pick = jnp.argmin(key, axis=1).astype(jnp.int32)
     nonempty = jnp.any(present, axis=1)
-    sel = slot[hosts, pick]
-    return nic.q_payload[hosts, sel], nic.q_dst[hosts, sel], nonempty, sel
+    sel = soa.get_at(slot, pick)
+    return (
+        soa.get_at(nic.q_payload, sel), soa.get_at(nic.q_dst, sel),
+        nonempty, sel,
+    )
 
 
 def pop_send_rr(nic: NicState, mask, slot) -> NicState:

@@ -238,6 +238,15 @@ class DeviceNetBridge:
         )
         self._pending: list[tuple[int, int, np.ndarray]] = []  # (t, src, row)
         self._drained = False  # device pool empty since the last injection
+        self._ring_prefixes = [""] + (
+            ["e_", "r_", "f_", "c_"] if with_tcp else []
+        )
+        # Fused sync loop: ONE device dispatch advances many windows, exiting
+        # early as soon as any output ring holds a row. Replaces the
+        # window-per-dispatch round trips that dominated managed-plane wall
+        # time over the accelerator tunnel (docs/bench_notes.md round 2).
+        self._sync_max_windows = 32
+        self._run_sync = jax.jit(self._make_run_sync())
         self._handles: dict[int, bytes] = {}
         self._next_handle = 1
         self._port_slot: dict[tuple[int, int], int] = {}
@@ -252,6 +261,47 @@ class DeviceNetBridge:
         # active opens, accepted children); while non-empty, sync() must let
         # the device advance (timers/retransmits may be pending)
         self._tcp_live: set[tuple[int, int]] = set()
+
+    def _make_run_sync(self):
+        """Build the fused device sync loop: step conservative windows until
+        (a) any output ring holds a row, (b) the pool drains past `horizon`,
+        or (c) max_windows elapse (bounds dispatch length for the
+        accelerator watchdog). Returns (state, min_next, out_rows)."""
+        step = self.sim._step_fn
+        runahead = jnp.int64(self.sim.runahead)
+        prefixes = list(self._ring_prefixes)
+
+        def out_count(state):
+            br = state.subs[BRIDGE_SUB]
+            tot = jnp.zeros((), jnp.int32)
+            for p in prefixes:
+                tot = tot + jnp.sum(br[f"{p}count"], dtype=jnp.int32)
+            return tot
+
+        def run_sync(state, params, horizon, max_windows):
+            horizon = jnp.asarray(horizon, jnp.int64)
+            max_windows = jnp.asarray(max_windows, jnp.int32)
+
+            def cond(c):
+                state, mn, w = c
+                return (
+                    (out_count(state) == 0) & (mn < horizon)
+                    & (w < max_windows)
+                )
+
+            def body(c):
+                state, mn, w = c
+                we = jnp.minimum(mn + runahead, horizon)
+                state, mn2 = step(state, params, mn, we)
+                return state, mn2, w + 1
+
+            mn0 = jnp.min(state.pool.time)
+            state, mn, _ = jax.lax.while_loop(
+                cond, body, (state, mn0, jnp.int32(0))
+            )
+            return state, mn, out_count(state)
+
+        return run_sync
 
     # ------------------------------------------------------------------
     # device-side handlers
@@ -532,58 +582,95 @@ class DeviceNetBridge:
             ),
         )
 
+    _RING_FIELDS = {
+        "": ("time", "src_host", "src_port", "dst_port", "length", "handle"),
+        "e_": ("time", "slot", "peer_host", "peer_port", "local_port",
+               "accept"),
+        "r_": ("time", "slot", "bytes"),
+        "f_": ("time", "slot", "tw"),
+        "c_": ("time", "slot", "reset"),
+    }
+
     def _drain_ring(self) -> list:
-        br = jax.device_get(self.sim.state.subs[BRIDGE_SUB])
+        # Count-first drain: fetch only the [H] per-ring counts (one small
+        # transfer), then fetch ring columns SLICED to the max occupancy of
+        # rings that actually hold rows. The old whole-sub device_get moved
+        # H*R*~20 arrays over the tunnel every window — megabytes per
+        # round trip at 1k hosts — for a usually-empty ring.
+        br_state = self.sim.state.subs[BRIDGE_SUB]
+        fetched = jax.device_get(
+            {
+                **{p: br_state[f"{p}count"] for p in self._ring_prefixes},
+                "_overflow": br_state["overflow"],
+            }
+        )
+        overflow_now = int(np.asarray(fetched.pop("_overflow")))
+        counts = {p: np.asarray(v) for p, v in fetched.items()}
+        fetch = {}
+        for p in self._ring_prefixes:
+            cm = int(counts[p].max()) if counts[p].size else 0
+            if cm == 0:
+                continue
+            for name in self._RING_FIELDS[p]:
+                fetch[f"{p}{name}"] = br_state[f"{p}{name}"][:, :cm]
+        if not fetch:
+            return []
+        br = jax.device_get(fetch)
         out: list = []
-        counts = np.asarray(br["count"])
-        for h in np.where(counts > 0)[0]:
-            for c in range(counts[h]):
-                out.append(Delivery(
-                    time=int(br["time"][h, c]),
-                    dst_host=int(h),
-                    src_host=int(br["src_host"][h, c]),
-                    src_port=int(br["src_port"][h, c]),
-                    dst_port=int(br["dst_port"][h, c]),
-                    length=int(br["length"][h, c]),
-                    handle=int(br["handle"][h, c]),
-                ))
+        cnt = counts[""]
+        if "time" in br:
+            for h in np.where(cnt > 0)[0]:
+                for c in range(cnt[h]):
+                    out.append(Delivery(
+                        time=int(br["time"][h, c]),
+                        dst_host=int(h),
+                        src_host=int(br["src_host"][h, c]),
+                        src_port=int(br["src_port"][h, c]),
+                        dst_port=int(br["dst_port"][h, c]),
+                        length=int(br["length"][h, c]),
+                        handle=int(br["handle"][h, c]),
+                    ))
         ndel = len(out)
         if self.with_tcp:
-            ec = np.asarray(br["e_count"])
-            for h in np.where(ec > 0)[0]:
-                for c in range(ec[h]):
-                    out.append(TcpEstablished(
-                        time=int(br["e_time"][h, c]), host=int(h),
-                        slot=int(br["e_slot"][h, c]),
-                        peer_host=int(br["e_peer_host"][h, c]),
-                        peer_port=int(br["e_peer_port"][h, c]),
-                        local_port=int(br["e_local_port"][h, c]),
-                        is_accept=bool(br["e_accept"][h, c]),
-                    ))
-            rc = np.asarray(br["r_count"])
-            for h in np.where(rc > 0)[0]:
-                for c in range(rc[h]):
-                    out.append(TcpBytes(
-                        time=int(br["r_time"][h, c]), host=int(h),
-                        slot=int(br["r_slot"][h, c]),
-                        nbytes=int(br["r_bytes"][h, c]),
-                    ))
-            fc = np.asarray(br["f_count"])
-            for h in np.where(fc > 0)[0]:
-                for c in range(fc[h]):
-                    out.append(TcpFin(
-                        time=int(br["f_time"][h, c]), host=int(h),
-                        slot=int(br["f_slot"][h, c]),
-                        time_wait=bool(br["f_tw"][h, c]),
-                    ))
-            cc = np.asarray(br["c_count"])
-            for h in np.where(cc > 0)[0]:
-                for c in range(cc[h]):
-                    out.append(TcpClosed(
-                        time=int(br["c_time"][h, c]), host=int(h),
-                        slot=int(br["c_slot"][h, c]),
-                        reset=bool(br["c_reset"][h, c]),
-                    ))
+            if "e_time" in br:
+                ec = counts["e_"]
+                for h in np.where(ec > 0)[0]:
+                    for c in range(ec[h]):
+                        out.append(TcpEstablished(
+                            time=int(br["e_time"][h, c]), host=int(h),
+                            slot=int(br["e_slot"][h, c]),
+                            peer_host=int(br["e_peer_host"][h, c]),
+                            peer_port=int(br["e_peer_port"][h, c]),
+                            local_port=int(br["e_local_port"][h, c]),
+                            is_accept=bool(br["e_accept"][h, c]),
+                        ))
+            if "r_time" in br:
+                rc = counts["r_"]
+                for h in np.where(rc > 0)[0]:
+                    for c in range(rc[h]):
+                        out.append(TcpBytes(
+                            time=int(br["r_time"][h, c]), host=int(h),
+                            slot=int(br["r_slot"][h, c]),
+                            nbytes=int(br["r_bytes"][h, c]),
+                        ))
+            if "f_time" in br:
+                fc = counts["f_"]
+                for h in np.where(fc > 0)[0]:
+                    for c in range(fc[h]):
+                        out.append(TcpFin(
+                            time=int(br["f_time"][h, c]), host=int(h),
+                            slot=int(br["f_slot"][h, c]),
+                            time_wait=bool(br["f_tw"][h, c]),
+                        ))
+            if "c_time" in br:
+                cc = counts["c_"]
+                for h in np.where(cc > 0)[0]:
+                    for c in range(cc[h]):
+                        out.append(TcpClosed(
+                            time=int(br["c_time"][h, c]), host=int(h),
+                            slot=int(br["c_slot"][h, c]),
+                            reset=bool(br["c_reset"][h, c]),
+                        ))
         if not out:
             return []
         # reset all rings
@@ -598,7 +685,7 @@ class DeviceNetBridge:
             reset[f"{prefix}count"] = jnp.zeros((self.H,), jnp.int32)
         self.sim.state = self.sim.state.with_sub(BRIDGE_SUB, reset)
         self._inflight = max(0, self._inflight - ndel)
-        overflow = int(np.asarray(br["overflow"]))
+        overflow = overflow_now
         if overflow > self._overflow_seen:
             from shadow_tpu.utils import log
 
@@ -636,8 +723,20 @@ class DeviceNetBridge:
         evs = self._drain_ring()
         if evs:
             return evs
+        hz = min(horizon, self.sim.stop_time)
         while True:
-            min_next = int(jnp.min(self.sim.state.pool.time))
+            # ONE dispatch advances up to _sync_max_windows windows, exiting
+            # early when output lands (fused while_loop — the per-window
+            # dispatch + readback round trips were the managed plane's
+            # dominant wall cost at 1k processes)
+            self.sim.state, mn, nout = self._run_sync(
+                self.sim.state, self.sim.params, hz, self._sync_max_windows
+            )
+            if int(nout):
+                evs = self._drain_ring()
+                if evs:
+                    return evs
+            min_next = int(mn)
             if min_next >= NEVER:
                 # device fully drained: any UDP datagram still unaccounted
                 # was dropped on-device (loss/CoDel/no-socket) — reclaim its
@@ -646,13 +745,5 @@ class DeviceNetBridge:
                 self._handles.clear()
                 self._drained = True
                 return []
-            if min_next >= min(horizon, self.sim.stop_time):
+            if min_next >= hz:
                 return []
-            ws = min_next
-            we = min(ws + self.sim.runahead, horizon, self.sim.stop_time)
-            self.sim.state, _ = self.sim._step(
-                self.sim.state, self.sim.params, ws, we
-            )
-            evs = self._drain_ring()
-            if evs:
-                return evs

@@ -32,6 +32,7 @@ import errno
 import heapq
 import os
 import random
+import struct as struct_mod
 import subprocess
 import time as wall_time
 from collections import deque
@@ -86,10 +87,35 @@ SYS_epoll_create1 = 291
 SYS_dup3 = 292
 SYS_pipe2 = 293
 SYS_getrandom = 318
+SYS_rt_sigaction = 13
+SYS_rt_sigprocmask = 14
+SYS_socketpair = 53
+SYS_kill = 62
 
 EFD_SEMAPHORE = 0x1
 TFD_TIMER_ABSTIME = 0x1
 O_NONBLOCK_FLAG = 0o4000
+
+AF_UNIX = 1
+AF_INET = 2
+
+# virtual signal plane (reference: syscall/signal.c emulation)
+SIGINT = 2
+SIGKILL = 9
+SIGUSR1 = 10
+SIGUSR2 = 12
+SIGPIPE = 13
+SIGALRM = 14
+SIGTERM = 15
+SIGCHLD = 17
+SA_SIGINFO = 4
+# SIG_DFL disposition that ignores (POSIX: CHLD/URG/WINCH/CONT ignore)
+_SIG_DFL_IGNORE = {SIGCHLD, 18, 23, 28}
+# park kinds a signal may interrupt with EINTR (interruptible waits)
+_SIG_INTERRUPTIBLE = {
+    "recv", "read", "accept", "connect", "send", "sleep", "poll", "epoll",
+    "futex", "waitpid",
+}
 
 # sysno -> name for syscall-count reporting (built from the SYS_* constants
 # above plus the pseudo-syscalls)
@@ -102,6 +128,15 @@ SYSCALL_NAMES.update({
     ipc.PSYS_YIELD: "yield",
     ipc.PSYS_GETHOSTNAME: "gethostname",
 })
+
+
+def _wait_status(q) -> int:
+    """Linux wait-status word: signaled = sig in the low 7 bits; normal
+    exit = (code & 0xff) << 8 (the shim passes this through verbatim, so
+    WIFEXITED/WIFSIGNALED/WTERMSIG all work)."""
+    if q.killed_by_signal:
+        return q.killed_by_signal & 0x7F
+    return (int(q.exit_code or 0) & 0xFF) << 8
 
 
 def format_syscall_counts(counts: dict[int, int]) -> str:
@@ -152,6 +187,12 @@ class Sock:
     listening: bool = False
     accept_q: deque = field(default_factory=deque)  # Conn | BridgeEnd
     conn: "Conn | None" = None
+    # AF_UNIX (descriptor/channel.c + unix-socket analog): family marks the
+    # namespace; `pair` links datagram socketpair twins; `unix_path` is the
+    # bound filesystem name in the host-scoped unix namespace
+    family: int = 2  # AF_INET
+    pair: "Sock | None" = None
+    unix_path: str | None = None
     bend: "BridgeEnd | None" = None  # device-carried TCP endpoint
     dev_listen_slot: int | None = None  # device listener slot (bridge mode)
     connecting: bool = False
@@ -191,6 +232,7 @@ class Conn:
     remote_addr: tuple[int, int] | None = None
     local_addr: tuple[int, int] | None = None
     sock: "Sock | None" = None  # owning endpoint socket (None until accepted)
+    unix: bool = False  # AF_UNIX: zero-latency local delivery
 
 
 @dataclass
@@ -343,6 +385,7 @@ class ManagedThread:
         self.state = ManagedThread.PARKED
         self.parked: Parked | None = None
         self.pending: tuple[int, bytes] | None = None  # deferred reply
+        self.sig_mask = 0  # blocked virtual signals (rt_sigprocmask)
 
     def __getattr__(self, name):
         # only called for attributes NOT found on the thread itself
@@ -398,6 +441,11 @@ class ManagedProcess:
         self.parent: "ManagedProcess | None" = None
         self.native_pid: int | None = None
         self.wait_reported = False
+        # virtual signal plane (syscall/signal.c analog): signo ->
+        # (handler addr, sa_flags, sa_mask); pending queue in post order
+        self.sig_actions: dict[int, tuple[int, int, int]] = {}
+        self.sig_pending: list[int] = []
+        self.killed_by_signal: int | None = None
         # prior native images retired by exec respawns (outputs are
         # concatenated in finish(), preserving stdio continuity)
         self.old_popens: list = []
@@ -586,6 +634,8 @@ class ProcessDriver:
         # (ip, port) -> Sock, per protocol
         self._udp_binds: dict[tuple[int, int], Sock] = {}
         self._tcp_binds: dict[tuple[int, int], Sock] = {}
+        # AF_UNIX namespace, scoped per host: (host index, path) -> Sock
+        self._unix_binds: dict[tuple[int, str], Sock] = {}
         self._latency_fn: Callable[[int, int], int] | None = None
         self._reliability_fn: Callable[[int, int], float] | None = None
         self.bootstrap_end = 0  # sim ns: no drops before this (worker.c:536)
@@ -626,6 +676,23 @@ class ProcessDriver:
         # enabled via use_perf_timers, reported at exit with the counts
         self.use_perf_timers = False
         self.syscall_times: dict[int, float] = {}
+        # Runnable-process queue (reference analog: the worker pool's ready
+        # queues, logical_processor.rs:17-68): the service loop visits only
+        # processes with RUNNING/READY threads instead of scanning all N
+        # procs per quiescence round — the O(N)-scan retirement that makes
+        # 4k+ processes serviceable. Keyed by registration index so the
+        # service order stays deterministic (lowest index first).
+        self._runq_heap: list[int] = []
+        self._runq_set: dict[int, ManagedProcess] = {}
+        self._next_reg_idx = 0
+        # fd-waiter registry: id(watched object) -> (obj, [(thread, Parked)])
+        # — replaces the O(procs × fds) scan per wake (_wake_fd_waiters).
+        # Entries are registered at park time and lazily pruned.
+        self._fd_waiters: dict[int, tuple[object, list]] = {}
+        # wall-clock budget per plane, logged at exit: where a managed-plane
+        # second actually goes (service = syscall handling + channel waits,
+        # device = bridge dispatches/readbacks, events = heap callbacks)
+        self.plane_wall = {"service": 0.0, "device": 0.0, "events": 0.0}
 
     # ------------------------------------------------------------------
     # build API
@@ -654,8 +721,24 @@ class ProcessDriver:
             stdout_path=stdout_path, stderr_path=stderr_path,
         )
         host.procs.append(p)
+        p.reg_idx = self._next_reg_idx
+        self._next_reg_idx += 1
         self.procs.append(p)
         return p
+
+    def _register_proc(self, p: ManagedProcess) -> None:
+        """Register a runtime-created process (fork child) for scheduling."""
+        p.reg_idx = self._next_reg_idx
+        self._next_reg_idx += 1
+        self.procs.append(p)
+
+    def _mark_runnable(self, p) -> None:
+        """Queue p's process for the service loop (idempotent)."""
+        proc = p.proc if isinstance(p, ManagedThread) else p
+        idx = proc.reg_idx
+        if idx not in self._runq_set:
+            self._runq_set[idx] = proc
+            heapq.heappush(self._runq_heap, idx)
 
     def set_latency_fn(self, fn: Callable[[int, int], int]) -> None:
         """fn(src_ip, dst_ip) -> one-way latency ns (topology hook)."""
@@ -800,8 +883,8 @@ class ProcessDriver:
         if dead:
             q = dead[0]
             q.wait_reported = True
-            st = int(q.exit_code or 0) & 0xFF
-            done(q.native_pid or 0, data=st.to_bytes(4, "little"))
+            done(q.native_pid or 0,
+                 data=_wait_status(q).to_bytes(4, "little"))
         elif any(match(q) and q.alive() for q in kids):
             if nohang:
                 done(0)
@@ -878,7 +961,10 @@ class ProcessDriver:
         new_ch = ipc.Channel()
         nt = ManagedThread(p, 0, new_ch)
         nt.state = ManagedThread.RUNNING  # HELLO incoming from the spawn
+        nt.sig_mask = thread.sig_mask  # exec keeps the mask...
+        p.sig_actions.clear()  # ...but resets handlers to default (POSIX)
         p.threads = [nt]
+        self._mark_runnable(p)
         # exec semantics: the caller's envp REPLACES the environment; the
         # shim's own vars are forced on top so the new image is managed
         env = dict(kv.split("=", 1) for kv in envl if "=" in kv)
@@ -924,19 +1010,82 @@ class ProcessDriver:
             if (target in (-1, 0) or q.native_pid == target) and q.exited \
                     and not q.wait_reported:
                 q.wait_reported = True
-                st = int(q.exit_code or 0) & 0xFF
                 t.parked = None
                 self._resume(t, q.native_pid or 0,
-                             data=st.to_bytes(4, "little"))
+                             data=_wait_status(q).to_bytes(4, "little"))
                 return
 
     def _park(self, proc: ManagedProcess, pk: Parked) -> None:
         """Park proc's in-flight syscall on pk (no reply is sent until a
-        wake or deadline; syscall_condition.c analog)."""
+        wake or deadline; syscall_condition.c analog). fd-condition parks
+        register in the waiter registry so wakes are O(waiters), not
+        O(processes × fds)."""
         proc.parked = pk
         proc.state = ManagedProcess.PARKED
+        self._register_waiter(proc, pk)
         if pk.deadline is not None:
             self._schedule(pk.deadline, lambda: self._fire_deadline(proc, pk))
+
+    def _watch_objects(self, thread, pk: Parked) -> list:
+        """The fd objects whose state changes could satisfy pk."""
+        objs = []
+        if pk.kind in ("recv", "read", "accept", "connect", "send"):
+            o = thread.fds.get(pk.fd)
+            if o is not None:
+                objs.append(o)
+        elif pk.kind == "poll":
+            for fd, _ev in pk.pollset:
+                o = thread.fds.get(fd)
+                if o is not None:
+                    objs.append(o)
+        elif pk.kind == "epoll":
+            ep = thread.fds.get(pk.epfd)
+            if isinstance(ep, Epoll):
+                objs.append(ep)
+                for fd in ep.interest:
+                    o = thread.fds.get(fd)
+                    if o is not None:
+                        objs.append(o)
+        return objs
+
+    def _register_waiter(self, thread, pk: Parked) -> None:
+        for o in self._watch_objects(thread, pk):
+            ent = self._fd_waiters.get(id(o))
+            if ent is None:
+                self._fd_waiters[id(o)] = (o, [(thread, pk)])
+            else:
+                ent[1].append((thread, pk))
+
+    def _unregister_waiter(self, thread, pk: Parked) -> None:
+        """Drop pk's registry entries after a non-wake unpark (deadline,
+        signal EINTR, condition completion) so closed/idle objects don't
+        pin stale waiter lists for the rest of the run."""
+        for o in self._watch_objects(thread, pk):
+            ent = self._fd_waiters.get(id(o))
+            if ent is None:
+                continue
+            lst = [e for e in ent[1] if e[1] is not pk]
+            if lst:
+                self._fd_waiters[id(o)] = (ent[0], lst)
+            else:
+                del self._fd_waiters[id(o)]
+
+    def _epoll_interest_added(self, proc, ep: "Epoll", fd: int) -> None:
+        """EPOLL_CTL_ADD/MOD while sibling threads are parked on ep: extend
+        their waiter registrations to the newly watched object."""
+        ent = self._fd_waiters.get(id(ep))
+        if not ent:
+            return
+        o = proc.fds.get(fd)
+        if o is None:
+            return
+        for (t, pk) in ent[1]:
+            if t.parked is pk and pk.kind == "epoll":
+                e2 = self._fd_waiters.get(id(o))
+                if e2 is None:
+                    self._fd_waiters[id(o)] = (o, [(t, pk)])
+                elif (t, pk) not in e2[1]:
+                    e2[1].append((t, pk))
 
     def _bend_send(self, proc: ManagedProcess, end: "BridgeEnd",
                    chunk: bytes) -> int:
@@ -967,6 +1116,14 @@ class ProcessDriver:
         if proc.state != ManagedThread.PARKED or proc.parked is None:
             return
         pk = proc.parked
+        try:
+            self._try_wake_thread_inner(proc, pk)
+        finally:
+            if proc.parked is not pk:  # completed: purge registry entries
+                self._unregister_waiter(proc, pk)
+
+    def _try_wake_thread_inner(self, proc: ManagedThread,
+                               pk: Parked) -> None:
         if pk.kind == "recv":
             sock = proc.fds.get(pk.fd)
             if isinstance(sock, Sock) and sock.readable():
@@ -1038,6 +1195,7 @@ class ProcessDriver:
         if proc.state != ManagedProcess.PARKED or proc.parked is not pk:
             return  # already woken by data
         proc.parked = None
+        self._unregister_waiter(proc, pk)
         if pk.kind == "sleep":
             self._resume(proc, 0)
         elif pk.kind == "poll":
@@ -1052,6 +1210,115 @@ class ProcessDriver:
             self._resume(proc, -errno.ETIMEDOUT)
         elif pk.kind in ("recv", "accept", "connect"):
             self._resume(proc, -errno.ETIMEDOUT)
+
+    # ------------------------------------------------------------------
+    # virtual signal plane (reference: syscall/signal.c + process signal
+    # checks at resume points). Delivery is piggybacked on syscall replies:
+    # the shim runs the registered handler at the syscall boundary — a
+    # deterministic delivery point (no async interruption of app code).
+    # ------------------------------------------------------------------
+
+    def _next_signal(self, thread) -> tuple[int, int, int] | None:
+        """Pop the first pending, unblocked, handler-registered signal of
+        thread's process as a (signo, handler, flags) reply rider."""
+        p = thread.proc if isinstance(thread, ManagedThread) else thread
+        pend = p.sig_pending
+        if not pend:
+            return None
+        mask = getattr(thread, "sig_mask", 0)
+        for i, s in enumerate(pend):
+            if (mask >> (s - 1)) & 1:
+                continue  # blocked for this thread; stays pending
+            act = p.sig_actions.get(s)
+            if act is None or act[0] in (0, 1):
+                pend.pop(i)  # disposition changed since posting; drop
+                return self._next_signal(thread)
+            pend.pop(i)
+            flags = ipc.SIGF_SIGINFO if act[1] & SA_SIGINFO else 0
+            return (s, act[0], flags)
+        return None
+
+    def _post_signal(self, p: ManagedProcess, sig: int) -> None:
+        """Deliver signal `sig` to process p (kill(2) / SIGCHLD analog)."""
+        if not p.alive():
+            return
+        act = p.sig_actions.get(sig)
+        if sig == SIGKILL or act is None or act[0] == 0:  # SIG_DFL
+            if sig != SIGKILL and sig in _SIG_DFL_IGNORE:
+                return
+            # default disposition terminates at this sim time
+            self._schedule(self.now, lambda: self._signal_kill(p, sig))
+            return
+        if act[0] == 1:  # SIG_IGN
+            return
+        p.sig_pending.append(sig)
+        # interrupt the lowest-tid parked thread in an interruptible wait
+        # whose mask admits the signal; the EINTR completion's reply
+        # carries the handler invocation
+        for t in p.threads:
+            if (
+                t.state == ManagedThread.PARKED
+                and t.parked is not None
+                and t.parked.kind in _SIG_INTERRUPTIBLE
+                and not ((t.sig_mask >> (sig - 1)) & 1)
+            ):
+                pk = t.parked
+                t.parked = None
+                self._unregister_waiter(t, pk)
+                if pk.kind == "futex":
+                    q = p.futexes.get(pk.want)
+                    if q is not None and t in q:
+                        q.remove(t)
+                ret = -errno.EINTR
+                if pk.kind == "send" and pk.want > 0:
+                    ret = pk.want  # partial write already accepted
+                self._resume(t, ret)
+                break
+
+    def _signal_kill(self, p: ManagedProcess, sig: int) -> None:
+        """Terminate p by default signal disposition: release fds, stop the
+        native image (fork children included — MSG_STOP works on any parked
+        channel), record the signaled wait status, and notify the parent
+        (waitpid completion + SIGCHLD), exactly like a natural exit would."""
+        if not p.alive():
+            return
+        p.killed_by_signal = sig
+        self._release_fds(p)
+        stopped = False
+        for t in p.threads:
+            if t.state == ManagedThread.PARKED and t.channel and t.parked:
+                t.channel.reply(128 + sig, sim_time_ns=self.now,
+                                msg_type=ipc.MSG_STOP)
+                t.parked = None
+                stopped = True
+                break
+        for t in p.threads:
+            t.state = ManagedThread.EXITED
+        p.exited = True
+        p.exit_code = 128 + sig  # shell-style exit code; wait status is sig
+        if p.popen is not None:
+            if stopped:
+                try:
+                    p.popen.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    p.popen.terminate()
+            else:
+                p.popen.terminate()
+            p.stdout, p.stderr = p.finish()
+        if p.parent is not None:
+            for t in p.parent.threads:
+                self._try_complete_waitpid(t)
+            self._post_signal(p.parent, SIGCHLD)
+
+    def _proc_by_pid(self, caller, pid: int) -> ManagedProcess | None:
+        """Resolve a kill(2) target: 0 = self; otherwise match the native
+        pid recorded at HELLO (what fork returned to the app)."""
+        if pid in (0, caller.proc.native_pid):
+            return caller.proc
+        for q in self.procs:
+            if q.native_pid == pid and q.alive():
+                return q
+        return None
 
     def _resume(self, proc: ManagedThread, ret: int, data: bytes = b"") -> None:
         """Complete a previously-blocked syscall. If no other thread of the
@@ -1069,9 +1336,12 @@ class ProcessDriver:
         if running:
             proc.pending = (ret, data)
             proc.state = ManagedThread.READY
+            self._mark_runnable(proc)
             return
-        proc.channel.reply(ret, sim_time_ns=self.now, data=data)
+        proc.channel.reply(ret, sim_time_ns=self.now, data=data,
+                           signal=self._next_signal(proc))
         proc.state = ManagedThread.RUNNING
+        self._mark_runnable(proc)
 
     def _release_ready(self, p: ManagedProcess) -> ManagedThread | None:
         """If no thread of p is running, hand the run token to the lowest-
@@ -1086,8 +1356,10 @@ class ProcessDriver:
                 if t.channel is None:
                     t.state = ManagedThread.EXITED
                     continue
-                t.channel.reply(ret, sim_time_ns=self.now, data=data)
+                t.channel.reply(ret, sim_time_ns=self.now, data=data,
+                                signal=self._next_signal(t))
                 t.state = ManagedThread.RUNNING
+                self._mark_runnable(t)
                 return t
         return None
 
@@ -1103,9 +1375,11 @@ class ProcessDriver:
                     self._wake_fd_waiters(o)
 
     def _wake_fd_waiters(self, obj) -> None:
-        """Wake any thread parked on obj — fork children share open
-        descriptions with their parent, so EVERY process whose fd table
-        references the object must be scanned, not just the creator's."""
+        """Wake any thread parked on obj via the waiter registry (registered
+        at park time — fork children share open descriptions, so waiters may
+        belong to any process). O(registered waiters) instead of the old
+        O(processes × fds) scan; stale entries (already resumed) are pruned
+        lazily. Wake order is park order — deterministic."""
         try:
             obj.wake_seq = getattr(obj, "wake_seq", 0) + 1  # EPOLLET edges
         except AttributeError:
@@ -1113,13 +1387,19 @@ class ProcessDriver:
         owner = getattr(obj, "owner", None)
         if owner is not None:
             self._try_wake(owner)
-        for q in self.procs:
-            if not q.alive():
-                continue
-            if owner is not None and q is getattr(owner, "proc", owner):
-                continue
-            if any(o is obj for o in q.fds.values()):
-                self._try_wake(q)
+        ent = self._fd_waiters.get(id(obj))
+        if ent is None:
+            return
+        keep = []
+        for (t, pk) in ent[1]:
+            if t.parked is pk and t.state == ManagedThread.PARKED:
+                self._try_wake_thread(t)
+                if t.parked is pk and t.state == ManagedThread.PARKED:
+                    keep.append((t, pk))  # condition not satisfied yet
+        if keep:
+            self._fd_waiters[id(obj)] = (obj, keep)
+        else:
+            del self._fd_waiters[id(obj)]
 
     # ------------------------------------------------------------------
     # per-host tracking + pcap (tracker.c / pcap_writer.c analogs)
@@ -1325,7 +1605,8 @@ class ProcessDriver:
                     lambda: self._resume(proc, ret, data=data),
                 )
                 return
-            ch.reply(ret, sim_time_ns=self.now, data=data)
+            ch.reply(ret, sim_time_ns=self.now, data=data,
+                     signal=self._next_signal(proc))
 
         def park(pk: Parked) -> None:
             self._park(proc, pk)
@@ -1344,14 +1625,62 @@ class ProcessDriver:
                 return
             fd = proc.alloc_fd()
             sock = Sock(fd=fd, proto=stype, owner=proc,
+                        family=(AF_UNIX if a[0] == AF_UNIX else AF_INET),
                         nonblock=bool(a[1] & SOCK_NONBLOCK),
                         cloexec=bool(a[1] & 0o2000000))  # SOCK_CLOEXEC
             proc.fds[fd] = sock
             done(fd)
+        elif sysno == SYS_socketpair:
+            # AF_UNIX socketpair (reference: descriptor/channel.c legacy
+            # unix-socketpair analog): two connected endpoints, zero-latency
+            # local delivery. Streams link Conn twins; datagrams link via
+            # `pair`.
+            stype = a[1] & 0xFF
+            if stype not in (SOCK_STREAM, SOCK_DGRAM):
+                done(-errno.EPROTONOSUPPORT)
+                return
+            nb = bool(a[1] & SOCK_NONBLOCK)
+            cx = bool(a[1] & 0o2000000)  # SOCK_CLOEXEC
+            fd1 = proc.alloc_fd()
+            fd2 = proc.alloc_fd()
+            s1 = Sock(fd=fd1, proto=stype, owner=proc, family=AF_UNIX,
+                      nonblock=nb, cloexec=cx)
+            s2 = Sock(fd=fd2, proto=stype, owner=proc, family=AF_UNIX,
+                      nonblock=nb, cloexec=cx)
+            addr = (proc.host.ip, 0)
+            if stype == SOCK_STREAM:
+                c1 = Conn(established=True, local_addr=addr,
+                          remote_addr=addr, sock=s1, unix=True)
+                c2 = Conn(established=True, local_addr=addr,
+                          remote_addr=addr, sock=s2, unix=True)
+                c1.remote = c2
+                c2.remote = c1
+                s1.conn = c1
+                s2.conn = c2
+            else:
+                s1.pair = s2
+                s2.pair = s1
+            proc.fds[fd1] = s1
+            proc.fds[fd2] = s2
+            done(0, data=struct_mod.pack("<ii", fd1, fd2))
         elif sysno == SYS_bind:
             sock = proc.fds.get(a[0])
             if not isinstance(sock, Sock):
                 done(-errno.EBADF)
+                return
+            if sock.family == AF_UNIX:
+                path = ch.data.decode("utf-8", "replace")
+                if not path:
+                    done(-errno.EINVAL)
+                    return
+                key = (proc.host.index, path)
+                if key in self._unix_binds:
+                    done(-errno.EADDRINUSE)
+                    return
+                sock.unix_path = path
+                sock.bound = (proc.host.ip, 0)
+                self._unix_binds[key] = sock
+                done(0)
                 return
             ip, port = a[1], a[2]
             if ip == 0:  # INADDR_ANY -> this host's address
@@ -1378,6 +1707,13 @@ class ProcessDriver:
             if not isinstance(sock, Sock) or sock.proto != SOCK_STREAM:
                 done(-errno.EBADF)
                 return
+            if sock.family == AF_UNIX:
+                if sock.unix_path is None:
+                    done(-errno.EINVAL)  # autobind unsupported
+                    return
+                sock.listening = True
+                done(0)
+                return
             self._ensure_bound(proc, sock)
             if self._bridge_tcp() and sock.dev_listen_slot is None:
                 # install the device-side listener so remote SYNs demux
@@ -1392,6 +1728,29 @@ class ProcessDriver:
             sock = proc.fds.get(a[0])
             if not isinstance(sock, Sock):
                 done(-errno.EBADF)
+                return
+            if sock.family == AF_UNIX:
+                if sock.conn is not None:
+                    done(-errno.EISCONN)
+                    return
+                path = ch.data.decode("utf-8", "replace")
+                lst = self._unix_binds.get((proc.host.index, path))
+                if lst is None or not lst.listening:
+                    done(-errno.ECONNREFUSED)
+                    return
+                # unix connect completes once queued on the listener's
+                # backlog (zero latency; Linux semantics)
+                addr = (proc.host.ip, 0)
+                cc = Conn(established=True, local_addr=addr,
+                          remote_addr=addr, sock=sock, unix=True)
+                sc = Conn(established=True, local_addr=addr,
+                          remote_addr=addr, unix=True)
+                cc.remote = sc
+                sc.remote = cc
+                sock.conn = cc
+                lst.accept_q.append(sc)
+                self._wake_sock_waiters(lst)
+                done(0)
                 return
             ip, port = a[1], a[2]
             if ip == 0x7F000001:
@@ -1612,6 +1971,7 @@ class ProcessDriver:
             if op == EPOLL_CTL_ADD or op == EPOLL_CTL_MOD:
                 ep.interest[fd] = (events, data)
                 ep.reported_seq.pop(fd, None)
+                self._epoll_interest_added(proc, ep, fd)
                 done(0)
             elif op == EPOLL_CTL_DEL:
                 ep.interest.pop(fd, None)
@@ -1802,6 +2162,7 @@ class ProcessDriver:
             # will HELLO on its own channel; serviced once the spawner blocks
             t_new.state = ManagedThread.RUNNING
             proc.proc.threads.append(t_new)
+            self._mark_runnable(proc)
             done(0, data=ch_new.path.encode())
         elif sysno == ipc.PSYS_THREAD_EXIT:
             if a[1] == 2:
@@ -1833,10 +2194,13 @@ class ProcessDriver:
                 # _stop_process does — an exiting child must not leak its
                 # sockets for the rest of the run
                 self._release_fds(p)
-                # a parent parked in waitpid wakes NOW, at this sim time
+                # a parent parked in waitpid wakes NOW, at this sim time;
+                # then SIGCHLD posts (a completed waitpid's reply carries
+                # the handler; otherwise an interruptible park EINTRs)
                 if p.parent is not None:
                     for t in p.parent.threads:
                         self._try_complete_waitpid(t)
+                    self._post_signal(p.parent, SIGCHLD)
             else:
                 # reply directly (same deferred-reply hazard as above)
                 ch.reply(0, sim_time_ns=self.now)
@@ -1853,10 +2217,15 @@ class ProcessDriver:
             # (the other side just unlinks its fd) — see _dispatch close.
             child.fds = dict(p.fds)
             child.next_fd = p.next_fd
+            # fork inherits dispositions and the calling thread's mask;
+            # pending signals are NOT inherited (POSIX)
+            child.sig_actions = dict(p.sig_actions)
+            child.main.sig_mask = proc.sig_mask
             ch_new = ipc.Channel()
             child.main.channel = ch_new
             child.main.state = ManagedThread.RUNNING  # HELLO incoming
-            self.procs.append(child)
+            self._register_proc(child)
+            self._mark_runnable(child)
             done(0, data=ch_new.path.encode())
         elif sysno == ipc.PSYS_EXEC:
             self._exec_respawn(proc, ch.data, a[0])
@@ -1869,6 +2238,47 @@ class ProcessDriver:
             done(self._futex_wake(proc.proc, a[0], a[1]))
         elif sysno == ipc.PSYS_WAITPID:
             self._waitpid(proc, a[0], bool(a[1]), park, done)
+        # ---- virtual signals (syscall/signal.c analog) ----
+        elif sysno == SYS_rt_sigaction:
+            sig, handler, flags, mask = a[0], a[1], a[2], a[3]
+            if not (1 <= sig <= 64) or sig == SIGKILL:
+                done(-errno.EINVAL)
+                return
+            old = proc.proc.sig_actions.get(sig)
+            oldh, oldf = (old[0], old[1]) if old else (0, 0)
+            if a[4]:  # act present (null act = query only)
+                proc.proc.sig_actions[sig] = (handler, flags, mask)
+            done(0, data=struct_mod.pack(
+                "<QII", oldh & ((1 << 64) - 1), oldf & 0xFFFFFFFF, 0
+            ))
+        elif sysno == SYS_rt_sigprocmask:
+            how, mask = a[0], a[1] & ((1 << 64) - 1)
+            oldm = proc.sig_mask
+            if how == 0:  # SIG_BLOCK
+                proc.sig_mask |= mask
+            elif how == 1:  # SIG_UNBLOCK
+                proc.sig_mask &= ~mask
+            elif how == 2:  # SIG_SETMASK
+                proc.sig_mask = mask
+            elif how == 3:  # query only (null set)
+                pass
+            else:
+                done(-errno.EINVAL)
+                return
+            # the reply itself delivers any newly-unblocked pending signal
+            done(0, data=struct_mod.pack("<Q", oldm))
+        elif sysno == SYS_kill:
+            pid, sig = a[0], a[1]
+            target = self._proc_by_pid(proc, pid)
+            if target is None:
+                done(-errno.ESRCH)
+            elif sig == 0:
+                done(0)  # existence probe
+            elif not (1 <= sig <= 64):
+                done(-errno.EINVAL)
+            else:
+                self._post_signal(target, sig)
+                done(0)
         else:
             done(-errno.ENOSYS)
 
@@ -1882,6 +2292,15 @@ class ProcessDriver:
         n, has_addr, ip, port = a[1], a[3], a[4], a[5]
         payload = payload[:n]
         if sock.proto == SOCK_DGRAM:
+            if sock.pair is not None:
+                # datagram socketpair: zero-latency delivery to the twin
+                peer = sock.pair
+                peer.dgrams.append((proc.host.ip, 0, bytes(payload)))
+                self.counters["packets_sent"] += 1
+                self.counters["bytes_sent"] += len(payload)
+                self._wake_sock_waiters(peer)
+                ch.reply(len(payload), sim_time_ns=self.now)
+                return
             if has_addr:
                 dst = (ip if ip != 0x7F000001 else proc.host.ip, port)
             elif sock.peer is not None:
@@ -1971,7 +2390,10 @@ class ProcessDriver:
                 conn.remote_addr or (0, 0), payload, dropped=False,
             )
             if remote is not None:
-                lat = self._latency(proc.host.ip, conn.remote_addr[0])
+                lat = (
+                    0 if conn.unix
+                    else self._latency(proc.host.ip, conn.remote_addr[0])
+                )
                 data = bytes(payload)
                 self._schedule(
                     self.now + lat,
@@ -2187,7 +2609,13 @@ class ProcessDriver:
         self._wake_fd_waiters(tf)
 
     def _close_obj(self, obj) -> None:
+        self._fd_waiters.pop(id(obj), None)
         if isinstance(obj, Sock):
+            if obj.unix_path is not None:
+                key = (obj.owner.host.index, obj.unix_path)
+                if self._unix_binds.get(key) is obj:
+                    del self._unix_binds[key]
+                obj.unix_path = None
             if obj.bound is not None:
                 binds = (
                     self._udp_binds if obj.proto == SOCK_DGRAM
@@ -2260,6 +2688,7 @@ class ProcessDriver:
             host=proc.host.name,
         )
         proc.spawn(spin=self.spin, seccomp=self.use_seccomp)
+        self._mark_runnable(proc)
 
     def _stop_process(self, p: ManagedProcess) -> None:
         """Scheduled per-process stop (process.c:655-677 stop task analog):
@@ -2322,13 +2751,20 @@ class ProcessDriver:
             self._schedule(self.heartbeat_interval, beat)
 
         while True:
-            # 1. service running threads to quiescence (deterministic order:
-            # processes in registration order, threads by tid; deferred
-            # wakes release one thread per process at a time)
-            progressed = True
-            while progressed:
-                progressed = False
-                for p in self.procs:
+            # 1. service runnable processes to quiescence (deterministic:
+            # lowest registration index first; each process's threads by
+            # tid; deferred wakes release one thread per process at a time).
+            # Only processes with RUNNING/READY threads are visited — wakes
+            # re-queue their process via _mark_runnable.
+            t_svc = wall_time.perf_counter()
+            while self._runq_heap:
+                idx = heapq.heappop(self._runq_heap)
+                p = self._runq_set.pop(idx, None)
+                if p is None:
+                    continue
+                progressed = True
+                while progressed:
+                    progressed = False
                     for t in p.threads:
                         while t.state == ManagedThread.RUNNING and t.channel:
                             progressed = True
@@ -2336,10 +2772,12 @@ class ProcessDriver:
                                 break
                     if self._release_ready(p) is not None:
                         progressed = True
+            self.plane_wall["service"] += wall_time.perf_counter() - t_svc
 
             # 2. all quiescent: let the device network advance first — its
             # deliveries may precede our next local event (the CPU↔TPU sync
             # point; reference analog: the round barrier)
+            t_dev = wall_time.perf_counter()
             if self.bridge is not None:
                 horizon = self._heap[0][0] if self._heap else self.stop_time
                 # Endpoint-map bookkeeping happens HERE, in device-event
@@ -2412,17 +2850,21 @@ class ProcessDriver:
                             d.time, lambda d=d, e=end: self._bridge_closed(d, e)
                         )
 
+            self.plane_wall["device"] += wall_time.perf_counter() - t_dev
+
             if not self._heap:
                 break
             t, _, cb = heapq.heappop(self._heap)
             if t >= self.stop_time:
                 break
+            t_ev = wall_time.perf_counter()
             self.now = max(self.now, t)
             cb()
             # coalesce same-timestamp events before re-servicing
             while self._heap and self._heap[0][0] <= self.now:
                 t2, _, cb2 = heapq.heappop(self._heap)
                 cb2()
+            self.plane_wall["events"] += wall_time.perf_counter() - t_ev
 
             live = [p for p in self.procs if p.alive() and p.channel]
             if not live and not self._heap:
@@ -2458,6 +2900,13 @@ class ProcessDriver:
                 "perf timers (handler wall seconds): %s",
                 ", ".join(f"{k}={v:.4f}" for k, v in top),
             )
+        # wall budget per plane: where the managed-plane seconds went
+        log.logger.info(
+            "plane wall budget: service=%.1fs device=%.1fs events=%.1fs "
+            "(sim %.3fs)",
+            self.plane_wall["service"], self.plane_wall["device"],
+            self.plane_wall["events"], self.now / 1e9,
+        )
         # leak-style check (reference: alloc/dealloc counter mismatch
         # warning, manager.c:276-292): device TCP slots still held after
         # every process's fds are released indicate a recycling leak —

@@ -50,9 +50,14 @@ OFF_SYSNO = 80
 OFF_ARGS = 88
 OFF_RET = 136
 OFF_SIM_TIME = 144
-OFF_DATA_LEN = 152
-OFF_DATA = 160
+OFF_SIG_NO = 152
+OFF_SIG_FLAGS = 156
+OFF_SIG_HANDLER = 160
+OFF_DATA_LEN = 168
+OFF_DATA = 176
 CHANNEL_SIZE = OFF_DATA + IPC_DATA_MAX
+
+SIGF_SIGINFO = 1  # sig_flags bit: SA_SIGINFO-style 3-arg handler
 
 ENV_SHM = "SHADOW_TPU_SHM"
 ENV_SPIN = "SHADOW_TPU_SPIN"
@@ -127,13 +132,23 @@ class Channel:
         return self._mm[OFF_DATA:OFF_DATA + n]
 
     def reply(self, ret: int, *, sim_time_ns: int, data: bytes = b"",
-              msg_type: int = MSG_RESULT) -> None:
-        """Write the response and wake the shim."""
+              msg_type: int = MSG_RESULT,
+              signal: tuple[int, int, int] | None = None) -> None:
+        """Write the response and wake the shim. `signal` optionally
+        piggybacks one pending virtual signal as (signo, handler, flags) —
+        the shim runs the handler before returning from the syscall."""
         if len(data) > IPC_DATA_MAX:
             raise ValueError("reply data too large")
         struct.pack_into("<i", self._mm, OFF_TYPE, msg_type)
         struct.pack_into("<q", self._mm, OFF_RET, ret)
         struct.pack_into("<q", self._mm, OFF_SIM_TIME, sim_time_ns)
+        if signal is not None:
+            signo, handler, flags = signal
+            struct.pack_into("<i", self._mm, OFF_SIG_NO, signo)
+            struct.pack_into("<i", self._mm, OFF_SIG_FLAGS, flags)
+            struct.pack_into("<Q", self._mm, OFF_SIG_HANDLER, handler)
+        else:
+            struct.pack_into("<i", self._mm, OFF_SIG_NO, 0)
         struct.pack_into("<i", self._mm, OFF_DATA_LEN, len(data))
         if data:
             self._mm[OFF_DATA:OFF_DATA + len(data)] = data

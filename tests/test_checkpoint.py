@@ -9,6 +9,9 @@ from shadow_tpu.core import simtime
 from shadow_tpu.core.checkpoint import CheckpointError, load_meta
 from shadow_tpu.sim import build_simulation
 
+pytestmark = pytest.mark.quick
+
+
 YAML = """
 general:
   stop_time: 4

@@ -13,6 +13,9 @@ import pytest
 from shadow_tpu.__main__ import main
 from shadow_tpu.procs import build as build_mod
 
+pytestmark = pytest.mark.quick
+
+
 NS_PER_MS = 1_000_000
 
 PHOLD_YAML = """

@@ -3,6 +3,9 @@ import pytest
 from shadow_tpu.core import simtime
 from shadow_tpu.core.config import ConfigError, load_config
 
+pytestmark = pytest.mark.quick
+
+
 PHOLD_LIKE = """
 general:
   stop_time: 10

@@ -7,6 +7,7 @@ oracle and the vectorized engine must agree EXACTLY: same delivery counts,
 same drop counts, same per-host draw counters.
 """
 
+import pytest
 import heapq
 
 import jax
@@ -21,6 +22,9 @@ from shadow_tpu.core.state import (
     NetParams,
 )
 from shadow_tpu.net.apps import PholdApp
+
+pytestmark = pytest.mark.quick
+
 
 MS = simtime.NS_PER_MS
 SEC = simtime.NS_PER_SEC

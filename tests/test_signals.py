@@ -1,0 +1,61 @@
+"""Virtual signal plane + AF_UNIX sockets (VERDICT r2 ask #4).
+
+Reference analogs: syscall/signal.c (rt_sigaction / rt_sigprocmask / kill
+emulation, SIGCHLD on child exit), descriptor/channel.c and unix sockets,
+src/test/signal. Delivery is deterministic: handlers run at syscall
+boundaries (piggybacked on the reply), parked interruptible syscalls
+return EINTR, and dispositions/masks live in the driver.
+"""
+
+import pytest
+
+from shadow_tpu.procs import build as build_mod
+from shadow_tpu.procs.builder import build_process_driver
+
+pytestmark = pytest.mark.skipif(
+    not build_mod.toolchain_available(), reason="no native toolchain"
+)
+
+
+def _yaml(path, args=""):
+    arg_line = f"\n        args: {args}" if args else ""
+    return f"""
+general:
+  stop_time: 30 s
+  seed: 5
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        node [ id 0 bandwidth_down "100 Mbit" bandwidth_up "100 Mbit" ]
+        edge [ source 0 target 0 latency "10 ms" ]
+      ]
+hosts:
+  solo:
+    processes:
+      - path: {path}{arg_line}
+        start_time: 1 s
+"""
+
+
+def test_sigchld_socketpair_unix_event_loop(apps):
+    """The libevent shape: SIGCHLD handler + self-pipe socketpair + named
+    AF_UNIX listener + epoll event loop + waitpid reaping — all
+    deterministic under the virtual clock."""
+    def run_once():
+        d = build_process_driver(_yaml(apps["sigpair"]))
+        d.run()
+        p = d.procs[0]
+        assert p.exit_code == 0, (p.stdout, p.stderr)
+        return p.stdout
+
+    out = run_once()
+    lines = out.decode().splitlines()
+    assert lines == [
+        "got: hello-unix",
+        "reaped: pid-match=1 status=7",
+        "done",
+    ], lines
+    # byte-identical rerun (determinism gate)
+    assert run_once() == out

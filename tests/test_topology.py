@@ -6,6 +6,9 @@ from shadow_tpu.routing.dns import Dns
 from shadow_tpu.routing.gml import GmlParseError, parse_gml
 from shadow_tpu.routing.topology import Topology, TopologyError
 
+pytestmark = pytest.mark.quick
+
+
 SELF_LOOP = """
 graph [
   directed 0

@@ -2,6 +2,9 @@ from shadow_tpu.core import simtime, units
 
 import pytest
 
+pytestmark = pytest.mark.quick
+
+
 
 def test_time_parsing():
     assert units.parse_time_ns("50 ms") == 50 * simtime.NS_PER_MS
