@@ -63,6 +63,9 @@ enum PseudoSys : int64_t {
   PSYS_FUTEX_WAIT = -107,  // args: uaddr, timeout_ns (-1 none); ret 0/ETIMEDOUT
   PSYS_FUTEX_WAKE = -108,  // args: uaddr, n; ret = number woken
   PSYS_WAITPID = -109,     // args: pid (-1 any); ret = pid, data = i32 status
+  // handler-return notification: restores the pre-delivery signal mask
+  // (the delivery auto-blocked the signal + sa_mask, Linux semantics)
+  PSYS_SIG_RETURN = -110,
 };
 
 #pragma pack(push, 8)
@@ -124,6 +127,9 @@ constexpr const char* ENV_SPIN = "SHADOW_TPU_SPIN";   // spin iterations
 constexpr const char* ENV_DEBUG = "SHADOW_TPU_SHIM_DEBUG";
 constexpr const char* ENV_SECCOMP = "SHADOW_TPU_SECCOMP";  // "0" disables
 constexpr const char* ENV_VDSO = "SHADOW_TPU_VDSO";        // "0" disables patch
+// "1" prefixes each stdout/stderr line with the sim clock (reference
+// analog: shim_logger.c sim-time stamping inside the managed process)
+constexpr const char* ENV_LOG_STAMP = "SHADOW_TPU_LOG_STAMP";
 
 // emulated fd space starts here; lower fds (stdio, real files the process
 // opens itself) stay native. The reference instead virtualizes the entire

@@ -138,6 +138,13 @@ long sys_native(long n, Args... args) {
 Channel* g_ch = nullptr;  // process-primary channel (thread 0's)
 long g_spin = 8192;
 int g_debug = 0;
+int g_log_stamp = 0;  // ENV_LOG_STAMP: sim-time prefix on stdout/stderr lines
+// per-fd (stdout, stderr) at-beginning-of-line state for the stamper
+bool g_at_bol[2] = {true, true};
+// Never-cleared channel alias for sim-time reads: exit teardown nulls g_ch
+// (shim_notify_exit) BEFORE stdio flushes its buffers, and those flushed
+// lines still deserve stamps — the shm stays mapped for the process life.
+Channel* g_stamp_ch = nullptr;
 // Thread-local channel: every pthread_create'd thread gets its OWN shm
 // channel from the driver (reference analog: per-thread IPC blocks,
 // thread_preload.c:131-179). Threads without one (e.g. raw clone) share
@@ -237,6 +244,10 @@ int64_t ipc_call(int64_t sysno, const int64_t args[6], const void* data_in,
     } else {
       ((void (*)(int))sig_handler)(sig_no);
     }
+    // handler done: restore the pre-delivery mask (driver auto-blocked the
+    // signal + sa_mask for the handler's duration — Linux semantics). The
+    // return reply may itself carry the NEXT now-unblocked pending signal.
+    ipc_call(PSYS_SIG_RETURN, nullptr, nullptr, 0, nullptr, 0, nullptr);
   }
   if (mtype == MSG_DO_NATIVE) {
     return sys_native((long)sysno, args[0], args[1], args[2], args[3],
@@ -286,6 +297,8 @@ __attribute__((constructor)) void shim_init() {
   const char* spin = getenv(ENV_SPIN);
   if (spin) g_spin = atol(spin);
   g_debug = getenv(ENV_DEBUG) != nullptr;
+  const char* stamp = getenv(ENV_LOG_STAMP);
+  g_log_stamp = stamp && strcmp(stamp, "0") != 0;
   int fd = open(path, O_RDWR);
   if (fd < 0) {
     fprintf(stderr, "shadow-tpu-shim: cannot open %s: %s\n", path,
@@ -300,6 +313,7 @@ __attribute__((constructor)) void shim_init() {
     return;
   }
   g_ch = (Channel*)p;
+  g_stamp_ch = g_ch;
   t_ch = g_ch;  // the main thread owns the primary channel
   g_ch->shim_pid = getpid();
   SHIM_LOG("attached, channel=%s", path);
@@ -539,8 +553,14 @@ int pthread_sigmask(int how, const sigset_t* set, sigset_t* old) {
 }
 
 int kill(pid_t pid, int sig) {
-  if (!g_ch || pid <= 0 || (sig != 0 && !is_virt_sig(sig)))
+  if (!g_ch || (sig != 0 && !is_virt_sig(sig)))
     return (int)sys_native(SYS_kill, pid, sig);
+  // Group/broadcast kills MUST stay virtual: the managed process shares
+  // the driver's real process group, so a native kill(0)/kill(-1) would
+  // signal the simulator itself. Wire encoding: arg2=1 marks a group kill
+  // (pid 0 = caller's lineage, -1 = all managed, -g = group of leader g).
+  if (pid <= 0)
+    return (int)ipc_call6(SYS_kill, pid == -1 ? -1 : -pid, sig, 1);
   return (int)ipc_call6(SYS_kill, pid == getpid() ? 0 : pid, sig);
 }
 
@@ -635,8 +655,61 @@ ssize_t read(int fd, void* buf, size_t n) {
   return (ssize_t)r;
 }
 
+// Sim-time line stamping for stdout/stderr (reference analog:
+// shim_logger.c — managed-process log lines carry the SIMULATED clock, not
+// wall time). The stamp is the channel's last-reply sim_time_ns: every
+// syscall reply refreshes it, so a line printed between syscalls carries
+// the time of the preceding syscall boundary — the same resolution the
+// reference gets from its start-offset + emulated clock. Prefix format
+// matches the driver's log lines (utils/log.py _fmt_time).
+ssize_t stamped_write(int fd, const uint8_t* buf, size_t n) {
+  Channel* c = cur_channel();
+  if (!c) c = g_stamp_ch;
+  int64_t ns = c ? c->sim_time_ns : 0;
+  char pfx[40];
+  int64_t us = ns / 1000;
+  int64_t s = us / 1000000;
+  int plen = snprintf(pfx, sizeof(pfx),
+                      "%02lld:%02lld:%02lld.%06lld [stdio] ",
+                      (long long)(s / 3600), (long long)(s / 60 % 60),
+                      (long long)(s % 60), (long long)(us % 1000000));
+  bool* bol = &g_at_bol[fd == 2 ? 1 : 0];
+  // full-write helper: stdio treats a successful flush as all-or-nothing,
+  // so retry short counts (pipe backpressure) until done or hard error
+  auto write_all = [fd](const void* p, size_t len) -> bool {
+    size_t off = 0;
+    while (off < len) {
+      ssize_t w = sys_native(SYS_write, fd, (const uint8_t*)p + off,
+                             len - off);
+      if (w < 0) {
+        if (errno == EINTR) continue;
+        return false;
+      }
+      off += (size_t)w;
+    }
+    return true;
+  };
+  size_t i = 0;
+  while (i < n) {
+    size_t j = i;
+    while (j < n && buf[j] != '\n') j++;
+    bool nl = j < n;
+    if (*bol && (j > i || nl))
+      if (!write_all(pfx, (size_t)plen)) return (i == 0) ? -1 : (ssize_t)i;
+    size_t seg = (nl ? j + 1 : j) - i;
+    if (seg && !write_all(buf + i, seg)) return (i == 0) ? -1 : (ssize_t)i;
+    *bol = nl;
+    i += seg ? seg : 1;
+  }
+  return (ssize_t)n;
+}
+
 ssize_t write(int fd, const void* buf, size_t n) {
-  if (!is_managed_fd(fd)) return sys_native(SYS_write, fd, buf, n);
+  if (!is_managed_fd(fd)) {
+    if (g_log_stamp && g_stamp_ch && (fd == 1 || fd == 2) && n)
+      return stamped_write(fd, (const uint8_t*)buf, n);
+    return sys_native(SYS_write, fd, buf, n);
+  }
   if (n > IPC_DATA_MAX) n = IPC_DATA_MAX;  // caller loops for the rest
   int64_t args[6] = {fd, (int64_t)n, 0, 0, 0, 0};
   return (ssize_t)ipc_call(SYS_write, args, buf, (uint32_t)n, nullptr, 0,
@@ -663,7 +736,21 @@ ssize_t readv(int fd, const struct iovec* iov, int iovcnt) {
 }
 
 ssize_t writev(int fd, const struct iovec* iov, int iovcnt) {
-  if (!is_managed_fd(fd)) return sys_native(SYS_writev, fd, iov, iovcnt);
+  if (!is_managed_fd(fd)) {
+    if (g_log_stamp && g_stamp_ch && (fd == 1 || fd == 2)) {
+      ssize_t total = 0;
+      for (int i = 0; i < iovcnt; i++) {
+        if (!iov[i].iov_len) continue;
+        ssize_t w =
+            stamped_write(fd, (const uint8_t*)iov[i].iov_base, iov[i].iov_len);
+        if (w < 0) return total ? total : w;
+        total += w;
+        if ((size_t)w < iov[i].iov_len) break;
+      }
+      return total;
+    }
+    return sys_native(SYS_writev, fd, iov, iovcnt);
+  }
   static thread_local uint8_t tmp[IPC_DATA_MAX];
   size_t n = 0;
   for (int i = 0; i < iovcnt; i++) {
@@ -2029,15 +2116,20 @@ void shim_install_seccomp() {
 
   constexpr int K = (int)(sizeof(kTrapped) / sizeof(kTrapped[0]));
   // layout: [arch check][gate IP window check][ld nr]
-  //         [K dispatch jeqs → TRAP / FD0 / FD01] [fallthrough ALLOW]
+  //         [K dispatch jeqs → TRAP / FD0 / FD01 / STDIO] [fallthrough ALLOW]
   //         FD0: ld args[0]; >= FD_BASE ? TRAP : ALLOW
   //         FD01: ld args[0]; >= FD_BASE ? TRAP : ld args[1]; ...
+  //         STDIO (write/writev when log stamping): trap the emulated fd
+  //           range AND fds 1-2, so stdio writes that never cross the libc
+  //           PLT (glibc stdio issues the syscall internally) still reach
+  //           the stamping wrapper via SIGSYS
   //         ALLOW / TRAP / KILL returns
   const int NR = 7;
   const int DISPATCH0 = 8;
   const int FD0 = DISPATCH0 + K + 1;   // after dispatch + fallthrough ALLOW
   const int FD01 = FD0 + 2;
-  const int ALLOW = FD01 + 4;
+  const int STDIO = FD01 + 4;
+  const int ALLOW = STDIO + 4;
   const int TRAP = ALLOW + 1;
   const int KILL = TRAP + 1;
   struct sock_filter prog[KILL + 1];
@@ -2066,6 +2158,9 @@ void shim_install_seccomp() {
     int target = kTrapped[k].act == ACT_TRAP   ? TRAP
                  : kTrapped[k].act == ACT_FD0  ? FD0
                                                : FD01;
+    if (g_log_stamp &&
+        (kTrapped[k].nr == SYS_write || kTrapped[k].nr == SYS_writev))
+      target = STDIO;
     prog[i] = BPF_JUMP(BPF_JMP | BPF_JEQ | BPF_K,
                        (uint32_t)kTrapped[k].nr,
                        (uint8_t)(target - (i + 1)), 0);
@@ -2089,6 +2184,19 @@ void shim_install_seccomp() {
   prog[i] = BPF_JUMP(BPF_JMP | BPF_JGE | BPF_K, (uint32_t)FD_BASE,
                      (uint8_t)(TRAP - (FD01 + 4)),
                      (uint8_t)(ALLOW - (FD01 + 4)));
+  i++;
+  // STDIO: fd >= FD_BASE → TRAP; fd >= 3 → ALLOW; fd >= 1 (1 or 2) → TRAP;
+  // fd 0 → ALLOW
+  prog[i++] = BPF_STMT(BPF_LD | BPF_W | BPF_ABS, ARG0_LO);
+  prog[i] = BPF_JUMP(BPF_JMP | BPF_JGE | BPF_K, (uint32_t)FD_BASE,
+                     (uint8_t)(TRAP - (STDIO + 2)), 0);
+  i++;
+  prog[i] = BPF_JUMP(BPF_JMP | BPF_JGE | BPF_K, 3,
+                     (uint8_t)(ALLOW - (STDIO + 3)), 0);
+  i++;
+  prog[i] = BPF_JUMP(BPF_JMP | BPF_JGE | BPF_K, 1,
+                     (uint8_t)(TRAP - (STDIO + 4)),
+                     (uint8_t)(ALLOW - (STDIO + 4)));
   i++;
   prog[i++] = BPF_STMT(BPF_RET | BPF_K, SECCOMP_RET_ALLOW);
   prog[i++] = BPF_STMT(BPF_RET | BPF_K, SECCOMP_RET_TRAP);

@@ -154,6 +154,10 @@ class ExperimentalOptions:
     router_queue_variant: str = "codel"
     # per-syscall-handler wall timing (-DUSE_PERF_TIMERS analog, setup:76-79)
     use_perf_timers: bool = False
+    # shim-side sim-time stamping of managed stdout/stderr lines
+    # (shim_logger.c analog; off by default so app output stays byte-exact
+    # for the determinism comparisons)
+    use_shim_log_stamps: bool = False
     devices: int = 1  # mesh size over the host axis
     inbox_slots: int = 8  # B: per-host intra-window self-event slots
     outbox_slots: int = 64  # O: per-host emission slots per window
@@ -217,6 +221,8 @@ class ExperimentalOptions:
                 setattr(out, name, int(d[name]))
         if "use_perf_timers" in d:
             out.use_perf_timers = bool(d["use_perf_timers"])
+        if "use_shim_log_stamps" in d:
+            out.use_shim_log_stamps = bool(d["use_shim_log_stamps"])
         if "router_queue_variant" in d:
             v = str(d["router_queue_variant"]).lower()
             if v not in ("codel", "static", "single"):

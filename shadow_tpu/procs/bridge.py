@@ -582,14 +582,24 @@ class DeviceNetBridge:
             ),
         )
 
-    _RING_FIELDS = {
-        "": ("time", "src_host", "src_port", "dst_port", "length", "handle"),
-        "e_": ("time", "slot", "peer_host", "peer_port", "local_port",
-               "accept"),
-        "r_": ("time", "slot", "bytes"),
-        "f_": ("time", "slot", "tw"),
-        "c_": ("time", "slot", "reset"),
-    }
+    def _ring_fields(self, prefix: str) -> list[str]:
+        """Column names of one ring, derived from the sub-state keys so the
+        drain can never silently miss a column added to the schema above.
+        A key belongs to ring `prefix` iff it starts with it, the remainder
+        has no further ring prefix, and it isn't the count/overflow scalar."""
+        br = self.sim.state.subs[BRIDGE_SUB]
+        others = [p for p in self._ring_prefixes if p]
+        out = []
+        for k in br:
+            if not k.startswith(prefix):
+                continue
+            rest = k[len(prefix):]
+            if prefix == "" and any(k.startswith(o) for o in others):
+                continue
+            if rest in ("count", "overflow"):
+                continue
+            out.append(rest)
+        return out
 
     def _drain_ring(self) -> list:
         # Count-first drain: fetch only the [H] per-ring counts (one small
@@ -611,7 +621,7 @@ class DeviceNetBridge:
             cm = int(counts[p].max()) if counts[p].size else 0
             if cm == 0:
                 continue
-            for name in self._RING_FIELDS[p]:
+            for name in self._ring_fields(p):
                 fetch[f"{p}{name}"] = br_state[f"{p}{name}"][:, :cm]
         if not fetch:
             return []

@@ -61,6 +61,7 @@ def build_process_driver(
     driver.use_seccomp = cfg.experimental.use_seccomp
     driver.socket_send_buffer = cfg.experimental.socket_send_buffer
     driver.use_perf_timers = cfg.experimental.use_perf_timers
+    driver.log_stamp = cfg.experimental.use_shim_log_stamps
     driver.cpu_ns_per_syscall = cfg.experimental.cpu_ns_per_syscall
     driver.cpu_threshold_ns = cfg.experimental.max_unapplied_cpu_latency
 

@@ -109,6 +109,7 @@ SIGALRM = 14
 SIGTERM = 15
 SIGCHLD = 17
 SA_SIGINFO = 4
+SA_NODEFER = 0x40000000
 # SIG_DFL disposition that ignores (POSIX: CHLD/URG/WINCH/CONT ignore)
 _SIG_DFL_IGNORE = {SIGCHLD, 18, 23, 28}
 # park kinds a signal may interrupt with EINTR (interruptible waits)
@@ -386,6 +387,10 @@ class ManagedThread:
         self.parked: Parked | None = None
         self.pending: tuple[int, bytes] | None = None  # deferred reply
         self.sig_mask = 0  # blocked virtual signals (rt_sigprocmask)
+        # saved masks for in-flight handler invocations: delivery blocks
+        # the signal (plus sa_mask) for the handler's duration, restored by
+        # PSYS_SIG_RETURN — Linux's auto-block-during-handler semantics
+        self.sig_mask_stack: list[int] = []
 
     def __getattr__(self, name):
         # only called for attributes NOT found on the thread itself
@@ -476,13 +481,18 @@ class ManagedProcess:
     def parked(self, v):
         self.main.parked = v
 
-    def spawn(self, spin: int = 4096, seccomp: bool = True) -> None:
+    def spawn(self, spin: int = 4096, seccomp: bool = True,
+              log_stamp: bool = False) -> None:
         self.main.channel = ipc.Channel()
         env = dict(os.environ)
         env["LD_PRELOAD"] = str(build_mod.shim_path())
         env[ipc.ENV_SHM] = self.main.channel.path
         env[ipc.ENV_SPIN] = str(spin)
         env[ipc.ENV_SECCOMP] = "1" if seccomp else "0"
+        if log_stamp:
+            # shim stamps stdout/stderr lines with the sim clock
+            # (shim_logger.c analog)
+            env[ipc.ENV_LOG_STAMP] = "1"
         env.update(self.extra_env)
         if self.stdout_path is not None:
             out_f = open(self.stdout_path, "wb")
@@ -509,22 +519,29 @@ class ManagedProcess:
     def alive(self) -> bool:
         return not self.exited
 
+    @staticmethod
+    def _communicate(op, timeout: float) -> tuple[bytes, bytes]:
+        """Bounded output collection. The post-kill retry must stay bounded
+        too: killing `op` does not close pipe fds inherited by its fork
+        children, so an unconditional communicate() can wait on EOF forever
+        while a descendant lives."""
+        try:
+            return op.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            op.kill()
+            try:
+                return op.communicate(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                return b"", b""
+
     def finish(self) -> tuple[bytes, bytes]:
         out, err = b"", b""
         for op in self.old_popens:
-            try:
-                o2, e2 = op.communicate(timeout=5)
-            except subprocess.TimeoutExpired:
-                op.kill()
-                o2, e2 = op.communicate()
+            o2, e2 = self._communicate(op, 5)
             out += o2 or b""
             err += e2 or b""
         if self.popen:
-            try:
-                o2, e2 = self.popen.communicate(timeout=10)
-            except subprocess.TimeoutExpired:
-                self.popen.kill()
-                o2, e2 = self.popen.communicate()
+            o2, e2 = self._communicate(self.popen, 10)
             out += o2 or b""
             err += e2 or b""
             self.exit_code = self.popen.returncode
@@ -623,6 +640,10 @@ class ProcessDriver:
         # configuration.rs:247-250 analog): catches raw syscall
         # instructions that bypass the interposed libc symbols
         self.use_seccomp = True
+        # shim-side sim-time stamping of managed stdout/stderr lines
+        # (shim_logger.c analog; off by default — byte-exact app output is
+        # what the determinism tests compare)
+        self.log_stamp = False
         self.service_timeout_s = service_timeout_s
         self.now = 0
         self.hosts: list[SimHost] = []
@@ -972,6 +993,8 @@ class ProcessDriver:
         env[ipc.ENV_SHM] = new_ch.path
         env.setdefault(ipc.ENV_SPIN, str(self.spin))
         env[ipc.ENV_SECCOMP] = "1" if self.use_seccomp else "0"
+        if self.log_stamp:
+            env[ipc.ENV_LOG_STAMP] = "1"
         if p.stdout_path is not None:
             out_f = open(p.stdout_path, "ab")
             err_f = open(p.stderr_path, "ab")
@@ -1230,11 +1253,27 @@ class ProcessDriver:
             if (mask >> (s - 1)) & 1:
                 continue  # blocked for this thread; stays pending
             act = p.sig_actions.get(s)
-            if act is None or act[0] in (0, 1):
-                pend.pop(i)  # disposition changed since posting; drop
+            if act is None or act[0] == 0:
+                # Disposition reset to SIG_DFL after posting: POSIX delivers
+                # under the CURRENT disposition — apply the default action
+                # (terminate unless default-ignore), don't drop.
+                pend.pop(i)
+                if s not in _SIG_DFL_IGNORE:
+                    self._schedule(self.now, lambda: self._signal_kill(p, s))
+                return self._next_signal(thread)
+            if act[0] == 1:  # SIG_IGN since posting: discard
+                pend.pop(i)
                 return self._next_signal(thread)
             pend.pop(i)
             flags = ipc.SIGF_SIGINFO if act[1] & SA_SIGINFO else 0
+            # Auto-block during the handler (Linux semantics): the signal
+            # itself (unless SA_NODEFER) plus the action's sa_mask are
+            # blocked until the shim's PSYS_SIG_RETURN restores the mask.
+            if isinstance(thread, ManagedThread):
+                thread.sig_mask_stack.append(thread.sig_mask)
+                thread.sig_mask |= act[2]
+                if not act[1] & SA_NODEFER:
+                    thread.sig_mask |= 1 << (s - 1)
             return (s, act[0], flags)
         return None
 
@@ -1251,6 +1290,8 @@ class ProcessDriver:
             return
         if act[0] == 1:  # SIG_IGN
             return
+        if sig in p.sig_pending:
+            return  # standard signals don't queue: already-pending collapses
         p.sig_pending.append(sig)
         # interrupt the lowest-tid parked thread in an interruptible wait
         # whose mask admits the signal; the EINTR completion's reply
@@ -2238,6 +2279,13 @@ class ProcessDriver:
             done(self._futex_wake(proc.proc, a[0], a[1]))
         elif sysno == ipc.PSYS_WAITPID:
             self._waitpid(proc, a[0], bool(a[1]), park, done)
+        elif sysno == ipc.PSYS_SIG_RETURN:
+            # handler finished: restore the pre-delivery mask (delivery
+            # pushed it in _next_signal); the done() reply may itself carry
+            # the next now-unblocked pending signal
+            if proc.sig_mask_stack:
+                proc.sig_mask = proc.sig_mask_stack.pop()
+            done(0)
         # ---- virtual signals (syscall/signal.c analog) ----
         elif sysno == SYS_rt_sigaction:
             sig, handler, flags, mask = a[0], a[1], a[2], a[3]
@@ -2268,14 +2316,43 @@ class ProcessDriver:
             # the reply itself delivers any newly-unblocked pending signal
             done(0, data=struct_mod.pack("<Q", oldm))
         elif sysno == SYS_kill:
-            pid, sig = a[0], a[1]
+            pid, sig, group = a[0], a[1], a[2]
+            if sig != 0 and not (1 <= sig <= 64):
+                done(-errno.EINVAL)
+                return
+            if group:
+                # Group/broadcast kill, kept VIRTUAL (a native kill(0)
+                # would signal the simulator's own process group). Process
+                # groups are modeled as fork lineages: pid 0 = caller's
+                # lineage, -1 = every managed process except the caller,
+                # g = the lineage containing native pid g.
+                if pid == -1:
+                    targets = [q for q in self.procs
+                               if q.alive() and q is not proc.proc]
+                else:
+                    leader = self._proc_by_pid(proc, pid)
+                    if leader is None:
+                        done(-errno.ESRCH)
+                        return
+
+                    def root(q):
+                        while q.parent is not None:
+                            q = q.parent
+                        return q
+
+                    r = root(leader)
+                    targets = [q for q in self.procs
+                               if q.alive() and root(q) is r]
+                if sig != 0:
+                    for q in targets:
+                        self._post_signal(q, sig)
+                done(0)
+                return
             target = self._proc_by_pid(proc, pid)
             if target is None:
                 done(-errno.ESRCH)
             elif sig == 0:
                 done(0)  # existence probe
-            elif not (1 <= sig <= 64):
-                done(-errno.EINVAL)
             else:
                 self._post_signal(target, sig)
                 done(0)
@@ -2687,7 +2764,8 @@ class ProcessDriver:
             "starting process %s: %s", proc.name, " ".join(proc.args),
             host=proc.host.name,
         )
-        proc.spawn(spin=self.spin, seccomp=self.use_seccomp)
+        proc.spawn(spin=self.spin, seccomp=self.use_seccomp,
+                   log_stamp=self.log_stamp)
         self._mark_runnable(proc)
 
     def _stop_process(self, p: ManagedProcess) -> None:
@@ -2870,13 +2948,17 @@ class ProcessDriver:
             if not live and not self._heap:
                 break
 
-        # teardown: stop anything still alive, collect output
+        # teardown: stop EVERYTHING still alive first, THEN collect output.
+        # Collection order matters: a fork child inherits its parent's
+        # stdout pipe fd, so finish() (communicate → EOF wait) on the
+        # parent deadlocks while any descendant lives.
         for p in self.procs:
             for t in p.threads:
                 if t.state == ManagedThread.PARKED and t.channel:
                     t.channel.reply(0, sim_time_ns=self.now,
                                     msg_type=ipc.MSG_STOP)
                     break
+        for p in self.procs:
             if p.channel:
                 p.stdout, p.stderr = p.finish()
             elif not hasattr(p, "stdout"):

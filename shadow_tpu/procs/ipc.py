@@ -37,6 +37,7 @@ PSYS_EXEC = -106
 PSYS_FUTEX_WAIT = -107
 PSYS_FUTEX_WAKE = -108
 PSYS_WAITPID = -109
+PSYS_SIG_RETURN = -110  # handler finished: restore pre-delivery sig mask
 
 FD_BASE = 1000
 
@@ -63,6 +64,7 @@ ENV_SHM = "SHADOW_TPU_SHM"
 ENV_SPIN = "SHADOW_TPU_SPIN"
 ENV_DEBUG = "SHADOW_TPU_SHIM_DEBUG"
 ENV_SECCOMP = "SHADOW_TPU_SECCOMP"  # "0" disables the SIGSYS backstop
+ENV_LOG_STAMP = "SHADOW_TPU_LOG_STAMP"  # "1": sim-time stdout/stderr stamps
 
 _libpthread = ctypes.CDLL(None, use_errno=True)  # glibc hosts sem_* now
 

@@ -1,0 +1,104 @@
+/* Signal-semantics probes for the virtual signal plane (one mode per run):
+ *
+ *   reenter    — a handler that re-raises its own signal must NOT nest:
+ *                delivery auto-blocks the signo until the handler returns
+ *                (Linux sigaction semantics); the second delivery runs
+ *                after, so max observed depth stays 1.
+ *   groupkill  — kill(0, SIGTERM) signals the fork lineage VIRTUALLY: the
+ *                parent's handler runs, the handler-less child dies with
+ *                the default disposition; a native escape would kill the
+ *                test harness itself.
+ *   dflpending — a signal left pending while blocked, then reset to
+ *                SIG_DFL and unblocked, applies the CURRENT (default,
+ *                terminating) disposition — the process must die.
+ *
+ * Reference analogs: syscall/signal.c, shim.c signal handling,
+ * src/test/signal.
+ */
+#include <signal.h>
+#include <stdio.h>
+#include <string.h>
+#include <sys/wait.h>
+#include <time.h>
+#include <unistd.h>
+
+static volatile int depth = 0, maxdepth = 0, runs = 0;
+
+static void msleep(long ms) {
+  struct timespec ts = {ms / 1000, (ms % 1000) * 1000000L};
+  nanosleep(&ts, NULL);
+}
+
+static void on_usr1(int sig) {
+  (void)sig;
+  depth++;
+  if (depth > maxdepth) maxdepth = depth;
+  runs++;
+  if (runs == 1) raise(SIGUSR1); /* must defer, not nest */
+  msleep(5);                     /* a syscall inside the handler: its reply
+                                  * must not re-enter us with the same signo */
+  depth--;
+}
+
+static void on_term(int sig) {
+  (void)sig;
+  const char m[] = "parent-term\n";
+  write(1, m, sizeof(m) - 1);
+}
+
+int main(int argc, char** argv) {
+  setvbuf(stdout, NULL, _IONBF, 0);
+  const char* mode = argc > 1 ? argv[1] : "reenter";
+
+  if (strcmp(mode, "reenter") == 0) {
+    struct sigaction sa;
+    memset(&sa, 0, sizeof(sa));
+    sa.sa_handler = on_usr1;
+    sigaction(SIGUSR1, &sa, NULL);
+    raise(SIGUSR1);
+    msleep(50); /* syscall boundary so the deferred delivery lands */
+    printf("runs=%d maxdepth=%d\n", runs, maxdepth);
+    return 0;
+  }
+
+  if (strcmp(mode, "groupkill") == 0) {
+    struct sigaction sa;
+    memset(&sa, 0, sizeof(sa));
+    sa.sa_handler = on_term;
+    sigaction(SIGTERM, &sa, NULL);
+    pid_t pid = fork();
+    if (pid == 0) {
+      signal(SIGTERM, SIG_DFL); /* drop the inherited handler (POSIX: fork
+                                 * inherits dispositions) */
+      for (;;) msleep(100); /* default disposition kills us */
+    }
+    msleep(50);
+    kill(0, SIGTERM); /* whole lineage, virtually */
+    int st = 0;
+    pid_t w = waitpid(pid, &st, 0);
+    printf("child-signaled=%d sig=%d pid-match=%d\n", WIFSIGNALED(st),
+           WIFSIGNALED(st) ? WTERMSIG(st) : 0, w == pid);
+    return 0;
+  }
+
+  if (strcmp(mode, "dflpending") == 0) {
+    struct sigaction sa;
+    memset(&sa, 0, sizeof(sa));
+    sa.sa_handler = on_term; /* handler exists at post time */
+    sigaction(SIGUSR2, &sa, NULL);
+    sigset_t s;
+    sigemptyset(&s);
+    sigaddset(&s, SIGUSR2);
+    sigprocmask(SIG_BLOCK, &s, NULL);
+    raise(SIGUSR2); /* pending (blocked) */
+    signal(SIGUSR2, SIG_DFL);
+    printf("about-to-unblock\n");
+    sigprocmask(SIG_UNBLOCK, &s, NULL); /* default action: terminate */
+    msleep(50);
+    printf("survived\n"); /* must NOT print */
+    return 0;
+  }
+
+  fprintf(stderr, "unknown mode %s\n", mode);
+  return 2;
+}

@@ -217,3 +217,40 @@ hosts:
     # edge on first datagram; edge on second; NO report without new data;
     # fresh edge after drain + third datagram
     assert lines == ["wait1 1", "wait2 1", "wait3 0", "wait4 1"], lines
+
+
+def test_shim_log_stamps(apps):
+    """Shim-side sim-time log stamping (reference: shim_logger.c — managed
+    stdout lines carry the SIMULATED clock): with log_stamp on, every
+    stdout line gains an HH:MM:SS.micros prefix whose value is sim time
+    (the client starts at sim 1 s, so stamps are >= 1 s while the whole
+    run takes well under a wall second of managed-process time)."""
+    import re
+
+    lat = 50_000_000
+    d = ProcessDriver(stop_time=30 * NS_PER_SEC, latency_ns=lat)
+    d.log_stamp = True
+    hs = d.add_host("server", "11.0.0.1")
+    hc = d.add_host("client", "11.0.0.2")
+    d.add_process(hs, [apps["udp_echo_server"], "9000", "2"], start_time=0)
+    d.add_process(
+        hc, [apps["udp_echo_client"], "server", "9000", "2"],
+        start_time=NS_PER_SEC,
+    )
+    d.run()
+    sp, cp = d.procs
+    assert cp.exit_code == 0, cp.stderr
+    lines = cp.stdout.decode().strip().splitlines()
+    pat = re.compile(r"^(\d{2}):(\d{2}):(\d{2})\.(\d{6}) \[stdio\] ")
+    assert lines and all(pat.match(l) for l in lines), lines
+    # rtt lines are printed right after the recv completes at sim >= 1 s
+    # + RTT; their stamp must reflect that virtual clock
+    for l in lines:
+        m = pat.match(l)
+        ns = (int(m[1]) * 3600 + int(m[2]) * 60 + int(m[3])) * 10**9 \
+            + int(m[4]) * 1000
+        if "rtt" in l:
+            assert ns >= NS_PER_SEC + 2 * lat, l
+    # the payload after the prefix is unchanged
+    rtts = [l.split("] ", 1)[1] for l in lines if "rtt" in l]
+    assert len(rtts) == 2, lines

@@ -59,3 +59,41 @@ def test_sigchld_socketpair_unix_event_loop(apps):
     ], lines
     # byte-identical rerun (determinism gate)
     assert run_once() == out
+
+
+def test_handler_no_reentry(apps):
+    """Delivery auto-blocks the signo for the handler's duration (Linux
+    sigaction semantics): a handler that re-raises its own signal runs
+    twice sequentially, never nested."""
+    d = build_process_driver(_yaml(apps["sigsem"], "reenter"))
+    d.run()
+    p = d.procs[0]
+    assert p.exit_code == 0, (p.stdout, p.stderr)
+    assert p.stdout.decode().strip() == "runs=2 maxdepth=1", p.stdout
+
+
+def test_group_kill_stays_virtual(apps):
+    """kill(0, SIGTERM) signals the fork lineage VIRTUALLY (the managed
+    process shares the driver's real process group — a native escape
+    would kill the test run): the parent's handler fires, the
+    handler-less child dies by default disposition."""
+    d = build_process_driver(_yaml(apps["sigsem"], "groupkill"))
+    d.run()
+    p = next(q for q in d.procs if q.parent is None)
+    assert p.exit_code == 0, (p.stdout, p.stderr)
+    lines = p.stdout.decode().splitlines()
+    assert "parent-term" in lines, lines
+    assert "child-signaled=1 sig=15 pid-match=1" in lines, lines
+
+
+def test_pending_signal_delivers_under_current_disposition(apps):
+    """A signal left pending while blocked, then reset to SIG_DFL and
+    unblocked, applies the CURRENT (default, terminating) disposition
+    instead of being dropped (POSIX delivery semantics)."""
+    d = build_process_driver(_yaml(apps["sigsem"], "dflpending"))
+    d.run()
+    p = d.procs[0]
+    out = p.stdout.decode()
+    assert "about-to-unblock" in out, (p.stdout, p.stderr)
+    assert "survived" not in out, p.stdout
+    assert p.exit_code == 128 + 12, p.exit_code  # SIGUSR2 default kill
