@@ -304,6 +304,9 @@ class HostOptions:
     # "udp_echo_server", ... with model-specific options.
     app_model: Optional[str] = None
     app_options: dict[str, Any] = dataclasses.field(default_factory=dict)
+    # Device-plane CPU model (host/cpu.c analog): simulated processing cost
+    # per device event; a loaded host's events serialize on its virtual CPU.
+    cpu_ns_per_event: int = 0
 
     @classmethod
     def from_dict(cls, name: str, d: dict, defaults: dict) -> "HostOptions":
@@ -312,7 +315,7 @@ class HostOptions:
             "ip_address_hint", "country_code_hint", "city_code_hint",
             "log_level", "pcap_directory", "network_node_id",
             "app_model", "app_options", "heartbeat_interval",
-            "heartbeat_log_info", "heartbeat_log_level",
+            "heartbeat_log_info", "heartbeat_log_level", "cpu_ns_per_event",
         }
         _check_fields(f"hosts.{name}", d, allowed)
         merged = dict(defaults)
@@ -336,6 +339,10 @@ class HostOptions:
         if merged.get("app_model") is not None:
             out.app_model = str(merged["app_model"])
         out.app_options = dict(merged.get("app_options", {}) or {})
+        if merged.get("cpu_ns_per_event") is not None:
+            out.cpu_ns_per_event = units.parse_time_ns(
+                merged["cpu_ns_per_event"], default_unit="ns"
+            )
         return out
 
     def expand(self) -> list["HostOptions"]:

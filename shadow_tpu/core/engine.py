@@ -371,6 +371,7 @@ def make_window_step(
     max_iters: int | None = None,
     bulk_kinds: dict[int, int] | None = None,
     matrix_handlers: dict[int, Callable] | None = None,
+    with_cpu_model: bool = False,
     _force_path: str | None = None,  # "matrix"|"loop": testing/profiling only
 ):
     """Build step(state, params, win_start, win_end) -> (state, min_next).
@@ -539,10 +540,29 @@ def make_window_step(
                 valid = (ev_time < win_end) & room
                 stalled = (ev_time < win_end) & ~room
 
+                # --- CPU model (host/cpu.c analog): a loaded host's events
+                # serialize on its virtual CPU — event at t EXECUTES at
+                # max(t, cpu_avail), advancing cpu_avail by cpu_cost.
+                # Selection/ordering stay keyed on the ORIGINAL times; only
+                # execution (and thus emission) timestamps shift. Compiled
+                # out entirely when the model is off.
+                if with_cpu_model:
+                    cost = state.host.cpu_cost
+                    exec_t = jnp.maximum(ev_time, state.host.cpu_avail)
+                    bulk_exec = []
+                    prev_e = exec_t
+                    for bt in bulk_t:
+                        e = jnp.maximum(bt, prev_e + cost)
+                        bulk_exec.append(e)
+                        prev_e = e
+                else:
+                    exec_t = ev_time
+                    bulk_exec = bulk_t
+
                 i_payload = soa.get_at(inbox.payload, i_slot)
                 ev = EventView(
                     mask=valid,
-                    time=ev_time,
+                    time=exec_t,
                     src=jnp.where(use_inbox, i_src, m_src),
                     seq=jnp.where(use_inbox, i_seq, m_seq),
                     kind=ev_kind,
@@ -556,14 +576,29 @@ def make_window_step(
                             dtype=jnp.int32)
                     if bulk_valid else jnp.zeros((H,), dtype=jnp.int32)
                 )
-                last_t = ev_time
-                for bt, bv in zip(bulk_t, bulk_valid):
+                last_t = exec_t
+                for bt, bv in zip(bulk_exec, bulk_valid):
                     last_t = jnp.where(bv, bt, last_t)
                 state = state.replace(
                     host=state.host.replace(
                         done_t=jnp.where(valid, last_t, state.host.done_t)
                     )
                 )
+                if with_cpu_model:
+                    delay = jnp.where(valid, exec_t - ev_time, 0)
+                    for bt, be, bv in zip(bulk_t, bulk_exec, bulk_valid):
+                        delay = delay + jnp.where(bv, be - bt, 0)
+                    state = state.replace(
+                        host=state.host.replace(
+                            cpu_avail=jnp.where(
+                                valid, last_t + cost, state.host.cpu_avail
+                            )
+                        ),
+                        counters=state.counters.replace(
+                            cpu_delay_applied=state.counters.cpu_delay_applied
+                            + jnp.sum(delay, dtype=jnp.int64)
+                        ),
+                    )
                 ptr = jnp.where(valid & ~use_inbox, ptr + 1 + taken_extra, ptr)
                 inbox = inbox.replace(
                     time=_set_col(inbox.time, i_slot, valid & use_inbox, NEVER)
@@ -579,7 +614,7 @@ def make_window_step(
                         for g in range(len(bulk_valid)):
                             gev = EventView(
                                 mask=bulk_valid[g],
-                                time=bulk_t[g],
+                                time=bulk_exec[g],
                                 src=bulk_s[g],
                                 seq=bulk_q[g],
                                 kind=jnp.full((H,), k, dtype=jnp.int32),
@@ -772,13 +807,47 @@ def make_window_step(
             # fillers interleave with real same-host rows only at time
             # NEVER, so a dense cell is real iff its time is set
             valid = d_t != NEVER
+            nvalid = jnp.sum(valid, axis=1, dtype=jnp.int32)
+            if with_cpu_model:
+                # CPU serialization as a scan (same semantics as the loop
+                # path's per-event chain): exec_k = max(t_k, exec_{k-1} +
+                # cost). With u_k = exec_k - k*cost this is a cummax of
+                # (t_k - k*cost) floored at cpu_avail.
+                cost = state.host.cpu_cost[:, None]  # [H, 1]
+                ks = jnp.arange(valid.shape[1], dtype=jnp.int64)[None, :]
+                shifted = jnp.where(
+                    valid, d_t - ks * cost, jnp.int64(-(1 << 62))
+                )
+                u = jax.lax.cummax(shifted, axis=1)
+                u = jnp.maximum(u, state.host.cpu_avail[:, None])
+                exec_t = jnp.where(valid, u + ks * cost, d_t)
+                last_exec = soa.get_at(
+                    exec_t, jnp.maximum(nvalid - 1, 0)
+                )
+                state = state.replace(
+                    host=state.host.replace(
+                        cpu_avail=jnp.where(
+                            nvalid > 0,
+                            last_exec + state.host.cpu_cost,
+                            state.host.cpu_avail,
+                        )
+                    ),
+                    counters=state.counters.replace(
+                        cpu_delay_applied=state.counters.cpu_delay_applied
+                        + jnp.sum(
+                            jnp.where(valid, exec_t - d_t, 0),
+                            dtype=jnp.int64,
+                        )
+                    ),
+                )
+            else:
+                exec_t = d_t
             mv = MatrixEventView(
-                mask=valid, time=d_t, src=d_s, seq=d_q, payload=d_p
+                mask=valid, time=exec_t, src=d_s, seq=d_q, payload=d_p
             )
             memit = MatrixEmitter()
             state = matrix_handlers[bulk_kind](state, mv, memit, params)
-            nvalid = jnp.sum(valid, axis=1, dtype=jnp.int32)
-            last_t = jnp.max(jnp.where(valid, d_t, jnp.int64(-1)), axis=1)
+            last_t = jnp.max(jnp.where(valid, exec_t, jnp.int64(-1)), axis=1)
             state = state.replace(
                 host=state.host.replace(
                     done_t=jnp.where(nvalid > 0, last_t, state.host.done_t)
@@ -937,6 +1006,7 @@ class Simulation:
         bulk_kinds: dict[int, int] | None = None,
         matrix_handlers: dict[int, Callable] | None = None,
         payload_words: int = PAYLOAD_WORDS,
+        cpu_ns_per_event: np.ndarray | None = None,
     ):
         # initial_events: (time, dst, src, kind, payload words)
         self.num_hosts = num_hosts
@@ -981,7 +1051,13 @@ class Simulation:
 
         self.handlers = handlers
         self.K, self.B, self.O = K, B, O
-        host = make_host_state(num_hosts, host_vertex)
+        with_cpu = cpu_ns_per_event is not None and bool(
+            np.any(np.asarray(cpu_ns_per_event) > 0)
+        )
+        host = make_host_state(
+            num_hosts, host_vertex,
+            cpu_cost=cpu_ns_per_event if with_cpu else None,
+        )
         host = host.replace(seq_next=jnp.asarray(seq_init))
         self.state = SimState(
             now=jnp.int64(0),
@@ -993,7 +1069,7 @@ class Simulation:
         )
         step = make_window_step(
             handlers, num_hosts, K=K, B=B, O=O, bulk_kinds=bulk_kinds,
-            matrix_handlers=matrix_handlers,
+            matrix_handlers=matrix_handlers, with_cpu_model=with_cpu,
         )
         # raw (unjitted) step for callers composing their own fused device
         # loops (e.g. procs.bridge's run-until-output sync loop)

@@ -111,6 +111,9 @@ class Counters:
     # docstring); nonzero means the fast path may have corrupted event
     # order. Asserted zero by tests; always-on (the check is elementwise).
     bulk_contract_violations: jnp.ndarray
+    # total ns of CPU-model execution deferral applied to device events
+    # (tracker_addVirtualProcessingDelay analog); 0 when the model is off
+    cpu_delay_applied: jnp.ndarray
 
     @classmethod
     def zeros(cls) -> "Counters":
@@ -129,6 +132,13 @@ class HostState:
     # it (-1 = none): the per-host progress clock that speculation
     # violations are judged against. Unused by conservative runs.
     done_t: jnp.ndarray  # i64
+    # Device-plane CPU model (host/cpu.c analog, deterministic form):
+    # cpu_cost = simulated processing nanoseconds per event (0 = off);
+    # cpu_avail = the host CPU's next-free time (timeCPUAvailable). An
+    # event at t executes at max(t, cpu_avail) and advances cpu_avail by
+    # cpu_cost — a loaded host's events serialize on its virtual CPU.
+    cpu_cost: jnp.ndarray  # i64
+    cpu_avail: jnp.ndarray  # i64
 
 
 @struct.dataclass
@@ -169,10 +179,18 @@ class SimState:
         return self.replace(subs=subs)
 
 
-def make_host_state(num_hosts: int, host_vertex: np.ndarray) -> HostState:
+def make_host_state(
+    num_hosts: int, host_vertex: np.ndarray, cpu_cost: np.ndarray | None = None
+) -> HostState:
     return HostState(
         seq_next=jnp.zeros((num_hosts,), dtype=jnp.int32),
         rng_counter=jnp.zeros((num_hosts,), dtype=jnp.uint32),
         vertex=jnp.asarray(host_vertex, dtype=jnp.int32),
         done_t=jnp.full((num_hosts,), -1, dtype=jnp.int64),
+        cpu_cost=(
+            jnp.asarray(cpu_cost, dtype=jnp.int64)
+            if cpu_cost is not None
+            else jnp.zeros((num_hosts,), dtype=jnp.int64)
+        ),
+        cpu_avail=jnp.zeros((num_hosts,), dtype=jnp.int64),
     )

@@ -192,11 +192,13 @@ def build_simulation(source) -> Simulation:
     if unknown:
         raise BuildError(f"unknown app model(s): {sorted(unknown)}")
 
+    cpu_cost = np.array([h.cpu_ns_per_event for h in cfg.hosts], dtype=np.int64)
     sim = Simulation(
         num_hosts=H,
         handlers=handlers,
         params=params,
         host_vertex=baked.host_vertex,
+        cpu_ns_per_event=cpu_cost if cpu_cost.any() else None,
         seed=cfg.general.seed,
         stop_time=cfg.general.stop_time,
         runahead=runahead,

@@ -461,3 +461,81 @@ def test_phold_matrix_path_matches_oracle():
     assert list(rng_c) == oracle["rng_counters"]
     # one micro-step per window: the loop path never ran
     assert c["micro_steps"] == windows
+
+
+@pytest.mark.quick
+def test_cpu_model_serializes_and_skews():
+    """Device-plane CPU model (reference host/cpu.c + event.c:64-92):
+    heterogeneous per-host costs serialize each host's events on its
+    virtual CPU — loaded hosts' commit clocks (done_t via cpu_avail) run
+    correspondingly behind, deterministically, and the loop and matrix
+    paths implement the identical serialization."""
+    H, seed = 6, 4242
+    # msgload 16 over 6 hosts ≈ 800 events/s/host; at 2 ms/event a loaded
+    # host's CPU caps at 500/s, so its backlog clock must run away from
+    # the free hosts' (the observable skew the reference model produces)
+    latency, msgload = 20 * MS, 32
+    runtime, stop = 2 * SEC, 4 * SEC
+    # hosts 0-2 free CPU; hosts 3-5 pay 10 ms per event (capacity 100
+    # events/s, well under the offered load -> the backlog clock runs away)
+    cost = np.array([0, 0, 0, 10 * MS, 10 * MS, 10 * MS], dtype=np.int64)
+
+    def build(bulk):
+        app = PholdApp(H, msgload=msgload, size_bytes=64, start_time=SEC,
+                       runtime=runtime)
+        return Simulation(
+            num_hosts=H,
+            handlers=app.handlers(),
+            params=make_params(H, latency, 1.0),
+            host_vertex=np.zeros(H, dtype=np.int32),
+            seed=seed, stop_time=stop, runahead=latency,
+            event_capacity=4096, K=16, B=4, O=16,
+            subs={PholdApp.SUB: app.init_sub()},
+            initial_events=app.initial_events(),
+            bulk_kinds=app.bulk_kinds() if bulk else None,
+            matrix_handlers=app.matrix_handlers() if bulk == "matrix" else None,
+            cpu_ns_per_event=cost,
+        )
+
+    sim = build(False)
+    sim.run_stepwise()
+    c = sim.counters()
+    assert c["cpu_delay_applied"] > 0
+    avail = jax.device_get(sim.state.host.cpu_avail)
+    # free hosts' CPU clock tracks their last event time; saturated hosts'
+    # backlog clock runs well past it (commit-time skew)
+    assert min(avail[3:]) > max(avail[:3]) + 200 * MS, avail
+
+    # determinism: bit-identical rerun
+    sim2 = build(False)
+    sim2.run_stepwise()
+    assert sim2.counters() == c
+    assert list(jax.device_get(sim2.state.host.cpu_avail)) == list(avail)
+
+    # matrix fast path implements the same serialization
+    simm = build("matrix")
+    simm._step = jax.jit(
+        lambda st, p, ws, we: simm._step_fn(st, p, ws, we)
+    )
+    simm.run_stepwise()
+    cm = simm.counters()
+    assert cm["cpu_delay_applied"] == c["cpu_delay_applied"]
+    assert cm["events_committed"] == c["events_committed"]
+    assert list(jax.device_get(simm.state.host.cpu_avail)) == list(avail)
+
+    # the model is observable: zero-cost run differs
+    sim0 = build(False)
+    # same build but no cpu cost
+    app0 = PholdApp(H, msgload=msgload, size_bytes=64, start_time=SEC,
+                    runtime=runtime)
+    sim0 = Simulation(
+        num_hosts=H, handlers=app0.handlers(),
+        params=make_params(H, latency, 1.0),
+        host_vertex=np.zeros(H, dtype=np.int32),
+        seed=seed, stop_time=stop, runahead=latency,
+        event_capacity=4096, K=16, B=4, O=16,
+        subs={PholdApp.SUB: app0.init_sub()},
+        initial_events=app0.initial_events(),
+    )
+    sim0.run_stepwise()
+    assert sim0.counters()["cpu_delay_applied"] == 0
