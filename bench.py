@@ -89,7 +89,8 @@ def cpp_phold_baseline(num_hosts: int, msgload: int, stop_s: int,
 def _run_stage(stage: str, app_model: str, loss: float, app_options: dict,
                extra_counters: tuple = (), num_hosts: int = 10240,
                stop_s: int = 4, event_capacity: int = 1 << 15,
-               extra_experimental: dict | None = None):
+               extra_experimental: dict | None = None,
+               windows_per_dispatch: int = 8):
     """Build, warm up (compile + bootstrap), then time the remaining sim
     span. Warm-up-committed events are subtracted so the reported rate and
     sim/wall ratio cover only the timed segment."""
@@ -128,13 +129,14 @@ def _run_stage(stage: str, app_model: str, loss: float, app_options: dict,
         },
     }
     sim = build_simulation(cfg)
-    # Short dispatch chunks: minutes-long single dispatches can crash the
-    # accelerator runtime's watchdog at this scale.
-    sim.run(until=warmup_ns, windows_per_dispatch=8)
+    # Bounded dispatch chunks: minutes-long single dispatches can crash the
+    # accelerator runtime's watchdog at this scale, but each dispatch costs
+    # ~8 ms of tunnel overhead (profiled), so size them as large as safe.
+    sim.run(until=warmup_ns, windows_per_dispatch=windows_per_dispatch)
     jax.block_until_ready(sim.state.pool.time)
     warm_events = sim.counters()["events_committed"]
     t0 = time.perf_counter()
-    sim.run(windows_per_dispatch=8)
+    sim.run(windows_per_dispatch=windows_per_dispatch)
     jax.block_until_ready(sim.state.pool.time)
     wall = time.perf_counter() - t0
     c = sim.counters()
@@ -157,10 +159,19 @@ def _run_stage(stage: str, app_model: str, loss: float, app_options: dict,
 def stage_udp_flood(num_hosts: int = 10240, stop_s: int = 4):
     """BASELINE staged config 2: 10k-host UDP flood through the full device
     network stack (NIC token buckets, CoDel router, UDP sockets)."""
+    # Shapes tuned from the on-chip profile (tools/profile_flood.py): the
+    # extraction/merge sorts carry C + H*(K+1) rows (+ H*(O+B) box rows in
+    # the merge) and are ~60% of device time — K/O/C are sized to the
+    # workload's Poisson tails, no further.
     return _run_stage(
         "udp_flood_10k", "udp_flood", 0.001,
         {"interval": "20 ms", "size": 1024, "runtime": stop_s - 1},
-        num_hosts=num_hosts, stop_s=stop_s,
+        # 1 << 14 pool capacity measurably overflows (1.5k drops); 1 << 15
+        # does not
+        num_hosts=num_hosts, stop_s=stop_s, event_capacity=1 << 15,
+        extra_experimental={"events_per_host_per_window": 12,
+                            "outbox_slots": 8},
+        windows_per_dispatch=32,
     )
 
 
@@ -182,6 +193,32 @@ def stage_tcp_bulk(num_hosts: int = 10240, stop_s: int = 4):
     )
 
 
+def stage_phold_100k(stop_s: int = 10):
+    """BASELINE staged configs 4-5 shape probe: 100k hosts on ONE chip
+    (matrix fast path). msgload 2 → 20M+ committed events."""
+    num_hosts, msgload = 100_000, 2
+    events, wall, sim_per_wall = device_phold(num_hosts, msgload, stop_s)
+    base = cpp_phold_baseline(num_hosts, msgload, stop_s)
+    rate = events / wall if wall > 0 else 0.0
+    return {
+        "stage": "phold_100k",
+        "hosts": num_hosts,
+        "events_per_sec": round(rate, 1),
+        "sim_sec_per_wall_sec": round(sim_per_wall, 2),
+        "vs_baseline": round(rate / (base["events_per_sec"] or 1.0), 3),
+    }
+
+
+def stage_udp_flood_100k(stop_s: int = 3):
+    """100k hosts through the full device network stack on one chip."""
+    return _run_stage(
+        "udp_flood_100k", "udp_flood", 0.001,
+        {"interval": "40 ms", "size": 1024, "runtime": stop_s - 1},
+        num_hosts=100_352,  # 98 * 1024: divisible for future mesh splits
+        stop_s=stop_s, event_capacity=1 << 18,
+    )
+
+
 def main():
     import sys
 
@@ -189,6 +226,11 @@ def main():
         # staged measurement configs (BASELINE.md 2-3); one JSON line each
         print(json.dumps(stage_udp_flood()))
         print(json.dumps(stage_tcp_bulk()))
+        return
+    if "--stages-100k" in sys.argv:
+        # BASELINE configs 4-5 SHAPE at one-chip scale (VERDICT r3 #3)
+        print(json.dumps(stage_phold_100k()))
+        print(json.dumps(stage_udp_flood_100k()))
         return
 
     num_hosts, msgload, stop_s = 16384, 8, 10

@@ -158,6 +158,18 @@ class ExperimentalOptions:
     # (shim_logger.c analog; off by default so app output stays byte-exact
     # for the determinism comparisons)
     use_shim_log_stamps: bool = False
+    # Managed-plane path model: None = auto (lazy per-source Dijkstra with
+    # a row cache — topology.c:1144-1259 analog — once the graph exceeds
+    # lazy_paths_threshold used vertices; dense baked matrices below).
+    # True/False force. The device plane always bakes dense (per-packet
+    # lookups on device cannot fault rows in).
+    lazy_paths: Optional[bool] = None
+    lazy_paths_threshold: int = 4096
+    # Per-packet delivery-status breadcrumb trails (packet.c:37-77 PDS_*):
+    # packets carry an extra trail word; per-host registers keep the last
+    # dropped/delivered packet's ordered stage chain. Debug mode (one
+    # extra payload word of sort traffic); UDP-only stacks for now.
+    packet_trails: bool = False
     devices: int = 1  # mesh size over the host axis
     inbox_slots: int = 8  # B: per-host intra-window self-event slots
     outbox_slots: int = 64  # O: per-host emission slots per window
@@ -223,6 +235,12 @@ class ExperimentalOptions:
             out.use_perf_timers = bool(d["use_perf_timers"])
         if "use_shim_log_stamps" in d:
             out.use_shim_log_stamps = bool(d["use_shim_log_stamps"])
+        if "lazy_paths" in d and d["lazy_paths"] is not None:
+            out.lazy_paths = bool(d["lazy_paths"])
+        if "lazy_paths_threshold" in d:
+            out.lazy_paths_threshold = int(d["lazy_paths_threshold"])
+        if "packet_trails" in d:
+            out.packet_trails = bool(d["packet_trails"])
         if "router_queue_variant" in d:
             v = str(d["router_queue_variant"]).lower()
             if v not in ("codel", "static", "single"):

@@ -170,13 +170,15 @@ class _Inbox:
     payload: jnp.ndarray  # [H, B, P]
 
     @classmethod
-    def empty(cls, H, B, P=PAYLOAD_WORDS):
+    def empty(cls, H, B, PP):
+        # payload PACKED (soa.pack_words): PP i64 columns, halving the
+        # box-write traffic and the merge-sort operand count
         return cls(
             time=jnp.full((H, B), NEVER, dtype=jnp.int64),
             src=jnp.zeros((H, B), dtype=jnp.int32),
             seq=jnp.zeros((H, B), dtype=jnp.int32),
             kind=jnp.zeros((H, B), dtype=jnp.int32),
-            payload=jnp.zeros((H, B, P), dtype=jnp.int32),
+            payload=jnp.zeros((H, B, PP), dtype=jnp.int64),
         )
 
 
@@ -191,14 +193,14 @@ class _Outbox:
     count: jnp.ndarray  # [H] i32
 
     @classmethod
-    def empty(cls, H, O, P=PAYLOAD_WORDS):
+    def empty(cls, H, O, PP):
         return cls(
             time=jnp.full((H, O), NEVER, dtype=jnp.int64),
             dst=jnp.zeros((H, O), dtype=jnp.int32),
             src=jnp.zeros((H, O), dtype=jnp.int32),
             seq=jnp.zeros((H, O), dtype=jnp.int32),
             kind=jnp.zeros((H, O), dtype=jnp.int32),
-            payload=jnp.zeros((H, O, P), dtype=jnp.int32),
+            payload=jnp.zeros((H, O, PP), dtype=jnp.int64),
             count=jnp.zeros((H,), dtype=jnp.int32),
         )
 
@@ -229,7 +231,7 @@ class _Tail(NamedTuple):
     payload: list
 
 
-def _dense_extract(pool: EventPool, win_end, H: int, Kc: int, P: int):
+def _dense_extract(pool: EventPool, win_end, H: int, Kc: int, PP: int):
     """Extract the window into a dense [H, Kc] matrix with SORTS AND SCANS
     ONLY (profiled on v5e: large gathers serialize at ~9 ns/element while
     multi-operand bitonic sorts run at memory bandwidth — so every event
@@ -259,7 +261,8 @@ def _dense_extract(pool: EventPool, win_end, H: int, Kc: int, P: int):
     cat_s = jnp.concatenate([pool.src, zf])
     cat_q = jnp.concatenate([pool.seq, zf])
     cat_k = jnp.concatenate([pool.kind, zf])
-    pcols = [jnp.concatenate([pool.payload[:, w], zf]) for w in range(P)]
+    zf64 = jnp.zeros((HK,), jnp.int64)
+    pcols = [jnp.concatenate([pool.payload[:, w], zf64]) for w in range(PP)]
     ops = jax.lax.sort(
         [cat_key, cat_t, cat_s, cat_q, cat_k, cat_d] + pcols,
         num_keys=4, is_stable=True,
@@ -372,6 +375,9 @@ def make_window_step(
     bulk_kinds: dict[int, int] | None = None,
     matrix_handlers: dict[int, Callable] | None = None,
     with_cpu_model: bool = False,
+    bulk_gate: Callable | None = None,
+    bulk_self_excluded: bool = False,
+    payload_words: int = PAYLOAD_WORDS,
     _force_path: str | None = None,  # "matrix"|"loop": testing/profiling only
 ):
     """Build step(state, params, win_start, win_end) -> (state, min_next).
@@ -389,6 +395,15 @@ def make_window_step(
     (Cross-host emissions always land >= win_end under conservative
     windows; PHOLD's message kind satisfies this by construction.)
     At most one bulk kind is supported currently.
+
+    ``bulk_gate(state, params, win_start, win_end) -> [H] i32`` makes the
+    contract DYNAMIC for kinds that are only conditionally bulk-safe (the
+    net stack's packet arrivals): it returns, per host, how many EXTRA
+    same-kind events may be batched this micro-step — 0 disables batching
+    for hosts whose handler might emit a sub-window self event (queued
+    router, exhausted tokens, armed pumps). ``bulk_self_excluded`` further
+    restricts batches to events whose src differs from the host (loopback
+    arrivals reply to self at the same timestamp).
     """
     H = num_hosts
     if max_iters is None:
@@ -405,7 +420,8 @@ def make_window_step(
     matrix_handlers = matrix_handlers or {}
 
     def step(state: SimState, params: NetParams, win_start, win_end):
-        P = state.pool.payload.shape[1]  # payload words (per-sim sized)
+        P = payload_words  # logical payload words (per-sim sized)
+        PP = soa.packed_words(P)  # packed i64 columns actually carried
         win_start = jnp.asarray(win_start, jnp.int64)
         win_end = jnp.asarray(win_end, jnp.int64)
         state = state.replace(now=win_start)
@@ -440,12 +456,19 @@ def make_window_step(
                 f"case emissions E={int(E_by_kind.max())}; raise "
                 f"experimental.outbox_slots"
             )
+        G_run = G
         if bulk_kind is not None and int(E_by_kind[bulk_kind]) * G > O:
-            raise ValueError(
-                f"outbox_slots O={O} cannot absorb a full bulk batch "
-                f"(kind {bulk_kind}: {int(E_by_kind[bulk_kind])} emissions "
-                f"x G={G}); raise outbox_slots or lower the bulk width"
-            )
+            if bulk_gate is None:
+                raise ValueError(
+                    f"outbox_slots O={O} cannot absorb a full bulk batch "
+                    f"(kind {bulk_kind}: {int(E_by_kind[bulk_kind])} "
+                    f"emissions x G={G}); raise outbox_slots or lower the "
+                    f"bulk width"
+                )
+            # gated batching degrades gracefully: clamp the batch width so
+            # a full batch always fits the outbox (the gate already makes
+            # batching best-effort per host)
+            G_run = max(1, O // max(1, int(E_by_kind[bulk_kind])))
 
         # The loop path's machinery closes over the dense window extraction;
         # building it in a factory keeps the extraction sorts INSIDE the
@@ -464,8 +487,8 @@ def make_window_step(
             defer_seq = dense.seq[:, K]
             carry0 = (
                 jnp.zeros((H,), dtype=jnp.int32),  # ptr (consumed per host)
-                _Inbox.empty(H, B, P),
-                _Outbox.empty(H, O, P),
+                _Inbox.empty(H, B, PP),
+                _Outbox.empty(H, O, PP),
                 jnp.int32(0),  # iteration counter
                 jnp.bool_(True),  # work remaining
             )
@@ -497,11 +520,19 @@ def make_window_step(
                 # required to precede the inbox head in key order so nothing
                 # that deserves to interleave is foreclosed. ---
                 bulk_t, bulk_s, bulk_q, bulk_p, bulk_m = [], [], [], [], []
-                if bulk_kind is not None and G > 1:
+                if bulk_kind is not None and G_run > 1:
                     prev = (
                         (ev_time < win_end) & ~use_inbox & (ev_kind == bulk_kind)
                     )
-                    for g in range(1, G):
+                    if bulk_self_excluded:
+                        # the HEAD is part of the batch too: a loopback
+                        # head may emit a same-time self reply that
+                        # deserves to interleave before any batched extra
+                        prev = prev & (m_src != hosts)
+                    if bulk_gate is not None:
+                        gate = bulk_gate(state, params, win_start, win_end)
+                        prev = prev & (gate > 0)
+                    for g in range(1, G_run):
                         ing = ptr + g < K
                         tg_r, sg, qg, kg, pg = _read_col(
                             dense, jnp.where(ing, ptr + g, 0), Kc
@@ -512,6 +543,10 @@ def make_window_step(
                             prev & ing & (kg == bulk_kind) & (tg < win_end)
                             & _key_lt(tg, sg, qg, i_time, i_src, i_seq)
                         )
+                        if bulk_self_excluded:
+                            okg = okg & (sg != hosts)
+                        if bulk_gate is not None:
+                            okg = okg & (gate >= g)
                         bulk_t.append(tg)
                         bulk_s.append(sg)
                         bulk_q.append(qg)
@@ -566,7 +601,12 @@ def make_window_step(
                     src=jnp.where(use_inbox, i_src, m_src),
                     seq=jnp.where(use_inbox, i_seq, m_seq),
                     kind=ev_kind,
-                    payload=jnp.where(use_inbox[:, None], i_payload, m_payload),
+                    # handlers see the unpacked i32 view (payloads travel
+                    # packed through sorts/boxes — soa.pack_words)
+                    payload=soa.unpack_words(
+                        jnp.where(use_inbox[:, None], i_payload, m_payload),
+                        P,
+                    ),
                 )
 
                 # --- consume the chosen event(s) ---
@@ -618,7 +658,7 @@ def make_window_step(
                                 src=bulk_s[g],
                                 seq=bulk_q[g],
                                 kind=jnp.full((H,), k, dtype=jnp.int32),
-                                payload=bulk_p[g],
+                                payload=soa.unpack_words(bulk_p[g], P),
                             )
                             state = handlers[k](state, gev, emitter, params)
 
@@ -635,6 +675,7 @@ def make_window_step(
 
                 # --- route emissions (order fixes per-source seq numbers) ---
                 for em in emitter.records:
+                    emp = soa.pack_words(em.payload)  # [H, PP]
                     seq = state.host.seq_next
                     state = state.replace(
                         host=state.host.replace(
@@ -665,7 +706,7 @@ def make_window_step(
                         src=_set_col(inbox.src, ff, ins, hosts),
                         seq=_set_col(inbox.seq, ff, ins, seq),
                         kind=_set_col(inbox.kind, ff, ins, em.kind),
-                        payload=_set_col(inbox.payload, ff, ins, em.payload),
+                        payload=_set_col(inbox.payload, ff, ins, emp),
                     )
 
                     ocol = outbox.count  # next free outbox column per host
@@ -676,7 +717,7 @@ def make_window_step(
                         src=_set_col(outbox.src, ocol, put, hosts),
                         seq=_set_col(outbox.seq, ocol, put, seq),
                         kind=_set_col(outbox.kind, ocol, put, em.kind),
-                        payload=_set_col(outbox.payload, ocol, put, em.payload),
+                        payload=_set_col(outbox.payload, ocol, put, emp),
                         count=outbox.count + put.astype(jnp.int32),
                     )
                     state = state.replace(
@@ -726,7 +767,7 @@ def make_window_step(
                         [dense.payload[:, :, w].reshape(-1), tail.payload[w],
                          bp[:, w]]
                     )
-                    for w in range(P)
+                    for w in range(PP)
                 ]
                 ops3 = jax.lax.sort(
                     [m_t, m_d, m_s, m_q, m_k] + m_p, num_keys=1,
@@ -758,7 +799,7 @@ def make_window_step(
             return carry0, cond, body, finish
 
         def run_loop(state):
-            dense, tail = _dense_extract(state.pool, win_end, H, K + 1, P)
+            dense, tail = _dense_extract(state.pool, win_end, H, K + 1, PP)
             carry0, cond, body, finish = make_loop_fns(dense, tail)
             state, ptr, inbox, outbox, _, _ = jax.lax.while_loop(
                 cond, body, (state,) + carry0
@@ -782,8 +823,8 @@ def make_window_step(
                     [outbox.kind.reshape(-1), inbox.kind.reshape(-1)]
                 ),
                 jnp.concatenate(
-                    [outbox.payload.reshape(-1, P),
-                     inbox.payload.reshape(-1, P)]
+                    [outbox.payload.reshape(-1, PP),
+                     inbox.payload.reshape(-1, PP)]
                 ),
             )
 
@@ -801,7 +842,7 @@ def make_window_step(
             and reshapes ONLY (_dense_extract)."""
             pool = state.pool
             C = pool.capacity
-            dense, tail = _dense_extract(pool, win_end, H, K, P)
+            dense, tail = _dense_extract(pool, win_end, H, K, PP)
             d_t, d_s, d_q = dense.time, dense.src, dense.seq
             d_p = dense.payload
             # fillers interleave with real same-host rows only at time
@@ -843,7 +884,8 @@ def make_window_step(
             else:
                 exec_t = d_t
             mv = MatrixEventView(
-                mask=valid, time=exec_t, src=d_s, seq=d_q, payload=d_p
+                mask=valid, time=exec_t, src=d_s, seq=d_q,
+                payload=soa.unpack_words(d_p, P),
             )
             memit = MatrixEmitter()
             state = matrix_handlers[bulk_kind](state, mv, memit, params)
@@ -866,13 +908,14 @@ def make_window_step(
             for j, r in enumerate(memit.records):
                 seqj = base[:, None] + col_excl + seen
                 seen = seen + masks[j]
+                rp = soa.pack_words(r.payload)  # [H, K, PP]
                 em_rows.append((
                     jnp.where(r.mask, r.time, NEVER).reshape(-1),
                     r.dst.reshape(-1),
                     hostsK.reshape(-1),
                     seqj.reshape(-1),
                     r.kind.reshape(-1),
-                    [r.payload[:, :, w].reshape(-1) for w in range(P)],
+                    [rp[:, :, w].reshape(-1) for w in range(PP)],
                 ))
             total = jnp.sum(per_col, axis=1, dtype=jnp.int32)
             state = state.replace(
@@ -915,7 +958,7 @@ def make_window_step(
             m_k = jnp.concatenate([tail.kind] + [e[4] for e in em_rows])
             m_p = [
                 jnp.concatenate([tail.payload[w]] + [e[5][w] for e in em_rows])
-                for w in range(P)
+                for w in range(PP)
             ]
             ops3 = jax.lax.sort(
                 [m_t, m_d, m_s, m_q, m_k] + m_p, num_keys=1, is_stable=True
@@ -1007,6 +1050,8 @@ class Simulation:
         matrix_handlers: dict[int, Callable] | None = None,
         payload_words: int = PAYLOAD_WORDS,
         cpu_ns_per_event: np.ndarray | None = None,
+        bulk_gate: Callable | None = None,
+        bulk_self_excluded: bool = False,
     ):
         # initial_events: (time, dst, src, kind, payload words)
         self.num_hosts = num_hosts
@@ -1041,7 +1086,9 @@ class Simulation:
                 src=pool.src.at[sl].set(jnp.asarray(srcs, jnp.int32)),
                 seq=pool.seq.at[sl].set(jnp.asarray(seqs, jnp.int32)),
                 kind=pool.kind.at[sl].set(jnp.asarray(kinds_, jnp.int32)),
-                payload=pool.payload.at[sl].set(jnp.asarray(pls, jnp.int32)),
+                payload=pool.payload.at[sl].set(
+                    soa.pack_words(jnp.asarray(pls, jnp.int32))
+                ),
             )
             seq_init = np.zeros(num_hosts, dtype=np.int32)
             for s, q in seq_ctr.items():
@@ -1070,6 +1117,8 @@ class Simulation:
         step = make_window_step(
             handlers, num_hosts, K=K, B=B, O=O, bulk_kinds=bulk_kinds,
             matrix_handlers=matrix_handlers, with_cpu_model=with_cpu,
+            bulk_gate=bulk_gate, bulk_self_excluded=bulk_self_excluded,
+            payload_words=payload_words,
         )
         # raw (unjitted) step for callers composing their own fused device
         # loops (e.g. procs.bridge's run-until-output sync loop)

@@ -51,6 +51,12 @@ class EventPool:
     The deterministic total order (event.c:109-152) is the tuple
     (time, dst, src, seq); seq is assigned from the emitting host's counter
     like the reference's per-source event ID.
+
+    Payload words are stored PACKED, two i32 words per i64 column
+    (core.soa.pack_words): every payload column rides the engine's window
+    sorts as an operand, and packing halves that operand count — the sorts
+    are the dominant window cost at netstack shapes (profiled on v5e).
+    Handlers always see the unpacked [H, P] i32 view via EventView.
     """
 
     time: jnp.ndarray  # [C] i64 ns
@@ -58,22 +64,26 @@ class EventPool:
     src: jnp.ndarray  # [C] i32
     seq: jnp.ndarray  # [C] i32
     kind: jnp.ndarray  # [C] i32
-    payload: jnp.ndarray  # [C, P] i32
+    payload: jnp.ndarray  # [C, ceil(P/2)] i64 PACKED (soa.pack_words)
 
     @classmethod
     def empty(cls, capacity: int,
               payload_words: int = PAYLOAD_WORDS) -> "EventPool":
         # payload_words is sizable per simulation: network sims need the
         # full packet-header layout (12 words, net/packet.py); pure-PDES
-        # models like PHOLD carry 2 — payload row gathers are a dominant
-        # per-window cost on TPU, so right-sizing is a direct speedup.
+        # models like PHOLD carry 2 — payload columns are a dominant
+        # per-window sort cost on TPU, so right-sizing is a direct speedup.
+        from shadow_tpu.core import soa
+
         return cls(
             time=jnp.full((capacity,), simtime.NEVER, dtype=jnp.int64),
             dst=jnp.zeros((capacity,), dtype=jnp.int32),
             src=jnp.zeros((capacity,), dtype=jnp.int32),
             seq=jnp.zeros((capacity,), dtype=jnp.int32),
             kind=jnp.zeros((capacity,), dtype=jnp.int32),
-            payload=jnp.zeros((capacity, payload_words), dtype=jnp.int32),
+            payload=jnp.zeros(
+                (capacity, soa.packed_words(payload_words)), dtype=jnp.int64
+            ),
         )
 
     @property

@@ -347,6 +347,7 @@ class UdpEchoApp:
             priority=jnp.zeros((H,), jnp.int32),
             src_host=hosts,
             socket_slot=jnp.zeros((H,), jnp.int32),
+            payload_words=self.stack.payload_words,
         )
         req = pkt.pack_time(req, jnp.where(send, ev.time, 0))
         state = self.stack.udp_sendto(

@@ -55,14 +55,19 @@ class RouterState:
     # counters
     codel_dropped: jnp.ndarray  # [] i64
     overflow_dropped: jnp.ndarray  # [] i64
+    # last AQM-dropped packet per host (PDS breadcrumb registers; trail
+    # word is 0 for simulations built without packet_trails)
+    drop_trail: jnp.ndarray  # [H] i32
+    drop_time: jnp.ndarray  # [H] i64
 
 
-def init(num_hosts: int, queue_slots: int = 64) -> RouterState:
+def init(num_hosts: int, queue_slots: int = 64,
+         payload_words: int = PAYLOAD_WORDS) -> RouterState:
     H, Q = num_hosts, queue_slots
     z64 = lambda: jnp.zeros((H,), jnp.int64)  # noqa: E731
     z32 = lambda: jnp.zeros((H,), jnp.int32)  # noqa: E731
     return RouterState(
-        q_payload=jnp.zeros((H, Q, PAYLOAD_WORDS), jnp.int32),
+        q_payload=jnp.zeros((H, Q, payload_words), jnp.int32),
         q_src=jnp.zeros((H, Q), jnp.int32),
         q_enq_ts=jnp.zeros((H, Q), jnp.int64),
         q_head=z32(),
@@ -75,6 +80,8 @@ def init(num_hosts: int, queue_slots: int = 64) -> RouterState:
         total_size=z64(),
         codel_dropped=jnp.zeros((), jnp.int64),
         overflow_dropped=jnp.zeros((), jnp.int64),
+        drop_trail=z32(),
+        drop_time=z64(),
     )
 
 
@@ -96,6 +103,23 @@ def enqueue(router: RouterState, mask, payload, src, now) -> RouterState:
         total_size=router.total_size + jnp.where(ok, size, 0),
         overflow_dropped=router.overflow_dropped
         + jnp.sum(mask & ~room, dtype=jnp.int64),
+    )
+
+
+def _record_drop(router: RouterState, mask, payload, now):
+    """Keep the dropped (in-hand) packet's breadcrumb trail + drop time in
+    per-host registers (packet.c PDS_* trail analog for the AQM's drops —
+    they happen inside the dequeue walk where no caller sees the packet).
+    Trail word 0 when the sim runs without packet_trails."""
+    if payload.shape[-1] <= pkt.W_TRAIL:
+        return router
+    tr = (payload[..., pkt.W_TRAIL] << 4) | jnp.int32(pkt.PDS_DROPPED_CODEL)
+    return router.replace(
+        drop_trail=jnp.where(mask, tr, router.drop_trail),
+        drop_time=jnp.where(
+            mask, jnp.broadcast_to(now, mask.shape).astype(jnp.int64),
+            router.drop_time,
+        ),
     )
 
 
@@ -183,6 +207,7 @@ def dequeue(router: RouterState, now, mask, aqm: bool = True):
             codel_dropped=router.codel_dropped + jnp.sum(cond, dtype=jnp.int64),
             drop_count=router.drop_count + cond.astype(jnp.int32),
         )
+        router = _record_drop(router, cond, payload, now)
         router, have2, payload2, src2, ok2 = _pop_helper(router, now, cond)
         have = jnp.where(cond, have2, have)
         payload = jnp.where(cond[:, None], payload2, payload)
@@ -203,6 +228,7 @@ def dequeue(router: RouterState, now, mask, aqm: bool = True):
     router = router.replace(
         codel_dropped=router.codel_dropped + jnp.sum(trans, dtype=jnp.int64)
     )
+    router = _record_drop(router, trans, payload, now)
     router, have3, payload3, src3, _ok3 = _pop_helper(router, now, trans)
     have = jnp.where(trans, have3, have)
     payload = jnp.where(trans[:, None], payload3, payload)

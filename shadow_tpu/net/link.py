@@ -69,6 +69,15 @@ def send(
 
     emitter.emit(deliver, now + lat, dst_host, kind, payload)
 
+    # breadcrumb registers for loss-dropped packets (worker.c:539-545 drop
+    # roll; packet.c PDS_INET_DROPPED analog) — no-op without packet_trails
+    from shadow_tpu.net import packet as pkt
+    from shadow_tpu.net import pds as pds_mod
+
+    state = pds_mod.record_drop(
+        state, roll_mask & ~kept, payload, pkt.PDS_DROPPED_LOSS, now
+    )
+
     c = state.counters
     n_sent = jnp.sum(mask, dtype=jnp.int64)
     state = state.replace(

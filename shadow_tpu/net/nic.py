@@ -70,7 +70,8 @@ class NicState:
     rx_bytes: jnp.ndarray  # [H] i64
 
 
-def init(bw_up_bits, bw_down_bits, queue_slots: int = 64) -> NicState:
+def init(bw_up_bits, bw_down_bits, queue_slots: int = 64,
+         payload_words: int = PAYLOAD_WORDS) -> NicState:
     """bw_*_bits: [H] int64 bits/sec per host."""
     H = bw_up_bits.shape[0]
     tx_refill = jnp.maximum(
@@ -93,7 +94,7 @@ def init(bw_up_bits, bw_down_bits, queue_slots: int = 64) -> NicState:
         rx_refill=rx_refill,
         tx_cap=tx_cap,
         rx_cap=rx_cap,
-        q_payload=jnp.zeros((H, NQ, PAYLOAD_WORDS), jnp.int32),
+        q_payload=jnp.zeros((H, NQ, payload_words), jnp.int32),
         q_dst=jnp.zeros((H, NQ), jnp.int32),
         q_head=jnp.zeros((H,), jnp.int32),
         q_tail=jnp.zeros((H,), jnp.int32),

@@ -33,6 +33,63 @@ W_HANDLE = 11  # CPU-side payload buffer handle (managed processes)
 # ranges (tcp.h:145,171 + tcp_retransmit_tally.cc interval lists).
 W_SACK = W_HANDLE
 
+# ---------------------------------------------------------------------------
+# Per-packet delivery-status breadcrumb trail (reference packet.c:37-77
+# PDS_* trail — its debugging workhorse). Debug mode: simulations built
+# with experimental.packet_trails carry ONE EXTRA payload word (index 12)
+# into which each stage shifts a 4-bit status code, preserving order —
+# up to 8 hops, enough for the longest stage chain. Zero cost when off:
+# the word (and every stamp) only exists at payload width >= 13.
+# ---------------------------------------------------------------------------
+W_TRAIL = 12
+TRAILED_PAYLOAD_WORDS = 13
+
+PDS_CREATED = 1
+PDS_NIC_QUEUED = 2  # send-ring enqueue (throttled path)
+PDS_SENT = 3  # left the NIC onto the wire
+PDS_DROPPED_LOSS = 4  # path reliability roll failed (worker.c:539)
+PDS_ROUTER_ENQUEUED = 5  # entered the upstream router (router.c:103)
+PDS_DROPPED_CODEL = 6  # CoDel control-law drop
+PDS_DROPPED_OVERFLOW = 7  # router ring overflow (drop-tail)
+PDS_DELIVERED = 8  # reached the destination socket
+PDS_DROPPED_SENDQ = 9  # NIC send-ring overflow
+
+PDS_NAMES = {
+    PDS_CREATED: "CREATED",
+    PDS_NIC_QUEUED: "NIC_QUEUED",
+    PDS_SENT: "SENT",
+    PDS_DROPPED_LOSS: "DROPPED_LOSS",
+    PDS_ROUTER_ENQUEUED: "ROUTER_ENQUEUED",
+    PDS_DROPPED_CODEL: "DROPPED_CODEL",
+    PDS_DROPPED_OVERFLOW: "DROPPED_OVERFLOW",
+    PDS_DELIVERED: "DELIVERED",
+    PDS_DROPPED_SENDQ: "DROPPED_SENDQ",
+}
+
+
+def stamp(payload, mask, code):
+    """Shift status `code` into masked packets' trail word; no-op when the
+    simulation was built without trails (payload width < 13)."""
+    if payload.shape[-1] <= W_TRAIL:
+        return payload
+    tr = payload[..., W_TRAIL]
+    new = (tr << 4) | jnp.int32(code)
+    if mask.ndim == tr.ndim:
+        m = mask
+    else:
+        m = jnp.broadcast_to(mask, tr.shape)
+    return payload.at[..., W_TRAIL].set(jnp.where(m, new, tr))
+
+
+def decode_trail(word: int) -> list[str]:
+    """Trail word → ordered status names (oldest first)."""
+    out = []
+    w = int(word) & 0xFFFFFFFF
+    while w:
+        out.append(PDS_NAMES.get(w & 0xF, f"?{w & 0xF}"))
+        w >>= 4
+    return list(reversed(out))
+
 PROTO_UDP = 17
 PROTO_TCP = 6
 
@@ -65,10 +122,13 @@ def unpack_time(payload):
     return (hi << 32) | lo
 
 
-def make_udp(src_port, dst_port, length, priority, src_host, socket_slot=None):
+def make_udp(src_port, dst_port, length, priority, src_host, socket_slot=None,
+             payload_words: int = PAYLOAD_WORDS):
     """Assemble [H, P] payload words for a UDP datagram (vectorized)."""
     H = src_port.shape[0]
-    pl = jnp.zeros((H, PAYLOAD_WORDS), dtype=jnp.int32)
+    pl = jnp.zeros((H, payload_words), dtype=jnp.int32)
+    if payload_words > W_TRAIL:
+        pl = pl.at[:, W_TRAIL].set(PDS_CREATED)
     pl = pl.at[:, W_PROTO].set(PROTO_UDP)
     pl = pl.at[:, W_SRC_PORT].set(src_port.astype(jnp.int32))
     pl = pl.at[:, W_DST_PORT].set(dst_port.astype(jnp.int32))

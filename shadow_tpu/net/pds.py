@@ -1,0 +1,91 @@
+"""Per-packet delivery-status recording (reference packet.c:37-77 PDS_*).
+
+The trail itself rides in the packet's 13th payload word (see
+shadow_tpu.net.packet: W_TRAIL, stamp, decode_trail) when the simulation is
+built with ``experimental.packet_trails``. This module holds the per-host
+REGISTERS that preserve a trail at the moments a packet leaves the
+simulation — dropped or delivered — so the full stage chain of the last
+such packet per host is reconstructable afterwards (the reference prints
+its trail into the pcap/debug log the same way).
+
+All writes are masked elementwise selects over [H]; zero scatter, zero
+cost when the sub is absent (simulations without packet_trails).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from shadow_tpu.net import packet as pkt
+
+SUB = "pds"
+
+
+def init(num_hosts: int) -> dict:
+    H = num_hosts
+    return {
+        # last drop seen by each host (the DROPPING side's host index)
+        "drop_trail": jnp.zeros((H,), jnp.int32),
+        "drop_time": jnp.zeros((H,), jnp.int64),
+        "drop_src": jnp.zeros((H,), jnp.int32),
+        "drop_count": jnp.zeros((H,), jnp.int64),
+        # last in-order delivery per destination host
+        "deliver_trail": jnp.zeros((H,), jnp.int32),
+        "deliver_time": jnp.zeros((H,), jnp.int64),
+    }
+
+
+def record_drop(state, mask, payload, cause, now):
+    """Record masked hosts' in-hand packet as dropped with `cause` shifted
+    onto its trail. No-op without the pds sub or the trail word."""
+    sub = state.subs.get(SUB)
+    if sub is None or payload.shape[-1] <= pkt.W_TRAIL:
+        return state
+    tr = (payload[..., pkt.W_TRAIL] << 4) | jnp.int32(cause)
+    new = dict(sub)
+    new["drop_trail"] = jnp.where(mask, tr, sub["drop_trail"])
+    new["drop_time"] = jnp.where(
+        mask, jnp.broadcast_to(now, mask.shape).astype(jnp.int64),
+        sub["drop_time"],
+    )
+    new["drop_src"] = jnp.where(
+        mask, payload[..., pkt.W_SRC_HOST], sub["drop_src"]
+    )
+    new["drop_count"] = sub["drop_count"] + mask.astype(jnp.int64)
+    return state.with_sub(SUB, new)
+
+
+def record_delivery(state, mask, payload, now):
+    sub = state.subs.get(SUB)
+    if sub is None or payload.shape[-1] <= pkt.W_TRAIL:
+        return state
+    tr = (payload[..., pkt.W_TRAIL] << 4) | jnp.int32(pkt.PDS_DELIVERED)
+    new = dict(sub)
+    new["deliver_trail"] = jnp.where(mask, tr, sub["deliver_trail"])
+    new["deliver_time"] = jnp.where(
+        mask, jnp.broadcast_to(now, mask.shape).astype(jnp.int64),
+        sub["deliver_time"],
+    )
+    return state.with_sub(SUB, new)
+
+
+def drop_report(sim) -> list[dict]:
+    """Decoded last-drop registers per host (empty without packet_trails)."""
+    import jax
+
+    sub = sim.state.subs.get(SUB)
+    if sub is None:
+        return []
+    got = jax.device_get(sub)
+    out = []
+    for h in range(got["drop_trail"].shape[0]):
+        if int(got["drop_count"][h]) == 0:
+            continue
+        out.append({
+            "host": h,
+            "src_host": int(got["drop_src"][h]),
+            "time_ns": int(got["drop_time"][h]),
+            "drops_seen": int(got["drop_count"][h]),
+            "trail": pkt.decode_trail(int(got["drop_trail"][h])),
+        })
+    return out

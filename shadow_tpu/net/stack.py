@@ -29,7 +29,7 @@ from shadow_tpu.core.state import (
     NetParams,
     SimState,
 )
-from shadow_tpu.net import codel, link, nic, packet as pkt, tcp as tcp_mod, udp
+from shadow_tpu.net import codel, link, nic, packet as pkt, pds as pds_mod, tcp as tcp_mod, udp
 
 KIND_NIC_SEND = 100
 KIND_NIC_RECV = KIND_NIC_REFILL
@@ -52,9 +52,16 @@ class NetStack:
         tcp_child_base: int = 0,
         qdisc: str = "fifo",
         router_variant: str = "codel",
+        payload_words: int = 12,
     ):
         if qdisc not in ("fifo", "roundrobin"):
             raise ValueError(f"unknown qdisc {qdisc!r}")
+        if payload_words != pkt.PAYLOAD_WORDS and with_tcp:
+            raise ValueError(
+                "packet_trails (payload_words 13) supports UDP-only stacks "
+                "for now — the TCP segment builders are fixed-width"
+            )
+        self.payload_words = payload_words
         if router_variant not in ("codel", "static", "single"):
             raise ValueError(f"unknown router variant {router_variant!r}")
         self.qdisc = qdisc
@@ -66,8 +73,13 @@ class NetStack:
             router_queue_slots = 1
         self.sockets_per_host = sockets_per_host
         self.num_hosts = num_hosts
-        self._init_nic = nic.init(bw_up_bits, bw_down_bits, nic_queue_slots)
-        self._init_router = codel.init(num_hosts, router_queue_slots)
+        self._init_nic = nic.init(
+            bw_up_bits, bw_down_bits, nic_queue_slots,
+            payload_words=payload_words,
+        )
+        self._init_router = codel.init(
+            num_hosts, router_queue_slots, payload_words=payload_words
+        )
         self._init_udp = udp.init(num_hosts, sockets_per_host)
         # UDP-only sims skip the TCP state machine entirely: its handlers
         # otherwise run (masked) every micro-step and dominate both compile
@@ -85,6 +97,14 @@ class NetStack:
         # unroll multiplies XLA compile time for little win, so batch only
         # the UDP-only build.
         self.recv_batch = 1 if with_tcp else self.PUMP_BATCH
+        # Gated arrival batching (engine bulk): how many CONSECUTIVE
+        # KIND_PKT_DELIVER events one host may consume per micro-step when
+        # bulk_gate proves them all direct-deliverable. The reference
+        # drains a whole arrival burst in ONE receivePackets task
+        # (network_interface.c:448-485); this is that, vectorized. TCP
+        # builds keep 1 for now: the segment handler arms sub-window RTO
+        # timers, which the gate cannot bound statically.
+        self.deliver_batch = 1 if with_tcp else 8
 
     # ---- build-time API ----
 
@@ -157,19 +177,25 @@ class NetStack:
                 ))
             state = state.with_sub(nic.SUB, n)
             remote = direct & (dst_host != hosts)
+            wire = pkt.stamp(payload, direct, pkt.PDS_SENT)
             state = link.send(
                 state, emitter, remote, dst_host.astype(jnp.int32), now64,
-                KIND_PKT_DELIVER, payload, params,
+                KIND_PKT_DELIVER, wire, params,
                 jnp.where(remote, size, 0),
                 control_mask=payload[:, pkt.W_LEN] == 0,
             )
             lb = direct & (dst_host == hosts)
             emitter.emit(lb, now64, hosts, jnp.int32(KIND_PKT_DELIVER),
-                         payload)
+                         wire)
             n = state.subs[nic.SUB]
 
+        enq = mask & ~direct
         n, ok = nic.enqueue_send(
-            n, mask & ~direct, dst_host.astype(jnp.int32), payload
+            n, enq, dst_host.astype(jnp.int32),
+            pkt.stamp(payload, enq, pkt.PDS_NIC_QUEUED),
+        )
+        state = pds_mod.record_drop(
+            state, enq & ~ok, payload, pkt.PDS_DROPPED_SENDQ, now64
         )
         need = ok & ~n.send_pending
         emitter.emit(
@@ -211,6 +237,7 @@ class NetStack:
                 socket_slot=jnp.broadcast_to(
                     jnp.asarray(socket_slot, jnp.int32), (H,)
                 ),
+                payload_words=self.payload_words,
             )
         state, ok = self._tx(state, emitter, mask, now, dst_host, payload,
                              params=params)
@@ -248,6 +275,7 @@ class NetStack:
             ),
         )
         state = state.with_sub(udp.SUB, u)
+        state = pds_mod.record_delivery(state, mask, payload, now)
         for hook in self.recv_hooks:
             state = hook(state, found, slot, src, payload, emitter, now, params)
         if self.tcp is not None:
@@ -297,7 +325,16 @@ class NetStack:
         )
 
         queued = remote & ~direct
-        r = codel.enqueue(r, queued, ev.payload, ev.src, now)
+        no_room = queued & ~(
+            (r.q_tail - r.q_head) < r.q_src.shape[1]
+        )
+        state = pds_mod.record_drop(
+            state, no_room, ev.payload, pkt.PDS_DROPPED_OVERFLOW, now
+        )
+        r = codel.enqueue(
+            r, queued, pkt.stamp(ev.payload, queued, pkt.PDS_ROUTER_ENQUEUED),
+            ev.src, now,
+        )
         state = state.with_sub(codel.SUB, r).with_sub(nic.SUB, n)
 
         state = self._deliver_local(
@@ -364,14 +401,15 @@ class NetStack:
             state = state.with_sub(nic.SUB, n)
 
             remote = do & (dst != hosts)
+            wire = pkt.stamp(payload, do, pkt.PDS_SENT)
             state = link.send(
-                state, emitter, remote, dst, now, KIND_PKT_DELIVER, payload,
+                state, emitter, remote, dst, now, KIND_PKT_DELIVER, wire,
                 params, jnp.where(remote, size, 0),
                 control_mask=payload[:, pkt.W_LEN] == 0,
             )
             # loopback: deliver at the same timestamp, no transit
             lb = do & (dst == hosts)
-            emitter.emit(lb, now, hosts, jnp.int32(KIND_PKT_DELIVER), payload)
+            emitter.emit(lb, now, hosts, jnp.int32(KIND_PKT_DELIVER), wire)
             n = state.subs[nic.SUB]
 
         still = n.q_head < n.q_tail
@@ -433,6 +471,54 @@ class NetStack:
         )
         n = n.replace(recv_pending=n.recv_pending | need)
         return state.with_sub(nic.SUB, n)
+
+    # ---- gated arrival batching (engine bulk support) ----
+
+    def bulk_kinds(self) -> dict | None:
+        if self.deliver_batch <= 1:
+            return None
+        return {KIND_PKT_DELIVER: self.deliver_batch}
+
+    def bulk_gate(self, state: SimState, params: NetParams, win_start,
+                  win_end):
+        """[H] i32: how many EXTRA consecutive arrivals each host may batch
+        this micro-step, such that EVERY batched arrival provably takes
+        on_pkt_deliver's direct path (no queueing → no sub-window self
+        pump) and any app reply takes _tx's direct path (no send pump).
+
+        Conservative by construction: token buckets are refilled only to
+        win_start (mid-window refills are ignored), each arrival/reply is
+        budgeted a full MTU, and any armed pump or non-empty queue zeroes
+        the gate. An ineligible host simply falls back to one-event-per-
+        micro-step — never incorrect, only slower."""
+        from shadow_tpu.net import codel as codel_mod
+
+        n = state.subs[nic.SUB]
+        r = state.subs[codel_mod.SUB]
+        ws = jnp.asarray(win_start, jnp.int64)
+        G = self.deliver_batch
+        rx_rem, _ = nic.lazy_refill(
+            n.rx_rem, n.rx_tick, n.rx_refill, n.rx_cap, ws
+        )
+        tx_rem, _ = nic.lazy_refill(
+            n.tx_rem, n.tx_tick, n.tx_refill, n.tx_cap, ws
+        )
+        # whole window inside bootstrap → tokens are not charged at all
+        free = jnp.asarray(win_end, jnp.int64) <= params.bootstrap_end
+        rx_cap_ev = jnp.where(
+            free, G, (rx_rem // pkt.MTU).astype(jnp.int64)
+        )
+        tx_cap_ev = jnp.where(
+            free, G, (tx_rem // pkt.MTU).astype(jnp.int64)
+        )
+        quiet = (
+            ~codel_mod.nonempty(r)
+            & (n.q_head == n.q_tail)
+            & ~n.recv_pending
+            & ~n.send_pending
+        )
+        cap = jnp.minimum(rx_cap_ev, tx_cap_ev) - 1  # head uses one budget
+        return jnp.where(quiet, jnp.clip(cap, 0, G - 1), 0).astype(jnp.int32)
 
     def handlers(self) -> dict:
         h = {

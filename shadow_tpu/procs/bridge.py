@@ -41,7 +41,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from shadow_tpu.core import simtime
+from shadow_tpu.core import simtime, soa
 from shadow_tpu.core.engine import Simulation, _set_col
 from shadow_tpu.core.state import KIND_PROC_SYSCALL, NetParams
 from shadow_tpu.net import packet as pkt, tcp as tcp_mod, udp
@@ -575,7 +575,9 @@ class DeviceNetBridge:
                 src=pool.src.at[idx].set(src),
                 seq=pool.seq.at[idx].set(jnp.asarray(seqs, jnp.int32)),
                 kind=pool.kind.at[idx].set(KIND_PROC_SYSCALL),
-                payload=pool.payload.at[idx].set(jnp.asarray(payload_rows)),
+                payload=pool.payload.at[idx].set(
+                    soa.pack_words(jnp.asarray(payload_rows, jnp.int32))
+                ),
             ),
             host=self.sim.state.host.replace(
                 seq_next=jnp.asarray(seq_np)

@@ -50,7 +50,23 @@ def build_process_driver(
             country_code_hint=h.country_code_hint,
             network_node_id=h.network_node_id,
         )
-    baked = topo.bake()
+    # Path model: dense baked matrices below the threshold, lazy per-source
+    # Dijkstra + row cache above it (no dense [U, U] allocation — the
+    # reference's strategy for Tor-scale maps, topology.c:1144-1259). The
+    # device-network bridge needs the dense arrays on device either way.
+    n_used = len(set(topo._attached_vertex))
+    lazy = cfg.experimental.lazy_paths
+    if lazy is None:
+        lazy = (
+            n_used > cfg.experimental.lazy_paths_threshold
+            and not cfg.experimental.use_device_network
+        )
+    if lazy and cfg.experimental.use_device_network:
+        raise ProcessBuildError(
+            "experimental.lazy_paths is incompatible with "
+            "use_device_network (device lookups need baked arrays)"
+        )
+    baked = topo.bake_lazy() if lazy else topo.bake()
 
     driver = ProcessDriver(
         stop_time=cfg.general.stop_time,
@@ -115,8 +131,14 @@ def build_process_driver(
                 )
                 n += 1
 
-    lat = baked.latency_vv
-    rel = baked.reliability_vv
+    if lazy:
+        lat_at = baked.latency_ns
+        rel_at = baked.reliability
+    else:
+        lat_vv = baked.latency_vv
+        rel_vv = baked.reliability_vv
+        lat_at = lambda sv, dv: int(lat_vv[sv, dv])  # noqa: E731
+        rel_at = lambda sv, dv: float(rel_vv[sv, dv])  # noqa: E731
 
     # Unknown destination IPs (apps sending to addresses that are not sim
     # hosts) fall back to defaults; the packet then vanishes at delivery
@@ -126,14 +148,14 @@ def build_process_driver(
         dv = ip_to_vertex.get(dst_ip)
         if sv is None or dv is None:
             return driver.latency_ns
-        return int(lat[sv, dv])
+        return lat_at(sv, dv)
 
     def reliability_fn(src_ip: int, dst_ip: int) -> float:
         sv = ip_to_vertex.get(src_ip)
         dv = ip_to_vertex.get(dst_ip)
         if sv is None or dv is None:
             return 1.0
-        return float(rel[sv, dv])
+        return rel_at(sv, dv)
 
     driver.set_latency_fn(latency_fn)
     driver.set_reliability_fn(reliability_fn)

@@ -70,6 +70,8 @@ def build_simulation(source) -> Simulation:
     initial_events: list = []
     bulk_kinds: dict | None = None
     matrix_handlers: dict | None = None
+    bulk_gate = None
+    bulk_self_excluded = False
     payload_words = 12  # net/packet.py layout; pure-PDES apps shrink it
     H = len(cfg.hosts)
     app_names = {h.app_model for h in cfg.hosts if h.app_model}
@@ -140,6 +142,10 @@ def build_simulation(source) -> Simulation:
                     f"host {h.name}: no bandwidth configured (host or graph "
                     f"vertex must set bandwidth_up/down)"
                 )
+        if cfg.experimental.packet_trails:
+            from shadow_tpu.net import packet as pkt_mod
+
+            payload_words = pkt_mod.TRAILED_PAYLOAD_WORDS
         stack = NetStack(
             H,
             jnp.asarray(bw_up),
@@ -149,6 +155,7 @@ def build_simulation(source) -> Simulation:
             router_variant=cfg.experimental.router_queue_variant,
             with_tcp=(name == "tcp_bulk"),
             qdisc=cfg.experimental.interface_qdisc,
+            payload_words=payload_words,
         )
         interval = units.parse_time_ns(
             client_opts.get("interval", "100 ms"), default_unit="ms"
@@ -187,6 +194,15 @@ def build_simulation(source) -> Simulation:
         subs.update(stack.init_subs())
         subs[app.SUB] = app.init_sub()
         initial_events.extend(app.initial_events())
+        # gated arrival batching: a host consumes a whole burst of
+        # same-window arrivals in one micro-step when provably safe
+        bulk_kinds = stack.bulk_kinds()
+        bulk_gate = stack.bulk_gate if bulk_kinds else None
+        bulk_self_excluded = bulk_kinds is not None
+        if cfg.experimental.packet_trails:
+            from shadow_tpu.net import pds as pds_mod
+
+            subs[pds_mod.SUB] = pds_mod.init(H)
 
     unknown = app_names - {"phold", "udp_flood", "udp_echo", "tcp_bulk"}
     if unknown:
@@ -211,6 +227,8 @@ def build_simulation(source) -> Simulation:
         bulk_kinds=bulk_kinds,
         matrix_handlers=matrix_handlers,
         payload_words=payload_words,
+        bulk_gate=bulk_gate,
+        bulk_self_excluded=bulk_self_excluded,
     )
     # attach build artifacts for inspection/observability
     sim.config = cfg

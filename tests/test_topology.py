@@ -167,3 +167,75 @@ def test_dns():
     assert dns.resolve_ip(ip_a) == "alpha"
     ip_c = dns.register(2, "gamma", ip_hint="11.0.0.50")  # taken → sequential
     assert ip_c != ip_b
+
+
+def test_lazy_paths_match_dense():
+    """LazyPaths (on-demand per-source rows, topology.c:1144-1259 analog)
+    must agree with the dense bake on every used pair, including
+    unreachable pairs and the explicit-self-loop diagonal rule."""
+    gml = """graph [
+      directed 0
+      node [ id 0 ] node [ id 1 ] node [ id 2 ] node [ id 3 ]
+      edge [ source 0 target 0 latency "5 ms" packet_loss 0.01 ]
+      edge [ source 0 target 1 latency "10 ms" packet_loss 0.02 ]
+      edge [ source 1 target 2 latency "20 ms" packet_loss 0.1 ]
+      edge [ source 0 target 2 latency "50 ms" ]
+    ]"""
+
+    def build():
+        t = Topology.from_gml(gml)
+        for i in range(4):
+            t.attach_host(i, network_node_id=i % 4)
+        return t
+
+    dense = build().bake()
+    lazy = build().bake_lazy()
+    U = len(dense.used_vertices)
+    for i in range(U):
+        for j in range(U):
+            assert lazy.latency_ns(i, j) == int(dense.latency_vv[i, j]), (i, j)
+            assert abs(
+                lazy.reliability(i, j) - float(dense.reliability_vv[i, j])
+            ) < 1e-6, (i, j)
+    # lazy runahead bound is the min EDGE latency (a sound lower bound)
+    assert lazy.min_latency_ns <= dense.min_latency_ns
+    assert list(lazy.host_vertex) == list(dense.host_vertex)
+
+
+def test_10k_vertex_gml_builds_without_dense_matrix():
+    """VERDICT r2 #8: a 10k-vertex graph must build and serve lookups
+    WITHOUT any dense [U, U] allocation, in seconds (the old Python U x U
+    bake loop would take hours; the dense arrays would take 1.2 GB)."""
+    import time
+
+    V = 10_000
+    lines = ["graph [", "  directed 0"]
+    for i in range(V):
+        lines.append(f"  node [ id {i} ]")
+    # ring + a few chords; every vertex also gets a self-loop (co-located
+    # host communication needs one)
+    for i in range(V):
+        lines.append(
+            f'  edge [ source {i} target {(i + 1) % V} latency "2 ms" '
+            f"packet_loss 0.001 ]"
+        )
+        lines.append(f'  edge [ source {i} target {i} latency "1 ms" ]')
+    gml = "\n".join(lines) + "\n]"
+
+    t0 = time.time()
+    topo = Topology.from_gml(gml)
+    for h in range(V):  # one host on every vertex: U = 10k
+        topo.attach_host(h, network_node_id=h)
+    lazy = topo.bake_lazy()
+    build_s = time.time() - t0
+    assert build_s < 60, f"lazy bake took {build_s:.1f}s"
+
+    t0 = time.time()
+    # ring distance 3 → 6 ms; reliability (1-0.001)^3
+    assert lazy.latency_ns(0, 3) == 6 * simtime.NS_PER_MS
+    assert abs(lazy.reliability(0, 3) - 0.999**3) < 1e-5
+    assert lazy.latency_ns(5000, 5000) == simtime.NS_PER_MS  # self-loop
+    assert lazy.min_latency_ns == simtime.NS_PER_MS
+    assert time.time() - t0 < 30
+    # only the queried source rows were materialized
+    assert len(lazy._rows) == 2

@@ -1,0 +1,82 @@
+"""Profile one udp_flood window batch on the real chip: wall per window,
+plus a jax.profiler trace parsed for op-class totals."""
+import glob, gzip, json, time, os, sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import jax
+
+cache = "/root/repo/.jax_cache"
+jax.config.update("jax_compilation_cache_dir", cache)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+
+from shadow_tpu.sim import build_simulation
+
+H = 10240
+cfg = {
+    "general": {"stop_time": 4, "seed": 7},
+    "network": {"graph": {"type": "gml", "inline": (
+        'graph [\n'
+        '  node [ id 0 bandwidth_down "1 Gbit" bandwidth_up "1 Gbit" ]\n'
+        '  edge [ source 0 target 0 latency "10 ms" packet_loss 0.001 ]\n]\n')}},
+    "experimental": {
+        "event_capacity": 1 << 15,
+        "events_per_host_per_window": 16,
+        "outbox_slots": 16,
+        "router_queue_slots": 16,
+        "inbox_slots": 4,
+    },
+    "hosts": {
+        "server": {"quantity": H // 8, "app_model": "udp_flood",
+                   "app_options": {"role": "server"}},
+        "client": {"quantity": H - H // 8, "app_model": "udp_flood",
+                   "app_options": {"interval": "20 ms", "size": 1024,
+                                   "runtime": 3}},
+    },
+}
+sim = build_simulation(cfg)
+sim.run(until=1_600_000_000, windows_per_dispatch=8)
+jax.block_until_ready(sim.state.pool.time)
+c0 = sim.counters()
+
+# timed: dispatch sizes 1 / 8 / 32 to split dispatch overhead from window cost
+for wpd in (1, 8, 32):
+    t0 = time.perf_counter()
+    n_disp = 4 if wpd >= 8 else 16
+    for _ in range(n_disp):
+        sim.state, mn = sim._run_to(sim.state, sim.params,
+                                    sim.stop_time, wpd)
+    jax.block_until_ready(sim.state.pool.time)
+    dt = time.perf_counter() - t0
+    print(json.dumps({"wpd": wpd, "dispatches": n_disp,
+                      "wall_per_dispatch_ms": round(1000*dt/n_disp, 1),
+                      "wall_per_window_ms": round(1000*dt/(n_disp*wpd), 1)}))
+
+c1 = sim.counters()
+print("micro_steps delta:", c1["micro_steps"] - c0["micro_steps"],
+      "events delta:", c1["events_committed"] - c0["events_committed"])
+
+# profile a few dispatches
+trace_dir = "/tmp/flood_trace"
+with jax.profiler.trace(trace_dir):
+    for _ in range(2):
+        sim.state, mn = sim._run_to(sim.state, sim.params, sim.stop_time, 8)
+    jax.block_until_ready(sim.state.pool.time)
+
+# parse the trace: op-class totals
+files = glob.glob(trace_dir + "/**/*.trace.json.gz", recursive=True)
+print("trace files:", files)
+if files:
+    with gzip.open(files[-1], "rt") as f:
+        tr = json.load(f)
+    tot = {}
+    for ev in tr.get("traceEvents", []):
+        if ev.get("ph") != "X":
+            continue
+        name = ev.get("name", "")
+        pid_name = ev.get("pid")
+        dur = ev.get("dur", 0)
+        key = name.split(".")[0].split("(")[0][:40]
+        tot[key] = tot.get(key, 0) + dur
+    top = sorted(tot.items(), key=lambda kv: -kv[1])[:25]
+    for k, v in top:
+        print(f"{v/1000:10.1f} ms  {k}")
