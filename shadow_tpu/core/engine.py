@@ -231,19 +231,38 @@ class _Tail(NamedTuple):
     payload: list
 
 
-def _dense_extract(pool: EventPool, win_end, H: int, Kc: int, PP: int):
+# window-relative time field width in the packed sort key: 2^44 ns ≈ 4.9 h
+# bounds a single window's span (runahead), far beyond any real runahead
+_DT_BITS = 44
+_DT_MAX = (1 << _DT_BITS) - 1
+
+
+def _dense_extract(pool: EventPool, win_start, win_end, H: int, Kc: int,
+                   PP: int):
     """Extract the window into a dense [H, Kc] matrix with SORTS AND SCANS
     ONLY (profiled on v5e: large gathers serialize at ~9 ns/element while
-    multi-operand bitonic sorts run at memory bandwidth — so every event
-    column and payload word rides the sorts as an operand).
+    multi-operand sorts run near memory bandwidth — so every event column
+    and payload word rides the sorts as an operand).
 
-    Sort 1 keys (dst | H-sentinel, time, src, seq) over pool rows plus Kc
-    filler rows per host (time NEVER — they sort after every real in-window
-    row of their host). A cummax scan derives each row's rank within its
-    host run (no searchsorted — its method="sort" lowers to a scatter).
-    Sort 2 by dense slot id (h*Kc + rank) lands extracted rows so the
-    window matrix is a plain reshape; everything else keeps relative order
-    at the tail and becomes the merge leftovers.
+    Sort cost on TPU scales with rows × comparator stages (measured:
+    payload-operand packing barely moved it, key count does), so the
+    4-component key (dst | H-sentinel, time, src, seq) is PACKED into two
+    i64 keys:
+
+        k1 = run_key << 44 | clip(time - win_start, 0, 2^44-1)
+        k2 = src << 32 | seq (zero-extended)
+
+    Exact for every in-window row: run_key < H only for in-window rows,
+    whose time ∈ [win_start, win_end) with win_end - win_start ≤ runahead
+    ≪ 2^44; out-of-window rows (run_key = H) may clip dt, but their order
+    is irrelevant — the next merge re-sorts everything by time. Filler
+    rows (Kc per host, dt = 2^44-1 > any real in-window dt) sort after
+    every real row of their host.
+
+    A cummax scan derives each row's rank within its host run (no
+    searchsorted — its method="sort" lowers to a scatter). Sort 2 by dense
+    slot id (h*Kc + rank) lands extracted rows so the window matrix is a
+    plain reshape; everything else becomes the merge leftovers.
 
     Replaces per-host priority queues (scheduler_policy_host_single.c:
     18-54) and their locks with two sorts shared by all hosts."""
@@ -252,23 +271,29 @@ def _dense_extract(pool: EventPool, win_end, H: int, Kc: int, PP: int):
     N = C + HK
     hosts = jnp.arange(H, dtype=jnp.int32)
     inwin = pool.time < win_end
-    key_r = jnp.where(inwin, pool.dst, jnp.int32(H))
-    key_f = jnp.repeat(hosts, Kc)  # [HK] filler keys
-    cat_key = jnp.concatenate([key_r, key_f])
+    run_key = jnp.where(inwin, pool.dst, jnp.int32(H)).astype(jnp.int64)
+    dt = jnp.clip(pool.time - win_start, 0, _DT_MAX)
+    k1_r = (run_key << _DT_BITS) | dt
+    k2_r = (pool.src.astype(jnp.int64) << 32) | (
+        pool.seq.astype(jnp.int64) & 0xFFFFFFFF
+    )
+    key_f = jnp.repeat(hosts, Kc)  # [HK] filler host ids
+    k1_f = (key_f.astype(jnp.int64) << _DT_BITS) | _DT_MAX
+    cat_k1 = jnp.concatenate([k1_r, k1_f])
+    cat_k2 = jnp.concatenate([k2_r, jnp.zeros((HK,), jnp.int64)])
     cat_t = jnp.concatenate([pool.time, jnp.full((HK,), NEVER, jnp.int64)])
     zf = jnp.zeros((HK,), jnp.int32)
     cat_d = jnp.concatenate([pool.dst, key_f])  # TRUE dst rides along
-    cat_s = jnp.concatenate([pool.src, zf])
-    cat_q = jnp.concatenate([pool.seq, zf])
     cat_k = jnp.concatenate([pool.kind, zf])
     zf64 = jnp.zeros((HK,), jnp.int64)
     pcols = [jnp.concatenate([pool.payload[:, w], zf64]) for w in range(PP)]
     ops = jax.lax.sort(
-        [cat_key, cat_t, cat_s, cat_q, cat_k, cat_d] + pcols,
-        num_keys=4, is_stable=True,
+        [cat_k1, cat_k2, cat_t, cat_k, cat_d] + pcols,
+        num_keys=2, is_stable=True,
     )
-    s_key, s_t, s_s, s_q, s_k, s_d = ops[:6]
-    s_p = ops[6:]
+    s_k1, s_k2, s_t, s_k, s_d = ops[:5]
+    s_p = ops[5:]
+    s_key = (s_k1 >> _DT_BITS).astype(jnp.int32)
     iota = jnp.arange(N, dtype=jnp.int32)
     boundary = jnp.concatenate(
         [jnp.ones((1,), bool), s_key[1:] != s_key[:-1]]
@@ -278,16 +303,22 @@ def _dense_extract(pool: EventPool, win_end, H: int, Kc: int, PP: int):
     extract = (s_key < H) & (rank < Kc)
     slot = jnp.where(extract, s_key * Kc + rank, jnp.int32(N))
     ops2 = jax.lax.sort(
-        [slot, s_t, s_s, s_q, s_k, s_d] + list(s_p),
+        [slot, s_t, s_k2, s_k, s_d] + list(s_p),
         num_keys=1, is_stable=True,
     )
-    d_t, d_s, d_q, d_k = (o[:HK].reshape(H, Kc) for o in ops2[1:5])
-    d_p = jnp.stack([o[:HK].reshape(H, Kc) for o in ops2[6:]], axis=-1)
+    o_t, o_k2, o_k, o_d = ops2[1], ops2[2], ops2[3], ops2[4]
+    o_s = (o_k2 >> 32).astype(jnp.int32)
+    o_q = o_k2.astype(jnp.int32)  # low 32 bits (seq is nonnegative)
+    d_t = o_t[:HK].reshape(H, Kc)
+    d_s = o_s[:HK].reshape(H, Kc)
+    d_q = o_q[:HK].reshape(H, Kc)
+    d_k = o_k[:HK].reshape(H, Kc)
+    d_p = jnp.stack([o[:HK].reshape(H, Kc) for o in ops2[5:]], axis=-1)
     dense = _DenseWindow(time=d_t, src=d_s, seq=d_q, kind=d_k, payload=d_p)
     tail = _Tail(
-        time=ops2[1][HK:], src=ops2[2][HK:], seq=ops2[3][HK:],
-        kind=ops2[4][HK:], dst=ops2[5][HK:],
-        payload=[o[HK:] for o in ops2[6:]],
+        time=o_t[HK:], src=o_s[HK:], seq=o_q[HK:],
+        kind=o_k[HK:], dst=o_d[HK:],
+        payload=[o[HK:] for o in ops2[5:]],
     )
     return dense, tail
 
@@ -799,7 +830,9 @@ def make_window_step(
             return carry0, cond, body, finish
 
         def run_loop(state):
-            dense, tail = _dense_extract(state.pool, win_end, H, K + 1, PP)
+            dense, tail = _dense_extract(
+                state.pool, win_start, win_end, H, K + 1, PP
+            )
             carry0, cond, body, finish = make_loop_fns(dense, tail)
             state, ptr, inbox, outbox, _, _ = jax.lax.while_loop(
                 cond, body, (state,) + carry0
@@ -842,7 +875,7 @@ def make_window_step(
             and reshapes ONLY (_dense_extract)."""
             pool = state.pool
             C = pool.capacity
-            dense, tail = _dense_extract(pool, win_end, H, K, PP)
+            dense, tail = _dense_extract(pool, win_start, win_end, H, K, PP)
             d_t, d_s, d_q = dense.time, dense.src, dense.seq
             d_p = dense.payload
             # fillers interleave with real same-host rows only at time

@@ -20,8 +20,8 @@ cfg = {
         '  edge [ source 0 target 0 latency "10 ms" packet_loss 0.001 ]\n]\n')}},
     "experimental": {
         "event_capacity": 1 << 15,
-        "events_per_host_per_window": 16,
-        "outbox_slots": 16,
+        "events_per_host_per_window": 12,
+        "outbox_slots": 8,
         "router_queue_slots": 16,
         "inbox_slots": 4,
     },
