@@ -37,7 +37,8 @@ def _enable_compile_cache():
 _enable_compile_cache()
 
 
-def device_phold(num_hosts: int, msgload: int, stop_s: int):
+def device_phold(num_hosts: int, msgload: int, stop_s: int,
+                 windows_per_dispatch: int = 64):
     import jax
 
     from shadow_tpu.core import simtime
@@ -47,10 +48,11 @@ def device_phold(num_hosts: int, msgload: int, stop_s: int):
         num_hosts, msgload=msgload, stop_s=stop_s, runtime_s=stop_s
     )
     # Warm-up compile (cached), then timed run.
-    sim.run(until=int(0.2 * simtime.NS_PER_SEC))
+    sim.run(until=int(0.2 * simtime.NS_PER_SEC),
+            windows_per_dispatch=windows_per_dispatch)
     jax.block_until_ready(sim.state.pool.time)
     t0 = time.perf_counter()
-    sim.run()
+    sim.run(windows_per_dispatch=windows_per_dispatch)
     jax.block_until_ready(sim.state.pool.time)
     wall = time.perf_counter() - t0
     c = sim.counters()
@@ -195,9 +197,13 @@ def stage_tcp_bulk(num_hosts: int = 10240, stop_s: int = 4):
 
 def stage_phold_100k(stop_s: int = 10):
     """BASELINE staged configs 4-5 shape probe: 100k hosts on ONE chip
-    (matrix fast path). msgload 2 → 20M+ committed events."""
+    (matrix fast path). msgload 2 → 20M+ committed events. SHORT dispatch
+    chunks: at this scale a 64-window dispatch runs long enough to trip
+    the accelerator runtime's watchdog and crash the worker."""
     num_hosts, msgload = 100_000, 2
-    events, wall, sim_per_wall = device_phold(num_hosts, msgload, stop_s)
+    events, wall, sim_per_wall = device_phold(
+        num_hosts, msgload, stop_s, windows_per_dispatch=4
+    )
     base = cpp_phold_baseline(num_hosts, msgload, stop_s)
     rate = events / wall if wall > 0 else 0.0
     return {

@@ -33,6 +33,9 @@ def main() -> int:
     ap.add_argument("--stop", type=int, default=15, help="sim seconds")
     ap.add_argument("--data-dir", default=None)
     ap.add_argument("--latency-ms", type=int, default=50)
+    ap.add_argument("--cpu-plane", action="store_true",
+                    help="stage-A CPU network model (no device bridge): "
+                    "isolates driver-plane scaling from the chip")
     args = ap.parse_args()
 
     n_cli = args.hosts - args.servers
@@ -61,8 +64,8 @@ network:
         edge [ source 0 target 0 latency "{args.latency_ms} ms" packet_loss 0.001 ]
       ]
 experimental:
-  use_device_network: true
-  use_device_tcp: true
+  use_device_network: {str(not args.cpu_plane).lower()}
+  use_device_tcp: {str(not args.cpu_plane).lower()}
   event_capacity: {1 << 17}
   events_per_host_per_window: 8
   sockets_per_host: 160
