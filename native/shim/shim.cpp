@@ -65,6 +65,7 @@
 #include <netinet/in.h>
 #include <poll.h>
 #include <sys/un.h>
+#include <sched.h>
 #include <pthread.h>
 #include <stdarg.h>
 #include <stdio.h>
@@ -902,6 +903,60 @@ int timerfd_gettime(int fd, struct itimerspec* curr) {
   return 0;
 }
 
+// Virtualized CPU visibility: the driver reports the simulated host's
+// CPU count (default 1 — matching the one-runnable-thread determinism
+// model), so glibc's __get_nprocs / sysconf(_SC_NPROCESSORS_ONLN) and
+// app thread-pool sizing are deterministic instead of leaking the real
+// machine's core count. (The reference pins workers but lets nproc
+// leak; Tor sizes its threadpool from it — determinism wants this.)
+// Returns the RAW KERNEL convention (size of the kernel cpumask copy,
+// or -errno) — the SIGSYS dispatcher forwards it as-is; the libc-facing
+// wrapper below converts to glibc's 0-on-success.
+long sched_getaffinity_raw(pid_t pid, size_t cpusetsize, cpu_set_t* mask) {
+  int64_t args[6] = {pid, (int64_t)cpusetsize, 0, 0, 0, 0};
+  uint32_t out_len = 0;
+  uint8_t tmp[128];
+  int64_t r = ipc_call(SYS_sched_getaffinity, args, nullptr, 0, tmp,
+                       sizeof(tmp), &out_len);
+  if (r < 0) return -(long)errno;
+  if (mask && cpusetsize) {
+    memset(mask, 0, cpusetsize);
+    size_t n = out_len < cpusetsize ? out_len : cpusetsize;
+    memcpy(mask, tmp, n);
+  }
+  return (long)r;
+}
+
+int sched_getaffinity(pid_t pid, size_t cpusetsize, cpu_set_t* mask) {
+  if (!g_ch)
+    return (int)sys_native(SYS_sched_getaffinity, pid, cpusetsize, mask) < 0
+               ? -1
+               : 0;
+  long r = sched_getaffinity_raw(pid, cpusetsize, mask);
+  if (r < 0) {
+    errno = (int)-r;
+    return -1;
+  }
+  return 0;  // glibc convention
+}
+
+long sysconf(int name) {
+  static auto real_sysconf = (long (*)(int))dlsym(RTLD_NEXT, "sysconf");
+  // glibc's __get_nprocs reads /sys (the REAL machine) on modern
+  // versions, so the processor-count queries are answered from the
+  // virtualized affinity mask instead.
+  if (g_ch && (name == _SC_NPROCESSORS_ONLN || name == _SC_NPROCESSORS_CONF)) {
+    cpu_set_t s;
+    CPU_ZERO(&s);
+    if (sched_getaffinity(0, sizeof(s), &s) == 0) {
+      int n = CPU_COUNT(&s);
+      if (n > 0) return n;
+    }
+    return 1;
+  }
+  return real_sysconf(name);
+}
+
 ssize_t getrandom(void* buf, size_t buflen, unsigned int flags) {
   if (!g_ch) return sys_native(SYS_getrandom, buf, buflen, flags);
   // deterministic per-host stream from the simulator's seeded RNG tree
@@ -1445,6 +1500,9 @@ long route_raw_syscall(long nr, long a0, long a1, long a2, long a3, long a4,
     }
     case SYS_getrandom:
       return RAWRET(getrandom((void*)a0, (size_t)a1, (unsigned int)a2));
+    case SYS_sched_getaffinity:
+      if (!g_ch) return shim_gate_syscall(nr, a0, a1, a2, a3, a4, a5);
+      return sched_getaffinity_raw((pid_t)a0, (size_t)a1, (cpu_set_t*)a2);
     default:
       return shim_gate_syscall(nr, a0, a1, a2, a3, a4, a5);
   }
@@ -1512,6 +1570,7 @@ const TrapEntry kTrapped[] = {
     {SYS_eventfd, ACT_TRAP},      {SYS_eventfd2, ACT_TRAP},
     {SYS_pipe, ACT_TRAP},         {SYS_pipe2, ACT_TRAP},
     {SYS_getrandom, ACT_TRAP},    {SYS_pselect6, ACT_TRAP},
+    {SYS_sched_getaffinity, ACT_TRAP},
 };
 
 }  // namespace

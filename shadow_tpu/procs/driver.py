@@ -87,6 +87,7 @@ SYS_epoll_create1 = 291
 SYS_dup3 = 292
 SYS_pipe2 = 293
 SYS_getrandom = 318
+SYS_sched_getaffinity = 204
 SYS_rt_sigaction = 13
 SYS_rt_sigprocmask = 14
 SYS_socketpair = 53
@@ -644,6 +645,9 @@ class ProcessDriver:
         # (shim_logger.c analog; off by default — byte-exact app output is
         # what the determinism tests compare)
         self.log_stamp = False
+        # CPUs a managed process observes via sched_getaffinity (and thus
+        # glibc nproc): deterministic, decoupled from the real machine
+        self.virtual_cpus = 1
         self.service_timeout_s = service_timeout_s
         self.now = 0
         self.hosts: list[SimHost] = []
@@ -2185,6 +2189,21 @@ class ProcessDriver:
         elif sysno == SYS_getrandom:
             n = min(a[0], ipc.IPC_DATA_MAX)
             done(n, data=proc.host.rand.randbytes(n))
+        elif sysno == SYS_sched_getaffinity:
+            # Virtual CPU visibility (deterministic nproc): the simulated
+            # host exposes `virtual_cpus` CPUs regardless of the real
+            # machine — glibc's __get_nprocs and app thread-pool sizing
+            # derive from this syscall. Kernel convention: ret = size of
+            # the kernel cpumask copy, data = the affinity mask bytes.
+            ncpu = max(1, self.virtual_cpus)
+            mask = bytearray((ncpu + 7) // 8)
+            for i in range(ncpu):
+                mask[i // 8] |= 1 << (i % 8)
+            want = a[1]
+            if want and want < len(mask):
+                done(-errno.EINVAL)
+            else:
+                done(8, data=bytes(mask))
         # ---- pseudo-syscalls ----
         elif sysno == ipc.PSYS_RESOLVE_NAME:
             name = ch.data.decode("utf-8", "replace")

@@ -1,0 +1,17 @@
+/* Virtual CPU visibility probe: under the simulator both the raw
+ * sched_getaffinity mask and glibc's sysconf(_SC_NPROCESSORS_ONLN)
+ * (which derives from it) must report the simulated host's CPU count,
+ * not the real machine's. */
+#define _GNU_SOURCE
+#include <sched.h>
+#include <stdio.h>
+#include <unistd.h>
+
+int main(void) {
+  cpu_set_t s;
+  CPU_ZERO(&s);
+  int r = sched_getaffinity(0, sizeof(s), &s);
+  printf("affinity rc=%d count=%d\n", r < 0 ? -1 : 0, CPU_COUNT(&s));
+  printf("nproc %ld\n", sysconf(_SC_NPROCESSORS_ONLN));
+  return 0;
+}

@@ -254,3 +254,31 @@ def test_shim_log_stamps(apps):
     # the payload after the prefix is unchanged
     rtts = [l.split("] ", 1)[1] for l in lines if "rtt" in l]
     assert len(rtts) == 2, lines
+
+
+def test_virtual_cpu_visibility(apps):
+    """sched_getaffinity (and glibc's sysconf(_SC_NPROCESSORS_ONLN), which
+    derives from it) reports the SIMULATED host's CPU count — apps that
+    size thread pools from nproc behave deterministically regardless of
+    the real machine."""
+    d = ProcessDriver(stop_time=10 * NS_PER_SEC, latency_ns=10_000_000)
+    h = d.add_host("solo", "11.0.0.1")
+    d.add_process(h, [apps["nproc_probe"]])
+    d.run()
+    p = d.procs[0]
+    assert p.exit_code == 0, (p.stdout, p.stderr)
+    lines = p.stdout.decode().splitlines()
+    assert lines[0] == "affinity rc=0 count=1", lines
+    assert lines[1] == "nproc 1", lines
+
+    # configurable: a 4-CPU virtual host reports 4
+    d2 = ProcessDriver(stop_time=10 * NS_PER_SEC, latency_ns=10_000_000)
+    d2.virtual_cpus = 4
+    h2 = d2.add_host("quad", "11.0.0.1")
+    d2.add_process(h2, [apps["nproc_probe"]])
+    d2.run()
+    p2 = d2.procs[0]
+    assert p2.exit_code == 0, (p2.stdout, p2.stderr)
+    lines2 = p2.stdout.decode().splitlines()
+    assert lines2[0] == "affinity rc=0 count=4", lines2
+    assert lines2[1] == "nproc 4", lines2
