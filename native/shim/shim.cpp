@@ -1337,6 +1337,20 @@ int gethostname(char* name, size_t len) {
   return 0;
 }
 
+int uname(struct utsname* buf) {
+  long r = sys_native(SYS_uname, buf);
+  if (r < 0 || !g_ch || !buf) return r < 0 ? -1 : 0;
+  // nodename must agree with the simulated hostname (gethostname above) —
+  // apps commonly identify themselves via uname and the real machine's
+  // name leaking in would break determinism comparisons across machines
+  char hn[sizeof(buf->nodename)];
+  if (gethostname(hn, sizeof(hn)) == 0) {
+    memset(buf->nodename, 0, sizeof(buf->nodename));
+    strncpy(buf->nodename, hn, sizeof(buf->nodename) - 1);
+  }
+  return 0;
+}
+
 int clock_nanosleep(clockid_t clk, int flags, const struct timespec* req,
                     struct timespec* rem) {
   if (!g_ch) {

@@ -1,0 +1,14 @@
+/* uname.nodename must agree with gethostname under the simulator. */
+#include <stdio.h>
+#include <string.h>
+#include <sys/utsname.h>
+#include <unistd.h>
+
+int main(void) {
+  struct utsname u;
+  char hn[256];
+  if (uname(&u) != 0) return 1;
+  if (gethostname(hn, sizeof(hn)) != 0) return 1;
+  printf("match %d nodename=%s\n", strcmp(u.nodename, hn) == 0, u.nodename);
+  return 0;
+}

@@ -282,3 +282,15 @@ def test_virtual_cpu_visibility(apps):
     lines2 = p2.stdout.decode().splitlines()
     assert lines2[0] == "affinity rc=0 count=4", lines2
     assert lines2[1] == "nproc 4", lines2
+
+
+def test_uname_nodename_simulated(apps):
+    """uname(2).nodename agrees with the simulated hostname (the real
+    machine's name must not leak into determinism-compared output)."""
+    d = ProcessDriver(stop_time=10 * NS_PER_SEC, latency_ns=10_000_000)
+    h = d.add_host("relay7", "11.0.0.1")
+    d.add_process(h, [apps["uname_probe"]])
+    d.run()
+    p = d.procs[0]
+    assert p.exit_code == 0, (p.stdout, p.stderr)
+    assert p.stdout.decode().strip() == "match 1 nodename=relay7", p.stdout
