@@ -1337,6 +1337,60 @@ int gethostname(char* name, size_t len) {
   return 0;
 }
 
+// ---------------------------------------------------------------------------
+// Minimal /proc virtualization: the CPU-count pseudo-files. Apps (and
+// glibc's __get_nprocs on /sys-reading versions) that COUNT CPUS from
+// files must see the simulated host's count, not the real machine's.
+// A matching open returns an anonymous memfd holding synthesized content;
+// everything else opens natively. (Reference analog: Shadow does not
+// virtualize /proc either, but its processes are pinned; our determinism
+// story makes nproc part of the simulation contract — see
+// sched_getaffinity above.)
+// ---------------------------------------------------------------------------
+
+long virt_cpu_file_open(const char* path) {
+  // returns a ready-to-read fd, or -1 when the path is not virtualized
+  if (!g_ch || !path) return -1;
+  if (strcmp(path, "/proc/cpuinfo") != 0 &&
+      strcmp(path, "/sys/devices/system/cpu/online") != 0 &&
+      strcmp(path, "/sys/devices/system/cpu/possible") != 0)
+    return -1;
+  cpu_set_t s;
+  CPU_ZERO(&s);
+  int ncpu = 1;
+  if (sched_getaffinity_raw(0, sizeof(s), &s) > 0) {
+    int n = CPU_COUNT(&s);
+    if (n > 0) ncpu = n;
+  }
+  char buf[4096];
+  size_t off = 0;
+  if (strcmp(path, "/proc/cpuinfo") == 0) {
+    for (int i = 0; i < ncpu && off + 64 < sizeof(buf); i++)
+      off += (size_t)snprintf(buf + off, sizeof(buf) - off,
+                              "processor\t: %d\nmodel name\t: simulated\n\n",
+                              i);
+  } else {
+    off = (size_t)(ncpu > 1
+                       ? snprintf(buf, sizeof(buf), "0-%d\n", ncpu - 1)
+                       : snprintf(buf, sizeof(buf), "0\n"));
+  }
+  long fd = shim_gate_syscall(SYS_memfd_create, (long)"cpu_virt", 0, 0, 0, 0,
+                              0);
+  if (fd < 0) return -1;
+  size_t w = 0;
+  while (w < off) {
+    long r = shim_gate_syscall(SYS_write, fd, (long)(buf + w), off - w, 0, 0,
+                               0);
+    if (r <= 0) {
+      shim_gate_syscall(SYS_close, fd, 0, 0, 0, 0, 0);
+      return -1;
+    }
+    w += (size_t)r;
+  }
+  shim_gate_syscall(SYS_lseek, fd, 0, SEEK_SET, 0, 0, 0);
+  return fd;
+}
+
 int uname(struct utsname* buf) {
   long r = sys_native(SYS_uname, buf);
   if (r < 0 || !g_ch || !buf) return r < 0 ? -1 : 0;
@@ -1517,6 +1571,19 @@ long route_raw_syscall(long nr, long a0, long a1, long a2, long a3, long a4,
     case SYS_sched_getaffinity:
       if (!g_ch) return shim_gate_syscall(nr, a0, a1, a2, a3, a4, a5);
       return sched_getaffinity_raw((pid_t)a0, (size_t)a1, (cpu_set_t*)a2);
+    case SYS_open: {
+      long vfd = virt_cpu_file_open((const char*)a0);
+      if (vfd >= 0) return vfd;
+      return shim_gate_syscall(nr, a0, a1, a2, a3, a4, a5);
+    }
+    case SYS_openat: {
+      const char* p = (const char*)a1;
+      if (p && p[0] == '/') {
+        long vfd = virt_cpu_file_open(p);
+        if (vfd >= 0) return vfd;
+      }
+      return shim_gate_syscall(nr, a0, a1, a2, a3, a4, a5);
+    }
     default:
       return shim_gate_syscall(nr, a0, a1, a2, a3, a4, a5);
   }
@@ -1585,6 +1652,10 @@ const TrapEntry kTrapped[] = {
     {SYS_pipe, ACT_TRAP},         {SYS_pipe2, ACT_TRAP},
     {SYS_getrandom, ACT_TRAP},    {SYS_pselect6, ACT_TRAP},
     {SYS_sched_getaffinity, ACT_TRAP},
+    // opens trap so CPU-count pseudo-files virtualize even through
+    // glibc-internal (non-PLT) calls; non-matching paths re-enter the
+    // kernel through the gate — one SIGSYS round trip per open
+    {SYS_open, ACT_TRAP},         {SYS_openat, ACT_TRAP},
 };
 
 }  // namespace

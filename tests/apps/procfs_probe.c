@@ -1,0 +1,31 @@
+/* CPU-count pseudo-files must reflect the SIMULATED host's CPU count. */
+#include <stdio.h>
+#include <string.h>
+
+static int count_processors(void) {
+  FILE* f = fopen("/proc/cpuinfo", "r");
+  if (!f) return -1;
+  char line[256];
+  int n = 0;
+  while (fgets(line, sizeof(line), f))
+    if (strncmp(line, "processor", 9) == 0) n++;
+  fclose(f);
+  return n;
+}
+
+int main(void) {
+  printf("cpuinfo %d\n", count_processors());
+  FILE* f = fopen("/sys/devices/system/cpu/online", "r");
+  char buf[64] = "?";
+  if (f) {
+    if (!fgets(buf, sizeof(buf), f)) buf[0] = '?';
+    fclose(f);
+    buf[strcspn(buf, "\n")] = 0;
+  }
+  printf("online %s\n", buf);
+  /* a non-virtualized file still opens natively through the trap */
+  FILE* g = fopen("/proc/version", "r");
+  printf("other %d\n", g != NULL);
+  if (g) fclose(g);
+  return 0;
+}

@@ -294,3 +294,18 @@ def test_uname_nodename_simulated(apps):
     p = d.procs[0]
     assert p.exit_code == 0, (p.stdout, p.stderr)
     assert p.stdout.decode().strip() == "match 1 nodename=relay7", p.stdout
+
+
+def test_proc_cpu_files_virtualized(apps):
+    """/proc/cpuinfo and /sys .../cpu/online report the SIMULATED CPU
+    count through the openat seccomp trap (glibc's internal opens never
+    cross the PLT); unrelated paths still open natively."""
+    d = ProcessDriver(stop_time=10 * NS_PER_SEC, latency_ns=10_000_000)
+    d.virtual_cpus = 3
+    h = d.add_host("solo", "11.0.0.1")
+    d.add_process(h, [apps["procfs_probe"]])
+    d.run()
+    p = d.procs[0]
+    assert p.exit_code == 0, (p.stdout, p.stderr)
+    lines = p.stdout.decode().splitlines()
+    assert lines == ["cpuinfo 3", "online 0-2", "other 1"], lines
