@@ -168,7 +168,7 @@ class ExperimentalOptions:
     # Per-packet delivery-status breadcrumb trails (packet.c:37-77 PDS_*):
     # packets carry an extra trail word; per-host registers keep the last
     # dropped/delivered packet's ordered stage chain. Debug mode (one
-    # extra payload word of sort traffic); UDP-only stacks for now.
+    # extra payload word of sort traffic).
     packet_trails: bool = False
     devices: int = 1  # mesh size over the host axis
     inbox_slots: int = 8  # B: per-host intra-window self-event slots

@@ -56,11 +56,6 @@ class NetStack:
     ):
         if qdisc not in ("fifo", "roundrobin"):
             raise ValueError(f"unknown qdisc {qdisc!r}")
-        if payload_words != pkt.PAYLOAD_WORDS and with_tcp:
-            raise ValueError(
-                "packet_trails (payload_words 13) supports UDP-only stacks "
-                "for now — the TCP segment builders are fixed-width"
-            )
         self.payload_words = payload_words
         if router_variant not in ("codel", "static", "single"):
             raise ValueError(f"unknown router variant {router_variant!r}")
@@ -86,7 +81,8 @@ class NetStack:
         # time and per-iteration cost.
         self.tcp = (
             tcp_mod.Tcp(num_hosts, sockets_per_host, tcp_ooo_chunks,
-                        child_base=tcp_child_base)
+                        child_base=tcp_child_base,
+                        payload_words=payload_words)
             if with_tcp else None
         )
         if self.tcp is not None:

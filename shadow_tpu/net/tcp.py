@@ -262,9 +262,11 @@ def demux(tcp: TcpState, mask, payload, src_host):
 
 
 def make_segment(src_port, dst_port, length, flags, seq, ack, wnd, src_host,
-                 socket_slot, sack=None):
+                 socket_slot, sack=None, payload_words=PAYLOAD_WORDS):
     H = src_port.shape[0]
-    pl = jnp.zeros((H, PAYLOAD_WORDS), dtype=jnp.int32)
+    pl = jnp.zeros((H, payload_words), dtype=jnp.int32)
+    if payload_words > pkt.W_TRAIL:
+        pl = pl.at[:, pkt.W_TRAIL].set(pkt.PDS_CREATED)
     pl = pl.at[:, pkt.W_PROTO].set(pkt.PROTO_TCP)
     pl = pl.at[:, pkt.W_SRC_PORT].set(src_port.astype(jnp.int32))
     pl = pl.at[:, pkt.W_DST_PORT].set(dst_port.astype(jnp.int32))
@@ -373,7 +375,8 @@ class Tcp:
     KIND_TIMER = 102  # retransmit / timewait timer event
 
     def __init__(self, num_hosts: int, sockets_per_host: int = 8,
-                 ooo_chunks: int = OOO_CHUNKS, child_base: int = 0):
+                 ooo_chunks: int = OOO_CHUNKS, child_base: int = 0,
+                 payload_words: int = PAYLOAD_WORDS):
         """child_base partitions the slot space when an external (CPU) plane
         allocates active-open slots: device-accepted children only use slots
         >= child_base, so a pending host-side connect injection can never
@@ -382,6 +385,7 @@ class Tcp:
         self.sockets_per_host = sockets_per_host
         self.ooo_chunks = ooo_chunks
         self.child_base = child_base
+        self.payload_words = payload_words
         self._init = init(num_hosts, sockets_per_host, ooo_chunks)
         self.established_hooks = []
         self.receive_hooks = []
@@ -426,7 +430,7 @@ class Tcp:
         pending = _g(t.out_pending, slot)
         need = mask & ~pending
         H = self.num_hosts
-        pl = jnp.zeros((H, PAYLOAD_WORDS), jnp.int32)
+        pl = jnp.zeros((H, self.payload_words), jnp.int32)
         pl = pl.at[:, EV_SLOT].set(slot.astype(jnp.int32))
         emitter.emit(
             need, jnp.broadcast_to(now, (H,)).astype(jnp.int64), self._hosts(),
@@ -443,7 +447,7 @@ class Tcp:
         rto = _g(t.rto, slot)
         expire = now + rto
         H = self.num_hosts
-        pl = jnp.zeros((H, PAYLOAD_WORDS), jnp.int32)
+        pl = jnp.zeros((H, self.payload_words), jnp.int32)
         pl = pl.at[:, EV_SLOT].set(slot.astype(jnp.int32))
         pl = pl.at[:, EV_TKIND].set(TIMER_RTX)
         pl = pl.at[:, EV_GEN].set(_g(t.gen, slot))
@@ -482,6 +486,7 @@ class Tcp:
             seq=seq, ack=ack,
             wnd=jnp.full((self.num_hosts,), RECV_WND, jnp.int32),
             src_host=self._hosts(), socket_slot=slot, sack=sack,
+            payload_words=self.payload_words,
         )
         state, _ok = self.stack._tx(
             state, emitter, mask, now, dst_host, seg, params=params
@@ -597,7 +602,7 @@ class Tcp:
 
     def _emit_timer(self, emitter, mask, slot, tkind, gen, time):
         H = self.num_hosts
-        pl = jnp.zeros((H, PAYLOAD_WORDS), jnp.int32)
+        pl = jnp.zeros((H, self.payload_words), jnp.int32)
         pl = pl.at[:, EV_SLOT].set(slot.astype(jnp.int32))
         pl = pl.at[:, EV_TKIND].set(jnp.broadcast_to(
             jnp.asarray(tkind, jnp.int32), (H,)))

@@ -261,3 +261,24 @@ def test_lossy_rtx_bounded():
         + int(t.timeouts) >= rtx - 2, t
     # the transfer still completes exactly
     assert int(t.bytes_acked.sum()) == 120 * 1024
+
+
+def test_tcp_packet_trails():
+    """packet_trails covers TCP stacks: a delivered segment's breadcrumb
+    chain starts at CREATED and ends at DELIVERED (packet.c PDS_* analog
+    for the TCP path)."""
+    from shadow_tpu.net import packet as pkt
+    from shadow_tpu.net import pds as pds_mod
+
+    cfg = _bulk_cfg(total="24 KiB", loss=0.0, stop=15)
+    cfg["experimental"]["packet_trails"] = True
+    sim = build_simulation(cfg)
+    sim.run()
+    t = jax.device_get(sim.state.subs[tcp_mod.SUB])
+    assert int(t.bytes_acked.sum()) == 24 * 1024  # transfer unaffected
+    p = jax.device_get(sim.state.subs[pds_mod.SUB])
+    trails = [pkt.decode_trail(int(w)) for w in p["deliver_trail"]]
+    got = [tr for tr in trails if tr]
+    assert got, "deliveries must record trails"
+    for tr in got:
+        assert tr[0] == "CREATED" and tr[-1] == "DELIVERED", tr
