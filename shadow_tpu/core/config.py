@@ -171,6 +171,19 @@ class ExperimentalOptions:
     # extra payload word of sort traffic).
     packet_trails: bool = False
     devices: int = 1  # mesh size over the host axis
+    # Islands engine (engine.IslandSpec / parallel/islands.py): split the
+    # host axis into num_shards blocks, each owning a local event pool and
+    # a local dense window; cross-shard emissions ride a bounded
+    # all_to_all (exchange_slots rows per destination shard per window).
+    # 1 = the global single-pool engine. island_mode "vmap" batches the
+    # shards on one chip (virtual islands); "shard_map" places them on
+    # real mesh devices.
+    num_shards: int = 1
+    exchange_slots: int = 0  # 0 = auto-size
+    island_mode: str = "vmap"  # "vmap" | "shard_map"
+    # Between-window host->shard re-sharding on load skew (the P3
+    # work-stealing replacement, scheduler_policy_host_steal.c analog).
+    rebalance: bool = False
     inbox_slots: int = 8  # B: per-host intra-window self-event slots
     outbox_slots: int = 64  # O: per-host emission slots per window
     # CPU model (host/cpu.c analog): simulated processing cost per syscall
@@ -228,9 +241,17 @@ class ExperimentalOptions:
         for name in (
             "event_capacity", "events_per_host_per_window", "sockets_per_host",
             "router_queue_slots", "devices", "inbox_slots", "outbox_slots",
+            "num_shards", "exchange_slots",
         ):
             if name in d:
                 setattr(out, name, int(d[name]))
+        if "rebalance" in d:
+            out.rebalance = bool(d["rebalance"])
+        if "island_mode" in d:
+            v = str(d["island_mode"]).lower()
+            if v not in ("vmap", "shard_map"):
+                raise ConfigError(f"unknown island_mode {v!r}")
+            out.island_mode = v
         if "use_perf_timers" in d:
             out.use_perf_timers = bool(d["use_perf_timers"])
         if "use_shim_log_stamps" in d:

@@ -47,6 +47,7 @@ from flax import struct
 
 from shadow_tpu.core import rng as rng_mod
 from shadow_tpu.core import simtime, soa
+from shadow_tpu.core import spill as spill_mod
 from shadow_tpu.core.state import (
     PAYLOAD_WORDS,
     Counters,
@@ -237,8 +238,137 @@ _DT_BITS = 44
 _DT_MAX = (1 << _DT_BITS) - 1
 
 
+class IslandSpec(NamedTuple):
+    """Per-shard ("island") execution of the window kernel.
+
+    The reference's parallel design is per-worker locality: each worker owns
+    a set of hosts and their event queues, and cross-host pushes go straight
+    into the owner's queue (scheduler.c:329-353, worker.c:517-576). The TPU
+    equivalent built here: the host axis is split into `num_shards`
+    contiguous blocks, each owning a LOCAL event pool and a LOCAL dense
+    window (so per-shard sort volume drops num_shards×); cross-shard
+    emissions ride a bounded all_to_all exchange at the merge; the round
+    barrier is a pmin over the shard axis. Runs identically under
+    jax.vmap(axis_name=...) (virtual shards on one chip — batched local
+    sorts) and jax.shard_map (real devices).
+    """
+
+    axis: str  # mesh/vmap axis name
+    num_shards: int  # S
+    exchange_slots: int  # X rows per destination shard per window
+    # route by params.slot_of table instead of dst//H arithmetic —
+    # required once the rebalancer may permute host→shard assignment
+    # (compiled in from the start so a rebalance never recompiles)
+    use_slot_table: bool = False
+
+
+def _island_route(
+    m_t, m_d, m_s, m_q, m_k, m_p, *,
+    win_start, H, C, spec: IslandSpec, slot_of=None,
+):
+    """Merge-stage routing for the islands engine: one grouping sort sends
+    each row toward (destination shard 0..S-1 | local pool), a bounded
+    [S, X] block per operand rides ONE all_to_all, and the local pool is
+    assembled by concatenation — no third sort (the pool is an unordered
+    bag; extraction re-sorts by the full key every window, and truncation
+    overflow is handled by the caller's drop/spill accounting).
+
+    Reference analog: scheduler_push into the destination host's locked
+    queue (scheduler.c:232-255) — here the destination SHARD's pool, with
+    the lock replaced by the collective.
+
+    Rows that miss the bounded exchange (more than X rows for one
+    destination shard) stay in the local pool and retry next window; their
+    min time is returned so the driver can clamp the next window's END
+    below it (the destination must not process past an in-transit event).
+
+    Returns (pool_cols, dropped, sent, deferred, deferred_min) where
+    pool_cols = (t, d, s, q, k, plist) each [C].
+    """
+    S, X = spec.num_shards, spec.exchange_slots
+    SX = S * X
+    if C <= SX:
+        raise ValueError(
+            f"per-shard pool capacity {C} must exceed exchange block "
+            f"{SX} (= num_shards x exchange_slots)"
+        )
+    my_shard = jax.lax.axis_index(spec.axis).astype(jnp.int64)
+    real = m_t != NEVER
+    if slot_of is not None:
+        slot = slot_of[jnp.clip(m_d, 0, slot_of.shape[0] - 1)]
+    else:
+        slot = m_d
+    dshard = jnp.clip(slot // H, 0, S - 1).astype(jnp.int64)
+    foreign = real & (dshard != my_shard)
+    group = jnp.where(foreign, dshard, jnp.int64(S))
+    dt = jnp.clip(m_t - win_start, 0, _DT_MAX)
+    # Packed (group, dt) key. dt saturates at 2^44 ns (~4.9 h) past the
+    # window start: rows beyond that tie and fall back to stable input
+    # order — deterministic, and lossless once overflow spills instead of
+    # drops; sub-horizon rows (every realistic sim span) order exactly.
+    k1_r = (group << _DT_BITS) | dt
+    gf = jnp.repeat(jnp.arange(S, dtype=jnp.int64), X)
+    k1_f = (gf << _DT_BITS) | _DT_MAX
+    z32 = jnp.zeros((SX,), jnp.int32)
+    cat = [
+        jnp.concatenate([k1_r, k1_f]),
+        jnp.concatenate([m_t, jnp.full((SX,), NEVER, jnp.int64)]),
+        jnp.concatenate([m_d, z32]),
+        jnp.concatenate([m_s, z32]),
+        jnp.concatenate([m_q, z32]),
+        jnp.concatenate([m_k, z32]),
+    ] + [jnp.concatenate([p, jnp.zeros((SX,), jnp.int64)]) for p in m_p]
+    ops = jax.lax.sort(cat, num_keys=1, is_stable=True)
+    s_k1 = ops[0]
+    N = s_k1.shape[0]
+    s_group = s_k1 >> _DT_BITS
+    iota = jnp.arange(N, dtype=jnp.int32)
+    boundary = jnp.concatenate(
+        [jnp.ones((1,), bool), s_group[1:] != s_group[:-1]]
+    )
+    run_start = jax.lax.cummax(jnp.where(boundary, iota, -1))
+    rank = iota - run_start
+    # X fillers per group guarantee every exchange slot is claimed (filler
+    # rows carry time NEVER; receivers mask them) — the [S, X] block is a
+    # plain reshape after the slot sort, exactly the dense-window trick.
+    extract = (s_group < S) & (rank < X)
+    slot = jnp.where(extract, (s_group * X + rank.astype(jnp.int64)), SX)
+    k2 = (slot << _DT_BITS) | (s_k1 & _DT_MAX)
+    ops2 = jax.lax.sort([k2] + list(ops[1:]), num_keys=1, is_stable=True)
+    cols = ops2[1:]  # t, d, s, q, k, p...
+    sent = jnp.sum(extract & (ops[1] != NEVER), dtype=jnp.int64)
+
+    recv_cols = []
+    for c in cols:
+        blk = c[:SX].reshape((S, X) + c.shape[1:])
+        r = jax.lax.all_to_all(blk, spec.axis, 0, 0)
+        recv_cols.append(r.reshape((SX,) + c.shape[1:]))
+
+    C_keep = C - SX
+    rem = [c[SX:] for c in cols]
+    rem_t, rem_d = rem[0], rem[1]
+    dropped = jnp.sum(rem_t[C_keep:] != NEVER, dtype=jnp.int64)
+    rd = rem_d[:C_keep]
+    if slot_of is not None:
+        rslot = slot_of[jnp.clip(rd, 0, slot_of.shape[0] - 1)]
+    else:
+        rslot = rd
+    def_mask = (rem_t[:C_keep] != NEVER) & (
+        jnp.clip(rslot // H, 0, S - 1).astype(jnp.int64) != my_shard
+    )
+    deferred = jnp.sum(def_mask, dtype=jnp.int64)
+    deferred_min = jnp.min(
+        jnp.where(def_mask, rem_t[:C_keep], NEVER)
+    )
+    pool_cols = [
+        jnp.concatenate([r[:C_keep], rc])
+        for r, rc in zip(rem, recv_cols)
+    ]
+    return pool_cols, dropped, sent, deferred, deferred_min
+
+
 def _dense_extract(pool: EventPool, win_start, win_end, H: int, Kc: int,
-                   PP: int):
+                   PP: int, lrow=None):
     """Extract the window into a dense [H, Kc] matrix with SORTS AND SCANS
     ONLY (profiled on v5e: large gathers serialize at ~9 ns/element while
     multi-operand sorts run near memory bandwidth — so every event column
@@ -270,8 +400,17 @@ def _dense_extract(pool: EventPool, win_start, win_end, H: int, Kc: int,
     HK = H * Kc
     N = C + HK
     hosts = jnp.arange(H, dtype=jnp.int32)
-    inwin = pool.time < win_end
-    run_key = jnp.where(inwin, pool.dst, jnp.int32(H)).astype(jnp.int64)
+    # Local row of each event's destination: dst itself on the global
+    # engine; the caller passes the shard-relative row under islands
+    # (contiguous-block arithmetic or the slot_of rebalance table).
+    # Foreign rows (in-transit exchange deferrals) fall outside [0, H) and
+    # must not extract — they ride the tail into the merge, where
+    # _island_route retries them.
+    if lrow is None:
+        lrow = pool.dst
+    local = (lrow >= 0) & (lrow < H)
+    inwin = (pool.time < win_end) & local
+    run_key = jnp.where(inwin, lrow, jnp.int32(H)).astype(jnp.int64)
     dt = jnp.clip(pool.time - win_start, 0, _DT_MAX)
     k1_r = (run_key << _DT_BITS) | dt
     k2_r = (pool.src.astype(jnp.int64) << 32) | (
@@ -409,6 +548,7 @@ def make_window_step(
     bulk_gate: Callable | None = None,
     bulk_self_excluded: bool = False,
     payload_words: int = PAYLOAD_WORDS,
+    island: IslandSpec | None = None,
     _force_path: str | None = None,  # "matrix"|"loop": testing/profiling only
 ):
     """Build step(state, params, win_start, win_end) -> (state, min_next).
@@ -439,7 +579,6 @@ def make_window_step(
     H = num_hosts
     if max_iters is None:
         max_iters = K + 4 * B + 16
-    hosts = jnp.arange(H, dtype=jnp.int32)
     kinds = sorted(handlers)
     if bulk_kinds and len(bulk_kinds) > 1:
         raise ValueError("at most one bulk kind is supported")
@@ -456,6 +595,20 @@ def make_window_step(
         win_start = jnp.asarray(win_start, jnp.int64)
         win_end = jnp.asarray(win_end, jnp.int64)
         state = state.replace(now=win_start)
+        # GLOBAL host id per local row: arange on the global engine, the
+        # shard's contiguous block (or rebalanced permutation) under
+        # islands. Every "my host id" use below (self-routing, emission
+        # src stamping) is gid, never arange.
+        gid = state.host.gid
+        if island is None:
+            _lrow = None  # dst IS the row
+        elif island.use_slot_table:
+            base = jax.lax.axis_index(island.axis).astype(jnp.int32) * H
+            _lrow = params.slot_of[
+                jnp.clip(state.pool.dst, 0, params.slot_of.shape[0] - 1)
+            ] - base
+        else:
+            _lrow = state.pool.dst - gid[0]
 
         # Static per-kind emission bound: probe the handlers once at trace
         # time with an all-masked-off event and count emit() calls per
@@ -500,6 +653,66 @@ def make_window_step(
             # a full batch always fits the outbox (the gate already makes
             # batching best-effort per host)
             G_run = max(1, O // max(1, int(E_by_kind[bulk_kind])))
+
+        def assemble(state, m_t, m_d, m_s, m_q, m_k, m_p):
+            """Merge candidates → next pool. Global engine: ONE 1-key stable
+            sort by time, truncate to capacity. Islands: route through
+            _island_route (grouping sort + bounded all_to_all + concat
+            assembly) — cross-shard rows land in their owner's pool here,
+            the TPU form of scheduler_push (scheduler.c:232-255)."""
+            C = state.pool.capacity
+            if island is None:
+                ops3 = jax.lax.sort(
+                    [m_t, m_d, m_s, m_q, m_k] + m_p, num_keys=1,
+                    is_stable=True,
+                )
+                dropped = jnp.sum(ops3[0][C:] != NEVER, dtype=jnp.int64)
+                new_pool = EventPool(
+                    time=ops3[0][:C], dst=ops3[1][:C], src=ops3[2][:C],
+                    seq=ops3[3][:C], kind=ops3[4][:C],
+                    payload=jnp.stack([o[:C] for o in ops3[5:]], axis=-1),
+                )
+                return state.replace(
+                    pool=new_pool,
+                    exch_deferred_min=jnp.asarray(NEVER, jnp.int64),
+                    counters=state.counters.replace(
+                        pool_overflow_dropped=(
+                            state.counters.pool_overflow_dropped + dropped
+                        )
+                    ),
+                )
+            cols, dropped, sent, deferred, dmin = _island_route(
+                m_t, m_d, m_s, m_q, m_k, m_p,
+                win_start=win_start, H=H, C=C, spec=island,
+                slot_of=params.slot_of if island.use_slot_table else None,
+            )
+            new_pool = EventPool(
+                time=cols[0], dst=cols[1], src=cols[2],
+                seq=cols[3], kind=cols[4],
+                payload=jnp.stack(cols[5:], axis=-1),
+            )
+            c = state.counters
+            return state.replace(
+                pool=new_pool,
+                exch_deferred_min=dmin,
+                counters=c.replace(
+                    pool_overflow_dropped=c.pool_overflow_dropped + dropped,
+                    exchange_sent=c.exchange_sent + sent,
+                    exchange_deferred=c.exchange_deferred + deferred,
+                ),
+            )
+
+        # Merge-absorption budget for the pool-headroom stall: the merge
+        # truncates at capacity (minus the islands' reserved exchange
+        # block), so a window may generate at most C_keep − occupancy new
+        # box rows without dropping. Computed once per window (the pool
+        # does not change until the merge).
+        _C_keep = state.pool.capacity - (
+            island.num_shards * island.exchange_slots if island else 0
+        )
+        pool_budget = jnp.int32(_C_keep) - jnp.sum(
+            state.pool.time != NEVER, dtype=jnp.int32
+        )
 
         # The loop path's machinery closes over the dense window extraction;
         # building it in a factory keeps the extraction sorts INSIDE the
@@ -559,7 +772,7 @@ def make_window_step(
                         # the HEAD is part of the batch too: a loopback
                         # head may emit a same-time self reply that
                         # deserves to interleave before any batched extra
-                        prev = prev & (m_src != hosts)
+                        prev = prev & (m_src != gid)
                     if bulk_gate is not None:
                         gate = bulk_gate(state, params, win_start, win_end)
                         prev = prev & (gate > 0)
@@ -575,7 +788,7 @@ def make_window_step(
                             & _key_lt(tg, sg, qg, i_time, i_src, i_seq)
                         )
                         if bulk_self_excluded:
-                            okg = okg & (sg != hosts)
+                            okg = okg & (sg != gid)
                         if bulk_gate is not None:
                             okg = okg & (gate >= g)
                         bulk_t.append(tg)
@@ -603,8 +816,24 @@ def make_window_step(
                         need_base = jnp.where(ev_kind == k, e_k, need_base)
                 need = need_base * (1 + g_extra)
                 room = (outbox.count + need) <= O
-                valid = (ev_time < win_end) & room
-                stalled = (ev_time < win_end) & ~room
+                # Pool-headroom backpressure (the never-drop invariant,
+                # scheduler.c:232-255): the merge can only absorb
+                # C − occupancy new box rows, so hosts whose emissions
+                # would overflow the pool STALL this window (defer, never
+                # drop). Budget is claimed in host-index order via an
+                # exclusive cumsum — deterministic, and host 0 always
+                # fits, so every window makes progress. Common case (ample
+                # headroom): every host passes, the gate folds away.
+                hot = ev_time < win_end
+                box_used = (
+                    jnp.sum(outbox.count)
+                    + jnp.sum(inbox.time != NEVER, dtype=jnp.int32)
+                )
+                need_hot = jnp.where(hot, need, 0)
+                cum = jnp.cumsum(need_hot) - need_hot  # exclusive
+                fits = (box_used + cum + need_hot) <= pool_budget
+                valid = hot & room & fits
+                stalled = hot & ~(room & fits)
 
                 # --- CPU model (host/cpu.c analog): a loaded host's events
                 # serialize on its virtual CPU — event at t EXECUTES at
@@ -718,9 +947,9 @@ def make_window_step(
                     # not jump the queue: route them through the pool.
                     is_self = (
                         em.mask
-                        & (em.dst == hosts)
+                        & (em.dst == gid)
                         & (em.time < win_end)
-                        & _key_lt(em.time, hosts, seq,
+                        & _key_lt(em.time, gid, seq,
                                   defer_time, defer_src, defer_seq)
                     )
 
@@ -734,7 +963,7 @@ def make_window_step(
                     to_out = em.mask & ~ins
                     inbox = inbox.replace(
                         time=_set_col(inbox.time, ff, ins, em.time),
-                        src=_set_col(inbox.src, ff, ins, hosts),
+                        src=_set_col(inbox.src, ff, ins, gid),
                         seq=_set_col(inbox.seq, ff, ins, seq),
                         kind=_set_col(inbox.kind, ff, ins, em.kind),
                         payload=_set_col(inbox.payload, ff, ins, emp),
@@ -745,7 +974,7 @@ def make_window_step(
                     outbox = outbox.replace(
                         time=_set_col(outbox.time, ocol, put, em.time),
                         dst=_set_col(outbox.dst, ocol, put, em.dst),
-                        src=_set_col(outbox.src, ocol, put, hosts),
+                        src=_set_col(outbox.src, ocol, put, gid),
                         seq=_set_col(outbox.seq, ocol, put, seq),
                         kind=_set_col(outbox.kind, ocol, put, em.kind),
                         payload=_set_col(outbox.payload, ocol, put, emp),
@@ -783,7 +1012,7 @@ def make_window_step(
                 dcols = jnp.arange(Kc, dtype=jnp.int32)
                 left = dcols[None, :] >= ptr[:, None]  # unconsumed cells
                 l_t = jnp.where(left, dense.time, NEVER).reshape(-1)
-                l_d = jnp.broadcast_to(hosts[:, None], (H, Kc)).reshape(-1)
+                l_d = jnp.broadcast_to(gid[:, None], (H, Kc)).reshape(-1)
                 l_s = dense.src.reshape(-1)
                 l_q = dense.seq.reshape(-1)
                 l_k = dense.kind.reshape(-1)
@@ -800,45 +1029,31 @@ def make_window_step(
                     )
                     for w in range(PP)
                 ]
-                ops3 = jax.lax.sort(
-                    [m_t, m_d, m_s, m_q, m_k] + m_p, num_keys=1,
-                    is_stable=True,
-                )
-                dropped = jnp.sum(ops3[0][C:] != NEVER, dtype=jnp.int64)
-                new_pool = EventPool(
-                    time=ops3[0][:C], dst=ops3[1][:C], src=ops3[2][:C],
-                    seq=ops3[3][:C], kind=ops3[4][:C],
-                    payload=jnp.stack([o[:C] for o in ops3[5:]], axis=-1),
-                )
-                if bt.shape[0]:
+                if island is None and bt.shape[0]:
                     cross = (bd != bs) & (bt != NEVER)
                     dst_last = state.host.done_t[jnp.clip(bd, 0, H - 1)]
                     violates = cross & (bt <= dst_last)
                     xmit_min = jnp.min(jnp.where(violates, bt, NEVER))
                 else:
+                    # islands run conservative-only: cross-shard progress
+                    # clocks would need a collective per emission row
                     xmit_min = jnp.asarray(NEVER, jnp.int64)
-                state = state.replace(
-                    pool=new_pool,
-                    xmit_min=xmit_min,
-                    counters=state.counters.replace(
-                        pool_overflow_dropped=state.counters.pool_overflow_dropped
-                        + dropped
-                    ),
-                )
-                return state, jnp.min(new_pool.time)
+                state = assemble(state, m_t, m_d, m_s, m_q, m_k, m_p)
+                state = state.replace(xmit_min=xmit_min)
+                return state, jnp.min(state.pool.time)
 
             return carry0, cond, body, finish
 
         def run_loop(state):
             dense, tail = _dense_extract(
-                state.pool, win_start, win_end, H, K + 1, PP
+                state.pool, win_start, win_end, H, K + 1, PP, lrow=_lrow,
             )
             carry0, cond, body, finish = make_loop_fns(dense, tail)
             state, ptr, inbox, outbox, _, _ = jax.lax.while_loop(
                 cond, body, (state,) + carry0
             )
             hostsB = jnp.broadcast_to(
-                hosts[:, None], inbox.time.shape
+                gid[:, None], inbox.time.shape
             ).reshape(-1)
             return finish(
                 state, ptr,
@@ -874,8 +1089,9 @@ def make_window_step(
             bandwidth, so this path is built from sorts, cumulative scans,
             and reshapes ONLY (_dense_extract)."""
             pool = state.pool
-            C = pool.capacity
-            dense, tail = _dense_extract(pool, win_start, win_end, H, K, PP)
+            dense, tail = _dense_extract(
+                pool, win_start, win_end, H, K, PP, lrow=_lrow
+            )
             d_t, d_s, d_q = dense.time, dense.src, dense.seq
             d_p = dense.payload
             # fillers interleave with real same-host rows only at time
@@ -937,7 +1153,7 @@ def make_window_step(
             col_excl = jnp.cumsum(per_col, axis=1) - per_col
             seen = jnp.zeros((H, K), dtype=jnp.int32)
             em_rows = []  # per record: (time, dst, src, seq, kind, pcols)
-            hostsK = jnp.broadcast_to(hosts[:, None], (H, K))
+            hostsK = jnp.broadcast_to(gid[:, None], (H, K))
             for j, r in enumerate(memit.records):
                 seqj = base[:, None] + col_excl + seen
                 seen = seen + masks[j]
@@ -993,19 +1209,11 @@ def make_window_step(
                 jnp.concatenate([tail.payload[w]] + [e[5][w] for e in em_rows])
                 for w in range(PP)
             ]
-            ops3 = jax.lax.sort(
-                [m_t, m_d, m_s, m_q, m_k] + m_p, num_keys=1, is_stable=True
-            )
-            n_t, n_d, n_s, n_q, n_k = (o[:C] for o in ops3[:5])
-            n_p = jnp.stack([o[:C] for o in ops3[5:]], axis=-1)
-            dropped = jnp.sum(ops3[0][C:] != NEVER, dtype=jnp.int64)
-            new_pool = EventPool(
-                time=n_t, dst=n_d, src=n_s, seq=n_q, kind=n_k, payload=n_p
-            )
+            state = assemble(state, m_t, m_d, m_s, m_q, m_k, m_p)
             # speculation-violation signal (optimistic synchronizer): the
             # one place a by-dst lookup is unavoidable; emissions are the
             # only candidate violators (leftovers already lived in the pool)
-            if em_rows:
+            if em_rows and island is None:
                 e_t = jnp.concatenate([e[0] for e in em_rows])
                 e_d = jnp.concatenate([e[1] for e in em_rows])
                 e_s = jnp.concatenate([e[2] for e in em_rows])
@@ -1027,15 +1235,8 @@ def make_window_step(
                 )
             else:
                 xmit_min = jnp.asarray(NEVER, jnp.int64)
-            state = state.replace(
-                pool=new_pool,
-                xmit_min=xmit_min,
-                counters=state.counters.replace(
-                    pool_overflow_dropped=state.counters.pool_overflow_dropped
-                    + dropped
-                ),
-            )
-            return state, jnp.min(new_pool.time)
+            state = state.replace(xmit_min=xmit_min)
+            return state, jnp.min(state.pool.time)
 
         if bulk_kind is None or bulk_kind not in matrix_handlers:
             return run_loop(state)
@@ -1134,6 +1335,15 @@ class Simulation:
         with_cpu = cpu_ns_per_event is not None and bool(
             np.any(np.asarray(cpu_ns_per_event) > 0)
         )
+        # Stash the kernel build config so parallel/islands.py (and any
+        # other re-wiring subclass) can rebuild the window step with a
+        # different execution layout.
+        self._bulk_kinds = bulk_kinds
+        self._matrix_handlers = matrix_handlers
+        self._with_cpu = with_cpu
+        self._bulk_gate = bulk_gate
+        self._bulk_self_excluded = bulk_self_excluded
+        self._payload_words = payload_words
         host = make_host_state(
             num_hosts, host_vertex,
             cpu_cost=cpu_ns_per_event if with_cpu else None,
@@ -1166,13 +1376,20 @@ class Simulation:
         def run_to(state: SimState, params: NetParams, stop, max_windows):
             """Advance up to max_windows windows (or until stop). Bounding
             the on-device while_loop keeps each dispatch short — long single
-            dispatches can trip accelerator-runtime watchdogs."""
+            dispatches can trip accelerator-runtime watchdogs.
+
+            Exits early (third return value True) when pool occupancy
+            crosses the spill red zone, so the driver can drain overflow to
+            host memory BEFORE the merge would drop rows (core/spill.py) —
+            one compare per window, no extra sorts."""
             stop = jnp.asarray(stop, jnp.int64)
             max_windows = jnp.asarray(max_windows, jnp.int32)
+            hi = self._spill_marks()[0]
 
             def cond(c):
                 state, mn, w = c
-                return (mn < stop) & (w < max_windows)
+                occ = jnp.sum(state.pool.time != NEVER)
+                return (mn < stop) & (w < max_windows) & (occ < hi)
 
             def body(c):
                 state, mn, w = c
@@ -1185,20 +1402,35 @@ class Simulation:
             state, mn, _ = jax.lax.while_loop(
                 cond, body, (state, mn0, jnp.int32(0))
             )
-            return state, mn
+            press = jnp.sum(state.pool.time != NEVER) >= hi
+            return state, mn, press
 
         return run_to
 
     # -- host-driven round loop (one device sync per window; debuggable) --
     def run_stepwise(self, until: int | None = None) -> int:
         stop = self.stop_time if until is None else min(until, self.stop_time)
+        spill = self._spill_store()
         windows = 0
-        min_next = int(jnp.min(self.state.pool.time))
-        while min_next < stop:
+        stall = 0
+        while True:
+            stop_at = spill_mod.manage(self, spill, stop)
+            min_next = int(jnp.min(self.state.pool.time))
+            if min_next >= stop_at:
+                if min_next >= stop and spill.min_time >= stop:
+                    break
+                stall += 1
+                if stall > 2:
+                    raise RuntimeError(
+                        "spill tier cannot make progress (a single "
+                        "timestamp holds more events than the pool fill "
+                        "mark); raise experimental.event_capacity"
+                    )
+                continue
+            stall = 0
             ws = min_next
-            we = min(ws + self.runahead, stop)
+            we = min(ws + self.runahead, stop_at)
             self.state, mn = self._step(self.state, self.params, ws, we)
-            min_next = int(mn)
             windows += 1
         return windows
 
@@ -1277,17 +1509,59 @@ class Simulation:
             windows += 1
         return windows, rollbacks
 
+    # -- host-spill tier (core/spill.py): the pool never silently drops --
+    def _spill_marks(self) -> tuple[int, int, int]:
+        """(pressure mark, rebalance fill mark, single-host admission cap)
+        in pool rows per shard. Pressure must fire while the merge can
+        still absorb one window's inflow; the fill mark sits below
+        pressure so a rebalance exits the red zone; the cap bounds how
+        many rows one host may occupy when partially resident
+        (core/spill.py HostSpill.rebalance)."""
+        C = int(self.state.pool.time.shape[-1])
+        hi = C - spill_mod.red_zone(C)
+        return hi, max(1, (3 * hi) // 4), max(1, C - 64)
+
+    def _spill_store(self):
+        if getattr(self, "_spill", None) is None:
+            from shadow_tpu.core import spill as spill_mod2
+
+            t = self.state.pool.time
+            S = t.shape[0] if t.ndim == 2 else 1
+            self._spill = spill_mod2.HostSpill(
+                S, self.state.pool.payload.shape[-1]
+            )
+        return self._spill
+
+    def spill_stats(self) -> dict:
+        return self._spill_store().stats()
+
     # -- fused run: windows execute in on-device while_loop chunks --
     def run(
         self, until: int | None = None, windows_per_dispatch: int = 64
     ) -> None:
         stop = self.stop_time if until is None else min(until, self.stop_time)
+        spill = self._spill_store()
+        last = None
         while True:
-            self.state, mn = self._run_to(
-                self.state, self.params, stop, windows_per_dispatch
+            active = (last is not None and last[2]) or spill.count
+            stop_at = spill_mod.manage(self, spill, stop) if active else stop
+            # whole-host spill residency is only exact with a manage pass
+            # between consecutive windows (core/spill.py manage docstring)
+            wpd = 1 if spill.count else windows_per_dispatch
+            self.state, mn, press = self._run_to(
+                self.state, self.params, stop_at, wpd
             )
-            if int(mn) >= stop:
+            mn, press = int(mn), bool(press)
+            if mn >= stop and spill.min_time >= stop and not press:
                 break
+            cur = (mn, spill.count, press)
+            if cur == last and mn >= stop_at:
+                raise RuntimeError(
+                    "spill tier cannot make progress (a single timestamp "
+                    "holds more events than the pool fill mark); raise "
+                    "experimental.event_capacity"
+                )
+            last = cur
 
     def counters(self) -> dict[str, int]:
         c = jax.device_get(self.state.counters)

@@ -124,6 +124,12 @@ class Counters:
     # total ns of CPU-model execution deferral applied to device events
     # (tracker_addVirtualProcessingDelay analog); 0 when the model is off
     cpu_delay_applied: jnp.ndarray
+    # islands engine (parallel/islands.py): cross-shard rows shipped
+    # through the all_to_all exchange, and rows that missed the bounded
+    # exchange window and deferred (retried next window under the
+    # exch_deferred_min window-end clamp — late but never lost)
+    exchange_sent: jnp.ndarray
+    exchange_deferred: jnp.ndarray
 
     @classmethod
     def zeros(cls) -> "Counters":
@@ -138,6 +144,13 @@ class HostState:
     seq_next: jnp.ndarray  # i32: next event sequence number for emissions
     rng_counter: jnp.ndarray  # u32: per-host RNG draw counter
     vertex: jnp.ndarray  # i32: used-vertex index in the baked topology
+    # GLOBAL host id of each local row. On the global engine this is
+    # arange(H); on the islands engine each shard holds the contiguous
+    # block [shard*H_local, (shard+1)*H_local). Handlers MUST use this —
+    # never jnp.arange(H) — wherever a value means "my host id" (packet
+    # src fields, loopback compares, self-addressed timer emissions):
+    # under islands arange would alias every shard onto shard 0's ids.
+    gid: jnp.ndarray  # i32
     # Max event time processed since the optimistic synchronizer last reset
     # it (-1 = none): the per-host progress clock that speculation
     # violations are judged against. Unused by conservative runs.
@@ -159,6 +172,18 @@ class NetParams:
     reliability_vv: jnp.ndarray  # [U, U] f32
     bootstrap_end: jnp.ndarray  # [] i64: no drops before this time
     # (configuration.rs:149-152, worker.c:536-545)
+    # GLOBAL host→vertex table, replicated to every shard. Destination
+    # host ids are global, so by-dst latency lookups under the islands
+    # engine must not index the shard-local host.vertex rows. None on
+    # single-vertex topologies (every lookup broadcasts) and legacy tests.
+    vertex_g: jnp.ndarray | None = None
+    # Islands re-sharding (scheduler_policy_host_steal.c analog): global
+    # host id → SLOT in the permuted island layout (shard = slot // H_l,
+    # local row = slot % H_l). None = static contiguous blocks (slot is
+    # the identity, routing is pure arithmetic). A rebalance permutes host
+    # rows across shards and rewrites this table — params are runtime
+    # arguments, so no recompilation.
+    slot_of: jnp.ndarray | None = None
 
 
 @struct.dataclass
@@ -175,6 +200,15 @@ class SimState:
     # to detect speculation violations (SURVEY §7.6); conservative windows
     # satisfy xmit_min >= window end by construction.
     xmit_min: jnp.ndarray = struct.field(
+        default_factory=lambda: jnp.asarray(simtime.NEVER, jnp.int64)
+    )
+    # Islands engine: min event time among cross-shard rows that missed the
+    # bounded exchange this window (NEVER if none). The driver clamps the
+    # next window's END to this so the destination shard cannot process
+    # past an in-transit event — the conservative invariant survives
+    # exchange backpressure (see parallel/islands.py). Always NEVER on the
+    # global engine.
+    exch_deferred_min: jnp.ndarray = struct.field(
         default_factory=lambda: jnp.asarray(simtime.NEVER, jnp.int64)
     )
     # Subsystem states keyed by name ("nic", "udp", "tcp", app models...).
@@ -196,6 +230,7 @@ def make_host_state(
         seq_next=jnp.zeros((num_hosts,), dtype=jnp.int32),
         rng_counter=jnp.zeros((num_hosts,), dtype=jnp.uint32),
         vertex=jnp.asarray(host_vertex, dtype=jnp.int32),
+        gid=jnp.arange(num_hosts, dtype=jnp.int32),
         done_t=jnp.full((num_hosts,), -1, dtype=jnp.int64),
         cpu_cost=(
             jnp.asarray(cpu_cost, dtype=jnp.int64)

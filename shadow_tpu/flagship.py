@@ -24,6 +24,9 @@ def build_phold_flagship(
     event_capacity: int | None = None,
     K: int | None = None,
     seed: int = 42,
+    num_shards: int = 1,
+    island_mode: str = "vmap",
+    exchange_slots: int = 0,
 ):
     from shadow_tpu.sim import build_simulation
 
@@ -44,6 +47,19 @@ def build_phold_flagship(
         # per-wave straggler probability near zero beyond 100k hosts while
         # the [H, K] filler block stays modest.
         K = msgload + 16
+    island_exp = {}
+    if num_shards > 1:
+        if exchange_slots <= 0:
+            # PHOLD cross-shard volume per window per destination shard:
+            # one wave ≈ Hl·msgload emissions per shard spread uniformly
+            # over S destinations, 2x headroom for wave clustering
+            hl = num_hosts // num_shards
+            exchange_slots = max(64, 2 * hl * msgload // num_shards)
+        island_exp = {
+            "num_shards": num_shards,
+            "island_mode": island_mode,
+            "exchange_slots": exchange_slots,
+        }
     return build_simulation(
         {
             "general": {"stop_time": stop_s, "seed": seed},
@@ -51,6 +67,7 @@ def build_phold_flagship(
             "experimental": {
                 "event_capacity": event_capacity,
                 "events_per_host_per_window": K,
+                **island_exp,
                 # PHOLD emits exactly one event per handled event, so K
                 # outbox slots per host can never overflow; small boxes keep
                 # the per-window merge sort lean (the hot cost at scale).

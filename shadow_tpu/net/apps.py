@@ -43,12 +43,33 @@ class PholdApp:
         size_bytes: int = 64,
         start_time: int = simtime.NS_PER_SEC,
         runtime: int = 5 * simtime.NS_PER_SEC,
+        hot_frac: float = 0.0,
+        hot_share: float = 0.0,
     ):
         self.num_hosts = num_hosts
         self.msgload = msgload
         self.size_bytes = size_bytes
         self.start_time = start_time
         self.stop_sending = start_time + runtime
+        # Skewed-destination variant (the work-stealing benchmark shape,
+        # scheduler_policy_host_steal.c's raison d'etre): hot_share of
+        # all messages target the first hot_frac of hosts. hot_frac 0 =
+        # classic uniform PHOLD. The hot variant permits self-sends
+        # (they arrive at +latency, respecting the bulk contract).
+        self.hot_frac = float(hot_frac)
+        self.hot_share = float(hot_share)
+        if (self.hot_frac > 0) != (self.hot_share > 0):
+            raise ValueError(
+                "phold hot_frac and hot_share must be set together"
+            )
+        if not (0.0 <= self.hot_share < 1.0) or not (
+            0.0 <= self.hot_frac <= 1.0
+        ):
+            raise ValueError("hot_share must be in [0,1), hot_frac in [0,1]")
+        self.hot_n = (
+            max(1, int(num_hosts * self.hot_frac))
+            if self.hot_frac > 0 else 0
+        )
 
     def init_sub(self) -> dict:
         H = self.num_hosts
@@ -79,21 +100,15 @@ class PholdApp:
     def handle_msg(
         self, state: SimState, ev: EventView, emitter: Emitter, params: NetParams
     ) -> SimState:
-        H = self.num_hosts
-        hosts = jnp.arange(H, dtype=jnp.int32)
+        H = self.num_hosts  # GLOBAL host count (destination id range)
+        hosts = state.host.gid  # global ids of this shard's rows
         sub = state.subs[self.SUB]
         sub = dict(sub)
         sub["received"] = sub["received"] + ev.mask.astype(jnp.int64)
 
         send_mask = ev.mask & (ev.time < self.stop_sending)
-        # Uniform peer choice over the other H-1 hosts.
         state, u = draw_uniform(state, send_mask)
-        if H > 1:
-            dst = jnp.floor(u * (H - 1)).astype(jnp.int32)
-            dst = jnp.clip(dst, 0, H - 2)
-            dst = dst + (dst >= hosts)  # skip self
-        else:
-            dst = hosts
+        dst = self._pick_dst(u, hosts)
         sub["forwarded"] = sub["forwarded"] + send_mask.astype(jnp.int64)
         state = state.with_sub(self.SUB, sub)
         return link.send(
@@ -108,6 +123,26 @@ class PholdApp:
             self.size_bytes,
         )
 
+    def _pick_dst(self, u, my_id):
+        """Map one uniform draw to a destination. Uniform mode skips self
+        exactly like the reference's `(me + 1 + rand%(H-1)) %% H`; the hot
+        variant splits the unit interval at hot_share."""
+        H = self.num_hosts
+        if self.hot_n > 0:
+            hs = self.hot_share
+            nh = self.hot_n
+            hot = jnp.floor(u / hs * nh).astype(jnp.int32)
+            cold = nh + jnp.floor(
+                (u - hs) / (1.0 - hs) * (H - nh)
+            ).astype(jnp.int32)
+            return jnp.clip(
+                jnp.where(u < hs, hot, cold), 0, H - 1
+            )
+        if H <= 1:
+            return jnp.broadcast_to(jnp.asarray(my_id), u.shape)
+        dst = jnp.clip(jnp.floor(u * (H - 1)).astype(jnp.int32), 0, H - 2)
+        return dst + (dst >= my_id)  # skip self
+
     def handlers(self):
         return {KIND_APP_MSG: self.handle_msg}
 
@@ -118,8 +153,8 @@ class PholdApp:
         draws use counters c0 + 2·(#sends before k) and +1 — REQUIRES an
         all-reachable topology so every send costs exactly two draws
         (sim.py only registers this handler when that holds)."""
-        H = self.num_hosts
-        hosts = jnp.arange(H, dtype=jnp.int32)
+        H = self.num_hosts  # GLOBAL host count (destination id range)
+        hosts = state.host.gid
         sub = dict(state.subs[self.SUB])
         sub["received"] = sub["received"] + jnp.sum(
             mv.mask, axis=1, dtype=jnp.int64
@@ -136,23 +171,28 @@ class PholdApp:
                 rng_counter=c0 + 2 * jnp.sum(si, axis=1, dtype=jnp.uint32)
             )
         )
-        if H > 1:
-            dst = jnp.clip(
-                jnp.floor(u1 * (H - 1)).astype(jnp.int32), 0, H - 2
-            )
-            dst = dst + (dst >= hosts[:, None])
-        else:
-            dst = jnp.broadcast_to(hosts[:, None], send.shape)
+        dst = self._pick_dst(u1, hosts[:, None])
         sub["forwarded"] = sub["forwarded"] + jnp.sum(
             send, axis=1, dtype=jnp.int64
         )
         state = state.with_sub(self.SUB, sub)
         # link.send in matrix form (worker.c:517-576): latency lookup,
-        # reliability roll, delivery emission
-        vd = state.host.vertex[dst]  # [H, K]
-        vs = jnp.broadcast_to(state.host.vertex[:, None], vd.shape)
-        lat = params.latency_vv[vs, vd]
-        rel = params.reliability_vv[vs, vd]
+        # reliability roll, delivery emission. Single-vertex topologies
+        # broadcast; the general case reads the replicated global
+        # host->vertex table (params.vertex_g) so dst — a GLOBAL id —
+        # never indexes the shard-local vertex array.
+        if params.latency_vv.shape[0] == 1:
+            lat = jnp.broadcast_to(params.latency_vv[0, 0], dst.shape)
+            rel = jnp.broadcast_to(params.reliability_vv[0, 0], dst.shape)
+        else:
+            vd = (
+                params.vertex_g[dst]
+                if params.vertex_g is not None
+                else state.host.vertex[dst]
+            )  # [H, K]
+            vs = jnp.broadcast_to(state.host.vertex[:, None], vd.shape)
+            lat = params.latency_vv[vs, vd]
+            rel = params.reliability_vv[vs, vd]
         kept = (mv.time < params.bootstrap_end) | (u2 < rel)
         emitter.emit(
             send & kept, mv.time + lat, dst, jnp.int32(KIND_APP_MSG),
@@ -231,9 +271,13 @@ class UdpFloodApp:
 
     def init_sub(self) -> dict:
         H = self.num_hosts
+        # role/target ride in the sub-state (not python closures) so the
+        # islands engine shards them with every other [H]-leading array
         return {
             "sent": jnp.zeros((H,), jnp.int64),
             "recv": jnp.zeros((H,), jnp.int64),
+            "role": self._role,
+            "target": self._target,
         }
 
     def initial_events(self):
@@ -244,27 +288,26 @@ class UdpFloodApp:
         ]
 
     def on_timer(self, state, ev, emitter, params):
-        send = ev.mask & (self._role == 1)
+        sub = dict(state.subs[self.SUB])
+        send = ev.mask & (sub["role"] == 1)
         if self.stop_sending is not None:
             send = send & (ev.time < self.stop_sending)
-        sub = dict(state.subs[self.SUB])
         sub["sent"] = sub["sent"] + send.astype(jnp.int64)
         state = state.with_sub(self.SUB, sub)
         state = self.stack.udp_sendto(
-            state, emitter, send, ev.time, self._target, SERVER_PORT,
+            state, emitter, send, ev.time, sub["target"], SERVER_PORT,
             CLIENT_PORT_BASE, self.size_bytes, 0,
             params=params,
         )
-        hosts = jnp.arange(self.num_hosts, dtype=jnp.int32)
         emitter.emit(
-            send, ev.time + self.interval_ns, hosts,
+            send, ev.time + self.interval_ns, state.host.gid,
             jnp.int32(KIND_APP_TIMER), ev.payload,
         )
         return state
 
     def on_receive(self, state, mask, slot, src, payload, emitter, now, params):
-        got = mask & (self._role == 0)
         sub = dict(state.subs[self.SUB])
+        got = mask & (sub["role"] == 0)
         sub["recv"] = sub["recv"] + got.astype(jnp.int64)
         return state.with_sub(self.SUB, sub)
 
@@ -319,6 +362,7 @@ class UdpEchoApp:
             "echoed": jnp.zeros((H,), jnp.int64),
             "rtt_sum": jnp.zeros((H,), jnp.int64),
             "rtt_count": jnp.zeros((H,), jnp.int64),
+            "role": self._role,
         }
 
     def initial_events(self):
@@ -329,12 +373,12 @@ class UdpEchoApp:
         ]
 
     def on_timer(self, state, ev, emitter, params):
-        H = self.num_hosts
-        hosts = jnp.arange(H, dtype=jnp.int32)
-        send = ev.mask & (self._role == 1)
+        hosts = state.host.gid
+        H = hosts.shape[0]
+        sub = dict(state.subs[self.SUB])
+        send = ev.mask & (sub["role"] == 1)
         if self.stop_sending is not None:
             send = send & (ev.time < self.stop_sending)
-        sub = dict(state.subs[self.SUB])
         sub["sent"] = sub["sent"] + send.astype(jnp.int64)
         state = state.with_sub(self.SUB, sub)
         # The send timestamp travels IN the datagram (spare seq/ack words)
@@ -363,14 +407,13 @@ class UdpEchoApp:
         return state
 
     def on_receive(self, state, mask, slot, src, payload, emitter, now, params):
-        H = self.num_hosts
-        hosts = jnp.arange(H, dtype=jnp.int32)
-        # server: echo back to (src, src_port), preserving the timestamp words
-        server_got = mask & (self._role == 0)
+        hosts = state.host.gid
         sub = dict(state.subs[self.SUB])
+        # server: echo back to (src, src_port), preserving the timestamp words
+        server_got = mask & (sub["role"] == 0)
         sub["echoed"] = sub["echoed"] + server_got.astype(jnp.int64)
         # client: RTT from the echoed timestamp
-        client_got = mask & (self._role == 1)
+        client_got = mask & (sub["role"] == 1)
         rtt = now - pkt.unpack_time(payload)
         sub["rtt_sum"] = sub["rtt_sum"] + jnp.where(client_got, rtt, 0)
         sub["rtt_count"] = sub["rtt_count"] + client_got.astype(jnp.int64)
@@ -440,6 +483,8 @@ class TcpBulkApp:
             "connected": jnp.zeros((H,), jnp.int64),
             "accepted": jnp.zeros((H,), jnp.int64),
             "eof_seen": jnp.zeros((H,), jnp.int64),
+            "role": self._role,
+            "target": self._target,
         }
 
     def initial_events(self):
@@ -451,21 +496,22 @@ class TcpBulkApp:
 
     def on_timer(self, state, ev, emitter, params):
         """Client start: active open toward the target server."""
-        go = ev.mask & (self._role == 1)
+        sub = state.subs[self.SUB]
+        go = ev.mask & (sub["role"] == 1)
         state = self.stack.tcp.connect(
-            state, emitter, go, jnp.zeros((self.num_hosts,), jnp.int32),
-            self._target, SERVER_PORT, CLIENT_PORT_BASE, ev.time,
+            state, emitter, go, jnp.zeros_like(sub["role"]),
+            sub["target"], SERVER_PORT, CLIENT_PORT_BASE, ev.time,
             params=params,
         )
         return state
 
     def on_established(self, state, mask, slot, is_accept, src, now, emitter,
                        params):
-        client_up = mask & ~is_accept & (self._role == 1)
         sub = dict(state.subs[self.SUB])
+        client_up = mask & ~is_accept & (sub["role"] == 1)
         sub["connected"] = sub["connected"] + client_up.astype(jnp.int64)
         sub["accepted"] = sub["accepted"] + (
-            mask & is_accept & (self._role == 0)
+            mask & is_accept & (sub["role"] == 0)
         ).astype(jnp.int64)
         state = state.with_sub(self.SUB, sub)
         # write the whole stream into sequence space; FIN rides after it
@@ -477,8 +523,8 @@ class TcpBulkApp:
 
     def on_peer_fin(self, state, mask, slot, now, emitter, params):
         """Server side: client finished sending → close our half too."""
-        srv = mask & (self._role == 0)
         sub = dict(state.subs[self.SUB])
+        srv = mask & (sub["role"] == 0)
         sub["eof_seen"] = sub["eof_seen"] + srv.astype(jnp.int64)
         state = state.with_sub(self.SUB, sub)
         state = self.stack.tcp.close_app(state, emitter, srv, slot, now)

@@ -50,8 +50,15 @@ def send(
         lat = jnp.broadcast_to(params.latency_vv[0, 0], dst_host.shape)
         rel = jnp.broadcast_to(params.reliability_vv[0, 0], dst_host.shape)
     else:
-        vs = state.host.vertex  # [H]
-        vd = state.host.vertex[dst_host]  # [H]
+        vs = state.host.vertex  # [H] (local rows)
+        # dst_host is a GLOBAL id: use the replicated global host→vertex
+        # table when present (required under the islands engine, where
+        # host.vertex holds only this shard's rows)
+        vd = (
+            params.vertex_g[dst_host]
+            if params.vertex_g is not None
+            else state.host.vertex[dst_host]
+        )
         lat = params.latency_vv[vs, vd]
         rel = params.reliability_vv[vs, vd]
     reachable = lat != simtime.NEVER

@@ -143,8 +143,8 @@ class NetStack:
 
         Returns (state, ok) where ok marks hosts whose packet was admitted.
         """
-        H = self.num_hosts
-        hosts = jnp.arange(H, dtype=jnp.int32)
+        hosts = state.host.gid  # GLOBAL ids of this shard's rows
+        H = hosts.shape[0]
         n = state.subs[nic.SUB]
         now64 = jnp.broadcast_to(now, (H,)).astype(jnp.int64)
         direct = jnp.zeros((H,), bool)
@@ -221,8 +221,8 @@ class NetStack:
         (transport_sendUserData → socket buffer → networkinterface_wantsSend).
         Apps may pass a prebuilt [H, P] payload (e.g. carrying timestamps in
         the spare words); ports/size args are ignored in that case."""
-        H = self.num_hosts
-        hosts = jnp.arange(H, dtype=jnp.int32)
+        hosts = state.host.gid
+        H = hosts.shape[0]
         if payload is None:
             payload = pkt.make_udp(
                 src_port=jnp.broadcast_to(jnp.asarray(src_port, jnp.int32), (H,)),
@@ -292,8 +292,7 @@ class NetStack:
         drains arrivals immediately when tokens allow,
         network_interface.c:448-485); the CoDel state updates applied are
         exactly those of dequeueing a zero-sojourn ("good") packet."""
-        H = self.num_hosts
-        hosts = jnp.arange(H, dtype=jnp.int32)
+        hosts = state.host.gid
         now = ev.time
         loopback = ev.mask & (ev.src == hosts)
         remote = ev.mask & (ev.src != hosts)
@@ -360,8 +359,7 @@ class NetStack:
         """Send pump: up to PUMP_BATCH packets per invocation while tokens
         allow; re-arms itself at `now` (more queued) or the next refill tick
         (tokens exhausted)."""
-        H = self.num_hosts
-        hosts = jnp.arange(H, dtype=jnp.int32)
+        hosts = state.host.gid
         now = ev.time
         mask = ev.mask
         n = state.subs[nic.SUB]
@@ -425,8 +423,7 @@ class NetStack:
         """Receive pump: CoDel-dequeue up to PUMP_BATCH packets per
         invocation while rx tokens allow; re-arms while the router queue is
         non-empty (network_interface.c:448-485 drains in one task too)."""
-        H = self.num_hosts
-        hosts = jnp.arange(H, dtype=jnp.int32)
+        hosts = state.host.gid
         now = ev.time
         mask = ev.mask
         n = state.subs[nic.SUB]

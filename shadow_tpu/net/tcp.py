@@ -93,6 +93,10 @@ ANY_PEER = -1
 
 @struct.dataclass
 class TcpState:
+    # GLOBAL host id of each local row (islands engine: the shard's
+    # contiguous gid block; arange on the global engine). All self-timer
+    # emissions and src_host stamping use this, never jnp.arange.
+    gid: jnp.ndarray  # [H] i32
     # identity / binding
     used: jnp.ndarray  # [H,S] bool
     local_port: jnp.ndarray  # [H,S] i32
@@ -163,6 +167,7 @@ def init(num_hosts: int, sockets_per_host: int = 8,
     i64 = lambda v=0: jnp.full((H, S), v, jnp.int64)  # noqa: E731
     b = lambda: jnp.zeros((H, S), bool)  # noqa: E731
     return TcpState(
+        gid=jnp.arange(H, dtype=jnp.int32),
         used=b(), local_port=i32(), peer_host=i32(ANY_PEER), peer_port=i32(),
         state=i32(CLOSED),
         snd_una=i32(), snd_nxt=i32(), snd_max=i32(), snd_wnd=i32(RECV_WND),
@@ -422,18 +427,15 @@ class Tcp:
 
     # ---- internal helpers ----
 
-    def _hosts(self):
-        return jnp.arange(self.num_hosts, dtype=jnp.int32)
-
     def _arm_out(self, t: TcpState, emitter, mask, slot, now):
         """Schedule the output pump for (host, slot) unless already pending."""
         pending = _g(t.out_pending, slot)
         need = mask & ~pending
-        H = self.num_hosts
+        H = t.gid.shape[0]
         pl = jnp.zeros((H, self.payload_words), jnp.int32)
         pl = pl.at[:, EV_SLOT].set(slot.astype(jnp.int32))
         emitter.emit(
-            need, jnp.broadcast_to(now, (H,)).astype(jnp.int64), self._hosts(),
+            need, jnp.broadcast_to(now, (H,)).astype(jnp.int64), t.gid,
             jnp.int32(self.KIND_OUT), pl,
         )
         return t.replace(
@@ -446,13 +448,13 @@ class Tcp:
         need = mask & ~armed
         rto = _g(t.rto, slot)
         expire = now + rto
-        H = self.num_hosts
+        H = t.gid.shape[0]
         pl = jnp.zeros((H, self.payload_words), jnp.int32)
         pl = pl.at[:, EV_SLOT].set(slot.astype(jnp.int32))
         pl = pl.at[:, EV_TKIND].set(TIMER_RTX)
         pl = pl.at[:, EV_GEN].set(_g(t.gen, slot))
         emitter.emit(
-            need, jnp.where(need, expire, 0).astype(jnp.int64), self._hosts(),
+            need, jnp.where(need, expire, 0).astype(jnp.int64), t.gid,
             jnp.int32(self.KIND_TIMER), pl,
         )
         return t.replace(
@@ -477,15 +479,14 @@ class Tcp:
         t = state.subs[SUB]
         sp = src_port if src_port is not None else _g(t.local_port, slot)
         dp = dst_port if dst_port is not None else _g(t.peer_port, slot)
+        Hl = t.gid.shape[0]
         seg = make_segment(
             src_port=sp, dst_port=dp,
-            length=jnp.broadcast_to(jnp.asarray(length, jnp.int32),
-                                    (self.num_hosts,)),
-            flags=jnp.broadcast_to(jnp.asarray(flags, jnp.int32),
-                                   (self.num_hosts,)),
+            length=jnp.broadcast_to(jnp.asarray(length, jnp.int32), (Hl,)),
+            flags=jnp.broadcast_to(jnp.asarray(flags, jnp.int32), (Hl,)),
             seq=seq, ack=ack,
-            wnd=jnp.full((self.num_hosts,), RECV_WND, jnp.int32),
-            src_host=self._hosts(), socket_slot=slot, sack=sack,
+            wnd=jnp.full((Hl,), RECV_WND, jnp.int32),
+            src_host=t.gid, socket_slot=slot, sack=sack,
             payload_words=self.payload_words,
         )
         state, _ok = self.stack._tx(
@@ -503,7 +504,7 @@ class Tcp:
         reference draws a random ISS but determinism is the property that
         matters (SURVEY.md §5.2)."""
         t = state.subs[SUB]
-        H = self.num_hosts
+        H = t.gid.shape[0]
         z32 = jnp.zeros((H,), jnp.int32)
         one32 = jnp.ones((H,), jnp.int32)
         fb = jnp.zeros((H,), bool)
@@ -576,7 +577,7 @@ class Tcp:
             | (_g(t.state, slot) == SYN_RECEIVED)
         ) & ~_g(t.fin_pending, slot)
         nb = jnp.broadcast_to(jnp.asarray(nbytes, jnp.int32),
-                              (self.num_hosts,))
+                              (t.gid.shape[0],))
         t = t.replace(
             snd_buf_end=_s(t.snd_buf_end, ok, slot,
                            _g(t.snd_buf_end, slot) + nb)
@@ -594,26 +595,26 @@ class Tcp:
             | (_g(t.state, slot) == SYN_RECEIVED)
         )
         t = t.replace(fin_pending=_s(t.fin_pending, ok, slot,
-                                     jnp.ones((self.num_hosts,), bool)))
+                                     jnp.ones((t.gid.shape[0],), bool)))
         t = self._arm_out(t, emitter, ok, slot, now)
         return state.with_sub(SUB, t)
 
     # ---- segment processing (tcp.c:1870 _tcp_processPacket) ----
 
-    def _emit_timer(self, emitter, mask, slot, tkind, gen, time):
-        H = self.num_hosts
+    def _emit_timer(self, emitter, mask, slot, tkind, gen, time, gid):
+        H = gid.shape[0]
         pl = jnp.zeros((H, self.payload_words), jnp.int32)
         pl = pl.at[:, EV_SLOT].set(slot.astype(jnp.int32))
         pl = pl.at[:, EV_TKIND].set(jnp.broadcast_to(
             jnp.asarray(tkind, jnp.int32), (H,)))
         pl = pl.at[:, EV_GEN].set(gen.astype(jnp.int32))
         emitter.emit(mask, jnp.where(mask, time, 0).astype(jnp.int64),
-                     self._hosts(), jnp.int32(self.KIND_TIMER), pl)
+                     gid, jnp.int32(self.KIND_TIMER), pl)
 
     def on_segment(self, state, mask, src, payload, emitter, now, params):
         """Process one incoming segment per host (vectorized over hosts)."""
-        H = self.num_hosts
         t = state.subs[SUB]
+        H = t.gid.shape[0]
         fl = payload[:, pkt.W_FLAGS]
         has_syn = (fl & SYN) != 0
         has_ack = (fl & ACK) != 0
@@ -1083,7 +1084,7 @@ class Tcp:
         # ---------- TIME_WAIT timer + socket free ----------
         self._emit_timer(
             emitter, m_tw_enter, slot, TIMER_TIMEWAIT, _g(t.gen, slot),
-            now64 + TIME_WAIT_NS,
+            now64 + TIME_WAIT_NS, t.gid,
         )
         t = t.replace(
             used=_s(t.used, m_free, slot, fb),
@@ -1131,8 +1132,8 @@ class Tcp:
     def on_out(self, state, ev, emitter, params):
         """Send at most one segment per (host, slot) per micro-step; re-arm
         while the window and stream allow more."""
-        H = self.num_hosts
         t = state.subs[SUB]
+        H = t.gid.shape[0]
         slot = ev.payload[:, EV_SLOT]
         now64 = ev.time.astype(jnp.int64)
         fb = jnp.zeros((H,), bool)
@@ -1236,8 +1237,8 @@ class Tcp:
     # ---- timers (lazy retransmit + TIME_WAIT) ----
 
     def on_timer(self, state, ev, emitter, params):
-        H = self.num_hosts
         t = state.subs[SUB]
+        H = t.gid.shape[0]
         slot = ev.payload[:, EV_SLOT]
         tkind = ev.payload[:, EV_TKIND]
         egen = ev.payload[:, EV_GEN]
@@ -1269,7 +1270,7 @@ class Tcp:
         # deadline was pushed back by ACKs → re-check at the new deadline
         exp = _g(t.rtx_expire, slot)
         pushed = m_rtx & outstanding & (now64 < exp)
-        self._emit_timer(emitter, pushed, slot, TIMER_RTX, egen, exp)
+        self._emit_timer(emitter, pushed, slot, TIMER_RTX, egen, exp, t.gid)
 
         # expired → timeout (tcp_cong_reno timeout hooks + RFC 6298 backoff)
         fire = m_rtx & outstanding & (now64 >= exp)
@@ -1301,7 +1302,8 @@ class Tcp:
             timeouts=t.timeouts + jnp.sum(fire, dtype=jnp.int64),
             retransmits=t.retransmits + jnp.sum(fire, dtype=jnp.int64),
         )
-        self._emit_timer(emitter, fire, slot, TIMER_RTX, egen, now64 + rto2)
+        self._emit_timer(emitter, fire, slot, TIMER_RTX, egen, now64 + rto2,
+                         t.gid)
 
         # handshake retransmits go out directly; data goes via the pump
         state = state.with_sub(SUB, t)

@@ -1,0 +1,542 @@
+"""The islands runner: per-shard event pools with an all_to_all exchange.
+
+The reference's parallel architecture is per-worker LOCALITY: hosts are
+partitioned across workers (scheduler.c:329-353), each worker pops only its
+own hosts' queues (scheduler_policy_host_single.c:18-54), and a cross-host
+emission is one push into the owner's locked queue (scheduler.c:232-255,
+worker.c:517-576). GSPMD auto-sharding of the single-pool engine reproduces
+none of that locality: every shard participates in every global sort.
+
+This module is the TPU-native equivalent of the reference design:
+
+  * the host axis splits into S contiguous blocks ("islands"); each owns a
+    LOCAL event pool (C/S rows) and a LOCAL dense window (H/S·(K+1) filler
+    rows), so per-shard sort volume — the measured dominant window cost —
+    drops S×;
+  * cross-shard emissions ride ONE bounded all_to_all per window at the
+    merge (engine._island_route): the locked-queue push becomes a
+    collective;
+  * the round barrier + min-next-event-time reduction (worker.c:332-363)
+    becomes a lax.pmin over the shard axis;
+  * rows that miss the bounded exchange defer to the next window under a
+    window-end clamp (state.exch_deferred_min), so the conservative
+    invariant survives backpressure — late, never lost, never reordered.
+
+One implementation, two executions:
+  mode="vmap"      S virtual islands batched on ONE chip: every local sort
+                   becomes a batched sort (S× smaller rows per sort);
+                   collectives lower to reshapes. This is how a single
+                   TPU benefits from the islands formulation.
+  mode="shard_map" S real devices on a jax Mesh: each island lives on its
+                   own chip; collectives ride ICI/DCN. Same program,
+                   hardware parallelism.
+
+Determinism: per-host event order, RNG streams and sequence numbering are
+functions of (seed, GLOBAL host id) only, so islands runs are bit-identical
+to the global engine apart from pool-overflow timing (tests assert exact
+counter equality on non-overflowing runs).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from shadow_tpu.core import simtime
+from shadow_tpu.core.engine import IslandSpec, Simulation, make_window_step
+from shadow_tpu.core.spill import HostSpill
+from shadow_tpu.core.state import Counters, EventPool, SimState
+
+AXIS = "islands"
+
+
+# ---------------------------------------------------------------------------
+# State layout transform: global [H]/[C] arrays → per-shard [S, ...] blocks
+# ---------------------------------------------------------------------------
+
+
+def _split_host_leaf(x, S: int, H: int):
+    """[H, ...] → [S, H/S, ...]; scalars → shard-0-holds-value (summed at
+    fetch, so counter aggregation stays exact)."""
+    x = jnp.asarray(x)
+    if x.ndim >= 1 and x.shape[0] == H:
+        return x.reshape((S, H // S) + x.shape[1:])
+    if x.ndim == 0:
+        z = jnp.zeros((S,), x.dtype)
+        return z.at[0].set(x)
+    raise ValueError(
+        f"sub-state leaf with shape {x.shape} is neither [H]-leading nor "
+        f"scalar; the islands layout cannot place it"
+    )
+
+
+def islandize_state(state: SimState, S: int, C_shard: int) -> SimState:
+    """Rebuild a freshly-built GLOBAL SimState in the [S, ...] islands
+    layout: host rows block-partitioned, pool rows routed to their
+    destination's shard, counters/scalars summed-at-fetch."""
+    H = state.host.gid.shape[0]
+    if H % S:
+        raise ValueError(f"num_hosts {H} must divide by num_shards {S}")
+    Hl = H // S
+
+    # --- pool: route rows home by dst block (np on host; build-time) ---
+    pool = jax.device_get(state.pool)
+    C = state.pool.capacity
+    PPcols = pool.payload.shape[1]
+    live = pool.time != simtime.NEVER
+    t = np.full((S, C_shard), simtime.NEVER, np.int64)
+    d = np.zeros((S, C_shard), np.int32)
+    s_ = np.zeros((S, C_shard), np.int32)
+    q = np.zeros((S, C_shard), np.int32)
+    k = np.zeros((S, C_shard), np.int32)
+    p = np.zeros((S, C_shard, PPcols), np.int64)
+    for sh in range(S):
+        rows = np.where(live & (pool.dst // Hl == sh))[0]
+        if len(rows) > C_shard:
+            raise ValueError(
+                f"shard {sh} initial events ({len(rows)}) exceed per-shard "
+                f"pool capacity {C_shard}"
+            )
+        n = len(rows)
+        t[sh, :n] = pool.time[rows]
+        d[sh, :n] = pool.dst[rows]
+        s_[sh, :n] = pool.src[rows]
+        q[sh, :n] = pool.seq[rows]
+        k[sh, :n] = pool.kind[rows]
+        p[sh, :n] = pool.payload[rows]
+    new_pool = EventPool(
+        time=jnp.asarray(t), dst=jnp.asarray(d), src=jnp.asarray(s_),
+        seq=jnp.asarray(q), kind=jnp.asarray(k), payload=jnp.asarray(p),
+    )
+
+    host = jax.tree.map(lambda x: _split_host_leaf(x, S, H), state.host)
+    subs = jax.tree.map(lambda x: _split_host_leaf(x, S, H), state.subs)
+    counters = jax.tree.map(lambda x: _split_host_leaf(x, S, H),
+                            state.counters)
+    bcast = lambda v: jnp.broadcast_to(jnp.asarray(v), (S,))  # noqa: E731
+    return state.replace(
+        pool=new_pool,
+        host=host,
+        subs=subs,
+        counters=counters,
+        rng_keys=state.rng_keys.reshape((S, Hl) + state.rng_keys.shape[1:]),
+        now=bcast(state.now),
+        xmit_min=bcast(state.xmit_min),
+        exch_deferred_min=bcast(state.exch_deferred_min),
+    )
+
+
+def deislandize_host_array(x, *trailing):
+    """[S, H/S, ...] → [H, ...] (for tracker/observability fetch)."""
+    x = np.asarray(x)
+    return x.reshape((x.shape[0] * x.shape[1],) + x.shape[2:])
+
+
+# ---------------------------------------------------------------------------
+# Runner
+# ---------------------------------------------------------------------------
+
+
+class IslandSimulation(Simulation):
+    """Simulation whose window kernel runs as S islands.
+
+    Accepts every Simulation kwarg plus:
+      num_shards      S (must divide num_hosts)
+      exchange_slots  X rows per destination shard per window (0 = auto:
+                      sized so a full window's worst-case cross-shard
+                      emissions fit, H/S·O/S with headroom)
+      mode            "vmap" (virtual islands, one device) or "shard_map"
+                      (one island per mesh device)
+      force_path      optional engine path pin. Under vmap a lax.cond with
+                      a batched predicate executes BOTH branches, so
+                      matrix-capable sims (PHOLD) should pin "matrix" —
+                      sound whenever the bulk contract is static.
+    """
+
+    def __init__(self, *, num_shards: int, exchange_slots: int = 0,
+                 mode: str = "vmap", force_path: str | None = None,
+                 rebalance: bool = False, **kw):
+        if mode not in ("vmap", "shard_map"):
+            raise ValueError(f"unknown islands mode {mode!r}")
+        self.num_shards = int(num_shards)
+        self.mode = mode
+        self.rebalance_enabled = bool(rebalance)
+        self.rebalances = 0
+        H = kw["num_hosts"]
+        S = self.num_shards
+        if H % S:
+            raise ValueError(f"num_hosts {H} must divide by num_shards {S}")
+        Hl = H // S
+        C = kw.get("event_capacity", 1 << 14)
+        O = kw.get("O", 64)
+        if exchange_slots <= 0:
+            # Typical-case sizing: a window's cross-shard emissions per
+            # destination shard ~ Hl·O spread over S destinations. Misses
+            # defer (correct, slower), so X is a perf knob, not a
+            # correctness one — and every extra slot costs pool rows
+            # (below) and grouping-sort fillers, so do not oversize.
+            exchange_slots = max(64, Hl * O // max(S, 2))
+        self.exchange_slots = int(exchange_slots)
+        # The exchange block occupies S·X pool slots STRUCTURALLY (the
+        # received rows land in the pool's tail block each window, mostly
+        # fillers), so the per-shard pool is the per-shard share of the
+        # configured capacity PLUS that block — otherwise the block eats
+        # real event storage and the shard overflows at C/S − S·X.
+        C_shard = (C + S - 1) // S + S * self.exchange_slots
+        kw = dict(kw, event_capacity=C)  # global build first (unchanged)
+        super().__init__(**kw)
+
+        spec = IslandSpec(
+            axis=AXIS, num_shards=S, exchange_slots=self.exchange_slots,
+            use_slot_table=self.rebalance_enabled,
+        )
+        self._island_spec = spec
+        self._C_shard = C_shard
+        # Re-layout the built global state into islands.
+        self.state = islandize_state(self.state, S, C_shard)
+        if self.rebalance_enabled:
+            # identity assignment to start; the table is a runtime param,
+            # so later rebalances never recompile
+            self.params = self.params.replace(
+                slot_of=jnp.arange(H, dtype=jnp.int32)
+            )
+
+        step = make_window_step(
+            self.handlers, Hl, K=self.K, B=self.B, O=self.O,
+            bulk_kinds=self._bulk_kinds,
+            matrix_handlers=self._matrix_handlers,
+            with_cpu_model=self._with_cpu,
+            bulk_gate=self._bulk_gate,
+            bulk_self_excluded=self._bulk_self_excluded,
+            payload_words=self._payload_words,
+            island=spec,
+            _force_path=force_path,
+        )
+        self._step_fn = step
+        runahead = jnp.int64(self.runahead)
+
+        def step_shard(state, params, ws, we):
+            st, mn = step(state, params, ws, we)
+            return st, jax.lax.pmin(mn, AXIS)
+
+        hi = self._spill_marks()[0]
+
+        def _press(state):
+            occ = jnp.sum(state.pool.time != simtime.NEVER)
+            return jax.lax.pmax((occ >= hi).astype(jnp.int32), AXIS)
+
+        def run_to(state, params, stop, max_windows):
+            stop = jnp.asarray(stop, jnp.int64)
+            max_windows = jnp.asarray(max_windows, jnp.int32)
+
+            def cond(c):
+                state, mn, w = c
+                return (mn < stop) & (w < max_windows) & (_press(state) == 0)
+
+            def body(c):
+                state, mn, w = c
+                ws = mn
+                # exchange-backpressure clamp: never let any shard process
+                # past an event still in transit (deferred exchange)
+                clamp = jax.lax.pmin(state.exch_deferred_min, AXIS)
+                we = jnp.minimum(jnp.minimum(ws + runahead, stop), clamp)
+                state, mn = step_shard(state, params, ws, we)
+                return state, mn, w + 1
+
+            mn0 = jax.lax.pmin(jnp.min(state.pool.time), AXIS)
+            state, mn, _ = jax.lax.while_loop(
+                cond, body, (state, mn0, jnp.int32(0))
+            )
+            return state, mn, _press(state) > 0
+
+        if mode == "vmap":
+            self._step = jax.jit(jax.vmap(
+                step_shard, in_axes=(0, None, None, None), axis_name=AXIS
+            ))
+            self._run_to = jax.jit(jax.vmap(
+                run_to, in_axes=(0, None, None, None), axis_name=AXIS
+            ))
+        else:
+            from jax.sharding import Mesh, PartitionSpec as P
+
+            devs = jax.devices()
+            if len(devs) < S:
+                raise ValueError(
+                    f"shard_map islands need {S} devices, have {len(devs)}"
+                )
+            mesh = Mesh(np.array(devs[:S]), (AXIS,))
+            self.mesh = mesh
+            shard_map = jax.shard_map
+
+            def _sq(tree):
+                return jax.tree.map(lambda x: x[0], tree)
+
+            def _unsq(tree):
+                return jax.tree.map(lambda x: x[None], tree)
+
+            state_spec = jax.tree.map(
+                lambda _: P(AXIS), self.state,
+            )
+            params_spec = jax.tree.map(lambda _: P(), self.params)
+
+            def sm(fn, n_scalar_out):
+                def body(state, params, a, b):
+                    out = fn(_sq(state), params, a, b)
+                    return (_unsq(out[0]),) + tuple(
+                        o[None] for o in out[1:]
+                    )
+
+                wrapped = shard_map(
+                    body, mesh=mesh,
+                    in_specs=(state_spec, params_spec, P(), P()),
+                    out_specs=(state_spec,) + (P(AXIS),) * n_scalar_out,
+                )
+                return jax.jit(wrapped)
+
+            self._step = sm(step_shard, 1)
+            self._run_to = sm(run_to, 2)
+        self._attempt = None  # islands run conservative-only
+
+    def _spill_marks(self):
+        """Islands: the merge truncates the remainder at C_keep =
+        C_shard − S·X (the exchange block structurally occupies the pool
+        tail), so pressure must fire below C_keep, not raw capacity."""
+        from shadow_tpu.core import spill as spill_mod
+
+        C_s = self._C_shard
+        keep = C_s - self.num_shards * self.exchange_slots
+        hi = keep - spill_mod.red_zone(C_s)
+        if hi <= 0:
+            raise ValueError(
+                "per-shard pool too small for its exchange block + red "
+                "zone; raise event_capacity or lower exchange_slots"
+            )
+        return hi, max(1, (3 * hi) // 4), max(1, keep - 64)
+
+    # ---- between-window re-sharding (the P3 work-stealing replacement,
+    # scheduler_policy_host_steal.c:1-562 / logical_processor.rs:43-54) ----
+
+    def shard_loads(self) -> np.ndarray:
+        """[S] resident event rows per shard (pool + host spill)."""
+        t = np.asarray(jax.device_get(self.state.pool.time))
+        occ = (t != simtime.NEVER).sum(axis=-1)
+        sp = getattr(self, "_spill", None)
+        if sp is not None:
+            occ = occ + np.array(
+                [r[0].shape[0] for r in sp._rows]
+            )
+        return occ
+
+    def rebalance_now(self) -> None:
+        """Permute host→shard assignment to even out resident load.
+
+        Load proxy = events resident per destination host (pool + spill
+        histogram). Assignment = LPT greedy onto S bins of exactly H/S
+        hosts each. All [H]-leading state permutes host-side (rare, a few
+        MB); pool and spill rows re-route to their new owners; the
+        slot_of routing table updates in place — no recompilation, and no
+        observable effect on results (per-host order, RNG streams and seq
+        numbering are functions of the GLOBAL host id only).
+        """
+        S, Hl = self.num_shards, self.num_hosts // self.num_shards
+        H = self.num_hosts
+        sp = self._spill_store()
+
+        # --- per-host resident load from pool + spill (by dst) ---
+        pt = np.array(jax.device_get(self.state.pool.time)).reshape(-1)
+        pd = np.array(jax.device_get(self.state.pool.dst)).reshape(-1)
+        live = pt != simtime.NEVER
+        load = np.bincount(pd[live], minlength=H).astype(np.int64)
+        for rows in sp._rows:
+            if rows[0].shape[0]:
+                load += np.bincount(rows[1], minlength=H)
+
+        # --- LPT: heaviest host to the lightest non-full shard ---
+        order = np.argsort(-load, kind="stable")
+        shard_load = np.zeros(S, np.int64)
+        shard_fill = np.zeros(S, np.int32)
+        new_slot = np.zeros(H, np.int32)
+        for h in order:
+            open_ = shard_fill < Hl
+            cand = np.flatnonzero(open_)
+            s = int(cand[np.argmin(shard_load[cand])])
+            new_slot[h] = s * Hl + shard_fill[s]
+            shard_fill[s] += 1
+            shard_load[s] += load[h]
+
+        # --- permute every [S, Hl, ...] host-indexed leaf ---
+        gid = np.array(jax.device_get(self.state.host.gid)).reshape(-1)
+        cur_slot = np.empty(H, np.int32)
+        cur_slot[gid] = np.arange(H, dtype=np.int32)
+        # row j of the NEW layout holds the host whose new_slot == j
+        host_at_new = np.empty(H, np.int32)
+        host_at_new[new_slot] = np.arange(H, dtype=np.int32)
+        idx = cur_slot[host_at_new]  # new flat row j ← old flat row idx[j]
+
+        def perm(x):
+            x = np.array(jax.device_get(x))
+            flat = x.reshape((H,) + x.shape[2:])
+            return jnp.asarray(flat[idx].reshape(x.shape))
+
+        self.state = self.state.replace(
+            host=jax.tree.map(perm, self.state.host),
+            subs=jax.tree.map(
+                lambda x: perm(x) if getattr(x, "ndim", 0) >= 2
+                and x.shape[0] == S and x.shape[1] == Hl else x,
+                self.state.subs,
+            ),
+            rng_keys=perm(self.state.rng_keys),
+        )
+
+        # --- re-route pool + spill rows to their new owner shards ---
+        cols = [
+            np.array(jax.device_get(c)) for c in (
+                self.state.pool.time, self.state.pool.dst,
+                self.state.pool.src, self.state.pool.seq,
+                self.state.pool.kind, self.state.pool.payload,
+            )
+        ]
+        C_s = cols[0].shape[1]
+        flatc = [c.reshape((-1,) + c.shape[2:]) for c in cols]
+        livef = flatc[0] != simtime.NEVER
+        allrows = [c[livef] for c in flatc]
+        for rows in sp._rows:
+            if rows[0].shape[0]:
+                allrows = [
+                    np.concatenate([a, r]) for a, r in zip(allrows, rows)
+                ]
+        owner = new_slot[allrows[1]] // Hl
+        t_new = np.full((S, C_s), simtime.NEVER, np.int64)
+        o_new = [np.zeros((S, C_s) + c.shape[1:], c.dtype)
+                 for c in allrows[1:]]
+        sp._rows = [sp._empty() for _ in range(S)]
+        # the partial-residency clamps describe the OLD layout; reset so a
+        # stale minimum cannot clamp future windows (manage recomputes per
+        # rebalance)
+        sp._partial_min = [int(simtime.NEVER)] * S
+        for s in range(S):
+            rows = np.where(owner == s)[0]
+            # earliest rows stay on device; overflow goes to the spill
+            # tier (never dropped)
+            osort = rows[HostSpill._order(
+                allrows[0][rows], allrows[1][rows],
+                allrows[2][rows], allrows[3][rows],
+            )]
+            fill = self._spill_marks()[1]
+            keep, rest = osort[:fill], osort[fill:]
+            n = keep.shape[0]
+            t_new[s, :n] = allrows[0][keep]
+            for c_new, c in zip(o_new, allrows[1:]):
+                c_new[s, :n] = c[keep]
+            if rest.shape[0]:
+                sp._rows[s] = tuple(
+                    c[rest] for c in allrows
+                )
+                sp.drained_total += rest.shape[0]
+        from shadow_tpu.core.state import EventPool
+
+        self.state = self.state.replace(pool=EventPool(
+            time=jnp.asarray(t_new), dst=jnp.asarray(o_new[0]),
+            src=jnp.asarray(o_new[1]), seq=jnp.asarray(o_new[2]),
+            kind=jnp.asarray(o_new[3]), payload=jnp.asarray(o_new[4]),
+        ))
+        self.params = self.params.replace(
+            slot_of=jnp.asarray(new_slot)
+        )
+        self.rebalances += 1
+
+    def _maybe_rebalance(self) -> None:
+        """Skew trigger: rebalance when the heaviest shard holds 2x the
+        mean resident load (and enough rows for the skew to matter)."""
+        if not self.rebalance_enabled:
+            return
+        occ = self.shard_loads()
+        mean = occ.mean()
+        if mean > 0 and occ.max() > max(2 * mean, occ.min() + 256):
+            self.rebalance_now()
+
+    def run(self, until=None, windows_per_dispatch: int = 64) -> None:
+        from shadow_tpu.core import spill as spill_mod
+
+        stop = self.stop_time if until is None else min(until, self.stop_time)
+        spill = self._spill_store()
+        last = None
+        while True:
+            if (last is not None and last[2]) or spill.count:
+                self._maybe_rebalance()
+                stop_at = spill_mod.manage(self, spill, stop)
+            else:
+                stop_at = stop
+            # single-window dispatches while the spill is active (exactness
+            # requires a manage pass between windows — core/spill.py)
+            wpd = 1 if spill.count else windows_per_dispatch
+            self.state, mn, press = self._run_to(
+                self.state, self.params, stop_at, wpd
+            )
+            mn = int(np.min(np.asarray(mn)))
+            press = bool(np.max(np.asarray(press)))
+            if mn >= stop and spill.min_time >= stop and not press:
+                break
+            cur = (mn, spill.count, press)
+            if cur == last and mn >= stop_at:
+                raise RuntimeError(
+                    "spill tier cannot make progress; raise "
+                    "experimental.event_capacity"
+                )
+            last = cur
+
+    def run_stepwise(self, until=None) -> int:
+        from shadow_tpu.core import spill as spill_mod
+
+        stop = self.stop_time if until is None else min(until, self.stop_time)
+        spill = self._spill_store()
+        windows = 0
+        stall = 0
+        while True:
+            stop_at = spill_mod.manage(self, spill, stop)
+            min_next = int(jax.device_get(jnp.min(self.state.pool.time)))
+            if min_next >= stop_at:
+                if min_next >= stop and spill.min_time >= stop:
+                    break
+                stall += 1
+                if stall > 2:
+                    raise RuntimeError(
+                        "spill tier cannot make progress; raise "
+                        "experimental.event_capacity"
+                    )
+                continue
+            stall = 0
+            ws = min_next
+            clamp = int(jax.device_get(
+                jnp.min(self.state.exch_deferred_min)
+            ))
+            we = min(ws + self.runahead, stop_at, clamp)
+            self.state, mn = self._step(self.state, self.params, ws, we)
+            windows += 1
+        return windows
+
+    def run_optimistic(self, *a, **kw):
+        raise NotImplementedError(
+            "islands run conservative windows only (cross-shard progress "
+            "clocks would need a collective per emission row); use the "
+            "global engine for optimistic synchronization"
+        )
+
+    def counters(self) -> dict[str, int]:
+        c = jax.device_get(self.state.counters)
+        return {
+            f.name: int(np.sum(np.asarray(getattr(c, f.name))))
+            for f in dataclasses.fields(c)
+        }
+
+    def host_trackers(self) -> dict[str, np.ndarray]:
+        sub = self.state.subs.get("nic")
+        if sub is None:
+            return {}
+        return {
+            k: deislandize_host_array(jax.device_get(getattr(sub, k)))
+            for k in ("tx_packets", "tx_bytes", "rx_packets", "rx_bytes")
+        }

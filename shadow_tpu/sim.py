@@ -48,6 +48,14 @@ def build_simulation(source) -> Simulation:
         latency_vv=jnp.asarray(baked.latency_vv),
         reliability_vv=jnp.asarray(baked.reliability_vv),
         bootstrap_end=jnp.int64(cfg.general.bootstrap_end_time),
+        # replicated GLOBAL host→vertex table for by-dst path lookups
+        # (required under islands, where host.vertex is shard-local);
+        # single-vertex graphs broadcast instead and skip the gather
+        vertex_g=(
+            jnp.asarray(baked.host_vertex, dtype=jnp.int32)
+            if np.asarray(baked.latency_vv).shape[0] > 1
+            else None
+        ),
     )
     runahead = cfg.experimental.runahead or baked.min_latency_ns
     if runahead > baked.min_latency_ns:
@@ -94,6 +102,8 @@ def build_simulation(source) -> Simulation:
             size_bytes=int(opts.get("size", 64)),
             start_time=units.parse_time_ns(opts.get("start_time", 1)),
             runtime=units.parse_time_ns(opts.get("runtime", 5)),
+            hot_frac=float(opts.get("hot_frac", 0.0)),
+            hot_share=float(opts.get("hot_share", 0.0)),
         )
         handlers.update(app.handlers())
         subs[PholdApp.SUB] = app.init_sub()
@@ -209,7 +219,23 @@ def build_simulation(source) -> Simulation:
         raise BuildError(f"unknown app model(s): {sorted(unknown)}")
 
     cpu_cost = np.array([h.cpu_ns_per_event for h in cfg.hosts], dtype=np.int64)
-    sim = Simulation(
+    sim_cls = Simulation
+    sim_kw = {}
+    if cfg.experimental.num_shards > 1:
+        from shadow_tpu.parallel.islands import IslandSimulation
+
+        sim_cls = IslandSimulation
+        sim_kw = dict(
+            num_shards=cfg.experimental.num_shards,
+            exchange_slots=cfg.experimental.exchange_slots,
+            mode=cfg.experimental.island_mode,
+            rebalance=cfg.experimental.rebalance,
+            # matrix-capable sims pin the matrix path: under vmap a
+            # lax.cond with a batched predicate executes BOTH branches
+            force_path="matrix" if matrix_handlers else None,
+        )
+    sim = sim_cls(
+        **sim_kw,
         num_hosts=H,
         handlers=handlers,
         params=params,

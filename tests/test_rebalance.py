@@ -1,0 +1,110 @@
+"""Between-window re-sharding (parallel/islands.py rebalance — the P3
+work-stealing replacement, scheduler_policy_host_steal.c:1-562).
+
+Correctness property: a rebalance permutes the host→shard layout ONLY —
+results stay bit-identical to the global engine (per-host order, RNG
+streams and sequence numbering key on GLOBAL host ids, never on layout).
+"""
+
+import numpy as np
+import pytest
+
+from shadow_tpu.flagship import SELF_LOOP_50MS_GML
+from shadow_tpu.sim import build_simulation
+
+
+def _hot_cfg(num_shards=1, rebalance=False, hosts=128, capacity=1024):
+    """Skewed PHOLD: 60% of traffic targets the first 12.5% of hosts —
+    which a static contiguous assignment parks ALL on shard 0."""
+    exp = {
+        "event_capacity": capacity,
+        "events_per_host_per_window": 12,
+        "outbox_slots": 12,
+        "inbox_slots": 4,
+    }
+    if num_shards > 1:
+        exp.update(num_shards=num_shards, exchange_slots=64,
+                   rebalance=rebalance)
+    return {
+        "general": {"stop_time": 3, "seed": 9},
+        "network": {"graph": {"type": "gml", "inline": SELF_LOOP_50MS_GML}},
+        "experimental": exp,
+        "hosts": {"peer": {"quantity": hosts, "app_model": "phold",
+                           "app_options": {"msgload": 4, "runtime": 2,
+                                           "hot_frac": 0.125,
+                                           "hot_share": 0.6}}},
+    }
+
+
+_KEYS = (
+    "events_committed", "events_emitted", "packets_sent",
+    "packets_dropped_loss", "bytes_sent", "pool_overflow_dropped",
+)
+
+
+def _phold_state(sim):
+    return {
+        k: np.asarray(sim.state.subs["phold"][k]).reshape(-1)
+        for k in ("received", "forwarded")
+    }
+
+
+@pytest.mark.quick
+def test_hot_phold_islands_match_global():
+    g = build_simulation(_hot_cfg())
+    g.run_stepwise()
+    i = build_simulation(_hot_cfg(num_shards=4))
+    i.run_stepwise()
+    cg, ci = g.counters(), i.counters()
+    for k in _KEYS:
+        assert cg[k] == ci[k], (k, cg[k], ci[k])
+    sg, si = _phold_state(g), _phold_state(i)
+    for k in sg:
+        assert (sg[k] == si[k]).all(), k
+
+
+@pytest.mark.quick
+def test_rebalance_preserves_results():
+    """Force rebalances mid-run (explicit + auto) and require bit-equality
+    with the global engine."""
+    g = build_simulation(_hot_cfg())
+    g.run_stepwise()
+    r = build_simulation(_hot_cfg(num_shards=4, rebalance=True))
+    # interleave: run a bit, rebalance, run on (fused path auto-triggers
+    # only under pressure; force one to exercise the permutation)
+    r.run(until=1_500_000_000, windows_per_dispatch=8)
+    r.rebalance_now()
+    assert r.rebalances >= 1
+    r.run(windows_per_dispatch=8)
+    cg, cr = g.counters(), r.counters()
+    for k in _KEYS:
+        assert cg[k] == cr[k], (k, cg[k], cr[k])
+    sg, sr = _phold_state(g), _phold_state(r)
+    for k in sg:
+        # islands state is laid out in permuted slots; map back via gid
+        gid = np.asarray(r.state.host.gid).reshape(-1)
+        back = np.empty_like(sr[k])
+        back[gid] = sr[k]
+        assert (sg[k] == back).all(), k
+
+
+@pytest.mark.quick
+def test_rebalance_actually_evens_load():
+    """After rebalancing, the skewed workload's per-shard resident load
+    must flatten (max/mean below the static assignment's)."""
+    static = build_simulation(_hot_cfg(num_shards=4, capacity=2048))
+    static.run(until=2_000_000_000, windows_per_dispatch=8)
+    occ_s = static.shard_loads().astype(float)
+
+    reb = build_simulation(
+        _hot_cfg(num_shards=4, rebalance=True, capacity=2048)
+    )
+    reb.run(until=1_000_000_000, windows_per_dispatch=8)
+    reb.rebalance_now()
+    reb.run(until=2_000_000_000, windows_per_dispatch=8)
+    occ_r = reb.shard_loads().astype(float)
+
+    skew_s = occ_s.max() / max(occ_s.mean(), 1.0)
+    skew_r = occ_r.max() / max(occ_r.mean(), 1.0)
+    assert skew_s > 1.8, f"workload not skewed enough: {occ_s}"
+    assert skew_r < skew_s * 0.7, (occ_s, occ_r)

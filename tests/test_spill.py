@@ -1,0 +1,102 @@
+"""Host-spill overflow tier (core/spill.py): an undersized pool completes
+with BIT-IDENTICAL results to an oversized one — the engine never silently
+drops an event (VERDICT r3 #7; reference invariant: queues grow on the
+heap, scheduler.c:232-255).
+
+The workload: UDP flood over a 400 ms self-loop link at a 10 ms send
+interval → ~40 packets in flight per client, far beyond the undersized
+pool. The driver must spill to host memory and re-inject, clamping windows
+below spilled timestamps.
+"""
+
+import numpy as np
+import pytest
+
+from shadow_tpu.sim import build_simulation
+
+
+def _cfg(event_capacity, num_shards=1):
+    exp = {
+        "event_capacity": event_capacity,
+        "events_per_host_per_window": 16,
+        "outbox_slots": 8,
+        "inbox_slots": 4,
+        "router_queue_slots": 64,
+    }
+    if num_shards > 1:
+        exp.update(num_shards=num_shards, exchange_slots=64)
+    return {
+        "general": {"stop_time": 3, "seed": 5},
+        "network": {"graph": {"type": "gml", "inline": (
+            'graph [\n'
+            '  node [ id 0 bandwidth_down "1 Gbit" bandwidth_up "1 Gbit" ]\n'
+            '  edge [ source 0 target 0 latency "400 ms" packet_loss 0.001 ]\n'
+            ']\n')}},
+        "experimental": exp,
+        "hosts": {
+            "server": {"quantity": 4, "app_model": "udp_flood",
+                       "app_options": {"role": "server"}},
+            "client": {"quantity": 28, "app_model": "udp_flood",
+                       "app_options": {"interval": "10 ms", "size": 256,
+                                       "runtime": 1}},
+        },
+    }
+
+
+_KEYS = (
+    "events_committed", "events_emitted", "packets_sent",
+    "packets_delivered", "packets_dropped_loss", "bytes_sent",
+    "bytes_delivered", "pool_overflow_dropped",
+)
+
+
+def _recv(sim):
+    return np.asarray(sim.state.subs["udp_flood"]["recv"]).reshape(-1)
+
+
+@pytest.mark.quick
+def test_undersized_pool_matches_oversized():
+    big = build_simulation(_cfg(1 << 13))
+    big.run_stepwise()
+    cb = big.counters()
+    assert cb["pool_overflow_dropped"] == 0
+    assert big.spill_stats()["spill_episodes"] == 0  # sized fine
+
+    small = build_simulation(_cfg(384))
+    small.run_stepwise()
+    cs = small.counters()
+    st = small.spill_stats()
+    assert st["spill_episodes"] > 0, "undersized pool never spilled"
+    assert st["spill_resident"] == 0, "spill must fully drain by stop"
+    for k in _KEYS:
+        assert cb[k] == cs[k], (k, cb[k], cs[k])
+    assert (_recv(big) == _recv(small)).all()
+
+
+@pytest.mark.quick
+def test_undersized_pool_fused_run_matches():
+    """The fused dispatch loop (run) exits on the red-zone flag and the
+    driver spills between dispatches — same results as stepwise."""
+    small = build_simulation(_cfg(384))
+    small.run(windows_per_dispatch=16)
+    cs = small.counters()
+    assert small.spill_stats()["spill_episodes"] > 0
+    big = build_simulation(_cfg(1 << 13))
+    big.run_stepwise()
+    cb = big.counters()
+    for k in _KEYS:
+        assert cb[k] == cs[k], (k, cb[k], cs[k])
+
+
+@pytest.mark.quick
+def test_undersized_islands_pool_matches():
+    big = build_simulation(_cfg(1 << 13))
+    big.run_stepwise()
+    cb = big.counters()
+    isl = build_simulation(_cfg(1024, num_shards=4))
+    isl.run_stepwise()
+    ci = isl.counters()
+    assert isl.spill_stats()["spill_episodes"] > 0
+    for k in _KEYS:
+        assert cb[k] == ci[k], (k, cb[k], ci[k])
+    assert (_recv(big) == _recv(isl)).all()

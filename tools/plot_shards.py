@@ -1,0 +1,46 @@
+"""Plot events/sec vs shard count from docs/shard_sweep.json
+(`python bench.py --shard-sweep` writes it). Emits docs/shard_sweep.png.
+
+VERDICT r4 gate 1c: "a plot of events/sec vs shard count exists".
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt  # noqa: E402
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main(path=None, out=None):
+    path = path or os.path.join(_REPO, "docs", "shard_sweep.json")
+    out = out or os.path.join(_REPO, "docs", "shard_sweep.png")
+    rows = json.load(open(path))
+    stages = sorted({r["stage"] for r in rows})
+    fig, ax = plt.subplots(figsize=(6, 4))
+    for st in stages:
+        pts = sorted(
+            [(r["num_shards"], r["events_per_sec"]) for r in rows
+             if r["stage"] == st]
+        )
+        ax.plot([p[0] for p in pts], [p[1] for p in pts], "o-", label=st)
+    ax.set_xlabel("virtual islands (shards) on one chip")
+    ax.set_ylabel("committed events / sec")
+    ax.set_xscale("log", base=2)
+    ax.set_yscale("log")
+    ax.grid(True, which="both", alpha=0.3)
+    ax.legend()
+    ax.set_title("islands engine: throughput vs shard count (one TPU chip)")
+    fig.tight_layout()
+    fig.savefig(out, dpi=120)
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main(*sys.argv[1:])

@@ -43,7 +43,7 @@ for wpd in (1, 8, 32):
     t0 = time.perf_counter()
     n_disp = 4 if wpd >= 8 else 16
     for _ in range(n_disp):
-        sim.state, mn = sim._run_to(sim.state, sim.params,
+        sim.state, mn, _press = sim._run_to(sim.state, sim.params,
                                     sim.stop_time, wpd)
     jax.block_until_ready(sim.state.pool.time)
     dt = time.perf_counter() - t0
@@ -59,7 +59,7 @@ print("micro_steps delta:", c1["micro_steps"] - c0["micro_steps"],
 trace_dir = "/tmp/flood_trace"
 with jax.profiler.trace(trace_dir):
     for _ in range(2):
-        sim.state, mn = sim._run_to(sim.state, sim.params, sim.stop_time, 8)
+        sim.state, mn, _press = sim._run_to(sim.state, sim.params, sim.stop_time, 8)
     jax.block_until_ready(sim.state.pool.time)
 
 # parse the trace: op-class totals
