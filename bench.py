@@ -167,7 +167,8 @@ def _run_stage(stage: str, app_model: str, loss: float, app_options: dict,
                extra_counters: tuple = (), num_hosts: int = 10240,
                stop_s: int = 4, event_capacity: int = 1 << 15,
                extra_experimental: dict | None = None,
-               windows_per_dispatch: int = 8, num_shards: int = 1):
+               windows_per_dispatch: int = 8, num_shards: int = 1,
+               sync: str = "conservative"):
     """Build, warm up (compile + bootstrap), then time the remaining sim
     span. Warm-up-committed events are subtracted so the reported rate and
     sim/wall ratio cover only the timed segment."""
@@ -210,13 +211,26 @@ def _run_stage(stage: str, app_model: str, loss: float, app_options: dict,
     # Bounded dispatch chunks: minutes-long single dispatches can crash the
     # accelerator runtime's watchdog at this scale, but each dispatch costs
     # ~8 ms of tunnel overhead (profiled), so size them as large as safe.
-    sim.run(until=warmup_ns, windows_per_dispatch=windows_per_dispatch)
-    jax.block_until_ready(sim.state.pool.time)
-    warm_events = sim.counters()["events_committed"]
-    t0 = time.perf_counter()
-    sim.run(windows_per_dispatch=windows_per_dispatch)
-    jax.block_until_ready(sim.state.pool.time)
-    wall = time.perf_counter() - t0
+    windows = rollbacks = None
+    if sync == "optimistic":
+        # BASELINE config 4's sync mode: adaptive speculative windows
+        # (engine.run_optimistic); warm-up compiles the attempt kernel
+        sim.run_optimistic(until=warmup_ns)
+        jax.block_until_ready(sim.state.pool.time)
+        warm_events = sim.counters()["events_committed"]
+        t0 = time.perf_counter()
+        # timed-segment counts only, consistent with events_per_sec
+        windows, rollbacks = sim.run_optimistic()
+        jax.block_until_ready(sim.state.pool.time)
+        wall = time.perf_counter() - t0
+    else:
+        sim.run(until=warmup_ns, windows_per_dispatch=windows_per_dispatch)
+        jax.block_until_ready(sim.state.pool.time)
+        warm_events = sim.counters()["events_committed"]
+        t0 = time.perf_counter()
+        sim.run(windows_per_dispatch=windows_per_dispatch)
+        jax.block_until_ready(sim.state.pool.time)
+        wall = time.perf_counter() - t0
     c = sim.counters()
     timed_events = c["events_committed"] - warm_events
     timed_sim_s = stop_s - warmup_ns / 1e9
@@ -224,12 +238,16 @@ def _run_stage(stage: str, app_model: str, loss: float, app_options: dict,
         "stage": stage,
         "hosts": num_hosts,
         "num_shards": num_shards,
+        "sync": sync,
         "events_per_sec": round(timed_events / wall, 1),
         "packets_delivered": c["packets_delivered"],
         "sim_sec_per_wall_sec": round(timed_sim_s / wall, 2),
         # must stay 0 or the measurement dropped work
         "pool_overflow_dropped": c["pool_overflow_dropped"],
     }
+    if windows is not None:
+        out["windows"] = windows
+        out["rollbacks"] = rollbacks
     for k in extra_counters:
         out[k] = c[k]
     return out
@@ -290,6 +308,21 @@ def stage_phold_100k(stop_s: int = 10):
         "sim_sec_per_wall_sec": round(sim_per_wall, 2),
         "vs_baseline": round(rate / (base["events_per_sec"] or 1.0), 3),
     }
+
+
+def stage_udp_flood_50k(sync: str = "conservative", stop_s: int = 3):
+    """BASELINE staged config 4 shape: 50k hosts through the full device
+    network stack, in BOTH sync modes (config 4 pairs this scale with
+    optimistic PDES windows; conservative is the control row)."""
+    return _run_stage(
+        "udp_flood_50k", "udp_flood", 0.001,
+        {"interval": "40 ms", "size": 1024, "runtime": stop_s - 1},
+        num_hosts=50176,  # 49 * 1024
+        stop_s=stop_s, event_capacity=1 << 17,
+        extra_experimental={"events_per_host_per_window": 12,
+                            "outbox_slots": 8},
+        windows_per_dispatch=16, sync=sync,
+    )
 
 
 def stage_udp_flood_100k(stop_s: int = 3):
@@ -356,6 +389,13 @@ def main():
         return
     if "--shard-sweep" in sys.argv:
         shard_sweep(out_path=os.path.join(_REPO, "docs", "shard_sweep.json"))
+        return
+    if "--stages-50k" in sys.argv:
+        # BASELINE config 4 rows: both synchronization modes
+        print(json.dumps(_with_backend_retry(stage_udp_flood_50k,
+                                             "conservative")), flush=True)
+        print(json.dumps(_with_backend_retry(stage_udp_flood_50k,
+                                             "optimistic")), flush=True)
         return
 
     num_hosts, msgload, stop_s = 16384, 8, 10

@@ -63,6 +63,7 @@ enum PseudoSys : int64_t {
   PSYS_FUTEX_WAIT = -107,  // args: uaddr, timeout_ns (-1 none); ret 0/ETIMEDOUT
   PSYS_FUTEX_WAKE = -108,  // args: uaddr, n; ret = number woken
   PSYS_WAITPID = -109,     // args: pid (-1 any); ret = pid, data = i32 status
+  PSYS_FSTAT = -111,       // args: fd; ret = FD_KIND_* of the managed fd
   // handler-return notification: restores the pre-delivery signal mask
   // (the delivery auto-blocked the signal + sa_mask, Linux semantics)
   PSYS_SIG_RETURN = -110,
@@ -136,5 +137,15 @@ constexpr const char* ENV_LOG_STAMP = "SHADOW_TPU_LOG_STAMP";
 // fd table (descriptor_table.rs); partitioning keeps real-file IO native
 // with zero syscall traffic.
 constexpr int FD_BASE = 1000;
+
+// fd kinds reported by PSYS_FSTAT (shim builds struct stat from these)
+enum {
+  FD_KIND_OTHER = 0,
+  FD_KIND_SOCKET = 1,
+  FD_KIND_PIPE = 2,
+  FD_KIND_EVENTFD = 3,
+  FD_KIND_TIMERFD = 4,
+  FD_KIND_EPOLL = 5,
+};
 
 }  // namespace shadow_tpu

@@ -73,7 +73,11 @@
 #include <string.h>
 #include <sys/epoll.h>
 #include <sys/eventfd.h>
+#include <climits>
+#include <ifaddrs.h>
+#include <net/if.h>
 #include <sys/mman.h>
+#include <sys/stat.h>
 #include <sys/random.h>
 #include <sys/select.h>
 #include <sys/socket.h>
@@ -306,8 +310,8 @@ __attribute__((constructor)) void shim_init() {
             strerror(errno));
     return;
   }
-  void* p = mmap(nullptr, sizeof(Channel), PROT_READ | PROT_WRITE, MAP_SHARED,
-                 fd, 0);
+  void* p = (void*)sys_native(SYS_mmap, (long)nullptr, sizeof(Channel),
+                              PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
   close(fd);
   if (p == MAP_FAILED || ((Channel*)p)->magic != IPC_MAGIC) {
     fprintf(stderr, "shadow-tpu-shim: bad channel mapping\n");
@@ -1429,6 +1433,144 @@ int clock_nanosleep(clockid_t clk, int flags, const struct timespec* req,
 }  // extern "C"
 
 // ---------------------------------------------------------------------------
+// wider libc surface (VERDICT r4 #5): stat on managed fds, interface
+// enumeration, deterministic localtime, and the memory-map policy.
+// Reference analogs: syscall_handler.c stat dispatch rows,
+// preload_libraries.c:31-652 (getifaddrs/localtime), and
+// memory_manager/memory_mapper.rs:66-95 (mmap interception — here a
+// policy refusal: plugin memory is process-local by design, so only
+// sharing-capable mappings need denying).
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+int fstat(int fd, struct stat* st) {
+  if (!is_managed_fd(fd))
+    return (int)syscall(SYS_fstat, fd, st);
+  int64_t kind = ipc_call6(PSYS_FSTAT, fd);
+  if (kind < 0) return -1;  // errno set by ipc_call
+  memset(st, 0, sizeof(*st));
+  switch ((int)kind) {
+    case FD_KIND_SOCKET:
+      st->st_mode = S_IFSOCK | 0777;
+      break;
+    case FD_KIND_PIPE:
+      st->st_mode = S_IFIFO | 0600;
+      break;
+    default:  // eventfd/timerfd/epoll present as anonymous inodes
+      st->st_mode = S_IFCHR | 0600;
+      break;
+  }
+  st->st_nlink = 1;
+  st->st_blksize = 4096;
+  return 0;
+}
+
+int fstat64(int fd, struct stat64* st) {
+  return fstat(fd, (struct stat*)st);  // identical layout on x86_64
+}
+
+int fstatat(int dirfd, const char* path, struct stat* st, int flags) {
+  if (is_managed_fd(dirfd) && (!path || !path[0]))
+    return fstat(dirfd, st);  // AT_EMPTY_PATH form glibc uses for fstat
+  return (int)syscall(SYS_newfstatat, dirfd, path, st, flags);
+}
+
+// Interface enumeration (preload_libraries.c getifaddrs analog): lo plus
+// one eth0 carrying this host's simulated address. Allocated as a single
+// block; freeifaddrs releases it whole.
+struct ShimIfBlock {
+  struct ifaddrs ifa[2];
+  struct sockaddr_in addr[2];
+  struct sockaddr_in mask[2];
+  char names[2][8];
+};
+
+int getifaddrs(struct ifaddrs** out) {
+  if (!g_ch) {
+    errno = ENOSYS;  // no native fallback under the simulator
+    return -1;
+  }
+  char host[256];
+  if (gethostname(host, sizeof host) != 0) return -1;
+  int64_t args[6] = {0, 0, 0, 0, 0, 0};
+  int64_t ip = ipc_call(PSYS_RESOLVE_NAME, args, host,
+                        (uint32_t)strlen(host), nullptr, 0, nullptr);
+  if (ip < 0) return -1;
+  ShimIfBlock* b = (ShimIfBlock*)calloc(1, sizeof(ShimIfBlock));
+  if (!b) {
+    errno = ENOMEM;
+    return -1;
+  }
+  strcpy(b->names[0], "lo");
+  strcpy(b->names[1], "eth0");
+  uint32_t ips[2] = {INADDR_LOOPBACK, (uint32_t)ip};
+  uint32_t masks[2] = {0xFF000000u, 0xFFFFFF00u};
+  unsigned int fl[2] = {IFF_UP | IFF_RUNNING | IFF_LOOPBACK,
+                        IFF_UP | IFF_RUNNING | IFF_BROADCAST};
+  for (int i = 0; i < 2; i++) {
+    b->addr[i].sin_family = AF_INET;
+    b->addr[i].sin_addr.s_addr = htonl(ips[i]);
+    b->mask[i].sin_family = AF_INET;
+    b->mask[i].sin_addr.s_addr = htonl(masks[i]);
+    b->ifa[i].ifa_name = b->names[i];
+    b->ifa[i].ifa_flags = fl[i];
+    b->ifa[i].ifa_addr = (struct sockaddr*)&b->addr[i];
+    b->ifa[i].ifa_netmask = (struct sockaddr*)&b->mask[i];
+    b->ifa[i].ifa_next = i == 0 ? &b->ifa[1] : nullptr;
+  }
+  *out = &b->ifa[0];
+  return 0;
+}
+
+void freeifaddrs(struct ifaddrs* ifa) {
+  free(ifa);  // head of the single ShimIfBlock allocation
+}
+
+// Deterministic local time (preload_libraries.c localtime analog): the
+// simulated clock is already served by time()/clock_gettime(); pinning the
+// zone to UTC removes the host machine's /etc/localtime from results, so
+// runs reproduce across machines.
+struct tm* localtime_r(const time_t* t, struct tm* out) {
+  return gmtime_r(t, out);
+}
+
+struct tm* localtime(const time_t* t) {
+  static thread_local struct tm buf;
+  return gmtime_r(t, &buf);
+}
+
+// Memory-map policy (memory_mapper.rs:66-95 analog, inverted: the
+// reference remaps plugin memory into the simulator; here plugin memory
+// is process-local by design, so mmap runs native EXCEPT where a mapping
+// could smuggle nondeterministic shared state past the simulated I/O
+// plane: writable file-backed MAP_SHARED is refused, and managed fds are
+// not mappable at all. The shim's own channel mappings use raw syscalls
+// and bypass this.
+void* mmap(void* addr, size_t len, int prot, int flags, int fd, off_t off) {
+  if (g_ch) {
+    if (is_managed_fd(fd)) {
+      errno = ENODEV;
+      return MAP_FAILED;
+    }
+    if (fd >= 0 && (flags & MAP_SHARED) && (prot & PROT_WRITE)) {
+      SHIM_LOG("mmap policy: refusing writable MAP_SHARED of fd %d", fd);
+      errno = EACCES;
+      return MAP_FAILED;
+    }
+  }
+  return (void*)sys_native(SYS_mmap, (long)addr, (long)len, (long)prot,
+                           (long)flags, (long)fd, (long)off);
+}
+
+void* mmap64(void* addr, size_t len, int prot, int flags, int fd,
+             off64_t off) {
+  return mmap(addr, len, prot, flags, fd, (off_t)off);
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
 // seccomp/SIGSYS backstop (reference analog: shim.c:399-463): raw syscall
 // instructions that bypass the interposed libc symbols trap to SIGSYS and
 // are routed through the same wrappers. Only the emulated syscall numbers
@@ -1443,6 +1585,18 @@ namespace {
     long _r = (long)(call);                 \
     _r < 0 ? -(long)errno : _r;             \
   })
+
+// /proc/self/fd/<n> for a MANAGED n: reopening one's own descriptor is a
+// dup of the open description (the kernel's magic-symlink semantics for
+// pipes/sockets reduce to that here). Returns LONG_MIN when the path is
+// not a managed /proc/self/fd entry (caller falls through to native).
+long virt_proc_fd_open(const char* path) {
+  if (!path || strncmp(path, "/proc/self/fd/", 14) != 0) return LONG_MIN;
+  char* end = nullptr;
+  long n = strtol(path + 14, &end, 10);
+  if (!end || *end != 0 || n < FD_BASE) return LONG_MIN;
+  return RAWRET(dup((int)n));
+}
 
 long route_raw_syscall(long nr, long a0, long a1, long a2, long a3, long a4,
                        long a5) {
@@ -1571,9 +1725,16 @@ long route_raw_syscall(long nr, long a0, long a1, long a2, long a3, long a4,
     case SYS_sched_getaffinity:
       if (!g_ch) return shim_gate_syscall(nr, a0, a1, a2, a3, a4, a5);
       return sched_getaffinity_raw((pid_t)a0, (size_t)a1, (cpu_set_t*)a2);
+    case SYS_fstat:
+      return RAWRET(fstat((int)a0, (struct stat*)a1));
+    case SYS_newfstatat:
+      return RAWRET(fstatat((int)a0, (const char*)a1, (struct stat*)a2,
+                            (int)a3));
     case SYS_open: {
       long vfd = virt_cpu_file_open((const char*)a0);
       if (vfd >= 0) return vfd;
+      vfd = virt_proc_fd_open((const char*)a0);
+      if (vfd != LONG_MIN) return vfd;
       return shim_gate_syscall(nr, a0, a1, a2, a3, a4, a5);
     }
     case SYS_openat: {
@@ -1581,6 +1742,8 @@ long route_raw_syscall(long nr, long a0, long a1, long a2, long a3, long a4,
       if (p && p[0] == '/') {
         long vfd = virt_cpu_file_open(p);
         if (vfd >= 0) return vfd;
+        vfd = virt_proc_fd_open(p);
+        if (vfd != LONG_MIN) return vfd;
       }
       return shim_gate_syscall(nr, a0, a1, a2, a3, a4, a5);
     }
@@ -1656,6 +1819,9 @@ const TrapEntry kTrapped[] = {
     // glibc-internal (non-PLT) calls; non-matching paths re-enter the
     // kernel through the gate — one SIGSYS round trip per open
     {SYS_open, ACT_TRAP},         {SYS_openat, ACT_TRAP},
+    // stat family: managed fds present synthesized metadata (PSYS_FSTAT);
+    // newfstatat discriminates on dirfd (AT_EMPTY_PATH fstat form)
+    {SYS_fstat, ACT_FD0},         {SYS_newfstatat, ACT_FD0},
 };
 
 }  // namespace
@@ -1677,8 +1843,10 @@ namespace {
 Channel* map_channel(const char* path) {
   int fd = open(path, O_RDWR);
   if (fd < 0) return nullptr;
-  void* p = mmap(nullptr, sizeof(Channel), PROT_READ | PROT_WRITE, MAP_SHARED,
-                 fd, 0);
+  // raw syscall: the libc-visible mmap wrapper (below) denies writable
+  // MAP_SHARED file mappings as policy, and must not deny our own channels
+  void* p = (void*)sys_native(SYS_mmap, (long)nullptr, sizeof(Channel),
+                              PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
   close(fd);
   if (p == MAP_FAILED || ((Channel*)p)->magic != IPC_MAGIC) return nullptr;
   return (Channel*)p;

@@ -1465,6 +1465,7 @@ class Simulation:
         self,
         until: int | None = None,
         window_factor: int = 8,
+        adaptive: bool = True,
     ) -> tuple[int, int]:
         """Advance with speculative windows of window_factor × runahead.
 
@@ -1481,6 +1482,13 @@ class Simulation:
         violation-free by construction (emission time >= now + min_latency
         >= ws + runahead >= any processed time).
 
+        With ``adaptive`` (BASELINE config 4's "optimistic PDES windows"
+        tuning), the factor self-regulates between 1 and window_factor: a
+        rolled-back window halves it (speculation is outrunning the
+        workload's lookahead), four clean windows in a row double it —
+        the standard Time-Warp throttling shape, per-run deterministic
+        (the schedule depends only on sim state, never wall time).
+
         Returns (windows_committed, rollbacks). Produces the conservative
         schedule's results; wins when the pool holds work spanning many
         runaheads (fewer barriers/dispatches per simulated second).
@@ -1488,6 +1496,8 @@ class Simulation:
         stop = self.stop_time if until is None else min(until, self.stop_time)
         cons = self.runahead
         windows = rollbacks = 0
+        factor = window_factor
+        streak = 0
         neg1 = jnp.full((self.num_hosts,), -1, dtype=jnp.int64)
         self.state = self.state.replace(
             host=self.state.host.replace(done_t=neg1)
@@ -1495,8 +1505,9 @@ class Simulation:
         min_next = int(jnp.min(self.state.pool.time))
         while min_next < stop:
             ws = min_next
-            we = min(ws + window_factor * cons, stop)
+            we = min(ws + factor * cons, stop)
             base = self.state  # rollback snapshot (done_t already reset)
+            rb0 = rollbacks
             while True:  # attempt [ws, we) in ONE dispatch; shrink on violation
                 st, mn, viol = self._attempt(base, self.params, ws, we)
                 viol = int(viol)
@@ -1507,19 +1518,27 @@ class Simulation:
             self.state = st.replace(host=st.host.replace(done_t=neg1))
             min_next = int(mn)
             windows += 1
+            if adaptive:
+                if rollbacks > rb0:
+                    factor = max(1, factor // 2)
+                    streak = 0
+                else:
+                    streak += 1
+                    if streak >= 4 and factor < window_factor:
+                        factor = min(window_factor, factor * 2)
+                        streak = 0
         return windows, rollbacks
 
     # -- host-spill tier (core/spill.py): the pool never silently drops --
-    def _spill_marks(self) -> tuple[int, int, int]:
-        """(pressure mark, rebalance fill mark, single-host admission cap)
-        in pool rows per shard. Pressure must fire while the merge can
-        still absorb one window's inflow; the fill mark sits below
-        pressure so a rebalance exits the red zone; the cap bounds how
-        many rows one host may occupy when partially resident
-        (core/spill.py HostSpill.rebalance)."""
+    def _spill_marks(self) -> tuple[int, int]:
+        """(pressure mark, rebalance fill mark) in pool rows per shard.
+        Pressure must fire while the merge can still absorb one window's
+        inflow; the fill mark sits below pressure so a rebalance —
+        including a partially-resident giant host's admission — exits the
+        red zone and the fused loop keeps running windows."""
         C = int(self.state.pool.time.shape[-1])
         hi = C - spill_mod.red_zone(C)
-        return hi, max(1, (3 * hi) // 4), max(1, C - 64)
+        return hi, max(1, (3 * hi) // 4)
 
     def _spill_store(self):
         if getattr(self, "_spill", None) is None:

@@ -90,7 +90,7 @@ class HostSpill:
         # np.lexsort: last key is primary
         return np.lexsort((q, s, d, t))
 
-    def rebalance(self, shard: int, cols, fill: int, cap: int):
+    def rebalance(self, shard: int, cols, fill: int):
         """Restore the tier invariant for one shard, HOST-GRANULAR: hosts
         claim pool space in order of their earliest event key, and a host
         is resident ALL-OR-NOTHING — a parked host has every one of its
@@ -121,21 +121,23 @@ class HostSpill:
         )
         csum = np.cumsum(counts[host_rank])
         self._partial_min[shard] = int(NEVER)
-        if csum.size and csum[0] > cap:
-            # The earliest host alone exceeds the pool region: admit its
-            # earliest `cap` rows (it must be resident for progress) and
-            # have manage() clamp windows STRICTLY below its first parked
-            # row — a partially-resident host must never process or emit
+        if csum.size and csum[0] > fill:
+            # The earliest host alone exceeds the fill mark: admit its
+            # earliest `fill` rows (it must be resident for progress —
+            # and no more, or occupancy would sit in the red zone and the
+            # fused loop's pressure gate would never run a window).
+            # manage() clamps windows STRICTLY below its first parked row
+            # — a partially-resident host must never process or emit
             # at/past its own parked backlog, or order could diverge from
             # the oversized-pool run.
             h0 = host_rank[0]
             h0_rows = order[srt_d == h0]
-            keep = h0_rows[:cap]
+            keep = h0_rows[:fill]
             rest_mask = np.ones(order.shape[0], bool)
-            pos = np.flatnonzero(srt_d == h0)[:cap]
+            pos = np.flatnonzero(srt_d == h0)[:fill]
             rest_mask[pos] = False
             rest = order[rest_mask]
-            self._partial_min[shard] = int(at[h0_rows[cap]])
+            self._partial_min[shard] = int(at[h0_rows[fill]])
         else:
             # whole hosts while the total fits the fill mark (always >= 1)
             n_hosts = int(np.searchsorted(csum, fill, side="right"))
@@ -188,7 +190,7 @@ def manage(sim, spill: HostSpill, stop: int) -> int:
     import jax.numpy as jnp
 
     S = pool.time.shape[0] if island else 1
-    hi, fill, cap = sim._spill_marks()
+    hi, fill = sim._spill_marks()[:2]
     # occupancy reduces ON DEVICE — the full pool transfers to host only
     # when a shard actually needs a rebalance
     occ = np.atleast_1d(np.asarray(jax.device_get(
@@ -212,7 +214,7 @@ def manage(sim, spill: HostSpill, stop: int) -> int:
             tuple(c[sh] for c in cols_all) if island
             else tuple(cols_all)
         )
-        view = spill.rebalance(sh, view, fill, cap)
+        view = spill.rebalance(sh, view, fill)
         if island:
             for c, v in zip(cols_all, view):
                 c[sh] = v

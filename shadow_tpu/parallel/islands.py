@@ -186,8 +186,7 @@ class IslandSimulation(Simulation):
         # configured capacity PLUS that block — otherwise the block eats
         # real event storage and the shard overflows at C/S − S·X.
         C_shard = (C + S - 1) // S + S * self.exchange_slots
-        kw = dict(kw, event_capacity=C)  # global build first (unchanged)
-        super().__init__(**kw)
+        super().__init__(**kw)  # global build first; islandized below
 
         spec = IslandSpec(
             axis=AXIS, num_shards=S, exchange_slots=self.exchange_slots,
@@ -314,7 +313,7 @@ class IslandSimulation(Simulation):
                 "per-shard pool too small for its exchange block + red "
                 "zone; raise event_capacity or lower exchange_slots"
             )
-        return hi, max(1, (3 * hi) // 4), max(1, keep - 64)
+        return hi, max(1, (3 * hi) // 4)
 
     # ---- between-window re-sharding (the P3 work-stealing replacement,
     # scheduler_policy_host_steal.c:1-562 / logical_processor.rs:43-54) ----
@@ -341,6 +340,12 @@ class IslandSimulation(Simulation):
         observable effect on results (per-host order, RNG streams and seq
         numbering are functions of the GLOBAL host id only).
         """
+        if not self.rebalance_enabled:
+            raise RuntimeError(
+                "rebalance_now() needs rebalance=True at build time: the "
+                "window kernel must compile slot_of-table routing, or the "
+                "permuted layout would silently misroute events"
+            )
         S, Hl = self.num_shards, self.num_hosts // self.num_shards
         H = self.num_hosts
         sp = self._spill_store()

@@ -2298,6 +2298,25 @@ class ProcessDriver:
             done(self._futex_wake(proc.proc, a[0], a[1]))
         elif sysno == ipc.PSYS_WAITPID:
             self._waitpid(proc, a[0], bool(a[1]), park, done)
+        elif sysno == ipc.PSYS_FSTAT:
+            # stat family on managed fds (syscall_handler.c stat rows
+            # analog): report the descriptor KIND; the shim synthesizes
+            # the struct stat (st_mode by kind, anonymous-inode style)
+            obj = proc.fds.get(a[0])
+            if obj is None:
+                done(-errno.EBADF)
+            elif isinstance(obj, (Sock, BridgeEnd)):
+                done(ipc.FD_KIND_SOCKET)
+            elif isinstance(obj, PipeEnd):
+                done(ipc.FD_KIND_PIPE)
+            elif isinstance(obj, EventFd):
+                done(ipc.FD_KIND_EVENTFD)
+            elif isinstance(obj, TimerFd):
+                done(ipc.FD_KIND_TIMERFD)
+            elif isinstance(obj, Epoll):
+                done(ipc.FD_KIND_EPOLL)
+            else:
+                done(0)
         elif sysno == ipc.PSYS_SIG_RETURN:
             # handler finished: restore the pre-delivery mask (delivery
             # pushed it in _next_signal); the done() reply may itself carry
@@ -2754,12 +2773,37 @@ class ProcessDriver:
             if proc.popen is not None and proc.popen.poll() is not None:
                 # drain any message raced in just before exit
                 if not proc.channel.try_request():
+                    if proc.tid == 0 and proc.proc.native_pid is None:
+                        # The image ran and exited WITHOUT ever completing
+                        # the shim handshake: LD_PRELOAD never took (a
+                        # statically linked binary, or an exec of one).
+                        # The reference covers these with ptrace
+                        # (thread_ptrace.c); we fail LOUDLY instead of
+                        # letting the process run unsimulated and silently
+                        # corrupt determinism.
+                        raise DriverError(
+                            f"{proc.name}: process exited (rc="
+                            f"{proc.popen.returncode}) without completing "
+                            f"the shim handshake — statically linked "
+                            f"binary? Interposition requires dynamically "
+                            f"linked executables (reference covers static "
+                            f"binaries via ptrace; unsupported here)"
+                        )
                     proc.proc.exit_code = proc.popen.returncode
                     for t in proc.proc.threads:
                         t.state = ManagedThread.EXITED
                     proc.proc.exited = True
                     return False
                 break
+            if wall_time.monotonic() > deadline and (
+                proc.tid == 0 and proc.proc.native_pid is None
+            ):
+                raise DriverError(
+                    f"{proc.name}: no shim handshake within "
+                    f"{self.service_timeout_s}s — statically linked "
+                    f"binary running unsimulated? Interposition requires "
+                    f"dynamically linked executables"
+                )
             if wall_time.monotonic() > deadline:
                 raise DriverError(
                     f"{proc.name}: no syscall within "

@@ -38,6 +38,10 @@ PSYS_FUTEX_WAIT = -107
 PSYS_FUTEX_WAKE = -108
 PSYS_WAITPID = -109
 PSYS_SIG_RETURN = -110  # handler finished: restore pre-delivery sig mask
+PSYS_FSTAT = -111  # args: fd -> FD_KIND_* code (shim builds struct stat)
+FD_KIND_SOCKET, FD_KIND_PIPE, FD_KIND_EVENTFD, FD_KIND_TIMERFD, FD_KIND_EPOLL = (
+    1, 2, 3, 4, 5,
+)
 
 FD_BASE = 1000
 

@@ -1,0 +1,72 @@
+/* Circuit client for the multi-hop relay e2e (tor-minimal analog,
+ * verify.sh:7-22 grep protocol): builds an onion-style circuit through
+ * relays, requests nbytes from the exit server, and prints one
+ * "stream-success" per completed stream.
+ *
+ * Usage: circuit_client <entry_host> <entry_port> <circuit> <streams> <nbytes>
+ *   circuit = "hop2:port/hop3:port/exit:port/" (hops AFTER the entry)
+ */
+#define _GNU_SOURCE
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+static int conn_to(const char* host, const char* port) {
+  struct addrinfo hints = {0}, *ai = NULL;
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  if (getaddrinfo(host, port, &hints, &ai) != 0 || !ai) return -1;
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0 || connect(fd, ai->ai_addr, ai->ai_addrlen) != 0) {
+    if (fd >= 0) close(fd);
+    freeaddrinfo(ai);
+    return -1;
+  }
+  freeaddrinfo(ai);
+  return fd;
+}
+
+int main(int argc, char** argv) {
+  if (argc < 6) return 2;
+  const char* entry = argv[1];
+  const char* eport = argv[2];
+  const char* circuit = argv[3];
+  int streams = atoi(argv[4]);
+  long nbytes = atol(argv[5]);
+  int ok = 0;
+  for (int s = 0; s < streams; s++) {
+    int fd = conn_to(entry, eport);
+    if (fd < 0) {
+      fprintf(stderr, "stream %d: connect failed\n", s);
+      continue;
+    }
+    char req[640];
+    int m = snprintf(req, sizeof req, "%s\nGET %ld\n", circuit, nbytes);
+    ssize_t off = 0;
+    while (off < m) {
+      ssize_t w = write(fd, req + off, (size_t)(m - off));
+      if (w <= 0) break;
+      off += w;
+    }
+    long got = 0;
+    char buf[4096];
+    for (;;) {
+      ssize_t r = read(fd, buf, sizeof buf);
+      if (r <= 0) break;
+      got += r;
+    }
+    close(fd);
+    if (got == nbytes) {
+      printf("stream-success %d %ld\n", s, got);
+      ok++;
+    } else {
+      printf("stream-fail %d %ld/%ld\n", s, got, nbytes);
+    }
+  }
+  printf("client done %d/%d\n", ok, streams);
+  return ok == streams ? 0 : 1;
+}

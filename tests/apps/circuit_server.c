@@ -1,0 +1,123 @@
+/* Exit server for the multi-hop relay e2e: accepts connections, reads a
+ * "GET <nbytes>\n" request, streams nbytes of deterministic data back,
+ * half-closes. poll()-multiplexed like relay.c.
+ *
+ * Usage: circuit_server <port> [lifetime_s]
+ */
+#define _GNU_SOURCE
+#include <arpa/inet.h>
+#include <poll.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <time.h>
+#include <unistd.h>
+
+#define MAX_SESS 512
+#define BUF 4096
+
+typedef struct {
+  int fd;
+  char req[64];
+  int req_n;
+  long remaining; /* -1 until the request parses */
+} Sess;
+
+static Sess sess[MAX_SESS];
+static int nsess = 0;
+
+static void drop(int i) {
+  close(sess[i].fd);
+  sess[i] = sess[--nsess];
+}
+
+int main(int argc, char** argv) {
+  if (argc < 2) return 2;
+  int port = atoi(argv[1]);
+  int life = argc > 2 ? atoi(argv[2]) : 0;
+  time_t t0 = time(NULL);
+  int ls = socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  setsockopt(ls, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+  struct sockaddr_in a = {0};
+  a.sin_family = AF_INET;
+  a.sin_port = htons(port);
+  if (bind(ls, (struct sockaddr*)&a, sizeof a) != 0) {
+    perror("bind");
+    return 1;
+  }
+  listen(ls, 256);
+  printf("server up %d\n", port);
+  fflush(stdout);
+  int served = 0;
+  char chunk[BUF];
+  for (size_t i = 0; i < sizeof chunk; i++) chunk[i] = (char)('a' + i % 26);
+
+  for (;;) {
+    if (life && time(NULL) - t0 >= life) break;
+    struct pollfd pf[1 + MAX_SESS];
+    int n = 0;
+    pf[n].fd = ls;
+    pf[n].events = nsess < MAX_SESS ? POLLIN : 0;
+    n++;
+    for (int i = 0; i < nsess; i++) {
+      pf[n].fd = sess[i].fd;
+      pf[n].events = sess[i].remaining < 0 ? POLLIN : POLLOUT;
+      n++;
+    }
+    if (poll(pf, n, 1000) < 0) break;
+    if (pf[0].revents & POLLIN) {
+      int c = accept(ls, NULL, NULL);
+      if (c >= 0 && nsess < MAX_SESS) {
+        Sess* s = &sess[nsess++];
+        memset(s, 0, sizeof *s);
+        s->fd = c;
+        s->remaining = -1;
+      } else if (c >= 0) {
+        close(c);
+      }
+    }
+    for (int k = 1; k < n; k++) {
+      int i = k - 1;
+      if (i >= nsess) continue;
+      Sess* s = &sess[i];
+      if (pf[k].fd != s->fd || !pf[k].revents) continue;
+      if (s->remaining < 0) {
+        ssize_t r = read(s->fd, s->req + s->req_n,
+                         sizeof(s->req) - 1 - s->req_n);
+        if (r <= 0) {
+          drop(i);
+          continue;
+        }
+        s->req_n += (int)r;
+        s->req[s->req_n] = 0;
+        char* nl = strchr(s->req, '\n');
+        if (!nl) continue;
+        long want = 0;
+        if (sscanf(s->req, "GET %ld", &want) != 1 || want < 0) {
+          drop(i);
+          continue;
+        }
+        s->remaining = want;
+      } else if (s->remaining > 0) {
+        size_t m = s->remaining < (long)sizeof chunk ? (size_t)s->remaining
+                                                     : sizeof chunk;
+        ssize_t w = write(s->fd, chunk, m);
+        if (w <= 0) {
+          drop(i);
+          continue;
+        }
+        s->remaining -= w;
+      }
+      if (s->remaining == 0) {
+        served++;
+        printf("served %d\n", served);
+        fflush(stdout);
+        drop(i);
+      }
+    }
+  }
+  printf("server done %d\n", served);
+  return 0;
+}

@@ -1,0 +1,279 @@
+/* Onion-style chained TCP forwarder (the tor-relay analog for the
+ * multi-hop e2e, reference: src/test/tor/minimal). Protocol: each inbound
+ * connection starts with one header line
+ *     hop1:port1/hop2:port2/.../\n
+ * naming the REMAINING circuit hops. The relay strips the first hop,
+ * connects to it, forwards the shortened header, then splices bytes both
+ * ways until EOF. A single poll() loop multiplexes many circuits.
+ *
+ * Usage: relay <listen_port> [max_lifetime_s]
+ */
+#define _GNU_SOURCE
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netdb.h>
+#include <poll.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <time.h>
+#include <unistd.h>
+
+#define MAX_SESS 512
+#define BUF 4096
+
+typedef struct {
+  int up;    /* inbound (toward client) */
+  int down;  /* outbound (toward next hop); -1 until connected */
+  int connecting; /* nonblocking connect in flight on down */
+  char hdr[512];
+  int hdr_len;
+  int hdr_done;
+  /* pending bytes parked in either direction */
+  char ub[BUF];
+  int ub_n;
+  char db[BUF];
+  int db_n;
+  int up_eof, down_eof;
+  char fwd_hdr[512];
+  int fwd_len, fwd_sent;
+} Sess;
+
+static Sess sess[MAX_SESS];
+static int nsess = 0;
+
+/* NONBLOCKING connect (a blocking one would serialize every circuit
+ * through this relay on the network RTT — the scale wall a real relay
+ * avoids the same way). *connecting is set when completion is pending
+ * (POLLOUT + SO_ERROR check). */
+static int conn_to(const char* host, int port, int* connecting) {
+  struct addrinfo hints = {0}, *ai = NULL;
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  char ps[16];
+  snprintf(ps, sizeof ps, "%d", port);
+  if (getaddrinfo(host, ps, &hints, &ai) != 0 || !ai) return -1;
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) {
+    freeaddrinfo(ai);
+    return -1;
+  }
+  fcntl(fd, F_SETFL, fcntl(fd, F_GETFL, 0) | O_NONBLOCK);
+  *connecting = 0;
+  if (connect(fd, ai->ai_addr, ai->ai_addrlen) != 0) {
+    if (errno == EINPROGRESS) {
+      *connecting = 1;
+    } else {
+      close(fd);
+      freeaddrinfo(ai);
+      return -1;
+    }
+  }
+  freeaddrinfo(ai);
+  return fd;
+}
+
+static int would_block(ssize_t r) {
+  return r < 0 && (errno == EAGAIN || errno == EWOULDBLOCK);
+}
+
+static void drop(int i) {
+  if (sess[i].up >= 0) close(sess[i].up);
+  if (sess[i].down >= 0) close(sess[i].down);
+  sess[i] = sess[--nsess];
+}
+
+int main(int argc, char** argv) {
+  if (argc < 2) return 2;
+  int port = atoi(argv[1]);
+  int life = argc > 2 ? atoi(argv[2]) : 0;
+  time_t t0 = time(NULL);
+  int ls = socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  setsockopt(ls, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+  struct sockaddr_in a = {0};
+  a.sin_family = AF_INET;
+  a.sin_port = htons(port);
+  if (bind(ls, (struct sockaddr*)&a, sizeof a) != 0) {
+    perror("bind");
+    return 1;
+  }
+  listen(ls, 256);
+  fprintf(stdout, "relay up %d\n", port);
+  fflush(stdout);
+
+  for (;;) {
+    if (life && time(NULL) - t0 >= life) break;
+    struct pollfd pf[1 + 2 * MAX_SESS];
+    int map[1 + 2 * MAX_SESS];
+    int n = 0;
+    pf[n].fd = ls;
+    pf[n].events = nsess < MAX_SESS ? POLLIN : 0;
+    map[n++] = -1;
+    for (int i = 0; i < nsess; i++) {
+      Sess* s = &sess[i];
+      short ue = 0, de = 0;
+      if (!s->hdr_done || (!s->up_eof && s->ub_n < BUF)) ue |= POLLIN;
+      if (s->db_n > 0) ue |= POLLOUT;
+      if (s->down >= 0) {
+        if (s->connecting) {
+          de = POLLOUT;  /* connect completion only */
+        } else {
+          if (s->fwd_sent < s->fwd_len || s->ub_n > 0) de |= POLLOUT;
+          if (!s->down_eof && s->db_n < BUF) de |= POLLIN;
+        }
+      }
+      pf[n].fd = s->up;
+      pf[n].events = ue;
+      map[n++] = i;
+      if (s->down >= 0) {
+        pf[n].fd = s->down;
+        pf[n].events = de;
+        map[n++] = i;
+      }
+    }
+    int rc = poll(pf, n, 1000);
+    if (rc < 0) break;
+    if (pf[0].revents & POLLIN) {
+      int c = accept(ls, NULL, NULL);
+      if (c >= 0 && nsess < MAX_SESS) {
+        fcntl(c, F_SETFL, fcntl(c, F_GETFL, 0) | O_NONBLOCK);
+        Sess* s = &sess[nsess++];
+        memset(s, 0, sizeof *s);
+        s->up = c;
+        s->down = -1;
+      } else if (c >= 0) {
+        close(c);
+      }
+    }
+    for (int k = 1; k < n; k++) {
+      int i = map[k];
+      if (i >= nsess) continue;  /* compacted away this round */
+      Sess* s = &sess[i];
+      int fd = pf[k].fd;
+      if (fd != s->up && fd != s->down) continue;
+      short re = pf[k].revents;
+      if (!re) continue;
+      if (fd == s->up && !s->hdr_done && (re & (POLLIN | POLLHUP))) {
+        ssize_t r = read(s->up, s->hdr + s->hdr_len,
+                         sizeof(s->hdr) - 1 - s->hdr_len);
+        if (would_block(r)) continue;
+        if (r <= 0) {
+          drop(i);
+          continue;
+        }
+        s->hdr_len += (int)r;
+        s->hdr[s->hdr_len] = 0;
+        char* nl = strchr(s->hdr, '\n');
+        if (!nl) continue;
+        *nl = 0;
+        /* first hop = "host:port"; rest (may be empty) forwards on */
+        char* slash = strchr(s->hdr, '/');
+        char rest[512] = "";
+        if (slash) {
+          snprintf(rest, sizeof rest, "%s", slash + 1);
+          *slash = 0;
+        }
+        char* colon = strchr(s->hdr, ':');
+        if (!colon) {
+          drop(i);
+          continue;
+        }
+        *colon = 0;
+        int dport = atoi(colon + 1);
+        s->down = conn_to(s->hdr, dport, &s->connecting);
+        if (s->down < 0) {
+          drop(i);
+          continue;
+        }
+        if (rest[0]) {
+          s->fwd_len = snprintf(s->fwd_hdr, sizeof s->fwd_hdr, "%s\n", rest);
+        }
+        /* any app bytes that followed the newline are queued upstream */
+        int extra = s->hdr_len - (int)(nl - s->hdr) - 1;
+        if (extra > 0) {
+          memcpy(s->ub, nl + 1, (size_t)extra);
+          s->ub_n = extra;
+        }
+        s->hdr_done = 1;
+        continue;
+      }
+      if (fd == s->down && s->connecting && (re & (POLLOUT | POLLERR))) {
+        int err = 0;
+        socklen_t el = sizeof err;
+        getsockopt(s->down, SOL_SOCKET, SO_ERROR, &err, &el);
+        if (err != 0) {
+          drop(i);
+          continue;
+        }
+        s->connecting = 0;
+      }
+      if (fd == s->down && !s->connecting && (re & POLLOUT)) {
+        if (s->fwd_sent < s->fwd_len) {
+          ssize_t w = write(s->down, s->fwd_hdr + s->fwd_sent,
+                            (size_t)(s->fwd_len - s->fwd_sent));
+          if (w > 0) s->fwd_sent += (int)w;
+        } else if (s->ub_n > 0) {
+          ssize_t w = write(s->down, s->ub, (size_t)s->ub_n);
+          if (w > 0) {
+            memmove(s->ub, s->ub + w, (size_t)(s->ub_n - w));
+            s->ub_n -= (int)w;
+          }
+        }
+      }
+      if (fd == s->up && s->hdr_done && (re & (POLLIN | POLLHUP))) {
+        if (s->ub_n < BUF) {
+          ssize_t r = read(s->up, s->ub + s->ub_n, (size_t)(BUF - s->ub_n));
+          if (would_block(r)) {
+            /* spurious wake: not EOF */
+          } else if (r <= 0) {
+            s->up_eof = 1;
+            if (s->down >= 0 && s->ub_n == 0 && s->fwd_sent >= s->fwd_len &&
+                !s->connecting)
+              shutdown(s->down, SHUT_WR);
+          } else {
+            s->ub_n += (int)r;
+          }
+        }
+      }
+      if (fd == s->down && !s->connecting && (re & (POLLIN | POLLHUP))) {
+        if (s->db_n < BUF) {
+          ssize_t r = read(s->down, s->db + s->db_n,
+                           (size_t)(BUF - s->db_n));
+          if (would_block(r)) {
+            r = 0; /* placeholder; handled below */
+            goto down_read_done;
+          }
+          if (r <= 0) {
+            s->down_eof = 1;
+            if (s->db_n == 0) shutdown(s->up, SHUT_WR);
+          } else {
+            s->db_n += (int)r;
+          }
+        }
+      }
+      down_read_done:
+      if (fd == s->up && (re & POLLOUT) && s->db_n > 0) {
+        ssize_t w = write(s->up, s->db, (size_t)s->db_n);
+        if (w > 0) {
+          memmove(s->db, s->db + w, (size_t)(s->db_n - w));
+          s->db_n -= (int)w;
+          if (s->down_eof && s->db_n == 0) shutdown(s->up, SHUT_WR);
+        }
+      }
+      /* drain completions */
+      if (s->up_eof == 1 && s->down >= 0 && !s->connecting &&
+          s->ub_n == 0 && s->fwd_sent >= s->fwd_len) {
+        shutdown(s->down, SHUT_WR);
+        s->up_eof = 2;
+      }
+      if (s->up_eof && s->down_eof && s->ub_n == 0 && s->db_n == 0) {
+        drop(i);
+      }
+    }
+  }
+  fprintf(stdout, "relay done\n");
+  return 0;
+}

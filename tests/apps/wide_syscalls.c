@@ -1,0 +1,115 @@
+/* Wider-syscall-surface probe (VERDICT r4 #5): stat family on managed
+ * fds, getifaddrs, deterministic localtime, mmap policy, /proc/self/fd.
+ * Prints one "ok <probe>" line per passing probe; exits nonzero on the
+ * first failure so the driver test can grep like verify.sh does. */
+#define _GNU_SOURCE
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <ifaddrs.h>
+#include <net/if.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/eventfd.h>
+#include <sys/mman.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <time.h>
+#include <unistd.h>
+
+static int fail(const char* what) {
+  fprintf(stderr, "FAIL %s: %s\n", what, strerror(errno));
+  return 1;
+}
+
+int main(void) {
+  /* ---- fstat on managed fds ---- */
+  int s = socket(AF_INET, SOCK_DGRAM, 0);
+  if (s < 0) return fail("socket");
+  struct stat st;
+  if (fstat(s, &st) != 0) return fail("fstat(sock)");
+  if (!S_ISSOCK(st.st_mode)) return fail("fstat(sock) mode");
+  printf("ok fstat-sock\n");
+
+  int pfd[2];
+  if (pipe(pfd) != 0) return fail("pipe");
+  if (fstat(pfd[0], &st) != 0) return fail("fstat(pipe)");
+  if (!S_ISFIFO(st.st_mode)) return fail("fstat(pipe) mode");
+  printf("ok fstat-pipe\n");
+
+  int efd = eventfd(0, 0);
+  if (efd < 0 || fstat(efd, &st) != 0) return fail("fstat(eventfd)");
+  printf("ok fstat-eventfd\n");
+
+  /* ---- getifaddrs: lo + eth0 with the simulated address ---- */
+  struct ifaddrs* ifa = NULL;
+  if (getifaddrs(&ifa) != 0) return fail("getifaddrs");
+  int saw_lo = 0;
+  char eth_ip[64] = "";
+  for (struct ifaddrs* p = ifa; p; p = p->ifa_next) {
+    if (!p->ifa_addr || p->ifa_addr->sa_family != AF_INET) continue;
+    struct sockaddr_in* sin = (struct sockaddr_in*)p->ifa_addr;
+    if (p->ifa_flags & IFF_LOOPBACK) {
+      saw_lo = 1;
+    } else {
+      inet_ntop(AF_INET, &sin->sin_addr, eth_ip, sizeof eth_ip);
+    }
+  }
+  freeifaddrs(ifa);
+  if (!saw_lo || !eth_ip[0]) return fail("getifaddrs entries");
+  printf("ok getifaddrs %s\n", eth_ip);
+
+  /* ---- localtime: simulated clock, UTC, deterministic ---- */
+  time_t t = time(NULL);
+  struct tm tmv;
+  if (!localtime_r(&t, &tmv)) return fail("localtime_r");
+  printf("ok localtime %ld %04d-%02d-%02d %02d:%02d:%02d\n", (long)t,
+         tmv.tm_year + 1900, tmv.tm_mon + 1, tmv.tm_mday, tmv.tm_hour,
+         tmv.tm_min, tmv.tm_sec);
+
+  /* ---- mmap policy ---- */
+  void* anon = mmap(NULL, 4096, PROT_READ | PROT_WRITE,
+                    MAP_PRIVATE | MAP_ANONYMOUS, -1, 0);
+  if (anon == MAP_FAILED) return fail("mmap(anon)");
+  ((char*)anon)[0] = 1;
+  munmap(anon, 4096);
+  printf("ok mmap-anon\n");
+
+  char tmpl[] = "/tmp/shadow_mmap_XXXXXX";
+  int tf = mkstemp(tmpl);
+  if (tf < 0) return fail("mkstemp");
+  if (ftruncate(tf, 4096) != 0) return fail("ftruncate");
+  void* shared = mmap(NULL, 4096, PROT_READ | PROT_WRITE, MAP_SHARED, tf, 0);
+  if (shared != MAP_FAILED || errno != EACCES) {
+    fprintf(stderr, "FAIL mmap policy: writable MAP_SHARED allowed\n");
+    return 1;
+  }
+  void* ro = mmap(NULL, 4096, PROT_READ, MAP_SHARED, tf, 0);
+  if (ro == MAP_FAILED) return fail("mmap(ro-shared)");
+  munmap(ro, 4096);
+  close(tf);
+  unlink(tmpl);
+  printf("ok mmap-policy\n");
+
+  if (mmap(NULL, 4096, PROT_READ, MAP_SHARED, s, 0) != MAP_FAILED ||
+      errno != ENODEV) {
+    fprintf(stderr, "FAIL mmap(managed fd) allowed\n");
+    return 1;
+  }
+  printf("ok mmap-managed-denied\n");
+
+  /* ---- /proc/self/fd on a managed fd: reopen == dup ---- */
+  char path[64];
+  snprintf(path, sizeof path, "/proc/self/fd/%d", pfd[1]);
+  int wdup = open(path, O_WRONLY);
+  if (wdup < 0) return fail("open(/proc/self/fd)");
+  if (write(wdup, "x", 1) != 1) return fail("write(dup)");
+  char c = 0;
+  if (read(pfd[0], &c, 1) != 1 || c != 'x') return fail("read(pipe)");
+  close(wdup);
+  printf("ok proc-self-fd\n");
+
+  printf("wide done\n");
+  return 0;
+}

