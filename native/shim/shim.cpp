@@ -190,6 +190,7 @@ bool is_managed_fd(int fd) { return g_ch != nullptr && fd >= FD_BASE; }
 
 void shim_install_seccomp();  // defined at the bottom (needs the wrappers)
 void shim_patch_vdso();       // defined at the bottom
+extern "C" void shim_install_tsc_trap();  // rdtsc virtualization (tsc.c)
 void shim_notify_exit(int status, void*);  // defined with the thread plane
 
 // One request/response round trip. data_in/data_in_len ride to the driver;
@@ -335,6 +336,7 @@ __attribute__((constructor)) void shim_init() {
   if (!sec || strcmp(sec, "0") != 0) {
     shim_patch_vdso();  // before the filter: time must reach the kernel
     shim_install_seccomp();
+    shim_install_tsc_trap();  // raw rdtsc reads the virtual clock too
   }
 }
 
@@ -460,10 +462,98 @@ static bool is_virt_sig(int sig) {
   return sig >= 1 && sig <= 64 && ((VIRT_SIG_MASK >> (sig - 1)) & 1);
 }
 
+// ---------------------------------------------------------------------------
+// rdtsc virtualization (reference analog: host/tsc.c:127). PR_SET_TSC
+// makes every raw rdtsc/rdtscp in app code fault; the SIGSEGV handler
+// decodes the two instruction forms and emulates them from the channel's
+// last-stamped sim time (a plain memory read — async-signal-safe): a
+// virtual 1 GHz TSC where 1 cycle == 1 sim-ns. App timing loops built on
+// rdtsc therefore read DETERMINISTIC virtual time instead of the real
+// machine's, like every other clock under the simulator. An app's own
+// SIGSEGV handler (registered through our sigaction) chains for
+// non-rdtsc faults.
+// ---------------------------------------------------------------------------
+
+struct sigaction g_app_segv;   // app's chained SIGSEGV disposition
+bool g_app_segv_set = false;
+bool g_tsc_trap_on = false;    // emulator installed (gates the intercepts)
+
+void on_sigsegv_tsc(int sig, siginfo_t* info, void* vctx) {
+#if defined(__x86_64__)
+  ucontext_t* uc = (ucontext_t*)vctx;
+  greg_t* g = uc->uc_mcontext.gregs;
+  const uint8_t* ip = (const uint8_t*)g[REG_RIP];
+  // PR_TSC faults arrive with si_code SI_KERNEL and RIP at the (mapped,
+  // executable) rdtsc insn; genuine memory faults are SEGV_MAPERR/ACCERR
+  // — gate on that BEFORE reading *ip, or a wild jump to an unmapped
+  // address would re-fault inside this handler
+  if (info->si_code == SI_KERNEL && ip && ip[0] == 0x0F &&
+      (ip[1] == 0x31 || (ip[1] == 0x01 && ip[2] == 0xF9))) {
+    Channel* c = cur_channel();
+    uint64_t ns = c ? (uint64_t)c->sim_time_ns : 0;
+    g[REG_RAX] = (greg_t)(ns & 0xFFFFFFFFu);
+    g[REG_RDX] = (greg_t)(ns >> 32);
+    if (ip[1] == 0x01) {       // rdtscp: also IA32_TSC_AUX -> ECX
+      g[REG_RCX] = 0;
+      g[REG_RIP] += 3;
+    } else {
+      g[REG_RIP] += 2;
+    }
+    return;
+  }
+#endif
+  // not an rdtsc fault: hand to the app's handler if it has a callable
+  // one; otherwise die like SIG_DFL (returning would restart the faulting
+  // instruction forever — SIG_IGN on a hardware fault is DFL in Linux)
+  if (g_app_segv_set) {
+    if (g_app_segv.sa_flags & SA_SIGINFO) {
+      g_app_segv.sa_sigaction(sig, info, vctx);
+      return;
+    }
+    if (g_app_segv.sa_handler != SIG_IGN &&
+        g_app_segv.sa_handler != SIG_DFL) {
+      g_app_segv.sa_handler(sig);
+      return;
+    }
+  }
+  signal(SIGSEGV, SIG_DFL);
+  raise(SIGSEGV);
+}
+
+void shim_install_tsc_trap() {
+#if defined(__x86_64__)
+  struct sigaction sa;
+  memset(&sa, 0, sizeof(sa));
+  sa.sa_sigaction = on_sigsegv_tsc;
+  sa.sa_flags = SA_SIGINFO | SA_NODEFER;
+  static auto real_sigaction =
+      (int (*)(int, const struct sigaction*, struct sigaction*))dlsym(
+          RTLD_NEXT, "sigaction");
+  real_sigaction(SIGSEGV, &sa, nullptr);
+  if (prctl(PR_SET_TSC, PR_TSC_SIGSEGV, 0, 0, 0) != 0) {
+    SHIM_LOG("PR_SET_TSC unavailable: raw rdtsc stays unvirtualized");
+  } else {
+    g_tsc_trap_on = true;
+  }
+#endif
+}
+
 int sigaction(int sig, const struct sigaction* act, struct sigaction* old) {
   static auto real_sigaction =
       (int (*)(int, const struct sigaction*, struct sigaction*))dlsym(
           RTLD_NEXT, "sigaction");
+  if (g_ch && sig == SIGSEGV && g_tsc_trap_on) {
+    // keep the rdtsc trap installed; the app's handler chains for
+    // genuine faults (on_sigsegv_tsc dispatches non-rdtsc hits to it).
+    // Only when the trap is actually installed — otherwise the app's
+    // registration must reach the kernel normally.
+    if (old) *old = g_app_segv;
+    if (act) {
+      g_app_segv = *act;
+      g_app_segv_set = true;
+    }
+    return 0;
+  }
   if (!g_ch || !is_virt_sig(sig)) return real_sigaction(sig, act, old);
   int64_t handler = 0, flags = 0;
   uint64_t mask = 0;
@@ -1444,9 +1534,20 @@ int clock_nanosleep(clockid_t clk, int flags, const struct timespec* req,
 
 extern "C" {
 
+// raw-kernel convention (-errno) → libc convention (-1 + errno)
+#define RAWRET_INV(call)                    \
+  ({                                        \
+    long _r = (long)(call);                 \
+    if (_r < 0) {                           \
+      errno = (int)-_r;                     \
+      _r = -1;                              \
+    }                                       \
+    _r;                                     \
+  })
+
 int fstat(int fd, struct stat* st) {
   if (!is_managed_fd(fd))
-    return (int)syscall(SYS_fstat, fd, st);
+    return (int)RAWRET_INV(sys_native(SYS_fstat, fd, st));
   int64_t kind = ipc_call6(PSYS_FSTAT, fd);
   if (kind < 0) return -1;  // errno set by ipc_call
   memset(st, 0, sizeof(*st));
@@ -1473,7 +1574,12 @@ int fstat64(int fd, struct stat64* st) {
 int fstatat(int dirfd, const char* path, struct stat* st, int flags) {
   if (is_managed_fd(dirfd) && (!path || !path[0]))
     return fstat(dirfd, st);  // AT_EMPTY_PATH form glibc uses for fstat
-  return (int)syscall(SYS_newfstatat, dirfd, path, st, flags);
+  // sys_native (the IP-whitelisted gate), NEVER plain syscall(): the raw
+  // instruction would re-trap the seccomp filter forever — and the FD0
+  // discriminator compares arg0 low-32 UNSIGNED, so AT_FDCWD (-100)
+  // traps every path-based stat through here
+  return (int)RAWRET_INV(sys_native(SYS_newfstatat, dirfd, path, st,
+                                    flags));
 }
 
 // Interface enumeration (preload_libraries.c getifaddrs analog): lo plus
@@ -1483,6 +1589,7 @@ struct ShimIfBlock {
   struct ifaddrs ifa[2];
   struct sockaddr_in addr[2];
   struct sockaddr_in mask[2];
+  struct sockaddr_in bcast[2];
   char names[2][8];
 };
 
@@ -1513,10 +1620,14 @@ int getifaddrs(struct ifaddrs** out) {
     b->addr[i].sin_addr.s_addr = htonl(ips[i]);
     b->mask[i].sin_family = AF_INET;
     b->mask[i].sin_addr.s_addr = htonl(masks[i]);
+    b->bcast[i].sin_family = AF_INET;
+    b->bcast[i].sin_addr.s_addr = htonl(ips[i] | ~masks[i]);
     b->ifa[i].ifa_name = b->names[i];
     b->ifa[i].ifa_flags = fl[i];
     b->ifa[i].ifa_addr = (struct sockaddr*)&b->addr[i];
     b->ifa[i].ifa_netmask = (struct sockaddr*)&b->mask[i];
+    if (fl[i] & IFF_BROADCAST)  // contract: broadaddr valid when flagged
+      b->ifa[i].ifa_broadaddr = (struct sockaddr*)&b->bcast[i];
     b->ifa[i].ifa_next = i == 0 ? &b->ifa[1] : nullptr;
   }
   *out = &b->ifa[0];
@@ -1727,6 +1838,11 @@ long route_raw_syscall(long nr, long a0, long a1, long a2, long a3, long a4,
       return sched_getaffinity_raw((pid_t)a0, (size_t)a1, (cpu_set_t*)a2);
     case SYS_fstat:
       return RAWRET(fstat((int)a0, (struct stat*)a1));
+    case SYS_mmap: {
+      void* r = mmap((void*)a0, (size_t)a1, (int)a2, (int)a3, (int)a4,
+                     (off_t)a5);
+      return r == MAP_FAILED ? -(long)errno : (long)r;
+    }
     case SYS_newfstatat:
       return RAWRET(fstatat((int)a0, (const char*)a1, (struct stat*)a2,
                             (int)a3));
@@ -1822,6 +1938,10 @@ const TrapEntry kTrapped[] = {
     // stat family: managed fds present synthesized metadata (PSYS_FSTAT);
     // newfstatat discriminates on dirfd (AT_EMPTY_PATH fstat form)
     {SYS_fstat, ACT_FD0},         {SYS_newfstatat, ACT_FD0},
+    // mmap policy (writable file-backed MAP_SHARED refused) must hold
+    // for raw/glibc-internal calls too; the shim's own channel maps go
+    // through the gate and are exempt
+    {SYS_mmap, ACT_TRAP},
 };
 
 }  // namespace
@@ -1906,6 +2026,11 @@ void thread_epilogue() {
 }
 
 void* thread_tramp(void* vp) {
+#if defined(__x86_64__)
+  // PR_SET_TSC is per-thread: new threads must trap rdtsc too (only if
+  // the process-wide SIGSEGV emulator is actually installed)
+  if (g_tsc_trap_on) prctl(PR_SET_TSC, PR_TSC_SIGSEGV, 0, 0, 0);
+#endif
   ThreadReg* r = (ThreadReg*)vp;
   Channel* ch = map_channel(r->shm);
   if (ch) {

@@ -1,0 +1,35 @@
+/* Raw rdtsc/rdtscp under the simulator (host/tsc.c analog): both must
+ * read the VIRTUAL clock — deterministic, advancing only with sim time.
+ * Prints tsc values around a nanosleep; the test asserts exact values. */
+#include <stdint.h>
+#include <stdio.h>
+#include <time.h>
+
+static inline uint64_t rdtsc(void) {
+  uint32_t lo, hi;
+  __asm__ volatile("rdtsc" : "=a"(lo), "=d"(hi));
+  return ((uint64_t)hi << 32) | lo;
+}
+
+static inline uint64_t rdtscp(void) {
+  uint32_t lo, hi, aux;
+  __asm__ volatile("rdtscp" : "=a"(lo), "=d"(hi), "=c"(aux));
+  return ((uint64_t)hi << 32) | lo;
+}
+
+int main(void) {
+  /* one syscall first so the channel's sim-time stamp is fresh */
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  uint64_t a = rdtsc();
+  uint64_t b = rdtsc();  /* no syscall between: identical virtual reads */
+  uint64_t c = rdtscp();
+  printf("tsc-a %llu\n", (unsigned long long)a);
+  printf("tsc-stable %d\n", a == b && b == c);
+  struct timespec d = {0, 250 * 1000 * 1000}; /* 250 ms on the sim clock */
+  nanosleep(&d, NULL);
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  uint64_t e = rdtsc();
+  printf("tsc-delta %llu\n", (unsigned long long)(e - a));
+  return 0;
+}

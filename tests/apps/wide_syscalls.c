@@ -42,6 +42,12 @@ int main(void) {
   if (efd < 0 || fstat(efd, &st) != 0) return fail("fstat(eventfd)");
   printf("ok fstat-eventfd\n");
 
+  /* path-based stat: glibc compiles this to newfstatat(AT_FDCWD, ...) —
+   * the negative dirfd traps the fd-discriminating filter and must
+   * complete through the gate (one SIGSYS round trip), not recurse */
+  if (stat("/", &st) != 0 || !S_ISDIR(st.st_mode)) return fail("stat(/)");
+  printf("ok stat-path\n");
+
   /* ---- getifaddrs: lo + eth0 with the simulated address ---- */
   struct ifaddrs* ifa = NULL;
   if (getifaddrs(&ifa) != 0) return fail("getifaddrs");

@@ -28,7 +28,8 @@ def test_wide_syscall_surface(apps):
     out = p.stdout.decode()
     assert p.exit_code == 0, (out, p.stderr.decode())
     for probe in (
-        "fstat-sock", "fstat-pipe", "fstat-eventfd", "getifaddrs",
+        "fstat-sock", "fstat-pipe", "fstat-eventfd", "stat-path",
+        "getifaddrs",
         "localtime", "mmap-anon", "mmap-policy", "mmap-managed-denied",
         "proc-self-fd",
     ):
@@ -77,3 +78,23 @@ def test_static_binary_fails_loudly(apps, tmp_path):
     d.add_process(h, [str(exe)], start_time=NS_PER_SEC)
     with pytest.raises(DriverError, match="shim handshake"):
         d.run()
+
+
+@pytest.mark.quick
+def test_rdtsc_reads_virtual_clock(apps):
+    """Raw rdtsc/rdtscp (host/tsc.c analog): PR_SET_TSC traps the
+    instruction and the shim serves the virtual clock — identical reads
+    between syscalls, exact sim-time advance across a nanosleep."""
+    d = ProcessDriver(stop_time=10 * NS_PER_SEC, latency_ns=10_000_000)
+    h = d.add_host("ticker", "11.0.0.8")
+    d.add_process(h, [apps["tsc_probe"]], start_time=NS_PER_SEC)
+    d.run()
+    p = d.procs[0]
+    out = p.stdout.decode()
+    assert p.exit_code == 0, (out, p.stderr.decode())
+    lines = dict(l.split(" ", 1) for l in out.strip().splitlines())
+    # 1 GHz virtual TSC: cycle == sim-ns; first read at sim t=1s
+    assert lines["tsc-a"] == str(NS_PER_SEC), lines
+    assert lines["tsc-stable"] == "1", lines
+    # nanosleep(250ms): the delta is EXACTLY the virtual elapsed time
+    assert lines["tsc-delta"] == str(250_000_000), lines

@@ -71,7 +71,14 @@ def main():
     ap.add_argument("--stop", type=int, default=6)
     ap.add_argument("--wpd", type=int, default=16)
     ap.add_argument("--capacity", type=int, default=None)
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the CPU backend (the axon site hook "
+                         "otherwise pins the TPU platform)")
     args = ap.parse_args()
+    if args.cpu:
+        from shadow_tpu.parallel.virtualize import force_cpu_devices
+
+        force_cpu_devices(1, cache_dir=os.path.join(_REPO, ".jax_cache"))
     # Capacity chosen so the hot shard (60% of the population) exceeds its
     # per-shard pool while the BALANCED layout fits comfortably.
     pop = args.hosts * args.msgload
