@@ -1571,6 +1571,30 @@ int fstat64(int fd, struct stat64* st) {
   return fstat(fd, (struct stat*)st);  // identical layout on x86_64
 }
 
+int statx(int dirfd, const char* path, int flags, unsigned int mask,
+          struct statx* stx) {
+  // modern glibc/Rust stat entry point; managed dirfd with an empty path
+  // (AT_EMPTY_PATH) is an fstat in disguise. The NULL test must go
+  // through a volatile copy: glibc declares the parameter nonnull, so
+  // -O2 would otherwise DELETE the check — and the raw-trap route feeds
+  // NULL here legitimately (statx(fd, NULL, AT_EMPTY_PATH, ...) is valid
+  // since Linux 6.11).
+  const char* volatile vpath = path;
+  if (is_managed_fd(dirfd) && (flags & AT_EMPTY_PATH) &&
+      (vpath == nullptr || vpath[0] == 0)) {
+    struct stat st;
+    if (fstat(dirfd, &st) != 0) return -1;
+    memset(stx, 0, sizeof(*stx));
+    stx->stx_mask = STATX_TYPE | STATX_MODE | STATX_NLINK;
+    stx->stx_mode = (uint16_t)st.st_mode;
+    stx->stx_nlink = (uint32_t)st.st_nlink;
+    stx->stx_blksize = (uint32_t)st.st_blksize;
+    return 0;
+  }
+  return (int)RAWRET_INV(sys_native(SYS_statx, dirfd, path, flags, mask,
+                                    stx));
+}
+
 int fstatat(int dirfd, const char* path, struct stat* st, int flags) {
   if (is_managed_fd(dirfd) && (!path || !path[0]))
     return fstat(dirfd, st);  // AT_EMPTY_PATH form glibc uses for fstat
@@ -1846,6 +1870,9 @@ long route_raw_syscall(long nr, long a0, long a1, long a2, long a3, long a4,
     case SYS_newfstatat:
       return RAWRET(fstatat((int)a0, (const char*)a1, (struct stat*)a2,
                             (int)a3));
+    case SYS_statx:
+      return RAWRET(statx((int)a0, (const char*)a1, (int)a2,
+                          (unsigned int)a3, (struct statx*)a4));
     case SYS_open: {
       long vfd = virt_cpu_file_open((const char*)a0);
       if (vfd >= 0) return vfd;
@@ -1938,6 +1965,7 @@ const TrapEntry kTrapped[] = {
     // stat family: managed fds present synthesized metadata (PSYS_FSTAT);
     // newfstatat discriminates on dirfd (AT_EMPTY_PATH fstat form)
     {SYS_fstat, ACT_FD0},         {SYS_newfstatat, ACT_FD0},
+    {SYS_statx, ACT_FD0},
     // mmap policy (writable file-backed MAP_SHARED refused) must hold
     // for raw/glibc-internal calls too; the shim's own channel maps go
     // through the gate and are exempt

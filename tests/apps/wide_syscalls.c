@@ -15,6 +15,8 @@
 #include <sys/mman.h>
 #include <sys/socket.h>
 #include <sys/stat.h>
+#include <linux/stat.h>
+#include <sys/syscall.h>
 #include <time.h>
 #include <unistd.h>
 
@@ -47,6 +49,23 @@ int main(void) {
    * complete through the gate (one SIGSYS round trip), not recurse */
   if (stat("/", &st) != 0 || !S_ISDIR(st.st_mode)) return fail("stat(/)");
   printf("ok stat-path\n");
+
+  /* statx with AT_EMPTY_PATH on a managed fd (the Rust/modern-glibc
+   * stat entry point) */
+  struct statx stx;
+  if (statx(s, "", AT_EMPTY_PATH, STATX_TYPE | STATX_MODE, &stx) != 0)
+    return fail("statx(sock)");
+  if (!S_ISSOCK(stx.stx_mode)) return fail("statx(sock) mode");
+  printf("ok statx\n");
+
+  /* raw SYS_statx (the seccomp-trap path Rust std uses — no PLT): the
+   * argument marshaling through route_raw_syscall must match */
+  memset(&stx, 0, sizeof stx);
+  if (syscall(SYS_statx, s, "", AT_EMPTY_PATH,
+              STATX_TYPE | STATX_MODE, &stx) != 0)
+    return fail("raw statx(sock)");
+  if (!S_ISSOCK(stx.stx_mode)) return fail("raw statx mode");
+  printf("ok statx-raw\n");
 
   /* ---- getifaddrs: lo + eth0 with the simulated address ---- */
   struct ifaddrs* ifa = NULL;

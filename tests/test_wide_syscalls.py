@@ -28,7 +28,7 @@ def test_wide_syscall_surface(apps):
     out = p.stdout.decode()
     assert p.exit_code == 0, (out, p.stderr.decode())
     for probe in (
-        "fstat-sock", "fstat-pipe", "fstat-eventfd", "stat-path",
+        "fstat-sock", "fstat-pipe", "fstat-eventfd", "stat-path", "statx", "statx-raw",
         "getifaddrs",
         "localtime", "mmap-anon", "mmap-policy", "mmap-managed-denied",
         "proc-self-fd",
