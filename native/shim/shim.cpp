@@ -491,6 +491,16 @@ void on_sigsegv_tsc(int sig, siginfo_t* info, void* vctx) {
       (ip[1] == 0x31 || (ip[1] == 0x01 && ip[2] == 0xF9))) {
     Channel* c = cur_channel();
     uint64_t ns = c ? (uint64_t)c->sim_time_ns : 0;
+    // The channel stamp only advances at syscalls, so a busy-wait
+    // calibrated purely on rdtsc (no syscall in the loop) would read a
+    // frozen clock and spin forever. Advance the emulated TSC by one
+    // virtual cycle (1 ns) per read past the stamp — deterministic
+    // (per-thread counter, one-thread-at-a-time scheduling), monotonic,
+    // and a pure-rdtsc delay loop of N cycles now terminates after N
+    // reads while staying pinned to sim time whenever syscalls stamp it.
+    static thread_local uint64_t last_tsc_read = 0;
+    if (ns <= last_tsc_read) ns = last_tsc_read + 1;
+    last_tsc_read = ns;
     g[REG_RAX] = (greg_t)(ns & 0xFFFFFFFFu);
     g[REG_RDX] = (greg_t)(ns >> 32);
     if (ip[1] == 0x01) {       // rdtscp: also IA32_TSC_AUX -> ECX
@@ -504,17 +514,35 @@ void on_sigsegv_tsc(int sig, siginfo_t* info, void* vctx) {
 #endif
   // not an rdtsc fault: hand to the app's handler if it has a callable
   // one; otherwise die like SIG_DFL (returning would restart the faulting
-  // instruction forever — SIG_IGN on a hardware fault is DFL in Linux)
+  // instruction forever — SIG_IGN on a hardware fault is DFL in Linux).
+  // Chaining must preserve the app's registered sigaction SEMANTICS, not
+  // just its function pointer: block its sa_mask (plus SIGSEGV itself
+  // unless it asked SA_NODEFER) around the call, as the kernel would
+  // have. sigprocmask is async-signal-safe; if the handler exits via
+  // siglongjmp the mask restore below is skipped, but siglongjmp restores
+  // the mask saved by sigsetjmp(.., 1) itself — the same contract the
+  // handler relies on under the kernel. SA_ONSTACK delivery (Go/JVM
+  // stack-overflow recovery on an altstack) is honored because OUR
+  // handler is installed with SA_ONSTACK: the kernel already switched to
+  // the app's sigaltstack before we run, so the chained call executes on
+  // it too.
   if (g_app_segv_set) {
+    sigset_t chain_mask = g_app_segv.sa_mask;
+    if (!(g_app_segv.sa_flags & SA_NODEFER)) sigaddset(&chain_mask, SIGSEGV);
+    sigset_t prev_mask;
+    sigprocmask(SIG_BLOCK, &chain_mask, &prev_mask);
     if (g_app_segv.sa_flags & SA_SIGINFO) {
       g_app_segv.sa_sigaction(sig, info, vctx);
+      sigprocmask(SIG_SETMASK, &prev_mask, nullptr);
       return;
     }
     if (g_app_segv.sa_handler != SIG_IGN &&
         g_app_segv.sa_handler != SIG_DFL) {
       g_app_segv.sa_handler(sig);
+      sigprocmask(SIG_SETMASK, &prev_mask, nullptr);
       return;
     }
+    sigprocmask(SIG_SETMASK, &prev_mask, nullptr);
   }
   signal(SIGSEGV, SIG_DFL);
   raise(SIGSEGV);
@@ -525,7 +553,12 @@ void shim_install_tsc_trap() {
   struct sigaction sa;
   memset(&sa, 0, sizeof(sa));
   sa.sa_sigaction = on_sigsegv_tsc;
-  sa.sa_flags = SA_SIGINFO | SA_NODEFER;
+  // SA_ONSTACK: if the app registers a sigaltstack (Go, JVM, Rust guard
+  // pages recover stack overflow there), genuine faults must be DELIVERED
+  // on it — our handler sits in front of theirs, so it must carry the
+  // flag or the chained handler would run on the overflowed stack and
+  // double-fault. rdtsc emulation is a few words of stack either way.
+  sa.sa_flags = SA_SIGINFO | SA_NODEFER | SA_ONSTACK;
   static auto real_sigaction =
       (int (*)(int, const struct sigaction*, struct sigaction*))dlsym(
           RTLD_NEXT, "sigaction");
@@ -1690,6 +1723,16 @@ void* mmap(void* addr, size_t len, int prot, int flags, int fd, off_t off) {
     }
     if (fd >= 0 && (flags & MAP_SHARED) && (prot & PROT_WRITE)) {
       SHIM_LOG("mmap policy: refusing writable MAP_SHARED of fd %d", fd);
+      errno = EACCES;
+      return MAP_FAILED;
+    }
+    if (fd < 0 && (flags & MAP_SHARED) && (flags & MAP_ANONYMOUS) &&
+        (prot & PROT_WRITE)) {
+      // Consistent policy (ADVICE r4): a fork-inherited anonymous shared
+      // mapping is exactly the cross-process shared-state channel the
+      // file-backed refusal exists to deny — an app coordinating through
+      // it would bypass the simulated I/O plane just the same.
+      SHIM_LOG("mmap policy: refusing writable anonymous MAP_SHARED");
       errno = EACCES;
       return MAP_FAILED;
     }

@@ -260,6 +260,15 @@ class IslandSpec(NamedTuple):
     # required once the rebalancer may permute host→shard assignment
     # (compiled in from the start so a rebalance never recompiles)
     use_slot_table: bool = False
+    # compile the speculation-violation checks for optimistic windows:
+    # LOCAL-dst emissions check against the shard's own done_t progress
+    # clocks at the merge (exactly the global engine's check), and
+    # FOREIGN emissions are checked at ARRIVAL on the destination shard —
+    # after the all_to_all, against the receiver's done_t — so no
+    # per-emission collective is ever needed (the exchange the rows
+    # already ride IS the collective). The per-shard xmit_min signals
+    # combine with one pmin in the attempt loop (parallel/islands.py).
+    optimistic: bool = False
 
 
 def _island_route(
@@ -600,15 +609,17 @@ def make_window_step(
         # islands. Every "my host id" use below (self-routing, emission
         # src stamping) is gid, never arange.
         gid = state.host.gid
-        if island is None:
-            _lrow = None  # dst IS the row
-        elif island.use_slot_table:
-            base = jax.lax.axis_index(island.axis).astype(jnp.int32) * H
-            _lrow = params.slot_of[
-                jnp.clip(state.pool.dst, 0, params.slot_of.shape[0] - 1)
-            ] - base
-        else:
-            _lrow = state.pool.dst - gid[0]
+        def _box_lrow(bd):
+            """dst → shard-local row for any dst column (pool, box, or
+            exchange-received rows); foreign dsts land outside [0, H)."""
+            if island.use_slot_table:
+                b = jax.lax.axis_index(island.axis).astype(jnp.int32) * H
+                return params.slot_of[
+                    jnp.clip(bd, 0, params.slot_of.shape[0] - 1)
+                ] - b
+            return bd - gid[0]
+
+        _lrow = None if island is None else _box_lrow(state.pool.dst)
 
         # Static per-kind emission bound: probe the handlers once at trace
         # time with an all-masked-off event and count emit() calls per
@@ -659,8 +670,16 @@ def make_window_step(
             sort by time, truncate to capacity. Islands: route through
             _island_route (grouping sort + bounded all_to_all + concat
             assembly) — cross-shard rows land in their owner's pool here,
-            the TPU form of scheduler_push (scheduler.c:232-255)."""
+            the TPU form of scheduler_push (scheduler.c:232-255).
+
+            Returns (state, arrival_viol_min): the second value is the
+            optimistic-islands ARRIVAL check — the earliest exchange-
+            received row that lands at/behind its destination host's
+            done_t progress clock (NEVER otherwise, and always NEVER for
+            the global engine, where emissions are checked before the
+            merge instead)."""
             C = state.pool.capacity
+            arrival_min = jnp.asarray(NEVER, jnp.int64)
             if island is None:
                 ops3 = jax.lax.sort(
                     [m_t, m_d, m_s, m_q, m_k] + m_p, num_keys=1,
@@ -680,12 +699,27 @@ def make_window_step(
                             state.counters.pool_overflow_dropped + dropped
                         )
                     ),
-                )
+                ), arrival_min
             cols, dropped, sent, deferred, dmin = _island_route(
                 m_t, m_d, m_s, m_q, m_k, m_p,
                 win_start=win_start, H=H, C=C, spec=island,
                 slot_of=params.slot_of if island.use_slot_table else None,
             )
+            if island.optimistic:
+                # Arrival check: rows just received through the exchange
+                # occupy the pool tail block [C_keep:). One received row
+                # behind its destination's progress clock means this
+                # shard speculated past an in-flight delivery — surface
+                # its time so the attempt loop rolls the window back.
+                # Covers rows that DEFERRED in earlier sub-steps too:
+                # they re-arrive here, and done_t only grows within an
+                # attempt, so a missed ordering is still caught.
+                C_keep = C - island.num_shards * island.exchange_slots
+                recv_t, recv_d = cols[0][C_keep:], cols[1][C_keep:]
+                lr = _box_lrow(recv_d)
+                dst_last = state.host.done_t[jnp.clip(lr, 0, H - 1)]
+                vio = (recv_t != NEVER) & (recv_t <= dst_last)
+                arrival_min = jnp.min(jnp.where(vio, recv_t, NEVER))
             new_pool = EventPool(
                 time=cols[0], dst=cols[1], src=cols[2],
                 seq=cols[3], kind=cols[4],
@@ -700,7 +734,7 @@ def make_window_step(
                     exchange_sent=c.exchange_sent + sent,
                     exchange_deferred=c.exchange_deferred + deferred,
                 ),
-            )
+            ), arrival_min
 
         # Merge-absorption budget for the pool-headroom stall: the merge
         # truncates at capacity (minus the islands' reserved exchange
@@ -821,8 +855,14 @@ def make_window_step(
                 # C − occupancy new box rows, so hosts whose emissions
                 # would overflow the pool STALL this window (defer, never
                 # drop). Budget is claimed in host-index order via an
-                # exclusive cumsum — deterministic, and host 0 always
-                # fits, so every window makes progress. Common case (ample
+                # exclusive cumsum — deterministic. NOT a progress
+                # guarantee: box rows accumulated by earlier micro
+                # -iterations (box_used) already count against the budget,
+                # so with occupancy deep in the red zone even host 0 can
+                # fail the gate and the window commits nothing; the driver
+                # surfaces that as the headroom-stall RuntimeError in the
+                # run loops (the spill tier then needs a larger pool to
+                # place even one window's inflow). Common case (ample
                 # headroom): every host passes, the gate folds away.
                 hot = ev_time < win_end
                 box_used = (
@@ -1029,17 +1069,29 @@ def make_window_step(
                     )
                     for w in range(PP)
                 ]
-                if island is None and bt.shape[0]:
+                if bt.shape[0] and (island is None or island.optimistic):
                     cross = (bd != bs) & (bt != NEVER)
-                    dst_last = state.host.done_t[jnp.clip(bd, 0, H - 1)]
-                    violates = cross & (bt <= dst_last)
+                    if island is None:
+                        dst_last = state.host.done_t[jnp.clip(bd, 0, H - 1)]
+                        violates = cross & (bt <= dst_last)
+                    else:
+                        # islands: only LOCAL-dst emissions can be checked
+                        # against this shard's progress clocks; foreign
+                        # ones are checked at ARRIVAL on their owner
+                        # (assemble's arrival_min) — no per-row collective
+                        lr = _box_lrow(bd)
+                        loc = (lr >= 0) & (lr < H)
+                        dst_last = state.host.done_t[jnp.clip(lr, 0, H - 1)]
+                        violates = cross & loc & (bt <= dst_last)
                     xmit_min = jnp.min(jnp.where(violates, bt, NEVER))
                 else:
-                    # islands run conservative-only: cross-shard progress
-                    # clocks would need a collective per emission row
                     xmit_min = jnp.asarray(NEVER, jnp.int64)
-                state = assemble(state, m_t, m_d, m_s, m_q, m_k, m_p)
-                state = state.replace(xmit_min=xmit_min)
+                state, arrival_min = assemble(
+                    state, m_t, m_d, m_s, m_q, m_k, m_p
+                )
+                state = state.replace(
+                    xmit_min=jnp.minimum(xmit_min, arrival_min)
+                )
                 return state, jnp.min(state.pool.time)
 
             return carry0, cond, body, finish
@@ -1209,11 +1261,11 @@ def make_window_step(
                 jnp.concatenate([tail.payload[w]] + [e[5][w] for e in em_rows])
                 for w in range(PP)
             ]
-            state = assemble(state, m_t, m_d, m_s, m_q, m_k, m_p)
+            state, arrival_min = assemble(state, m_t, m_d, m_s, m_q, m_k, m_p)
             # speculation-violation signal (optimistic synchronizer): the
             # one place a by-dst lookup is unavoidable; emissions are the
             # only candidate violators (leftovers already lived in the pool)
-            if em_rows and island is None:
+            if em_rows and (island is None or island.optimistic):
                 e_t = jnp.concatenate([e[0] for e in em_rows])
                 e_d = jnp.concatenate([e[1] for e in em_rows])
                 e_s = jnp.concatenate([e[2] for e in em_rows])
@@ -1222,20 +1274,42 @@ def make_window_step(
                     # the one unavoidable by-dst lookup (a serialized
                     # gather on TPU) — only reached when a violation is
                     # even possible, i.e. under optimistic long windows
-                    dst_last = state.host.done_t[jnp.clip(e_d, 0, H - 1)]
-                    viol = (
-                        (e_d != e_s) & (e_t != NEVER) & (e_t <= dst_last)
-                    )
+                    if island is None:
+                        dst_last = state.host.done_t[jnp.clip(e_d, 0, H - 1)]
+                        viol = (
+                            (e_d != e_s) & (e_t != NEVER) & (e_t <= dst_last)
+                        )
+                    else:
+                        # local-dst only; foreign emissions are covered by
+                        # assemble's arrival check on the owner shard
+                        lr = _box_lrow(e_d)
+                        loc = (lr >= 0) & (lr < H)
+                        dst_last = state.host.done_t[jnp.clip(lr, 0, H - 1)]
+                        viol = (
+                            (e_d != e_s) & loc & (e_t != NEVER)
+                            & (e_t <= dst_last)
+                        )
                     return jnp.min(jnp.where(viol, e_t, NEVER))
 
                 possible = jnp.min(e_t) <= jnp.max(state.host.done_t)
-                xmit_min = jax.lax.cond(
-                    possible, _exact,
-                    lambda _: jnp.asarray(NEVER, jnp.int64), 0,
-                )
+
+                def _never(_):
+                    never = jnp.asarray(NEVER, jnp.int64)
+                    if island is not None:
+                        # under shard_map the true branch's output varies
+                        # over the islands axis; the constant must be cast
+                        # to the same varying type or cond rejects it
+                        never = jax.lax.pcast(
+                            never, (island.axis,), to="varying"
+                        )
+                    return never
+
+                xmit_min = jax.lax.cond(possible, _exact, _never, 0)
             else:
                 xmit_min = jnp.asarray(NEVER, jnp.int64)
-            state = state.replace(xmit_min=xmit_min)
+            state = state.replace(
+                xmit_min=jnp.minimum(xmit_min, arrival_min)
+            )
             return state, jnp.min(state.pool.time)
 
         if bulk_kind is None or bulk_kind not in matrix_handlers:
@@ -1422,9 +1496,12 @@ class Simulation:
                 stall += 1
                 if stall > 2:
                     raise RuntimeError(
-                        "spill tier cannot make progress (a single "
+                        "spill tier cannot make progress: either a single "
                         "timestamp holds more events than the pool fill "
-                        "mark); raise experimental.event_capacity"
+                        "mark, or pool occupancy leaves too little "
+                        "headroom for even one window's emissions (the "
+                        "pool-headroom gate stalled every host); raise "
+                        "experimental.event_capacity"
                     )
                 continue
             stall = 0
@@ -1461,6 +1538,22 @@ class Simulation:
     # -- optimistic synchronization: speculate long windows, roll back on
     # violation (SURVEY §7.6). Pure-array state makes rollback free: the
     # pre-window state is just the previous pytree. --
+    @staticmethod
+    def adapt_window_factor(
+        factor: int, streak: int, rolled_back: bool, cap: int
+    ) -> tuple[int, int]:
+        """The Time-Warp throttling policy shared by the global and
+        islands optimistic drivers: halve the speculation factor on a
+        rolled-back window, double it after four clean windows in a row.
+        Per-run deterministic (depends only on sim state, never wall
+        time)."""
+        if rolled_back:
+            return max(1, factor // 2), 0
+        streak += 1
+        if streak >= 4 and factor < cap:
+            return min(cap, factor * 2), 0
+        return factor, streak
+
     def run_optimistic(
         self,
         until: int | None = None,
@@ -1519,14 +1612,9 @@ class Simulation:
             min_next = int(mn)
             windows += 1
             if adaptive:
-                if rollbacks > rb0:
-                    factor = max(1, factor // 2)
-                    streak = 0
-                else:
-                    streak += 1
-                    if streak >= 4 and factor < window_factor:
-                        factor = min(window_factor, factor * 2)
-                        streak = 0
+                factor, streak = self.adapt_window_factor(
+                    factor, streak, rollbacks > rb0, window_factor
+                )
         return windows, rollbacks
 
     # -- host-spill tier (core/spill.py): the pool never silently drops --
@@ -1576,8 +1664,11 @@ class Simulation:
             cur = (mn, spill.count, press)
             if cur == last and mn >= stop_at:
                 raise RuntimeError(
-                    "spill tier cannot make progress (a single timestamp "
-                    "holds more events than the pool fill mark); raise "
+                    "spill tier cannot make progress: either a single "
+                    "timestamp holds more events than the pool fill mark, "
+                    "or pool occupancy leaves too little headroom for even "
+                    "one window's emissions (the pool-headroom gate "
+                    "stalled every host); raise "
                     "experimental.event_capacity"
                 )
             last = cur

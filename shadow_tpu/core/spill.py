@@ -64,6 +64,7 @@ class HostSpill:
         self._partial_min: list[int] = [int(NEVER)] * num_shards
         self.drained_total = 0
         self.injected_total = 0
+        self.rerouted_total = 0  # foreign in-transit rows shipped host-side
         self.episodes = 0
 
     def _empty(self):
@@ -169,6 +170,7 @@ class HostSpill:
             "spill_resident": self.count,
             "spill_drained_total": self.drained_total,
             "spill_injected_total": self.injected_total,
+            "spill_rerouted_total": self.rerouted_total,
             "spill_episodes": self.episodes,
         }
 
@@ -208,6 +210,62 @@ def manage(sim, spill: HostSpill, stop: int) -> int:
         for c in (pool.time, pool.dst, pool.src, pool.seq, pool.kind,
                   pool.payload)
     ]
+    if island:
+        # A FOREIGN in-transit row (an exchange deferral whose destination
+        # host lives on another shard) is protected by the STRICT
+        # exch_deferred_min window-end clamp only while it sits in the
+        # pool; letting rebalance() park it would downgrade that to the
+        # spill clamp (min_time + runahead) and the destination host could
+        # process its own events in [T, T+runahead) before the delivery
+        # re-injects — diverging from the oversized-pool run (ADVICE r4,
+        # high). Never park them: before rebalancing a shard, ship its
+        # foreign rows host-side to the DESTINATION shard's spill store
+        # (the locked-queue push of scheduler.c:232-255, done by the
+        # driver), and rebalance the destination in the same pass so the
+        # row is pool-resident — and ordinarily clamped — again before
+        # the next window runs.
+        Hl = sim.num_hosts // S
+        slot_of = getattr(sim.params, "slot_of", None)
+        slot_np = (
+            np.asarray(jax.device_get(slot_of))
+            if getattr(sim, "rebalance_enabled", False) and slot_of is not None
+            else None
+        )
+        # The worklist GROWS: a destination shard appended here must have
+        # its own foreign rows shipped out before ITS rebalance runs, or
+        # rebalance() would park them (rerouted rows themselves are
+        # local-dst at their owner, so each shard needs one pass — the
+        # loop is bounded by S).
+        worklist = list(act)
+        qi = 0
+        while qi < len(worklist):
+            sh = worklist[qi]
+            qi += 1
+            t_sh = cols_all[0][sh]
+            live = np.where(t_sh != NEVER)[0]
+            d_live = cols_all[1][sh][live]
+            owner = (
+                slot_np[d_live] // Hl if slot_np is not None
+                else d_live // Hl
+            )
+            fmask = owner != sh
+            if not fmask.any():
+                continue
+            frows, fown = live[fmask], owner[fmask]
+            for dst_sh in np.unique(fown):
+                sel = frows[fown == dst_sh]
+                add = tuple(c[sh][sel] for c in cols_all)
+                merged = tuple(
+                    np.concatenate([a, b])
+                    for a, b in zip(spill._rows[int(dst_sh)], add)
+                )
+                order = spill._order(*merged[:4])
+                spill._rows[int(dst_sh)] = tuple(m[order] for m in merged)
+                spill.rerouted_total += sel.shape[0]
+                if int(dst_sh) not in worklist:
+                    worklist.append(int(dst_sh))
+            t_sh[frows] = NEVER
+        act = worklist
     for sh in act:
         spill.episodes += 1
         view = (

@@ -52,9 +52,14 @@ def build_phold_flagship(
         if exchange_slots <= 0:
             # PHOLD cross-shard volume per window per destination shard:
             # one wave ≈ Hl·msgload emissions per shard spread uniformly
-            # over S destinations, 2x headroom for wave clustering
+            # over S destinations. No headroom multiplier: misses defer
+            # safely under the window-end clamp, while every extra slot
+            # costs S pool rows AND S grouping-sort fillers per shard —
+            # oversizing re-grows the sort volume islands exist to shrink
+            # (VERDICT r4 weak #1; islands.suggest_exchange_slots() gives
+            # the measured-traffic figure for retuning).
             hl = num_hosts // num_shards
-            exchange_slots = max(64, 2 * hl * msgload // num_shards)
+            exchange_slots = max(64, hl * msgload // num_shards)
         island_exp = {
             "num_shards": num_shards,
             "island_mode": island_mode,

@@ -55,6 +55,10 @@ def _final_fingerprint(sim):
     # different number of engine iterations than the conservative schedule
     c.pop("micro_steps", None)
     c.pop("outbox_stall_deferred", None)
+    # transport metrics of the islands layout, not results (always 0 on
+    # the global engine)
+    c.pop("exchange_sent", None)
+    c.pop("exchange_deferred", None)
     subs = jax.device_get(sim.state.subs)
     return c, jax.tree.map(lambda x: np.asarray(x), subs)
 
@@ -135,6 +139,78 @@ def test_prescheduled_work_commits_long_windows():
     assert opt_windows <= cons_windows / 8
     assert cons.counters()["events_committed"] == 200
     assert opt.counters()["events_committed"] == 200
+
+
+def _islandize_yaml(yaml: str, shards: int = 4, slots: int = 16,
+                    mode: str = "vmap") -> str:
+    return yaml.replace(
+        "experimental:\n",
+        f"experimental:\n  num_shards: {shards}\n"
+        f"  exchange_slots: {slots}\n  island_mode: {mode}\n",
+    )
+
+
+def _assert_equivalent_islands(cons, isl):
+    """Counters sum over shards already; subs leaves need the [S, Hl] →
+    [H] reshape before comparing."""
+    ca, sa = _final_fingerprint(cons)
+    cb, sb = _final_fingerprint(isl)
+    assert ca == cb
+    for key in sa:
+        for leaf_a, leaf_b in zip(
+            jax.tree.leaves(sa[key]), jax.tree.leaves(sb[key])
+        ):
+            assert np.array_equal(
+                leaf_a, np.asarray(leaf_b).reshape(leaf_a.shape)
+            ), key
+
+
+def test_islands_optimistic_mixed_latency_equivalence():
+    """Optimistic windows ON the islands runner (VERDICT r4 #4): the
+    asymmetric-latency workload forces speculation violations whose
+    detection now spans shards — local emissions against local done_t,
+    cross-shard emissions at arrival after the all_to_all — and after
+    rollbacks the results must match the global conservative schedule
+    bit-for-bit."""
+    cons = build_simulation(MIXED_YAML)
+    cons.run_stepwise()
+
+    opt = build_simulation(_islandize_yaml(MIXED_YAML))
+    windows, rollbacks = opt.run_optimistic(window_factor=8)
+    assert rollbacks > 0, "speculation never violated across shards"
+    _assert_equivalent_islands(cons, opt)
+
+
+def test_islands_optimistic_shard_map_equivalence(devices):
+    """The multi-chip form: one island per mesh device (shard_map), the
+    attempt loop's pmin riding real collectives, rollback dropping the
+    speculated pytree on every device. Exercises the shard_map-only
+    machinery (pcast'd cond branches, check_vma=False wrappers) that the
+    vmap tests never compile."""
+    if len(devices) < 4:
+        pytest.skip("needs 4 virtual devices")
+    cons = build_simulation(MIXED_YAML)
+    cons.run_stepwise()
+
+    opt = build_simulation(_islandize_yaml(MIXED_YAML, mode="shard_map"))
+    windows, rollbacks = opt.run_optimistic(window_factor=8)
+    assert rollbacks > 0
+    _assert_equivalent_islands(cons, opt)
+
+
+def test_islands_optimistic_under_exchange_backpressure():
+    """exchange_slots=1 keeps cross-shard rows in transit across
+    sub-steps: the speculative windows must respect the deferred-row
+    floor (never overtake an in-transit delivery without detecting it)
+    and still reproduce the conservative results exactly."""
+    cons = build_simulation(MIXED_YAML)
+    cons.run_stepwise()
+
+    opt = build_simulation(_islandize_yaml(MIXED_YAML, slots=1))
+    windows, rollbacks = opt.run_optimistic(window_factor=8)
+    ci = opt.counters()
+    assert ci["exchange_deferred"] > 0, "no exchange backpressure"
+    _assert_equivalent_islands(cons, opt)
 
 
 def test_adaptive_factor_equivalence():

@@ -84,6 +84,79 @@ def test_relay_circuits_all_streams_succeed(apps):
     assert f"served {n_clients * streams}" in exits[0].stdout.decode()
 
 
+@pytest.mark.nightly
+def test_relay_256_hosts_device_plane(apps):
+    """Scale gate (VERDICT r4 #7): the tor analog at 256 hosts with every
+    circuit leg on the DEVICE TCP machine — 9 relays (tor-minimal's count),
+    2 exits, the rest circuit clients round-robining distinct 3-relay
+    chains (chain builder shared with tools/run_relay.py). Nightly: ~256
+    real processes + the device netstack compile."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "run_relay", os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools", "run_relay.py",
+        )
+    )
+    run_relay = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(run_relay)
+
+    n_relays, n_exits, streams, nbytes, stop = 9, 2, 1, 2048, 30
+    n_clients = 256 - n_relays - n_exits
+    chains = run_relay.circuit_host_blocks(
+        n_clients, n_relays, n_exits, apps["circuit_client"], streams, nbytes
+    )
+    yaml = f"""
+general:
+  stop_time: {stop} s
+  seed: 31
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        node [ id 0 bandwidth_down "100 Mbit" bandwidth_up "100 Mbit" ]
+        edge [ source 0 target 0 latency "20 ms" packet_loss 0.0 ]
+      ]
+experimental:
+  use_device_network: true
+  use_device_tcp: true
+  event_capacity: {1 << 16}
+  events_per_host_per_window: 8
+  sockets_per_host: 128
+hosts:
+  relay:
+    quantity: {n_relays}
+    processes:
+      - path: {apps["relay"]}
+        args: {RELAY_PORT} 0
+        stop_time: {stop - 2} s
+  exit:
+    quantity: {n_exits}
+    processes:
+      - path: {apps["circuit_server"]}
+        args: {EXIT_PORT} 0
+        stop_time: {stop - 2} s
+{chains}
+"""
+    d = build_process_driver(yaml)
+    d.run()
+    clients = [p for p in d.procs if "circuit_client" in p.args[0]]
+    assert len(clients) == n_clients
+    success = sum(
+        p.stdout.decode().count("stream-success") for p in clients
+    )
+    assert success == n_clients * streams, (
+        f"{success}/{n_clients * streams} streams; first failures: "
+        + str([
+            (p.name, p.stdout.decode()[-200:], p.stderr.decode()[-200:])
+            for p in clients if b"stream-success" not in p.stdout
+        ][:3])
+    )
+
+
 def test_relay_circuits_deterministic(apps):
     """tor-minimal's determinism bar (determinism1_compare.cmake analog):
     two identical runs produce byte-identical client output."""

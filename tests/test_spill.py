@@ -15,7 +15,7 @@ import pytest
 from shadow_tpu.sim import build_simulation
 
 
-def _cfg(event_capacity, num_shards=1):
+def _cfg(event_capacity, num_shards=1, exchange_slots=64):
     exp = {
         "event_capacity": event_capacity,
         "events_per_host_per_window": 16,
@@ -24,7 +24,7 @@ def _cfg(event_capacity, num_shards=1):
         "router_queue_slots": 64,
     }
     if num_shards > 1:
-        exp.update(num_shards=num_shards, exchange_slots=64)
+        exp.update(num_shards=num_shards, exchange_slots=exchange_slots)
     return {
         "general": {"stop_time": 3, "seed": 5},
         "network": {"graph": {"type": "gml", "inline": (
@@ -97,6 +97,28 @@ def test_undersized_islands_pool_matches():
     isl.run_stepwise()
     ci = isl.counters()
     assert isl.spill_stats()["spill_episodes"] > 0
+    for k in _KEYS:
+        assert cb[k] == ci[k], (k, cb[k], ci[k])
+    assert (_recv(big) == _recv(isl)).all()
+
+
+@pytest.mark.quick
+def test_spill_under_exchange_backpressure_matches():
+    """Deferral × spill combined (ADVICE r4, high): exchange_slots=1 keeps
+    cross-shard rows IN TRANSIT across windows while the undersized pool
+    spills — a foreign row caught by a spill rebalance must keep its strict
+    ordering guarantee (manage() re-routes it to the destination shard
+    host-side instead of parking it). Results must stay bit-identical to
+    the oversized single-pool run."""
+    big = build_simulation(_cfg(1 << 13))
+    big.run_stepwise()
+    cb = big.counters()
+    isl = build_simulation(_cfg(768, num_shards=4, exchange_slots=1))
+    isl.run_stepwise()
+    ci = isl.counters()
+    st = isl.spill_stats()
+    assert st["spill_episodes"] > 0, "pool never spilled"
+    assert ci["exchange_deferred"] > 0, "no exchange backpressure"
     for k in _KEYS:
         assert cb[k] == ci[k], (k, cb[k], ci[k])
     assert (_recv(big) == _recv(isl)).all()
