@@ -64,6 +64,7 @@ enum PseudoSys : int64_t {
   PSYS_FUTEX_WAKE = -108,  // args: uaddr, n; ret = number woken
   PSYS_WAITPID = -109,     // args: pid (-1 any); ret = pid, data = i32 status
   PSYS_FSTAT = -111,       // args: fd; ret = FD_KIND_* of the managed fd
+  PSYS_FD_LIST = -112,     // ret = count; data = i32[] open managed fds
   // handler-return notification: restores the pre-delivery signal mask
   // (the delivery auto-blocked the signal + sa_mask, Linux semantics)
   PSYS_SIG_RETURN = -110,

@@ -76,7 +76,9 @@
 #include <climits>
 #include <ifaddrs.h>
 #include <net/if.h>
+#include <dirent.h>
 #include <sys/mman.h>
+#include <sys/resource.h>
 #include <sys/stat.h>
 #include <sys/random.h>
 #include <sys/select.h>
@@ -143,6 +145,11 @@ long sys_native(long n, Args... args) {
 Channel* g_ch = nullptr;  // process-primary channel (thread 0's)
 long g_spin = 8192;
 int g_debug = 0;
+// count of virtual-signal handler invocations on this thread (reply
+// piggyback path) — lets composed mask-swapping waits (ppoll/epoll_pwait)
+// report EINTR when a pending signal fires at the mask swap, as the
+// kernel's atomic form would
+thread_local uint64_t g_sig_handled = 0;
 int g_log_stamp = 0;  // ENV_LOG_STAMP: sim-time prefix on stdout/stderr lines
 // per-fd (stdout, stderr) at-beginning-of-line state for the stamper
 bool g_at_bol[2] = {true, true};
@@ -242,6 +249,7 @@ int64_t ipc_call(int64_t sysno, const int64_t args[6], const void* data_in,
   // handler-made syscalls recurse safely through the channel.
   if (sig_no > 0 && sig_handler != 0) {
     SHIM_LOG("delivering virtual signal %d", sig_no);
+    g_sig_handled++;  // ppoll/pselect compose: detect delivery-on-entry
     if (sig_flags & 1) {  // SA_SIGINFO-style handler
       siginfo_t si;
       memset(&si, 0, sizeof(si));
@@ -1030,6 +1038,203 @@ int timerfd_gettime(int fd, struct itimerspec* curr) {
   return 0;
 }
 
+int signalfd(int fd, const sigset_t* mask, int flags) {
+  // Virtual-signal-plane signalfd (syscall/signal.c surface): reads
+  // consume the process's PENDING virtual signals matching the mask —
+  // the block-then-read contract apps use with epoll event loops.
+  if (!g_ch) return (int)sys_native(SYS_signalfd4, fd, mask, 8, flags);
+  uint64_t m = 0;
+  if (mask) memcpy(&m, mask, sizeof(m));
+  if (m & ~VIRT_SIG_MASK) {
+    // A non-virtualized signal (SIGWINCH, realtime, ...) never enters the
+    // driver's pending queue, so an fd watching it would silently never
+    // fire while the signal stays blocked natively — fail FAST instead.
+    SHIM_LOG("signalfd: mask 0x%llx includes non-virtualized signals "
+             "(virtual set 0x%llx) — refusing",
+             (unsigned long long)m, (unsigned long long)VIRT_SIG_MASK);
+    errno = EINVAL;
+    return -1;
+  }
+  int64_t args[6] = {fd, flags, 0, 0, 0, 0};
+  return (int)ipc_call(SYS_signalfd4, args, (const uint8_t*)&m, 8, nullptr,
+                       0, nullptr);
+}
+
+// Shared sigmask-swap guard for the composed mask-swapping waits
+// (ppoll/epoll_pwait). The kernel's atomicity guarantee holds in this
+// plane because signals only deliver at syscall boundaries: a pending
+// signal unblocked by the swap rides the sigprocmask REPLY (its handler
+// runs before the wait is entered), which the guard reports as the
+// kernel's delivery-on-entry EINTR; one arriving during the wait EINTRs
+// the wait itself under the temporary mask.
+static int sigmask_swap_enter(const sigset_t* sigmask, sigset_t* oldm) {
+  if (!sigmask) return 0;
+  // NATIVE pending signals the swap would unblock deliver inside
+  // real_sigprocmask without touching g_sig_handled — probe them first
+  // (sigpending reports the native plane only; virtual pending rides the
+  // driver reply and bumps the counter).
+  bool native_hit = false;
+  sigset_t pend;
+  if (sigpending(&pend) == 0) {
+    for (int s = 1; s <= 64; s++)
+      if (sigismember(&pend, s) && !sigismember(sigmask, s)) {
+        native_hit = true;
+        break;
+      }
+  }
+  uint64_t h0 = g_sig_handled;
+  sigprocmask(SIG_SETMASK, sigmask, oldm);
+  if (g_sig_handled != h0 || native_hit) {
+    sigprocmask(SIG_SETMASK, oldm, nullptr);
+    errno = EINTR;
+    return -1;
+  }
+  return 0;
+}
+
+static void sigmask_swap_exit(const sigset_t* sigmask,
+                              const sigset_t* oldm) {
+  if (!sigmask) return;
+  int saved = errno;
+  sigprocmask(SIG_SETMASK, oldm, nullptr);
+  errno = saved;
+}
+
+int ppoll(struct pollfd* fds, nfds_t nfds, const struct timespec* ts,
+          const sigset_t* sigmask) {
+  if (!g_ch) {
+    static auto real = (int (*)(struct pollfd*, nfds_t,
+                                const struct timespec*,
+                                const sigset_t*))dlsym(RTLD_NEXT, "ppoll");
+    return real(fds, nfds, ts, sigmask);
+  }
+  if (ts && (ts->tv_sec < 0 || ts->tv_nsec < 0 ||
+             ts->tv_nsec >= 1000000000L)) {
+    errno = EINVAL;  // kernel contract for an invalid timespec
+    return -1;
+  }
+  sigset_t oldm;
+  if (sigmask_swap_enter(sigmask, &oldm) != 0) return -1;
+  int timeout_ms = -1;
+  if (ts) {
+    int64_t ms = (int64_t)ts->tv_sec * 1000 + (ts->tv_nsec + 999999) / 1000000;
+    timeout_ms = ms > INT_MAX ? INT_MAX : (int)ms;  // clamp, don't wrap
+  }
+  int r = poll(fds, nfds, timeout_ms);
+  sigmask_swap_exit(sigmask, &oldm);
+  return r;
+}
+
+int epoll_pwait(int epfd, struct epoll_event* evs, int maxevents,
+                int timeout_ms, const sigset_t* sigmask) {
+  if (!g_ch) {
+    static auto real = (int (*)(int, struct epoll_event*, int, int,
+                                const sigset_t*))dlsym(RTLD_NEXT,
+                                                       "epoll_pwait");
+    return real(epfd, evs, maxevents, timeout_ms, sigmask);
+  }
+  sigset_t oldm;
+  if (sigmask_swap_enter(sigmask, &oldm) != 0) return -1;
+  int r = epoll_wait(epfd, evs, maxevents, timeout_ms);
+  sigmask_swap_exit(sigmask, &oldm);
+  return r;
+}
+
+// ---------------------------------------------------------------------------
+// Deterministic resource limits + usage (rlimit.c-class surface): limits
+// are app-visible state, so reading the real machine's would leak
+// nondeterminism across hosts; the table below is fixed per process (fork
+// children inherit the current values with the copied address space).
+// getrusage serves the VIRTUAL clock as CPU time.
+// ---------------------------------------------------------------------------
+
+static struct rlimit g_rlim[16];
+static bool g_rlim_init = false;
+static pthread_mutex_t g_rlim_mu = PTHREAD_MUTEX_INITIALIZER;
+
+static void rlim_init_locked() {
+  if (g_rlim_init) return;
+  for (int i = 0; i < 16; i++) {
+    g_rlim[i].rlim_cur = RLIM_INFINITY;
+    g_rlim[i].rlim_max = RLIM_INFINITY;
+  }
+  g_rlim[RLIMIT_NOFILE].rlim_cur = 1024;
+  g_rlim[RLIMIT_NOFILE].rlim_max = 262144;
+  g_rlim[RLIMIT_STACK].rlim_cur = 8ull << 20;
+  g_rlim_init = true;
+}
+
+int getrlimit(int res, struct rlimit* rl) {
+  if (!g_ch) return (int)sys_native(SYS_getrlimit, res, rl);
+  if (res < 0 || res >= 16 || !rl) {
+    errno = EINVAL;
+    return -1;
+  }
+  pthread_mutex_lock(&g_rlim_mu);
+  rlim_init_locked();
+  *rl = g_rlim[res];
+  pthread_mutex_unlock(&g_rlim_mu);
+  return 0;
+}
+
+int setrlimit(int res, const struct rlimit* rl) {
+  if (!g_ch) return (int)sys_native(SYS_setrlimit, res, rl);
+  if (res < 0 || res >= 16 || !rl || rl->rlim_cur > rl->rlim_max) {
+    errno = EINVAL;
+    return -1;
+  }
+  pthread_mutex_lock(&g_rlim_mu);
+  rlim_init_locked();
+  if (rl->rlim_max > g_rlim[res].rlim_max) {
+    pthread_mutex_unlock(&g_rlim_mu);
+    errno = EPERM;  // raising the hard limit needs privilege — refuse
+    return -1;
+  }
+  g_rlim[res] = *rl;
+  pthread_mutex_unlock(&g_rlim_mu);
+  return 0;
+}
+
+int prlimit(pid_t pid, __rlimit_resource res, const struct rlimit* nl,
+            struct rlimit* ol) {
+  if (!g_ch) return (int)sys_native(SYS_prlimit64, pid, res, nl, ol);
+  if (pid != 0 && pid != getpid()) {
+    errno = EPERM;  // cross-process limits stay out of the sim plane
+    return -1;
+  }
+  if (ol && getrlimit(res, ol) != 0) return -1;
+  if (nl) return setrlimit(res, nl);
+  return 0;
+}
+
+int prlimit64(pid_t pid, __rlimit_resource res, const struct rlimit64* nl,
+              struct rlimit64* ol) {
+  // x86_64: rlimit == rlimit64 (both 64-bit fields)
+  return prlimit(pid, res, (const struct rlimit*)nl, (struct rlimit*)ol);
+}
+
+int getrusage(int who, struct rusage* ru) {
+  if (!g_ch) return (int)sys_native(SYS_getrusage, who, ru);
+  if (!ru) {
+    errno = EFAULT;
+    return -1;
+  }
+  // Deterministic synthesis: CPU time = the virtual clock (the CPU model
+  // charges simulated processing to it), everything else fixed. Only
+  // RUSAGE_SELF carries the clock: children's accumulated time (and
+  // per-thread time) report zero — the Linux baseline for a process that
+  // has reaped nothing.
+  memset(ru, 0, sizeof(*ru));
+  if (who == RUSAGE_SELF) {
+    Channel* c = cur_channel();
+    uint64_t ns = c ? (uint64_t)c->sim_time_ns : 0;
+    ru->ru_utime.tv_sec = (time_t)(ns / 1000000000ull);
+    ru->ru_utime.tv_usec = (suseconds_t)((ns % 1000000000ull) / 1000);
+  }
+  ru->ru_maxrss = 65536;  // fixed 64 MiB in KB — deterministic
+  return 0;
+}
+
 // Virtualized CPU visibility: the driver reports the simulated host's
 // CPU count (default 1 — matching the one-runnable-thread determinism
 // model), so glibc's __get_nprocs / sysconf(_SC_NPROCESSORS_ONLN) and
@@ -1746,6 +1951,182 @@ void* mmap64(void* addr, size_t len, int prot, int flags, int fd,
   return mmap(addr, len, prot, flags, fd, (off_t)off);
 }
 
+// ---------------------------------------------------------------------------
+// /proc/self/fd DIRECTORY LISTING with managed fds merged in: the kernel's
+// listing only shows real fds, so an app enumerating its descriptors (fd
+// hygiene sweeps, close-range fallbacks) would miss every simulated
+// socket/pipe/timer. opendir on the fd directory returns a synthetic
+// stream of real entries (from the kernel) plus the driver's open managed
+// fds (PSYS_FD_LIST). glibc-INTERNAL opendir calls (e.g. scandir) bypass
+// PLT interposition and still see only real fds — documented limitation.
+// ---------------------------------------------------------------------------
+
+struct VirtFdDir {
+  long fds[1024];
+  int count;
+  int pos;
+  int backing_fd;  // real O_DIRECTORY fd: dirfd() identity for skip logic
+  struct dirent ent;
+};
+
+// Registry slots are atomics: readdir/closedir on ORDINARY directory
+// streams must not take a process-wide lock — the hot-path membership
+// check is a handful of relaxed loads; the mutex only serializes open
+// registration.
+static std::atomic<VirtFdDir*> g_vdirs[64];
+static pthread_mutex_t g_vdir_mu = PTHREAD_MUTEX_INITIALIZER;
+
+static bool is_proc_fd_dir(const char* name) {
+  if (!name) return false;
+  if (strcmp(name, "/proc/self/fd") == 0 ||
+      strcmp(name, "/proc/self/fd/") == 0)
+    return true;
+  char buf[64];
+  snprintf(buf, sizeof buf, "/proc/%d/fd", (int)getpid());
+  return strcmp(name, buf) == 0;
+}
+
+static VirtFdDir* vdir_of(DIR* dp) {
+  for (auto& slot : g_vdirs)
+    if (slot.load(std::memory_order_relaxed) == (VirtFdDir*)dp)
+      return (VirtFdDir*)dp;
+  return nullptr;
+}
+
+DIR* opendir(const char* name) {
+  static auto real_opendir = (DIR * (*)(const char*)) dlsym(RTLD_NEXT,
+                                                            "opendir");
+  static auto real_readdir =
+      (struct dirent * (*)(DIR*)) dlsym(RTLD_NEXT, "readdir");
+  static auto real_closedir = (int (*)(DIR*))dlsym(RTLD_NEXT, "closedir");
+  if (!g_ch || !is_proc_fd_dir(name)) return real_opendir(name);
+  VirtFdDir* d = (VirtFdDir*)calloc(1, sizeof(VirtFdDir));
+  if (!d) return nullptr;
+  // real directory fd FIRST: dirfd() must return a live fd that appears
+  // in the listing, exactly like a kernel DIR (fd-hygiene sweeps skip it)
+  d->backing_fd = (int)sys_native(SYS_open, (long)name,
+                                  O_RDONLY | O_DIRECTORY, 0);
+  DIR* rd = real_opendir(name);
+  if (rd) {
+    struct dirent* e;
+    while ((e = real_readdir(rd)) && d->count < 1000) {
+      if (e->d_name[0] == '.') continue;
+      char* end = nullptr;
+      long fd = strtol(e->d_name, &end, 10);
+      if (end && *end == 0) d->fds[d->count++] = fd;
+    }
+    real_closedir(rd);
+  }
+  int64_t args[6] = {0, 0, 0, 0, 0, 0};
+  static thread_local uint8_t out[IPC_DATA_MAX];
+  uint32_t out_len = 0;
+  int64_t r = ipc_call(PSYS_FD_LIST, args, nullptr, 0, out, IPC_DATA_MAX,
+                       &out_len);
+  for (int i = 0; r > 0 && i < (int)r && d->count < 1024 &&
+                  (uint32_t)(i * 4 + 4) <= out_len;
+       i++) {
+    int32_t fd;
+    memcpy(&fd, out + i * 4, 4);
+    d->fds[d->count++] = fd;
+  }
+  bool registered = false;
+  pthread_mutex_lock(&g_vdir_mu);
+  for (auto& slot : g_vdirs)
+    if (slot.load(std::memory_order_relaxed) == nullptr) {
+      slot.store(d, std::memory_order_release);
+      registered = true;
+      break;
+    }
+  pthread_mutex_unlock(&g_vdir_mu);
+  if (!registered) {
+    // registry exhausted: the kernel-only view would NONDETERMINISTICALLY
+    // hide managed fds depending on open-stream count — be loud about it
+    SHIM_LOG("opendir(%s): virtual-dir registry full (64 streams); "
+             "falling back to the kernel view WITHOUT managed fds", name);
+    if (d->backing_fd >= 0) sys_native(SYS_close, d->backing_fd);
+    free(d);
+    return real_opendir(name);
+  }
+  return (DIR*)d;
+}
+
+int dirfd(DIR* dp) {
+  static auto real_dirfd = (int (*)(DIR*))dlsym(RTLD_NEXT, "dirfd");
+  VirtFdDir* d = vdir_of(dp);
+  if (!d) return real_dirfd(dp);
+  if (d->backing_fd < 0) {
+    errno = EINVAL;
+    return -1;
+  }
+  return d->backing_fd;
+}
+
+void rewinddir(DIR* dp) {
+  static auto real_rewinddir = (void (*)(DIR*))dlsym(RTLD_NEXT, "rewinddir");
+  VirtFdDir* d = vdir_of(dp);
+  if (!d) {
+    real_rewinddir(dp);
+    return;
+  }
+  d->pos = 0;  // replay the open-time snapshot (proc listings are
+               // snapshots under the kernel too)
+}
+
+long telldir(DIR* dp) {
+  static auto real_telldir = (long (*)(DIR*))dlsym(RTLD_NEXT, "telldir");
+  VirtFdDir* d = vdir_of(dp);
+  if (!d) return real_telldir(dp);
+  return d->pos;
+}
+
+void seekdir(DIR* dp, long loc) {
+  static auto real_seekdir = (void (*)(DIR*, long))dlsym(RTLD_NEXT,
+                                                         "seekdir");
+  VirtFdDir* d = vdir_of(dp);
+  if (!d) {
+    real_seekdir(dp, loc);
+    return;
+  }
+  if (loc >= 0 && loc <= d->count) d->pos = (int)loc;
+}
+
+struct dirent* readdir(DIR* dp) {
+  static auto real_readdir =
+      (struct dirent * (*)(DIR*)) dlsym(RTLD_NEXT, "readdir");
+  VirtFdDir* d = vdir_of(dp);
+  if (!d) return real_readdir(dp);
+  if (d->pos >= d->count) return nullptr;
+  long fd = d->fds[d->pos++];
+  memset(&d->ent, 0, sizeof(d->ent));
+  d->ent.d_ino = (ino_t)(fd + 1);
+  d->ent.d_type = DT_LNK;  // proc fd entries are magic symlinks
+  snprintf(d->ent.d_name, sizeof(d->ent.d_name), "%ld", fd);
+  return &d->ent;
+}
+
+struct dirent64* readdir64(DIR* dp) {
+  static auto real_readdir64 =
+      (struct dirent64 * (*)(DIR*)) dlsym(RTLD_NEXT, "readdir64");
+  VirtFdDir* d = vdir_of(dp);
+  if (!d) return real_readdir64(dp);
+  // x86_64 glibc: dirent and dirent64 share the layout
+  return (struct dirent64*)readdir(dp);
+}
+
+int closedir(DIR* dp) {
+  static auto real_closedir = (int (*)(DIR*))dlsym(RTLD_NEXT, "closedir");
+  VirtFdDir* d = vdir_of(dp);
+  if (!d) return real_closedir(dp);
+  pthread_mutex_lock(&g_vdir_mu);
+  for (auto& slot : g_vdirs)
+    if (slot.load(std::memory_order_relaxed) == d)
+      slot.store(nullptr, std::memory_order_release);
+  pthread_mutex_unlock(&g_vdir_mu);
+  if (d->backing_fd >= 0) sys_native(SYS_close, d->backing_fd);
+  free(d);
+  return 0;
+}
+
 }  // extern "C"
 
 // ---------------------------------------------------------------------------
@@ -1862,11 +2243,30 @@ long route_raw_syscall(long nr, long a0, long a1, long a2, long a3, long a4,
       return RAWRET(
           epoll_ctl((int)a0, (int)a1, (int)a2, (struct epoll_event*)a3));
     case SYS_epoll_wait:
-    case SYS_epoll_pwait:  // sigmask ignored (no signal emulation yet)
       return RAWRET(
           epoll_wait((int)a0, (struct epoll_event*)a1, (int)a2, (int)a3));
+    case SYS_epoll_pwait:
+      return RAWRET(epoll_pwait((int)a0, (struct epoll_event*)a1, (int)a2,
+                                (int)a3, (const sigset_t*)a4));
     case SYS_poll:
       return RAWRET(poll((struct pollfd*)a0, (nfds_t)a1, (int)a2));
+    case SYS_ppoll:
+      return RAWRET(ppoll((struct pollfd*)a0, (nfds_t)a1,
+                          (const struct timespec*)a2,
+                          (const sigset_t*)a3));
+    case SYS_signalfd:
+      return RAWRET(signalfd((int)a0, (const sigset_t*)a1, 0));
+    case SYS_signalfd4:
+      return RAWRET(signalfd((int)a0, (const sigset_t*)a1, (int)a3));
+    case SYS_getrlimit:
+      return RAWRET(getrlimit((int)a0, (struct rlimit*)a1));
+    case SYS_setrlimit:
+      return RAWRET(setrlimit((int)a0, (const struct rlimit*)a1));
+    case SYS_prlimit64:
+      return RAWRET(prlimit((pid_t)a0, (__rlimit_resource)a1,
+                            (const struct rlimit*)a2, (struct rlimit*)a3));
+    case SYS_getrusage:
+      return RAWRET(getrusage((int)a0, (struct rusage*)a1));
     case SYS_select:
       return RAWRET(select((int)a0, (fd_set*)a1, (fd_set*)a2, (fd_set*)a3,
                            (struct timeval*)a4));
@@ -2001,6 +2401,12 @@ const TrapEntry kTrapped[] = {
     {SYS_pipe, ACT_TRAP},         {SYS_pipe2, ACT_TRAP},
     {SYS_getrandom, ACT_TRAP},    {SYS_pselect6, ACT_TRAP},
     {SYS_sched_getaffinity, ACT_TRAP},
+    // signal-plane descriptors + mask-swapping waits ride the virtual
+    // signal tables; resource limits/usage are deterministic synthesis
+    {SYS_signalfd, ACT_TRAP},     {SYS_signalfd4, ACT_TRAP},
+    {SYS_ppoll, ACT_TRAP},
+    {SYS_getrlimit, ACT_TRAP},    {SYS_setrlimit, ACT_TRAP},
+    {SYS_prlimit64, ACT_TRAP},    {SYS_getrusage, ACT_TRAP},
     // opens trap so CPU-count pseudo-files virtualize even through
     // glibc-internal (non-PLT) calls; non-matching paths re-enter the
     // kernel through the gate — one SIGSYS round trip per open

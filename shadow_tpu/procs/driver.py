@@ -87,6 +87,7 @@ SYS_epoll_create1 = 291
 SYS_dup3 = 292
 SYS_pipe2 = 293
 SYS_getrandom = 318
+SYS_signalfd4 = 289
 SYS_sched_getaffinity = 204
 SYS_rt_sigaction = 13
 SYS_rt_sigprocmask = 14
@@ -322,6 +323,37 @@ class EventFd:
 
     def writable(self) -> bool:
         return self.value < (1 << 64) - 2
+
+
+@dataclass
+class SignalFd:
+    """signalfd emulation on the VIRTUAL signal plane (reference:
+    syscall/signal.c + descriptor surface): reads consume pending virtual
+    signals of the owning process that match the fd's mask, regardless of
+    thread signal masks (the kernel's signalfd contract — the standard
+    usage blocks the signals first so only the fd consumes them)."""
+
+    fd: int
+    owner: "ManagedProcess"
+    mask: int = 0
+    nonblock: bool = False
+    cloexec: bool = False
+
+    def _process(self):
+        return getattr(self.owner, "proc", self.owner)
+
+    def readable_for(self, p) -> bool:
+        """Readiness is relative to the process LOOKING at the fd: reads
+        consume the reading process's pending signals, so a fork-inherited
+        signalfd must poll readable against the poller's queue, not the
+        creator's."""
+        return any((self.mask >> (s - 1)) & 1 for s in p.sig_pending)
+
+    def readable(self) -> bool:
+        return self.readable_for(self._process())
+
+    def writable(self) -> bool:
+        return False
 
 
 @dataclass
@@ -820,6 +852,18 @@ class ProcessDriver:
     # readiness + wakeups (status_listener.c / syscall_condition.c analog)
     # ------------------------------------------------------------------
 
+    def _fd_readable(self, proc, obj) -> bool:
+        """Readiness of obj as OBSERVED by proc: objects whose readiness
+        depends on the observing process (SignalFd after fork) expose
+        readable_for(process); everything else falls back to readable().
+        Every readiness call site must go through here, or a new site
+        would silently judge a fork-inherited signalfd against its
+        CREATOR's signal queue."""
+        f = getattr(obj, "readable_for", None)
+        if f is not None:
+            return f(getattr(proc, "proc", proc))
+        return obj.readable()
+
     def _poll_revents(self, proc: ManagedProcess, fd: int, events: int) -> int:
         # POLLIN/POLLOUT/POLLERR/POLLHUP share values with their EPOLL*
         # counterparts, so one readiness routine serves both interfaces.
@@ -828,7 +872,7 @@ class ProcessDriver:
         if obj is None:
             return POLLERR if fd >= ipc.FD_BASE else 0
         if hasattr(obj, "readable"):
-            if (events & POLLIN) and obj.readable():
+            if (events & POLLIN) and self._fd_readable(proc, obj):
                 rev |= POLLIN
             if (events & POLLOUT) and obj.writable():
                 rev |= POLLOUT
@@ -1158,7 +1202,8 @@ class ProcessDriver:
                 self._complete_recv(proc, sock, pk.want, hdr=pk.hdr)
         elif pk.kind == "read":
             obj = proc.fds.get(pk.fd)
-            if obj is not None and hasattr(obj, "readable") and obj.readable():
+            if (obj is not None and hasattr(obj, "readable")
+                    and self._fd_readable(proc, obj)):
                 proc.parked = None
                 self._complete_read(proc, obj, pk.want)
         elif pk.kind == "accept":
@@ -1289,6 +1334,19 @@ class ProcessDriver:
         if sig == SIGKILL or act is None or act[0] == 0:  # SIG_DFL
             if sig != SIGKILL and sig in _SIG_DFL_IGNORE:
                 return
+            if sig != SIGKILL and all(
+                (t.sig_mask >> (sig - 1)) & 1 for t in p.threads
+                if t.state != ManagedThread.EXITED
+            ):
+                # Blocked in every thread: POSIX keeps the signal PENDING
+                # (the default action applies only on unblock, under the
+                # then-current disposition — _next_signal handles that).
+                # This is the signalfd usage contract: block the signal,
+                # consume it through the fd.
+                if sig not in p.sig_pending:
+                    p.sig_pending.append(sig)
+                    self._wake_signalfds(p, sig)
+                return
             # default disposition terminates at this sim time
             self._schedule(self.now, lambda: self._signal_kill(p, sig))
             return
@@ -1297,6 +1355,7 @@ class ProcessDriver:
         if sig in p.sig_pending:
             return  # standard signals don't queue: already-pending collapses
         p.sig_pending.append(sig)
+        self._wake_signalfds(p, sig)
         # interrupt the lowest-tid parked thread in an interruptible wait
         # whose mask admits the signal; the EINTR completion's reply
         # carries the handler invocation
@@ -1319,6 +1378,13 @@ class ProcessDriver:
                     ret = pk.want  # partial write already accepted
                 self._resume(t, ret)
                 break
+
+    def _wake_signalfds(self, p: ManagedProcess, sig: int) -> None:
+        """A newly-pending signal makes matching signalfds readable: wake
+        their parked readers and bump EPOLLET edges."""
+        for o in p.fds.values():
+            if isinstance(o, SignalFd) and (o.mask >> (sig - 1)) & 1:
+                self._wake_fd_waiters(o)
 
     def _signal_kill(self, p: ManagedProcess, sig: int) -> None:
         """Terminate p by default signal disposition: release fds, stop the
@@ -2088,8 +2154,10 @@ class ProcessDriver:
                 done(-errno.EBADF)
             elif isinstance(obj, (EventFd, TimerFd)) and want < 8:
                 done(-errno.EINVAL)  # Linux: 8-byte counter reads only
+            elif isinstance(obj, SignalFd) and want < 128:
+                done(-errno.EINVAL)  # Linux: whole signalfd_siginfo reads
             elif hasattr(obj, "readable"):
-                if obj.readable():
+                if self._fd_readable(proc, obj):
                     self._complete_read(proc, obj, want)
                 elif obj.nonblock:
                     done(-errno.EAGAIN)
@@ -2156,6 +2224,28 @@ class ProcessDriver:
                 fd, proc, nonblock=bool(a[1] & O_NONBLOCK_FLAG)
             )
             done(fd)
+        elif sysno == SYS_signalfd4:
+            # data = 8-byte little-endian sigset; a[0] = -1 (new) or an
+            # existing signalfd whose mask is replaced (Linux semantics)
+            mask = int.from_bytes(ch.data[:8], "little")
+            if a[0] == -1:
+                fd = proc.alloc_fd()
+                proc.fds[fd] = SignalFd(
+                    fd, proc, mask=mask,
+                    nonblock=bool(a[1] & O_NONBLOCK_FLAG),
+                    cloexec=bool(a[1] & 0o2000000),
+                )
+                done(fd)
+            else:
+                sfd = proc.fds.get(a[0])
+                if isinstance(sfd, SignalFd):
+                    sfd.mask = mask
+                    # a widened mask may match an ALREADY-pending signal:
+                    # re-evaluate parked readers/pollers now
+                    self._wake_fd_waiters(sfd)
+                    done(a[0])
+                else:
+                    done(-errno.EINVAL)
         elif sysno == SYS_timerfd_settime:
             tf = proc.fds.get(a[0])
             if not isinstance(tf, TimerFd):
@@ -2317,6 +2407,13 @@ class ProcessDriver:
                 done(ipc.FD_KIND_EPOLL)
             else:
                 done(0)
+        elif sysno == ipc.PSYS_FD_LIST:
+            # open managed fds of the calling process, sorted (the shim
+            # merges them into /proc/self/fd directory listings)
+            fds = sorted(proc.fds.keys())
+            done(len(fds), data=b"".join(
+                int(f).to_bytes(4, "little") for f in fds
+            ))
         elif sysno == ipc.PSYS_SIG_RETURN:
             # handler finished: restore the pre-delivery mask (delivery
             # pushed it in _next_signal); the done() reply may itself carry
@@ -2562,6 +2659,26 @@ class ProcessDriver:
             n = obj.expirations
             obj.expirations = 0
             self._resume(proc, 8, data=n.to_bytes(8, "little"))
+        elif isinstance(obj, SignalFd):
+            # Linux signalfd semantics: a read consumes signals pending
+            # for the READING process (matters after fork — the fd is
+            # inherited but each process's signal queue is its own)
+            p = getattr(proc, "proc", proc)
+            for i, s in enumerate(p.sig_pending):
+                if (obj.mask >> (s - 1)) & 1:
+                    p.sig_pending.pop(i)
+                    # struct signalfd_siginfo: ssi_signo u32 first; the
+                    # remaining fields (errno/code/pid/...) read as zero
+                    buf = s.to_bytes(4, "little") + b"\x00" * 124
+                    self._resume(proc, 128, data=buf)
+                    return
+            # no matching signal for THIS process (raced, or readiness was
+            # judged against another process's queue): a blocking reader
+            # re-parks, a nonblocking one gets EAGAIN
+            if obj.nonblock:
+                self._resume(proc, -errno.EAGAIN)
+            else:
+                self._park(proc, Parked(proc, "read", fd=obj.fd, want=want))
         else:
             self._resume(proc, -errno.EBADF)
 

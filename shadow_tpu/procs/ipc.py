@@ -39,6 +39,7 @@ PSYS_FUTEX_WAKE = -108
 PSYS_WAITPID = -109
 PSYS_SIG_RETURN = -110  # handler finished: restore pre-delivery sig mask
 PSYS_FSTAT = -111  # args: fd -> FD_KIND_* code (shim builds struct stat)
+PSYS_FD_LIST = -112  # ret = count; data = i32[] open managed fds (sorted)
 FD_KIND_SOCKET, FD_KIND_PIPE, FD_KIND_EVENTFD, FD_KIND_TIMERFD, FD_KIND_EPOLL = (
     1, 2, 3, 4, 5,
 )

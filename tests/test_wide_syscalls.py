@@ -31,7 +31,8 @@ def test_wide_syscall_surface(apps):
         "fstat-sock", "fstat-pipe", "fstat-eventfd", "stat-path", "statx", "statx-raw",
         "getifaddrs",
         "localtime", "mmap-anon", "mmap-policy", "mmap-managed-denied",
-        "proc-self-fd",
+        "proc-self-fd", "proc-fd-listing", "signalfd", "ppoll-sigmask",
+        "rlimit-roundtrip",
     ):
         assert f"ok {probe}" in out, (probe, out)
     # getifaddrs reports the SIMULATED address
@@ -41,6 +42,12 @@ def test_wide_syscall_surface(apps):
     lt = [l for l in out.splitlines() if l.startswith("ok localtime")][0]
     assert lt.split()[2] == "1", lt
     assert "1970-01-01" in lt, lt  # UTC rendering of the sim epoch
+    # rlimits are the deterministic synthesized table, not the machine's
+    assert "ok rlimit-nofile 1024 262144" in out, out
+    # getrusage serves the virtual clock as CPU time (sim t >= 1s here)
+    ru = [l for l in out.splitlines() if l.startswith("ok rusage")][0]
+    assert ru.split()[2].startswith("1."), ru
+    assert ru.split()[3] == "65536", ru
 
 
 @pytest.mark.quick
