@@ -234,6 +234,7 @@ def _run_stage(stage: str, app_model: str, loss: float, app_options: dict,
     c = sim.counters()
     timed_events = c["events_committed"] - warm_events
     timed_sim_s = stop_s - warmup_ns / 1e9
+    spill_st = sim.spill_stats()
     out = {
         "stage": stage,
         "hosts": num_hosts,
@@ -248,6 +249,8 @@ def _run_stage(stage: str, app_model: str, loss: float, app_options: dict,
     if windows is not None:
         out["windows"] = windows
         out["rollbacks"] = rollbacks
+    if spill_st.get("spill_episodes"):
+        out.update(spill_st)  # the never-drop tier fired: record its cost
     for k in extra_counters:
         out[k] = c[k]
     return out
@@ -310,10 +313,14 @@ def stage_phold_100k(stop_s: int = 10):
     }
 
 
-def stage_udp_flood_50k(sync: str = "conservative", stop_s: int = 3):
+def stage_udp_flood_50k(sync: str = "conservative", stop_s: int = 3,
+                        num_shards: int = 1):
     """BASELINE staged config 4 shape: 50k hosts through the full device
     network stack, in BOTH sync modes (config 4 pairs this scale with
-    optimistic PDES windows; conservative is the control row)."""
+    optimistic PDES windows; conservative is the control row) — and, with
+    num_shards > 1, on the ISLANDS runner in both modes (virtual islands
+    batch the local sorts S× smaller; optimistic×islands is the round-5
+    engine work)."""
     return _run_stage(
         "udp_flood_50k", "udp_flood", 0.001,
         {"interval": "40 ms", "size": 1024, "runtime": stop_s - 1},
@@ -321,7 +328,24 @@ def stage_udp_flood_50k(sync: str = "conservative", stop_s: int = 3):
         stop_s=stop_s, event_capacity=1 << 17,
         extra_experimental={"events_per_host_per_window": 12,
                             "outbox_slots": 8},
-        windows_per_dispatch=16, sync=sync,
+        windows_per_dispatch=16, sync=sync, num_shards=num_shards,
+    )
+
+
+def stage_spill_50k(stop_s: int = 3):
+    """Deliberately undersized pool at the 50k shape (VERDICT r4 #6): the
+    spill tier must complete the run — measure what the never-drop
+    guarantee costs at scale (episodes, drained/injected rows, sim/wall vs
+    the right-sized conservative row)."""
+    return _run_stage(
+        "udp_flood_50k_spill", "udp_flood", 0.001,
+        {"interval": "40 ms", "size": 1024, "runtime": stop_s - 1},
+        num_hosts=50176, stop_s=stop_s,
+        # a quarter of the right-sized pool: guaranteed spill episodes
+        event_capacity=1 << 15,
+        extra_experimental={"events_per_host_per_window": 12,
+                            "outbox_slots": 8},
+        windows_per_dispatch=16,
     )
 
 
@@ -391,11 +415,18 @@ def main():
         shard_sweep(out_path=os.path.join(_REPO, "docs", "shard_sweep.json"))
         return
     if "--stages-50k" in sys.argv:
-        # BASELINE config 4 rows: both synchronization modes
+        # BASELINE config 4 rows: both synchronization modes, on the
+        # global engine AND the islands runner (r5: optimistic×islands),
+        # plus the undersized-pool spill-cost row (VERDICT r4 #6)
         print(json.dumps(_with_backend_retry(stage_udp_flood_50k,
                                              "conservative")), flush=True)
         print(json.dumps(_with_backend_retry(stage_udp_flood_50k,
                                              "optimistic")), flush=True)
+        print(json.dumps(_with_backend_retry(
+            stage_udp_flood_50k, "conservative", num_shards=8)), flush=True)
+        print(json.dumps(_with_backend_retry(
+            stage_udp_flood_50k, "optimistic", num_shards=8)), flush=True)
+        print(json.dumps(_with_backend_retry(stage_spill_50k)), flush=True)
         return
 
     num_hosts, msgload, stop_s = 16384, 8, 10

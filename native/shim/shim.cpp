@@ -1140,6 +1140,32 @@ int epoll_pwait(int epfd, struct epoll_event* evs, int maxevents,
   return r;
 }
 
+int pselect(int nfds, fd_set* rd, fd_set* wr, fd_set* ex,
+            const struct timespec* ts, const sigset_t* sigmask) {
+  if (!g_ch) {
+    static auto real =
+        (int (*)(int, fd_set*, fd_set*, fd_set*, const struct timespec*,
+                 const sigset_t*))dlsym(RTLD_NEXT, "pselect");
+    return real(nfds, rd, wr, ex, ts, sigmask);
+  }
+  if (ts && (ts->tv_sec < 0 || ts->tv_nsec < 0 ||
+             ts->tv_nsec >= 1000000000L)) {
+    errno = EINVAL;
+    return -1;
+  }
+  sigset_t oldm;
+  if (sigmask_swap_enter(sigmask, &oldm) != 0) return -1;
+  struct timeval tv, *tvp = nullptr;
+  if (ts) {
+    tv.tv_sec = ts->tv_sec;
+    tv.tv_usec = (ts->tv_nsec + 999) / 1000;
+    tvp = &tv;
+  }
+  int r = select(nfds, rd, wr, ex, tvp);
+  sigmask_swap_exit(sigmask, &oldm);
+  return r;
+}
+
 // ---------------------------------------------------------------------------
 // Deterministic resource limits + usage (rlimit.c-class surface): limits
 // are app-visible state, so reading the real machine's would leak
@@ -1979,11 +2005,15 @@ static pthread_mutex_t g_vdir_mu = PTHREAD_MUTEX_INITIALIZER;
 static bool is_proc_fd_dir(const char* name) {
   if (!name) return false;
   if (strcmp(name, "/proc/self/fd") == 0 ||
-      strcmp(name, "/proc/self/fd/") == 0)
+      strcmp(name, "/proc/self/fd/") == 0 ||
+      strcmp(name, "/dev/fd") == 0 ||  // the portable alias (symlink to
+      strcmp(name, "/dev/fd/") == 0)   // /proc/self/fd; BSD-derived code)
     return true;
   char buf[64];
   snprintf(buf, sizeof buf, "/proc/%d/fd", (int)getpid());
-  return strcmp(name, buf) == 0;
+  size_t n = strlen(buf);
+  return strncmp(name, buf, n) == 0 &&
+         (name[n] == 0 || (name[n] == '/' && name[n + 1] == 0));
 }
 
 static VirtFdDir* vdir_of(DIR* dp) {
@@ -2150,9 +2180,16 @@ namespace {
 // pipes/sockets reduce to that here). Returns LONG_MIN when the path is
 // not a managed /proc/self/fd entry (caller falls through to native).
 long virt_proc_fd_open(const char* path) {
-  if (!path || strncmp(path, "/proc/self/fd/", 14) != 0) return LONG_MIN;
+  if (!path) return LONG_MIN;
+  const char* num = nullptr;
+  if (strncmp(path, "/proc/self/fd/", 14) == 0)
+    num = path + 14;
+  else if (strncmp(path, "/dev/fd/", 8) == 0)  // portable alias
+    num = path + 8;
+  else
+    return LONG_MIN;
   char* end = nullptr;
-  long n = strtol(path + 14, &end, 10);
+  long n = strtol(num, &end, 10);
   if (!end || *end != 0 || n < FD_BASE) return LONG_MIN;
   return RAWRET(dup((int)n));
 }
@@ -2271,15 +2308,15 @@ long route_raw_syscall(long nr, long a0, long a1, long a2, long a3, long a4,
       return RAWRET(select((int)a0, (fd_set*)a1, (fd_set*)a2, (fd_set*)a3,
                            (struct timeval*)a4));
     case SYS_pselect6: {
-      const struct timespec* ts = (const struct timespec*)a4;
-      struct timeval tv, *tvp = nullptr;
-      if (ts) {
-        tv.tv_sec = ts->tv_sec;
-        tv.tv_usec = ts->tv_nsec / 1000;
-        tvp = &tv;
-      }
-      return RAWRET(
-          select((int)a0, (fd_set*)a1, (fd_set*)a2, (fd_set*)a3, tvp));
+      // the kernel ABI's 6th arg is {const sigset_t*, size_t}
+      struct KernelSigset {
+        const sigset_t* ss;
+        size_t len;
+      };
+      const KernelSigset* sm = (const KernelSigset*)a5;
+      return RAWRET(pselect((int)a0, (fd_set*)a1, (fd_set*)a2, (fd_set*)a3,
+                            (const struct timespec*)a4,
+                            sm ? sm->ss : nullptr));
     }
     case SYS_clock_gettime:
       return RAWRET(clock_gettime((clockid_t)a0, (struct timespec*)a1));

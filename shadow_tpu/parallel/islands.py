@@ -593,45 +593,33 @@ class IslandSimulation(Simulation):
         }
 
     def _ensure_optimistic(self):
-        """Lazily compile the speculative window kernel (a second XLA
+        """Lazily compile the speculative SUB-STEP kernel (a second XLA
         program): the conservative kernel stays untouched, so conservative
-        runs never pay for the done_t checks."""
+        runs never pay for the done_t checks.
+
+        The attempt loop is HOST-DRIVEN (one dispatch per sub-step, like
+        run_stepwise) rather than a fused on-device while_loop: compiling
+        vmap(S) of while_loop(full netstack step) measured >90 min on a
+        CPU host at S=8 — the fused program buys one dispatch per attempt
+        but costs a pathological compile. The sub-step kernel is the same
+        size as the conservative step (known-fast compile), semantics are
+        identical (each sub-step processes [max(mn, ws), we) and reports
+        the pmin'd frontier + earliest violation), and the host loop gets
+        stall detection for free."""
         if self._attempt is not None:
             return
         spec_opt = self._island_spec._replace(optimistic=True)
         step_opt = self._step_builder(spec_opt)
 
-        def attempt(state, params, ws, we):
-            ws = jnp.asarray(ws, jnp.int64)
-            we = jnp.asarray(we, jnp.int64)
+        def substep(state, params, ws, we):
+            st2, mn2 = step_opt(state, params, ws, we)
+            # one pmin each: the shards agree on the frontier + earliest
+            # violation, so every shard reports the same scalars
+            mn2 = jax.lax.pmin(mn2, AXIS)
+            viol = jax.lax.pmin(st2.xmit_min, AXIS)
+            return st2, mn2, viol
 
-            def cond(c):
-                _, mn, v, k = c
-                # the k bound turns a pool-headroom stall (step commits
-                # nothing, mn frozen) into a loop exit the driver can
-                # diagnose, instead of an unkillable compiled spin — the
-                # conservative drivers' Python-side stall checks have no
-                # reach inside this while_loop
-                return (mn < we) & (v == simtime.NEVER) & (k < _MAX_SUBSTEPS)
-
-            def body(c):
-                st, mn, _, k = c
-                st2, mn2 = step_opt(st, params, jnp.maximum(mn, ws), we)
-                # one pmin each: the shards agree on frontier + earliest
-                # violation, so every shard takes the same loop decision
-                # (lockstep while_loop — no divergent control flow)
-                mn2 = jax.lax.pmin(mn2, AXIS)
-                viol = jax.lax.pmin(st2.xmit_min, AXIS)
-                return st2, mn2, viol, k + 1
-
-            mn0 = jax.lax.pmin(jnp.min(state.pool.time), AXIS)
-            return jax.lax.while_loop(
-                cond, body,
-                (state, mn0, jnp.asarray(simtime.NEVER, jnp.int64),
-                 jnp.int32(0)),
-            )
-
-        self._attempt = self._wrap(attempt, 3)
+        self._attempt = self._wrap(substep, 2)
 
     def run_optimistic(
         self,
@@ -708,35 +696,45 @@ class IslandSimulation(Simulation):
             we = min(max(min(ws + factor * cons, stop), floor), stop)
             base = self.state  # rollback snapshot (done_t already reset)
             rb0 = rollbacks
+            never = int(simtime.NEVER)
             while True:  # attempt [ws, we); shrink on violation
-                st, mn, viol, k = self._attempt(base, self.params, ws, we)
-                viol = int(np.min(np.asarray(viol)))
-                mn_i = int(np.min(np.asarray(mn)))
-                if (viol >= int(simtime.NEVER) and mn_i < we
-                        and int(np.max(np.asarray(k))) >= _MAX_SUBSTEPS):
-                    # sub-step ceiling hit without finishing the window
-                    if mn_i <= ws:
-                        raise RuntimeError(
-                            "optimistic attempt cannot make progress "
-                            "(pool-headroom stall: the window commits "
-                            "nothing and its frontier is frozen); raise "
-                            "experimental.event_capacity"
-                        )
-                    # genuinely enormous window: shrink to the reached
-                    # frontier and retry from the snapshot (bounded work
-                    # per attempt, monotonic convergence)
+                # host-driven sub-step loop (see _ensure_optimistic): one
+                # dispatch per sub-step until the window completes or a
+                # shard reports a violation
+                st = base
+                mn_i, viol, k = ws, never, 0
+                while mn_i < we and viol >= never:
+                    if k >= _MAX_SUBSTEPS:
+                        if mn_i <= ws:
+                            raise RuntimeError(
+                                "optimistic attempt cannot make progress "
+                                "(pool-headroom stall: the window commits "
+                                "nothing and its frontier is frozen); "
+                                "raise experimental.event_capacity"
+                            )
+                        # genuinely enormous window: shrink to the
+                        # reached frontier, retry from the snapshot
+                        break
+                    st, mn, vl = self._attempt(
+                        st, self.params, max(mn_i, ws), we
+                    )
+                    mn_i = int(np.min(np.asarray(mn)))
+                    viol = int(np.min(np.asarray(vl)))
+                    k += 1
+                if viol >= never and mn_i < we and k >= _MAX_SUBSTEPS:
                     we = mn_i
                     continue
-                if viol >= int(simtime.NEVER) or we <= floor:
+                if viol >= never or we <= floor:
                     break
                 rollbacks += 1
                 we = min(max(viol, floor), stop)
+            # exchange rounds of the ACCEPTED attempt only: rolled-back
+            # sub-steps' exchange counters are discarded with the rollback,
+            # and suggest_exchange_slots normalizes sent/windows_run
+            self.windows_run += k
             self.state = st.replace(host=st.host.replace(done_t=neg1))
-            min_next = int(np.min(np.asarray(mn)))
+            min_next = mn_i
             windows += 1
-            # each sub-step of the ACCEPTED attempt ran one exchange
-            # round, which is what suggest_exchange_slots normalizes by
-            self.windows_run += int(np.max(np.asarray(k)))
             if adaptive:
                 factor, streak = self.adapt_window_factor(
                     factor, streak, rollbacks > rb0, window_factor

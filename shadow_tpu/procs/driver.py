@@ -1332,20 +1332,21 @@ class ProcessDriver:
             return
         act = p.sig_actions.get(sig)
         if sig == SIGKILL or act is None or act[0] == 0:  # SIG_DFL
-            if sig != SIGKILL and sig in _SIG_DFL_IGNORE:
-                return
             if sig != SIGKILL and all(
                 (t.sig_mask >> (sig - 1)) & 1 for t in p.threads
                 if t.state != ManagedThread.EXITED
             ):
                 # Blocked in every thread: POSIX keeps the signal PENDING
-                # (the default action applies only on unblock, under the
-                # then-current disposition — _next_signal handles that).
-                # This is the signalfd usage contract: block the signal,
-                # consume it through the fd.
+                # — INCLUDING default-ignore signals like SIGCHLD, whose
+                # discard must happen at delivery/unblock time, not here
+                # (the canonical signalfd pattern blocks SIGCHLD and
+                # consumes child exits through the fd). _next_signal
+                # applies the then-current disposition on unblock.
                 if sig not in p.sig_pending:
                     p.sig_pending.append(sig)
                     self._wake_signalfds(p, sig)
+                return
+            if sig != SIGKILL and sig in _SIG_DFL_IGNORE:
                 return
             # default disposition terminates at this sim time
             self._schedule(self.now, lambda: self._signal_kill(p, sig))

@@ -22,6 +22,7 @@
 #include <sys/signalfd.h>
 #include <sys/socket.h>
 #include <sys/stat.h>
+#include <sys/wait.h>
 #include <linux/stat.h>
 #include <sys/syscall.h>
 #include <time.h>
@@ -193,6 +194,28 @@ int main(void) {
   }
   close(sfd);
   printf("ok signalfd\n");
+
+  /* the canonical pattern: block SIGCHLD (a default-IGNORE signal — it
+   * must stay PENDING while blocked, not be discarded), fork, consume
+   * the child's exit through the fd */
+  sigset_t chld;
+  sigemptyset(&chld);
+  sigaddset(&chld, SIGCHLD);
+  if (sigprocmask(SIG_BLOCK, &chld, NULL) != 0)
+    return fail("sigprocmask(block CHLD)");
+  int cfd = signalfd(-1, &chld, 0);
+  if (cfd < 0) return fail("signalfd(chld)");
+  pid_t kid = fork();
+  if (kid < 0) return fail("fork");
+  if (kid == 0) _exit(0);
+  struct pollfd cpf = {.fd = cfd, .events = POLLIN};
+  if (poll(&cpf, 1, 5000) != 1 || !(cpf.revents & POLLIN))
+    return fail("poll(signalfd chld)");
+  if (read(cfd, &ssi, sizeof ssi) != sizeof ssi || ssi.ssi_signo != SIGCHLD)
+    return fail("signalfd chld read");
+  close(cfd);
+  if (waitpid(kid, NULL, 0) != kid) return fail("waitpid");
+  printf("ok signalfd-chld\n");
 
   /* ---- ppoll: pending signal unblocked by the sigmask swap -> EINTR,
    * handler invoked (the atomic mask-swap contract) ---- */

@@ -213,6 +213,58 @@ def test_islands_optimistic_under_exchange_backpressure():
     _assert_equivalent_islands(cons, opt)
 
 
+FLOOD_YAML = """
+general:
+  stop_time: 3
+  seed: 7
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        node [ id 0 bandwidth_down "100 Mbit" bandwidth_up "100 Mbit" ]
+        edge [ source 0 target 0 latency "10 ms" packet_loss 0.001 ]
+      ]
+experimental:
+  event_capacity: 2048
+  events_per_host_per_window: 8
+  outbox_slots: 8
+  inbox_slots: 4
+  router_queue_slots: 8
+hosts:
+  server:
+    quantity: 4
+    app_model: udp_flood
+    app_options: {role: server}
+  client:
+    quantity: 28
+    app_model: udp_flood
+    app_options: {interval: "40 ms", size: 512, runtime: 1}
+"""
+
+
+def test_islands_optimistic_netstack_equivalence():
+    """The LOOP path (full NIC/router/UDP netstack — no matrix pin) under
+    optimistic islands: the PHOLD gates above exercise only the matrix
+    path, so this is the coverage for the micro-step loop's emission
+    check + the exchange arrival check together. Must reproduce the
+    global conservative run bit-for-bit."""
+    cons = build_simulation(FLOOD_YAML)
+    cons.run_stepwise()
+    cc = cons.counters()
+
+    opt = build_simulation(_islandize_yaml(FLOOD_YAML))
+    windows, rollbacks = opt.run_optimistic(window_factor=8)
+    co = opt.counters()
+    for k in ("events_committed", "events_emitted", "packets_sent",
+              "packets_delivered", "packets_dropped_loss", "bytes_sent",
+              "bytes_delivered", "pool_overflow_dropped"):
+        assert cc[k] == co[k], (k, cc[k], co[k])
+    a = np.asarray(jax.device_get(cons.state.subs["udp_flood"]["recv"]))
+    b = np.asarray(jax.device_get(opt.state.subs["udp_flood"]["recv"]))
+    assert (a == b.reshape(a.shape)).all()
+
+
 def test_adaptive_factor_equivalence():
     """Adaptive window_factor (BASELINE config 4 tuning: halve on
     rollback, re-grow after clean streaks) must still reproduce the

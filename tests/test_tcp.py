@@ -50,7 +50,11 @@ def _bulk_cfg(total="200 KiB", loss=0.0, stop=20, seed=7, clients=1,
         "general": general,
         "network": {"graph": {"type": "gml", "inline": _gml(loss)}},
         "experimental": {
-            "event_capacity": 16384,
+            # canonical small TCP shape (compile-cost policy, ROADMAP.md):
+            # every _bulk_cfg variant shares (C, K) so XLA compiles the
+            # TCP kernel once per HOST COUNT, and the pool is sized to the
+            # ≤4-host in-flight population, not the 10k-host stages'
+            "event_capacity": 4096,
             "events_per_host_per_window": 8,
         },
         "hosts": hosts,
@@ -216,6 +220,10 @@ def test_sack_loss_recovery_not_timeout_bound():
     SACK-guided fast retransmissions — retransmit count stays in the
     vicinity of the loss count, and RTO timeouts stay rare instead of
     pacing the transfer."""
+    # clients=2 is part of the tuned workload: at 3 clients the shared
+    # bottleneck congests enough that spurious retransmits blur the
+    # SACK-efficiency bound this gate exists to enforce (tried for the
+    # compile-shape merge; not worth weakening the gate)
     sim = build_simulation(_bulk_cfg(total="300 KiB", loss=0.02, stop=30,
                                      clients=2, bootstrap=0))
     sim.run_stepwise()

@@ -31,8 +31,8 @@ def test_wide_syscall_surface(apps):
         "fstat-sock", "fstat-pipe", "fstat-eventfd", "stat-path", "statx", "statx-raw",
         "getifaddrs",
         "localtime", "mmap-anon", "mmap-policy", "mmap-managed-denied",
-        "proc-self-fd", "proc-fd-listing", "signalfd", "ppoll-sigmask",
-        "rlimit-roundtrip",
+        "proc-self-fd", "proc-fd-listing", "signalfd", "signalfd-chld",
+        "ppoll-sigmask", "rlimit-roundtrip",
     ):
         assert f"ok {probe}" in out, (probe, out)
     # getifaddrs reports the SIMULATED address

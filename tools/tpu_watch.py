@@ -44,7 +44,7 @@ SLEEP_S = 240.0
 STAGES = [
     ("phold_16k", [PY, "bench.py"], False, 5400),
     ("stages_10k", [PY, "bench.py", "--stages"], False, 10800),
-    ("stages_50k", [PY, "bench.py", "--stages-50k"], False, 10800),
+    ("stages_50k", [PY, "bench.py", "--stages-50k"], False, 14400),
     ("stages_100k", [PY, "bench.py", "--stages-100k"], False, 10800),
     ("shard_sweep", [PY, "bench.py", "--shard-sweep"], True, 14400),
     ("rebalance", [PY, "tools/bench_rebalance.py"], True, 7200),
