@@ -1159,6 +1159,10 @@ int pselect(int nfds, fd_set* rd, fd_set* wr, fd_set* ex,
   if (ts) {
     tv.tv_sec = ts->tv_sec;
     tv.tv_usec = (ts->tv_nsec + 999) / 1000;
+    if (tv.tv_usec >= 1000000) {  // round-up overflow: carry, or the
+      tv.tv_sec += 1;             // kernel rejects the timeval (EINVAL)
+      tv.tv_usec -= 1000000;
+    }
     tvp = &tv;
   }
   int r = select(nfds, rd, wr, ex, tvp);

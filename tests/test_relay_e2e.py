@@ -125,7 +125,10 @@ experimental:
   use_device_tcp: true
   event_capacity: {1 << 16}
   events_per_host_per_window: 8
-  sockets_per_host: 128
+  # each relay transits ~n_clients*3/n_relays circuits at 2 sockets per
+  # transit (held open through the run), and each exit accepts
+  # ~n_clients/n_exits streams: 128 capped success at exactly 128/245
+  sockets_per_host: 512
 hosts:
   relay:
     quantity: {n_relays}
