@@ -174,6 +174,7 @@ def _run_stage(stage: str, app_model: str, loss: float, app_options: dict,
     sim/wall ratio cover only the timed segment."""
     import jax
 
+    from shadow_tpu.obs import metrics as obs_metrics
     from shadow_tpu.sim import build_simulation
 
     warmup_ns = 1_500_000_000
@@ -208,6 +209,11 @@ def _run_stage(stage: str, app_model: str, loss: float, app_options: dict,
         },
     }
     sim = build_simulation(cfg)
+    # Telemetry session: wall histograms + per-round throughput ride the
+    # handoff boundaries the driver already syncs at; the device counter
+    # block is compiled into the kernel either way.
+    session = obs_metrics.ObsSession()
+    sim.obs_session = session
     # Bounded dispatch chunks: minutes-long single dispatches can crash the
     # accelerator runtime's watchdog at this scale, but each dispatch costs
     # ~8 ms of tunnel overhead (profiled), so size them as large as safe.
@@ -253,6 +259,25 @@ def _run_stage(stage: str, app_model: str, loss: float, app_options: dict,
         out.update(spill_st)  # the never-drop tier fired: record its cost
     for k in extra_counters:
         out[k] = c[k]
+    # compact telemetry sub-object: the signals every perf comparison
+    # needs, pulled from the device block + wall histograms (the full
+    # document is what --metrics-out dumps)
+    session.finalize(sim)
+    doc = session.metrics.to_doc()
+    hist = doc["histograms"]
+    out["metrics"] = {
+        "windows_run": doc["counters"].get("obs.windows_run", 0),
+        "matrix_dispatches": doc["counters"].get("obs.matrix_dispatches", 0),
+        "loop_dispatches": doc["counters"].get("obs.loop_dispatches", 0),
+        "window_shrinks": doc["counters"].get("obs.window_shrinks", 0),
+        "vtime_spread_ns": doc["gauges"].get("vtime.spread_ns", 0),
+        "dispatch_p50_s": round(
+            hist.get("wall.dispatch_s", {}).get("p50", 0.0), 4
+        ),
+        "round_events_per_sec_p50": round(
+            hist.get("round.events_per_sec", {}).get("p50", 0.0), 1
+        ),
+    }
     return out
 
 
@@ -359,6 +384,45 @@ def stage_udp_flood_100k(stop_s: int = 3):
     )
 
 
+def stage_obs_overhead(num_hosts: int = 8192, msgload: int = 4,
+                       stop_s: int = 4):
+    """Telemetry-plane overhead smoke row (ISSUE 1 acceptance gate): the
+    flagship PHOLD shape with the device counter block compiled IN vs OUT
+    (experimental.obs_counters). The block costs one fused [NUM_WIN] add
+    plus two [H] selects per window step; the gate is <= 3% step time."""
+    import jax
+
+    from shadow_tpu.core import simtime
+    from shadow_tpu.flagship import build_phold_flagship
+
+    def timed(obs_on: bool) -> tuple[float, int]:
+        sim = build_phold_flagship(
+            num_hosts, msgload=msgload, stop_s=stop_s, runtime_s=stop_s,
+            obs_counters=obs_on,
+        )
+        sim.run(until=int(0.2 * simtime.NS_PER_SEC))
+        jax.block_until_ready(sim.state.pool.time)
+        t0 = time.perf_counter()
+        sim.run()
+        jax.block_until_ready(sim.state.pool.time)
+        return time.perf_counter() - t0, sim.counters()["events_committed"]
+
+    # interleave the arms to decorrelate machine drift from the comparison
+    w_on = min(timed(True)[0] for _ in range(2))
+    w_off, events = timed(False)
+    w_off = min(w_off, timed(False)[0])
+    overhead = (w_on - w_off) / w_off * 100.0 if w_off > 0 else 0.0
+    return {
+        "stage": "obs_overhead",
+        "hosts": num_hosts,
+        "events": int(events),
+        "wall_obs_on_s": round(w_on, 3),
+        "wall_obs_off_s": round(w_off, 3),
+        "overhead_pct": round(overhead, 2),
+        "gate_3pct": overhead <= 3.0,
+    }
+
+
 def shard_sweep(shards=(1, 2, 4, 8), out_path: str | None = None):
     """Virtual-islands scaling sweep on ONE chip (VERDICT r4 gate 1c):
     PHOLD 16k and udp_flood_10k at each shard count; one JSON line each.
@@ -413,6 +477,10 @@ def main():
         return
     if "--shard-sweep" in sys.argv:
         shard_sweep(out_path=os.path.join(_REPO, "docs", "shard_sweep.json"))
+        return
+    if "--obs-smoke" in sys.argv:
+        # telemetry-plane overhead gate (<= 3% step time with counters on)
+        print(json.dumps(_with_backend_retry(stage_obs_overhead)), flush=True)
         return
     if "--stages-50k" in sys.argv:
         # BASELINE config 4 rows: both synchronization modes, on the

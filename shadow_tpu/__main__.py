@@ -53,6 +53,18 @@ def _build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--progress", action="store_true", help="log round progress"
     )
+    p.add_argument(
+        "--metrics-out", metavar="PATH",
+        help="write the run's metrics registry (device counters, wall-time "
+             "histograms, virtual-time roughness) as versioned JSON "
+             "(docs/observability.md); device plane only",
+    )
+    p.add_argument(
+        "--trace-out", metavar="PATH",
+        help="write driver-phase spans as Chrome trace-event JSON "
+             "(load in Perfetto, or summarize with tools/trace_summary.py); "
+             "device plane only",
+    )
     return p
 
 
@@ -168,7 +180,19 @@ def _run_process_plane(cfg, driver, progress: bool) -> int:
     return 0
 
 
-def _run_device_plane(cfg, sim, progress: bool) -> int:
+def _run_device_plane(
+    cfg, sim, progress: bool,
+    metrics_out: str | None = None, trace_out: str | None = None,
+) -> int:
+    session = None
+    if metrics_out or trace_out:
+        from shadow_tpu.obs import metrics as obs_metrics
+        from shadow_tpu.obs import trace as obs_trace
+
+        session = obs_metrics.ObsSession(
+            tracer=obs_trace.ChromeTracer() if trace_out else None
+        )
+        sim.obs_session = session
     t0 = time.monotonic()
     if progress:
         import jax
@@ -205,6 +229,20 @@ def _run_device_plane(cfg, sim, progress: bool) -> int:
             f"(raise experimental.event_capacity)",
             file=sys.stderr,
         )
+    if session is not None:
+        session.finalize(sim)
+        meta = {
+            "hosts": sim.num_hosts,
+            "stop_time_ns": sim.stop_time,
+            "seed": cfg.general.seed,
+            "wall_s": round(wall, 3),
+        }
+        if metrics_out:
+            session.metrics.dump(metrics_out, meta=meta)
+            print(f"metrics written to {metrics_out}", file=sys.stderr)
+        if trace_out:
+            session.tracer.write(trace_out)
+            print(f"trace written to {trace_out}", file=sys.stderr)
     return 0
 
 
@@ -263,8 +301,17 @@ def main(argv: list[str] | None = None) -> int:
         return 2
 
     if has_procs:
+        if args.metrics_out or args.trace_out:
+            print(
+                "note: --metrics-out/--trace-out cover the device plane "
+                "only; ignored for managed-process simulations",
+                file=sys.stderr,
+            )
         return _run_process_plane(cfg, built, cfg.general.progress)
-    return _run_device_plane(cfg, built, cfg.general.progress)
+    return _run_device_plane(
+        cfg, built, cfg.general.progress,
+        metrics_out=args.metrics_out, trace_out=args.trace_out,
+    )
 
 
 if __name__ == "__main__":

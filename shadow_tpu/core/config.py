@@ -191,6 +191,13 @@ class ExperimentalOptions:
     # virtual clock once it exceeds max_unapplied_cpu_latency.
     cpu_ns_per_syscall: int = 0  # 0 = CPU model off
     max_unapplied_cpu_latency: int = units.parse_time_ns("1 us")
+    # Device telemetry counter block (shadow_tpu/obs/counters.py): window
+    # -plane counters + per-host event/virtual-time rows carried in
+    # SimState and updated inside the jitted kernel. On by default (the
+    # updates are fused adds, measured <= 3% of step time by bench.py's
+    # obs-overhead smoke row); False compiles them out — the control arm
+    # of that measurement.
+    obs_counters: bool = True
     # CPU↔TPU seam: route managed-process UDP through the device-stepped
     # network (procs/bridge.py). The BASELINE north-star path.
     use_device_network: bool = False
@@ -219,7 +226,7 @@ class ExperimentalOptions:
             if name in d:
                 setattr(out, name, units.parse_bytes(d[name]))
         for name in (
-            "use_device_network", "use_device_tcp",
+            "use_device_network", "use_device_tcp", "obs_counters",
             "socket_recv_autotune", "socket_send_autotune", "use_memory_manager",
             "use_seccomp", "use_syscall_counters", "use_object_counters",
         ):

@@ -48,6 +48,8 @@ from flax import struct
 from shadow_tpu.core import rng as rng_mod
 from shadow_tpu.core import simtime, soa
 from shadow_tpu.core import spill as spill_mod
+from shadow_tpu.obs import counters as obs_mod
+from shadow_tpu.obs import metrics as metrics_mod
 from shadow_tpu.core.state import (
     PAYLOAD_WORDS,
     Counters,
@@ -621,6 +623,22 @@ def make_window_step(
 
         _lrow = None if island is None else _box_lrow(state.pool.dst)
 
+        def _obs_win_bump(state, *slots):
+            """One fused add to the telemetry block's window-plane row.
+            Under islands the bump is scaled by (axis_index == 0) so the
+            summed-at-fetch counts equal the global engine's. Compiled out
+            entirely when the block is disabled."""
+            if state.obs is None:
+                return state
+            vec = obs_mod.win_bump_vec(*slots)
+            if island is not None:
+                vec = vec * (
+                    jax.lax.axis_index(island.axis) == 0
+                ).astype(jnp.int64)
+            return state.replace(
+                obs=state.obs.replace(win=state.obs.win + vec)
+            )
+
         # Static per-kind emission bound: probe the handlers once at trace
         # time with an all-masked-off event and count emit() calls per
         # kind. A host processes exactly ONE event (of one kind) per
@@ -972,6 +990,17 @@ def make_window_step(
                         micro_steps=state.counters.micro_steps + 1,
                     )
                 )
+                if state.obs is not None:
+                    # telemetry block: per-host committed count + the
+                    # virtual-time frontier (events process in key order
+                    # per host, so a where-select IS the running max)
+                    ob = state.obs
+                    state = state.replace(obs=ob.replace(
+                        host_events=ob.host_events
+                        + valid.astype(jnp.int64)
+                        + taken_extra.astype(jnp.int64),
+                        host_last_t=jnp.where(valid, last_t, ob.host_last_t),
+                    ))
 
                 # --- route emissions (order fixes per-source seq numbers) ---
                 for em in emitter.records:
@@ -1097,6 +1126,9 @@ def make_window_step(
             return carry0, cond, body, finish
 
         def run_loop(state):
+            state = _obs_win_bump(
+                state, obs_mod.WIN_WINDOWS, obs_mod.WIN_LOOP
+            )
             dense, tail = _dense_extract(
                 state.pool, win_start, win_end, H, K + 1, PP, lrow=_lrow,
             )
@@ -1140,6 +1172,9 @@ def make_window_step(
             element) while multi-operand sorts and scans run at memory
             bandwidth, so this path is built from sorts, cumulative scans,
             and reshapes ONLY (_dense_extract)."""
+            state = _obs_win_bump(
+                state, obs_mod.WIN_WINDOWS, obs_mod.WIN_MATRIX
+            )
             pool = state.pool
             dense, tail = _dense_extract(
                 pool, win_start, win_end, H, K, PP, lrow=_lrow
@@ -1248,6 +1283,15 @@ def make_window_step(
                     micro_steps=state.counters.micro_steps + 1,
                 )
             )
+            if state.obs is not None:
+                ob = state.obs
+                state = state.replace(obs=ob.replace(
+                    host_events=ob.host_events
+                    + jnp.sum(valid, axis=1, dtype=jnp.int64),
+                    host_last_t=jnp.where(
+                        nvalid > 0, last_t, ob.host_last_t
+                    ),
+                ))
             # --- merge (sort 3): tail leftovers ∪ emissions, ONE 1-key
             # stable sort by time carrying every column; no payload
             # indirection gathers. Output truncates to pool capacity
@@ -1360,6 +1404,7 @@ class Simulation:
         cpu_ns_per_event: np.ndarray | None = None,
         bulk_gate: Callable | None = None,
         bulk_self_excluded: bool = False,
+        obs_counters: bool = True,
     ):
         # initial_events: (time, dst, src, kind, payload words)
         self.num_hosts = num_hosts
@@ -1430,7 +1475,12 @@ class Simulation:
             counters=Counters.zeros(),
             rng_keys=rng_mod.host_keys(seed, num_hosts),
             subs=subs or {},
+            obs=obs_mod.ObsBlock.zeros(num_hosts) if obs_counters else None,
         )
+        # Telemetry session (obs/metrics.ObsSession): attached by the CLI
+        # (--metrics-out/--trace-out) or bench; None keeps the run loops on
+        # their zero-instrumentation path.
+        self.obs_session = None
         step = make_window_step(
             handlers, num_hosts, K=K, B=B, O=O, bulk_kinds=bulk_kinds,
             matrix_handlers=matrix_handlers, with_cpu_model=with_cpu,
@@ -1485,10 +1535,12 @@ class Simulation:
     def run_stepwise(self, until: int | None = None) -> int:
         stop = self.stop_time if until is None else min(until, self.stop_time)
         spill = self._spill_store()
+        obs = self.obs_session
         windows = 0
         stall = 0
         while True:
-            stop_at = spill_mod.manage(self, spill, stop)
+            with metrics_mod.span(obs, "spill"):
+                stop_at = spill_mod.manage(self, spill, stop)
             min_next = int(jnp.min(self.state.pool.time))
             if min_next >= stop_at:
                 if min_next >= stop and spill.min_time >= stop:
@@ -1507,7 +1559,8 @@ class Simulation:
             stall = 0
             ws = min_next
             we = min(ws + self.runahead, stop_at)
-            self.state, mn = self._step(self.state, self.params, ws, we)
+            with metrics_mod.span(obs, "dispatch", windows=1):
+                self.state, mn = self._step(self.state, self.params, ws, we)
             windows += 1
         return windows
 
@@ -1595,22 +1648,34 @@ class Simulation:
         self.state = self.state.replace(
             host=self.state.host.replace(done_t=neg1)
         )
+        obs = self.obs_session
         min_next = int(jnp.min(self.state.pool.time))
         while min_next < stop:
             ws = min_next
             we = min(ws + factor * cons, stop)
             base = self.state  # rollback snapshot (done_t already reset)
             rb0 = rollbacks
-            while True:  # attempt [ws, we) in ONE dispatch; shrink on violation
-                st, mn, viol = self._attempt(base, self.params, ws, we)
-                viol = int(viol)
-                if viol >= int(simtime.NEVER) or we <= ws + cons:
-                    break
-                rollbacks += 1
-                we = max(viol, ws + cons)
+            with metrics_mod.span(obs, "window", factor=factor):
+                while True:  # attempt [ws, we) in ONE dispatch; shrink on violation
+                    with metrics_mod.span(obs, "dispatch"):
+                        st, mn, viol = self._attempt(base, self.params, ws, we)
+                        viol = int(viol)
+                    if viol >= int(simtime.NEVER) or we <= ws + cons:
+                        break
+                    rollbacks += 1
+                    if obs is not None and obs.tracer:
+                        obs.tracer.instant("rollback", viol_ns=viol)
+                    we = max(viol, ws + cons)
+            # driver-plane telemetry bumps ride the state replace the loop
+            # does anyway (handoff boundary — no sync added); each rollback
+            # shrank the window once
+            st = obs_mod.bump_win(st, obs_mod.WIN_ROLLBACKS, rollbacks - rb0)
+            st = obs_mod.bump_win(st, obs_mod.WIN_SHRINKS, rollbacks - rb0)
             self.state = st.replace(host=st.host.replace(done_t=neg1))
             min_next = int(mn)
             windows += 1
+            if obs is not None:
+                obs.round_done(self)
             if adaptive:
                 factor, streak = self.adapt_window_factor(
                     factor, streak, rollbacks > rb0, window_factor
@@ -1648,17 +1713,25 @@ class Simulation:
     ) -> None:
         stop = self.stop_time if until is None else min(until, self.stop_time)
         spill = self._spill_store()
+        obs = self.obs_session
         last = None
         while True:
             active = (last is not None and last[2]) or spill.count
-            stop_at = spill_mod.manage(self, spill, stop) if active else stop
+            if active:
+                with metrics_mod.span(obs, "spill"):
+                    stop_at = spill_mod.manage(self, spill, stop)
+            else:
+                stop_at = stop
             # whole-host spill residency is only exact with a manage pass
             # between consecutive windows (core/spill.py manage docstring)
             wpd = 1 if spill.count else windows_per_dispatch
-            self.state, mn, press = self._run_to(
-                self.state, self.params, stop_at, wpd
-            )
-            mn, press = int(mn), bool(press)
+            with metrics_mod.span(obs, "dispatch", windows=wpd):
+                self.state, mn, press = self._run_to(
+                    self.state, self.params, stop_at, wpd
+                )
+                mn, press = int(mn), bool(press)
+            if obs is not None:
+                obs.round_done(self)
             if mn >= stop and spill.min_time >= stop and not press:
                 break
             cur = (mn, spill.count, press)
@@ -1676,6 +1749,12 @@ class Simulation:
     def counters(self) -> dict[str, int]:
         c = jax.device_get(self.state.counters)
         return {k: int(v) for k, v in c.__dict__.items()}
+
+    def obs_snapshot(self) -> dict:
+        """The device telemetry block (obs/counters.py), normalized across
+        engine layouts; {} when built with obs_counters=False. Read at
+        handoff boundaries only — it device_gets the block."""
+        return obs_mod.snapshot(self.state)
 
     def save_checkpoint(self, path: str) -> None:
         """Snapshot the full device state to disk (resume is bit-exact)."""

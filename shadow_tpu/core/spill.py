@@ -287,6 +287,13 @@ def manage(sim, spill: HostSpill, stop: int) -> int:
         src=jnp.asarray(cols_all[2]), seq=jnp.asarray(cols_all[3]),
         kind=jnp.asarray(cols_all[4]), payload=jnp.asarray(cols_all[5]),
     ))
+    from shadow_tpu.obs import counters as obs_mod
+
+    # telemetry: one spill-tier fire per rebalanced shard (the pool is
+    # being rewritten on the host anyway — no extra sync)
+    sim.state = obs_mod.bump_win(
+        sim.state, obs_mod.WIN_SPILL_FIRES, len(act)
+    )
     # Clamp: resident hosts may run up to spill_min + runahead — a parked
     # event at spill_min emits deliveries no earlier than that (the
     # conservative bound), and parked hosts themselves process nothing

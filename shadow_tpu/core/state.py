@@ -214,6 +214,12 @@ class SimState:
     # Subsystem states keyed by name ("nic", "udp", "tcp", app models...).
     # A plain dict is a pytree node; handlers look up their own slice.
     subs: dict[str, Any] = struct.field(default_factory=dict)
+    # Device telemetry counter block (shadow_tpu.obs.counters.ObsBlock):
+    # window-plane counters + per-host committed-event/virtual-time rows,
+    # updated inside the jitted step with fused adds and read only at
+    # handoff boundaries. None compiles every update out (the bench's
+    # obs-overhead control arm; experimental.obs_counters).
+    obs: Any = None
 
     def with_sub(self, key: str, value) -> "SimState":
         """Functional sub-state update (dict copy; the pytree structure is

@@ -27,6 +27,7 @@ def build_phold_flagship(
     num_shards: int = 1,
     island_mode: str = "vmap",
     exchange_slots: int = 0,
+    obs_counters: bool = True,
 ):
     from shadow_tpu.sim import build_simulation
 
@@ -78,6 +79,7 @@ def build_phold_flagship(
                 # the per-window merge sort lean (the hot cost at scale).
                 "outbox_slots": K,
                 "inbox_slots": 4,
+                "obs_counters": obs_counters,
             },
             "hosts": {
                 "peer": {

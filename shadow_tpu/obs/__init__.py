@@ -1,0 +1,17 @@
+"""Device-resident telemetry plane.
+
+Three pieces (docs/observability.md):
+
+  counters  fixed-layout int64 counter block carried in SimState and
+            incremented inside the jitted window kernel — no host sync
+            until a handoff boundary reads it
+  metrics   host-side registry of counters/gauges/histograms the drivers
+            snapshot at CPU<->TPU handoff boundaries; dumped as versioned
+            JSON (--metrics-out)
+  trace     nestable wall-time spans in Chrome trace-event JSON
+            (--trace-out), loadable in Perfetto
+
+Reference analog: tracker.c per-host byte/CPU accounting, lifted onto the
+device plane; virtual-time-progress statistics follow the PDES literature
+(desynchronization spread as the central health metric).
+"""

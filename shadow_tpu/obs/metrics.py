@@ -1,0 +1,253 @@
+"""Host-side metrics registry: counters / gauges / histograms, snapshotted
+at CPU<->TPU handoff boundaries and dumped as versioned JSON.
+
+Namespaces in the dumped document:
+  engine.*  the engine Counters struct (core/state.py), fetched once
+  obs.*     the device counter block (obs/counters.py): window plane,
+            per-host event totals, virtual-time roughness
+  net.*     device network-plane counters read from SimState subs
+            (nic tx/rx, router CoDel drops, TCP retransmits/timeouts)
+  wall.*    driver wall-time histograms (compile/dispatch/host phases)
+  round.*   per-dispatch-round throughput series
+
+The JSON schema (docs/observability.md) carries `schema_version`;
+`validate_metrics_doc` is the reference validator used by the tier-1
+smoke test and available to downstream consumers.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from contextlib import contextmanager, nullcontext
+
+import numpy as np
+
+from shadow_tpu.obs import counters as obs_counters
+
+SCHEMA_VERSION = 1
+DOC_KIND = "shadow_tpu.metrics"
+
+# Histograms keep exact count/sum/min/max plus a bounded sample buffer for
+# percentiles: past the cap, samples are kept with a deterministic stride
+# (every k-th observation) — no RNG, reruns dump identical documents.
+_SAMPLE_CAP = 4096
+
+
+class Histogram:
+    def __init__(self):
+        self.count = 0
+        self.total = 0.0
+        self.min = None
+        self.max = None
+        self._samples: list[float] = []
+        self._stride = 1
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        self.count += 1
+        self.total += v
+        self.min = v if self.min is None else min(self.min, v)
+        self.max = v if self.max is None else max(self.max, v)
+        if (self.count - 1) % self._stride == 0:
+            self._samples.append(v)
+            if len(self._samples) >= _SAMPLE_CAP:
+                # decimate in place, double the stride
+                self._samples = self._samples[::2]
+                self._stride *= 2
+
+    def summary(self) -> dict:
+        if self.count == 0:
+            return {"count": 0, "sum": 0.0, "min": 0.0, "max": 0.0,
+                    "mean": 0.0, "p50": 0.0, "p90": 0.0, "p99": 0.0}
+        s = np.asarray(self._samples, dtype=np.float64)
+        p50, p90, p99 = np.percentile(s, [50, 90, 99])
+        return {
+            "count": int(self.count),
+            "sum": float(self.total),
+            "min": float(self.min),
+            "max": float(self.max),
+            "mean": float(self.total / self.count),
+            "p50": float(p50),
+            "p90": float(p90),
+            "p99": float(p99),
+        }
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self.counters: dict[str, int] = {}
+        self.gauges: dict[str, float | int] = {}
+        self._hists: dict[str, Histogram] = {}
+
+    def counter_set(self, name: str, value: int) -> None:
+        self.counters[name] = int(value)
+
+    def counter_add(self, name: str, delta: int) -> None:
+        self.counters[name] = self.counters.get(name, 0) + int(delta)
+
+    def gauge_set(self, name: str, value) -> None:
+        self.gauges[name] = value
+
+    def histogram(self, name: str) -> Histogram:
+        h = self._hists.get(name)
+        if h is None:
+            h = self._hists[name] = Histogram()
+        return h
+
+    def to_doc(self, meta: dict | None = None) -> dict:
+        return {
+            "kind": DOC_KIND,
+            "schema_version": SCHEMA_VERSION,
+            "created_unix": time.time(),
+            "meta": dict(meta or {}),
+            "counters": dict(self.counters),
+            "gauges": dict(self.gauges),
+            "histograms": {
+                k: h.summary() for k, h in sorted(self._hists.items())
+            },
+        }
+
+    def dump(self, path: str, meta: dict | None = None) -> dict:
+        doc = self.to_doc(meta)
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=1)
+            f.write("\n")
+        return doc
+
+
+_HIST_KEYS = {"count", "sum", "min", "max", "mean", "p50", "p90", "p99"}
+
+
+def validate_metrics_doc(doc: dict) -> None:
+    """Raise ValueError unless `doc` conforms to the documented schema
+    (docs/observability.md). The tier-1 smoke test runs this on the
+    --metrics-out output of the flagship tiny config."""
+    if not isinstance(doc, dict):
+        raise ValueError("metrics doc must be a JSON object")
+    if doc.get("kind") != DOC_KIND:
+        raise ValueError(f"metrics doc kind {doc.get('kind')!r} != {DOC_KIND!r}")
+    if doc.get("schema_version") != SCHEMA_VERSION:
+        raise ValueError(
+            f"metrics schema_version {doc.get('schema_version')!r} != "
+            f"{SCHEMA_VERSION}"
+        )
+    for section in ("meta", "counters", "gauges", "histograms"):
+        if not isinstance(doc.get(section), dict):
+            raise ValueError(f"metrics doc section {section!r} missing or not an object")
+    for k, v in doc["counters"].items():
+        if not isinstance(v, int) or isinstance(v, bool):
+            raise ValueError(f"counter {k!r} must be an integer, got {v!r}")
+    for k, v in doc["gauges"].items():
+        if not isinstance(v, (int, float)) or isinstance(v, bool):
+            raise ValueError(f"gauge {k!r} must be a number, got {v!r}")
+    for k, h in doc["histograms"].items():
+        if not isinstance(h, dict) or not _HIST_KEYS <= set(h):
+            raise ValueError(
+                f"histogram {k!r} must carry keys {sorted(_HIST_KEYS)}"
+            )
+
+
+def _sub_counter(reg: MetricsRegistry, sub, prefix: str, fields) -> None:
+    for f in fields:
+        v = getattr(sub, f, None)
+        if v is not None:
+            reg.counter_set(f"{prefix}.{f}", int(np.sum(np.asarray(v))))
+
+
+def snapshot_device(sim, reg: MetricsRegistry) -> None:
+    """Read every device-resident counter plane at a handoff boundary:
+    engine Counters, the obs block, and the net-plane subs. One pass, no
+    mid-window syncs — callers invoke this only between dispatches or at
+    the end of a run."""
+    import jax
+
+    for k, v in sim.counters().items():
+        reg.counter_set(f"engine.{k}", v)
+    snap = obs_counters.snapshot(sim.state)
+    if snap:
+        for k, v in snap["win"].items():
+            reg.counter_set(f"obs.{k}", v)
+        he = snap["host_events"]
+        reg.counter_set("obs.host_events_total", int(he.sum()))
+        reg.gauge_set("obs.host_events_min", int(he.min()))
+        reg.gauge_set("obs.host_events_max", int(he.max()))
+        reg.gauge_set("obs.host_events_mean", float(he.mean()))
+        for k, v in obs_counters.vtime_stats(snap["host_last_t"]).items():
+            reg.gauge_set(f"vtime.{k}", v)
+    subs = sim.state.subs
+    nic = subs.get("nic")
+    if nic is not None:
+        nic = jax.device_get(nic)
+        _sub_counter(reg, nic, "net.nic",
+                     ("tx_packets", "tx_bytes", "rx_packets", "rx_bytes",
+                      "sendq_dropped"))
+    router = subs.get("router")
+    if router is not None:
+        _sub_counter(reg, jax.device_get(router), "net.router",
+                     ("codel_dropped",))
+    tcp = subs.get("tcp")
+    if tcp is not None:
+        _sub_counter(reg, jax.device_get(tcp), "net.tcp",
+                     ("retransmits", "timeouts", "rtx_fast", "rtx_sack",
+                      "rtx_walk", "drop_no_socket", "drop_ooo",
+                      "accept_overflow"))
+    reg.gauge_set("sim.num_hosts", int(sim.num_hosts))
+    reg.gauge_set("sim.runahead_ns", int(sim.runahead))
+    for k, v in sim.spill_stats().items():
+        reg.counter_set(f"spill.{k}", int(v))
+
+
+class ObsSession:
+    """The driver-facing handle: one per run, attached as
+    `sim.obs_session`. Bundles the metrics registry with an optional
+    Chrome tracer; the engine drivers call `span()` around each phase and
+    `round_done()` after each dispatch round's handoff sync."""
+
+    def __init__(self, metrics: MetricsRegistry | None = None, tracer=None):
+        self.metrics = metrics or MetricsRegistry()
+        self.tracer = tracer
+        self._last_events = 0
+        self._last_wall = time.perf_counter()
+        self._dispatches = 0
+
+    @contextmanager
+    def span(self, name: str, **args):
+        """Wall-time span: observed into `wall.{name}_s` and (when tracing)
+        emitted as a Chrome complete event. The FIRST dispatch span also
+        lands in `wall.first_dispatch_s` — it includes XLA compilation."""
+        t0 = time.perf_counter()
+        tr = self.tracer.span(name, **args) if self.tracer else nullcontext()
+        with tr:
+            yield
+        dt = time.perf_counter() - t0
+        self.metrics.histogram(f"wall.{name}_s").observe(dt)
+        if name == "dispatch":
+            self._dispatches += 1
+            if self._dispatches == 1:
+                self.metrics.gauge_set("wall.first_dispatch_s", dt)
+
+    def round_done(self, sim) -> None:
+        """Per-round throughput sample, taken at the handoff boundary the
+        driver already synced at (the scalar frontier fetch)."""
+        now = time.perf_counter()
+        ev = sim.counters()["events_committed"]
+        dt = now - self._last_wall
+        if dt > 0 and ev > self._last_events:
+            self.metrics.histogram("round.events_per_sec").observe(
+                (ev - self._last_events) / dt
+            )
+        if self.tracer:
+            self.tracer.counter(
+                "progress", {"events_committed": int(ev)}
+            )
+        self._last_events, self._last_wall = ev, now
+
+    def finalize(self, sim) -> None:
+        snapshot_device(sim, self.metrics)
+
+
+def span(session: ObsSession | None, name: str, **args):
+    """Null-safe span: drivers call this unconditionally; with no session
+    attached it is a nullcontext — zero overhead on the default path."""
+    return session.span(name, **args) if session is not None else nullcontext()

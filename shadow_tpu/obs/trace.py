@@ -1,0 +1,86 @@
+"""Chrome trace-event export: nestable wall-time spans, Perfetto-loadable.
+
+Format: the Trace Event JSON object form — {"traceEvents": [...],
+"displayTimeUnit": "ms", "metadata": {...}} — with complete ("X") events
+for spans, instant ("i") events for markers, and counter ("C") events for
+progress series. Timestamps are microseconds since tracer creation.
+
+The tracer is driver-plane only (wall time, host process); device-plane
+telemetry lives in obs/counters.py. Spans nest by call structure:
+round -> window -> dispatch / host-exchange / spill.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from contextlib import contextmanager
+
+FORMAT = "chrome-trace-events"
+VERSION = 1
+
+
+class ChromeTracer:
+    """Collects trace events in memory; write() dumps the JSON document.
+
+    Single-threaded by design (the drivers are): every span lands on one
+    tid and nests by strict LIFO, which is exactly what the complete-event
+    renderer expects.
+    """
+
+    def __init__(self, process_name: str = "shadow_tpu"):
+        self._t0 = time.perf_counter()
+        self.events: list[dict] = []
+        self._depth = 0
+        self.events.append({
+            "name": "process_name", "ph": "M", "pid": 0, "tid": 0,
+            "args": {"name": process_name},
+        })
+
+    def _now_us(self) -> float:
+        return (time.perf_counter() - self._t0) * 1e6
+
+    @contextmanager
+    def span(self, name: str, cat: str = "sim", **args):
+        """Nestable wall-time span emitted as one complete ("X") event."""
+        t0 = self._now_us()
+        self._depth += 1
+        try:
+            yield
+        finally:
+            self._depth -= 1
+            ev = {
+                "name": name, "cat": cat, "ph": "X", "pid": 0, "tid": 0,
+                "ts": t0, "dur": self._now_us() - t0,
+            }
+            if args:
+                ev["args"] = args
+            self.events.append(ev)
+
+    def instant(self, name: str, cat: str = "sim", **args) -> None:
+        ev = {
+            "name": name, "cat": cat, "ph": "i", "s": "t",
+            "pid": 0, "tid": 0, "ts": self._now_us(),
+        }
+        if args:
+            ev["args"] = args
+        self.events.append(ev)
+
+    def counter(self, name: str, values: dict) -> None:
+        """Counter ("C") sample: Perfetto draws each key as a series."""
+        self.events.append({
+            "name": name, "ph": "C", "pid": 0, "tid": 0,
+            "ts": self._now_us(), "args": dict(values),
+        })
+
+    def to_doc(self) -> dict:
+        return {
+            "displayTimeUnit": "ms",
+            "metadata": {"format": FORMAT, "version": VERSION},
+            "traceEvents": list(self.events),
+        }
+
+    def write(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(self.to_doc(), f)
+            f.write("\n")

@@ -121,12 +121,25 @@ def islandize_state(state: SimState, S: int, C_shard: int) -> SimState:
     subs = jax.tree.map(lambda x: _split_host_leaf(x, S, H), state.subs)
     counters = jax.tree.map(lambda x: _split_host_leaf(x, S, H),
                             state.counters)
+    obs = state.obs
+    if obs is not None:
+        # telemetry block: host rows block-partition like everything else;
+        # the window-plane row is per-shard (the kernel scales shared
+        # bumps by axis_index==0, so the fetch-time sum matches the
+        # global engine's counts)
+        obs = obs.replace(
+            win=jnp.zeros((S,) + obs.win.shape, obs.win.dtype)
+            .at[0].set(obs.win),
+            host_events=obs.host_events.reshape((S, Hl)),
+            host_last_t=obs.host_last_t.reshape((S, Hl)),
+        )
     bcast = lambda v: jnp.broadcast_to(jnp.asarray(v), (S,))  # noqa: E731
     return state.replace(
         pool=new_pool,
         host=host,
         subs=subs,
         counters=counters,
+        obs=obs,
         rng_keys=state.rng_keys.reshape((S, Hl) + state.rng_keys.shape[1:]),
         now=bcast(state.now),
         xmit_min=bcast(state.xmit_min),
@@ -433,6 +446,14 @@ class IslandSimulation(Simulation):
                 and x.shape[0] == S and x.shape[1] == Hl else x,
                 self.state.subs,
             ),
+            obs=(
+                self.state.obs.replace(
+                    host_events=perm(self.state.obs.host_events),
+                    host_last_t=perm(self.state.obs.host_last_t),
+                )
+                if self.state.obs is not None
+                else None
+            ),
             rng_keys=perm(self.state.rng_keys),
         )
 
@@ -505,25 +526,31 @@ class IslandSimulation(Simulation):
 
     def run(self, until=None, windows_per_dispatch: int = 64) -> None:
         from shadow_tpu.core import spill as spill_mod
+        from shadow_tpu.obs import metrics as metrics_mod
 
         stop = self.stop_time if until is None else min(until, self.stop_time)
         spill = self._spill_store()
+        obs = self.obs_session
         last = None
         while True:
             if (last is not None and last[2]) or spill.count:
-                self._maybe_rebalance()
-                stop_at = spill_mod.manage(self, spill, stop)
+                with metrics_mod.span(obs, "spill"):
+                    self._maybe_rebalance()
+                    stop_at = spill_mod.manage(self, spill, stop)
             else:
                 stop_at = stop
             # single-window dispatches while the spill is active (exactness
             # requires a manage pass between windows — core/spill.py)
             wpd = 1 if spill.count else windows_per_dispatch
-            self.state, mn, press, w = self._run_to(
-                self.state, self.params, stop_at, wpd
-            )
-            mn = int(np.min(np.asarray(mn)))
-            press = bool(np.max(np.asarray(press)))
+            with metrics_mod.span(obs, "dispatch", windows=wpd):
+                self.state, mn, press, w = self._run_to(
+                    self.state, self.params, stop_at, wpd
+                )
+                mn = int(np.min(np.asarray(mn)))
+                press = bool(np.max(np.asarray(press)))
             self.windows_run += int(np.max(np.asarray(w)))
+            if obs is not None:
+                obs.round_done(self)
             if mn >= stop and spill.min_time >= stop and not press:
                 break
             cur = (mn, spill.count, press)
@@ -537,13 +564,16 @@ class IslandSimulation(Simulation):
 
     def run_stepwise(self, until=None) -> int:
         from shadow_tpu.core import spill as spill_mod
+        from shadow_tpu.obs import metrics as metrics_mod
 
         stop = self.stop_time if until is None else min(until, self.stop_time)
         spill = self._spill_store()
+        obs = self.obs_session
         windows = 0
         stall = 0
         while True:
-            stop_at = spill_mod.manage(self, spill, stop)
+            with metrics_mod.span(obs, "spill"):
+                stop_at = spill_mod.manage(self, spill, stop)
             min_next = int(jax.device_get(jnp.min(self.state.pool.time)))
             if min_next >= stop_at:
                 if min_next >= stop and spill.min_time >= stop:
@@ -562,7 +592,8 @@ class IslandSimulation(Simulation):
                 jnp.min(self.state.exch_deferred_min)
             ))
             we = min(ws + self.runahead, stop_at, clamp)
-            self.state, mn = self._step(self.state, self.params, ws, we)
+            with metrics_mod.span(obs, "dispatch", windows=1):
+                self.state, mn = self._step(self.state, self.params, ws, we)
             windows += 1
             self.windows_run += 1
         return windows
@@ -674,6 +705,10 @@ class IslandSimulation(Simulation):
         self.state = self.state.replace(
             host=self.state.host.replace(done_t=neg1)
         )
+        from shadow_tpu.obs import counters as obs_mod
+        from shadow_tpu.obs import metrics as metrics_mod
+
+        obs = self.obs_session
         min_next = int(jax.device_get(jnp.min(self.state.pool.time)))
         while min_next < stop:
             ws = min_next
@@ -684,10 +719,14 @@ class IslandSimulation(Simulation):
             if floor <= ws:
                 # in-transit deferred row parked AT the frontier: null
                 # conservative window to retry the exchange
-                self.state, mn = self._step(
-                    self.state, self.params, ws, ws
+                with metrics_mod.span(obs, "dispatch", null_window=1):
+                    self.state, mn = self._step(
+                        self.state, self.params, ws, ws
+                    )
+                    min_next = int(np.min(np.asarray(mn)))
+                self.state = obs_mod.bump_win(
+                    self.state, obs_mod.WIN_OPT_STALLS
                 )
-                min_next = int(np.min(np.asarray(mn)))
                 self.windows_run += 1  # one exchange round dispatched
                 continue
             # never past stop (the conservative schedule's end), even when
@@ -696,6 +735,7 @@ class IslandSimulation(Simulation):
             we = min(max(min(ws + factor * cons, stop), floor), stop)
             base = self.state  # rollback snapshot (done_t already reset)
             rb0 = rollbacks
+            shrinks = 0
             never = int(simtime.NEVER)
             while True:  # attempt [ws, we); shrink on violation
                 # host-driven sub-step loop (see _ensure_optimistic): one
@@ -715,26 +755,35 @@ class IslandSimulation(Simulation):
                         # genuinely enormous window: shrink to the
                         # reached frontier, retry from the snapshot
                         break
-                    st, mn, vl = self._attempt(
-                        st, self.params, max(mn_i, ws), we
-                    )
-                    mn_i = int(np.min(np.asarray(mn)))
-                    viol = int(np.min(np.asarray(vl)))
+                    with metrics_mod.span(obs, "dispatch"):
+                        st, mn, vl = self._attempt(
+                            st, self.params, max(mn_i, ws), we
+                        )
+                        mn_i = int(np.min(np.asarray(mn)))
+                        viol = int(np.min(np.asarray(vl)))
                     k += 1
                 if viol >= never and mn_i < we and k >= _MAX_SUBSTEPS:
                     we = mn_i
+                    shrinks += 1
                     continue
                 if viol >= never or we <= floor:
                     break
                 rollbacks += 1
+                shrinks += 1
+                if obs is not None and obs.tracer:
+                    obs.tracer.instant("rollback", viol_ns=viol)
                 we = min(max(viol, floor), stop)
             # exchange rounds of the ACCEPTED attempt only: rolled-back
             # sub-steps' exchange counters are discarded with the rollback,
             # and suggest_exchange_slots normalizes sent/windows_run
             self.windows_run += k
+            st = obs_mod.bump_win(st, obs_mod.WIN_ROLLBACKS, rollbacks - rb0)
+            st = obs_mod.bump_win(st, obs_mod.WIN_SHRINKS, shrinks)
             self.state = st.replace(host=st.host.replace(done_t=neg1))
             min_next = mn_i
             windows += 1
+            if obs is not None:
+                obs.round_done(self)
             if adaptive:
                 factor, streak = self.adapt_window_factor(
                     factor, streak, rollbacks > rb0, window_factor

@@ -255,6 +255,7 @@ def build_simulation(source) -> Simulation:
         payload_words=payload_words,
         bulk_gate=bulk_gate,
         bulk_self_excluded=bulk_self_excluded,
+        obs_counters=cfg.experimental.obs_counters,
     )
     # attach build artifacts for inspection/observability
     sim.config = cfg

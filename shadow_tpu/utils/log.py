@@ -45,6 +45,18 @@ def parse_level(name: str) -> int:
         ) from None
 
 
+def _fmt_msg(msg: str, args: tuple) -> str:
+    """%-format only when args are present; a message whose literal '%'
+    doesn't match the args (URLs, \"50% full\" with trailing args) must
+    never crash the logger — fall back to appending the args."""
+    if not args:
+        return msg
+    try:
+        return msg % args
+    except (TypeError, ValueError):
+        return f"{msg} {' '.join(str(a) for a in args)}"
+
+
 def _fmt_time(ns: int) -> str:
     """ns → HH:MM:SS.micros (log_format.md sim-time shape)."""
     us, _ = divmod(int(ns), 1_000)
@@ -71,8 +83,7 @@ class SimLogger:
     def log(self, level: int, msg: str, *args, host: str | None = None) -> None:
         if level < self.level:
             return
-        if args:
-            msg = msg % args
+        msg = _fmt_msg(msg, args)
         wall = wall_time.monotonic() - self._t0
         sim = self.sim_now_fn()
         ctx = host or self.host
@@ -103,7 +114,7 @@ class SimLogger:
 
     def panic(self, msg, *a, **kw):
         self.log(PANIC, msg, *a, **kw)
-        raise RuntimeError(msg % a if a else msg)
+        raise RuntimeError(_fmt_msg(msg, a))
 
 
 # module-level default logger (the reference's single global logger)

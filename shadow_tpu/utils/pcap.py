@@ -13,24 +13,37 @@ import struct
 
 LINKTYPE_RAW = 101  # packets start with the IPv4 header
 
+# classic pcap magics: the second-field granularity of every record
+MAGIC_USEC = 0xA1B2C3D4  # microsecond timestamps (the historical default)
+MAGIC_NSEC = 0xA1B23C4D  # nanosecond timestamps (libpcap >= 1.5 readers)
+
 _PROTO_UDP = 17
 _PROTO_TCP = 6
 
 
 class PcapWriter:
-    """One capture file (classic pcap format, microsecond timestamps)."""
+    """One capture file (classic pcap format).
 
-    def __init__(self, path: str):
+    The engine stamps packets in nanoseconds; the default microsecond
+    records truncate that. ``nanosecond=True`` opts into the
+    nanosecond-resolution magic (0xA1B23C4D) so captures round-trip the
+    engine's timestamps exactly — Wireshark/tshark read both.
+    """
+
+    def __init__(self, path: str, *, nanosecond: bool = False):
         self._f = open(path, "wb")
+        self._ns = bool(nanosecond)
+        magic = MAGIC_NSEC if self._ns else MAGIC_USEC
         # magic, v2.4, thiszone=0, sigfigs=0, snaplen, linktype
         self._f.write(
-            struct.pack("<IHHiIII", 0xA1B2C3D4, 2, 4, 0, 0, 65535, LINKTYPE_RAW)
+            struct.pack("<IHHiIII", magic, 2, 4, 0, 0, 65535, LINKTYPE_RAW)
         )
 
     def _record(self, time_ns: int, data: bytes) -> None:
         sec, ns = divmod(int(time_ns), 1_000_000_000)
+        frac = ns if self._ns else ns // 1000
         self._f.write(
-            struct.pack("<IIII", sec, ns // 1000, len(data), len(data))
+            struct.pack("<IIII", sec, frac, len(data), len(data))
         )
         self._f.write(data)
 

@@ -43,18 +43,41 @@ def test_logger_panic_raises():
         lg.panic("boom")
 
 
+def test_logger_literal_percent_never_crashes():
+    """A literal '%' in the message must never raise: no-args messages go
+    out verbatim, mismatched format args fall back to being appended."""
+    buf = io.StringIO()
+    lg = log_mod.SimLogger(stream=buf, level=log_mod.INFO)
+    lg.info("queue 50% full")  # no args: no formatting applied
+    lg.info("fetching http://x/?a=%b0")  # '%b' is not a format code
+    lg.info("queue 50% full on %s", "peer2")  # '% f' breaks the format
+    lg.warning("count %d of %d", 3)  # too few args
+    out = buf.getvalue()
+    assert "queue 50% full" in out
+    assert "http://x/?a=%b0" in out
+    assert "peer2" in out  # mismatched args appended, not lost
+    assert "3" in out
+    with pytest.raises(RuntimeError):
+        lg.panic("dying at 99% with %s", "x", "y")  # must still raise
+
+
 def _parse_pcap(path):
+    """Classic pcap reader for both timestamp magics (pcap.MAGIC_USEC /
+    MAGIC_NSEC); packet timestamps come back in NANOSECONDS either way."""
     raw = open(path, "rb").read()
     magic, _maj, _min, _tz, _sf, _snap, link = struct.unpack(
         "<IHHiIII", raw[:24]
     )
-    assert magic == 0xA1B2C3D4
+    assert magic in (0xA1B2C3D4, 0xA1B23C4D)
+    frac_ns = 1 if magic == 0xA1B23C4D else 1_000
     off = 24
     pkts = []
     while off < len(raw):
-        sec, usec, caplen, origlen = struct.unpack("<IIII", raw[off:off + 16])
+        sec, frac, caplen, origlen = struct.unpack("<IIII", raw[off:off + 16])
         off += 16
-        pkts.append((sec * 1_000_000 + usec, raw[off:off + caplen]))
+        pkts.append(
+            (sec * 1_000_000_000 + frac * frac_ns, raw[off:off + caplen])
+        )
         off += caplen
     return link, pkts
 
@@ -75,7 +98,7 @@ def test_pcap_writer_roundtrip(tmp_path):
     assert link == 101  # LINKTYPE_RAW
     assert len(pkts) == 2
     ts, ip = pkts[0]
-    assert ts == 1_500_000
+    assert ts == 1_500_000_000
     assert ip[0] == 0x45  # IPv4, IHL 5
     assert ip[9] == 17  # UDP
     assert ip[-5:] == b"hello"
@@ -85,6 +108,26 @@ def test_pcap_writer_roundtrip(tmp_path):
     assert tcp[9] == 6  # TCP
     seq = struct.unpack(">I", tcp[24:28])[0]
     assert seq == 7
+
+
+def test_pcap_writer_nanosecond_mode(tmp_path):
+    """Opt-in ns-resolution captures round-trip the engine's ns stamps
+    exactly (the default microsecond magic truncates them)."""
+    t_ns = 1_500_000_123  # not a whole microsecond
+    mk = lambda name, **kw: tmp_path / name  # noqa: E731
+    us_p, ns_p = mk("us.pcap"), mk("ns.pcap")
+    for path, nanos in ((us_p, False), (ns_p, True)):
+        with PcapWriter(str(path), nanosecond=nanos) as w:
+            w.write_packet(
+                t_ns, proto="udp", src_ip=1, src_port=1, dst_ip=2,
+                dst_port=2, payload=b"p",
+            )
+    _, us_pkts = _parse_pcap(str(us_p))
+    _, ns_pkts = _parse_pcap(str(ns_p))
+    assert us_pkts[0][0] == 1_500_000_000  # truncated to us
+    assert ns_pkts[0][0] == t_ns  # exact
+    raw = open(ns_p, "rb").read()
+    assert struct.unpack("<I", raw[:4])[0] == 0xA1B23C4D
 
 
 @pytest.mark.skipif(
@@ -113,7 +156,7 @@ def test_driver_tracker_and_pcap(tmp_path, apps):
     link, pkts = _parse_pcap(str(tmp_path / "pcap" / "client.pcap"))
     assert len(pkts) == 6  # 3 tx + 3 rx at the client
     # capture timestamps are sim time: first ping at t=1s exactly
-    assert pkts[0][0] == 1_000_000
+    assert pkts[0][0] == 1_000_000_000
 
 
 def test_device_tracker_counts():
@@ -157,18 +200,22 @@ hosts:
     assert t["rx_bytes"][3] > 0
 
 
-def test_parse_sim_log_tool():
-    """tools/parse_sim_log.py digests logger output into structured JSON
-    (reference analog: src/tools/parse-shadow.py)."""
+def _load_tool(name):
     import importlib.util
     import pathlib
 
     spec = importlib.util.spec_from_file_location(
-        "parse_sim_log",
-        pathlib.Path(__file__).parent.parent / "tools" / "parse_sim_log.py",
+        name, pathlib.Path(__file__).parent.parent / "tools" / f"{name}.py"
     )
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
+    return mod
+
+
+def test_parse_sim_log_tool():
+    """tools/parse_sim_log.py digests logger output into structured JSON
+    (reference analog: src/tools/parse-shadow.py)."""
+    mod = _load_tool("parse_sim_log")
 
     lines = [
         "heartbeat: sim 2.000s, 53 syscalls, 4 packets, wall 0.2s",
@@ -188,6 +235,21 @@ def test_parse_sim_log_tool():
     assert doc["process_exits"][0]["exit_code"] == 0
     assert doc["syscall_counts"] == {"read": 8, "resolve_name": 1}
     assert doc["warnings"][0]["level"] == "warning"
+
+
+def test_parse_sim_log_malformed_line_errors_cleanly():
+    """A line that matches the log shape but whose fields do not parse
+    raises ParseError carrying the line number — the CLI turns that into
+    a nonzero exit with a clear message, not a bare traceback."""
+    mod = _load_tool("parse_sim_log")
+    lines = [
+        "00:00:01.0 00:00:02.0 [debug] [h] process x.0 exited with 0",
+        "00:00:01.1 00:00:02.1 [debug] [h] process y.0 exited with signal",
+    ]
+    with pytest.raises(mod.ParseError) as e:
+        mod.parse(lines)
+    assert e.value.lineno == 2
+    assert "exited with signal" in str(e.value)
 
 
 def test_packet_breadcrumb_trails():
@@ -253,3 +315,147 @@ def test_packet_breadcrumb_trails():
     # report helper decodes
     rep = pds_mod.drop_report(sim)
     assert rep and all("trail" in e for e in rep)
+
+
+# ---------------------------------------------------------------------------
+# Device telemetry plane (shadow_tpu/obs): counter block, metrics JSON,
+# Chrome-trace spans — docs/observability.md
+# ---------------------------------------------------------------------------
+
+_UDP_TINY_YAML = """
+general:
+  stop_time: 3
+  seed: 2
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        node [ id 0 bandwidth_down "100 Mbit" bandwidth_up "100 Mbit" ]
+        edge [ source 0 target 0 latency "10 ms" packet_loss 0.0 ]
+      ]
+experimental:
+  event_capacity: 2048
+  events_per_host_per_window: 8
+hosts:
+  server:
+    app_model: udp_flood
+    app_options: {role: server}
+  client:
+    quantity: 3
+    app_model: udp_flood
+    app_options: {interval: "100 ms", size: 600, runtime: 1}
+"""
+
+
+def test_counter_parity_conservative_vs_optimistic():
+    """Same seed + config under the conservative and optimistic engines
+    must report identical committed-event and packet counters (rollback
+    accounting may differ) — the device counter block included."""
+    from shadow_tpu.sim import build_simulation
+
+    cons = build_simulation(_UDP_TINY_YAML)
+    cons.run()
+    opt = build_simulation(_UDP_TINY_YAML)
+    opt.run_optimistic()
+    cc, co = cons.counters(), opt.counters()
+    for k in ("events_committed", "events_emitted", "packets_sent",
+              "packets_delivered", "packets_dropped_loss", "bytes_sent",
+              "bytes_delivered"):
+        assert cc[k] == co[k], (k, cc[k], co[k])
+    sc, so = cons.obs_snapshot(), opt.obs_snapshot()
+    assert (sc["host_events"] == so["host_events"]).all()
+    assert (sc["host_last_t"] == so["host_last_t"]).all()
+    assert sc["win"]["windows_run"] > 0
+    # the conservative run never rolls back; the block says so
+    assert sc["win"]["rollbacks"] == 0 and sc["win"]["window_shrinks"] == 0
+
+
+def test_obs_block_disabled_compiles_out():
+    """experimental.obs_counters: false removes the block entirely — the
+    bench's overhead-control arm — and snapshots degrade to {}."""
+    from shadow_tpu.sim import build_simulation
+
+    yaml = _UDP_TINY_YAML.replace(
+        "experimental:", "experimental:\n  obs_counters: false"
+    )
+    sim = build_simulation(yaml)
+    assert sim.state.obs is None
+    sim.run(until=1_000_000_000)
+    assert sim.obs_snapshot() == {}
+
+
+def test_metrics_and_trace_smoke_cli(tmp_path):
+    """Tier-1 smoke (ISSUE 1 gate): the flagship tiny config run through
+    the CLI with --metrics-out/--trace-out produces schema-valid metrics
+    JSON and a Perfetto-loadable Chrome trace, and tools/trace_summary.py
+    digests the trace."""
+    import json
+
+    from shadow_tpu import flagship
+    from shadow_tpu.__main__ import main as cli_main
+    from shadow_tpu.obs import metrics as obs_metrics
+
+    cfg = tmp_path / "flagship_tiny.yaml"
+    cfg.write_text(
+        "general: {stop_time: 2, seed: 3}\n"
+        "network:\n  graph:\n    type: gml\n    inline: |\n"
+        + "".join(f"      {ln}\n"
+                  for ln in flagship.SELF_LOOP_50MS_GML.splitlines())
+        + "experimental:\n"
+        "  event_capacity: 2048\n"
+        "  events_per_host_per_window: 18\n"
+        "  outbox_slots: 18\n"
+        "  inbox_slots: 4\n"
+        "hosts:\n"
+        "  peer:\n"
+        "    quantity: 32\n"
+        "    app_model: phold\n"
+        "    app_options: {msgload: 2, runtime: 1}\n"
+    )
+    m_out = tmp_path / "metrics.json"
+    t_out = tmp_path / "trace.json"
+    rc = cli_main([
+        str(cfg), "-d", str(tmp_path / "data"),
+        "--metrics-out", str(m_out), "--trace-out", str(t_out),
+    ])
+    assert rc == 0
+
+    doc = json.loads(m_out.read_text())
+    obs_metrics.validate_metrics_doc(doc)  # the documented schema
+    assert doc["counters"]["engine.events_committed"] > 0
+    assert doc["counters"]["obs.windows_run"] > 0
+    assert doc["counters"]["obs.matrix_dispatches"] \
+        + doc["counters"]["obs.loop_dispatches"] \
+        == doc["counters"]["obs.windows_run"]
+    assert doc["gauges"]["vtime.committed_hosts"] == 32
+    assert doc["histograms"]["wall.dispatch_s"]["count"] > 0
+
+    trace = json.loads(t_out.read_text())
+    spans = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+    assert any(e["name"] == "dispatch" for e in spans)
+    assert all("ts" in e and "dur" in e for e in spans)
+
+    summary = _load_tool("trace_summary")
+    rows, _ = summary.summarize(trace)
+    assert rows and rows[0]["count"] > 0
+    assert summary.main([str(t_out), "-n", "5"]) == 0
+
+
+def test_metrics_schema_validator_rejects_bad_docs():
+    from shadow_tpu.obs import metrics as obs_metrics
+
+    good = obs_metrics.MetricsRegistry()
+    good.counter_set("engine.events_committed", 1)
+    good.histogram("wall.dispatch_s").observe(0.5)
+    doc = good.to_doc()
+    obs_metrics.validate_metrics_doc(doc)
+    with pytest.raises(ValueError):
+        obs_metrics.validate_metrics_doc({**doc, "schema_version": 99})
+    with pytest.raises(ValueError):
+        obs_metrics.validate_metrics_doc(
+            {**doc, "counters": {"x": "not-an-int"}}
+        )
+    with pytest.raises(ValueError):
+        bad_h = {**doc, "histograms": {"h": {"count": 1}}}
+        obs_metrics.validate_metrics_doc(bad_h)

@@ -22,6 +22,21 @@ import json
 import re
 import sys
 
+class ParseError(ValueError):
+    """A line matched the log-line shape but its fields do not parse.
+
+    Carries the 1-based line number and the offending line so the CLI can
+    exit with a clear message instead of a bare traceback.
+    """
+
+    def __init__(self, lineno: int, line: str, why: str):
+        super().__init__(
+            f"malformed log line {lineno}: {why}\n  {line!r}"
+        )
+        self.lineno = lineno
+        self.line = line
+
+
 _TS = r"(\d+:\d+:\d+\.\d+)"
 LOG_RE = re.compile(
     rf"^{_TS} {_TS} \[(\w+)\](?: \[([^\]]+)\])? (.*)$"
@@ -49,7 +64,7 @@ def parse(lines) -> dict:
         "syscall_counts": {},
         "warnings": [],
     }
-    for line in lines:
+    for lineno, line in enumerate(lines, start=1):
         line = line.rstrip("\n")
         m = LOG_RE.match(line)
         if not m:
@@ -60,41 +75,51 @@ def parse(lines) -> dict:
                 )
             continue
         wall, sim, level, host, msg = m.groups()
-        rec_time = {"wall_s": _ts_to_seconds(wall), "sim_s": _ts_to_seconds(sim)}
-        tm = TRACKER_RE.match(msg)
-        if tm and host:
-            out["trackers"].setdefault(host, []).append(
-                {
-                    **rec_time,
-                    "tx_packets": int(tm.group(1)),
-                    "tx_bytes": int(tm.group(2)),
-                    "rx_packets": int(tm.group(3)),
-                    "rx_bytes": int(tm.group(4)),
-                    "dropped_packets": int(tm.group(5)),
-                }
-            )
-            continue
-        em = EXIT_RE.match(msg)
-        if em:
-            out["process_exits"].append(
-                {**rec_time, "process": em.group(1),
-                 "exit_code": None if em.group(2) == "None"
-                 else int(em.group(2))}
-            )
-            continue
-        cm = COUNTS_RE.match(msg)
-        if cm:
-            for part in cm.group(1).split():
-                name, _, count = part.rpartition(":")
-                out["syscall_counts"][name] = int(count)
-            continue
+        try:
+            rec_time = {
+                "wall_s": _ts_to_seconds(wall),
+                "sim_s": _ts_to_seconds(sim),
+            }
+            tm = TRACKER_RE.match(msg)
+            if tm and host:
+                out["trackers"].setdefault(host, []).append(
+                    {
+                        **rec_time,
+                        "tx_packets": int(tm.group(1)),
+                        "tx_bytes": int(tm.group(2)),
+                        "rx_packets": int(tm.group(3)),
+                        "rx_bytes": int(tm.group(4)),
+                        "dropped_packets": int(tm.group(5)),
+                    }
+                )
+                continue
+            em = EXIT_RE.match(msg)
+            if em:
+                out["process_exits"].append(
+                    {**rec_time, "process": em.group(1),
+                     "exit_code": None if em.group(2) == "None"
+                     else int(em.group(2))}
+                )
+                continue
+            cm = COUNTS_RE.match(msg)
+            if cm:
+                for part in cm.group(1).split():
+                    name, _, count = part.rpartition(":")
+                    out["syscall_counts"][name] = int(count)
+                continue
+        except ValueError as e:
+            raise ParseError(lineno, line, str(e)) from None
         if level in ("warning", "error", "panic"):
             out["warnings"].append({**rec_time, "level": level, "msg": msg})
     return out
 
 
 def main() -> int:
-    doc = parse(sys.stdin)
+    try:
+        doc = parse(sys.stdin)
+    except ParseError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
     json.dump(doc, sys.stdout, indent=1)
     sys.stdout.write("\n")
     return 0

@@ -1,0 +1,85 @@
+#!/usr/bin/env python3
+"""Summarize a Chrome trace-event file written by --trace-out.
+
+Aggregates complete ("X") span events by name — count, total/mean/max
+wall milliseconds — and prints the top spans, widest first. Instant and
+counter events are tallied but not timed.
+
+Usage:  python tools/trace_summary.py shadow.trace.json [-n TOP]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def summarize(doc: dict) -> tuple[list[dict], dict[str, int]]:
+    events = doc.get("traceEvents")
+    if not isinstance(events, list):
+        raise ValueError(
+            "not a Chrome trace-event document (no traceEvents array)"
+        )
+    spans: dict[str, dict] = {}
+    other: dict[str, int] = {}
+    for ev in events:
+        ph = ev.get("ph")
+        if ph == "X":
+            s = spans.setdefault(
+                ev.get("name", "?"),
+                {"count": 0, "total_us": 0.0, "max_us": 0.0},
+            )
+            dur = float(ev.get("dur", 0.0))
+            s["count"] += 1
+            s["total_us"] += dur
+            s["max_us"] = max(s["max_us"], dur)
+        elif ph in ("i", "C"):
+            key = f"{'instant' if ph == 'i' else 'counter'}:{ev.get('name', '?')}"
+            other[key] = other.get(key, 0) + 1
+    rows = [
+        {
+            "name": name,
+            "count": s["count"],
+            "total_ms": s["total_us"] / 1e3,
+            "mean_ms": s["total_us"] / s["count"] / 1e3,
+            "max_ms": s["max_us"] / 1e3,
+        }
+        for name, s in spans.items()
+    ]
+    rows.sort(key=lambda r: -r["total_ms"])
+    return rows, other
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("trace", help="trace JSON written by --trace-out")
+    ap.add_argument("-n", "--top", type=int, default=20,
+                    help="spans to print (default 20)")
+    args = ap.parse_args(argv)
+    try:
+        with open(args.trace) as f:
+            doc = json.load(f)
+        rows, other = summarize(doc)
+    except (OSError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if not rows:
+        print("no span events in trace")
+        return 0
+    w = max(len(r["name"]) for r in rows[: args.top])
+    print(f"{'span':<{w}}  {'count':>7}  {'total ms':>10}  "
+          f"{'mean ms':>9}  {'max ms':>9}")
+    for r in rows[: args.top]:
+        print(
+            f"{r['name']:<{w}}  {r['count']:>7}  {r['total_ms']:>10.3f}  "
+            f"{r['mean_ms']:>9.3f}  {r['max_ms']:>9.3f}"
+        )
+    if other:
+        marks = ", ".join(f"{k} x{v}" for k, v in sorted(other.items()))
+        print(f"\nmarkers: {marks}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
