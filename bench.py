@@ -103,6 +103,10 @@ def _enable_compile_cache():
     import jax
 
     cache = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
+    # Create the directory up front: PJRT's lazy mkdir races when several
+    # bench processes (or a bench and a test run) cold-start on a fresh
+    # checkout at once.
+    os.makedirs(cache, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", cache)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
@@ -265,7 +269,11 @@ def _run_stage(stage: str, app_model: str, loss: float, app_options: dict,
     session.finalize(sim)
     doc = session.metrics.to_doc()
     hist = doc["histograms"]
+    gear = sim.gear_stats()
     out["metrics"] = {
+        # which backend actually ran the row: a TPU-worker outage silently
+        # falls back to CPU, and a result row must be attributable
+        "platform": jax.default_backend(),
         "windows_run": doc["counters"].get("obs.windows_run", 0),
         "matrix_dispatches": doc["counters"].get("obs.matrix_dispatches", 0),
         "loop_dispatches": doc["counters"].get("obs.loop_dispatches", 0),
@@ -277,6 +285,12 @@ def _run_stage(stage: str, app_model: str, loss: float, app_options: dict,
         "round_events_per_sec_p50": round(
             hist.get("round.events_per_sec", {}).get("p50", 0.0), 1
         ),
+        # gearbox telemetry (core/gearbox.py): active level, shift count,
+        # and the per-gear dispatch histogram
+        "gear_level": gear["gear_level"],
+        "gear_tiers": gear["gear_tiers"],
+        "gear_shifts": gear["gear_shifts"],
+        "gear_dispatches": gear["gear_dispatches"],
     }
     return out
 
@@ -423,6 +437,64 @@ def stage_obs_overhead(num_hosts: int = 8192, msgload: int = 4,
     }
 
 
+def stage_gear_win(num_hosts: int = 8192, msgload: int = 4, stop_s: int = 4):
+    """Gearing win smoke row (ISSUE 2 acceptance gate): the flagship PHOLD
+    shape with the pool oversized 8× above steady-state occupancy — the
+    burst-provisioned pool every production config carries — run fixed
+    (pool_gears=1) vs geared (pool_gears=3, engages the C/4 tier). Gate:
+    geared per-window wall time ≥ 25% better at occupancy ≤ C/4."""
+    import jax
+
+    from shadow_tpu.core import simtime
+    from shadow_tpu.flagship import build_phold_flagship
+
+    # live population = num_hosts * msgload; capacity 8x above it
+    capacity = 8 * num_hosts * msgload
+
+    def timed(gears: int):
+        sim = build_phold_flagship(
+            num_hosts, msgload=msgload, stop_s=stop_s, runtime_s=stop_s,
+            event_capacity=capacity, pool_gears=gears,
+        )
+        sim.run(until=int(0.2 * simtime.NS_PER_SEC))
+        jax.block_until_ready(sim.state.pool.time)
+        t0 = time.perf_counter()
+        sim.run()
+        jax.block_until_ready(sim.state.pool.time)
+        wall = time.perf_counter() - t0
+        snap = sim.obs_snapshot()
+        windows = snap["win"]["windows_run"] if snap else 0
+        return wall, windows, sim.counters()["events_committed"], \
+            sim.gear_stats()
+
+    # interleave the arms to decorrelate machine drift from the comparison
+    w_fix, n_fix, ev_fix, _ = timed(1)
+    w_gear, n_gear, ev_gear, gear = timed(3)
+    w_fix = min(w_fix, timed(1)[0])
+    w_gear = min(w_gear, timed(3)[0])
+    per_win_fix = w_fix / max(n_fix, 1)
+    per_win_gear = w_gear / max(n_gear, 1)
+    win_pct = (1.0 - per_win_gear / per_win_fix) * 100.0 if per_win_fix else 0.0
+    return {
+        "stage": "gear_win",
+        "hosts": num_hosts,
+        "pool_capacity": capacity,
+        "occupancy": num_hosts * msgload,
+        "events_fixed": int(ev_fix),
+        "events_geared": int(ev_gear),
+        "events_equal": ev_fix == ev_gear,
+        "windows_fixed": int(n_fix),
+        "windows_geared": int(n_gear),
+        "wall_fixed_s": round(w_fix, 3),
+        "wall_geared_s": round(w_gear, 3),
+        "per_window_fixed_ms": round(per_win_fix * 1e3, 4),
+        "per_window_geared_ms": round(per_win_gear * 1e3, 4),
+        "win_pct": round(win_pct, 2),
+        "gate_25pct": win_pct >= 25.0,
+        "gear": gear,
+    }
+
+
 def shard_sweep(shards=(1, 2, 4, 8), out_path: str | None = None):
     """Virtual-islands scaling sweep on ONE chip (VERDICT r4 gate 1c):
     PHOLD 16k and udp_flood_10k at each shard count; one JSON line each.
@@ -481,6 +553,11 @@ def main():
     if "--obs-smoke" in sys.argv:
         # telemetry-plane overhead gate (<= 3% step time with counters on)
         print(json.dumps(_with_backend_retry(stage_obs_overhead)), flush=True)
+        return
+    if "--gear-smoke" in sys.argv:
+        # occupancy-adaptive gearing gate (>= 25% per-window win with the
+        # pool oversized 8x above steady-state occupancy)
+        print(json.dumps(_with_backend_retry(stage_gear_win)), flush=True)
         return
     if "--stages-50k" in sys.argv:
         # BASELINE config 4 rows: both synchronization modes, on the

@@ -65,6 +65,13 @@ def _build_parser() -> argparse.ArgumentParser:
              "(load in Perfetto, or summarize with tools/trace_summary.py); "
              "device plane only",
     )
+    p.add_argument(
+        "--pool-gears", type=int, metavar="N",
+        help="override experimental.pool_gears: compile the window kernel "
+             "at N pool-capacity tiers (C/4, C/2, C for 3) and shift to "
+             "the smallest gear covering live occupancy at each dispatch "
+             "boundary (core/gearbox.py); 1 = single fixed-capacity kernel",
+    )
     return p
 
 
@@ -86,6 +93,10 @@ def _apply_overrides(cfg, args) -> None:
         cfg.general.parallelism = args.parallelism
     if args.progress:
         cfg.general.progress = True
+    if args.pool_gears is not None:
+        if args.pool_gears < 1:
+            raise ValueError("--pool-gears must be >= 1")
+        cfg.experimental.pool_gears = args.pool_gears
 
 
 def _dump_config(cfg) -> str:

@@ -46,6 +46,17 @@ def save(sim, path: str) -> None:
         "now": int(jax.device_get(sim.state.now)),
         "leaves": sorted(arrays),
     }
+    # Pool gearing (core/gearbox.py): the active gear decides the pool
+    # leaves' shapes, so restore must re-bind the same gear before the
+    # shape check. Recorded for every build (pool_gears=1 is a one-tier
+    # ladder whose level is always 0).
+    ladder = getattr(sim, "_gear_ladder", None)
+    if ladder:
+        meta["gear"] = {
+            "level": int(sim._gear),
+            "capacity": int(ladder[sim._gear].capacity),
+            "tiers": len(ladder),
+        }
     arrays["__meta__"] = np.frombuffer(
         json.dumps(meta).encode(), dtype=np.uint8
     )
@@ -76,6 +87,26 @@ def restore(sim, path: str) -> None:
             f"checkpoint has {meta['num_hosts']} hosts, sim has "
             f"{sim.num_hosts} (must be built from the same config)"
         )
+    gear = meta.get("gear")
+    ladder = getattr(sim, "_gear_ladder", None)
+    if gear is not None and ladder:
+        lvl = int(gear["level"])
+        if (
+            len(ladder) != int(gear.get("tiers", len(ladder)))
+            or lvl >= len(ladder)
+            or ladder[lvl].capacity != int(gear["capacity"])
+        ):
+            raise CheckpointError(
+                f"checkpoint gear {gear} does not exist on this build's "
+                f"ladder ({[(s.level, s.capacity) for s in ladder]}); the "
+                f"sim must be built from the same config (including "
+                f"experimental.pool_gears)"
+            )
+        if lvl != sim._gear:
+            # re-bind the checkpointed gear so every pool leaf matches the
+            # recorded shapes; the transitional resize + telemetry bumps
+            # land on state that the leaf restore below replaces wholesale
+            sim._shift_gear(lvl)
     pairs, treedef = _leaf_paths(sim.state)
     with np.load(path) as z:
         want = {k for k, _ in pairs}

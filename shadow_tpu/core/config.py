@@ -147,6 +147,14 @@ class ExperimentalOptions:
     interpose_method: str = "preload"
     # TPU engine static shapes:
     event_capacity: int = 1 << 14  # event-pool rows per shard
+    # Occupancy-adaptive pool gearing (core/gearbox.py): compile the window
+    # kernel at a ladder of pool capacities (pool_gears tiers: C/4, C/2, C
+    # for 3) and let the drivers pick the smallest gear covering live
+    # occupancy plus hysteresis headroom at each dispatch boundary. 1 = a
+    # single fixed-capacity kernel (the pre-gearbox build). Results are
+    # identical either way (capacity only bounds what fits, never the
+    # order); gears only change wall time and compile count.
+    pool_gears: int = 1
     events_per_host_per_window: int = 32  # K: scan depth of the window kernel
     sockets_per_host: int = 8
     router_queue_slots: int = 64  # per-host CoDel ring capacity
@@ -248,10 +256,12 @@ class ExperimentalOptions:
         for name in (
             "event_capacity", "events_per_host_per_window", "sockets_per_host",
             "router_queue_slots", "devices", "inbox_slots", "outbox_slots",
-            "num_shards", "exchange_slots",
+            "num_shards", "exchange_slots", "pool_gears",
         ):
             if name in d:
                 setattr(out, name, int(d[name]))
+        if out.pool_gears < 1:
+            raise ConfigError("experimental.pool_gears must be >= 1")
         if "rebalance" in d:
             out.rebalance = bool(d["rebalance"])
         if "island_mode" in d:

@@ -45,6 +45,7 @@ import jax.numpy as jnp
 import numpy as np
 from flax import struct
 
+from shadow_tpu.core import gearbox
 from shadow_tpu.core import rng as rng_mod
 from shadow_tpu.core import simtime, soa
 from shadow_tpu.core import spill as spill_mod
@@ -1339,11 +1340,14 @@ def make_window_step(
 
                 def _never(_):
                     never = jnp.asarray(NEVER, jnp.int64)
-                    if island is not None:
+                    pcast = getattr(jax.lax, "pcast", None)
+                    if island is not None and pcast is not None:
                         # under shard_map the true branch's output varies
                         # over the islands axis; the constant must be cast
                         # to the same varying type or cond rejects it
-                        never = jax.lax.pcast(
+                        # (jax < 0.7 has no varying-type checker and no
+                        # lax.pcast — the bare constant is already valid)
+                        never = pcast(
                             never, (island.axis,), to="varying"
                         )
                     return never
@@ -1405,6 +1409,7 @@ class Simulation:
         bulk_gate: Callable | None = None,
         bulk_self_excluded: bool = False,
         obs_counters: bool = True,
+        pool_gears: int = 1,
     ):
         # initial_events: (time, dst, src, kind, payload words)
         self.num_hosts = num_hosts
@@ -1413,10 +1418,32 @@ class Simulation:
         if self.runahead <= 0:
             raise ValueError("runahead must be > 0 (min topology latency)")
         self.params = params
-        pool = EventPool.empty(event_capacity, payload_words)
         n0 = len(initial_events or [])
         if n0 > event_capacity:
             raise ValueError("initial events exceed event pool capacity")
+        # Occupancy-adaptive pool gearing (core/gearbox.py): a ladder of
+        # (capacity, dense width) tiers, each compiling its own window
+        # kernel; drivers shift at dispatch boundaries. pool_gears=1 keeps
+        # a single tier at the configured shapes — the pre-gearbox build.
+        self.pool_gears = int(pool_gears)
+        self._gear_ladder = gearbox.build_ladder(
+            self.pool_gears, event_capacity, K, num_hosts, spill_mod.marks
+        )
+        self._gear = (
+            gearbox.target_level(self._gear_ladder, n0)
+            if len(self._gear_ladder) > 1
+            else self._gear_ladder[-1].level
+        )
+        self._shifter = (
+            gearbox.GearShifter(self._gear_ladder)
+            if len(self._gear_ladder) > 1
+            else None
+        )
+        self._gear_shifts = 0
+        self._gear_dispatches: dict[int, int] = {}
+        pool = EventPool.empty(
+            self._gear_ladder[self._gear].capacity, payload_words
+        )
         if initial_events:
             # Assign per-source sequence numbers in list order, like the
             # reference assigns per-source event IDs at push time.
@@ -1481,20 +1508,111 @@ class Simulation:
         # (--metrics-out/--trace-out) or bench; None keeps the run loops on
         # their zero-instrumentation path.
         self.obs_session = None
+        self._gear_fns: dict[int, dict] = {}
+        self._bind_gear()
+
+    # -- gearbox plumbing (core/gearbox.py): one compiled kernel set per
+    # active gear, bound into the attributes every driver (and test, and
+    # procs.bridge) already reads --
+    def _build_gear_fns(self, spec: gearbox.GearSpec) -> dict:
         step = make_window_step(
-            handlers, num_hosts, K=K, B=B, O=O, bulk_kinds=bulk_kinds,
-            matrix_handlers=matrix_handlers, with_cpu_model=with_cpu,
-            bulk_gate=bulk_gate, bulk_self_excluded=bulk_self_excluded,
-            payload_words=payload_words,
+            self.handlers, self.num_hosts, K=spec.K, B=self.B, O=self.O,
+            bulk_kinds=self._bulk_kinds,
+            matrix_handlers=self._matrix_handlers,
+            with_cpu_model=self._with_cpu,
+            bulk_gate=self._bulk_gate,
+            bulk_self_excluded=self._bulk_self_excluded,
+            payload_words=self._payload_words,
         )
+        return {
+            "step_fn": step,
+            "step": jax.jit(step),
+            "run_to": jax.jit(self._make_run_to(step, spec.hi)),
+            "attempt": jax.jit(self._make_attempt(step)),
+        }
+
+    def _bind_gear(self) -> None:
+        spec = self._gear_ladder[self._gear]
+        fns = self._gear_fns.get(spec.level)
+        if fns is None:
+            fns = self._gear_fns[spec.level] = self._build_gear_fns(spec)
         # raw (unjitted) step for callers composing their own fused device
         # loops (e.g. procs.bridge's run-until-output sync loop)
-        self._step_fn = step
-        self._step = jax.jit(step)
-        self._run_to = jax.jit(self._make_run_to(step))
-        self._attempt = jax.jit(self._make_attempt(step))
+        self._step_fn = fns["step_fn"]
+        self._step = fns["step"]
+        self._run_to = fns["run_to"]
+        self._attempt = fns["attempt"]
 
-    def _make_run_to(self, step):
+    def _shift_gear(self, level: int) -> None:
+        """Move the pool to `level`'s capacity (one truncating/padding
+        re-sort — gearbox.resize_pool) and rebind that gear's compiled
+        kernels. Handoff-boundary only: never inside a jitted window loop,
+        and never inside an optimistic attempt (rollback snapshots must
+        keep their shapes)."""
+        spec = self._gear_ladder[level]
+        pool, dropped = gearbox.resize_pool(self.state.pool, spec.capacity)
+        self.state = self.state.replace(
+            pool=pool,
+            counters=self.state.counters.replace(
+                pool_overflow_dropped=(
+                    self.state.counters.pool_overflow_dropped + dropped
+                )
+            ),
+        )
+        self._gear = level
+        self._gear_shifts += 1
+        if self._shifter is not None:
+            self._shifter.reset()
+        self.state = obs_mod.bump_win(self.state, obs_mod.WIN_GEAR_SHIFTS)
+        obs = getattr(self, "obs_session", None)
+        if obs is not None and obs.tracer:
+            obs.tracer.instant(
+                "gear_shift", level=level, capacity=spec.capacity
+            )
+        self._bind_gear()
+
+    def _gear_tick(self, occ: int, press: bool = False,
+                   margin: int = 1) -> bool:
+        """One dispatch-boundary gearing decision; returns True iff the
+        gear changed. No-op (and no occupancy math) on ungeared builds."""
+        if self._shifter is None:
+            return False
+        new = self._shifter.observe(
+            self._gear, int(occ), press=press, margin=margin
+        )
+        if new is None:
+            return False
+        self._shift_gear(new)
+        return True
+
+    def _gear_note_dispatch(self) -> None:
+        self._gear_dispatches[self._gear] = (
+            self._gear_dispatches.get(self._gear, 0) + 1
+        )
+
+    def _pool_occupancy(self) -> int:
+        """Live pool rows — the gearing decision signal for the stepwise
+        and optimistic drivers (the fused driver gets it for free on the
+        run_to sync). One small reduce + fetch per dispatch boundary, paid
+        only on geared builds."""
+        return int(jnp.sum(self.state.pool.time != NEVER))
+
+    def gear_stats(self) -> dict:
+        """Gearbox telemetry for bench rows / metrics dumps: active level,
+        ladder shape, shift count, and the per-gear dispatch histogram."""
+        spec = self._gear_ladder[self._gear]
+        return {
+            "gear_level": self._gear,
+            "gear_tiers": len(self._gear_ladder),
+            "gear_capacity": spec.capacity,
+            "gear_k": spec.K,
+            "gear_shifts": self._gear_shifts,
+            "gear_dispatches": {
+                str(lvl): n for lvl, n in sorted(self._gear_dispatches.items())
+            },
+        }
+
+    def _make_run_to(self, step, hi: int):
         runahead = jnp.int64(self.runahead)
 
         def run_to(state: SimState, params: NetParams, stop, max_windows):
@@ -1503,12 +1621,14 @@ class Simulation:
             dispatches can trip accelerator-runtime watchdogs.
 
             Exits early (third return value True) when pool occupancy
-            crosses the spill red zone, so the driver can drain overflow to
+            crosses the spill red zone — the mark is PER-GEAR (`hi` is the
+            bound gear's) — so the driver can upshift, or drain overflow to
             host memory BEFORE the merge would drop rows (core/spill.py) —
-            one compare per window, no extra sorts."""
+            one compare per window, no extra sorts. The final occupancy
+            rides back as the fourth value: it is the gearing decision
+            signal, fetched on the sync the driver already pays."""
             stop = jnp.asarray(stop, jnp.int64)
             max_windows = jnp.asarray(max_windows, jnp.int32)
-            hi = self._spill_marks()[0]
 
             def cond(c):
                 state, mn, w = c
@@ -1526,8 +1646,8 @@ class Simulation:
             state, mn, _ = jax.lax.while_loop(
                 cond, body, (state, mn0, jnp.int32(0))
             )
-            press = jnp.sum(state.pool.time != NEVER) >= hi
-            return state, mn, press
+            occ = jnp.sum(state.pool.time != NEVER)
+            return state, mn, occ >= hi, occ
 
         return run_to
 
@@ -1539,6 +1659,10 @@ class Simulation:
         windows = 0
         stall = 0
         while True:
+            if self._shifter is not None:
+                # gear decision BEFORE spill manage: an upshift absorbs
+                # red-zone pressure without a host drain episode
+                self._gear_tick(self._pool_occupancy())
             with metrics_mod.span(obs, "spill"):
                 stop_at = spill_mod.manage(self, spill, stop)
             min_next = int(jnp.min(self.state.pool.time))
@@ -1561,6 +1685,7 @@ class Simulation:
             we = min(ws + self.runahead, stop_at)
             with metrics_mod.span(obs, "dispatch", windows=1):
                 self.state, mn = self._step(self.state, self.params, ws, we)
+            self._gear_note_dispatch()
             windows += 1
         return windows
 
@@ -1651,6 +1776,11 @@ class Simulation:
         obs = self.obs_session
         min_next = int(jnp.min(self.state.pool.time))
         while min_next < stop:
+            if self._shifter is not None:
+                # margin=2: a speculative window absorbs several windows'
+                # inflow between decision points, so gear selection keeps
+                # double headroom (core/gearbox.target_level)
+                self._gear_tick(self._pool_occupancy(), margin=2)
             ws = min_next
             we = min(ws + factor * cons, stop)
             base = self.state  # rollback snapshot (done_t already reset)
@@ -1660,6 +1790,22 @@ class Simulation:
                     with metrics_mod.span(obs, "dispatch"):
                         st, mn, viol = self._attempt(base, self.params, ws, we)
                         viol = int(viol)
+                        self._gear_note_dispatch()
+                    if we <= ws + cons and viol < int(simtime.NEVER):
+                        # A conservative-width window is violation-free BY
+                        # CONSTRUCTION (emission time >= ws + runahead >=
+                        # any processed time). A violation here means the
+                        # conservative-width invariant itself is broken —
+                        # committing would silently accept a causally
+                        # -violated window (ADVICE round-5 finding).
+                        raise RuntimeError(
+                            f"speculation violation at t={viol} inside a "
+                            f"conservative-width window [{ws}, {we}): the "
+                            f"conservative-width invariant is broken "
+                            f"(runahead {cons} exceeds a real path "
+                            f"latency, or a handler emitted into the "
+                            f"past); refusing to commit"
+                        )
                     if viol >= int(simtime.NEVER) or we <= ws + cons:
                         break
                     rollbacks += 1
@@ -1684,14 +1830,14 @@ class Simulation:
 
     # -- host-spill tier (core/spill.py): the pool never silently drops --
     def _spill_marks(self) -> tuple[int, int]:
-        """(pressure mark, rebalance fill mark) in pool rows per shard.
+        """(pressure mark, rebalance fill mark) in pool rows per shard —
+        PER-GEAR: the active gear's capacity defines the red zone.
         Pressure must fire while the merge can still absorb one window's
         inflow; the fill mark sits below pressure so a rebalance —
         including a partially-resident giant host's admission — exits the
         red zone and the fused loop keeps running windows."""
-        C = int(self.state.pool.time.shape[-1])
-        hi = C - spill_mod.red_zone(C)
-        return hi, max(1, (3 * hi) // 4)
+        spec = self._gear_ladder[self._gear]
+        return spec.hi, spec.fill
 
     def _spill_store(self):
         if getattr(self, "_spill", None) is None:
@@ -1726,16 +1872,20 @@ class Simulation:
             # between consecutive windows (core/spill.py manage docstring)
             wpd = 1 if spill.count else windows_per_dispatch
             with metrics_mod.span(obs, "dispatch", windows=wpd):
-                self.state, mn, press = self._run_to(
+                self.state, mn, press, occ = self._run_to(
                     self.state, self.params, stop_at, wpd
                 )
-                mn, press = int(mn), bool(press)
+                mn, press, occ = int(mn), bool(press), int(occ)
+            self._gear_note_dispatch()
             if obs is not None:
                 obs.round_done(self)
+            # gearing: a red-zone early exit upshifts (one pool re-sort)
+            # before the spill tier would pay host drain round-trips
+            shifted = self._gear_tick(occ, press=press)
             if mn >= stop and spill.min_time >= stop and not press:
                 break
             cur = (mn, spill.count, press)
-            if cur == last and mn >= stop_at:
+            if cur == last and mn >= stop_at and not shifted:
                 raise RuntimeError(
                     "spill tier cannot make progress: either a single "
                     "timestamp holds more events than the pool fill mark, "

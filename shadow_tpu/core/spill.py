@@ -44,6 +44,19 @@ def red_zone(capacity: int) -> int:
     return max(min(64, capacity // 4), capacity // 8)
 
 
+def marks(capacity: int) -> tuple[int, int]:
+    """(pressure mark, rebalance fill mark) for a pool of `capacity` rows:
+    the red-zone geometry every capacity-holder shares. The marks are
+    PER-GEAR under pool gearing (core/gearbox.py): each tier of the
+    capacity ladder carries its own marks, so the fused loop's early exit
+    and the drain target always describe the pool the kernel actually
+    compiled against. Pressure must fire while the merge can still absorb
+    one window's inflow; the fill mark sits below pressure so a rebalance
+    exits the red zone and the fused loop keeps running windows."""
+    hi = capacity - red_zone(capacity)
+    return hi, max(1, (3 * hi) // 4)
+
+
 class HostSpill:
     """Per-shard unbounded host-side overflow store.
 

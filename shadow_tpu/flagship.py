@@ -28,6 +28,7 @@ def build_phold_flagship(
     island_mode: str = "vmap",
     exchange_slots: int = 0,
     obs_counters: bool = True,
+    pool_gears: int = 1,
 ):
     from shadow_tpu.sim import build_simulation
 
@@ -80,6 +81,7 @@ def build_phold_flagship(
                 "outbox_slots": K,
                 "inbox_slots": 4,
                 "obs_counters": obs_counters,
+                "pool_gears": pool_gears,
             },
             "hosts": {
                 "peer": {

@@ -20,7 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 from flax import struct
 
-BLOCK_VERSION = 1
+BLOCK_VERSION = 2
 
 # --- fixed window-plane slot indices (append-only; never renumber) ---
 WIN_WINDOWS = 0  # window steps executed (one per step() call)
@@ -30,7 +30,8 @@ WIN_SHRINKS = 3  # optimistic windows shrunk after a violation
 WIN_ROLLBACKS = 4  # optimistic whole-window rollbacks
 WIN_OPT_STALLS = 5  # optimistic null-window exchange-retry stalls
 WIN_SPILL_FIRES = 6  # spill-tier manage episodes (shard rebalances)
-NUM_WIN = 7
+WIN_GEAR_SHIFTS = 7  # pool gear changes (core/gearbox.py re-sorts)
+NUM_WIN = 8
 
 WIN_NAMES = (
     "windows_run",
@@ -40,6 +41,7 @@ WIN_NAMES = (
     "rollbacks",
     "opt_stalls",
     "spill_fires",
+    "gear_shifts",
 )
 assert len(WIN_NAMES) == NUM_WIN
 

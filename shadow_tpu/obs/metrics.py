@@ -25,7 +25,7 @@ import numpy as np
 
 from shadow_tpu.obs import counters as obs_counters
 
-SCHEMA_VERSION = 1
+SCHEMA_VERSION = 2  # v2: gear.* counters/gauges (occupancy-adaptive gearing)
 DOC_KIND = "shadow_tpu.metrics"
 
 # Histograms keep exact count/sum/min/max plus a bounded sample buffer for
@@ -196,6 +196,15 @@ def snapshot_device(sim, reg: MetricsRegistry) -> None:
     reg.gauge_set("sim.runahead_ns", int(sim.runahead))
     for k, v in sim.spill_stats().items():
         reg.counter_set(f"spill.{k}", int(v))
+    gear_stats = getattr(sim, "gear_stats", None)
+    if gear_stats is not None:
+        g = gear_stats()
+        reg.gauge_set("gear.level", int(g["gear_level"]))
+        reg.gauge_set("gear.tiers", int(g["gear_tiers"]))
+        reg.gauge_set("gear.capacity", int(g["gear_capacity"]))
+        reg.counter_set("gear.shifts", int(g["gear_shifts"]))
+        for lvl, n in g["gear_dispatches"].items():
+            reg.counter_set(f"gear.dispatches.level{lvl}", int(n))
 
 
 class ObsSession:

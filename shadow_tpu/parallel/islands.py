@@ -45,7 +45,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from shadow_tpu.core import simtime
+from shadow_tpu.core import gearbox, simtime
+from shadow_tpu.core import spill as spill_mod
 from shadow_tpu.core.engine import IslandSpec, Simulation, make_window_step
 from shadow_tpu.core.spill import HostSpill
 from shadow_tpu.core.state import Counters, EventPool, SimState
@@ -178,7 +179,7 @@ class IslandSimulation(Simulation):
 
     def __init__(self, *, num_shards: int, exchange_slots: int = 0,
                  mode: str = "vmap", force_path: str | None = None,
-                 rebalance: bool = False, **kw):
+                 rebalance: bool = False, pool_gears: int = 1, **kw):
         if mode not in ("vmap", "shard_map"):
             raise ValueError(f"unknown islands mode {mode!r}")
         self.num_shards = int(num_shards)
@@ -227,16 +228,67 @@ class IslandSimulation(Simulation):
                 f"Lower exchange_slots (misses defer safely) or raise "
                 f"event_capacity."
             )
-        super().__init__(**kw)  # global build first; islandized below
+        kw = dict(kw)
+        kw["pool_gears"] = 1  # global build first (islandized below); the
+        # islands ladder replaces the global one with per-shard capacities
+        super().__init__(**kw)
 
         spec = IslandSpec(
             axis=AXIS, num_shards=S, exchange_slots=self.exchange_slots,
             use_slot_table=self.rebalance_enabled,
         )
         self._island_spec = spec
-        self._C_shard = C_shard
+        self._force_path = force_path
+
+        # Islands gear ladder (core/gearbox.py): tiers over the GLOBAL
+        # capacity, each mapped to its per-shard pool (share + structural
+        # exchange block) with exchange-aware red-zone marks. Tiers whose
+        # per-shard pool can't hold the exchange block + red zone are
+        # skipped; the top tier is exactly the pre-gearbox C_shard.
+        SX = S * self.exchange_slots
+
+        def island_marks(C_s: int) -> tuple[int, int]:
+            """Per-gear marks: the merge truncates the remainder at
+            C_keep = C_shard − S·X (the exchange block structurally
+            occupies the pool tail), so pressure must fire below C_keep,
+            not raw capacity."""
+            keep = C_s - SX
+            hi = keep - spill_mod.red_zone(C_s)
+            if hi <= 0:
+                raise ValueError(
+                    "per-shard pool too small for its exchange block + "
+                    "red zone; raise event_capacity or lower "
+                    "exchange_slots"
+                )
+            return hi, max(1, (3 * hi) // 4)
+
+        self.pool_gears = int(pool_gears)
+        self._gear_ladder = gearbox.build_ladder(
+            self.pool_gears, C, self.K, Hl, island_marks,
+            capacity_map=lambda c: (c + S - 1) // S + SX,
+        )
+        # initial gear from the per-shard initial occupancy (max shard)
+        pt = np.asarray(jax.device_get(self.state.pool.time))
+        pd = np.asarray(jax.device_get(self.state.pool.dst))
+        live = pt != simtime.NEVER
+        occ0 = int(np.bincount(
+            pd[live] // Hl, minlength=S
+        ).max()) if live.any() else 0
+        self._gear = (
+            gearbox.target_level(self._gear_ladder, occ0)
+            if len(self._gear_ladder) > 1
+            else self._gear_ladder[-1].level
+        )
+        self._shifter = (
+            gearbox.GearShifter(self._gear_ladder)
+            if len(self._gear_ladder) > 1
+            else None
+        )
+        self._gear_shifts = 0
+        self._gear_dispatches = {}
+        self._C_shard = self._gear_ladder[self._gear].capacity
         # Re-layout the built global state into islands.
-        self.state = islandize_state(self.state, S, C_shard)
+        self.state = islandize_state(self.state, S, self._C_shard)
         if self.rebalance_enabled:
             # identity assignment to start; the table is a runtime param,
             # so later rebalances never recompile
@@ -244,9 +296,9 @@ class IslandSimulation(Simulation):
                 slot_of=jnp.arange(H, dtype=jnp.int32)
             )
 
-        def build_step(sp: IslandSpec):
+        def build_step(sp: IslandSpec, K: int):
             return make_window_step(
-                self.handlers, Hl, K=self.K, B=self.B, O=self.O,
+                self.handlers, Hl, K=K, B=self.B, O=self.O,
                 bulk_kinds=self._bulk_kinds,
                 matrix_handlers=self._matrix_handlers,
                 with_cpu_model=self._with_cpu,
@@ -258,51 +310,12 @@ class IslandSimulation(Simulation):
             )
 
         self._step_builder = build_step
-        step = build_step(spec)
-        self._step_fn = step
-        runahead = jnp.int64(self.runahead)
-
-        def step_shard(state, params, ws, we):
-            st, mn = step(state, params, ws, we)
-            return st, jax.lax.pmin(mn, AXIS)
-
-        hi = self._spill_marks()[0]
-
-        def _press(state):
-            occ = jnp.sum(state.pool.time != simtime.NEVER)
-            return jax.lax.pmax((occ >= hi).astype(jnp.int32), AXIS)
-
-        def run_to(state, params, stop, max_windows):
-            stop = jnp.asarray(stop, jnp.int64)
-            max_windows = jnp.asarray(max_windows, jnp.int32)
-
-            def cond(c):
-                state, mn, w = c
-                return (mn < stop) & (w < max_windows) & (_press(state) == 0)
-
-            def body(c):
-                state, mn, w = c
-                ws = mn
-                # exchange-backpressure clamp: never let any shard process
-                # past an event still in transit (deferred exchange)
-                clamp = jax.lax.pmin(state.exch_deferred_min, AXIS)
-                we = jnp.minimum(jnp.minimum(ws + runahead, stop), clamp)
-                state, mn = step_shard(state, params, ws, we)
-                return state, mn, w + 1
-
-            mn0 = jax.lax.pmin(jnp.min(state.pool.time), AXIS)
-            state, mn, w = jax.lax.while_loop(
-                cond, body, (state, mn0, jnp.int32(0))
-            )
-            return state, mn, _press(state) > 0, w
 
         if mode == "vmap":
             self._wrap = lambda fn, n=1: jax.jit(jax.vmap(
                 fn, in_axes=(0, None, None, None), axis_name=AXIS
             ))
-            self._step = self._wrap(step_shard)
-            self._run_to = self._wrap(run_to)
-        else:
+        else:  # shard_map: _wrap is defined below with the mesh in scope
             from jax.sharding import Mesh, PartitionSpec as P
 
             devs = jax.devices()
@@ -312,7 +325,16 @@ class IslandSimulation(Simulation):
                 )
             mesh = Mesh(np.array(devs[:S]), (AXIS,))
             self.mesh = mesh
-            shard_map = jax.shard_map
+            # jax >= 0.7 exposes jax.shard_map with the varying-manual-axes
+            # checker (check_vma); earlier releases ship the experimental
+            # module with the replication checker (check_rep). Both must be
+            # disabled for the same reason (see the sm() comment below).
+            shard_map = getattr(jax, "shard_map", None)
+            if shard_map is not None:
+                no_check = {"check_vma": False}
+            else:
+                from jax.experimental.shard_map import shard_map
+                no_check = {"check_rep": False}
 
             def _sq(tree):
                 return jax.tree.map(lambda x: x[0], tree)
@@ -340,33 +362,84 @@ class IslandSimulation(Simulation):
                     # into varying state fields (e.g. state.now ← window
                     # start): semantically sound — every shard computes the
                     # identical value from the collective — but the static
-                    # varying-manual-axes checker can't see that, so it is
+                    # varying/replication checker can't see that, so it is
                     # disabled for these wrappers
-                    check_vma=False,
+                    **no_check,
                 )
                 return jax.jit(wrapped)
 
             self._wrap = sm
-            self._step = sm(step_shard, 1)
-            self._run_to = sm(run_to, 3)
-        self._attempt = None  # built lazily by _ensure_optimistic
+        # drop the GLOBAL-layout kernels super().__init__ bound and rebind
+        # the islands kernels for the active gear (one compiled set per
+        # gear level, cached in _gear_fns like the global engine's)
+        self._gear_fns = {}
+        self._bind_gear()
         self.windows_run = 0  # dispatched windows (suggest_exchange_slots)
 
-    def _spill_marks(self):
-        """Islands: the merge truncates the remainder at C_keep =
-        C_shard − S·X (the exchange block structurally occupies the pool
-        tail), so pressure must fire below C_keep, not raw capacity."""
-        from shadow_tpu.core import spill as spill_mod
+    def _build_gear_fns(self, spec: gearbox.GearSpec) -> dict:
+        if getattr(self, "_step_builder", None) is None:
+            # super().__init__ pre-build (global layout, discarded once the
+            # islands ladder rebinds below)
+            return super()._build_gear_fns(spec)
+        step = self._step_builder(self._island_spec, spec.K)
+        runahead = jnp.int64(self.runahead)
+        hi = spec.hi
 
-        C_s = self._C_shard
-        keep = C_s - self.num_shards * self.exchange_slots
-        hi = keep - spill_mod.red_zone(C_s)
-        if hi <= 0:
-            raise ValueError(
-                "per-shard pool too small for its exchange block + red "
-                "zone; raise event_capacity or lower exchange_slots"
+        def step_shard(state, params, ws, we):
+            st, mn = step(state, params, ws, we)
+            return st, jax.lax.pmin(mn, AXIS)
+
+        def _occ(state):
+            return jnp.sum(state.pool.time != simtime.NEVER)
+
+        def _press(state):
+            return jax.lax.pmax((_occ(state) >= hi).astype(jnp.int32), AXIS)
+
+        def run_to(state, params, stop, max_windows):
+            stop = jnp.asarray(stop, jnp.int64)
+            max_windows = jnp.asarray(max_windows, jnp.int32)
+
+            def cond(c):
+                state, mn, w = c
+                return (mn < stop) & (w < max_windows) & (_press(state) == 0)
+
+            def body(c):
+                state, mn, w = c
+                ws = mn
+                # exchange-backpressure clamp: never let any shard process
+                # past an event still in transit (deferred exchange)
+                clamp = jax.lax.pmin(state.exch_deferred_min, AXIS)
+                we = jnp.minimum(jnp.minimum(ws + runahead, stop), clamp)
+                state, mn = step_shard(state, params, ws, we)
+                return state, mn, w + 1
+
+            mn0 = jax.lax.pmin(jnp.min(state.pool.time), AXIS)
+            state, mn, w = jax.lax.while_loop(
+                cond, body, (state, mn0, jnp.int32(0))
             )
-        return hi, max(1, (3 * hi) // 4)
+            # occupancy rides back pmax'd: the gearing decision covers the
+            # FULLEST shard (every shard's pool compiles the same capacity)
+            occ = jax.lax.pmax(_occ(state), AXIS)
+            return state, mn, _press(state) > 0, occ, w
+
+        return {
+            "step_fn": step,
+            "step": self._wrap(step_shard, 1),
+            "run_to": self._wrap(run_to, 4),
+            # the optimistic sub-step kernel compiles lazily per gear
+            # (_ensure_optimistic): conservative runs never pay for it
+            "attempt": None,
+        }
+
+    def _shift_gear(self, level: int) -> None:
+        super()._shift_gear(level)
+        self._C_shard = self._gear_ladder[level].capacity
+
+    def _pool_occupancy(self) -> int:
+        """Gearing decision signal: live rows on the FULLEST shard."""
+        return int(jnp.max(
+            jnp.sum(self.state.pool.time != simtime.NEVER, axis=-1)
+        ))
 
     # ---- between-window re-sharding (the P3 work-stealing replacement,
     # scheduler_policy_host_steal.c:1-562 / logical_processor.rs:43-54) ----
@@ -543,18 +616,23 @@ class IslandSimulation(Simulation):
             # requires a manage pass between windows — core/spill.py)
             wpd = 1 if spill.count else windows_per_dispatch
             with metrics_mod.span(obs, "dispatch", windows=wpd):
-                self.state, mn, press, w = self._run_to(
+                self.state, mn, press, occ, w = self._run_to(
                     self.state, self.params, stop_at, wpd
                 )
                 mn = int(np.min(np.asarray(mn)))
                 press = bool(np.max(np.asarray(press)))
+                occ = int(np.max(np.asarray(occ)))
+            self._gear_note_dispatch()
             self.windows_run += int(np.max(np.asarray(w)))
             if obs is not None:
                 obs.round_done(self)
+            # gearing: a red-zone early exit upshifts (one pool re-sort)
+            # before the spill tier would pay host drain round-trips
+            shifted = self._gear_tick(occ, press=press)
             if mn >= stop and spill.min_time >= stop and not press:
                 break
             cur = (mn, spill.count, press)
-            if cur == last and mn >= stop_at:
+            if cur == last and mn >= stop_at and not shifted:
                 raise RuntimeError(
                     "spill tier cannot make progress (single over-full "
                     "timestamp or no pool headroom for one window's "
@@ -572,6 +650,10 @@ class IslandSimulation(Simulation):
         windows = 0
         stall = 0
         while True:
+            if self._shifter is not None:
+                # gear decision BEFORE spill manage: an upshift absorbs
+                # red-zone pressure without a host drain episode
+                self._gear_tick(self._pool_occupancy())
             with metrics_mod.span(obs, "spill"):
                 stop_at = spill_mod.manage(self, spill, stop)
             min_next = int(jax.device_get(jnp.min(self.state.pool.time)))
@@ -594,6 +676,7 @@ class IslandSimulation(Simulation):
             we = min(ws + self.runahead, stop_at, clamp)
             with metrics_mod.span(obs, "dispatch", windows=1):
                 self.state, mn = self._step(self.state, self.params, ws, we)
+            self._gear_note_dispatch()
             windows += 1
             self.windows_run += 1
         return windows
@@ -639,8 +722,9 @@ class IslandSimulation(Simulation):
         stall detection for free."""
         if self._attempt is not None:
             return
+        spec = self._gear_ladder[self._gear]
         spec_opt = self._island_spec._replace(optimistic=True)
-        step_opt = self._step_builder(spec_opt)
+        step_opt = self._step_builder(spec_opt, spec.K)
 
         def substep(state, params, ws, we):
             st2, mn2 = step_opt(state, params, ws, we)
@@ -650,7 +734,11 @@ class IslandSimulation(Simulation):
             viol = jax.lax.pmin(st2.xmit_min, AXIS)
             return st2, mn2, viol
 
-        self._attempt = self._wrap(substep, 2)
+        # cache per gear: a shift rebinds _attempt to the new gear's entry
+        # (None until this runs again for that gear)
+        self._attempt = self._gear_fns[spec.level]["attempt"] = self._wrap(
+            substep, 2
+        )
 
     def run_optimistic(
         self,
@@ -711,6 +799,12 @@ class IslandSimulation(Simulation):
         obs = self.obs_session
         min_next = int(jax.device_get(jnp.min(self.state.pool.time)))
         while min_next < stop:
+            if self._shifter is not None:
+                # margin=2: a speculative window absorbs several windows'
+                # inflow between decision points (core/gearbox.target_level);
+                # a shift rebinds _attempt to None, so re-ensure per gear
+                self._gear_tick(self._pool_occupancy(), margin=2)
+                self._ensure_optimistic()
             ws = min_next
             clamp = int(jax.device_get(
                 jnp.min(self.state.exch_deferred_min)
@@ -761,11 +855,28 @@ class IslandSimulation(Simulation):
                         )
                         mn_i = int(np.min(np.asarray(mn)))
                         viol = int(np.min(np.asarray(vl)))
+                        self._gear_note_dispatch()
                     k += 1
                 if viol >= never and mn_i < we and k >= _MAX_SUBSTEPS:
                     we = mn_i
                     shrinks += 1
                     continue
+                if viol < never and we <= floor:
+                    # A floor-width window is violation-free BY CONSTRUCTION
+                    # (floor = min(ws + runahead, exchange clamp): emissions
+                    # land at or after ws + runahead, and no shard overtakes
+                    # an in-transit deferred row). A violation here means
+                    # the conservative-width invariant itself is broken —
+                    # committing would silently accept a causally-violated
+                    # window (ADVICE round-5 finding).
+                    raise RuntimeError(
+                        f"speculation violation at t={viol} inside a "
+                        f"floor-width window [{ws}, {we}) (floor {floor}): "
+                        f"the conservative-width invariant is broken "
+                        f"(runahead {cons} exceeds a real path latency, or "
+                        f"a handler emitted into the past); refusing to "
+                        f"commit"
+                    )
                 if viol >= never or we <= floor:
                     break
                 rollbacks += 1

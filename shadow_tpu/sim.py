@@ -256,6 +256,7 @@ def build_simulation(source) -> Simulation:
         bulk_gate=bulk_gate,
         bulk_self_excluded=bulk_self_excluded,
         obs_counters=cfg.experimental.obs_counters,
+        pool_gears=cfg.experimental.pool_gears,
     )
     # attach build artifacts for inspection/observability
     sim.config = cfg
