@@ -495,6 +495,94 @@ def stage_gear_win(num_hosts: int = 8192, msgload: int = 4, stop_s: int = 4):
     }
 
 
+def stage_fault_smoke():
+    """Fault-plane smoke row (ISSUE 3 acceptance gate): a quarantine-mode
+    managed-process run with ONE injected kill_proc mid-run must complete
+    with rc=0 (the unaffected pair finishes; the faulted process is
+    excluded from plugin-error accounting) and record faults.* metrics
+    (hosts_quarantined, injections_fired)."""
+    import contextlib
+    import io
+    import pathlib
+    import shutil
+    import tempfile
+
+    from shadow_tpu.procs import build as build_mod
+
+    if not build_mod.toolchain_available():
+        return {"stage": "fault_smoke", "error": "no native toolchain",
+                "gate_rc0": False, "gate_metrics": False}
+
+    tmp = tempfile.mkdtemp(prefix="shadow_tpu_fault_smoke_")
+    try:
+        cc = shutil.which("cc") or shutil.which("gcc")
+        apps = {}
+        for stem in ("udp_echo_server", "udp_echo_client"):
+            src = pathlib.Path(_REPO) / "tests" / "apps" / f"{stem}.c"
+            exe = pathlib.Path(tmp) / stem
+            subprocess.run(
+                [cc, "-O1", "-o", str(exe), str(src), "-lpthread"],
+                check=True, capture_output=True,
+            )
+            apps[stem] = str(exe)
+
+        from shadow_tpu.__main__ import _run_process_plane
+        from shadow_tpu.core.config import load_config
+        from shadow_tpu.procs.builder import build_process_driver
+
+        gml = (
+            'graph [\n'
+            '  node [ id 0 bandwidth_down "100 Mbit" '
+            'bandwidth_up "100 Mbit" ]\n'
+            '  edge [ source 0 target 0 latency "50 ms" '
+            'packet_loss 0.0 ]\n'
+            ']\n'
+        )
+        # pair A completes normally; pair B's client (40 pings x 100 ms
+        # RTT: busy until ~5 s) is killed at 3 s and its host quarantined
+        cfg = load_config({
+            "general": {"stop_time": "6 s", "seed": 7},
+            "network": {"graph": {"type": "gml", "inline": gml}},
+            "faults": {
+                "on_proc_failure": "quarantine",
+                "inject": [
+                    {"at": "3 s", "op": "kill_proc", "proc": "clientb.0"},
+                ],
+            },
+            "hosts": {
+                "servera": {"processes": [
+                    {"path": apps["udp_echo_server"], "args": "9000 3"}]},
+                "clienta": {"processes": [
+                    {"path": apps["udp_echo_client"],
+                     "args": "servera 9000 3", "start_time": "1 s"}]},
+                "serverb": {"processes": [
+                    {"path": apps["udp_echo_server"], "args": "9000 40"}]},
+                "clientb": {"processes": [
+                    {"path": apps["udp_echo_client"],
+                     "args": "serverb 9000 40", "start_time": "1 s"}]},
+            },
+        })
+        driver = build_process_driver(
+            cfg, data_root=pathlib.Path(tmp) / "data"
+        )
+        out = io.StringIO()
+        with contextlib.redirect_stdout(out), contextlib.redirect_stderr(out):
+            rc = _run_process_plane(cfg, driver, False)
+        stats = {k: int(v) for k, v in sorted(driver.fault_stats().items())}
+        return {
+            "stage": "fault_smoke",
+            "rc": rc,
+            "faults": stats,
+            "gate_rc0": rc == 0,
+            "gate_metrics": (
+                stats.get("hosts_quarantined", 0) >= 1
+                and stats.get("injections_fired", 0) >= 1
+            ),
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def shard_sweep(shards=(1, 2, 4, 8), out_path: str | None = None):
     """Virtual-islands scaling sweep on ONE chip (VERDICT r4 gate 1c):
     PHOLD 16k and udp_flood_10k at each shard count; one JSON line each.
@@ -528,6 +616,12 @@ def shard_sweep(shards=(1, 2, 4, 8), out_path: str | None = None):
 
 
 def main():
+    if "--fault-smoke" in sys.argv:
+        # fault-tolerance gate: quarantine-mode run with one injected
+        # process kill completes rc=0 and records faults.* metrics.
+        # Managed plane only — no accelerator, so no backend wait.
+        print(json.dumps(stage_fault_smoke()), flush=True)
+        return
     if not wait_for_backend():
         # No backend after the full retry budget: record the failure as a
         # JSON line (the driver stores stdout) and exit nonzero.

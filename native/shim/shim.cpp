@@ -1188,7 +1188,12 @@ static void rlim_init_locked() {
     g_rlim[i].rlim_cur = RLIM_INFINITY;
     g_rlim[i].rlim_max = RLIM_INFINITY;
   }
-  g_rlim[RLIMIT_NOFILE].rlim_cur = 1024;
+  // Soft limit must clear FD_BASE (1000) + the whole managed-fd budget:
+  // the driver allocates virtual fds upward from FD_BASE, and a
+  // synthesized 1024 would tell apps (and their fd-hygiene sweeps) that
+  // descriptors the driver legitimately hands out cannot exist. The
+  // driver clamps alloc_fd to this same value (procs/driver.VIRT_NOFILE).
+  g_rlim[RLIMIT_NOFILE].rlim_cur = 65536;
   g_rlim[RLIMIT_NOFILE].rlim_max = 262144;
   g_rlim[RLIMIT_STACK].rlim_cur = 8ull << 20;
   g_rlim_init = true;

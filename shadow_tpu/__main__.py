@@ -72,6 +72,42 @@ def _build_parser() -> argparse.ArgumentParser:
              "the smallest gear covering live occupancy at each dispatch "
              "boundary (core/gearbox.py); 1 = single fixed-capacity kernel",
     )
+    p.add_argument(
+        "--fault-plan", metavar="PATH",
+        help="fault-plan JSON (docs/fault_tolerance.md): virtual-time-"
+             "keyed injections (kill/wedge a managed process, refuse an "
+             "IPC reply, kill a device host, corrupt a checkpoint, force "
+             "a spill) executed deterministically — merged with the "
+             "config's faults.inject list",
+    )
+    p.add_argument(
+        "--on-proc-failure", choices=("abort", "quarantine"),
+        help="override faults.on_proc_failure: what the supervisor does "
+             "when a managed process wedges — abort the run, or "
+             "quarantine the simulated host (mark it dead, drain its "
+             "events) and keep going",
+    )
+    p.add_argument(
+        "--checkpoint-every", metavar="TIME",
+        help="write a crash-consistent device-state checkpoint (atomic "
+             "tmp+fsync+rename, digest-verified) every TIME of sim time "
+             "at handoff boundaries, into --checkpoint-dir with a small "
+             "retention ring; device plane only",
+    )
+    p.add_argument(
+        "--checkpoint-dir", metavar="DIR",
+        help="checkpoint ring directory (default: <data-dir>/checkpoints)",
+    )
+    p.add_argument(
+        "--checkpoint-retain", type=int, default=3, metavar="N",
+        help="ring size: keep the newest N checkpoints (default 3)",
+    )
+    p.add_argument(
+        "--resume", metavar="DIR",
+        help="restore the newest checkpoint in DIR that passes integrity "
+             "validation (falling back past corrupt entries) before "
+             "running; the config must match the checkpointed build",
+    )
     return p
 
 
@@ -97,6 +133,10 @@ def _apply_overrides(cfg, args) -> None:
         if args.pool_gears < 1:
             raise ValueError("--pool-gears must be >= 1")
         cfg.experimental.pool_gears = args.pool_gears
+    if args.fault_plan is not None:
+        cfg.faults.plan = args.fault_plan
+    if args.on_proc_failure is not None:
+        cfg.faults.on_proc_failure = args.on_proc_failure
 
 
 def _dump_config(cfg) -> str:
@@ -113,17 +153,22 @@ def _dump_config(cfg) -> str:
             "general": clean(cfg.general),
             "network": clean(cfg.network),
             "experimental": clean(cfg.experimental),
+            "faults": clean(cfg.faults),
             "hosts": {h.name: clean(h) for h in cfg.hosts},
         },
         sort_keys=False,
     )
 
 
-def _prepare_data_dir(cfg) -> pathlib.Path:
+def _prepare_data_dir(cfg, resuming: bool = False) -> pathlib.Path:
     """Create the data directory; refuse to clobber an existing one, exactly
-    like the reference (manager.c:177-190 errors out if the path exists)."""
+    like the reference (manager.c:177-190 errors out if the path exists).
+    A --resume re-launch is the exception: the crashed run's directory (and
+    its checkpoint ring) is precisely what we are coming back for."""
     data_dir = pathlib.Path(cfg.general.data_directory)
     if data_dir.exists():
+        if resuming:
+            return data_dir
         raise SystemExit(
             f"error: data directory '{data_dir}' already exists; remove it "
             f"or pass --data-directory"
@@ -172,12 +217,22 @@ def _run_process_plane(cfg, driver, progress: bool) -> int:
     for p in driver.procs:
         if p.stopped_by_sim:
             continue  # stopped at its stop_time, not an app failure
+        if p.faulted:
+            continue  # killed/quarantined by the fault plane, not the app
         if p.exit_code not in (0, None):
             errors += 1
             print(
                 f"process {p.name} exited with {p.exit_code}",
                 file=sys.stderr,
             )
+    fstats = driver.fault_stats()
+    if any(fstats.values()):
+        print(
+            "fault plane: " + ", ".join(
+                f"{k}={v}" for k, v in sorted(fstats.items()) if v
+            ),
+            file=sys.stderr,
+        )
     c = driver.counters
     print(
         f"done: {len(driver.hosts)} hosts, {len(driver.procs)} processes, "
@@ -194,6 +249,9 @@ def _run_process_plane(cfg, driver, progress: bool) -> int:
 def _run_device_plane(
     cfg, sim, progress: bool,
     metrics_out: str | None = None, trace_out: str | None = None,
+    checkpoint_every: str | None = None, checkpoint_dir: str | None = None,
+    checkpoint_retain: int = 3, resume: str | None = None,
+    data_dir=None,
 ) -> int:
     session = None
     if metrics_out or trace_out:
@@ -204,6 +262,37 @@ def _run_device_plane(
             tracer=obs_trace.ChromeTracer() if trace_out else None
         )
         sim.obs_session = session
+    faults = cfg.faults.load_faults()
+    if faults:
+        sim.attach_faults(faults)
+    if resume:
+        from shadow_tpu.core.checkpoint import CheckpointError
+
+        try:
+            info = sim.resume_from(resume)
+        except CheckpointError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        note = (
+            f" ({info['fallbacks']} corrupt checkpoint(s) skipped)"
+            if info["fallbacks"] else ""
+        )
+        print(
+            f"resumed from {info['path']} at sim "
+            f"{info['sim_ns'] / 1e9:.3f}s{note}",
+            file=sys.stderr,
+        )
+    if checkpoint_every:
+        from shadow_tpu.core import units
+
+        ckpt_dir = checkpoint_dir or str(
+            pathlib.Path(data_dir or cfg.general.data_directory)
+            / "checkpoints"
+        )
+        sim.configure_auto_checkpoint(
+            ckpt_dir, units.parse_time_ns(checkpoint_every),
+            checkpoint_retain,
+        )
     t0 = time.monotonic()
     if progress:
         import jax
@@ -238,6 +327,14 @@ def _run_device_plane(
         print(
             f"warning: {dropped} events dropped on pool overflow "
             f"(raise experimental.event_capacity)",
+            file=sys.stderr,
+        )
+    fstats = sim.fault_stats()
+    if any(fstats.values()):
+        print(
+            "fault plane: " + ", ".join(
+                f"{k}={v}" for k, v in sorted(fstats.items()) if v
+            ),
             file=sys.stderr,
         )
     if session is not None:
@@ -292,7 +389,14 @@ def main(argv: list[str] | None = None) -> int:
         )
         return 2
 
-    data_dir = _prepare_data_dir(cfg)
+    try:
+        # fail on a malformed fault plan BEFORE creating the data dir
+        cfg.faults.load_faults()
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    data_dir = _prepare_data_dir(cfg, resuming=args.resume is not None)
     try:
         if has_procs:
             from shadow_tpu.procs.builder import build_process_driver
@@ -318,10 +422,21 @@ def main(argv: list[str] | None = None) -> int:
                 "only; ignored for managed-process simulations",
                 file=sys.stderr,
             )
+        if args.checkpoint_every or args.resume:
+            print(
+                "note: --checkpoint-every/--resume cover the device plane "
+                "only (managed-process state lives in native images and "
+                "cannot be snapshotted); ignored",
+                file=sys.stderr,
+            )
         return _run_process_plane(cfg, built, cfg.general.progress)
     return _run_device_plane(
         cfg, built, cfg.general.progress,
         metrics_out=args.metrics_out, trace_out=args.trace_out,
+        checkpoint_every=args.checkpoint_every,
+        checkpoint_dir=args.checkpoint_dir,
+        checkpoint_retain=args.checkpoint_retain,
+        resume=args.resume, data_dir=data_dir,
     )
 
 

@@ -1,4 +1,4 @@
-"""Checkpoint/resume of device simulation state.
+"""Crash-consistent checkpoint/resume of device simulation state.
 
 The reference has none (SURVEY.md §5.4): simulation state lives partly in
 native process memory of managed plugins, which makes snapshots hard. Here
@@ -6,21 +6,45 @@ the device-plane state is a pure pytree of arrays, so a checkpoint is just
 those arrays on disk — resume is bit-exact because a window step is a pure
 function of (state, params, window).
 
-Format: one .npz whose keys are the pytree key-paths of SimState leaves,
-plus a `__meta__` JSON blob (host count, sim time, version) for validation.
+Format (FORMAT_VERSION 2): one .npz whose keys are the pytree key-paths of
+SimState leaves, plus
+  ``__meta__``    JSON blob (host count, sim time, version, gear) for
+                  validation, and
+  ``__digest__``  sha256 over every other entry's name, dtype, shape and
+                  raw bytes (sorted by name) — content integrity that a
+                  zip CRC pass alone cannot provide for a flipped byte
+                  that survives decompression.
+
+Crash consistency: `save` writes to a same-directory temp file, fsyncs,
+then renames into place — a simulator SIGKILLed mid-write leaves either
+the previous checkpoint or a temp file that resume ignores, never a
+half-written archive under the real name. `resume_latest` walks the
+retention ring newest-first and falls back past any checkpoint that fails
+integrity validation (truncated, flipped, wrong structure), so one corrupt
+file costs one interval of progress, not the run.
+
 Restoring requires a Simulation built from the SAME config (the kernel and
 state structure are compile-time artifacts; only the array contents travel).
 """
 
 from __future__ import annotations
 
+import hashlib
 import io
 import json
+import os
+import re
+import zipfile
+import zlib
 
 import jax
 import numpy as np
 
-FORMAT_VERSION = 1
+FORMAT_VERSION = 2
+
+# auto-checkpoint ring entries: ckpt-<seq>-<sim_ns>.npz — seq gives the
+# newest-first order even if two boundaries share a frontier time
+_RING_RE = re.compile(r"^ckpt-(\d{6})-(\d+)\.npz$")
 
 
 class CheckpointError(ValueError):
@@ -32,8 +56,21 @@ def _leaf_paths(state):
     return [(jax.tree_util.keystr(path), leaf) for path, leaf in leaves], treedef
 
 
+def _digest(arrays: dict) -> str:
+    h = hashlib.sha256()
+    for key in sorted(arrays):
+        a = np.ascontiguousarray(arrays[key])
+        h.update(key.encode())
+        h.update(str(a.dtype).encode())
+        h.update(str(a.shape).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
+
+
 def save(sim, path: str) -> None:
-    """Write sim.state (and metadata) to `path` as an .npz archive."""
+    """Write sim.state (and metadata) to `path` as an .npz archive,
+    atomically: tmp file + fsync + rename (crash mid-save never leaves a
+    torn archive under `path`)."""
     pairs, _ = _leaf_paths(sim.state)
     arrays = {}
     for key, leaf in pairs:
@@ -43,7 +80,7 @@ def save(sim, path: str) -> None:
         "num_hosts": sim.num_hosts,
         "stop_time": sim.stop_time,
         "runahead": sim.runahead,
-        "now": int(jax.device_get(sim.state.now)),
+        "now": int(np.max(np.asarray(jax.device_get(sim.state.now)))),
         "leaves": sorted(arrays),
     }
     # Pool gearing (core/gearbox.py): the active gear decides the pool
@@ -57,31 +94,103 @@ def save(sim, path: str) -> None:
             "capacity": int(ladder[sim._gear].capacity),
             "tiers": len(ladder),
         }
+    meta["digest"] = _digest(arrays)
     arrays["__meta__"] = np.frombuffer(
         json.dumps(meta).encode(), dtype=np.uint8
     )
     buf = io.BytesIO()
     np.savez_compressed(buf, **arrays)
-    with open(path, "wb") as f:
-        f.write(buf.getvalue())
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            f.write(buf.getvalue())
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    # the rename itself must survive a crash: fsync the directory entry
+    d = os.open(os.path.dirname(os.path.abspath(path)), os.O_RDONLY)
+    try:
+        os.fsync(d)
+    finally:
+        os.close(d)
+
+
+def _open_checkpoint(path: str):
+    """np.load with every failure class collapsed to CheckpointError:
+    callers (and the resume fallback) see one clean exception type instead
+    of zipfile/KeyError/json internals."""
+    try:
+        return np.load(path)
+    except (zipfile.BadZipFile, zlib.error, OSError, ValueError,
+            EOFError) as e:
+        raise CheckpointError(f"{path}: unreadable archive: {e}") from e
 
 
 def load_meta(path: str) -> dict:
-    with np.load(path) as z:
-        return json.loads(bytes(z["__meta__"]).decode())
+    with _open_checkpoint(path) as z:
+        try:
+            raw = z["__meta__"]
+        except (KeyError, zipfile.BadZipFile, zlib.error, EOFError) as e:
+            raise CheckpointError(
+                f"{path}: missing or unreadable __meta__ entry"
+            ) from e
+        try:
+            meta = json.loads(bytes(raw).decode())
+        except (UnicodeDecodeError, json.JSONDecodeError) as e:
+            raise CheckpointError(f"{path}: corrupt __meta__ JSON") from e
+    if not isinstance(meta, dict) or "version" not in meta:
+        raise CheckpointError(f"{path}: __meta__ is not a checkpoint header")
+    return meta
+
+
+def verify(path: str) -> dict:
+    """Full integrity validation without touching any sim: header parses,
+    format version matches, every recorded leaf decompresses, and the
+    content digest matches. Returns the meta on success."""
+    meta = load_meta(path)
+    if meta["version"] != FORMAT_VERSION:
+        raise CheckpointError(
+            f"{path}: checkpoint version {meta['version']} != {FORMAT_VERSION}"
+        )
+    want = meta.get("digest")
+    if not want:
+        raise CheckpointError(f"{path}: header carries no content digest")
+    arrays = {}
+    with _open_checkpoint(path) as z:
+        names = set(z.files) - {"__meta__"}
+        if names != set(meta.get("leaves", [])):
+            raise CheckpointError(
+                f"{path}: archive entries do not match the recorded leaf "
+                f"set (torn or tampered archive)"
+            )
+        for key in names:
+            try:
+                arrays[key] = z[key]
+            except (zipfile.BadZipFile, zlib.error, EOFError, OSError,
+                    ValueError) as e:
+                raise CheckpointError(
+                    f"{path}: leaf {key} unreadable: {e}"
+                ) from e
+    got = _digest(arrays)
+    if got != want:
+        raise CheckpointError(
+            f"{path}: content digest mismatch (corrupt checkpoint): "
+            f"{got[:12]} != {want[:12]}"
+        )
+    return meta
 
 
 def restore(sim, path: str) -> None:
     """Replace sim.state with the checkpointed arrays (in place).
 
     The Simulation must be built from the same config: every state leaf must
-    exist in the checkpoint with identical shape and dtype.
+    exist in the checkpoint with identical shape and dtype. Integrity is
+    verified (digest) before any state is touched.
     """
-    meta = load_meta(path)
-    if meta["version"] != FORMAT_VERSION:
-        raise CheckpointError(
-            f"checkpoint version {meta['version']} != {FORMAT_VERSION}"
-        )
+    meta = verify(path)
     if meta["num_hosts"] != sim.num_hosts:
         raise CheckpointError(
             f"checkpoint has {meta['num_hosts']} hosts, sim has "
@@ -108,7 +217,7 @@ def restore(sim, path: str) -> None:
             # land on state that the leaf restore below replaces wholesale
             sim._shift_gear(lvl)
     pairs, treedef = _leaf_paths(sim.state)
-    with np.load(path) as z:
+    with _open_checkpoint(path) as z:
         want = {k for k, _ in pairs}
         have = set(meta["leaves"])
         if want != have:
@@ -129,3 +238,73 @@ def restore(sim, path: str) -> None:
                 )
             new_leaves.append(jax.numpy.asarray(arr))
     sim.state = jax.tree_util.tree_unflatten(treedef, new_leaves)
+
+
+# ---------------------------------------------------------------------------
+# auto-checkpoint retention ring (--checkpoint-every / --resume)
+# ---------------------------------------------------------------------------
+
+
+def ring_entries(ckpt_dir: str) -> list[tuple[int, int, str]]:
+    """(seq, sim_ns, path) for every ring entry in `ckpt_dir`, oldest
+    first. Temp files and foreign names are ignored."""
+    out = []
+    try:
+        names = os.listdir(ckpt_dir)
+    except FileNotFoundError:
+        return []
+    for name in names:
+        m = _RING_RE.match(name)
+        if m:
+            out.append((int(m.group(1)), int(m.group(2)),
+                        os.path.join(ckpt_dir, name)))
+    out.sort()
+    return out
+
+
+def save_ring(sim, ckpt_dir: str, seq: int, sim_ns: int,
+              retain: int = 3) -> tuple[str, int]:
+    """Write one ring checkpoint ckpt-<seq>-<sim_ns>.npz and prune the
+    oldest entries beyond `retain`. Returns (path, pruned_count)."""
+    os.makedirs(ckpt_dir, exist_ok=True)
+    path = os.path.join(ckpt_dir, f"ckpt-{seq:06d}-{sim_ns}.npz")
+    save(sim, path)
+    pruned = 0
+    entries = ring_entries(ckpt_dir)
+    for _, _, old in entries[:max(0, len(entries) - max(1, retain))]:
+        os.unlink(old)
+        pruned += 1
+    return path, pruned
+
+
+def resume_latest(sim, ckpt_dir: str) -> dict:
+    """Restore the newest ring checkpoint that passes integrity
+    validation, falling back past corrupt ones (each fallback is counted).
+    Returns {"path", "meta", "fallbacks", "rejected": [(path, error)]}.
+    Raises CheckpointError when no entry validates."""
+    entries = ring_entries(ckpt_dir)
+    if not entries:
+        raise CheckpointError(
+            f"{ckpt_dir}: no checkpoints to resume from (expected "
+            f"ckpt-<seq>-<ns>.npz entries)"
+        )
+    rejected = []
+    for seq, sim_ns, path in reversed(entries):
+        try:
+            restore(sim, path)
+        except CheckpointError as e:
+            rejected.append((path, str(e)))
+            continue
+        return {
+            "path": path,
+            "meta": load_meta(path),
+            "seq": seq,
+            "sim_ns": sim_ns,
+            "fallbacks": len(rejected),
+            "rejected": rejected,
+        }
+    detail = "; ".join(f"{os.path.basename(p)}: {e}" for p, e in rejected)
+    raise CheckpointError(
+        f"{ckpt_dir}: every checkpoint failed integrity validation "
+        f"({detail})"
+    )

@@ -415,16 +415,87 @@ class HostOptions:
 
 
 @dataclasses.dataclass
+class FaultOptions:
+    """`faults` section: deterministic fault injection + recovery policy
+    (shadow_tpu/faults; no reference analog — Shadow dies whole-run on any
+    plugin failure)."""
+
+    # fault-plan JSON file (same schema as --fault-plan), merged with the
+    # inline `inject` list; both are virtual-time-keyed injection lists
+    plan: Optional[str] = None
+    inject: list[dict] = dataclasses.field(default_factory=list)
+    # what the supervisor does when a managed process wedges (IPC-timeout
+    # escalation ladder exhausted) — abort the run, or quarantine the
+    # simulated host (mark it dead, drain its events, keep running)
+    on_proc_failure: str = "abort"
+    # escalation ladder: extra timed waits (doubling backoff) before a
+    # non-responsive managed process is declared wedged
+    ipc_timeout_retries: int = 1
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FaultOptions":
+        _check_fields(
+            "faults", d,
+            {"plan", "inject", "on_proc_failure", "ipc_timeout_retries"},
+        )
+        out = cls()
+        if d.get("plan") is not None:
+            out.plan = str(d["plan"])
+        if d.get("inject"):
+            out.inject = list(d["inject"])
+            # fail at config time, not mid-run: entries must parse
+            from shadow_tpu.faults import plan as plan_mod
+
+            try:
+                plan_mod.parse_fault_plan(out.inject)
+            except plan_mod.FaultPlanError as e:
+                raise ConfigError(f"faults.inject: {e}") from e
+        if "on_proc_failure" in d:
+            v = str(d["on_proc_failure"]).lower()
+            if v not in ("abort", "quarantine"):
+                raise ConfigError(
+                    f"faults.on_proc_failure must be abort|quarantine, "
+                    f"got {v!r}"
+                )
+            out.on_proc_failure = v
+        if "ipc_timeout_retries" in d:
+            out.ipc_timeout_retries = int(d["ipc_timeout_retries"])
+            if out.ipc_timeout_retries < 0:
+                raise ConfigError("faults.ipc_timeout_retries must be >= 0")
+        return out
+
+    def load_faults(self) -> list:
+        """Materialize the merged injection list (plan file + inline),
+        ordered by (at, declaration)."""
+        from shadow_tpu.faults import plan as plan_mod
+
+        faults = []
+        if self.plan:
+            faults.extend(plan_mod.load_fault_plan(self.plan))
+        if self.inject:
+            inline = plan_mod.parse_fault_plan(self.inject)
+            base = len(faults)
+            for f in inline:
+                f.seq += base  # plan-file entries order before inline ones
+            faults.extend(inline)
+        faults.sort(key=lambda f: (f.at_ns, f.seq))
+        return faults
+
+
+@dataclasses.dataclass
 class Config:
     general: GeneralOptions
     network: NetworkOptions
     experimental: ExperimentalOptions
     hosts: list[HostOptions]
+    faults: FaultOptions = dataclasses.field(default_factory=FaultOptions)
 
     @classmethod
     def from_dict(cls, d: dict) -> "Config":
         _check_fields(
-            "config", d, {"general", "network", "experimental", "host_defaults", "hosts"}
+            "config", d,
+            {"general", "network", "experimental", "host_defaults", "hosts",
+             "faults"},
         )
         if "general" not in d:
             raise ConfigError("general section is required")
@@ -433,6 +504,7 @@ class Config:
         general = GeneralOptions.from_dict(d["general"] or {})
         network = NetworkOptions.from_dict(d["network"] or {})
         experimental = ExperimentalOptions.from_dict(d.get("experimental") or {})
+        faults = FaultOptions.from_dict(d.get("faults") or {})
         defaults = d.get("host_defaults") or {}
         hosts: list[HostOptions] = []
         for name, hd in (d.get("hosts") or {}).items():
@@ -440,7 +512,7 @@ class Config:
         # Deterministic host ordering regardless of YAML dict order, matching
         # the reference's BTreeMap iteration (configuration.rs:75-76).
         hosts.sort(key=lambda h: h.name)
-        return cls(general, network, experimental, hosts)
+        return cls(general, network, experimental, hosts, faults)
 
     def graph_gml(self) -> str:
         g = self.network.graph

@@ -1508,6 +1508,27 @@ class Simulation:
         # (--metrics-out/--trace-out) or bench; None keeps the run loops on
         # their zero-instrumentation path.
         self.obs_session = None
+        # Fault-tolerance plane (shadow_tpu/faults): device/file injections
+        # execute at handoff boundaries via _handoff_tick; quarantined
+        # (dead) hosts have their pending pool/spill events drained at
+        # every subsequent handoff — the crashed-host semantic. Auto-
+        # checkpointing (--checkpoint-every) rides the same tick.
+        self.fault_injector = None
+        self._dead_hosts: set[int] = set()
+        self._force_spill = False
+        self.checkpoint_dir: str | None = None
+        self.checkpoint_every_ns = 0
+        self.checkpoint_retain = 3
+        self._ckpt_next_t = 0
+        self._ckpt_seq = 0
+        self.fault_counters = {
+            "hosts_quarantined": 0,
+            "events_drained": 0,
+            "files_corrupted": 0,
+            "checkpoints_written": 0,
+            "checkpoints_pruned": 0,
+            "resume_fallbacks": 0,
+        }
         self._gear_fns: dict[int, dict] = {}
         self._bind_gear()
 
@@ -1666,6 +1687,10 @@ class Simulation:
             with metrics_mod.span(obs, "spill"):
                 stop_at = spill_mod.manage(self, spill, stop)
             min_next = int(jnp.min(self.state.pool.time))
+            if self._fault_plane_active():
+                self._handoff_tick(min_next)
+                # a drain may have removed the frontier event
+                min_next = int(jnp.min(self.state.pool.time))
             if min_next >= stop_at:
                 if min_next >= stop and spill.min_time >= stop:
                     break
@@ -1822,6 +1847,9 @@ class Simulation:
             windows += 1
             if obs is not None:
                 obs.round_done(self)
+            if self._fault_plane_active():
+                self._handoff_tick(min_next)
+                min_next = int(jnp.min(self.state.pool.time))
             if adaptive:
                 factor, streak = self.adapt_window_factor(
                     factor, streak, rollbacks > rb0, window_factor
@@ -1862,7 +1890,10 @@ class Simulation:
         obs = self.obs_session
         last = None
         while True:
-            active = (last is not None and last[2]) or spill.count
+            active = (
+                (last is not None and last[2]) or spill.count
+                or self._force_spill  # injected force_spill fault
+            )
             if active:
                 with metrics_mod.span(obs, "spill"):
                     stop_at = spill_mod.manage(self, spill, stop)
@@ -1871,6 +1902,9 @@ class Simulation:
             # whole-host spill residency is only exact with a manage pass
             # between consecutive windows (core/spill.py manage docstring)
             wpd = 1 if spill.count else windows_per_dispatch
+            if self._fault_plane_active():
+                # hand off at the next injection/checkpoint mark
+                stop_at = min(stop_at, self._fault_mark())
             with metrics_mod.span(obs, "dispatch", windows=wpd):
                 self.state, mn, press, occ = self._run_to(
                     self.state, self.params, stop_at, wpd
@@ -1882,6 +1916,8 @@ class Simulation:
             # gearing: a red-zone early exit upshifts (one pool re-sort)
             # before the spill tier would pay host drain round-trips
             shifted = self._gear_tick(occ, press=press)
+            if self._fault_plane_active():
+                self._handoff_tick(mn)
             if mn >= stop and spill.min_time >= stop and not press:
                 break
             cur = (mn, spill.count, press)
@@ -1895,6 +1931,195 @@ class Simulation:
                     "experimental.event_capacity"
                 )
             last = cur
+
+    # -- fault-tolerance plane (shadow_tpu/faults) + auto-checkpointing --
+
+    def attach_faults(self, faults) -> None:
+        """Arm a parsed fault plan (list of faults.plan.Fault). Device and
+        file ops execute at handoff boundaries; proc ops are not valid on
+        the device plane (the builder/CLI routes those to ProcessDriver)."""
+        from shadow_tpu.faults import FaultInjector
+
+        self.fault_injector = FaultInjector(faults) if faults else None
+
+    def configure_auto_checkpoint(
+        self, ckpt_dir: str, every_ns: int, retain: int = 3
+    ) -> None:
+        """Arm crash-consistent ring checkpoints every `every_ns` of sim
+        time, written at handoff boundaries (core/checkpoint.save_ring).
+        Safe to call after resume: ring numbering continues past existing
+        entries and the next boundary is derived from the restored clock."""
+        from shadow_tpu.core import checkpoint as ckpt_mod
+
+        self.checkpoint_dir = str(ckpt_dir)
+        self.checkpoint_every_ns = int(every_ns)
+        self.checkpoint_retain = max(1, int(retain))
+        now = int(np.max(np.asarray(jax.device_get(self.state.now))))
+        if self.checkpoint_every_ns > 0:
+            self._ckpt_next_t = (
+                (now // self.checkpoint_every_ns) + 1
+            ) * self.checkpoint_every_ns
+        entries = ckpt_mod.ring_entries(self.checkpoint_dir)
+        self._ckpt_seq = entries[-1][0] + 1 if entries else 0
+
+    def resume_from(self, ckpt_dir: str) -> dict:
+        """Restore the newest checkpoint in `ckpt_dir` that passes
+        integrity validation, falling back past corrupt entries."""
+        from shadow_tpu.core import checkpoint as ckpt_mod
+
+        info = ckpt_mod.resume_latest(self, ckpt_dir)
+        self.fault_counters["resume_fallbacks"] += info["fallbacks"]
+        return info
+
+    def _resolve_host_id(self, host) -> int:
+        if isinstance(host, (int, np.integer)):
+            hid = int(host)
+        else:
+            cfg = getattr(self, "config", None)
+            names = [h.name for h in cfg.hosts] if cfg is not None else []
+            if host not in names:
+                raise ValueError(
+                    f"kill_host: unknown host {host!r} (named lookup needs "
+                    f"a config-built sim; known: {names[:8]})"
+                )
+            hid = names.index(host)
+        if not 0 <= hid < self.num_hosts:
+            raise ValueError(
+                f"kill_host: host id {hid} out of range [0, {self.num_hosts})"
+            )
+        return hid
+
+    def quarantine_host(self, host) -> int:
+        """Mark a simulated host dead (crashed-host semantic): its pending
+        device-plane events are drained now and at every subsequent
+        handoff — exchange-deferred rows that arrive later are caught by
+        the recurring drain, which is what makes quarantine compose with
+        the islands shard exchange. Events it already emitted remain in
+        flight (a crashed host's packets still arrive). Idempotent;
+        returns rows drained by this call."""
+        hid = self._resolve_host_id(host)
+        if hid in self._dead_hosts:
+            return 0
+        self._dead_hosts.add(hid)
+        self.fault_counters["hosts_quarantined"] += 1
+        obs = self.obs_session
+        if obs is not None and obs.tracer:
+            obs.tracer.fault("quarantine_host", host=hid)
+        return self._drain_dead()
+
+    def _drain_dead(self) -> int:
+        """Cancel pool + spill rows destined to quarantined hosts. Runs at
+        handoff boundaries only (the pool is about to be re-sorted by the
+        next window's merge; a freed NEVER row is just a free slot)."""
+        pool = self.state.pool
+        dead = jnp.asarray(sorted(self._dead_hosts), pool.dst.dtype)
+        mask = jnp.isin(pool.dst, dead) & (pool.time != NEVER)
+        n = int(jnp.sum(mask))
+        if n:
+            self.state = self.state.replace(
+                pool=pool.replace(time=jnp.where(mask, NEVER, pool.time))
+            )
+        sp = getattr(self, "_spill", None)
+        if sp is not None:
+            n += sp.drain_hosts(self._dead_hosts)
+        if n:
+            self.fault_counters["events_drained"] += n
+            self.state = obs_mod.bump_win(self.state, obs_mod.WIN_FAULTS)
+        return n
+
+    def _handoff_tick(self, mn: int) -> None:
+        """The fault-plane + auto-checkpoint hook every driver calls at
+        its handoff boundary (state synced, `mn` = committed frontier):
+        fire due device/file injections, drain quarantined hosts' events,
+        and write a ring checkpoint when the frontier crosses the next
+        checkpoint mark. Zero work — four attribute checks — when neither
+        faults nor checkpointing are configured."""
+        inj = self.fault_injector
+        obs = self.obs_session
+        drained_this_tick = False
+        if inj is not None and inj.pending:
+            from shadow_tpu.faults import injector as inj_mod
+            from shadow_tpu.faults import plan as plan_mod
+
+            for f in inj.due(mn, plan_mod.DEVICE_OPS | plan_mod.FILE_OPS):
+                if f.op == "kill_host":
+                    self.quarantine_host(f.host)
+                    drained_this_tick = True
+                elif f.op == "force_spill":
+                    self._force_spill = True
+                    self.state = obs_mod.bump_win(
+                        self.state, obs_mod.WIN_FAULTS
+                    )
+                else:  # corrupt_file
+                    touched = inj_mod.corrupt_file(
+                        f, default_dir=self.checkpoint_dir
+                    )
+                    self.fault_counters["files_corrupted"] += len(touched)
+                    self.state = obs_mod.bump_win(
+                        self.state, obs_mod.WIN_FAULTS
+                    )
+                if obs is not None and obs.tracer:
+                    obs.tracer.fault(
+                        "fault_injection", op=f.op, at_ns=f.at_ns
+                    )
+        if self._dead_hosts and not drained_this_tick:
+            # recurring drain: exchange-deferred / late-emitted rows for
+            # dead hosts are cancelled before the next window runs
+            self._drain_dead()
+        if self.checkpoint_every_ns and mn >= self._ckpt_next_t:
+            from shadow_tpu.core import checkpoint as ckpt_mod
+
+            t = min(int(mn), self.stop_time)
+            with metrics_mod.span(obs, "checkpoint"):
+                path, pruned = ckpt_mod.save_ring(
+                    self, self.checkpoint_dir, self._ckpt_seq, t,
+                    self.checkpoint_retain,
+                )
+            self._ckpt_seq += 1
+            self.fault_counters["checkpoints_written"] += 1
+            self.fault_counters["checkpoints_pruned"] += pruned
+            if obs is not None and obs.tracer:
+                obs.tracer.fault("checkpoint", sim_ns=t)
+            self._ckpt_next_t = (
+                (t // self.checkpoint_every_ns) + 1
+            ) * self.checkpoint_every_ns
+
+    def _fault_plane_active(self) -> bool:
+        """True when a handoff tick has work to do — the drivers skip the
+        tick (and any re-sync it would force) entirely otherwise."""
+        return (
+            self.fault_injector is not None
+            or bool(self._dead_hosts)
+            or bool(self.checkpoint_every_ns)
+        )
+
+    def _fault_mark(self) -> int:
+        """Earliest virtual time the fused drivers must create a handoff
+        boundary at: the next unfired device/file injection or the next
+        checkpoint mark. Multi-window dispatches clamp their stop time
+        here — otherwise a 64-window dispatch would sail seconds past a
+        scheduled injection and both the checkpoint cadence and the fault
+        plan's timing would degrade to dispatch granularity."""
+        mark = int(NEVER)
+        inj = self.fault_injector
+        if inj is not None:
+            from shadow_tpu.faults import plan as plan_mod
+
+            ops = plan_mod.DEVICE_OPS | plan_mod.FILE_OPS
+            for f in inj.faults:
+                if not f.fired and f.op in ops:
+                    mark = min(mark, f.at_ns)
+        if self.checkpoint_every_ns:
+            mark = min(mark, self._ckpt_next_t)
+        return mark
+
+    def fault_stats(self) -> dict:
+        """Fault-plane telemetry for metrics dumps (faults.* namespace,
+        schema v3) and bench rows."""
+        d = dict(self.fault_counters)
+        if self.fault_injector is not None:
+            d.update(self.fault_injector.stats())
+        return d
 
     def counters(self) -> dict[str, int]:
         c = jax.device_get(self.state.counters)

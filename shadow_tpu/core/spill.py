@@ -178,6 +178,27 @@ class HostSpill:
         )
         return t, d, s, q, k, p
 
+    def drain_hosts(self, dead) -> int:
+        """Fault plane (engine.quarantine_host): drop every parked row
+        destined to a dead host, all shards. Returns rows dropped. The
+        stale `_partial_min` of a killed partial host only over-clamps the
+        next window (conservative) — the following rebalance resets it."""
+        dead_arr = np.asarray(sorted(int(h) for h in dead), np.int64)
+        if dead_arr.size == 0:
+            return 0
+        dropped = 0
+        for sh in range(self.S):
+            t, d, s, q, k, p = self._rows[sh]
+            mask = np.isin(d, dead_arr)
+            n = int(mask.sum())
+            if n:
+                keep = ~mask
+                self._rows[sh] = (
+                    t[keep], d[keep], s[keep], q[keep], k[keep], p[keep]
+                )
+                dropped += n
+        return dropped
+
     def stats(self) -> dict:
         return {
             "spill_resident": self.count,
@@ -211,9 +232,15 @@ def manage(sim, spill: HostSpill, stop: int) -> int:
     occ = np.atleast_1d(np.asarray(jax.device_get(
         jnp.sum(pool.time != NEVER, axis=-1)
     )))
+    # fault plane (shadow_tpu/faults force_spill): one injected episode
+    # rebalances EVERY shard regardless of occupancy — exercises the
+    # drain/clamp/re-inject machinery under test control. One-shot.
+    force = bool(getattr(sim, "_force_spill", False))
+    if force:
+        sim._force_spill = False
     act = [
         sh for sh in range(S)
-        if occ[sh] >= hi or spill._rows[sh][0].shape[0]
+        if force or occ[sh] >= hi or spill._rows[sh][0].shape[0]
     ]
     if not act:
         return stop

@@ -1,0 +1,34 @@
+"""Fault-tolerance plane: deterministic fault injection + supervised
+recovery.
+
+Shadow's core promise is replicable experiments; a fault path that only
+ever runs by accident is a fault path that silently rots. This package
+makes failure a first-class, *scheduled* input: a fault plan is a list of
+virtual-time-keyed injections (kill/wedge a managed process, refuse an IPC
+reply, corrupt a checkpoint file, force a pool-overflow spill, kill a
+device host) executed at deterministic points — the driver's event heap on
+the managed plane, handoff boundaries on the device plane — so two runs
+with the same plan are bit-identical.
+
+  plan.py      fault-plan schema: parse/validate JSON documents and the
+               `faults:` config section's inline list
+  injector.py  runtime side: ordered injection bookkeeping per plane,
+               plus the file-corruption executor
+"""
+
+from shadow_tpu.faults.plan import (  # noqa: F401
+    DEVICE_OPS,
+    FILE_OPS,
+    PROC_OPS,
+    Fault,
+    FaultPlanError,
+    PLAN_KIND,
+    PLAN_SCHEMA_VERSION,
+    load_fault_plan,
+    parse_fault_plan,
+    validate_fault_plan_doc,
+)
+from shadow_tpu.faults.injector import (  # noqa: F401
+    FaultInjector,
+    corrupt_file,
+)

@@ -1,0 +1,97 @@
+"""Runtime half of the fault plane: injection bookkeeping + file corruptor.
+
+The injector itself holds no plane-specific logic — the ProcessDriver and
+the device Simulation each ask for the ops THEY execute (`due(...)`) at
+their own deterministic points (event heap vs handoff boundary) and apply
+them. Keeping execution in the owning plane keeps ordering identical run
+to run: the managed plane fires at exactly `at` on the virtual clock, the
+device plane at the first handoff whose committed frontier reaches `at`.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+
+from shadow_tpu.faults import plan as plan_mod
+
+
+class FaultInjector:
+    """Ordered, fire-once view over a parsed fault plan."""
+
+    def __init__(self, faults: list[plan_mod.Fault]):
+        self.faults = sorted(faults, key=lambda f: (f.at_ns, f.seq))
+        self.fired: list[plan_mod.Fault] = []
+        self.counts: dict[str, int] = {}
+
+    def mark_fired(self, f: plan_mod.Fault) -> None:
+        """Record an execution (callers that schedule faults themselves —
+        the ProcessDriver's event heap — bypass due())."""
+        if not f.fired:
+            f.fired = True
+            self.fired.append(f)
+            self.counts[f.op] = self.counts.get(f.op, 0) + 1
+
+    def due(self, now_ns: int, ops: frozenset[str] | set[str]) -> list:
+        """Faults with at <= now whose op is in `ops`, not yet fired —
+        marked fired and tallied on return (the caller MUST execute them)."""
+        out = []
+        for f in self.faults:
+            if f.fired or f.op not in ops:
+                continue
+            if f.at_ns > now_ns:
+                # sorted by at: nothing later can be due either, but keep
+                # scanning — earlier entries of OTHER planes interleave
+                continue
+            self.mark_fired(f)
+            out.append(f)
+        return out
+
+    @property
+    def pending(self) -> int:
+        return sum(1 for f in self.faults if not f.fired)
+
+    def stats(self) -> dict[str, int]:
+        d = {"injections_fired": len(self.fired),
+             "injections_pending": self.pending}
+        for op, n in sorted(self.counts.items()):
+            d[f"injected_{op}"] = n
+        return d
+
+
+def corrupt_file(f: plan_mod.Fault, default_dir: str | None = None) -> list[str]:
+    """Execute one corrupt_file fault: apply `mode` to every file matching
+    the glob (relative patterns resolve against f.dir or `default_dir`).
+    Returns the paths touched. Deterministic: matches are sorted, and the
+    flip mode XORs a fixed byte at a fixed offset — no RNG."""
+    pat = f.path
+    base = f.dir or default_dir
+    if base and not os.path.isabs(pat):
+        pat = os.path.join(base, pat)
+    touched = []
+    for path in sorted(glob.glob(pat)):
+        if not os.path.isfile(path):
+            continue
+        if f.mode == "delete":
+            os.unlink(path)
+        elif f.mode == "truncate":
+            size = os.path.getsize(path)
+            with open(path, "r+b") as fh:
+                fh.truncate(max(0, size // 2))
+        else:  # flip: XOR a 64-byte span mid-file (archive payload, not
+            # the zip end-of-central-directory, so the file still OPENS
+            # and only content verification can catch it; a span — not a
+            # single byte — so the damage cannot land entirely in zip
+            # padding that readers never touch)
+            size = os.path.getsize(path)
+            if size == 0:
+                continue
+            off = size // 2
+            n = min(64, size - off)
+            with open(path, "r+b") as fh:
+                fh.seek(off)
+                b = fh.read(n)
+                fh.seek(off)
+                fh.write(bytes(x ^ 0xFF for x in b))
+        touched.append(path)
+    return touched

@@ -1,0 +1,194 @@
+"""Fault-plan schema: virtual-time-keyed injections, validated up front.
+
+A plan is a JSON document (``--fault-plan plan.json``) or the inline
+``faults.inject`` list of the YAML config — same entry schema either way:
+
+    {
+      "kind": "shadow_tpu.fault_plan",
+      "schema_version": 1,
+      "faults": [
+        {"at": "2 s", "op": "kill_proc",    "proc": "client.0"},
+        {"at": "2 s", "op": "wedge_proc",   "proc": "client.0"},
+        {"at": "1 s", "op": "refuse_ipc",   "proc": "client.0", "count": 1},
+        {"at": "3 s", "op": "kill_host",    "host": 3},
+        {"at": "1 s", "op": "force_spill"},
+        {"at": "4 s", "op": "corrupt_file", "path": "ckpt-*.npz",
+         "mode": "flip"}
+      ]
+    }
+
+``at`` accepts the config time grammar (core/units.py; bare numbers are
+seconds). Ops are split by execution plane:
+
+  PROC_OPS    executed by the ProcessDriver at sim time ``at`` exactly
+              (scheduled on its event heap):
+                kill_proc   SIGKILL the named managed process's native
+                            image — the crashed-plugin case
+                wedge_proc  SIGSTOP it — the wedged-plugin case (detected
+                            by the IPC-timeout escalation ladder)
+                refuse_ipc  drop the next `count` driver→shim IPC replies
+                            (the shim blocks; same ladder detects it)
+  DEVICE_OPS  executed by the device engine at the first handoff boundary
+              whose committed frontier reaches ``at``:
+                kill_host   quarantine the host id/name: its pending pool
+                            events drain at every subsequent handoff
+                force_spill force one pool-overflow spill episode
+  FILE_OPS    executed by whichever plane runs, at the same points:
+                corrupt_file  truncate/flip/delete files matching a glob
+                              (checkpoint or spill artifacts) — proves
+                              resume integrity validation actually gates
+
+Validation mirrors obs/metrics.validate_metrics_doc: a reference
+validator (`validate_fault_plan_doc`) shared by the loader, the
+tools/validate_fault_plan.py CLI, and the tier-1 tests.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Optional
+
+from shadow_tpu.core import units
+
+PLAN_KIND = "shadow_tpu.fault_plan"
+PLAN_SCHEMA_VERSION = 1
+
+PROC_OPS = frozenset({"kill_proc", "wedge_proc", "refuse_ipc"})
+DEVICE_OPS = frozenset({"kill_host", "force_spill"})
+FILE_OPS = frozenset({"corrupt_file"})
+ALL_OPS = PROC_OPS | DEVICE_OPS | FILE_OPS
+
+CORRUPT_MODES = ("truncate", "flip", "delete")
+
+# per-op field contract: required / optional (beyond `at` + `op`)
+_FIELDS = {
+    "kill_proc": ({"proc"}, set()),
+    "wedge_proc": ({"proc"}, set()),
+    "refuse_ipc": ({"proc"}, {"count"}),
+    "kill_host": ({"host"}, set()),
+    "force_spill": (set(), set()),
+    "corrupt_file": ({"path"}, {"mode", "dir"}),
+}
+
+
+class FaultPlanError(ValueError):
+    pass
+
+
+@dataclasses.dataclass
+class Fault:
+    """One parsed injection. ``seq`` is the declaration index — the
+    deterministic tiebreak for same-timestamp faults."""
+
+    at_ns: int
+    op: str
+    seq: int = 0
+    proc: Optional[str] = None
+    host: Optional[int | str] = None
+    count: int = 1
+    path: Optional[str] = None
+    mode: str = "truncate"
+    dir: Optional[str] = None
+    fired: bool = False
+
+    def describe(self) -> str:
+        tgt = self.proc or self.host or self.path or ""
+        return f"{self.op}({tgt}) @ {self.at_ns}ns"
+
+
+def _parse_entry(i: int, d: dict) -> Fault:
+    if not isinstance(d, dict):
+        raise FaultPlanError(f"faults[{i}] must be an object, got {d!r}")
+    if "op" not in d:
+        raise FaultPlanError(f"faults[{i}]: `op` is required")
+    op = str(d["op"])
+    if op not in ALL_OPS:
+        raise FaultPlanError(
+            f"faults[{i}]: unknown op {op!r} (known: {sorted(ALL_OPS)})"
+        )
+    if "at" not in d:
+        raise FaultPlanError(f"faults[{i}] ({op}): `at` is required")
+    required, optional = _FIELDS[op]
+    allowed = {"at", "op"} | required | optional
+    unknown = set(d) - allowed
+    if unknown:
+        raise FaultPlanError(
+            f"faults[{i}] ({op}): unknown field(s) {sorted(unknown)}"
+        )
+    missing = required - set(d)
+    if missing:
+        raise FaultPlanError(
+            f"faults[{i}] ({op}): missing field(s) {sorted(missing)}"
+        )
+    try:
+        at_ns = units.parse_time_ns(d["at"])
+    except ValueError as e:
+        raise FaultPlanError(f"faults[{i}] ({op}): bad `at`: {e}") from e
+    if at_ns < 0:
+        raise FaultPlanError(f"faults[{i}] ({op}): `at` must be >= 0")
+    f = Fault(at_ns=at_ns, op=op, seq=i)
+    if "proc" in d:
+        f.proc = str(d["proc"])
+    if "host" in d:
+        f.host = d["host"] if isinstance(d["host"], int) else str(d["host"])
+    if "count" in d:
+        f.count = int(d["count"])
+        if f.count < 1:
+            raise FaultPlanError(f"faults[{i}] ({op}): count must be >= 1")
+    if "path" in d:
+        f.path = str(d["path"])
+    if "dir" in d and d["dir"] is not None:
+        f.dir = str(d["dir"])
+    if "mode" in d:
+        f.mode = str(d["mode"])
+        if f.mode not in CORRUPT_MODES:
+            raise FaultPlanError(
+                f"faults[{i}] ({op}): mode {f.mode!r} not in {CORRUPT_MODES}"
+            )
+    return f
+
+
+def validate_fault_plan_doc(doc: dict) -> None:
+    """Raise FaultPlanError unless `doc` conforms to the plan schema.
+    The reference validator behind tools/validate_fault_plan.py."""
+    if not isinstance(doc, dict):
+        raise FaultPlanError("fault plan must be a JSON object")
+    if doc.get("kind") != PLAN_KIND:
+        raise FaultPlanError(
+            f"fault plan kind {doc.get('kind')!r} != {PLAN_KIND!r}"
+        )
+    if doc.get("schema_version") != PLAN_SCHEMA_VERSION:
+        raise FaultPlanError(
+            f"fault plan schema_version {doc.get('schema_version')!r} != "
+            f"{PLAN_SCHEMA_VERSION}"
+        )
+    unknown = set(doc) - {"kind", "schema_version", "faults", "meta"}
+    if unknown:
+        raise FaultPlanError(f"unknown top-level field(s) {sorted(unknown)}")
+    faults = doc.get("faults")
+    if not isinstance(faults, list):
+        raise FaultPlanError("`faults` must be a list")
+    for i, d in enumerate(faults):
+        _parse_entry(i, d)
+
+
+def parse_fault_plan(entries: list) -> list[Fault]:
+    """Parse a bare injection list (a plan doc's `faults`, or the config's
+    inline `faults.inject`) into Fault records ordered by (at, seq)."""
+    if not isinstance(entries, list):
+        raise FaultPlanError("fault injections must be a list")
+    out = [_parse_entry(i, d) for i, d in enumerate(entries)]
+    out.sort(key=lambda f: (f.at_ns, f.seq))
+    return out
+
+
+def load_fault_plan(path: str) -> list[Fault]:
+    """Load and validate a fault-plan JSON file."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except json.JSONDecodeError as e:
+        raise FaultPlanError(f"{path}: not valid JSON: {e}") from e
+    validate_fault_plan_doc(doc)
+    return parse_fault_plan(doc["faults"])

@@ -20,7 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 from flax import struct
 
-BLOCK_VERSION = 2
+BLOCK_VERSION = 3
 
 # --- fixed window-plane slot indices (append-only; never renumber) ---
 WIN_WINDOWS = 0  # window steps executed (one per step() call)
@@ -31,7 +31,8 @@ WIN_ROLLBACKS = 4  # optimistic whole-window rollbacks
 WIN_OPT_STALLS = 5  # optimistic null-window exchange-retry stalls
 WIN_SPILL_FIRES = 6  # spill-tier manage episodes (shard rebalances)
 WIN_GEAR_SHIFTS = 7  # pool gear changes (core/gearbox.py re-sorts)
-NUM_WIN = 8
+WIN_FAULTS = 8  # fault-plane actions applied at handoffs (shadow_tpu/faults)
+NUM_WIN = 9
 
 WIN_NAMES = (
     "windows_run",
@@ -42,6 +43,7 @@ WIN_NAMES = (
     "opt_stalls",
     "spill_fires",
     "gear_shifts",
+    "fault_actions",
 )
 assert len(WIN_NAMES) == NUM_WIN
 

@@ -25,7 +25,7 @@ import numpy as np
 
 from shadow_tpu.obs import counters as obs_counters
 
-SCHEMA_VERSION = 2  # v2: gear.* counters/gauges (occupancy-adaptive gearing)
+SCHEMA_VERSION = 3  # v3: faults.* recovery counters (fault-tolerance plane)
 DOC_KIND = "shadow_tpu.metrics"
 
 # Histograms keep exact count/sum/min/max plus a bounded sample buffer for
@@ -205,6 +205,12 @@ def snapshot_device(sim, reg: MetricsRegistry) -> None:
         reg.counter_set("gear.shifts", int(g["gear_shifts"]))
         for lvl, n in g["gear_dispatches"].items():
             reg.counter_set(f"gear.dispatches.level{lvl}", int(n))
+    # fault-tolerance plane (schema v3): injections fired, quarantines,
+    # drained events, auto-checkpoint ring activity (shadow_tpu/faults)
+    fault_stats = getattr(sim, "fault_stats", None)
+    if fault_stats is not None:
+        for k, v in fault_stats().items():
+            reg.counter_set(f"faults.{k}", int(v))
 
 
 class ObsSession:

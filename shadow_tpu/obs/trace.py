@@ -66,6 +66,12 @@ class ChromeTracer:
             ev["args"] = args
         self.events.append(ev)
 
+    def fault(self, name: str, **args) -> None:
+        """Fault-plane marker (injection fired, quarantine, checkpoint
+        fallback): an instant event under its own category so Perfetto
+        can filter recovery actions from the sim timeline."""
+        self.instant(name, cat="fault", **args)
+
     def counter(self, name: str, values: dict) -> None:
         """Counter ("C") sample: Perfetto draws each key as a series."""
         self.events.append({

@@ -606,7 +606,10 @@ class IslandSimulation(Simulation):
         obs = self.obs_session
         last = None
         while True:
-            if (last is not None and last[2]) or spill.count:
+            if (
+                (last is not None and last[2]) or spill.count
+                or self._force_spill  # injected force_spill fault
+            ):
                 with metrics_mod.span(obs, "spill"):
                     self._maybe_rebalance()
                     stop_at = spill_mod.manage(self, spill, stop)
@@ -615,6 +618,9 @@ class IslandSimulation(Simulation):
             # single-window dispatches while the spill is active (exactness
             # requires a manage pass between windows — core/spill.py)
             wpd = 1 if spill.count else windows_per_dispatch
+            if self._fault_plane_active():
+                # hand off at the next injection/checkpoint mark
+                stop_at = min(stop_at, self._fault_mark())
             with metrics_mod.span(obs, "dispatch", windows=wpd):
                 self.state, mn, press, occ, w = self._run_to(
                     self.state, self.params, stop_at, wpd
@@ -629,6 +635,8 @@ class IslandSimulation(Simulation):
             # gearing: a red-zone early exit upshifts (one pool re-sort)
             # before the spill tier would pay host drain round-trips
             shifted = self._gear_tick(occ, press=press)
+            if self._fault_plane_active():
+                self._handoff_tick(mn)
             if mn >= stop and spill.min_time >= stop and not press:
                 break
             cur = (mn, spill.count, press)
@@ -657,6 +665,10 @@ class IslandSimulation(Simulation):
             with metrics_mod.span(obs, "spill"):
                 stop_at = spill_mod.manage(self, spill, stop)
             min_next = int(jax.device_get(jnp.min(self.state.pool.time)))
+            if self._fault_plane_active():
+                self._handoff_tick(min_next)
+                # a drain may have removed the frontier event
+                min_next = int(jax.device_get(jnp.min(self.state.pool.time)))
             if min_next >= stop_at:
                 if min_next >= stop and spill.min_time >= stop:
                     break
@@ -895,6 +907,9 @@ class IslandSimulation(Simulation):
             windows += 1
             if obs is not None:
                 obs.round_done(self)
+            if self._fault_plane_active():
+                self._handoff_tick(min_next)
+                min_next = int(jax.device_get(jnp.min(self.state.pool.time)))
             if adaptive:
                 factor, streak = self.adapt_window_factor(
                     factor, streak, rollbacks > rb0, window_factor

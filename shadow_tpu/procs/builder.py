@@ -80,6 +80,17 @@ def build_process_driver(
     driver.log_stamp = cfg.experimental.use_shim_log_stamps
     driver.cpu_ns_per_syscall = cfg.experimental.cpu_ns_per_syscall
     driver.cpu_threshold_ns = cfg.experimental.max_unapplied_cpu_latency
+    # fault-tolerance plane (shadow_tpu/faults): recovery policy + armed
+    # injections; corrupt_file globs resolve against the data directory
+    driver.on_proc_failure = cfg.faults.on_proc_failure
+    driver.ipc_timeout_retries = cfg.faults.ipc_timeout_retries
+    faults = cfg.faults.load_faults()
+    if faults:
+        from shadow_tpu.faults import FaultInjector
+
+        driver.fault_injector = FaultInjector(faults)
+    if data_root is not None:
+        driver.fault_dir = str(data_root)
 
     # Register hinted hosts first so a sequential allocation for an
     # unhinted host can never claim another host's requested address

@@ -32,6 +32,7 @@ import errno
 import heapq
 import os
 import random
+import signal as os_signal
 import struct as struct_mod
 import subprocess
 import time as wall_time
@@ -465,6 +466,7 @@ class ManagedProcess:
         self.stdout_path = stdout_path
         self.stderr_path = stderr_path
         self.stopped_by_sim = False  # stopped at stop_time, not app exit
+        self.faulted = False  # killed/quarantined by the fault plane
         self.popen: subprocess.Popen | None = None
         self.exited = False  # process-level liveness (threads track their own)
         self.fds: dict[int, object] = {}
@@ -545,6 +547,13 @@ class ManagedProcess:
         # counter, and allocating over it would silently drop the alias
         while self.next_fd in self.fds:
             self.next_fd += 1
+        if self.next_fd >= VIRT_NOFILE:
+            # clamp against the shim's virtual RLIMIT_NOFILE soft limit:
+            # the app observes EMFILE, exactly what its getrlimit() predicts
+            raise FdLimitError(
+                f"{self.name}: virtual fd space exhausted "
+                f"(RLIMIT_NOFILE soft limit {VIRT_NOFILE})"
+            )
         fd = self.next_fd
         self.next_fd += 1
         return fd
@@ -618,6 +627,9 @@ class SimHost:
     # CPU model (host/cpu.c): simulated processing time not yet applied to
     # the virtual clock
     cpu_unapplied: int = 0
+    # fault plane: a quarantined (crashed) host — its processes are dead
+    # and pending deliveries to it are drained instead of delivered
+    dead: bool = False
 
 
 def ip_from_str(s: str) -> int:
@@ -637,6 +649,26 @@ def _pack_epoll_event(events: int, data: int) -> bytes:
 
 class DriverError(RuntimeError):
     pass
+
+
+class ProcWedged(DriverError):
+    """A managed process stopped responding on its IPC channel and the
+    escalation ladder (bounded retries with backoff) is exhausted. The
+    on_proc_failure policy decides: abort re-raises, quarantine marks the
+    simulated host dead and the run continues."""
+
+
+class FdLimitError(DriverError):
+    """Virtual fd space exhausted (the shim's synthesized RLIMIT_NOFILE
+    soft limit). Dispatch translates this to -EMFILE for the app."""
+
+
+# Mirror of the shim's synthesized RLIMIT_NOFILE soft limit
+# (native/shim/shim.cpp rlim_init_locked): managed fds live in
+# [FD_BASE, VIRT_NOFILE), well clear of FD_BASE + any per-host socket
+# budget; alloc_fd clamps here so the driver can never hand out an fd the
+# app's own getrlimit() says cannot exist.
+VIRT_NOFILE = 65536
 
 
 class ProcessDriver:
@@ -750,6 +782,25 @@ class ProcessDriver:
         # second actually goes (service = syscall handling + channel waits,
         # device = bridge dispatches/readbacks, events = heap callbacks)
         self.plane_wall = {"service": 0.0, "device": 0.0, "events": 0.0}
+        # Fault-tolerance plane (shadow_tpu/faults): supervised recovery
+        # policy + deterministic injections. on_proc_failure governs what
+        # the supervisor does when the IPC-timeout escalation ladder
+        # exhausts: "abort" re-raises (the pre-fault-plane behavior),
+        # "quarantine" marks the simulated host dead and keeps running.
+        self.on_proc_failure = "abort"
+        # extra timed waits (doubling backoff) before declaring a
+        # non-responsive process wedged
+        self.ipc_timeout_retries = 1
+        self.fault_injector = None  # faults.FaultInjector (proc/file ops)
+        self.fault_dir: str | None = None  # corrupt_file default base dir
+        self.fault_counters = {
+            "hosts_quarantined": 0,
+            "procs_wedged": 0,
+            "events_drained": 0,
+            "ipc_retries": 0,
+            "ipc_replies_refused": 0,
+            "files_corrupted": 0,
+        }
 
     # ------------------------------------------------------------------
     # build API
@@ -1569,6 +1620,12 @@ class ProcessDriver:
     # ------------------------------------------------------------------
 
     def _deliver_dgram(self, src_addr, dst_addr, payload: bytes) -> None:
+        dst_host = self._host_by_ip(dst_addr[0])
+        if dst_host is not None and dst_host.dead:
+            # quarantined host: in-flight deliveries drain at their event
+            # time, like packets arriving at a crashed machine
+            self.fault_counters["events_drained"] += 1
+            return
         sock = self._udp_binds.get(dst_addr)
         if sock is None or not sock.owner.alive():
             return  # no listener: datagram vanishes (no ICMP in v1)
@@ -1631,6 +1688,11 @@ class ProcessDriver:
 
     def _deliver_stream(self, conn: Conn, payload: bytes) -> None:
         if conn.local_addr is not None:
+            h = self._host_by_ip(conn.local_addr[0])
+            if h is not None and h.dead:
+                self.fault_counters["events_drained"] += 1
+                return
+        if conn.local_addr is not None:
             self._track_rx(
                 conn.local_addr[0], "tcp",
                 conn.remote_addr or (0, 0), conn.local_addr, payload,
@@ -1679,16 +1741,22 @@ class ProcessDriver:
     def _dispatch(self, proc: ManagedProcess) -> None:
         """Handle one MSG_SYSCALL from proc (with optional per-handler wall
         timing — the USE_PERF_TIMERS analog, syscall_handler.c:80-83)."""
-        if not self.use_perf_timers:
-            return self._dispatch_inner(proc)
-        sysno = proc.channel.sysno
-        t0 = wall_time.perf_counter()
         try:
-            return self._dispatch_inner(proc)
-        finally:
-            self.syscall_times[sysno] = self.syscall_times.get(
-                sysno, 0.0
-            ) + (wall_time.perf_counter() - t0)
+            if not self.use_perf_timers:
+                return self._dispatch_inner(proc)
+            sysno = proc.channel.sysno
+            t0 = wall_time.perf_counter()
+            try:
+                return self._dispatch_inner(proc)
+            finally:
+                self.syscall_times[sysno] = self.syscall_times.get(
+                    sysno, 0.0
+                ) + (wall_time.perf_counter() - t0)
+        except FdLimitError as e:
+            # virtual RLIMIT_NOFILE clamp (alloc_fd): the app observes
+            # EMFILE — consistent with the limit its getrlimit() reports
+            log.logger.warning("%s: %s", proc.name, e, host=proc.host.name)
+            proc.channel.reply(-errno.EMFILE, sim_time_ns=self.now)
 
     def _dispatch_inner(self, proc: ManagedProcess) -> None:
         """Handle one MSG_SYSCALL from proc. Either replies (proc keeps
@@ -2663,16 +2731,30 @@ class ProcessDriver:
         elif isinstance(obj, SignalFd):
             # Linux signalfd semantics: a read consumes signals pending
             # for the READING process (matters after fork — the fd is
-            # inherited but each process's signal queue is its own)
+            # inherited but each process's signal queue is its own), and
+            # ONE read fills as many whole signalfd_siginfo records as the
+            # buffer holds — kernel behavior (fs/signalfd.c
+            # signalfd_read dequeues until the count is exhausted), not
+            # one record per read
             p = getattr(proc, "proc", proc)
-            for i, s in enumerate(p.sig_pending):
-                if (obj.mask >> (s - 1)) & 1:
-                    p.sig_pending.pop(i)
-                    # struct signalfd_siginfo: ssi_signo u32 first; the
-                    # remaining fields (errno/code/pid/...) read as zero
-                    buf = s.to_bytes(4, "little") + b"\x00" * 124
-                    self._resume(proc, 128, data=buf)
-                    return
+            max_rec = min(want // 128, ipc.IPC_DATA_MAX // 128)
+            recs = []
+            while len(recs) < max_rec:
+                idx = next(
+                    (j for j, s in enumerate(p.sig_pending)
+                     if (obj.mask >> (s - 1)) & 1),
+                    None,
+                )
+                if idx is None:
+                    break
+                s = p.sig_pending.pop(idx)
+                # struct signalfd_siginfo: ssi_signo u32 first; the
+                # remaining fields (errno/code/pid/...) read as zero
+                recs.append(s.to_bytes(4, "little") + b"\x00" * 124)
+            if recs:
+                buf = b"".join(recs)
+                self._resume(proc, len(buf), data=buf)
+                return
             # no matching signal for THIS process (raced, or readiness was
             # judged against another process's queue): a blocking reader
             # re-parks, a nonblocking one gets EAGAIN
@@ -2883,8 +2965,15 @@ class ProcessDriver:
 
     def _service_one(self, proc: ManagedThread) -> bool:
         """Wait for the thread's next message and handle it. Returns False
-        if the process exited instead of posting a message."""
+        if the process exited instead of posting a message.
+
+        Non-responsiveness escalates instead of aborting outright: after
+        the base service timeout, up to ipc_timeout_retries extra waits
+        with doubling backoff (the bounded-retry rung of the recovery
+        ladder); only then is the process declared wedged (ProcWedged),
+        which the service loop resolves via the on_proc_failure policy."""
         deadline = wall_time.monotonic() + self.service_timeout_s
+        attempt = 0
         while True:
             if proc.channel.wait_request(timeout_s=0.05):
                 break
@@ -2923,9 +3012,24 @@ class ProcessDriver:
                     f"dynamically linked executables"
                 )
             if wall_time.monotonic() > deadline:
-                raise DriverError(
+                if attempt < self.ipc_timeout_retries:
+                    attempt += 1
+                    backoff = self.service_timeout_s * (2 ** attempt)
+                    self.fault_counters["ipc_retries"] += 1
+                    log.logger.warning(
+                        "%s: no syscall within %.1fs; IPC retry %d/%d "
+                        "(backoff %.1fs)",
+                        proc.name, self.service_timeout_s, attempt,
+                        self.ipc_timeout_retries, backoff,
+                        host=proc.host.name,
+                    )
+                    deadline = wall_time.monotonic() + backoff
+                    continue
+                self.fault_counters["procs_wedged"] += 1
+                raise ProcWedged(
                     f"{proc.name}: no syscall within "
-                    f"{self.service_timeout_s}s (wedged managed process?)"
+                    f"{self.service_timeout_s}s (+{attempt} backoff "
+                    f"retries) — wedged managed process"
                 )
         mtype = proc.channel.msg_type
         if mtype == ipc.MSG_HELLO:
@@ -2984,6 +3088,121 @@ class ProcessDriver:
             p.popen.terminate()
         p.stdout, p.stderr = p.finish()
 
+    # ------------------------------------------------------------------
+    # fault plane: injections + supervised recovery (shadow_tpu/faults)
+    # ------------------------------------------------------------------
+
+    def _find_proc(self, name: str) -> ManagedProcess | None:
+        for p in self.procs:
+            if p.name == name:
+                return p
+        return None
+
+    def _execute_fault(self, f) -> None:
+        """Fire one scheduled injection at its virtual time (event-heap
+        callback: every live process is parked, so the process state the
+        fault observes is deterministic)."""
+        self.fault_injector.mark_fired(f)
+        log.logger.warning("fault injection: %s", f.describe())
+        if f.op == "corrupt_file":
+            from shadow_tpu.faults import injector as inj_mod
+
+            touched = inj_mod.corrupt_file(f, default_dir=self.fault_dir)
+            self.fault_counters["files_corrupted"] += len(touched)
+            return
+        if f.op == "kill_host":
+            h = (
+                self._host_by_name(f.host) if isinstance(f.host, str)
+                else (self.hosts[f.host] if 0 <= f.host < len(self.hosts)
+                      else None)
+            )
+            if h is None:
+                raise DriverError(
+                    f"fault plan names unknown host {f.host!r}"
+                )
+            self._quarantine_host(h, "injected kill_host")
+            return
+        p = self._find_proc(f.proc)
+        if p is None:
+            raise DriverError(
+                f"fault plan names unknown process {f.proc!r} "
+                f"(known: {[q.name for q in self.procs[:8]]})"
+            )
+        if not p.alive() or p.popen is None or p.popen.poll() is not None:
+            log.logger.warning(
+                "fault %s: process already exited; no-op", f.describe()
+            )
+            return
+        if f.op == "kill_proc":
+            # the crashed-plugin case: SIGKILL the native image. Under the
+            # quarantine policy the whole simulated host dies with it
+            # (crashed-host semantic); under abort the exit surfaces as a
+            # normal nonzero exit code via the service loop.
+            p.faulted = True
+            os.kill(p.popen.pid, os_signal.SIGKILL)
+            if self.on_proc_failure == "quarantine":
+                self._quarantine_host(
+                    p.host, f"injected kill_proc({p.name})"
+                )
+        elif f.op == "wedge_proc":
+            # the wedged-plugin case: freeze the image; detection is the
+            # IPC-timeout escalation ladder's job (ProcWedged -> policy)
+            p.faulted = True
+            os.kill(p.popen.pid, os_signal.SIGSTOP)
+        elif f.op == "refuse_ipc":
+            # drop the next `count` replies on the main-thread channel:
+            # the shim blocks exactly as if the reply were lost
+            ch = p.threads[0].channel
+            if ch is not None:
+                ch.refuse_next += f.count
+                self.fault_counters["ipc_replies_refused"] += f.count
+                p.faulted = True
+
+    def _quarantine_host(self, host: SimHost, reason: str) -> None:
+        """Mark a simulated host dead and keep the run going (the crashed
+        -host semantic real Shadow models when a plugin segfaults): every
+        process on the host is killed and collected, its network footprint
+        is released (peers see EOF), and pending deliveries TO the host
+        are drained at their event time instead of delivered. Idempotent;
+        deterministic because it only ever runs from event-heap callbacks
+        or the service loop's policy rung — both fixed points of the
+        virtual-time schedule."""
+        if host.dead:
+            return
+        host.dead = True
+        self.fault_counters["hosts_quarantined"] += 1
+        log.logger.warning(
+            "quarantining host %s: %s", host.name, reason, host=host.name
+        )
+        mine = [p for p in self.procs if p.host is host]
+        # kill every native image FIRST, then collect: a fork child holds
+        # its parent's stdout pipe end, so collecting the parent while any
+        # descendant lives would deadlock in communicate()
+        for p in mine:
+            if p.alive() and p.popen is not None and p.popen.poll() is None:
+                p.faulted = True
+                p.popen.kill()
+        for p in mine:
+            if not p.alive():
+                continue
+            p.faulted = True
+            self._release_fds(p)
+            if p.popen is not None or p.channel:
+                p.stdout, p.stderr = p.finish()
+            else:
+                # never spawned: cancel by marking dead (the scheduled
+                # _spawn checks alive())
+                p.state = ManagedProcess.EXITED
+                p.exited = True
+                p.stdout, p.stderr = b"", b""
+
+    def fault_stats(self) -> dict:
+        """Fault-plane telemetry (faults.* namespace, schema v3)."""
+        d = dict(self.fault_counters)
+        if self.fault_injector is not None:
+            d.update(self.fault_injector.stats())
+        return d
+
     def run(self) -> None:
         """Run the simulation until stop_time or all processes exit."""
         # Point the global logger's sim clock at this driver for the run
@@ -3000,6 +3219,21 @@ class ProcessDriver:
             self._schedule(p.start_time, lambda p=p: self._spawn(p))
             if p.stop_time is not None:
                 self._schedule(p.stop_time, lambda p=p: self._stop_process(p))
+        if self.fault_injector is not None:
+            # deterministic injection: faults ride the same (time, seq)
+            # event heap as every other scheduled action, keyed to virtual
+            # time — two runs with the same plan fire them identically
+            from shadow_tpu.faults import plan as plan_mod
+
+            ops = plan_mod.PROC_OPS | plan_mod.FILE_OPS | {"kill_host"}
+            for f in self.fault_injector.faults:
+                if f.op in ops:
+                    self._schedule(f.at_ns, lambda f=f: self._execute_fault(f))
+                else:
+                    log.logger.warning(
+                        "fault plan op %s has no managed-plane executor; "
+                        "ignored", f.op,
+                    )
         if self.heartbeat_interval and self.heartbeat_fn:
 
             def beat():
@@ -3027,7 +3261,14 @@ class ProcessDriver:
                     for t in p.threads:
                         while t.state == ManagedThread.RUNNING and t.channel:
                             progressed = True
-                            if not self._service_one(t):
+                            try:
+                                if not self._service_one(t):
+                                    break
+                            except ProcWedged as e:
+                                # recovery ladder exhausted: the policy rung
+                                if self.on_proc_failure != "quarantine":
+                                    raise
+                                self._quarantine_host(p.host, str(e))
                                 break
                     if self._release_ready(p) is not None:
                         progressed = True
@@ -3045,6 +3286,12 @@ class ProcessDriver:
                 # app-visible effects are deferred to the events' times.
                 for d in self.bridge.sync(horizon):
                     if isinstance(d, Delivery):
+                        if self.hosts[d.dst_host].dead:
+                            # quarantined host: device-plane deliveries for
+                            # it are cancelled at the handoff boundary
+                            self.bridge.take_payload(d.handle)
+                            self.fault_counters["events_drained"] += 1
+                            continue
                         data = self.bridge.take_payload(d.handle)
                         src_addr = (self.hosts[d.src_host].ip, d.src_port)
                         dst_addr = (self.hosts[d.dst_host].ip, d.dst_port)

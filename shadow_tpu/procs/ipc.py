@@ -98,6 +98,13 @@ class Channel:
             fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o600)
             os.ftruncate(fd, CHANNEL_SIZE)
         self.path = path
+        # Fault plane (shadow_tpu/faults refuse_ipc): while > 0, reply()
+        # consumes the pending request but never writes the response or
+        # posts the shim's semaphore — the managed process blocks exactly
+        # as if the reply were lost, and the driver's IPC-timeout
+        # escalation ladder is what must notice.
+        self.refuse_next = 0
+        self.refused_total = 0
         self._mm = mmap.mmap(fd, CHANNEL_SIZE)
         os.close(fd)
         self._buf = (ctypes.c_char * CHANNEL_SIZE).from_buffer(self._mm)
@@ -146,6 +153,10 @@ class Channel:
         the shim runs the handler before returning from the syscall."""
         if len(data) > IPC_DATA_MAX:
             raise ValueError("reply data too large")
+        if self.refuse_next > 0:
+            self.refuse_next -= 1
+            self.refused_total += 1
+            return  # injected fault: the reply is dropped on the floor
         struct.pack_into("<i", self._mm, OFF_TYPE, msg_type)
         struct.pack_into("<q", self._mm, OFF_RET, ret)
         struct.pack_into("<q", self._mm, OFF_SIM_TIME, sim_time_ns)

@@ -10,9 +10,15 @@ import pytest
 from shadow_tpu.procs import build as build_mod
 from shadow_tpu.procs.builder import build_process_driver
 
-pytestmark = pytest.mark.skipif(
-    not build_mod.toolchain_available(), reason="no native toolchain"
-)
+pytestmark = [
+    pytest.mark.skipif(
+        not build_mod.toolchain_available(), reason="no native toolchain"
+    ),
+    # compiling the device TCP machine for six configs takes several
+    # hundred seconds even with a warm XLA cache — out of the tier-1
+    # budgeted run, invoke this file directly instead
+    pytest.mark.slow,
+]
 
 NS_PER_MS = 1_000_000
 

@@ -1,10 +1,18 @@
 """Checkpoint/resume: run→snapshot→resume must be bit-exact vs an
-uninterrupted run (a capability the reference lacks — SURVEY.md §5.4)."""
+uninterrupted run (a capability the reference lacks — SURVEY.md §5.4),
+and every failure path — truncation, corruption, structure mismatch —
+must surface as a clean CheckpointError, never a zipfile/KeyError
+internal, with the retention ring falling back past bad entries."""
+
+import io
+import json
+import shutil
 
 import jax
 import numpy as np
 import pytest
 
+from shadow_tpu.core import checkpoint as ck
 from shadow_tpu.core import simtime
 from shadow_tpu.core.checkpoint import CheckpointError, load_meta
 from shadow_tpu.sim import build_simulation
@@ -74,3 +82,165 @@ def test_restore_rejects_other_config(tmp_path):
     other = build_simulation(YAML.replace("quantity: 8", "quantity: 4"))
     with pytest.raises(CheckpointError, match="hosts"):
         other.load_checkpoint(ckpt)
+
+
+# ---------------------------------------------------------------------------
+# failure paths: every corruption class must raise CheckpointError cleanly
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def good_ckpt(tmp_path_factory):
+    """One sim + one valid checkpoint shared by the failure-path tests
+    (they only ever copy/tamper the file, never mutate the good one)."""
+    d = tmp_path_factory.mktemp("ckpt")
+    sim = build_simulation(YAML)
+    sim.run(until=1 * simtime.NS_PER_SEC)
+    path = str(d / "good.npz")
+    sim.save_checkpoint(path)
+    return sim, path
+
+
+def _rewrite(src: str, dst: str, mutate) -> None:
+    """Load every entry of a checkpoint, apply `mutate(arrays, meta)`,
+    re-sign with a VALID digest, and write `dst` — forging structurally
+    wrong archives whose corruption only semantic validation can catch."""
+    with np.load(src) as z:
+        arrays = {k: z[k] for k in z.files if k != "__meta__"}
+        meta = json.loads(bytes(z["__meta__"]).decode())
+    mutate(arrays, meta)
+    meta["leaves"] = sorted(arrays)
+    meta["digest"] = ck._digest(arrays)
+    arrays["__meta__"] = np.frombuffer(
+        json.dumps(meta).encode(), dtype=np.uint8
+    )
+    buf = io.BytesIO()
+    np.savez_compressed(buf, **arrays)
+    with open(dst, "wb") as f:
+        f.write(buf.getvalue())
+
+
+def test_truncated_archive_clean_error(good_ckpt, tmp_path):
+    _, good = good_ckpt
+    bad = str(tmp_path / "trunc.npz")
+    shutil.copy(good, bad)
+    size = len(open(bad, "rb").read())
+    with open(bad, "r+b") as f:
+        f.truncate(size // 2)
+    with pytest.raises(CheckpointError):
+        ck.verify(bad)
+
+
+def test_flipped_bytes_fail_digest(good_ckpt, tmp_path):
+    sim, good = good_ckpt
+    bad = str(tmp_path / "flip.npz")
+    shutil.copy(good, bad)
+    size = len(open(bad, "rb").read())
+    off = size // 2
+    with open(bad, "r+b") as f:
+        f.seek(off)
+        span = f.read(64)
+        f.seek(off)
+        f.write(bytes(x ^ 0xFF for x in span))
+    with pytest.raises(CheckpointError):
+        ck.verify(bad)
+    with pytest.raises(CheckpointError):
+        ck.restore(sim, bad)
+
+
+def test_corrupt_meta_clean_error(good_ckpt, tmp_path):
+    _, good = good_ckpt
+    # __meta__ present but not JSON
+    bad = str(tmp_path / "badmeta.npz")
+    with np.load(good) as z:
+        arrays = {k: z[k] for k in z.files if k != "__meta__"}
+    arrays["__meta__"] = np.frombuffer(b"\x00garbage", dtype=np.uint8)
+    np.savez_compressed(bad, **arrays)
+    with pytest.raises(CheckpointError, match="__meta__"):
+        load_meta(bad)
+    # __meta__ missing entirely: CheckpointError, not KeyError
+    bad2 = str(tmp_path / "nometa.npz")
+    np.savez_compressed(bad2, **{k: v for k, v in arrays.items()
+                                 if k != "__meta__"})
+    with pytest.raises(CheckpointError, match="__meta__"):
+        load_meta(bad2)
+    # not a zip at all
+    bad3 = str(tmp_path / "notzip.npz")
+    with open(bad3, "wb") as f:
+        f.write(b"this is not an archive")
+    with pytest.raises(CheckpointError):
+        load_meta(bad3)
+
+
+def test_version_mismatch_clean_error(good_ckpt, tmp_path):
+    sim, good = good_ckpt
+    bad = str(tmp_path / "oldver.npz")
+    _rewrite(good, bad, lambda arrays, meta: meta.update(version=1))
+    with pytest.raises(CheckpointError, match="version"):
+        ck.restore(sim, bad)
+
+
+def test_leaf_shape_mismatch_clean_error(good_ckpt, tmp_path):
+    sim, good = good_ckpt
+    bad = str(tmp_path / "shape.npz")
+
+    def shrink_one(arrays, meta):
+        key = next(k for k in sorted(arrays) if arrays[k].ndim >= 1
+                   and arrays[k].shape[0] > 1)
+        arrays[key] = arrays[key][:-1]
+
+    _rewrite(good, bad, shrink_one)
+    with pytest.raises(CheckpointError, match="leaf"):
+        ck.restore(sim, bad)
+
+
+def test_leaf_set_mismatch_clean_error(good_ckpt, tmp_path):
+    sim, good = good_ckpt
+    bad = str(tmp_path / "missing.npz")
+    _rewrite(good, bad,
+             lambda arrays, meta: arrays.pop(sorted(arrays)[0]))
+    with pytest.raises(CheckpointError, match="structure mismatch"):
+        ck.restore(sim, bad)
+
+
+def test_ring_fallback_restores_previous_good(tmp_path):
+    """Retention ring: resume falls back past a corrupt newest checkpoint
+    to the previous good one, and the resumed run still finishes with the
+    uninterrupted run's exact totals."""
+    ref = build_simulation(YAML)
+    ref.run()
+
+    d = str(tmp_path / "ring")
+    sim = build_simulation(YAML)
+    sim.run(until=1 * simtime.NS_PER_SEC)
+    ck.save_ring(sim, d, 0, 1 * simtime.NS_PER_SEC, retain=3)
+    sim.run(until=2 * simtime.NS_PER_SEC)
+    ck.save_ring(sim, d, 1, 2 * simtime.NS_PER_SEC, retain=3)
+
+    # corrupt the NEWEST entry (XOR a span mid-file)
+    newest = ck.ring_entries(d)[-1][2]
+    size = len(open(newest, "rb").read())
+    with open(newest, "r+b") as f:
+        f.seek(size // 2)
+        span = f.read(64)
+        f.seek(size // 2)
+        f.write(bytes(x ^ 0xFF for x in span))
+
+    resumed = build_simulation(YAML)
+    info = resumed.resume_from(d)
+    assert info["fallbacks"] == 1
+    assert info["path"].endswith(f"ckpt-000000-{1 * simtime.NS_PER_SEC}.npz")
+    assert resumed.fault_counters["resume_fallbacks"] == 1
+    resumed.run()
+    assert resumed.counters() == ref.counters()
+    assert _states_equal(ref.state, resumed.state)
+
+
+def test_save_is_atomic_no_tmp_left(good_ckpt, tmp_path):
+    sim, _ = good_ckpt
+    path = str(tmp_path / "atomic.npz")
+    ck.save(sim, path)
+    assert ck.verify(path)["num_hosts"] == 8
+    # no temp droppings next to the checkpoint
+    leftovers = [p.name for p in tmp_path.iterdir() if ".tmp." in p.name]
+    assert leftovers == []

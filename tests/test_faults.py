@@ -1,0 +1,463 @@
+"""Fault-tolerance plane (shadow_tpu/faults): deterministic injection,
+supervised recovery, crash-consistent auto-checkpointing.
+
+The acceptance gates of ISSUE 3:
+  * determinism under faults — the same fault plan twice yields identical
+    committed-event counts and final state (device plane) / byte-identical
+    per-host outputs (managed plane), with unaffected hosts matching a
+    fault-free run;
+  * crash-resume exactness — SIGKILL the simulator between handoffs,
+    re-launch with --resume, and the final committed-event totals equal an
+    uninterrupted run's.
+"""
+
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from shadow_tpu.core import simtime
+from shadow_tpu.faults import plan as plan_mod
+from shadow_tpu.faults.injector import FaultInjector, corrupt_file
+from shadow_tpu.procs import build as build_mod
+from shadow_tpu.sim import build_simulation
+
+pytestmark = pytest.mark.quick
+
+NS = simtime.NS_PER_SEC
+
+DEVICE_YAML = """
+general:
+  stop_time: 4
+  seed: 13
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        node [ id 0 bandwidth_down "81920 Kibit" bandwidth_up "81920 Kibit" ]
+        edge [ source 0 target 0 latency "50 ms" packet_loss 0.0 ]
+      ]
+experimental:
+  event_capacity: 1024
+  events_per_host_per_window: 8
+hosts:
+  peer:
+    quantity: 8
+    app_model: phold
+    app_options: {msgload: 1, runtime: 3}
+"""
+
+
+def _states_equal(a, b) -> bool:
+    fa = jax.tree_util.tree_leaves(a)
+    fb = jax.tree_util.tree_leaves(b)
+    return all(
+        np.array_equal(np.asarray(x), np.asarray(y)) for x, y in zip(fa, fb)
+    )
+
+
+# ---------------------------------------------------------------------------
+# plan schema + injector bookkeeping (pure host code)
+# ---------------------------------------------------------------------------
+
+
+def test_plan_validation():
+    good = {
+        "kind": plan_mod.PLAN_KIND,
+        "schema_version": plan_mod.PLAN_SCHEMA_VERSION,
+        "faults": [
+            {"at": "1 s", "op": "kill_proc", "proc": "a.0"},
+            {"at": "500 ms", "op": "refuse_ipc", "proc": "a.0", "count": 2},
+            {"at": 2, "op": "kill_host", "host": 3},
+            {"at": "1 s", "op": "force_spill"},
+            {"at": "3 s", "op": "corrupt_file", "path": "*.npz",
+             "mode": "flip"},
+        ],
+    }
+    plan_mod.validate_fault_plan_doc(good)
+    faults = plan_mod.parse_fault_plan(good["faults"])
+    # ordered by (at, declaration index)
+    assert [f.op for f in faults] == [
+        "refuse_ipc", "kill_proc", "force_spill", "kill_host",
+        "corrupt_file",
+    ]
+    assert faults[1].at_ns == 1 * NS
+
+    for bad in (
+        {**good, "kind": "nope"},
+        {**good, "schema_version": 99},
+        {**good, "faults": [{"op": "kill_proc", "proc": "a.0"}]},  # no at
+        {**good, "faults": [{"at": 1, "op": "explode"}]},
+        {**good, "faults": [{"at": 1, "op": "kill_proc"}]},  # no proc
+        {**good, "faults": [{"at": 1, "op": "kill_proc", "proc": "a",
+                             "bogus": 1}]},
+        {**good, "faults": [{"at": 1, "op": "corrupt_file", "path": "x",
+                             "mode": "eat"}]},
+        {**good, "faults": [{"at": -1, "op": "force_spill"}]},
+        {**good, "extra_top": {}},
+    ):
+        with pytest.raises(plan_mod.FaultPlanError):
+            plan_mod.validate_fault_plan_doc(bad)
+
+
+def test_validator_tool(tmp_path):
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+    try:
+        import validate_fault_plan as tool
+    finally:
+        sys.path.pop(0)
+    good = tmp_path / "good.json"
+    good.write_text(json.dumps({
+        "kind": plan_mod.PLAN_KIND,
+        "schema_version": plan_mod.PLAN_SCHEMA_VERSION,
+        "faults": [{"at": "1 s", "op": "force_spill"}],
+    }))
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"kind": "x"}))
+    notjson = tmp_path / "notjson.json"
+    notjson.write_text("{nope")
+    assert tool.main([str(good)]) == 0
+    assert tool.main([str(bad)]) == 2
+    assert tool.main([str(notjson)]) == 2
+    assert tool.main([str(tmp_path / "absent.json")]) == 2
+
+
+def test_injector_planes_and_stats():
+    faults = plan_mod.parse_fault_plan([
+        {"at": 1, "op": "kill_proc", "proc": "a.0"},
+        {"at": 2, "op": "kill_host", "host": 0},
+        {"at": 3, "op": "force_spill"},
+    ])
+    inj = FaultInjector(faults)
+    # device plane at t=2.5s: only the device op fires; the proc op is
+    # another plane's and stays pending
+    due = inj.due(int(2.5 * NS), plan_mod.DEVICE_OPS)
+    assert [f.op for f in due] == ["kill_host"]
+    assert inj.pending == 2
+    # firing is once-only
+    assert inj.due(int(2.5 * NS), plan_mod.DEVICE_OPS) == []
+    s = inj.stats()
+    assert s["injections_fired"] == 1 and s["injected_kill_host"] == 1
+
+
+def test_corrupt_file_modes(tmp_path):
+    for i in range(2):
+        (tmp_path / f"f{i}.bin").write_bytes(bytes(range(200)))
+    f = plan_mod.parse_fault_plan(
+        [{"at": 0, "op": "corrupt_file", "path": "f*.bin", "mode": "flip"}]
+    )[0]
+    touched = corrupt_file(f, default_dir=str(tmp_path))
+    assert len(touched) == 2
+    data = (tmp_path / "f0.bin").read_bytes()
+    assert len(data) == 200 and data != bytes(range(200))
+    f2 = plan_mod.parse_fault_plan(
+        [{"at": 0, "op": "corrupt_file", "path": "f0.bin",
+          "mode": "truncate"}]
+    )[0]
+    corrupt_file(f2, default_dir=str(tmp_path))
+    assert (tmp_path / "f0.bin").stat().st_size == 100
+    f3 = plan_mod.parse_fault_plan(
+        [{"at": 0, "op": "corrupt_file", "path": "f1.bin", "mode": "delete"}]
+    )[0]
+    corrupt_file(f3, default_dir=str(tmp_path))
+    assert not (tmp_path / "f1.bin").exists()
+
+
+# ---------------------------------------------------------------------------
+# device plane: quarantine determinism, force_spill exactness, islands
+# ---------------------------------------------------------------------------
+
+
+def _device_run(inject=None, **build_kw):
+    sim = build_simulation(DEVICE_YAML)
+    if inject:
+        sim.attach_faults(plan_mod.parse_fault_plan(inject))
+    sim.run(**build_kw)
+    return sim
+
+
+def test_device_kill_host_deterministic():
+    """Acceptance gate: the same kill_host plan twice is bit-identical —
+    same committed counts, same final state digest."""
+    plan = [{"at": "1 s", "op": "kill_host", "host": 3}]
+    a = _device_run(plan)
+    b = _device_run(plan)
+    assert a.counters() == b.counters()
+    assert _states_equal(a.state, b.state)
+    assert a.fault_counters["hosts_quarantined"] == 1
+    assert a.fault_counters["events_drained"] >= 1
+    # the dead host stops committing: a fault-free run commits more
+    ref = _device_run()
+    assert ref.counters()["events_committed"] > a.counters()[
+        "events_committed"]
+    # obs block records the fault-plane actions (slot 8, block v3)
+    snap = a.obs_snapshot()
+    assert snap["win"]["fault_actions"] >= 1
+
+
+def _live_rows(sim):
+    """Canonical multiset of pending pool events: spill round-trips may
+    permute SLOTS (immaterial — core/spill.py docstring) but never the
+    event set itself."""
+    p = jax.device_get(sim.state.pool)
+    t = np.asarray(p.time).reshape(-1)
+    live = t != simtime.NEVER
+    cols = np.stack([
+        t[live],
+        np.asarray(p.dst).reshape(-1)[live],
+        np.asarray(p.src).reshape(-1)[live],
+        np.asarray(p.seq).reshape(-1)[live],
+        np.asarray(p.kind).reshape(-1)[live],
+    ])
+    return cols[:, np.lexsort(cols[::-1])]
+
+
+def test_device_force_spill_is_bit_exact():
+    """An injected spill episode exercises the drain/clamp/re-inject
+    machinery without changing ANY result: committed work, per-host
+    frontiers, and the pending-event multiset all match a fault-free run
+    (only pool SLOT order — immaterial — may differ)."""
+    ref = _device_run()
+    sim = _device_run([{"at": "1 s", "op": "force_spill"}])
+    assert sim.counters() == ref.counters()
+    assert np.array_equal(_live_rows(sim), _live_rows(ref))
+    sa, sr = sim.obs_snapshot(), ref.obs_snapshot()
+    assert np.array_equal(sa["host_events"], sr["host_events"])
+    assert np.array_equal(sa["host_last_t"], sr["host_last_t"])
+    assert sim.spill_stats()["spill_episodes"] >= 1
+
+
+def test_islands_kill_host_composes_with_exchange():
+    """Quarantine on the islands runner: rows for the dead host drain from
+    EVERY shard's pool (exchange-deferred rows included, via the recurring
+    handoff drain), and the run stays deterministic."""
+    yaml = DEVICE_YAML.replace(
+        "  event_capacity: 1024",
+        "  event_capacity: 1024\n  num_shards: 2",
+    )
+    assert "num_shards" in yaml
+
+    def run():
+        sim = build_simulation(yaml)
+        sim.attach_faults(plan_mod.parse_fault_plan(
+            [{"at": "1 s", "op": "kill_host", "host": 5}]
+        ))
+        sim.run()
+        return sim
+
+    a, b = run(), run()
+    assert a.counters() == b.counters()
+    assert _states_equal(a.state, b.state)
+    assert a.fault_counters["hosts_quarantined"] == 1
+    assert a.fault_counters["events_drained"] >= 1
+
+
+def test_device_metrics_carry_faults_namespace():
+    from shadow_tpu.obs import metrics as obs_metrics
+
+    sim = _device_run([{"at": "1 s", "op": "kill_host", "host": 0}])
+    reg = obs_metrics.MetricsRegistry()
+    obs_metrics.snapshot_device(sim, reg)
+    doc = reg.to_doc()
+    obs_metrics.validate_metrics_doc(doc)
+    assert doc["counters"]["faults.hosts_quarantined"] == 1
+    assert doc["counters"]["faults.injections_fired"] == 1
+    assert doc["counters"]["faults.events_drained"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# crash-resume exactness (acceptance gate): SIGKILL between handoffs,
+# re-launch with --resume, totals equal an uninterrupted run
+# ---------------------------------------------------------------------------
+
+
+def test_cli_sigkill_then_resume_matches_uninterrupted(tmp_path):
+    ref = build_simulation(DEVICE_YAML)
+    ref.run()
+    want = ref.counters()["events_committed"]
+
+    cfg = tmp_path / "c.yaml"
+    cfg.write_text(DEVICE_YAML)
+    data = tmp_path / "data"
+    ckdir = data / "checkpoints"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.abspath(os.path.join(os.path.dirname(__file__), "..",
+                                     ".jax_cache")),
+    )
+    env["PYTHONPATH"] = os.path.abspath(
+        os.path.join(os.path.dirname(__file__), "..")
+    ) + os.pathsep + env.get("PYTHONPATH", "")
+
+    p = subprocess.Popen(
+        [sys.executable, "-m", "shadow_tpu", str(cfg), "-d", str(data),
+         "--checkpoint-every", "1 s"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+    )
+    try:
+        deadline = time.time() + 240
+        while time.time() < deadline:
+            if ckdir.is_dir() and any(
+                n.startswith("ckpt-") and n.endswith(".npz")
+                for n in os.listdir(ckdir)
+            ):
+                break
+            if p.poll() is not None:
+                pytest.fail(
+                    "run finished before SIGKILL: "
+                    + p.stdout.read().decode()[-400:]
+                )
+            time.sleep(0.05)
+        else:
+            pytest.fail("no checkpoint appeared within 240 s")
+        os.kill(p.pid, signal.SIGKILL)
+    finally:
+        p.wait()
+
+    r = subprocess.run(
+        [sys.executable, "-m", "shadow_tpu", str(cfg), "-d", str(data),
+         "--resume", str(ckdir)],
+        env=env, capture_output=True, text=True, timeout=300,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "resumed from" in r.stderr
+    m = re.search(r"done: 8 hosts, (\d+) events", r.stdout)
+    assert m, r.stdout
+    assert int(m.group(1)) == want
+
+
+# ---------------------------------------------------------------------------
+# managed plane: kill/wedge/refuse + quarantine policy (needs toolchain)
+# ---------------------------------------------------------------------------
+
+toolchain = pytest.mark.skipif(
+    not build_mod.toolchain_available(), reason="no native toolchain"
+)
+
+GML_50MS = (
+    'graph [\n'
+    '  node [ id 0 bandwidth_down "100 Mbit" bandwidth_up "100 Mbit" ]\n'
+    '  edge [ source 0 target 0 latency "50 ms" packet_loss 0.0 ]\n'
+    ']\n'
+)
+
+
+def _pairs_cfg(apps, faults: dict):
+    """Two independent UDP echo pairs: pair A finishes early, pair B's
+    client stays busy (40 pings x 100 ms RTT) — the fault target."""
+    return {
+        "general": {"stop_time": "6 s", "seed": 7},
+        "network": {"graph": {"type": "gml", "inline": GML_50MS}},
+        "faults": faults,
+        "hosts": {
+            "servera": {"processes": [
+                {"path": apps["udp_echo_server"], "args": "9000 3"}]},
+            "clienta": {"processes": [
+                {"path": apps["udp_echo_client"], "args": "servera 9000 3",
+                 "start_time": "1 s"}]},
+            "serverb": {"processes": [
+                {"path": apps["udp_echo_server"], "args": "9000 40"}]},
+            "clientb": {"processes": [
+                {"path": apps["udp_echo_client"], "args": "serverb 9000 40",
+                 "start_time": "1 s"}]},
+        },
+    }
+
+
+def _run_managed(apps, faults: dict, tmp, tag, timeout_s=None, retries=None):
+    from shadow_tpu.core.config import load_config
+    from shadow_tpu.procs.builder import build_process_driver
+
+    data = tmp / f"data_{tag}"
+    cfg = load_config(_pairs_cfg(apps, faults))
+    driver = build_process_driver(cfg, data_root=data)
+    if timeout_s is not None:
+        driver.service_timeout_s = timeout_s
+    if retries is not None:
+        driver.ipc_timeout_retries = retries
+    driver.run()
+    outs = {
+        str(p.relative_to(data)): p.read_bytes()
+        for p in sorted(data.rglob("*.stdout"))
+    }
+    return driver, outs
+
+
+@toolchain
+def test_managed_kill_proc_quarantine_deterministic(apps, tmp_path):
+    """Acceptance gate: kill one managed process mid-run under quarantine
+    — two runs are byte-identical, and the UNAFFECTED pair's outputs match
+    a fault-free run exactly."""
+    faults = {
+        "on_proc_failure": "quarantine",
+        "inject": [{"at": "3 s", "op": "kill_proc", "proc": "clientb.0"}],
+    }
+    d1, o1 = _run_managed(apps, faults, tmp_path, "a")
+    d2, o2 = _run_managed(apps, faults, tmp_path, "b")
+    assert o1 == o2
+    assert d1.counters == d2.counters
+    assert d1.fault_counters == d2.fault_counters
+    assert d1.fault_counters["hosts_quarantined"] == 1
+    # non-faulted processes all succeeded; faulted ones excluded
+    for p in d1.procs:
+        if not p.faulted:
+            assert p.exit_code in (0, None), (p.name, p.exit_code)
+    # unaffected pair matches the fault-free run byte for byte
+    _, o_ref = _run_managed(apps, {}, tmp_path, "ref")
+    for k in o_ref:
+        if "hosts/servera" in k or "hosts/clienta" in k:
+            assert o1[k] == o_ref[k], k
+
+
+@toolchain
+def test_managed_wedge_recovery_quarantine(apps, tmp_path):
+    """SIGSTOP-wedged process: the escalation ladder (retry with backoff,
+    then policy) quarantines the host and the run completes."""
+    faults = {
+        "on_proc_failure": "quarantine",
+        "inject": [{"at": "3 s", "op": "wedge_proc", "proc": "clientb.0"}],
+    }
+    d, _ = _run_managed(apps, faults, tmp_path, "wedge",
+                        timeout_s=0.4, retries=1)
+    assert d.fault_counters["procs_wedged"] == 1
+    assert d.fault_counters["ipc_retries"] >= 1
+    assert d.fault_counters["hosts_quarantined"] == 1
+    assert d.hosts[[h.name for h in d.hosts].index("clientb")].dead
+
+
+@toolchain
+def test_managed_refuse_ipc_recovery(apps, tmp_path):
+    """A dropped IPC reply wedges the shim exactly like a lost message;
+    the same ladder detects it and quarantine keeps the run alive."""
+    faults = {
+        "on_proc_failure": "quarantine",
+        "inject": [{"at": "2 s", "op": "refuse_ipc", "proc": "clientb.0"}],
+    }
+    d, _ = _run_managed(apps, faults, tmp_path, "refuse",
+                        timeout_s=0.4, retries=1)
+    assert d.fault_counters["ipc_replies_refused"] == 1
+    assert d.fault_counters["hosts_quarantined"] == 1
+
+
+@toolchain
+def test_managed_wedge_abort_policy_raises(apps, tmp_path):
+    """Default policy: a wedged process still aborts the run loudly."""
+    from shadow_tpu.procs.driver import ProcWedged
+
+    faults = {
+        "on_proc_failure": "abort",
+        "inject": [{"at": "3 s", "op": "wedge_proc", "proc": "clientb.0"}],
+    }
+    with pytest.raises(ProcWedged):
+        _run_managed(apps, faults, tmp_path, "abort",
+                     timeout_s=0.4, retries=0)

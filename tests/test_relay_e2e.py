@@ -10,9 +10,14 @@ import pytest
 from shadow_tpu.procs import build as build_mod
 from shadow_tpu.procs.builder import build_process_driver
 
-pytestmark = pytest.mark.skipif(
-    not build_mod.toolchain_available(), reason="no native toolchain"
-)
+pytestmark = [
+    pytest.mark.skipif(
+        not build_mod.toolchain_available(), reason="no native toolchain"
+    ),
+    # chained device-TCP circuits: the netstack compile alone blows the
+    # tier-1 budget — invoke this file directly instead
+    pytest.mark.slow,
+]
 
 RELAY_PORT = 9200
 EXIT_PORT = 9300

@@ -42,8 +42,10 @@ def test_wide_syscall_surface(apps):
     lt = [l for l in out.splitlines() if l.startswith("ok localtime")][0]
     assert lt.split()[2] == "1", lt
     assert "1970-01-01" in lt, lt  # UTC rendering of the sim epoch
-    # rlimits are the deterministic synthesized table, not the machine's
-    assert "ok rlimit-nofile 1024 262144" in out, out
+    # rlimits are the deterministic synthesized table, not the machine's;
+    # the NOFILE soft limit must clear FD_BASE + the managed-fd budget
+    # (procs/driver.VIRT_NOFILE mirrors it)
+    assert "ok rlimit-nofile 65536 262144" in out, out
     # getrusage serves the virtual clock as CPU time (sim t >= 1s here)
     ru = [l for l in out.splitlines() if l.startswith("ok rusage")][0]
     assert ru.split()[2].startswith("1."), ru

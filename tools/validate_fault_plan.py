@@ -1,0 +1,58 @@
+#!/usr/bin/env python
+"""Validate a fault-plan JSON document against the documented schema.
+
+Mirrors the metrics validator's role (obs/metrics.validate_metrics_doc):
+one reference check shared by the simulator's loader, CI gates, and
+downstream tooling. Exit 0 on a valid plan, 2 with a one-line diagnosis
+otherwise — never a traceback for malformed input.
+
+  python tools/validate_fault_plan.py plan.json [more.json ...]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = sys.argv[1:] if argv is None else argv
+    if not args or "-h" in args or "--help" in args:
+        print(__doc__.strip(), file=sys.stderr)
+        return 0 if args else 2
+    from shadow_tpu.faults.plan import (
+        FaultPlanError,
+        parse_fault_plan,
+        validate_fault_plan_doc,
+    )
+
+    rc = 0
+    for path in args:
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except FileNotFoundError:
+            print(f"{path}: no such file", file=sys.stderr)
+            rc = 2
+            continue
+        except json.JSONDecodeError as e:
+            print(f"{path}: not valid JSON: {e}", file=sys.stderr)
+            rc = 2
+            continue
+        try:
+            validate_fault_plan_doc(doc)
+            faults = parse_fault_plan(doc["faults"])
+        except FaultPlanError as e:
+            print(f"{path}: INVALID: {e}", file=sys.stderr)
+            rc = 2
+            continue
+        by_op: dict[str, int] = {}
+        for fl in faults:
+            by_op[fl.op] = by_op.get(fl.op, 0) + 1
+        ops = ", ".join(f"{k}×{v}" for k, v in sorted(by_op.items()))
+        print(f"{path}: OK ({len(faults)} injection(s): {ops or 'none'})")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
