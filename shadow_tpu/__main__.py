@@ -355,6 +355,14 @@ def _run_device_plane(
 
 
 def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if argv and argv[0] == "sweep":
+        # batched multi-experiment execution (shadow_tpu/fleet): expand a
+        # `sweep:` config matrix into a job queue and run it as ONE
+        # vmapped device fleet — `python -m shadow_tpu sweep --help`
+        from shadow_tpu.fleet.cli import main as sweep_main
+
+        return sweep_main(argv[1:])
     args = _build_parser().parse_args(argv)
     from shadow_tpu.core.config import ConfigError, load_config
 
@@ -371,6 +379,15 @@ def main(argv: list[str] | None = None) -> int:
     if args.show_config:
         print(_dump_config(cfg), end="")
         return 0
+
+    if cfg.sweep_raw is not None:
+        print(
+            "error: this file carries a `sweep:` matrix (a multi-"
+            "experiment fleet); run it with `python -m shadow_tpu sweep "
+            f"{args.config}` instead of the single-run CLI",
+            file=sys.stderr,
+        )
+        return 2
 
     has_procs = any(h.processes for h in cfg.hosts)
     has_apps = any(h.app_model for h in cfg.hosts)

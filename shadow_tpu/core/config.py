@@ -483,19 +483,72 @@ class FaultOptions:
 
 
 @dataclasses.dataclass
+class FleetOptions:
+    """`fleet` section: batched multi-experiment execution knobs
+    (shadow_tpu/fleet; consumed by the `sweep` CLI subcommand). These are
+    scheduler-plane values — they never compile into the window kernel,
+    so sweep jobs may carry them without breaking kernel sharing."""
+
+    lanes: int = 0  # device lanes; 0 = one lane per job
+    deadline_s: Optional[float] = None  # wall-clock budget per job
+    sync: str = "conservative"  # "conservative" | "optimistic"
+    windows_per_dispatch: int = 32
+    checkpoint_every: int = 0  # ns of fleet frontier; 0 = off
+    checkpoint_dir: Optional[str] = None
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FleetOptions":
+        _check_fields(
+            "fleet", d,
+            {"lanes", "deadline_s", "sync", "windows_per_dispatch",
+             "checkpoint_every", "checkpoint_dir"},
+        )
+        out = cls()
+        if "lanes" in d:
+            out.lanes = int(d["lanes"])
+            if out.lanes < 0:
+                raise ConfigError("fleet.lanes must be >= 0")
+        if d.get("deadline_s") is not None:
+            out.deadline_s = float(d["deadline_s"])
+            if out.deadline_s <= 0:
+                raise ConfigError("fleet.deadline_s must be > 0")
+        if "sync" in d:
+            v = str(d["sync"]).lower()
+            if v not in ("conservative", "optimistic"):
+                raise ConfigError(
+                    f"fleet.sync must be conservative|optimistic, got {v!r}"
+                )
+            out.sync = v
+        if "windows_per_dispatch" in d:
+            out.windows_per_dispatch = int(d["windows_per_dispatch"])
+            if out.windows_per_dispatch < 1:
+                raise ConfigError("fleet.windows_per_dispatch must be >= 1")
+        if d.get("checkpoint_every") is not None:
+            out.checkpoint_every = units.parse_time_ns(d["checkpoint_every"])
+        if d.get("checkpoint_dir") is not None:
+            out.checkpoint_dir = str(d["checkpoint_dir"])
+        return out
+
+
+@dataclasses.dataclass
 class Config:
     general: GeneralOptions
     network: NetworkOptions
     experimental: ExperimentalOptions
     hosts: list[HostOptions]
     faults: FaultOptions = dataclasses.field(default_factory=FaultOptions)
+    fleet: FleetOptions = dataclasses.field(default_factory=FleetOptions)
+    # raw `sweep:` section, if present: expanded by shadow_tpu/fleet/sweep
+    # (the `sweep` CLI subcommand); the single-run CLI refuses such files
+    # with a pointer there instead of silently running only the base config
+    sweep_raw: Optional[dict] = None
 
     @classmethod
     def from_dict(cls, d: dict) -> "Config":
         _check_fields(
             "config", d,
             {"general", "network", "experimental", "host_defaults", "hosts",
-             "faults"},
+             "faults", "fleet", "sweep"},
         )
         if "general" not in d:
             raise ConfigError("general section is required")
@@ -505,6 +558,7 @@ class Config:
         network = NetworkOptions.from_dict(d["network"] or {})
         experimental = ExperimentalOptions.from_dict(d.get("experimental") or {})
         faults = FaultOptions.from_dict(d.get("faults") or {})
+        fleet = FleetOptions.from_dict(d.get("fleet") or {})
         defaults = d.get("host_defaults") or {}
         hosts: list[HostOptions] = []
         for name, hd in (d.get("hosts") or {}).items():
@@ -512,7 +566,8 @@ class Config:
         # Deterministic host ordering regardless of YAML dict order, matching
         # the reference's BTreeMap iteration (configuration.rs:75-76).
         hosts.sort(key=lambda h: h.name)
-        return cls(general, network, experimental, hosts, faults)
+        return cls(general, network, experimental, hosts, faults, fleet,
+                   d.get("sweep"))
 
     def graph_gml(self) -> str:
         g = self.network.graph

@@ -1375,6 +1375,93 @@ def make_window_step(
 
 
 # ---------------------------------------------------------------------------
+# Driver kernel factories
+# ---------------------------------------------------------------------------
+#
+# Module-level so the fleet runner (shadow_tpu/fleet) can vmap them over a
+# leading JOB axis: every argument that varies per job (runahead, stop) is a
+# traced value, never a closed-over Python constant. The Simulation methods
+# below delegate here with their own runahead baked in.
+
+
+def make_run_to(step, hi: int):
+    """Build run_to(state, params, runahead, stop, max_windows) ->
+    (state, min_next, pressed, occupancy): the fused conservative window
+    loop of the single-pool engine. `runahead` and `stop` are traced (the
+    fleet passes per-job values); `hi` is the bound gear's red-zone mark
+    (a compile-time int — every fleet lane shares the compiled pool
+    shape, so it is shared too)."""
+
+    def run_to(state: SimState, params: NetParams, runahead, stop,
+               max_windows):
+        """Advance up to max_windows windows (or until stop). Bounding
+        the on-device while_loop keeps each dispatch short — long single
+        dispatches can trip accelerator-runtime watchdogs.
+
+        Exits early (third return value True) when pool occupancy
+        crosses the spill red zone — the mark is PER-GEAR (`hi` is the
+        bound gear's) — so the driver can upshift, or drain overflow to
+        host memory BEFORE the merge would drop rows (core/spill.py) —
+        one compare per window, no extra sorts. The final occupancy
+        rides back as the fourth value: it is the gearing decision
+        signal, fetched on the sync the driver already pays."""
+        runahead = jnp.asarray(runahead, jnp.int64)
+        stop = jnp.asarray(stop, jnp.int64)
+        max_windows = jnp.asarray(max_windows, jnp.int32)
+
+        def cond(c):
+            state, mn, w = c
+            occ = jnp.sum(state.pool.time != NEVER)
+            return (mn < stop) & (w < max_windows) & (occ < hi)
+
+        def body(c):
+            state, mn, w = c
+            ws = mn
+            we = jnp.minimum(ws + runahead, stop)
+            state, mn = step(state, params, ws, we)
+            return state, mn, w + 1
+
+        mn0 = jnp.min(state.pool.time)
+        state, mn, _ = jax.lax.while_loop(
+            cond, body, (state, mn0, jnp.int32(0))
+        )
+        occ = jnp.sum(state.pool.time != NEVER)
+        return state, mn, occ >= hi, occ
+
+    return run_to
+
+
+def make_attempt(step):
+    """Build attempt(state, params, ws, we) -> (state, min_next, viol):
+    one optimistic window processed to completion ON DEVICE. All four
+    arguments are traced, so the factory is directly vmappable over a
+    leading job axis (the fleet's per-lane speculative windows)."""
+
+    def attempt(state: SimState, params: NetParams, ws, we):
+        """Process the window [ws, we) to completion: sub-step until no
+        pool events remain below we, or a speculation violation surfaces
+        (state.xmit_min != NEVER). One dispatch per attempt."""
+        ws = jnp.asarray(ws, jnp.int64)
+        we = jnp.asarray(we, jnp.int64)
+
+        def cond(c):
+            _, mn, v = c
+            return (mn < we) & (v == simtime.NEVER)
+
+        def body(c):
+            st, mn, _ = c
+            st2, mn2 = step(st, params, jnp.maximum(mn, ws), we)
+            return st2, mn2, st2.xmit_min
+
+        mn0 = jnp.min(state.pool.time)
+        return jax.lax.while_loop(
+            cond, body, (state, mn0, jnp.asarray(simtime.NEVER, jnp.int64))
+        )
+
+    return attempt
+
+
+# ---------------------------------------------------------------------------
 # Simulation driver (controller/manager analog)
 # ---------------------------------------------------------------------------
 
@@ -1634,41 +1721,11 @@ class Simulation:
         }
 
     def _make_run_to(self, step, hi: int):
+        lane = make_run_to(step, hi)
         runahead = jnp.int64(self.runahead)
 
         def run_to(state: SimState, params: NetParams, stop, max_windows):
-            """Advance up to max_windows windows (or until stop). Bounding
-            the on-device while_loop keeps each dispatch short — long single
-            dispatches can trip accelerator-runtime watchdogs.
-
-            Exits early (third return value True) when pool occupancy
-            crosses the spill red zone — the mark is PER-GEAR (`hi` is the
-            bound gear's) — so the driver can upshift, or drain overflow to
-            host memory BEFORE the merge would drop rows (core/spill.py) —
-            one compare per window, no extra sorts. The final occupancy
-            rides back as the fourth value: it is the gearing decision
-            signal, fetched on the sync the driver already pays."""
-            stop = jnp.asarray(stop, jnp.int64)
-            max_windows = jnp.asarray(max_windows, jnp.int32)
-
-            def cond(c):
-                state, mn, w = c
-                occ = jnp.sum(state.pool.time != NEVER)
-                return (mn < stop) & (w < max_windows) & (occ < hi)
-
-            def body(c):
-                state, mn, w = c
-                ws = mn
-                we = jnp.minimum(ws + runahead, stop)
-                state, mn = step(state, params, ws, we)
-                return state, mn, w + 1
-
-            mn0 = jnp.min(state.pool.time)
-            state, mn, _ = jax.lax.while_loop(
-                cond, body, (state, mn0, jnp.int32(0))
-            )
-            occ = jnp.sum(state.pool.time != NEVER)
-            return state, mn, occ >= hi, occ
+            return lane(state, params, runahead, stop, max_windows)
 
         return run_to
 
@@ -1715,28 +1772,7 @@ class Simulation:
         return windows
 
     def _make_attempt(self, step):
-        def attempt(state: SimState, params: NetParams, ws, we):
-            """Process the window [ws, we) to completion ON DEVICE: sub-step
-            until no pool events remain below we, or a speculation violation
-            surfaces (state.xmit_min != NEVER). One dispatch per attempt."""
-            ws = jnp.asarray(ws, jnp.int64)
-            we = jnp.asarray(we, jnp.int64)
-
-            def cond(c):
-                _, mn, v = c
-                return (mn < we) & (v == simtime.NEVER)
-
-            def body(c):
-                st, mn, _ = c
-                st2, mn2 = step(st, params, jnp.maximum(mn, ws), we)
-                return st2, mn2, st2.xmit_min
-
-            mn0 = jnp.min(state.pool.time)
-            return jax.lax.while_loop(
-                cond, body, (state, mn0, jnp.asarray(simtime.NEVER, jnp.int64))
-            )
-
-        return attempt
+        return make_attempt(step)
 
     # -- optimistic synchronization: speculate long windows, roll back on
     # violation (SURVEY §7.6). Pure-array state makes rollback free: the

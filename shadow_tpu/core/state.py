@@ -229,6 +229,64 @@ class SimState:
         return self.replace(subs=subs)
 
 
+# ---------------------------------------------------------------------------
+# Fleet batch axis (shadow_tpu/fleet): F independent jobs stacked along a
+# NEW leading axis over every state/params leaf. The window kernel is
+# vmapped over it — per-job halt comes from per-lane (runahead, stop)
+# window bounds, so a finished job's lane freezes (its fused-loop cond is
+# false) without mutating any other lane. These helpers are the only
+# sanctioned way to build/read/replace a lane: they preserve pytree
+# structure exactly, so the compiled fleet kernel never retraces on a
+# lane swap.
+# ---------------------------------------------------------------------------
+
+
+def stack_pytrees(trees: list):
+    """Stack identically-structured pytrees along a new leading axis.
+    Leaf shape/dtype mismatches raise with the offending key path (the
+    fleet's job-compatibility error surface)."""
+    import jax
+
+    flat0, treedef = jax.tree_util.tree_flatten_with_path(trees[0])
+    cols = [[leaf for _, leaf in flat0]]
+    for t in trees[1:]:
+        flat, td = jax.tree_util.tree_flatten_with_path(t)
+        if td != treedef:
+            raise ValueError(
+                "fleet jobs carry different state structures (subsystem "
+                "or telemetry config differs); jobs sharing one kernel "
+                "must be built from compatible configs"
+            )
+        for (path, a), b in zip(flat0, (leaf for _, leaf in flat)):
+            ja, jb = jnp.asarray(a), jnp.asarray(b)
+            if ja.shape != jb.shape or ja.dtype != jb.dtype:
+                raise ValueError(
+                    f"fleet leaf {jax.tree_util.keystr(path)}: "
+                    f"{jb.shape}/{jb.dtype} vs template {ja.shape}/"
+                    f"{ja.dtype} — jobs sharing one kernel must compile "
+                    f"identical shapes"
+                )
+        cols.append([leaf for _, leaf in flat])
+    stacked = [jnp.stack(col) for col in zip(*cols)]
+    return jax.tree_util.tree_unflatten(treedef, stacked)
+
+
+def slice_lane(tree, lane: int):
+    """Read one job's slice out of a stacked fleet pytree (device-side
+    views; the solo layout the lane was admitted with)."""
+    import jax
+
+    return jax.tree.map(lambda x: x[lane], tree)
+
+
+def set_lane(tree, lane: int, solo):
+    """Replace lane `lane` of a stacked fleet pytree with a solo-layout
+    pytree (the lane-swap write). Structure must match the stack."""
+    import jax
+
+    return jax.tree.map(lambda s, n: s.at[lane].set(n), tree, solo)
+
+
 def make_host_state(
     num_hosts: int, host_vertex: np.ndarray, cpu_cost: np.ndarray | None = None
 ) -> HostState:

@@ -1,0 +1,33 @@
+"""Scenario fleet: batched multi-experiment execution with a job scheduler.
+
+Public surface:
+
+  expand_sweep / load_sweep / load_job_list   sweep matrix → job list
+  JobSpec                                     one experiment of a fleet
+  build_fleet / FleetSimulation               the batched runner
+  save_fleet / resume_fleet                   fleet checkpointing
+  FleetError / SweepError                     configuration-shaped errors
+"""
+
+from shadow_tpu.fleet.checkpoint import resume_fleet, save_fleet
+from shadow_tpu.fleet.engine import FleetError, FleetSimulation, build_fleet
+from shadow_tpu.fleet.sweep import (
+    JobSpec,
+    SweepError,
+    expand_sweep,
+    load_job_list,
+    load_sweep,
+)
+
+__all__ = [
+    "FleetError",
+    "FleetSimulation",
+    "JobSpec",
+    "SweepError",
+    "build_fleet",
+    "expand_sweep",
+    "load_job_list",
+    "load_sweep",
+    "resume_fleet",
+    "save_fleet",
+]

@@ -1,0 +1,190 @@
+"""Fleet checkpoint/resume: per-job state slices + a scheduler manifest.
+
+A fleet checkpoint is a directory holding
+
+  manifest.json       scheduler-plane truth: every job's spec + status +
+                      harvested results, lane assignments, the fleet gear,
+                      and per-lane fault state — written LAST, atomically,
+                      so a crash mid-checkpoint leaves the previous
+                      manifest pointing at the previous slices;
+  job-<name>.npz      one core/checkpoint.py archive per RUNNING lane:
+                      the lane's state slice in the SOLO layout (the same
+                      digest-verified crash-consistent format solo runs
+                      use), so a fleet slice is also directly loadable
+                      into a solo Simulation for debugging.
+
+Resume rebuilds the fleet from the manifest: completed jobs keep their
+recorded results, running jobs restore their slices into fresh lanes, and
+still-queued jobs re-queue — so an interrupted sweep finishes from where
+it stopped instead of re-running finished experiments.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from shadow_tpu.core import checkpoint as ckpt_mod
+from shadow_tpu.core import state as state_mod
+from shadow_tpu.fleet import scheduler as sched_mod
+from shadow_tpu.fleet.sweep import JobSpec
+
+MANIFEST = "manifest.json"
+MANIFEST_KIND = "shadow_tpu.fleet_ckpt"
+MANIFEST_VERSION = 1
+
+
+class _LaneView:
+    """The solo-shaped handle core/checkpoint.save expects, wrapping one
+    lane's slice of the stacked fleet state."""
+
+    def __init__(self, fleet, lane: int, stop_time: int, runahead: int):
+        self.state = state_mod.slice_lane(fleet.state, lane)
+        self.num_hosts = fleet.template.num_hosts
+        self.stop_time = int(stop_time)
+        self.runahead = int(runahead)
+        self._gear_ladder = fleet._ladder
+        self._gear = fleet._gear
+
+
+def _job_file(name: str) -> str:
+    return f"job-{name}.npz"
+
+
+def save_fleet(fleet, ckpt_dir: str) -> str:
+    """Write every running lane's slice + the manifest. Returns the
+    manifest path."""
+    os.makedirs(ckpt_dir, exist_ok=True)
+    jobs = []
+    for rec in fleet.sched.records:
+        entry = {
+            "spec": rec.spec.to_json(),
+            "status": rec.status,
+            "summary": rec.summary(),
+        }
+        if rec.status == sched_mod.RUNNING and rec.lane is not None:
+            j = rec.lane
+            fname = _job_file(rec.name)
+            view = _LaneView(
+                fleet, j, fleet._stop[j], fleet._runahead[j]
+            )
+            ckpt_mod.save(view, os.path.join(ckpt_dir, fname))
+            lf = fleet._lane_faults[j]
+            entry["file"] = fname
+            entry["faults_state"] = {
+                "pending": [[int(a), int(h)] for a, h in lf.pending],
+                "dead": sorted(int(h) for h in lf.dead),
+                "stats": {k: int(v) for k, v in lf.stats.items()},
+            }
+        jobs.append(entry)
+    manifest = {
+        "kind": MANIFEST_KIND,
+        "version": MANIFEST_VERSION,
+        "lanes": fleet.lanes,
+        "gear": fleet._gear,
+        "ckpt_next_t": int(fleet._ckpt_next_t),
+        "stats": fleet.fleet_stats(),
+        "jobs": jobs,
+    }
+    path = os.path.join(ckpt_dir, MANIFEST)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(manifest, f, indent=1)
+            f.write("\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    return path
+
+
+def load_manifest(ckpt_dir: str) -> dict:
+    path = os.path.join(ckpt_dir, MANIFEST)
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except FileNotFoundError:
+        raise ckpt_mod.CheckpointError(
+            f"{ckpt_dir}: no fleet manifest ({MANIFEST}) to resume from"
+        ) from None
+    except json.JSONDecodeError as e:
+        raise ckpt_mod.CheckpointError(
+            f"{path}: corrupt fleet manifest: {e}"
+        ) from e
+    if doc.get("kind") != MANIFEST_KIND:
+        raise ckpt_mod.CheckpointError(
+            f"{path}: kind {doc.get('kind')!r} != {MANIFEST_KIND!r}"
+        )
+    if doc.get("version") != MANIFEST_VERSION:
+        raise ckpt_mod.CheckpointError(
+            f"{path}: fleet manifest version {doc.get('version')!r} != "
+            f"{MANIFEST_VERSION}"
+        )
+    return doc
+
+
+def resume_fleet(ckpt_dir: str, **fleet_kw):
+    """Rebuild a FleetSimulation from a fleet checkpoint directory.
+
+    Job order in the rebuilt fleet: formerly-running jobs first (their
+    lanes restore from the saved slices), then the still-queued jobs;
+    completed jobs are carried as terminal records with their recorded
+    results. Slice restores go through core/checkpoint.restore, so a
+    corrupt slice fails with a clean CheckpointError naming the job."""
+    from shadow_tpu.fleet.engine import FleetSimulation, _align_gear, \
+        _build_solo
+
+    doc = load_manifest(ckpt_dir)
+    running = [e for e in doc["jobs"] if e["status"] == sched_mod.RUNNING]
+    queued = [e for e in doc["jobs"] if e["status"] == sched_mod.QUEUED]
+    terminal = [
+        e for e in doc["jobs"]
+        if e["status"] in sched_mod.TERMINAL
+    ]
+    unfinished = running + queued
+    if not unfinished:
+        raise ckpt_mod.CheckpointError(
+            f"{ckpt_dir}: every job in the manifest is already terminal; "
+            f"nothing to resume"
+        )
+    specs = [JobSpec.from_json(e["spec"]) for e in unfinished + terminal]
+    lanes = min(int(doc["lanes"]), len(unfinished))
+    fleet_kw.setdefault("checkpoint_dir", ckpt_dir)
+    fleet = FleetSimulation(specs, lanes=lanes, **fleet_kw)
+    fleet._ckpt_next_t = int(doc.get("ckpt_next_t", fleet._ckpt_next_t))
+
+    # restore formerly-running lanes (the constructor admitted the first
+    # `lanes` unfinished jobs in order, so each running entry's record is
+    # already in a lane — find it and overwrite the fresh state)
+    by_name = {r.name: r for r in fleet.sched.records}
+    for e in running:
+        rec = by_name[e["spec"]["name"]]
+        if rec.lane is None:
+            continue  # more running jobs than lanes (shrunk fleet): requeue
+        sim = _build_solo(rec.spec)
+        ckpt_mod.restore(sim, os.path.join(ckpt_dir, e["file"]))
+        _align_gear(sim, fleet._gear)
+        fleet.state = state_mod.set_lane(fleet.state, rec.lane, sim.state)
+        fleet.params = state_mod.set_lane(fleet.params, rec.lane, sim.params)
+        fs = e.get("faults_state") or {}
+        lf = fleet._lane_faults[rec.lane]
+        lf.pending = [(int(a), int(h)) for a, h in fs.get("pending", [])]
+        lf.dead = set(fs.get("dead", []))
+        lf.stats = dict(fs.get("stats", {}))
+
+    # carry terminal jobs' recorded results (they never touch a lane)
+    for e in terminal:
+        rec = by_name[e["spec"]["name"]]
+        s = e["summary"]
+        rec.status = e["status"]
+        rec.reason = s.get("reason", "")
+        rec.events_committed = s.get("events_committed", 0)
+        rec.windows = s.get("windows", 0)
+        rec.frontier_ns = s.get("frontier_ns", -1)
+        rec.wall_s = s.get("wall_s", 0.0)
+        rec.counters = dict(s.get("counters", {}))
+        rec.faults = dict(s.get("faults", {}))
+    return fleet

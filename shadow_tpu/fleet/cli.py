@@ -1,0 +1,186 @@
+"""The `sweep` CLI subcommand: ``python -m shadow_tpu sweep sweep.yaml``.
+
+Expands a `sweep:` config matrix (or an explicit ``--fleet jobs.yaml`` job
+list) into a validated job queue and runs it as ONE batched device fleet
+(shadow_tpu/fleet). Prints one JSON result line per job as it completes
+plus a final summary line; exit status is nonzero when any job failed or
+timed out, mirroring the solo CLI's plugin-error accounting.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="shadow_tpu sweep",
+        description="batched multi-experiment execution (scenario fleet)",
+    )
+    p.add_argument(
+        "config", nargs="?",
+        help="sweep YAML: a base experiment config plus a `sweep:` matrix "
+             "section (docs/fleet.md)",
+    )
+    p.add_argument(
+        "--fleet", metavar="JOBS_YAML",
+        help="explicit job list (tools/expand_sweep.py output) instead of "
+             "a sweep config",
+    )
+    p.add_argument(
+        "--lanes", type=int, metavar="N",
+        help="device lanes (parallel jobs resident on the kernel's batch "
+             "axis); default fleet.lanes, else one lane per job",
+    )
+    p.add_argument(
+        "--sync", choices=("conservative", "optimistic"),
+        help="window synchronization mode (default fleet.sync)",
+    )
+    p.add_argument(
+        "--deadline-s", type=float, metavar="SECS",
+        help="wall-clock budget per job once admitted (default "
+             "fleet.deadline_s)",
+    )
+    p.add_argument(
+        "--list", action="store_true",
+        help="expand and validate the job list, print it, and exit",
+    )
+    p.add_argument(
+        "--metrics-out", metavar="PATH",
+        help="write the fleet metrics document (schema v4: fleet.jobs[*] "
+             "per-job rows) as JSON",
+    )
+    p.add_argument(
+        "--checkpoint-every", metavar="TIME",
+        help="write a fleet checkpoint (per-job slices + manifest) every "
+             "TIME of fleet frontier progress into --checkpoint-dir",
+    )
+    p.add_argument(
+        "--checkpoint-dir", metavar="DIR",
+        help="fleet checkpoint directory (default fleet.checkpoint_dir)",
+    )
+    p.add_argument(
+        "--resume", metavar="DIR",
+        help="resume a partially-finished fleet from its checkpoint "
+             "directory (completed jobs keep their results; running "
+             "lanes restore their slices)",
+    )
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    from shadow_tpu.core import units
+    from shadow_tpu.core.checkpoint import CheckpointError
+    from shadow_tpu.core.config import ConfigError, FleetOptions, load_config
+    from shadow_tpu.fleet import (
+        FleetError,
+        SweepError,
+        build_fleet,
+        load_job_list,
+        load_sweep,
+        resume_fleet,
+    )
+
+    fopts = FleetOptions()
+    jobs = []
+    try:
+        if args.resume is None:
+            if bool(args.config) == bool(args.fleet):
+                print(
+                    "error: pass exactly one of a sweep config or "
+                    "--fleet jobs.yaml (or --resume DIR)",
+                    file=sys.stderr,
+                )
+                return 2
+            if args.fleet:
+                jobs = load_job_list(args.fleet)
+            else:
+                jobs, sweep_opts = load_sweep(args.config)
+                # fleet options ride the base config's `fleet:` section;
+                # sweep.lanes is a convenience alias that wins over it
+                fopts = load_config(jobs[0].config).fleet
+                if sweep_opts.get("lanes") is not None:
+                    fopts.lanes = int(sweep_opts["lanes"])
+        if args.deadline_s is not None:
+            for j in jobs:
+                j.deadline_s = args.deadline_s
+        elif fopts.deadline_s is not None:
+            for j in jobs:
+                if j.deadline_s is None:
+                    j.deadline_s = fopts.deadline_s
+    except (SweepError, ConfigError, FileNotFoundError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    if args.list:
+        for j in jobs:
+            print(json.dumps(j.to_json()))
+        print(f"# {len(jobs)} job(s), validated", file=sys.stderr)
+        return 0
+
+    lanes = args.lanes if args.lanes is not None else (fopts.lanes or None)
+    sync = args.sync or fopts.sync
+    ckpt_dir = args.checkpoint_dir or fopts.checkpoint_dir
+    ckpt_every = (
+        units.parse_time_ns(args.checkpoint_every)
+        if args.checkpoint_every else fopts.checkpoint_every
+    )
+    if ckpt_every and not ckpt_dir:
+        print(
+            "error: --checkpoint-every needs --checkpoint-dir "
+            "(or fleet.checkpoint_dir)", file=sys.stderr,
+        )
+        return 2
+
+    t0 = time.monotonic()
+    try:
+        if args.resume:
+            fleet = resume_fleet(
+                args.resume, lanes=lanes,
+                checkpoint_every_ns=ckpt_every or 0,
+            )
+        else:
+            fleet = build_fleet(
+                jobs, lanes=lanes,
+                windows_per_dispatch=fopts.windows_per_dispatch,
+                checkpoint_dir=ckpt_dir,
+                checkpoint_every_ns=ckpt_every or 0,
+            )
+        if sync == "optimistic":
+            fleet.run_optimistic()
+        else:
+            fleet.run()
+    except (FleetError, SweepError, ConfigError, CheckpointError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    wall = time.monotonic() - t0
+
+    failed = 0
+    for row in fleet.results():
+        print(json.dumps(row), flush=True)
+        if row["status"] != "done":
+            failed += 1
+    stats = fleet.fleet_stats()
+    stats["wall_s"] = round(wall, 3)
+    print(json.dumps({"fleet": stats}), flush=True)
+    if ckpt_dir:
+        from shadow_tpu.fleet import save_fleet
+
+        save_fleet(fleet, ckpt_dir)
+    if args.metrics_out:
+        from shadow_tpu.obs import metrics as obs_metrics
+
+        reg = obs_metrics.MetricsRegistry()
+        obs_metrics.snapshot_fleet(fleet, reg)
+        reg.dump(args.metrics_out, meta={
+            "jobs": stats["jobs_total"], "wall_s": stats["wall_s"],
+        })
+        print(f"metrics written to {args.metrics_out}", file=sys.stderr)
+    if failed:
+        print(f"{failed} job(s) did not complete", file=sys.stderr)
+        return 1
+    return 0

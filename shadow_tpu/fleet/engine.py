@@ -1,0 +1,786 @@
+"""FleetSimulation: F independent experiments as ONE device program.
+
+Shadow runs parameter sweeps one process per config; every solo run on the
+TPU engine pays the same XLA compile and leaves the device under-occupied
+at small host counts. The fleet stacks per-job state/`NetParams`/seeds
+along a NEW leading vmap axis over the existing window kernel
+(core/state.py stack_pytrees) and vmaps the driver kernels over it:
+
+  * per-job HALT comes from per-lane (runahead, stop) window bounds — a
+    finished job's fused-loop condition goes false and JAX's batched
+    while_loop masks its lane, so jobs of different lengths finish
+    raggedly without mutating each other;
+  * a freed lane is REUSED: the host-side scheduler (fleet/scheduler.py)
+    swaps the next queued job's freshly-built state into the lane slice —
+    the compiled kernel's shapes never change, so the whole sweep costs
+    ONE window-kernel compile (`kernel_traces` is the auditable metric);
+  * the fleet axis composes with the islands engine: vmap-of-jobs
+    OUTSIDE, shards INSIDE (parallel/islands.make_shard_run_to), so each
+    lane is itself an S-shard island program;
+  * per-job results ship through sliced counter/obs blocks at harvest
+    (metrics schema v4 `fleet.jobs[*]`), and per-job checkpoint slices
+    (fleet/checkpoint.py) make a partially-finished fleet resumable;
+  * job-scoped fault quarantine: a `kill_host` injection in one job's
+    fault plan drains THAT lane's rows only (the PR-3 crashed-host
+    semantic, scoped to a lane), and a lane that cannot progress fails
+    its job — never the fleet.
+
+Determinism: a lane's trajectory is a pure function of its own (state,
+params, window bounds); vmapped integer kernels compute the same values
+as solo runs, so each job is bit-identical to the same scenario run solo
+(tests/test_fleet.py asserts this for conservative AND optimistic,
+global AND islands engines).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from shadow_tpu.core import gearbox, simtime
+from shadow_tpu.core import engine as engine_mod
+from shadow_tpu.core import state as state_mod
+from shadow_tpu.core.config import load_config
+from shadow_tpu.fleet.scheduler import (
+    DONE, FAILED, TIMEOUT, FleetScheduler, JobRecord,
+)
+from shadow_tpu.fleet.sweep import JobSpec, validate_jobs
+from shadow_tpu.obs import counters as obs_mod
+from shadow_tpu.parallel import islands as islands_mod
+
+NEVER = simtime.NEVER
+
+# Per-attempt sub-step ceiling for optimistic fleet rounds (mirrors
+# parallel/islands._MAX_SUBSTEPS: generous, but a pool-headroom stall
+# surfaces as a driver error in seconds rather than hanging).
+_MAX_SUBSTEPS = 4096
+
+
+class FleetError(ValueError):
+    pass
+
+
+@dataclasses.dataclass
+class _LaneFaults:
+    """Job-scoped fault plane: resolved kill_host injections + the lane's
+    dead-host set (drained recurringly, the crashed-host semantic)."""
+
+    pending: list  # [(at_ns, host_id)] sorted, unfired
+    dead: set
+    stats: dict
+
+    @classmethod
+    def empty(cls) -> "_LaneFaults":
+        return cls(pending=[], dead=set(), stats={})
+
+
+def _build_solo(spec: JobSpec):
+    """Build one job's solo Simulation (host-side: topology bake + initial
+    events; no kernel is ever dispatched on it)."""
+    from shadow_tpu.sim import build_simulation
+
+    return build_simulation(load_config(spec.config))
+
+
+def _align_gear(sim, level: int) -> None:
+    """Force a freshly-built solo sim onto the fleet's gear (pool shapes
+    must match the compiled lanes). Pure resize — no kernel rebind, no
+    telemetry bump (the solo kernels are never used)."""
+    if sim._gear == level:
+        return
+    spec = sim._gear_ladder[level]
+    pool, dropped = gearbox.resize_pool(sim.state.pool, spec.capacity)
+    if int(np.sum(np.asarray(jax.device_get(dropped)))):
+        raise FleetError(
+            f"job pool resize to gear {level} dropped events (initial "
+            f"occupancy exceeds the fleet gear's capacity)"
+        )
+    sim.state = sim.state.replace(pool=pool)
+    sim._gear = level
+
+
+class FleetSimulation:
+    """Batched multi-experiment runner over one compiled window kernel.
+
+    Build via `build_fleet(jobs, lanes=...)`. Drive with `run()`
+    (conservative windows) or `run_optimistic()` (per-lane speculative
+    windows); read `results()` / `fleet_stats()` afterwards.
+    """
+
+    def __init__(
+        self,
+        jobs: list[JobSpec],
+        lanes: int | None = None,
+        windows_per_dispatch: int = 32,
+        keep_final_subs: bool = False,
+        checkpoint_dir: str | None = None,
+        checkpoint_every_ns: int = 0,
+    ):
+        if not jobs:
+            raise FleetError("fleet needs at least one job")
+        validate_jobs(jobs)
+        L = min(len(jobs), lanes) if lanes else len(jobs)
+        self.sched = FleetScheduler(jobs, L)
+        self.lanes = L
+        self.windows_per_dispatch = int(windows_per_dispatch)
+        self.keep_final_subs = bool(keep_final_subs)
+        self.checkpoint_dir = checkpoint_dir
+        self.checkpoint_every_ns = int(checkpoint_every_ns)
+        self._ckpt_next_t = self.checkpoint_every_ns or int(NEVER)
+        self.kernel_traces = 0
+        self.gear_shifts = 0
+
+        # --- build the first wave of solo sims; the first is the template
+        # whose kernel config (handlers, shapes, ladder) the fleet adopts
+        sims = [_build_solo(r.spec) for r in self.sched.records[:L]]
+        t = sims[0]
+        self.template = t
+        self._islands = isinstance(t, islands_mod.IslandSimulation)
+        if self._islands and t.mode != "vmap":
+            raise FleetError(
+                "fleet islands jobs run in island_mode: vmap (virtual "
+                "shards batch under the job axis); shard_map composition "
+                "is not supported yet"
+            )
+        self._ladder = t._gear_ladder
+        self._shifter = (
+            gearbox.GearShifter(self._ladder) if len(self._ladder) > 1
+            else None
+        )
+        for s in sims[1:]:
+            self._check_compat(s)
+
+        # --- fleet gear: smallest level admitting every first-wave job
+        g = t._gear
+        for s in sims:
+            g = max(g, FleetScheduler.admission_gear(
+                self._ladder, self._occupancy_of(s), g
+            ))
+        self._gear = g
+        for s in sims:
+            _align_gear(s, g)
+
+        # --- stack along the new leading job axis ---
+        try:
+            self.state = state_mod.stack_pytrees([s.state for s in sims])
+            self.params = state_mod.stack_pytrees([s.params for s in sims])
+        except ValueError as e:
+            raise FleetError(str(e)) from e
+        self._runahead = np.array([s.runahead for s in sims], np.int64)
+        self._stop = np.array([s.stop_time for s in sims], np.int64)
+        self._lane_faults = [
+            self._resolve_faults(s) for s in sims
+        ]
+        for j, rec in enumerate(self.sched.records[:L]):
+            self.sched.admit(j, rec)
+
+        self._gear_fns: dict[int, dict] = {}
+        self._bind_gear()
+
+    # ------------------------------------------------------------------
+    # compatibility + admission plumbing
+    # ------------------------------------------------------------------
+
+    def _check_compat(self, sim) -> None:
+        t = self.template
+        if type(sim) is not type(t):
+            raise FleetError(
+                "fleet jobs mix engine layouts (islands vs global); the "
+                "sweep must hold experimental.num_shards fixed"
+            )
+        if sim.num_hosts != t.num_hosts:
+            raise FleetError(
+                f"fleet jobs disagree on host count ({sim.num_hosts} vs "
+                f"{t.num_hosts}); host topology compiles into the kernel"
+            )
+        lt = [(s.capacity, s.K) for s in t._gear_ladder]
+        ls = [(s.capacity, s.K) for s in sim._gear_ladder]
+        if lt != ls:
+            raise FleetError(
+                f"fleet jobs disagree on the pool gear ladder ({ls} vs "
+                f"{lt}); event_capacity / K / pool_gears compile into the "
+                f"kernel"
+            )
+
+    def _occupancy_of(self, sim) -> int:
+        """Live resident rows of a solo sim (max shard under islands) —
+        the admission-control signal."""
+        occ = jnp.sum(sim.state.pool.time != NEVER, axis=-1)
+        return int(np.max(np.asarray(jax.device_get(occ))))
+
+    def _resolve_faults(self, sim) -> _LaneFaults:
+        """Resolve the job's fault plan (kill_host only; validated by
+        fleet/sweep.py) into (at_ns, host_id) pairs against ITS config's
+        host names — job-scoped: the injections only ever touch this
+        lane."""
+        lf = _LaneFaults.empty()
+        cfg = getattr(sim, "config", None)
+        faults = cfg.faults.load_faults() if cfg is not None else []
+        for f in faults:
+            if f.op != "kill_host":  # validated earlier; belt-and-braces
+                raise FleetError(
+                    f"fleet fault plans support kill_host only, got {f.op!r}"
+                )
+            lf.pending.append((int(f.at_ns), sim._resolve_host_id(f.host)))
+        lf.pending.sort()
+        return lf
+
+    # ------------------------------------------------------------------
+    # kernel binding (one compiled program per active gear)
+    # ------------------------------------------------------------------
+
+    def _lane_step(self, spec: gearbox.GearSpec, optimistic: bool = False):
+        """The raw per-job window step in the template's layout."""
+        t = self.template
+        if self._islands:
+            isl = t._island_spec
+            if optimistic:
+                isl = isl._replace(optimistic=True)
+            return t._step_builder(isl, spec.K)
+        return engine_mod.make_window_step(
+            t.handlers, t.num_hosts, K=spec.K, B=t.B, O=t.O,
+            bulk_kinds=t._bulk_kinds,
+            matrix_handlers=t._matrix_handlers,
+            with_cpu_model=t._with_cpu,
+            bulk_gate=t._bulk_gate,
+            bulk_self_excluded=t._bulk_self_excluded,
+            payload_words=t._payload_words,
+            # under vmap a lax.cond with a batched predicate executes BOTH
+            # branches, so matrix-capable sims pin the matrix path — the
+            # same rule sim.py applies to vmap islands
+            _force_path="matrix" if t._matrix_handlers else None,
+        )
+
+    def _counted(self, fn):
+        """jit with a trace counter: tracing happens exactly once per
+        compiled program, so the count IS the window-kernel compile
+        metric the fleet-smoke gate asserts on."""
+        def counted(*args):
+            self.kernel_traces += 1
+            return fn(*args)
+
+        return jax.jit(counted)
+
+    def _build_gear_fns(self, spec: gearbox.GearSpec) -> dict:
+        step = self._lane_step(spec)
+        if self._islands:
+            lane = islands_mod.make_shard_run_to(step, spec.hi)
+            inner = jax.vmap(
+                lane, in_axes=(0, None, None, None, None),
+                axis_name=islands_mod.AXIS,
+            )
+        else:
+            inner = engine_mod.make_run_to(step, spec.hi)
+        run_to = jax.vmap(inner, in_axes=(0, 0, 0, 0, None))
+        return {
+            "run_to": self._counted(run_to),
+            "attempt": None,  # compiled lazily by run_optimistic
+        }
+
+    def _bind_gear(self) -> None:
+        spec = self._ladder[self._gear]
+        fns = self._gear_fns.get(spec.level)
+        if fns is None:
+            fns = self._gear_fns[spec.level] = self._build_gear_fns(spec)
+        self._run_to = fns["run_to"]
+        self._attempt = fns["attempt"]
+
+    def _ensure_attempt(self) -> None:
+        """Lazily build the optimistic kernel for the bound gear:
+        conservative fleets never pay for the done_t machinery."""
+        if self._attempt is not None:
+            return
+        spec = self._ladder[self._gear]
+        if self._islands:
+            sub = islands_mod.make_shard_substep(
+                self._lane_step(spec, optimistic=True)
+            )
+            inner = jax.vmap(
+                sub, in_axes=(0, None, None, None),
+                axis_name=islands_mod.AXIS,
+            )
+        else:
+            inner = engine_mod.make_attempt(
+                self._lane_step(spec)
+            )
+        att = jax.vmap(inner, in_axes=(0, 0, 0, 0))
+        self._attempt = self._gear_fns[spec.level]["attempt"] = \
+            self._counted(att)
+
+    def _shift_gear(self, level: int) -> None:
+        """Move EVERY lane's pool to `level`'s capacity (one batched
+        truncating/padding re-sort) and rebind the fleet kernels. Handoff
+        boundary only, exactly like the solo drivers."""
+        spec = self._ladder[level]
+        pool, dropped = gearbox.resize_pool(self.state.pool, spec.capacity)
+        n = int(np.sum(np.asarray(jax.device_get(dropped))))
+        if n:
+            raise FleetError(
+                f"fleet gear shift to level {level} dropped {n} events "
+                f"(decision-rule bug: occupancy exceeded the target gear)"
+            )
+        self.state = self.state.replace(pool=pool)
+        self._gear = level
+        self.gear_shifts += 1
+        if self._shifter is not None:
+            self._shifter.reset()
+        self._bind_gear()
+
+    # ------------------------------------------------------------------
+    # lane lifecycle
+    # ------------------------------------------------------------------
+
+    def _lane_min_times(self) -> np.ndarray:
+        mn = jnp.min(self.state.pool.time, axis=-1)
+        return np.asarray(jax.device_get(mn)).reshape(
+            self.lanes, -1
+        ).min(axis=1)
+
+    def _bump_lane_win(self, lane: int, idx: int, n: int = 1) -> None:
+        if self.state.obs is None or n == 0:
+            return
+        w = self.state.obs.win
+        if w.ndim == 3:  # islands lanes: [L, S, NUM_WIN]; shard 0 carries
+            w = w.at[lane, 0, idx].add(n)
+        else:
+            w = w.at[lane, idx].add(n)
+        self.state = self.state.replace(
+            obs=self.state.obs.replace(win=w)
+        )
+
+    def _harvest(self, lane: int, status: str = DONE,
+                 reason: str = "") -> JobRecord:
+        """Read one finished lane's results (counters, obs slice,
+        frontier) at the handoff boundary and free the lane."""
+        lane_state = state_mod.slice_lane(self.state, lane)
+        rec = self.sched.release(lane, status, reason)
+        c = jax.device_get(lane_state.counters)
+        rec.counters = {
+            f.name: int(np.sum(np.asarray(getattr(c, f.name))))
+            for f in dataclasses.fields(c)
+        }
+        rec.events_committed = rec.counters["events_committed"]
+        snap = obs_mod.snapshot(lane_state)
+        if snap:
+            rec.windows = snap["win"]["windows_run"]
+            hl = snap["host_last_t"]
+            rec.frontier_ns = int(hl.max()) if hl.size else -1
+            rec.obs = {
+                "win": snap["win"],
+                "vtime": obs_mod.vtime_stats(hl),
+            }
+        rec.faults = dict(self._lane_faults[lane].stats)
+        if self.keep_final_subs:
+            rec.subs = jax.device_get(lane_state.subs)
+        self._lane_faults[lane] = _LaneFaults.empty()
+        return rec
+
+    def _admit_next(self, lane: int) -> bool:
+        """Swap the next queued job into a freed lane: build its solo
+        state, clear the admission gate (upshifting the fleet gear if the
+        job's initial rows demand it), and write the lane slice. The
+        compiled kernel is untouched — compile once, reuse the lane."""
+        rec = self.sched.peek()
+        if rec is None:
+            return False
+        sim = _build_solo(rec.spec)
+        self._check_compat(sim)
+        want = FleetScheduler.admission_gear(
+            self._ladder, self._occupancy_of(sim), self._gear
+        )
+        if want > self._gear:
+            self.sched.admission_upshifts += 1
+            self._shift_gear(want)
+        _align_gear(sim, self._gear)
+        try:
+            self.state = state_mod.set_lane(self.state, lane, sim.state)
+            self.params = state_mod.set_lane(self.params, lane, sim.params)
+        except ValueError as e:
+            raise FleetError(f"job {rec.name!r}: {e}") from e
+        self._runahead[lane] = sim.runahead
+        self._stop[lane] = sim.stop_time
+        self._lane_faults[lane] = self._resolve_faults(sim)
+        self.sched.admit(lane, rec)
+        self.sched.lane_swaps += 1
+        return True
+
+    def _kill_lane(self, lane: int) -> None:
+        """Drop every pending event of a lane (timeout / pressure kill):
+        the lane's frontier jumps to NEVER and its fused-loop cond goes
+        false — a dead lane is indistinguishable from a finished one."""
+        t = self.state.pool.time
+        self.state = self.state.replace(
+            pool=self.state.pool.replace(
+                time=t.at[lane].set(jnp.full_like(t[lane], NEVER))
+            )
+        )
+
+    def _drain_lane_dead(self, lane: int) -> int:
+        """Cancel pool rows destined to the lane's quarantined hosts —
+        THIS lane only (the job-scoped crashed-host semantic). Recurring:
+        late emissions and islands exchange-deferred rows are caught at
+        every subsequent handoff."""
+        lf = self._lane_faults[lane]
+        if not lf.dead:
+            return 0
+        pool = self.state.pool
+        tl, dl = pool.time[lane], pool.dst[lane]
+        mask = jnp.isin(dl, jnp.asarray(sorted(lf.dead), dl.dtype)) \
+            & (tl != NEVER)
+        n = int(jnp.sum(mask))
+        if n:
+            self.state = self.state.replace(pool=pool.replace(
+                time=pool.time.at[lane].set(jnp.where(mask, NEVER, tl))
+            ))
+            lf.stats["events_drained"] = lf.stats.get("events_drained", 0) + n
+            self._bump_lane_win(lane, obs_mod.WIN_FAULTS)
+        return n
+
+    def _fault_marks(self) -> np.ndarray:
+        """Per-lane earliest unfired injection time (NEVER if none): the
+        conservative driver clamps each lane's dispatch stop here, so an
+        injection executes at a handoff whose committed frontier sits
+        exactly at its mark — the solo drivers' _fault_mark clamp,
+        lane-scoped. Without the clamp a fused multi-window dispatch
+        would sail past the mark and the injection timing would degrade
+        to dispatch granularity."""
+        marks = np.full(self.lanes, int(NEVER), np.int64)
+        for j in range(self.lanes):
+            lf = self._lane_faults[j]
+            if lf.pending and self.sched.lane_job[j] is not None:
+                marks[j] = lf.pending[0][0]
+        return marks
+
+    def _fault_tick(self, mn: np.ndarray) -> bool:
+        """Fire due job-scoped injections + recurring drains at the
+        handoff boundary. Returns True if any lane's pool changed."""
+        changed = False
+        for j in range(self.lanes):
+            if self.sched.lane_job[j] is None:
+                continue
+            lf = self._lane_faults[j]
+            while lf.pending and lf.pending[0][0] <= mn[j]:
+                _, hid = lf.pending.pop(0)
+                lf.stats["injections_fired"] = \
+                    lf.stats.get("injections_fired", 0) + 1
+                if hid not in lf.dead:
+                    lf.dead.add(hid)
+                    lf.stats["hosts_quarantined"] = \
+                        lf.stats.get("hosts_quarantined", 0) + 1
+            if lf.dead and self._drain_lane_dead(j):
+                changed = True
+        return changed
+
+    def _handoff(self, mn: np.ndarray, press: np.ndarray) -> bool:
+        """Everything the host does between dispatches: job-scoped fault
+        injections, harvest of finished lanes, lane swaps, wall-clock
+        deadlines, pressure kills, checkpoint marks. Returns True when
+        any scheduler-visible action happened (the stall guard's
+        signal)."""
+        changed = self._fault_tick(mn)
+        if changed:
+            mn[:] = self._lane_min_times()  # a drain may move frontiers
+        for j in range(self.lanes):
+            rec = self.sched.lane_job[j]
+            if rec is None:
+                continue
+            if mn[j] >= self._stop[j]:
+                self._harvest(j, DONE)
+                changed = True
+            elif rec.deadline_exceeded():
+                self._kill_lane(j)
+                self._harvest(
+                    j, TIMEOUT,
+                    f"wall deadline {rec.spec.deadline_s}s exceeded",
+                )
+                changed = True
+            elif press[j] and self._gear >= self._ladder[-1].level:
+                # red zone at the top gear with no spill tier: the lane
+                # cannot place one window's inflow — fail THIS job, not
+                # the fleet
+                self._kill_lane(j)
+                self._harvest(
+                    j, FAILED,
+                    "pool pressure at top gear (raise "
+                    "experimental.event_capacity for this sweep)",
+                )
+                changed = True
+            if self.sched.lane_job[j] is None and self._admit_next(j):
+                changed = True
+        if changed:
+            mn[:] = self._lane_min_times()
+        self._checkpoint_tick(mn)
+        return changed
+
+    def _checkpoint_tick(self, mn: np.ndarray) -> None:
+        if not (self.checkpoint_dir and self.checkpoint_every_ns):
+            return
+        active = [
+            mn[j] for j in range(self.lanes)
+            if self.sched.lane_job[j] is not None
+        ]
+        if not active:
+            return
+        frontier = int(min(min(active), max(self._stop)))
+        if frontier >= self._ckpt_next_t:
+            from shadow_tpu.fleet import checkpoint as fleet_ckpt
+
+            fleet_ckpt.save_fleet(self, self.checkpoint_dir)
+            self._ckpt_next_t = (
+                frontier // self.checkpoint_every_ns + 1
+            ) * self.checkpoint_every_ns
+
+    # ------------------------------------------------------------------
+    # drivers
+    # ------------------------------------------------------------------
+
+    def run(self, windows_per_dispatch: int | None = None,
+            max_dispatches: int | None = None) -> int:
+        """Conservative fleet run: fused per-lane window loops in one
+        vmapped dispatch, scheduler work at every handoff boundary.
+        Returns the dispatch count."""
+        wpd = windows_per_dispatch or self.windows_per_dispatch
+        dispatches = 0
+        last_sig = None
+        while not self.sched.all_terminal():
+            if max_dispatches is not None and dispatches >= max_dispatches:
+                break
+            eff_stop = np.minimum(self._stop, self._fault_marks())
+            out = self._run_to(
+                self.state, self.params,
+                jnp.asarray(self._runahead), jnp.asarray(eff_stop), wpd,
+            )
+            self.state = out[0]
+            mn = np.asarray(jax.device_get(out[1])).reshape(
+                self.lanes, -1).min(axis=1)
+            press = np.asarray(jax.device_get(out[2])).reshape(
+                self.lanes, -1).any(axis=1)
+            occ = int(np.max(np.asarray(jax.device_get(out[3]))))
+            dispatches += 1
+            changed = self._handoff(mn, press)
+            if self._shifter is not None:
+                new = self._shifter.observe(
+                    self._gear, occ, press=bool(press.any())
+                )
+                if new is not None:
+                    self._shift_gear(new)
+                    changed = True
+            sig = (tuple(mn), tuple(r.status for r in self.sched.records),
+                   tuple(len(lf.pending) for lf in self._lane_faults),
+                   self._gear)
+            if not changed and sig == last_sig:
+                raise RuntimeError(
+                    "fleet cannot make progress: no lane advanced and no "
+                    "scheduler action fired (pool occupancy leaves too "
+                    "little headroom for even one window's emissions); "
+                    "raise experimental.event_capacity"
+                )
+            last_sig = sig
+        return dispatches
+
+    def _reset_done_t(self) -> None:
+        d = self.state.host.done_t
+        self.state = self.state.replace(
+            host=self.state.host.replace(done_t=jnp.full_like(d, -1))
+        )
+
+    def _attempt_round(self, base, ws: np.ndarray, we: np.ndarray):
+        """One optimistic attempt over all lanes from the snapshot
+        `base`: per-lane windows [ws, we) processed to completion.
+        Returns (state, mn[L], viol[L]). Global engine: one fused
+        dispatch (vmapped attempt kernel). Islands: host-driven sub-steps
+        (vmap-of-jobs over vmap-of-shards), mirroring the solo islands
+        attempt loop — every lane gets at least one sub-step, so a lane
+        parked on an exchange-deferred frontier retries its exchange (the
+        solo driver's null-window stall)."""
+        ws_d, we_d = jnp.asarray(ws), jnp.asarray(we)
+        if not self._islands:
+            st, mn, viol = self._attempt(base, self.params, ws_d, we_d)
+            return (
+                st,
+                np.array(jax.device_get(mn), np.int64),
+                np.array(jax.device_get(viol), np.int64),
+            )
+        st = base
+        mn = ws.copy()
+        viol = np.full(self.lanes, int(NEVER), np.int64)
+        k = 0
+        while True:
+            st, mn_d, viol_d = self._attempt(
+                st, self.params, jnp.asarray(np.maximum(mn, ws)), we_d
+            )
+            mn = np.asarray(jax.device_get(mn_d)).reshape(
+                self.lanes, -1).min(axis=1)
+            viol = np.minimum(viol, np.asarray(jax.device_get(viol_d)).reshape(
+                self.lanes, -1).min(axis=1))
+            k += 1
+            need = (mn < we) & (viol >= int(NEVER))
+            if not need.any():
+                return st, mn, viol
+            if k >= _MAX_SUBSTEPS:
+                if (need & (mn <= ws)).any():
+                    raise RuntimeError(
+                        "optimistic fleet attempt cannot make progress "
+                        "(pool-headroom stall); raise "
+                        "experimental.event_capacity"
+                    )
+                # genuinely enormous window: report the reached frontier;
+                # the caller shrinks those lanes and retries from base
+                return st, mn, viol
+
+    def run_optimistic(
+        self,
+        window_factor: int = 8,
+        adaptive: bool = True,
+        max_rounds: int | None = None,
+    ) -> tuple[int, int]:
+        """Per-lane speculative windows (the Time-Warp shape of the solo
+        run_optimistic, vectorized over jobs): every lane speculates its
+        own [ws, ws + factor·runahead) window each round; a lane whose
+        attempt reports a violation shrinks ITS window and the round
+        retries from the snapshot (clean lanes recompute identical
+        results — pure functions). The per-lane adaptive factor follows
+        Simulation.adapt_window_factor. Returns (rounds, rollbacks)."""
+        self._ensure_attempt()
+        L = self.lanes
+        factor = np.full(L, int(window_factor), np.int64)
+        streak = np.zeros(L, np.int64)
+        rounds = rollbacks = 0
+        never = int(NEVER)
+        self._reset_done_t()
+        mn = self._lane_min_times()
+        last_sig = None
+        while not self.sched.all_terminal():
+            if max_rounds is not None and rounds >= max_rounds:
+                break
+            cons = self._runahead
+            stop = self._stop
+            ws = mn.copy()
+            if self._islands:
+                clamp = np.asarray(jax.device_get(jnp.min(
+                    self.state.exch_deferred_min.reshape(L, -1), axis=-1
+                )), np.int64)
+                floor = np.minimum(ws + cons, clamp)
+            else:
+                floor = ws + cons
+            we = np.minimum(
+                np.maximum(np.minimum(ws + factor * cons, stop), floor),
+                stop,
+            )
+            # finished/idle lanes attempt nothing: ws == we == frontier
+            idle = mn >= stop
+            we = np.where(idle, np.maximum(ws, stop), we)
+            # in-transit deferred row parked AT a lane's frontier: that
+            # lane gets a null-window round (we == ws) so its first
+            # sub-step retries the exchange — the solo islands driver's
+            # null-window stall, lane-scoped
+            stalled = (~idle) & (floor <= ws)
+            we = np.where(stalled, ws, we)
+            base = self.state
+            rb_round = np.zeros(L, np.int64)
+            while True:
+                st, mn_a, viol = self._attempt_round(base, ws, we)
+                bad = (viol < never) & ~idle
+                guard = bad & (we <= floor)
+                if guard.any():
+                    j = int(np.argmax(guard))
+                    # A floor-width window is violation-free BY
+                    # CONSTRUCTION; a violation here means the
+                    # conservative-width invariant itself is broken —
+                    # refuse to commit (ADVICE r5 #1, fleet-scoped).
+                    raise RuntimeError(
+                        f"speculation violation at t={int(viol[j])} inside "
+                        f"a floor-width window [{int(ws[j])}, {int(we[j])}) "
+                        f"on lane {j}: the conservative-width invariant is "
+                        f"broken (runahead exceeds a real path latency, or "
+                        f"a handler emitted into the past); refusing to "
+                        f"commit"
+                    )
+                incomplete = (viol >= never) & (mn_a < we) & ~idle
+                if incomplete.any():
+                    # sub-step ceiling hit: shrink to the reached frontier
+                    we = np.where(incomplete, np.maximum(mn_a, floor), we)
+                    rb_round += incomplete  # counted as shrinks
+                    continue
+                if not bad.any():
+                    break
+                rb_round += bad
+                we = np.where(
+                    bad, np.minimum(np.maximum(viol, floor), stop), we
+                )
+            rollbacks += int(rb_round.sum())
+            self.state = st
+            for j in np.flatnonzero(rb_round):
+                self._bump_lane_win(int(j), obs_mod.WIN_ROLLBACKS,
+                                    int(rb_round[j]))
+                self._bump_lane_win(int(j), obs_mod.WIN_SHRINKS,
+                                    int(rb_round[j]))
+            self._reset_done_t()
+            mn = mn_a
+            rounds += 1
+            if adaptive:
+                for j in range(L):
+                    if not idle[j]:
+                        f, s = engine_mod.Simulation.adapt_window_factor(
+                            int(factor[j]), int(streak[j]),
+                            bool(rb_round[j]), int(window_factor),
+                        )
+                        factor[j], streak[j] = f, s
+            before = [self.sched.lane_job[j] for j in range(L)]
+            changed = self._handoff(mn, np.zeros(L, bool))
+            for j in range(L):
+                if self.sched.lane_job[j] is not before[j]:
+                    # a fresh job entered lane j: it speculates from the
+                    # full factor with a clean streak, like a solo run
+                    factor[j] = int(window_factor)
+                    streak[j] = 0
+            if changed:
+                mn = self._lane_min_times()
+            sig = (tuple(mn), tuple(r.status for r in self.sched.records))
+            if not changed and not (mn > ws).any() and sig == last_sig:
+                raise RuntimeError(
+                    "optimistic fleet cannot make progress; raise "
+                    "experimental.event_capacity"
+                )
+            last_sig = sig
+        return rounds, rollbacks
+
+    # ------------------------------------------------------------------
+    # results / telemetry
+    # ------------------------------------------------------------------
+
+    def results(self) -> list[dict]:
+        """Per-job result rows (metrics schema v4 `fleet.jobs[*]`), in
+        job declaration order."""
+        return [r.summary() for r in self.sched.records]
+
+    def records(self) -> list[JobRecord]:
+        return list(self.sched.records)
+
+    def fleet_stats(self) -> dict:
+        spec = self._ladder[self._gear]
+        st = self.sched.stats()
+        st.update({
+            "kernel_traces": self.kernel_traces,
+            "gear_level": self._gear,
+            "gear_capacity": spec.capacity,
+            "gear_shifts": self.gear_shifts,
+            "islands": self._islands,
+        })
+        return st
+
+    def ok(self) -> bool:
+        return all(r.status == DONE for r in self.sched.records)
+
+
+def build_fleet(
+    jobs: list[JobSpec],
+    lanes: int | None = None,
+    **kw,
+) -> FleetSimulation:
+    """Build a FleetSimulation from a validated job list (fleet/sweep.py
+    expand_sweep / load_job_list output)."""
+    return FleetSimulation(jobs, lanes=lanes, **kw)

@@ -1,0 +1,182 @@
+"""Host-side fleet scheduler state: job lifecycle, queue, admission.
+
+The device side of the fleet (fleet/engine.py) is a fixed set of LANES —
+slots of the vmapped window kernel. This module owns everything about the
+JOBS that flow through those lanes: the FIFO queue, per-job lifecycle
+records (status, wall clocks, harvested results), and the admission rule
+that decides whether a queued job may enter a freed lane at the fleet's
+current pool gear.
+
+Lifecycle:  queued → running → done | failed | timeout
+A job leaves `running` exactly once (harvest), and its lane is then free
+for the next queued job — the compiled kernel never changes shape on a
+swap, so the fleet pays XLA compilation once for the whole sweep.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Optional
+
+from shadow_tpu.fleet.sweep import JobSpec
+
+QUEUED = "queued"
+RUNNING = "running"
+DONE = "done"
+FAILED = "failed"
+TIMEOUT = "timeout"
+
+TERMINAL = (DONE, FAILED, TIMEOUT)
+
+
+@dataclasses.dataclass
+class JobRecord:
+    """One job's scheduler-plane state, from spec to harvested result."""
+
+    spec: JobSpec
+    status: str = QUEUED
+    lane: Optional[int] = None
+    reason: str = ""  # failure/timeout detail
+    admitted_wall: Optional[float] = None
+    wall_s: float = 0.0
+    # harvested at completion (device reads at the handoff boundary):
+    events_committed: int = 0
+    windows: int = 0
+    frontier_ns: int = -1
+    counters: dict = dataclasses.field(default_factory=dict)
+    faults: dict = dataclasses.field(default_factory=dict)
+    # optional deep captures for tests / downstream analysis
+    subs: Any = None
+    obs: Optional[dict] = None
+    checkpoint: Optional[str] = None
+
+    @property
+    def name(self) -> str:
+        return self.spec.name
+
+    def deadline_exceeded(self) -> bool:
+        d = self.spec.deadline_s
+        return (
+            d is not None
+            and self.admitted_wall is not None
+            and time.monotonic() - self.admitted_wall > d
+        )
+
+    def summary(self) -> dict:
+        """The metrics-schema-v4 `fleet.jobs[*]` row (and the manifest
+        entry a fleet checkpoint records)."""
+        return {
+            "name": self.name,
+            "status": self.status,
+            "lane": self.lane,
+            "reason": self.reason,
+            "events_committed": int(self.events_committed),
+            "windows": int(self.windows),
+            "frontier_ns": int(self.frontier_ns),
+            "wall_s": round(float(self.wall_s), 4),
+            "counters": {k: int(v) for k, v in self.counters.items()},
+            "faults": {k: int(v) for k, v in self.faults.items()},
+        }
+
+
+class FleetScheduler:
+    """FIFO job queue + admission control over a fixed lane count.
+
+    Admission is keyed to pool occupancy and the gear ladder
+    (core/gearbox.py): a job is admitted into a freed lane only when its
+    initial resident rows fit under the CURRENT gear's rebalance fill
+    mark; otherwise the scheduler demands an upshift first (`admit`
+    returns the gear level the fleet must shift to). The fleet shares one
+    compiled pool shape across lanes, so gear decisions are fleet-global:
+    the decision signal is the max lane occupancy, exactly the fullest-
+    shard rule the islands runner uses.
+    """
+
+    def __init__(self, jobs: list[JobSpec], lanes: int):
+        if lanes < 1:
+            raise ValueError("fleet needs at least one lane")
+        self.records = [JobRecord(spec=j) for j in jobs]
+        self._by_name = {r.name: r for r in self.records}
+        if len(self._by_name) != len(self.records):
+            raise ValueError("duplicate job names in fleet")
+        self.lanes = lanes
+        self.lane_job: list[Optional[JobRecord]] = [None] * lanes
+        self._next = 0  # queue cursor (records are admitted in order)
+        self.lane_swaps = 0
+        self.admission_upshifts = 0
+
+    # -- queue --
+
+    def pending(self) -> list[JobRecord]:
+        return [r for r in self.records[self._next:] if r.status == QUEUED]
+
+    def peek(self) -> Optional[JobRecord]:
+        while self._next < len(self.records):
+            r = self.records[self._next]
+            if r.status == QUEUED:
+                return r
+            self._next += 1
+        return None
+
+    # -- admission --
+
+    @staticmethod
+    def admission_gear(ladder, initial_rows: int, gear: int) -> int:
+        """The gear the fleet must be in before a job with
+        `initial_rows` resident events may enter a lane: the smallest
+        ladder level whose fill mark covers the rows, never below the
+        current gear (other lanes' live occupancy holds the floor)."""
+        for spec in ladder:
+            if spec.level >= gear and initial_rows <= spec.fill:
+                return spec.level
+        return ladder[-1].level
+
+    def admit(self, lane: int, record: JobRecord) -> None:
+        if self.lane_job[lane] is not None:
+            raise RuntimeError(f"lane {lane} is occupied")
+        if record.status != QUEUED:
+            raise RuntimeError(f"job {record.name} is {record.status}")
+        record.status = RUNNING
+        record.lane = lane
+        record.admitted_wall = time.monotonic()
+        self.lane_job[lane] = record
+        if self._next < len(self.records) and \
+                self.records[self._next] is record:
+            self._next += 1
+
+    def release(self, lane: int, status: str, reason: str = "") -> JobRecord:
+        record = self.lane_job[lane]
+        if record is None:
+            raise RuntimeError(f"lane {lane} is already free")
+        record.status = status
+        record.reason = reason
+        record.wall_s = time.monotonic() - (
+            record.admitted_wall or time.monotonic()
+        )
+        self.lane_job[lane] = None
+        return record
+
+    # -- introspection --
+
+    def running(self) -> list[JobRecord]:
+        return [r for r in self.lane_job if r is not None]
+
+    def all_terminal(self) -> bool:
+        return all(r.status in TERMINAL for r in self.records)
+
+    def stats(self) -> dict:
+        by = {s: 0 for s in (QUEUED, RUNNING, DONE, FAILED, TIMEOUT)}
+        for r in self.records:
+            by[r.status] += 1
+        return {
+            "jobs_total": len(self.records),
+            "jobs_done": by[DONE],
+            "jobs_failed": by[FAILED],
+            "jobs_timeout": by[TIMEOUT],
+            "jobs_queued": by[QUEUED],
+            "jobs_running": by[RUNNING],
+            "lanes": self.lanes,
+            "lane_swaps": self.lane_swaps,
+            "admission_upshifts": self.admission_upshifts,
+        }

@@ -1,0 +1,300 @@
+"""Sweep expansion: a `sweep:` config matrix → a validated job list.
+
+Shadow's primary workload is parameter sweeps: many near-identical
+experiment configs (seeds, latencies, loss rates, stop times) that the
+reference runs one-at-a-time as separate OS processes. Here a sweep file
+is ONE base experiment config plus a `sweep:` section:
+
+    sweep:
+      name: loss-sweep          # optional job-name prefix
+      lanes: 4                  # optional: device lanes (default = jobs)
+      matrix:                   # cross product, declaration order
+        general.seed: [1, 2, 3]
+        general.stop_time: ["300 ms", "1 s"]
+      jobs:                     # optional explicit extra jobs
+        - name: long-tail
+          set: {general.seed: 99, general.stop_time: "2 s"}
+    general: {...}              # base config — everything else
+    network: {...}
+    hosts:   {...}
+
+Every expanded job must (a) load as a valid experiment config and (b) be
+KERNEL-COMPATIBLE with the others: the fleet runs all jobs as one vmapped
+device program, so fields that are baked into the compiled window kernel
+(host counts, pool shapes, app handler options) must be identical across
+jobs — only data-plane fields (seeds, stop times, graph latencies/losses,
+fault plans) may vary. Incompatible sweeps fail at expansion time with the
+offending field paths, never mid-run.
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+import io
+import re
+from typing import Any, Optional
+
+import yaml
+
+
+class SweepError(ValueError):
+    pass
+
+
+# Dotted config paths (prefix match) that are DATA to the compiled window
+# kernel: they land in NetParams / rng keys / host-side window bounds, so
+# jobs may vary them while sharing one compiled program. Everything else
+# is (conservatively) assumed to change the kernel — shapes, handler
+# closures, payload layouts — and must be identical across a fleet.
+DATA_PATHS = (
+    "general.seed",
+    "general.stop_time",
+    "general.bootstrap_end_time",
+    "general.data_directory",
+    "general.log_level",
+    "general.progress",
+    "general.heartbeat_interval",
+    "network.graph",  # latency/loss VALUES; baked shapes re-checked at build
+    "faults",  # job-scoped injections are scheduler-plane, not compiled
+    "sweep",
+    "fleet",
+)
+
+
+@dataclasses.dataclass
+class JobSpec:
+    """One experiment of a fleet: a name, the fully-expanded config dict,
+    and scheduler-plane options."""
+
+    name: str
+    config: dict
+    deadline_s: Optional[float] = None  # wall-clock budget once admitted
+
+    def to_json(self) -> dict:
+        return {
+            "name": self.name,
+            "config": self.config,
+            "deadline_s": self.deadline_s,
+        }
+
+    @classmethod
+    def from_json(cls, d: dict) -> "JobSpec":
+        return cls(
+            name=str(d["name"]),
+            config=dict(d["config"]),
+            deadline_s=d.get("deadline_s"),
+        )
+
+
+def _set_path(d: dict, path: str, value) -> None:
+    parts = path.split(".")
+    cur = d
+    for p in parts[:-1]:
+        nxt = cur.get(p)
+        if not isinstance(nxt, dict):
+            raise SweepError(
+                f"sweep path {path!r}: {p!r} is not a config section in the "
+                f"base document (matrix paths must point into existing "
+                f"sections)"
+            )
+        cur = nxt
+    if parts[-1] not in cur:
+        raise SweepError(
+            f"sweep path {path!r}: field {parts[-1]!r} is not present in "
+            f"the base document; set a base value so the override target "
+            f"is explicit"
+        )
+    cur[parts[-1]] = value
+
+
+def _flatten(d, prefix="") -> dict[str, Any]:
+    out = {}
+    if isinstance(d, dict):
+        for k, v in d.items():
+            out.update(_flatten(v, f"{prefix}{k}."))
+    else:
+        out[prefix[:-1]] = d
+    return out
+
+
+def _is_data_path(path: str) -> bool:
+    return any(
+        path == p or path.startswith(p + ".") for p in DATA_PATHS
+    )
+
+
+def check_kernel_compat(jobs: list[JobSpec]) -> None:
+    """Raise unless every job can share ONE compiled window kernel: all
+    config differences vs the first job must lie under DATA_PATHS."""
+    if not jobs:
+        raise SweepError("sweep expanded to zero jobs")
+    base = _flatten(jobs[0].config)
+    for job in jobs[1:]:
+        flat = _flatten(job.config)
+        bad = sorted(
+            p
+            for p in set(base) | set(flat)
+            if base.get(p) != flat.get(p) and not _is_data_path(p)
+        )
+        if bad:
+            raise SweepError(
+                f"job {job.name!r} differs from {jobs[0].name!r} in kernel-"
+                f"shaping field(s) {bad[:6]}: these compile into the window "
+                f"kernel (shapes or handler constants), so the jobs cannot "
+                f"share one fleet program — run them as separate fleets, or "
+                f"sweep only data-plane fields ({', '.join(DATA_PATHS[:6])}, "
+                f"...)"
+            )
+
+
+_NAME_SANITIZE = re.compile(r"[^A-Za-z0-9._=-]+")
+
+
+def _job_name(prefix: str, idx: int, overrides: dict) -> str:
+    parts = [f"{prefix}{idx:03d}"]
+    for path, v in overrides.items():
+        leaf = path.rsplit(".", 1)[-1]
+        parts.append(_NAME_SANITIZE.sub("_", f"{leaf}={v}"))
+    return "-".join(parts)
+
+
+def expand_sweep(doc: dict, validate: bool = True) -> list[JobSpec]:
+    """Expand a sweep document (base config + `sweep:` section) into the
+    ordered job list: matrix cross product (declaration order, first key
+    slowest) followed by explicit `jobs:` entries. With `validate`, each
+    expanded config is loaded through the experiment-config parser and the
+    cross-job kernel-compatibility check runs — a bad spec fails HERE with
+    its job name, never mid-fleet."""
+    if not isinstance(doc, dict):
+        raise SweepError("sweep document must be a YAML mapping")
+    sweep = doc.get("sweep")
+    if not isinstance(sweep, dict):
+        raise SweepError("document has no `sweep:` section")
+    unknown = set(sweep) - {"name", "matrix", "jobs", "lanes", "deadline_s"}
+    if unknown:
+        raise SweepError(f"unknown field(s) in sweep: {sorted(unknown)}")
+    base = {k: copy.deepcopy(v) for k, v in doc.items() if k != "sweep"}
+    prefix = str(sweep.get("name", "job"))
+    deadline = sweep.get("deadline_s")
+    deadline = float(deadline) if deadline is not None else None
+
+    matrix = sweep.get("matrix") or {}
+    if not isinstance(matrix, dict):
+        raise SweepError("sweep.matrix must be a mapping of path -> values")
+    for path, vals in matrix.items():
+        if not isinstance(vals, list) or not vals:
+            raise SweepError(
+                f"sweep.matrix.{path} must be a non-empty list of values"
+            )
+
+    combos: list[dict] = [{}]
+    for path, vals in matrix.items():
+        combos = [
+            {**c, path: v} for c in combos for v in vals
+        ]
+    if not matrix:
+        combos = []
+
+    jobs: list[JobSpec] = []
+    for i, overrides in enumerate(combos):
+        cfg = copy.deepcopy(base)
+        for path, v in overrides.items():
+            _set_path(cfg, path, v)
+        jobs.append(JobSpec(
+            name=_job_name(prefix, i, overrides), config=cfg,
+            deadline_s=deadline,
+        ))
+    for j, entry in enumerate(sweep.get("jobs") or []):
+        if not isinstance(entry, dict) or "set" not in entry:
+            raise SweepError(
+                f"sweep.jobs[{j}] must be a mapping with a `set:` override "
+                f"table"
+            )
+        cfg = copy.deepcopy(base)
+        for path, v in (entry["set"] or {}).items():
+            _set_path(cfg, path, v)
+        name = str(entry.get("name", _job_name(prefix, len(jobs), entry["set"])))
+        jobs.append(JobSpec(
+            name=name, config=cfg,
+            deadline_s=entry.get("deadline_s", deadline),
+        ))
+    if not jobs:
+        raise SweepError(
+            "sweep expanded to zero jobs (empty matrix and no jobs list)"
+        )
+    names = [j.name for j in jobs]
+    dupes = {n for n in names if names.count(n) > 1}
+    if dupes:
+        raise SweepError(f"duplicate job name(s): {sorted(dupes)[:4]}")
+    if validate:
+        validate_jobs(jobs)
+    return jobs
+
+
+def validate_jobs(jobs: list[JobSpec]) -> None:
+    """Each job's config must parse as an experiment config (ConfigError
+    surfaces with the job name) and the set must be kernel-compatible."""
+    from shadow_tpu.core.config import ConfigError, load_config
+
+    for job in jobs:
+        try:
+            cfg = load_config(job.config)
+        except (ConfigError, ValueError) as e:
+            raise SweepError(f"job {job.name!r}: {e}") from e
+        if any(h.processes for h in cfg.hosts):
+            raise SweepError(
+                f"job {job.name!r}: fleet jobs run on the device plane "
+                f"only (hosts with `processes` need their own managed-"
+                f"process run)"
+            )
+        for f in cfg.faults.load_faults():
+            if f.op != "kill_host":
+                raise SweepError(
+                    f"job {job.name!r}: fleet fault plans support the "
+                    f"device-plane `kill_host` op only (got {f.op!r}); "
+                    f"proc/file ops need a solo run"
+                )
+    check_kernel_compat(jobs)
+
+
+def load_sweep(source) -> tuple[list[JobSpec], dict]:
+    """Load a sweep document from a YAML path/string/dict; returns
+    (jobs, sweep_section)."""
+    if isinstance(source, dict):
+        doc = source
+    elif isinstance(source, io.IOBase):
+        doc = yaml.safe_load(source)
+    else:
+        text = str(source)
+        if "\n" in text:
+            doc = yaml.safe_load(text)
+        else:
+            with open(text) as f:
+                doc = yaml.safe_load(f)
+    jobs = expand_sweep(doc)
+    return jobs, dict(doc.get("sweep") or {})
+
+
+def load_job_list(path: str) -> list[JobSpec]:
+    """Load an explicit job list (`--fleet jobs.yaml` / expand_sweep.py
+    output): either {"jobs": [{name, config, deadline_s?}, ...]} or a bare
+    list of those entries. Validates like expand_sweep."""
+    with open(path) as f:
+        doc = yaml.safe_load(f)
+    entries = doc.get("jobs") if isinstance(doc, dict) else doc
+    if not isinstance(entries, list) or not entries:
+        raise SweepError(
+            f"{path}: expected a `jobs:` list of {{name, config}} entries"
+        )
+    jobs = []
+    for i, e in enumerate(entries):
+        if not isinstance(e, dict) or "config" not in e:
+            raise SweepError(f"{path}: jobs[{i}] needs a `config` mapping")
+        jobs.append(JobSpec(
+            name=str(e.get("name", f"job{i:03d}")),
+            config=dict(e["config"]),
+            deadline_s=e.get("deadline_s"),
+        ))
+    validate_jobs(jobs)
+    return jobs

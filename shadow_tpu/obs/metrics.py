@@ -25,8 +25,15 @@ import numpy as np
 
 from shadow_tpu.obs import counters as obs_counters
 
-SCHEMA_VERSION = 3  # v3: faults.* recovery counters (fault-tolerance plane)
+# v4: optional top-level `fleet` section (fleet.jobs[*] per-job rows) +
+# fleet.* counters; v3: faults.* recovery counters (fault-tolerance plane)
+SCHEMA_VERSION = 4
 DOC_KIND = "shadow_tpu.metrics"
+
+# metrics-doc `fleet.jobs[*]` rows must carry at least these keys
+_FLEET_JOB_KEYS = {
+    "name", "status", "events_committed", "windows", "frontier_ns", "wall_s",
+}
 
 # Histograms keep exact count/sum/min/max plus a bounded sample buffer for
 # percentiles: past the cap, samples are kept with a deterministic stride
@@ -79,6 +86,12 @@ class MetricsRegistry:
         self.counters: dict[str, int] = {}
         self.gauges: dict[str, float | int] = {}
         self._hists: dict[str, Histogram] = {}
+        # structured top-level sections (schema v4: "fleet"); absent from
+        # the doc until set, so solo-run documents are unchanged
+        self.sections: dict[str, dict] = {}
+
+    def section_set(self, name: str, value: dict) -> None:
+        self.sections[name] = dict(value)
 
     def counter_set(self, name: str, value: int) -> None:
         self.counters[name] = int(value)
@@ -106,6 +119,7 @@ class MetricsRegistry:
             "histograms": {
                 k: h.summary() for k, h in sorted(self._hists.items())
             },
+            **{k: dict(v) for k, v in sorted(self.sections.items())},
         }
 
     def dump(self, path: str, meta: dict | None = None) -> dict:
@@ -146,6 +160,21 @@ def validate_metrics_doc(doc: dict) -> None:
             raise ValueError(
                 f"histogram {k!r} must carry keys {sorted(_HIST_KEYS)}"
             )
+    fleet = doc.get("fleet")
+    if fleet is not None:
+        # schema v4: fleet runs attach per-job rows (docs/observability.md)
+        if not isinstance(fleet, dict) or not isinstance(
+            fleet.get("jobs"), list
+        ):
+            raise ValueError(
+                "fleet section must be an object with a jobs list"
+            )
+        for i, row in enumerate(fleet["jobs"]):
+            if not isinstance(row, dict) or not _FLEET_JOB_KEYS <= set(row):
+                raise ValueError(
+                    f"fleet.jobs[{i}] must carry keys "
+                    f"{sorted(_FLEET_JOB_KEYS)}"
+                )
 
 
 def _sub_counter(reg: MetricsRegistry, sub, prefix: str, fields) -> None:
@@ -211,6 +240,28 @@ def snapshot_device(sim, reg: MetricsRegistry) -> None:
     if fault_stats is not None:
         for k, v in fault_stats().items():
             reg.counter_set(f"faults.{k}", int(v))
+
+
+def snapshot_fleet(fleet, reg: MetricsRegistry) -> None:
+    """Read a FleetSimulation's scheduler-plane results into the registry
+    (schema v4): fleet.* counters plus the top-level `fleet` section with
+    one `jobs[*]` row per experiment (per-job events / windows / virtual-
+    time frontier). Per-job device counters ride inside each row — the
+    fleet harvested them at the job's own handoff boundary, so this call
+    never forces a sync."""
+    stats = fleet.fleet_stats()
+    for k in ("jobs_total", "jobs_done", "jobs_failed", "jobs_timeout",
+              "lane_swaps", "admission_upshifts", "kernel_traces",
+              "gear_shifts"):
+        reg.counter_set(f"fleet.{k}", int(stats.get(k, 0)))
+    reg.gauge_set("fleet.lanes", int(stats.get("lanes", 0)))
+    reg.gauge_set("fleet.gear_level", int(stats.get("gear_level", 0)))
+    reg.section_set("fleet", {
+        "lanes": int(stats.get("lanes", 0)),
+        "lane_swaps": int(stats.get("lane_swaps", 0)),
+        "kernel_traces": int(stats.get("kernel_traces", 0)),
+        "jobs": fleet.results(),
+    })
 
 
 class ObsSession:

@@ -60,6 +60,79 @@ _MAX_SUBSTEPS = 4096
 
 
 # ---------------------------------------------------------------------------
+# Shard kernel factories
+# ---------------------------------------------------------------------------
+#
+# Module-level so the fleet runner (shadow_tpu/fleet) can compose them:
+# vmap-of-jobs OUTSIDE, shards INSIDE (the per-shard collectives stay on
+# the inner axis). `runahead` and `stop` are traced arguments — the fleet
+# passes per-job values; IslandSimulation._build_gear_fns closes over its
+# own runahead and delegates here.
+
+
+def make_shard_run_to(step, hi: int, axis: str = AXIS):
+    """Build run_to(state, params, runahead, stop, max_windows) ->
+    (state, min_next, pressed, occupancy, windows) for ONE shard of the
+    islands engine; wrap with vmap(axis_name=axis) over the shard axis
+    (or shard_map) to get the full per-job kernel."""
+
+    def step_shard(state, params, ws, we):
+        st, mn = step(state, params, ws, we)
+        return st, jax.lax.pmin(mn, axis)
+
+    def _occ(state):
+        return jnp.sum(state.pool.time != simtime.NEVER)
+
+    def _press(state):
+        return jax.lax.pmax((_occ(state) >= hi).astype(jnp.int32), axis)
+
+    def run_to(state, params, runahead, stop, max_windows):
+        runahead = jnp.asarray(runahead, jnp.int64)
+        stop = jnp.asarray(stop, jnp.int64)
+        max_windows = jnp.asarray(max_windows, jnp.int32)
+
+        def cond(c):
+            state, mn, w = c
+            return (mn < stop) & (w < max_windows) & (_press(state) == 0)
+
+        def body(c):
+            state, mn, w = c
+            ws = mn
+            # exchange-backpressure clamp: never let any shard process
+            # past an event still in transit (deferred exchange)
+            clamp = jax.lax.pmin(state.exch_deferred_min, axis)
+            we = jnp.minimum(jnp.minimum(ws + runahead, stop), clamp)
+            state, mn = step_shard(state, params, ws, we)
+            return state, mn, w + 1
+
+        mn0 = jax.lax.pmin(jnp.min(state.pool.time), axis)
+        state, mn, w = jax.lax.while_loop(
+            cond, body, (state, mn0, jnp.int32(0))
+        )
+        # occupancy rides back pmax'd: the gearing decision covers the
+        # FULLEST shard (every shard's pool compiles the same capacity)
+        occ = jax.lax.pmax(_occ(state), axis)
+        return state, mn, _press(state) > 0, occ, w
+
+    return run_to
+
+
+def make_shard_substep(step, axis: str = AXIS):
+    """Build substep(state, params, ws, we) -> (state, min_next, viol)
+    for ONE shard of the optimistic islands engine: one window sub-step
+    with the frontier and earliest-violation scalars pmin-combined so
+    every shard reports the same values."""
+
+    def substep(state, params, ws, we):
+        st2, mn2 = step(state, params, ws, we)
+        mn2 = jax.lax.pmin(mn2, axis)
+        viol = jax.lax.pmin(st2.xmit_min, axis)
+        return st2, mn2, viol
+
+    return substep
+
+
+# ---------------------------------------------------------------------------
 # State layout transform: global [H]/[C] arrays → per-shard [S, ...] blocks
 # ---------------------------------------------------------------------------
 
@@ -383,44 +456,14 @@ class IslandSimulation(Simulation):
             return super()._build_gear_fns(spec)
         step = self._step_builder(self._island_spec, spec.K)
         runahead = jnp.int64(self.runahead)
-        hi = spec.hi
+        lane_run_to = make_shard_run_to(step, spec.hi)
 
         def step_shard(state, params, ws, we):
             st, mn = step(state, params, ws, we)
             return st, jax.lax.pmin(mn, AXIS)
 
-        def _occ(state):
-            return jnp.sum(state.pool.time != simtime.NEVER)
-
-        def _press(state):
-            return jax.lax.pmax((_occ(state) >= hi).astype(jnp.int32), AXIS)
-
         def run_to(state, params, stop, max_windows):
-            stop = jnp.asarray(stop, jnp.int64)
-            max_windows = jnp.asarray(max_windows, jnp.int32)
-
-            def cond(c):
-                state, mn, w = c
-                return (mn < stop) & (w < max_windows) & (_press(state) == 0)
-
-            def body(c):
-                state, mn, w = c
-                ws = mn
-                # exchange-backpressure clamp: never let any shard process
-                # past an event still in transit (deferred exchange)
-                clamp = jax.lax.pmin(state.exch_deferred_min, AXIS)
-                we = jnp.minimum(jnp.minimum(ws + runahead, stop), clamp)
-                state, mn = step_shard(state, params, ws, we)
-                return state, mn, w + 1
-
-            mn0 = jax.lax.pmin(jnp.min(state.pool.time), AXIS)
-            state, mn, w = jax.lax.while_loop(
-                cond, body, (state, mn0, jnp.int32(0))
-            )
-            # occupancy rides back pmax'd: the gearing decision covers the
-            # FULLEST shard (every shard's pool compiles the same capacity)
-            occ = jax.lax.pmax(_occ(state), AXIS)
-            return state, mn, _press(state) > 0, occ, w
+            return lane_run_to(state, params, runahead, stop, max_windows)
 
         return {
             "step_fn": step,
@@ -737,14 +780,10 @@ class IslandSimulation(Simulation):
         spec = self._gear_ladder[self._gear]
         spec_opt = self._island_spec._replace(optimistic=True)
         step_opt = self._step_builder(spec_opt, spec.K)
-
-        def substep(state, params, ws, we):
-            st2, mn2 = step_opt(state, params, ws, we)
-            # one pmin each: the shards agree on the frontier + earliest
-            # violation, so every shard reports the same scalars
-            mn2 = jax.lax.pmin(mn2, AXIS)
-            viol = jax.lax.pmin(st2.xmit_min, AXIS)
-            return st2, mn2, viol
+        # one pmin each inside (make_shard_substep): the shards agree on
+        # the frontier + earliest violation, so every shard reports the
+        # same scalars
+        substep = make_shard_substep(step_opt)
 
         # cache per gear: a shift rebinds _attempt to the new gear's entry
         # (None until this runs again for that gear)

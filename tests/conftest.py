@@ -41,7 +41,7 @@ _BUDGET_TIER = {
     "test_sim_build": 3, "test_spill": 3, "test_optimistic": 3,
     # minutes: multi-engine parity matrices / many-shape compiles
     "test_gearbox": 4, "test_islands": 4, "test_rebalance": 4,
-    "test_sharding": 4, "test_tcp": 4, "test_tgen": 5,
+    "test_sharding": 4, "test_tcp": 4, "test_fleet": 4, "test_tgen": 5,
     # slow-marked e2e tiers (excluded from tier-1 anyway)
     "test_bridge_tcp": 6, "test_relay_e2e": 6,
 }

@@ -1,0 +1,360 @@
+"""Scenario fleet (shadow_tpu/fleet): batched multi-experiment execution.
+
+The load-bearing guarantee is BIT-PARITY: every job of a batched fleet —
+committed events, full engine counters, app sub-state, virtual-time
+frontier — must equal the same scenario run solo, across the engine
+matrix (conservative AND optimistic, global AND islands), through ragged
+completion and lane swaps, with ONE window-kernel compile for the whole
+sweep (the trace-count metric). Plus the scheduler plane: sweep
+expansion/validation, job-scoped fault quarantine, wall deadlines, and
+checkpoint/resume of a partially-finished fleet.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from shadow_tpu.fleet import (
+    FleetError,
+    JobSpec,
+    SweepError,
+    build_fleet,
+    expand_sweep,
+    resume_fleet,
+    save_fleet,
+)
+from shadow_tpu.obs import counters as obs_counters
+from shadow_tpu.sim import build_simulation
+
+GML = """\
+graph [
+  node [ id 0 bandwidth_down "81920 Kibit" bandwidth_up "81920 Kibit" ]
+  edge [ source 0 target 0 latency "50 ms" packet_loss 0.0 ]
+]
+"""
+
+
+def _cfg(seed, stop, shards=0, faults=None, hosts=8):
+    exp = {
+        "event_capacity": 1024,
+        "events_per_host_per_window": 8,
+        "outbox_slots": 8,
+        "inbox_slots": 4,
+    }
+    if shards:
+        exp.update({"num_shards": shards, "exchange_slots": 16})
+    d = {
+        "general": {"stop_time": stop, "seed": seed},
+        "network": {"graph": {"type": "gml", "inline": GML}},
+        "experimental": exp,
+        "hosts": {
+            "peer": {
+                "quantity": hosts,
+                "app_model": "phold",
+                "app_options": {
+                    "msgload": 2, "runtime": 2, "start_time": "100 ms",
+                },
+            }
+        },
+    }
+    if faults:
+        d["faults"] = faults
+    return d
+
+
+# 8 mixed-length scenarios: four distinct stop times, distinct seeds —
+# ragged completion is structural, not incidental
+_STOPS = ["700 ms", "1.2 s", "1.8 s", "1.5 s"] * 2
+
+
+def _jobs(shards=0, n=8):
+    return [
+        JobSpec(f"job{i}", _cfg(100 + i, _STOPS[i], shards=shards))
+        for i in range(n)
+    ]
+
+
+def _solo_fingerprint(cfg, drop=()):
+    sim = build_simulation(cfg)
+    sim.run()
+    c = sim.counters()
+    for k in drop:
+        c.pop(k)
+    subs = jax.device_get(sim.state.subs)
+    snap = obs_counters.snapshot(sim.state)
+    frontier = int(snap["host_last_t"].max()) if snap else -1
+    return c, subs, frontier
+
+
+def _assert_job_matches_solo(rec, cfg, drop=()):
+    c, subs, frontier = _solo_fingerprint(cfg, drop)
+    fc = dict(rec.counters)
+    for k in drop:
+        fc.pop(k)
+    assert fc == c, (rec.name, fc, c)
+    assert rec.frontier_ns == frontier, rec.name
+    for key in subs:
+        for leaf_a, leaf_b in zip(
+            jax.tree.leaves(subs[key]), jax.tree.leaves(rec.subs[key])
+        ):
+            assert np.array_equal(
+                np.asarray(leaf_a),
+                np.asarray(leaf_b).reshape(np.asarray(leaf_a).shape),
+            ), (rec.name, key)
+
+
+# ---------------------------------------------------------------------------
+# sweep expansion / validation (host-only, no device work)
+# ---------------------------------------------------------------------------
+
+
+def _sweep_doc(matrix):
+    return {"sweep": {"name": "t", "matrix": matrix}, **_cfg(1, "1 s")}
+
+
+def test_sweep_matrix_expansion():
+    jobs = expand_sweep(_sweep_doc({
+        "general.seed": [1, 2, 3],
+        "general.stop_time": ["700 ms", "1.2 s"],
+    }))
+    assert len(jobs) == 6
+    assert len({j.name for j in jobs}) == 6
+    # declaration order: first key slowest
+    assert [j.config["general"]["seed"] for j in jobs] == [1, 1, 2, 2, 3, 3]
+    assert jobs[1].config["general"]["stop_time"] == "1.2 s"
+
+
+def test_sweep_rejects_kernel_shaping_axes():
+    # msgload compiles into the PHOLD handlers: one kernel cannot serve it
+    with pytest.raises(SweepError, match="kernel-shaping"):
+        expand_sweep(_sweep_doc({
+            "hosts.peer.app_options.msgload": [1, 2],
+        }))
+
+
+def test_sweep_rejects_bad_specs():
+    with pytest.raises(SweepError, match="unknown"):
+        expand_sweep({"sweep": {"matrix": {}, "bogus": 1}, **_cfg(1, "1 s")})
+    with pytest.raises(SweepError, match="not present"):
+        expand_sweep(_sweep_doc({"general.nonsense": [1]}))
+    with pytest.raises(SweepError, match="zero jobs"):
+        expand_sweep({"sweep": {"matrix": {}}, **_cfg(1, "1 s")})
+    # a matrix value the config parser rejects fails with the job named
+    with pytest.raises(SweepError, match="job .*seed"):
+        expand_sweep(_sweep_doc({"general.seed": ["not-a-seed"]}))
+    # fleet jobs are device-plane only
+    doc = _sweep_doc({"general.seed": [1]})
+    doc["hosts"]["peer"] = {
+        "quantity": 1, "processes": [{"path": "/bin/true"}],
+    }
+    del doc["hosts"]["peer"]["quantity"]
+    with pytest.raises(SweepError, match="device plane"):
+        expand_sweep(doc)
+
+
+def test_fleet_rejects_incompatible_jobs():
+    jobs = [
+        JobSpec("a", _cfg(1, "1 s", hosts=8)),
+        JobSpec("b", _cfg(2, "1 s", hosts=16)),
+    ]
+    with pytest.raises((SweepError, FleetError)):
+        build_fleet(jobs)
+
+
+# ---------------------------------------------------------------------------
+# bit-parity: the acceptance matrix
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_conservative_parity_ragged_and_swaps():
+    """THE acceptance gate: an 8-job mixed-length sweep on 4 lanes —
+    ragged completion AND four lane swaps — with every job bit-identical
+    to its solo run (full counters, including schedule metrics: the
+    per-lane window sequence is exactly the solo driver's) and exactly
+    ONE window-kernel compile for the whole sweep."""
+    jobs = _jobs()
+    fleet = build_fleet(jobs, lanes=4, keep_final_subs=True)
+    fleet.run()
+    stats = fleet.fleet_stats()
+    assert stats["jobs_done"] == 8
+    assert stats["lane_swaps"] == 4  # 8 jobs through 4 lanes
+    assert stats["kernel_traces"] == 1  # compile once, reuse the lane
+    for rec, job in zip(fleet.records(), jobs):
+        assert rec.status == "done"
+        assert rec.events_committed > 0
+        _assert_job_matches_solo(rec, job.config)
+
+
+def test_fleet_islands_conservative_parity():
+    """The fleet axis composes with the islands engine: vmap-of-jobs
+    outside, shards inside. Per-job results must still equal the solo
+    islands runs bit-for-bit, one compile total."""
+    jobs = _jobs(shards=2, n=3)
+    fleet = build_fleet(jobs, lanes=2, keep_final_subs=True)
+    fleet.run()
+    assert fleet.fleet_stats()["kernel_traces"] == 1
+    assert fleet.fleet_stats()["lane_swaps"] == 1
+    for rec, job in zip(fleet.records(), jobs):
+        _assert_job_matches_solo(rec, job.config)
+
+
+# schedule metrics that optimistic runs legitimately take different paths
+# on (mirrors tests/test_optimistic.py's fingerprint)
+_OPT_DROP = (
+    "micro_steps", "outbox_stall_deferred", "exchange_sent",
+    "exchange_deferred",
+)
+
+
+def test_fleet_optimistic_parity():
+    """Per-lane speculative windows (vmapped fused attempts) must
+    reproduce the solo conservative results for every job, through a
+    lane swap."""
+    jobs = _jobs(n=3)
+    fleet = build_fleet(jobs, lanes=2, keep_final_subs=True)
+    rounds, rollbacks = fleet.run_optimistic(window_factor=8)
+    assert rounds > 0
+    assert fleet.fleet_stats()["jobs_done"] == 3
+    for rec, job in zip(fleet.records(), jobs):
+        _assert_job_matches_solo(rec, job.config, drop=_OPT_DROP)
+
+
+def test_fleet_islands_optimistic_parity():
+    """Optimistic × islands × fleet: host-driven sub-step rounds over
+    vmap-of-jobs(vmap-of-shards), with per-lane exchange-backpressure
+    floors. Results must equal the solo conservative runs."""
+    jobs = _jobs(shards=2, n=2)
+    fleet = build_fleet(jobs, keep_final_subs=True)
+    fleet.run_optimistic(window_factor=8)
+    assert fleet.fleet_stats()["jobs_done"] == 2
+    for rec, job in zip(fleet.records(), jobs):
+        _assert_job_matches_solo(rec, job.config, drop=_OPT_DROP)
+
+
+# ---------------------------------------------------------------------------
+# job-scoped fault quarantine
+# ---------------------------------------------------------------------------
+
+
+def test_kill_host_quarantines_exactly_one_lane():
+    """An injected kill_host in ONE job's fault plan drains that job's
+    lane only: the faulted job must bit-match a SOLO run with the same
+    fault plan (injection timing included), and the clean neighbor must
+    bit-match a solo no-fault run."""
+    faults = {"inject": [{"at": "500 ms", "op": "kill_host", "host": 3}]}
+    jobs = [
+        JobSpec("clean", _cfg(50, "1.2 s")),
+        JobSpec("faulty", _cfg(50, "1.2 s", faults=faults)),
+    ]
+    fleet = build_fleet(jobs, keep_final_subs=True)
+    fleet.run()
+    clean, faulty = fleet.records()
+    assert clean.faults == {}
+    assert faulty.faults["hosts_quarantined"] == 1
+    assert faulty.faults["injections_fired"] == 1
+    assert faulty.faults["events_drained"] > 0
+    assert faulty.events_committed < clean.events_committed
+
+    # clean lane: untouched by the neighbor's fault
+    _assert_job_matches_solo(clean, jobs[0].config)
+
+    # faulty lane: identical to the solo faulted run
+    from shadow_tpu.core.config import load_config
+
+    solo = build_simulation(jobs[1].config)
+    solo.attach_faults(load_config(jobs[1].config).faults.load_faults())
+    solo.run()
+    assert faulty.counters == solo.counters()
+    assert (
+        faulty.faults["events_drained"]
+        == solo.fault_counters["events_drained"]
+    )
+
+
+def test_fleet_floor_width_violation_refuses_commit():
+    """The fleet driver carries the same floor-commit guard as the solo
+    engines (ADVICE r5 #1): a forged violation inside a floor-width
+    window must raise, naming the lane, instead of committing."""
+    import jax.numpy as jnp
+
+    fleet = build_fleet(_jobs(n=2))
+
+    def forged(state, params, ws, we):
+        return state, we, ws  # "complete" but violated at the window start
+
+    fleet._attempt = forged  # _ensure_attempt keeps a non-None kernel
+    with pytest.raises(RuntimeError, match="refusing to commit"):
+        fleet.run_optimistic(window_factor=1)
+
+
+def test_fleet_rejects_proc_fault_ops():
+    faults = {"inject": [{"at": "1 s", "op": "kill_proc", "proc": "x.0"}]}
+    with pytest.raises(SweepError, match="kill_host"):
+        build_fleet([JobSpec("a", _cfg(1, "1 s", faults=faults))])
+
+
+# ---------------------------------------------------------------------------
+# scheduler plane: deadlines, checkpoint/resume, metrics
+# ---------------------------------------------------------------------------
+
+
+def test_wall_deadline_times_out_one_job():
+    jobs = [
+        JobSpec("ok", _cfg(70, "1.2 s")),
+        JobSpec("slow", _cfg(71, "1.2 s"), deadline_s=1e-9),
+    ]
+    fleet = build_fleet(jobs, keep_final_subs=True)
+    fleet.run(windows_per_dispatch=2)
+    ok, slow = fleet.records()
+    assert slow.status == "timeout"
+    assert "deadline" in slow.reason
+    assert ok.status == "done"
+    _assert_job_matches_solo(ok, jobs[0].config)
+
+
+def test_fleet_checkpoint_resume(tmp_path):
+    """A fleet interrupted mid-sweep resumes from its per-job slices +
+    manifest and finishes with results identical to an uninterrupted
+    run: completed jobs keep their recorded results, running lanes
+    restore bit-exactly, queued jobs re-queue."""
+    jobs = _jobs(n=4)
+    full = build_fleet(jobs, lanes=2)
+    full.run()
+    want = {r.name: r.summary() for r in full.records()}
+
+    part = build_fleet(jobs, lanes=2)
+    part.run(windows_per_dispatch=4, max_dispatches=3)
+    statuses = {r.status for r in part.records()}
+    assert "queued" in statuses or "running" in statuses  # truly partial
+    d = tmp_path / "fleet-ckpt"
+    save_fleet(part, str(d))
+    assert (d / "manifest.json").exists()
+
+    res = resume_fleet(str(d))
+    res.run()
+    for name, w in want.items():
+        g = next(r for r in res.records() if r.name == name).summary()
+        assert g["counters"] == w["counters"], name
+        assert g["events_committed"] == w["events_committed"], name
+        assert g["frontier_ns"] == w["frontier_ns"], name
+
+
+def test_metrics_schema_v4_fleet_section():
+    from shadow_tpu.obs import metrics as obs_metrics
+
+    jobs = _jobs(n=2)
+    fleet = build_fleet(jobs)
+    fleet.run()
+    reg = obs_metrics.MetricsRegistry()
+    obs_metrics.snapshot_fleet(fleet, reg)
+    doc = reg.to_doc()
+    obs_metrics.validate_metrics_doc(doc)
+    assert doc["schema_version"] == 4
+    rows = doc["fleet"]["jobs"]
+    assert len(rows) == 2
+    assert all(r["status"] == "done" for r in rows)
+    assert doc["counters"]["fleet.kernel_traces"] == 1
+    # the validator actually gates the fleet rows
+    rows[0].pop("frontier_ns")
+    with pytest.raises(ValueError, match="fleet.jobs"):
+        obs_metrics.validate_metrics_doc(doc)

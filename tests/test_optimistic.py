@@ -5,6 +5,7 @@ BASELINE staged config 4 calls for optimistic PDES windows)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from shadow_tpu.core import simtime
 from shadow_tpu.core.engine import Simulation
@@ -263,6 +264,44 @@ def test_islands_optimistic_netstack_equivalence():
     a = np.asarray(jax.device_get(cons.state.subs["udp_flood"]["recv"]))
     b = np.asarray(jax.device_get(opt.state.subs["udp_flood"]["recv"]))
     assert (a == b.reshape(a.shape)).all()
+
+
+def test_floor_width_violation_refuses_commit():
+    """ADVICE r5 #1 regression (global engine): forge a speculation
+    violation inside a conservative-width window. Such a window is
+    violation-free BY CONSTRUCTION, so a reported violation means the
+    invariant itself broke — the driver must raise instead of silently
+    committing the causally-violated window."""
+    sim = _noop_sim()
+
+    def forged_attempt(state, params, ws, we):
+        # window "completes" (mn = we) but reports a violation at ws
+        return state, jnp.asarray(we, jnp.int64), jnp.asarray(ws, jnp.int64)
+
+    sim._attempt = forged_attempt
+    with pytest.raises(RuntimeError, match="refusing to commit"):
+        # factor 1: every window is conservative-width, the guard zone
+        sim.run_optimistic(window_factor=1)
+
+
+def test_islands_floor_width_violation_refuses_commit():
+    """ADVICE r5 #1 regression (islands runner): same forged violation
+    through the per-shard attempt kernel's return shape — the
+    floor-width commit path must raise, mirroring the engine-side
+    guard."""
+    sim = build_simulation(_islandize_yaml(MIXED_YAML))
+    S = sim.num_shards
+
+    def forged_attempt(state, params, ws, we):
+        return (
+            state,
+            jnp.full((S,), jnp.asarray(we, jnp.int64)),
+            jnp.full((S,), jnp.asarray(ws, jnp.int64)),
+        )
+
+    sim._attempt = forged_attempt  # _ensure_optimistic keeps it (non-None)
+    with pytest.raises(RuntimeError, match="refusing to commit"):
+        sim.run_optimistic(window_factor=1)
 
 
 def test_adaptive_factor_equivalence():

@@ -1,0 +1,70 @@
+#!/usr/bin/env python3
+"""Expand a `sweep:` config matrix into a validated job list.
+
+    python tools/expand_sweep.py sweep.yaml [-o jobs.yaml] [--json]
+
+Each expanded job's config is parsed through the experiment-config loader
+and the set is checked for kernel compatibility (all jobs of a fleet share
+ONE compiled window kernel — see docs/fleet.md), so a bad sweep spec fails
+HERE with a clean nonzero exit and the offending job/field named, never
+minutes into a fleet run. The output loads back with
+``python -m shadow_tpu sweep --fleet jobs.yaml``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import yaml
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("sweep", help="sweep YAML (base config + sweep: section)")
+    p.add_argument(
+        "-o", "--out", metavar="PATH",
+        help="write the job list here (default: stdout)",
+    )
+    p.add_argument(
+        "--json", action="store_true",
+        help="emit JSON instead of YAML",
+    )
+    args = p.parse_args(argv)
+
+    # import after arg parsing so --help never pays jax startup
+    from shadow_tpu.core.config import ConfigError
+    from shadow_tpu.fleet.sweep import SweepError, load_sweep
+
+    try:
+        jobs, sweep = load_sweep(args.sweep)
+    except (SweepError, ConfigError, FileNotFoundError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    except yaml.YAMLError as e:
+        print(f"error: {args.sweep}: invalid YAML: {e}", file=sys.stderr)
+        return 2
+
+    doc = {"jobs": [j.to_json() for j in jobs]}
+    text = (
+        json.dumps(doc, indent=1) + "\n" if args.json
+        else yaml.safe_dump(doc, sort_keys=False)
+    )
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text)
+        print(
+            f"{len(jobs)} job(s) validated -> {args.out}", file=sys.stderr
+        )
+    else:
+        sys.stdout.write(text)
+        print(f"# {len(jobs)} job(s) validated", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
