@@ -456,6 +456,105 @@ def stage_obs_overhead(num_hosts: int = 8192, msgload: int = 4,
     }
 
 
+def stage_audit_smoke(num_hosts: int = 8192, msgload: int = 4,
+                      stop_s: int = 4, flight_capacity: int = 64):
+    """Determinism-audit gate (ISSUE 5 acceptance): the flagship PHOLD
+    shape with the digest chain + flight ring compiled IN vs OUT — the
+    folds are fused i64 arithmetic and one-hot ring writes per window
+    step, gated at ≤ 3% step time. Also asserts the chain is identical
+    across two seeded reruns, and that the divergence bisector pinpoints
+    the exact forged window (the diff engine behind tools/diff_digest.py).
+    Writes a schema-v5 metrics artifact (audit.* namespace) so
+    tools/tpu_watch.py can schema-gate this stage line at capture."""
+    import copy
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from shadow_tpu.core import simtime
+    from shadow_tpu.flagship import build_phold_flagship
+    from shadow_tpu.obs import audit as audit_mod
+    from shadow_tpu.obs import metrics as obs_metrics
+
+    def timed(audit_on: bool, flight: int, seed: int = 42):
+        sim = build_phold_flagship(
+            num_hosts, msgload=msgload, stop_s=stop_s, runtime_s=stop_s,
+            seed=seed, audit_digest=audit_on, flight_recorder=flight,
+        )
+        sim.run(until=int(0.2 * simtime.NS_PER_SEC))
+        jax.block_until_ready(sim.state.pool.time)
+        t0 = time.perf_counter()
+        sim.run()
+        jax.block_until_ready(sim.state.pool.time)
+        return time.perf_counter() - t0, sim
+
+    # interleave the arms to decorrelate machine drift from the comparison
+    w_aud, sim1 = timed(True, flight_capacity)
+    w_base, _ = timed(False, 0)
+    w2, sim2 = timed(True, flight_capacity)
+    w_aud = min(w_aud, w2)
+    w_base = min(w_base, timed(False, 0)[0])
+    overhead = (w_aud - w_base) / w_base * 100.0 if w_base > 0 else 0.0
+    chain1, chain2 = sim1.audit_chain(), sim2.audit_chain()
+
+    # divergence bisection: two seeded reruns dump identical digest docs;
+    # forging one mid-run record (and one host sub-chain) must be
+    # pinpointed to the exact window and host
+    tiny = dict(num_hosts=1024, msgload=2, stop_s=2, runtime_s=2)
+    with tempfile.TemporaryDirectory(prefix="audit_smoke_") as td:
+        docs = []
+        for i in range(2):
+            s = build_phold_flagship(audit_digest=True, **tiny)
+            s.attach_audit(meta={"arm": i})
+            s.run(windows_per_dispatch=4)
+            docs.append(s.write_digest(os.path.join(td, f"d{i}.json")))
+    clean = audit_mod.diff_digest_docs(docs[0], docs[1])
+    forged = copy.deepcopy(docs[1])
+    k = len(forged["records"]) // 2
+    forged["records"][k]["chain"] ^= 0x5A5A
+    forged["hosts"][3] = (forged["hosts"][3] ^ 0x5A5A) & ((1 << 64) - 1)
+    forged["final"]["chain"] = audit_mod.combine(
+        np.asarray(forged["hosts"], dtype=np.uint64)
+    )
+    rep = audit_mod.diff_digest_docs(docs[0], forged)
+    first = rep["first_divergent_record"] or {}
+    forged_found = (
+        first.get("seq_a") == docs[0]["records"][k]["seq"]
+        and rep["divergent_hosts"] == [3]
+    )
+
+    # schema-v5 metrics artifact with the audit.* namespace, referenced
+    # from this row so tpu_watch schema-gates it at capture time
+    metrics_path = os.path.join(_REPO, "audit_smoke.metrics.json")
+    session = obs_metrics.ObsSession()
+    session.finalize(sim1)
+    doc = session.metrics.dump(metrics_path, meta={
+        "stage": "audit_smoke", "hosts": num_hosts,
+    })
+    obs_metrics.validate_metrics_doc(doc)
+
+    gate_3 = overhead <= 3.0
+    return {
+        "stage": "audit_smoke",
+        "hosts": num_hosts,
+        "flight_capacity": flight_capacity,
+        "wall_base_s": round(w_base, 3),
+        "wall_audit_s": round(w_aud, 3),
+        "overhead_pct": round(overhead, 2),
+        "gate_3pct": gate_3,
+        "chain": int(chain1),
+        "chains_equal": chain1 == chain2 and chain1 != 0,
+        "rerun_docs_identical": clean["identical"],
+        "forged_window_found": forged_found,
+        "metrics_out": os.path.relpath(metrics_path, _REPO),
+        "gate": bool(
+            gate_3 and chain1 == chain2 and chain1 != 0
+            and clean["identical"] and forged_found
+        ),
+    }
+
+
 def stage_gear_win(num_hosts: int = 8192, msgload: int = 4, stop_s: int = 4):
     """Gearing win smoke row (ISSUE 2 acceptance gate): the flagship PHOLD
     shape with the pool oversized 8× above steady-state occupancy — the
@@ -770,6 +869,12 @@ def main():
     if "--obs-smoke" in sys.argv:
         # telemetry-plane overhead gate (<= 3% step time with counters on)
         print(json.dumps(_with_backend_retry(stage_obs_overhead)), flush=True)
+        return
+    if "--audit-smoke" in sys.argv:
+        # determinism-audit gate (<= 3% step time with digest chain +
+        # flight ring compiled in; identical chains across seeded reruns;
+        # the bisector pinpoints a forged divergence)
+        print(json.dumps(_with_backend_retry(stage_audit_smoke)), flush=True)
         return
     if "--gear-smoke" in sys.argv:
         # occupancy-adaptive gearing gate (>= 25% per-window win with the

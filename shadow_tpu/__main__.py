@@ -66,6 +66,20 @@ def _build_parser() -> argparse.ArgumentParser:
              "device plane only",
     )
     p.add_argument(
+        "--digest-out", metavar="PATH",
+        help="write the determinism-audit digest document (per-handoff "
+             "chain records + final per-host sub-chains, obs/audit.py); "
+             "compare two runs with tools/diff_digest.py; device plane "
+             "only",
+    )
+    p.add_argument(
+        "--flight-out", metavar="PATH",
+        help="spool the flight-recorder ring (last R committed events per "
+             "host; requires experimental.flight_recorder) to a binary "
+             "file at every handoff boundary; convert with "
+             "tools/flight_to_trace.py; device plane only",
+    )
+    p.add_argument(
         "--pool-gears", type=int, metavar="N",
         help="override experimental.pool_gears: compile the window kernel "
              "at N pool-capacity tiers (C/4, C/2, C for 3) and shift to "
@@ -251,7 +265,8 @@ def _run_device_plane(
     metrics_out: str | None = None, trace_out: str | None = None,
     checkpoint_every: str | None = None, checkpoint_dir: str | None = None,
     checkpoint_retain: int = 3, resume: str | None = None,
-    data_dir=None,
+    data_dir=None, digest_out: str | None = None,
+    flight_out: str | None = None,
 ) -> int:
     session = None
     if metrics_out or trace_out:
@@ -262,6 +277,22 @@ def _run_device_plane(
             tracer=obs_trace.ChromeTracer() if trace_out else None
         )
         sim.obs_session = session
+    if digest_out:
+        try:
+            sim.attach_audit(meta={
+                "hosts": sim.num_hosts,
+                "stop_time_ns": sim.stop_time,
+                "seed": cfg.general.seed,
+            })
+        except ValueError as e:
+            print(f"error: --digest-out: {e}", file=sys.stderr)
+            return 2
+    if flight_out:
+        try:
+            sim.attach_flight_spool(flight_out)
+        except ValueError as e:
+            print(f"error: --flight-out: {e}", file=sys.stderr)
+            return 2
     faults = cfg.faults.load_faults()
     if faults:
         sim.attach_faults(faults)
@@ -351,6 +382,24 @@ def _run_device_plane(
         if trace_out:
             session.tracer.write(trace_out)
             print(f"trace written to {trace_out}", file=sys.stderr)
+    if sim.flight_spool is not None:
+        # final flush at the run's end frontier, then close the spool
+        sim.flight_spool.flush(sim, sim.stop_time)
+        sim.flight_spool.close()
+        st = sim.flight_spool.stats()
+        print(
+            f"flight spool written to {flight_out} "
+            f"({st['records_written']} records, {st['frames']} frames)",
+            file=sys.stderr,
+        )
+    if digest_out:
+        doc = sim.write_digest(digest_out)
+        print(
+            f"digest written to {digest_out} "
+            f"(chain {doc['final']['chain']:#018x}, "
+            f"{len(doc['records'])} records)",
+            file=sys.stderr,
+        )
     return 0
 
 
@@ -433,10 +482,12 @@ def main(argv: list[str] | None = None) -> int:
         return 2
 
     if has_procs:
-        if args.metrics_out or args.trace_out:
+        if args.metrics_out or args.trace_out or args.digest_out \
+                or args.flight_out:
             print(
-                "note: --metrics-out/--trace-out cover the device plane "
-                "only; ignored for managed-process simulations",
+                "note: --metrics-out/--trace-out/--digest-out/--flight-out "
+                "cover the device plane only; ignored for managed-process "
+                "simulations",
                 file=sys.stderr,
             )
         if args.checkpoint_every or args.resume:
@@ -454,6 +505,7 @@ def main(argv: list[str] | None = None) -> int:
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_retain=args.checkpoint_retain,
         resume=args.resume, data_dir=data_dir,
+        digest_out=args.digest_out, flight_out=args.flight_out,
     )
 
 

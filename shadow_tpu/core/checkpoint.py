@@ -94,6 +94,18 @@ def save(sim, path: str) -> None:
             "capacity": int(ladder[sim._gear].capacity),
             "tiers": len(ladder),
         }
+    # Determinism-audit chain (obs/audit.py): a header copy of the digest
+    # chain at this boundary, so tools/diff_digest.py can audit a
+    # checkpoint against a digest document without decompressing leaves.
+    ob = getattr(sim.state, "obs", None)
+    if ob is not None and getattr(ob, "host_digest", None) is not None:
+        from shadow_tpu.obs import audit as audit_mod
+
+        meta["audit"] = {
+            "chain": audit_mod.combine(
+                np.asarray(jax.device_get(ob.host_digest))
+            ),
+        }
     meta["digest"] = _digest(arrays)
     arrays["__meta__"] = np.frombuffer(
         json.dumps(meta).encode(), dtype=np.uint8

@@ -206,6 +206,18 @@ class ExperimentalOptions:
     # obs-overhead smoke row); False compiles them out — the control arm
     # of that measurement.
     obs_counters: bool = True
+    # Determinism-audit digest chain (shadow_tpu/obs/audit.py): fold every
+    # committed event's key into the per-host rolling-mix chain inside the
+    # window kernel. On by default (fused i64 arithmetic, gated <= 3% by
+    # bench.py --audit-smoke); False compiles the folds out — the control
+    # arm of that measurement.
+    audit_digest: bool = True
+    # Flight recorder (shadow_tpu/obs/flight.py): device-resident ring of
+    # the last R committed event records per host, flushed to a binary
+    # spool at handoff boundaries (--flight-out) and convertible into a
+    # virtual-time Perfetto clock domain (tools/flight_to_trace.py).
+    # Accepts an integer capacity or {capacity: R}; 0 = compiled out.
+    flight_recorder: int = 0
     # CPU↔TPU seam: route managed-process UDP through the device-stepped
     # network (procs/bridge.py). The BASELINE north-star path.
     use_device_network: bool = False
@@ -235,6 +247,7 @@ class ExperimentalOptions:
                 setattr(out, name, units.parse_bytes(d[name]))
         for name in (
             "use_device_network", "use_device_tcp", "obs_counters",
+            "audit_digest",
             "socket_recv_autotune", "socket_send_autotune", "use_memory_manager",
             "use_seccomp", "use_syscall_counters", "use_object_counters",
         ):
@@ -262,6 +275,16 @@ class ExperimentalOptions:
                 setattr(out, name, int(d[name]))
         if out.pool_gears < 1:
             raise ConfigError("experimental.pool_gears must be >= 1")
+        if d.get("flight_recorder") is not None:
+            v = d["flight_recorder"]
+            if isinstance(v, dict):
+                _check_fields("experimental.flight_recorder", v, {"capacity"})
+                v = v.get("capacity", 0)
+            out.flight_recorder = int(v)
+            if out.flight_recorder < 0:
+                raise ConfigError(
+                    "experimental.flight_recorder capacity must be >= 0"
+                )
         if "rebalance" in d:
             out.rebalance = bool(d["rebalance"])
         if "island_mode" in d:

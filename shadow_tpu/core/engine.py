@@ -49,7 +49,9 @@ from shadow_tpu.core import gearbox
 from shadow_tpu.core import rng as rng_mod
 from shadow_tpu.core import simtime, soa
 from shadow_tpu.core import spill as spill_mod
+from shadow_tpu.obs import audit as audit_mod
 from shadow_tpu.obs import counters as obs_mod
+from shadow_tpu.obs import flight as flight_mod
 from shadow_tpu.obs import metrics as metrics_mod
 from shadow_tpu.core.state import (
     PAYLOAD_WORDS,
@@ -561,6 +563,7 @@ def make_window_step(
     bulk_self_excluded: bool = False,
     payload_words: int = PAYLOAD_WORDS,
     island: IslandSpec | None = None,
+    audit: bool = True,
     _force_path: str | None = None,  # "matrix"|"loop": testing/profiling only
 ):
     """Build step(state, params, win_start, win_end) -> (state, min_next).
@@ -996,12 +999,40 @@ def make_window_step(
                     # virtual-time frontier (events process in key order
                     # per host, so a where-select IS the running max)
                     ob = state.obs
+                    hd = ob.host_digest
+                    if audit:
+                        # determinism-audit chain (obs/audit.py): fold the
+                        # head event then each bulk column — per-host key
+                        # order, the order every engine layout commits in.
+                        # Keys use the ORIGINAL event time (not the CPU
+                        # model's exec shift), so chains are model-stable.
+                        hd = audit_mod.fold(
+                            hd, valid, ev_time, ev.src, gid, ev_kind
+                        )
+                        for bt, bs, bv in zip(bulk_t, bulk_s, bulk_valid):
+                            hd = audit_mod.fold(
+                                hd, bv, bt, bs, gid, bulk_kind
+                            )
                     state = state.replace(obs=ob.replace(
                         host_events=ob.host_events
                         + valid.astype(jnp.int64)
                         + taken_extra.astype(jnp.int64),
                         host_last_t=jnp.where(valid, last_t, ob.host_last_t),
+                        host_digest=hd,
                     ))
+                if state.flight is not None:
+                    # flight recorder (obs/flight.py): append the committed
+                    # records at each host's ring cursor, head then bulk
+                    # columns — the same commit order the digest folds in
+                    fl = flight_mod.record(
+                        state.flight, valid, ev_time, ev.src, ev.seq,
+                        ev_kind,
+                    )
+                    for bt, bs, bq, bv in zip(
+                        bulk_t, bulk_s, bulk_q, bulk_valid
+                    ):
+                        fl = flight_mod.record(fl, bv, bt, bs, bq, bulk_kind)
+                    state = state.replace(flight=fl)
 
                 # --- route emissions (order fixes per-source seq numbers) ---
                 for em in emitter.records:
@@ -1286,13 +1317,33 @@ def make_window_step(
             )
             if state.obs is not None:
                 ob = state.obs
+                hd = ob.host_digest
+                if audit:
+                    # audit chain over the dense window, column by column —
+                    # per-host key order, identical to the loop path's
+                    # micro-step commit order, so either dispatch path of
+                    # the same window folds the same chain
+                    for j in range(K):
+                        hd = audit_mod.fold(
+                            hd, valid[:, j], d_t[:, j], d_s[:, j], gid,
+                            dense.kind[:, j],
+                        )
                 state = state.replace(obs=ob.replace(
                     host_events=ob.host_events
                     + jnp.sum(valid, axis=1, dtype=jnp.int64),
                     host_last_t=jnp.where(
                         nvalid > 0, last_t, ob.host_last_t
                     ),
+                    host_digest=hd,
                 ))
+            if state.flight is not None:
+                fl = state.flight
+                for j in range(K):
+                    fl = flight_mod.record(
+                        fl, valid[:, j], d_t[:, j], d_s[:, j], d_q[:, j],
+                        dense.kind[:, j],
+                    )
+                state = state.replace(flight=fl)
             # --- merge (sort 3): tail leftovers ∪ emissions, ONE 1-key
             # stable sort by time carrying every column; no payload
             # indirection gathers. Output truncates to pool capacity
@@ -1497,6 +1548,8 @@ class Simulation:
         bulk_self_excluded: bool = False,
         obs_counters: bool = True,
         pool_gears: int = 1,
+        audit_digest: bool = True,
+        flight_capacity: int = 0,
     ):
         # initial_events: (time, dst, src, kind, payload words)
         self.num_hosts = num_hosts
@@ -1577,6 +1630,10 @@ class Simulation:
         self._bulk_gate = bulk_gate
         self._bulk_self_excluded = bulk_self_excluded
         self._payload_words = payload_words
+        # Determinism audit plane (obs/audit.py): the digest chain folds
+        # ride the obs block; False compiles the folds out — the control
+        # arm of bench.py --audit-smoke.
+        self._audit_digest = bool(audit_digest)
         host = make_host_state(
             num_hosts, host_vertex,
             cpu_cost=cpu_ns_per_event if with_cpu else None,
@@ -1590,11 +1647,20 @@ class Simulation:
             rng_keys=rng_mod.host_keys(seed, num_hosts),
             subs=subs or {},
             obs=obs_mod.ObsBlock.zeros(num_hosts) if obs_counters else None,
+            flight=(
+                flight_mod.FlightRing.zeros(num_hosts, int(flight_capacity))
+                if flight_capacity else None
+            ),
         )
         # Telemetry session (obs/metrics.ObsSession): attached by the CLI
         # (--metrics-out/--trace-out) or bench; None keeps the run loops on
         # their zero-instrumentation path.
         self.obs_session = None
+        # Determinism-audit trail + flight spool (obs/audit.py /
+        # obs/flight.py): attached by --digest-out / --flight-out; None
+        # keeps every handoff free of the extra obs-block fetch.
+        self.audit = None
+        self.flight_spool = None
         # Fault-tolerance plane (shadow_tpu/faults): device/file injections
         # execute at handoff boundaries via _handoff_tick; quarantined
         # (dead) hosts have their pending pool/spill events drained at
@@ -1631,6 +1697,7 @@ class Simulation:
             bulk_gate=self._bulk_gate,
             bulk_self_excluded=self._bulk_self_excluded,
             payload_words=self._payload_words,
+            audit=self._audit_digest,
         )
         return {
             "step_fn": step,
@@ -1768,6 +1835,8 @@ class Simulation:
             with metrics_mod.span(obs, "dispatch", windows=1):
                 self.state, mn = self._step(self.state, self.params, ws, we)
             self._gear_note_dispatch()
+            if self._audit_active():
+                self._audit_tick(int(mn))
             windows += 1
         return windows
 
@@ -1883,6 +1952,7 @@ class Simulation:
             windows += 1
             if obs is not None:
                 obs.round_done(self)
+            self._audit_tick(min_next)
             if self._fault_plane_active():
                 self._handoff_tick(min_next)
                 min_next = int(jnp.min(self.state.pool.time))
@@ -1949,6 +2019,7 @@ class Simulation:
             self._gear_note_dispatch()
             if obs is not None:
                 obs.round_done(self)
+            self._audit_tick(mn)
             # gearing: a red-zone early exit upshifts (one pool re-sort)
             # before the spill tier would pay host drain round-trips
             shifted = self._gear_tick(occ, press=press)
@@ -2166,6 +2237,64 @@ class Simulation:
         engine layouts; {} when built with obs_counters=False. Read at
         handoff boundaries only — it device_gets the block."""
         return obs_mod.snapshot(self.state)
+
+    # -- determinism audit plane (obs/audit.py, obs/flight.py) --
+
+    def attach_audit(self, meta: dict | None = None):
+        """Arm per-handoff digest-chain recording (--digest-out). Needs
+        the obs block (the chain lives in it)."""
+        if self.state.obs is None:
+            raise ValueError(
+                "digest auditing needs the obs block "
+                "(experimental.obs_counters: true)"
+            )
+        self.audit = audit_mod.AuditTrail(meta)
+        return self.audit
+
+    def attach_flight_spool(self, path: str):
+        """Arm flight-ring spooling to `path` (--flight-out). Needs the
+        ring compiled in (experimental.flight_recorder)."""
+        if self.state.flight is None:
+            raise ValueError(
+                "flight spooling needs experimental.flight_recorder "
+                "(the ring compiles into the kernel)"
+            )
+        self.flight_spool = flight_mod.FlightSpool(
+            path, self.num_hosts, self.state.flight.capacity
+        )
+        return self.flight_spool
+
+    def audit_chain(self) -> int:
+        """The current global digest-chain value: one obs-block fetch plus
+        the order-independent per-host combine. 0 when the block is off."""
+        snap = self.obs_snapshot()
+        if not snap or "host_digest" not in snap:
+            return 0
+        return audit_mod.combine(snap["host_digest"])
+
+    def write_digest(self, path: str) -> dict:
+        """Dump the digest document (--digest-out): chain records, final
+        per-host sub-chains, final combined chain."""
+        if self.audit is None:
+            raise ValueError("no audit trail attached (attach_audit first)")
+        return self.audit.dump(path, self.obs_snapshot())
+
+    def _audit_active(self) -> bool:
+        return self.audit is not None or self.flight_spool is not None
+
+    def _audit_tick(self, mn: int) -> None:
+        """Record the digest chain and flush the flight ring at a handoff
+        boundary the driver already synced at. Zero work unless a trail
+        or spool is attached."""
+        if not self._audit_active():
+            return
+        frontier = min(int(mn), self.stop_time)
+        if self.audit is not None:
+            snap = self.obs_snapshot()
+            if snap:
+                self.audit.record(snap, frontier)
+        if self.flight_spool is not None:
+            self.flight_spool.flush(self, frontier)
 
     def save_checkpoint(self, path: str) -> None:
         """Snapshot the full device state to disk (resume is bit-exact)."""

@@ -220,6 +220,13 @@ class SimState:
     # handoff boundaries. None compiles every update out (the bench's
     # obs-overhead control arm; experimental.obs_counters).
     obs: Any = None
+    # Flight recorder (shadow_tpu.obs.flight.FlightRing): opt-in per-host
+    # ring of the last R committed event records, written in-kernel by
+    # masked one-hot updates and flushed to a binary spool at handoff
+    # boundaries (experimental.flight_recorder). Rides the pytree like
+    # obs: rollbacks discard speculated records, checkpoints capture the
+    # ring, the fleet stacks it per lane. None compiles it out.
+    flight: Any = None
 
     def with_sub(self, key: str, value) -> "SimState":
         """Functional sub-state update (dict copy; the pytree structure is

@@ -29,6 +29,8 @@ def build_phold_flagship(
     exchange_slots: int = 0,
     obs_counters: bool = True,
     pool_gears: int = 1,
+    audit_digest: bool = True,
+    flight_recorder: int = 0,
 ):
     from shadow_tpu.sim import build_simulation
 
@@ -82,6 +84,8 @@ def build_phold_flagship(
                 "inbox_slots": 4,
                 "obs_counters": obs_counters,
                 "pool_gears": pool_gears,
+                "audit_digest": audit_digest,
+                "flight_recorder": flight_recorder,
             },
             "hosts": {
                 "peer": {

@@ -50,8 +50,14 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--metrics-out", metavar="PATH",
-        help="write the fleet metrics document (schema v4: fleet.jobs[*] "
-             "per-job rows) as JSON",
+        help="write the fleet metrics document (schema v5: fleet.jobs[*] "
+             "per-job rows incl. audit.chain digests) as JSON",
+    )
+    p.add_argument(
+        "--trace-out", metavar="PATH",
+        help="write driver-phase spans + per-lane job lifecycles as "
+             "Chrome trace-event JSON (each lane gets its own named tid; "
+             "load in Perfetto or summarize with tools/trace_summary.py)",
     )
     p.add_argument(
         "--checkpoint-every", metavar="TIME",
@@ -137,6 +143,7 @@ def main(argv: list[str] | None = None) -> int:
         return 2
 
     t0 = time.monotonic()
+    session = None
     try:
         if args.resume:
             fleet = resume_fleet(
@@ -150,6 +157,15 @@ def main(argv: list[str] | None = None) -> int:
                 checkpoint_dir=ckpt_dir,
                 checkpoint_every_ns=ckpt_every or 0,
             )
+        if args.metrics_out or args.trace_out:
+            from shadow_tpu.obs import metrics as obs_metrics
+            from shadow_tpu.obs import trace as obs_trace
+
+            session = obs_metrics.ObsSession(
+                tracer=obs_trace.ChromeTracer("shadow_tpu sweep")
+                if args.trace_out else None
+            )
+            fleet.attach_obs(session)
         if sync == "optimistic":
             fleet.run_optimistic()
         else:
@@ -174,12 +190,20 @@ def main(argv: list[str] | None = None) -> int:
     if args.metrics_out:
         from shadow_tpu.obs import metrics as obs_metrics
 
-        reg = obs_metrics.MetricsRegistry()
+        # the session's registry (when attached) already carries the
+        # dispatch wall histograms; the fleet section rides on top
+        reg = (
+            session.metrics if session is not None
+            else obs_metrics.MetricsRegistry()
+        )
         obs_metrics.snapshot_fleet(fleet, reg)
         reg.dump(args.metrics_out, meta={
             "jobs": stats["jobs_total"], "wall_s": stats["wall_s"],
         })
         print(f"metrics written to {args.metrics_out}", file=sys.stderr)
+    if args.trace_out and session is not None and session.tracer is not None:
+        session.tracer.write(args.trace_out)
+        print(f"trace written to {args.trace_out}", file=sys.stderr)
     if failed:
         print(f"{failed} job(s) did not complete", file=sys.stderr)
         return 1

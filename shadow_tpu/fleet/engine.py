@@ -48,7 +48,9 @@ from shadow_tpu.fleet.scheduler import (
     DONE, FAILED, TIMEOUT, FleetScheduler, JobRecord,
 )
 from shadow_tpu.fleet.sweep import JobSpec, validate_jobs
+from shadow_tpu.obs import audit as audit_mod
 from shadow_tpu.obs import counters as obs_mod
+from shadow_tpu.obs import metrics as metrics_mod
 from shadow_tpu.parallel import islands as islands_mod
 
 NEVER = simtime.NEVER
@@ -132,6 +134,11 @@ class FleetSimulation:
         self._ckpt_next_t = self.checkpoint_every_ns or int(NEVER)
         self.kernel_traces = 0
         self.gear_shifts = 0
+        # Telemetry session (obs/metrics.ObsSession): attached by the
+        # sweep CLI (--metrics-out/--trace-out) via attach_obs. Fleet
+        # traces give each lane its own tid (lane index + 1; tid 0 is the
+        # driver row), named with "M" metadata events.
+        self.obs_session = None
 
         # --- build the first wave of solo sims; the first is the template
         # whose kernel config (handlers, shapes, ladder) the fleet adopts
@@ -248,6 +255,7 @@ class FleetSimulation:
             bulk_gate=t._bulk_gate,
             bulk_self_excluded=t._bulk_self_excluded,
             payload_words=t._payload_words,
+            audit=t._audit_digest,
             # under vmap a lax.cond with a batched predicate executes BOTH
             # branches, so matrix-capable sims pin the matrix path — the
             # same rule sim.py applies to vmap islands
@@ -330,6 +338,59 @@ class FleetSimulation:
         self._bind_gear()
 
     # ------------------------------------------------------------------
+    # telemetry session + per-lane trace rows
+    # ------------------------------------------------------------------
+
+    def attach_obs(self, session) -> None:
+        """Attach an ObsSession (metrics + optional Chrome tracer). Lanes
+        already occupied at attach time get their thread rows named and
+        an `admit` marker immediately, so a session attached right after
+        build still renders every job's full residency."""
+        self.obs_session = session
+        tr = session.tracer if session is not None else None
+        if tr is not None:
+            tr.thread_name(0, "driver")
+            for j, rec in enumerate(self.sched.lane_job):
+                if rec is not None:
+                    self._trace_admit(j, rec)
+
+    def _trace_admit(self, lane: int, rec: JobRecord) -> None:
+        obs = self.obs_session
+        if obs is None or obs.tracer is None:
+            return
+        tid = lane + 1
+        obs.tracer.thread_name(tid, f"lane {lane}")
+        rec._trace_ts0 = obs.tracer._now_us()
+        obs.tracer.instant("admit", tid=tid, job=rec.name, lane=lane)
+
+    def _trace_harvest(self, lane: int, rec: JobRecord) -> None:
+        obs = self.obs_session
+        if obs is None or obs.tracer is None:
+            return
+        tid = lane + 1
+        now = obs.tracer._now_us()
+        t0 = getattr(rec, "_trace_ts0", None)
+        if t0 is not None:
+            # one complete event per job residency on the lane's row
+            obs.tracer.complete(
+                rec.name, t0, now - t0, cat="job", tid=tid,
+                status=rec.status,
+                events_committed=int(rec.events_committed),
+            )
+        obs.tracer.instant(
+            "harvest", tid=tid, job=rec.name, status=rec.status
+        )
+
+    def counters(self) -> dict[str, int]:
+        """Engine counters summed across every lane (fleet-wide progress;
+        per-job counters are harvested per lane)."""
+        c = jax.device_get(self.state.counters)
+        return {
+            f.name: int(np.sum(np.asarray(getattr(c, f.name))))
+            for f in dataclasses.fields(c)
+        }
+
+    # ------------------------------------------------------------------
     # lane lifecycle
     # ------------------------------------------------------------------
 
@@ -372,10 +433,19 @@ class FleetSimulation:
                 "win": snap["win"],
                 "vtime": obs_mod.vtime_stats(hl),
             }
+            if "host_digest" in snap:
+                # the job's determinism-audit chain (obs/audit.py):
+                # lane slices are solo-layout, so this equals the same
+                # scenario's solo-run chain bit-for-bit (schema v5
+                # fleet.jobs[*].audit)
+                rec.audit = {
+                    "chain": audit_mod.combine(snap["host_digest"]),
+                }
         rec.faults = dict(self._lane_faults[lane].stats)
         if self.keep_final_subs:
             rec.subs = jax.device_get(lane_state.subs)
         self._lane_faults[lane] = _LaneFaults.empty()
+        self._trace_harvest(lane, rec)
         return rec
 
     def _admit_next(self, lane: int) -> bool:
@@ -405,6 +475,7 @@ class FleetSimulation:
         self._lane_faults[lane] = self._resolve_faults(sim)
         self.sched.admit(lane, rec)
         self.sched.lane_swaps += 1
+        self._trace_admit(lane, rec)
         return True
 
     def _kill_lane(self, lane: int) -> None:
@@ -470,6 +541,11 @@ class FleetSimulation:
                     lf.dead.add(hid)
                     lf.stats["hosts_quarantined"] = \
                         lf.stats.get("hosts_quarantined", 0) + 1
+                    obs = self.obs_session
+                    if obs is not None and obs.tracer is not None:
+                        obs.tracer.fault(
+                            "kill_host", tid=j + 1, host=hid, lane=j
+                        )
             if lf.dead and self._drain_lane_dead(j):
                 changed = True
         return changed
@@ -545,21 +621,25 @@ class FleetSimulation:
         wpd = windows_per_dispatch or self.windows_per_dispatch
         dispatches = 0
         last_sig = None
+        obs = self.obs_session
         while not self.sched.all_terminal():
             if max_dispatches is not None and dispatches >= max_dispatches:
                 break
             eff_stop = np.minimum(self._stop, self._fault_marks())
-            out = self._run_to(
-                self.state, self.params,
-                jnp.asarray(self._runahead), jnp.asarray(eff_stop), wpd,
-            )
-            self.state = out[0]
-            mn = np.asarray(jax.device_get(out[1])).reshape(
-                self.lanes, -1).min(axis=1)
-            press = np.asarray(jax.device_get(out[2])).reshape(
-                self.lanes, -1).any(axis=1)
-            occ = int(np.max(np.asarray(jax.device_get(out[3]))))
+            with metrics_mod.span(obs, "dispatch", windows=wpd):
+                out = self._run_to(
+                    self.state, self.params,
+                    jnp.asarray(self._runahead), jnp.asarray(eff_stop), wpd,
+                )
+                self.state = out[0]
+                mn = np.asarray(jax.device_get(out[1])).reshape(
+                    self.lanes, -1).min(axis=1)
+                press = np.asarray(jax.device_get(out[2])).reshape(
+                    self.lanes, -1).any(axis=1)
+                occ = int(np.max(np.asarray(jax.device_get(out[3]))))
             dispatches += 1
+            if obs is not None:
+                obs.round_done(self)
             changed = self._handoff(mn, press)
             if self._shifter is not None:
                 new = self._shifter.observe(
@@ -597,21 +677,24 @@ class FleetSimulation:
         parked on an exchange-deferred frontier retries its exchange (the
         solo driver's null-window stall)."""
         ws_d, we_d = jnp.asarray(ws), jnp.asarray(we)
+        obs = self.obs_session
         if not self._islands:
-            st, mn, viol = self._attempt(base, self.params, ws_d, we_d)
-            return (
-                st,
-                np.array(jax.device_get(mn), np.int64),
-                np.array(jax.device_get(viol), np.int64),
-            )
+            with metrics_mod.span(obs, "dispatch"):
+                st, mn, viol = self._attempt(base, self.params, ws_d, we_d)
+                return (
+                    st,
+                    np.array(jax.device_get(mn), np.int64),
+                    np.array(jax.device_get(viol), np.int64),
+                )
         st = base
         mn = ws.copy()
         viol = np.full(self.lanes, int(NEVER), np.int64)
         k = 0
         while True:
-            st, mn_d, viol_d = self._attempt(
-                st, self.params, jnp.asarray(np.maximum(mn, ws)), we_d
-            )
+            with metrics_mod.span(obs, "dispatch"):
+                st, mn_d, viol_d = self._attempt(
+                    st, self.params, jnp.asarray(np.maximum(mn, ws)), we_d
+                )
             mn = np.asarray(jax.device_get(mn_d)).reshape(
                 self.lanes, -1).min(axis=1)
             viol = np.minimum(viol, np.asarray(jax.device_get(viol_d)).reshape(
@@ -721,6 +804,8 @@ class FleetSimulation:
             self._reset_done_t()
             mn = mn_a
             rounds += 1
+            if self.obs_session is not None:
+                self.obs_session.round_done(self)
             if adaptive:
                 for j in range(L):
                     if not idle[j]:

@@ -46,6 +46,9 @@ class JobRecord:
     frontier_ns: int = -1
     counters: dict = dataclasses.field(default_factory=dict)
     faults: dict = dataclasses.field(default_factory=dict)
+    # determinism-audit sub-object (schema v5): at least {"chain": int},
+    # the job's digest-chain value — equal to the same scenario run solo
+    audit: dict = dataclasses.field(default_factory=dict)
     # optional deep captures for tests / downstream analysis
     subs: Any = None
     obs: Optional[dict] = None
@@ -77,6 +80,7 @@ class JobRecord:
             "wall_s": round(float(self.wall_s), 4),
             "counters": {k: int(v) for k, v in self.counters.items()},
             "faults": {k: int(v) for k, v in self.faults.items()},
+            "audit": {k: int(v) for k, v in self.audit.items()},
         }
 
 

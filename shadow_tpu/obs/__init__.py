@@ -9,9 +9,17 @@ Three pieces (docs/observability.md):
             snapshot at CPU<->TPU handoff boundaries; dumped as versioned
             JSON (--metrics-out)
   trace     nestable wall-time spans in Chrome trace-event JSON
-            (--trace-out), loadable in Perfetto
+            (--trace-out), loadable in Perfetto; fleet runs ride
+            per-lane named tids
+  audit     determinism-audit digest chains: in-kernel rolling-mix
+            hashes of committed event keys (--digest-out), plus the
+            divergence bisector behind tools/diff_digest.py
+  flight    opt-in flight recorder: device ring of the last R committed
+            events per host, spooled at handoffs (--flight-out) and
+            rendered as a virtual-time Perfetto clock domain
 
 Reference analog: tracker.c per-host byte/CPU accounting, lifted onto the
 device plane; virtual-time-progress statistics follow the PDES literature
-(desynchronization spread as the central health metric).
+(desynchronization spread as the central health metric); per-LP run-audit
+instrumentation follows PARSIR (arxiv 2410.00644).
 """

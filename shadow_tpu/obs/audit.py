@@ -1,0 +1,319 @@
+"""Determinism audit plane: in-kernel committed-event digest chains.
+
+Every plane in this engine stakes correctness on bit-identical replay —
+conservative vs optimistic, islands vs global, fleet lane vs solo, resume
+vs uninterrupted — but until this module that property was only checked
+inside tests by hauling full event arrays to the host. The digest chain
+makes it a production signal (PARSIR's per-LP run-audit instrumentation,
+arxiv 2410.00644, carried the way `host_last_t` carries the roughness
+metric): a per-host i64 rolling-mix hash of every committed event's key
+(time, src, dst, kind), folded INSIDE the jitted window step as an
+`ObsBlock` field (block v4), so two runs that committed exactly the same
+history carry exactly the same digests — and two that didn't, don't.
+
+Design invariants:
+
+* **Per-host order-dependent, cross-host order-independent.** Each host
+  folds its own events in per-host key order (the order every engine
+  commits them in), so per-host digests are layout-independent; the
+  GLOBAL chain combines host digests with a commutative reduction, so
+  islands shards / fleet lanes / rebalance permutations all report the
+  value the global engine would.
+* **Committed-only.** The digest rides the state pytree: an optimistic
+  rollback drops the speculated digests with the rest of the speculated
+  state, so chains never include rolled-back work.
+* **Checkpointed.** `host_digest` is a SimState leaf, so every checkpoint
+  carries the chain (plus a header copy in the .npz meta) and resume
+  parity is auditable end-to-end with `tools/diff_digest.py`.
+
+The host-side pieces here — `AuditTrail` (per-handoff chain records),
+the digest-document schema + validator, and the diff engine behind
+`tools/diff_digest.py` — turn "two runs disagree" into one tool
+invocation instead of a full-rerun bisect.
+"""
+
+from __future__ import annotations
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_MASK = (1 << 64) - 1
+
+
+def _i64(x: int) -> int:
+    """A 64-bit constant as the (possibly negative) python int whose i64
+    bit pattern matches — jnp promotes it into i64 expressions exactly."""
+    x &= _MASK
+    return x - (1 << 64) if x >= (1 << 63) else x
+
+
+# splitmix64 / PCG-style odd multipliers: full-period under wrapping i64
+# multiply, so single-field changes (one event's time, src, dst or kind)
+# avalanche through the key. XLA integer arithmetic wraps two's-complement,
+# which is exactly the modular arithmetic the chain is defined over.
+_K_TIME = _i64(0xBF58476D1CE4E5B9)
+_K_SRC = _i64(0x94D049BB133111EB)
+_K_DST = _i64(0x2545F4914F6CDD1D)
+_K_KIND = _i64(0xFF51AFD7ED558CCD)
+_CHAIN_MULT = _i64(0x5851F42D4C957F2D)
+_COMBINE_MULT = 0x9E3779B97F4A7C15
+
+
+def event_key(time, src, dst, kind) -> jnp.ndarray:
+    """Mix one committed event's total-order key into a single i64. All
+    four fields participate (+1 offsets keep host/kind 0 from zeroing a
+    term); a final xorshift spreads low-entropy inputs across the word."""
+    k = jnp.asarray(time, jnp.int64) * _K_TIME
+    k = k ^ ((jnp.asarray(src, jnp.int64) + 1) * _K_SRC)
+    k = k ^ ((jnp.asarray(dst, jnp.int64) + 1) * _K_DST)
+    k = k ^ ((jnp.asarray(kind, jnp.int64) + 1) * _K_KIND)
+    return k ^ jax.lax.shift_right_logical(k, jnp.asarray(31, jnp.int64))
+
+
+def fold(digest, mask, time, src, dst, kind) -> jnp.ndarray:
+    """One rolling-mix chain step per masked host:
+    digest' = digest * MULT + key(event). Order-DEPENDENT by construction
+    — the per-host commit order IS part of what the chain audits — and a
+    pure fused select/multiply/add, so it rides the window step at
+    vector bandwidth (no sync, no gather)."""
+    nd = digest * _CHAIN_MULT + event_key(time, src, dst, kind)
+    return jnp.where(mask, nd, digest)
+
+
+def combine(host_digests) -> int:
+    """Collapse per-host digests into ONE unsigned 64-bit chain value with
+    a commutative reduction (wrapping sum + xor), so the result is
+    independent of host enumeration order — islands shard layouts, fleet
+    lane slices and rebalance permutations all combine to the value the
+    global engine reports. Host-side only (runs on snapshot output)."""
+    d = np.asarray(host_digests).astype(np.uint64).reshape(-1)
+    if d.size == 0:
+        return 0
+    s = int(np.sum(d, dtype=np.uint64))
+    x = int(np.bitwise_xor.reduce(d))
+    return ((s * _COMBINE_MULT) ^ x) & _MASK
+
+
+# ---------------------------------------------------------------------------
+# The digest document (--digest-out) + validator + diff engine
+# ---------------------------------------------------------------------------
+
+DOC_KIND = "shadow_tpu.digest"
+DIGEST_SCHEMA_VERSION = 1
+
+
+class AuditTrail:
+    """Per-handoff chain records for one run. The drivers call
+    `record()` at every handoff boundary they already sync at (one extra
+    device_get of the obs block); `dump()` writes the schema'd digest
+    document `tools/diff_digest.py` consumes."""
+
+    def __init__(self, meta: dict | None = None):
+        self.meta = dict(meta or {})
+        self.records: list[dict] = []
+
+    def record(self, snap: dict, frontier_ns: int) -> dict | None:
+        """Append one chain record from an obs snapshot
+        (obs.counters.snapshot output). Consecutive duplicates (stalled
+        handoffs that committed nothing) collapse into one record."""
+        if not snap or "host_digest" not in snap:
+            return None
+        chain = combine(snap["host_digest"])
+        events = int(np.asarray(snap["host_events"]).sum())
+        if self.records:
+            last = self.records[-1]
+            if (last["frontier_ns"] == int(frontier_ns)
+                    and last["chain"] == chain):
+                return last
+        rec = {
+            "seq": len(self.records),
+            "frontier_ns": int(frontier_ns),
+            "chain": chain,
+            "events_committed": events,
+        }
+        self.records.append(rec)
+        return rec
+
+    def to_doc(self, snap: dict) -> dict:
+        """The digest document: meta, the per-handoff chain records, the
+        final per-host sub-chains (unsigned ints, GLOBAL host order) and
+        the final combined chain."""
+        hosts = [
+            int(np.uint64(v)) for v in np.asarray(snap["host_digest"])
+        ] if snap and "host_digest" in snap else []
+        events = (
+            int(np.asarray(snap["host_events"]).sum()) if snap else 0
+        )
+        final = {
+            "chain": combine(snap["host_digest"]) if hosts else 0,
+            "events_committed": events,
+            "frontier_ns": (
+                self.records[-1]["frontier_ns"] if self.records else -1
+            ),
+        }
+        return {
+            "kind": DOC_KIND,
+            "schema_version": DIGEST_SCHEMA_VERSION,
+            "meta": dict(self.meta),
+            "final": final,
+            "hosts": hosts,
+            "records": list(self.records),
+        }
+
+    def dump(self, path: str, snap: dict) -> dict:
+        doc = self.to_doc(snap)
+        validate_digest_doc(doc)
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=1)
+            f.write("\n")
+        return doc
+
+
+_REC_KEYS = ("seq", "frontier_ns", "chain", "events_committed")
+
+
+def validate_digest_doc(doc: dict) -> None:
+    """Raise ValueError unless `doc` conforms to the digest-document
+    schema (docs/observability.md)."""
+    if not isinstance(doc, dict):
+        raise ValueError("digest doc must be a JSON object")
+    if doc.get("kind") != DOC_KIND:
+        raise ValueError(
+            f"digest doc kind {doc.get('kind')!r} != {DOC_KIND!r}"
+        )
+    if doc.get("schema_version") != DIGEST_SCHEMA_VERSION:
+        raise ValueError(
+            f"digest schema_version {doc.get('schema_version')!r} != "
+            f"{DIGEST_SCHEMA_VERSION}"
+        )
+    if not isinstance(doc.get("meta"), dict):
+        raise ValueError("digest doc meta missing or not an object")
+    final = doc.get("final")
+    if not isinstance(final, dict) or not {
+        "chain", "events_committed", "frontier_ns"
+    } <= set(final):
+        raise ValueError(
+            "digest doc final must carry chain/events_committed/frontier_ns"
+        )
+    hosts = doc.get("hosts")
+    if not isinstance(hosts, list) or not all(
+        isinstance(h, int) and not isinstance(h, bool) for h in hosts
+    ):
+        raise ValueError("digest doc hosts must be a list of integers")
+    recs = doc.get("records")
+    if not isinstance(recs, list):
+        raise ValueError("digest doc records must be a list")
+    prev = None
+    for i, r in enumerate(recs):
+        if not isinstance(r, dict) or not set(_REC_KEYS) <= set(r):
+            raise ValueError(
+                f"digest record [{i}] must carry keys {list(_REC_KEYS)}"
+            )
+        for k in _REC_KEYS:
+            if not isinstance(r[k], int) or isinstance(r[k], bool):
+                raise ValueError(f"digest record [{i}].{k} must be an integer")
+        if prev is not None and r["frontier_ns"] < prev:
+            raise ValueError(
+                f"digest record [{i}] frontier_ns regresses "
+                f"({r['frontier_ns']} < {prev})"
+            )
+        prev = r["frontier_ns"]
+
+
+def diff_digest_docs(a: dict, b: dict) -> dict:
+    """Compare two digest documents: the FIRST window (handoff record)
+    whose chains disagree, and the hosts whose final sub-chains differ.
+
+    Records are aligned by virtual-time frontier, not by index — two runs
+    of the same scenario may chunk their dispatches differently (different
+    windows_per_dispatch, a resume mid-run), so only frontiers both runs
+    recorded are comparable; at each, the chain must match or the runs
+    committed different histories up to that point."""
+    fa = {r["frontier_ns"]: r for r in a.get("records", [])}
+    fb = {r["frontier_ns"]: r for r in b.get("records", [])}
+    common = sorted(set(fa) & set(fb))
+    first = None
+    last_match_ns = None
+    for t in common:
+        if fa[t]["chain"] != fb[t]["chain"]:
+            first = {
+                "frontier_ns": t,
+                "seq_a": fa[t]["seq"],
+                "seq_b": fb[t]["seq"],
+                "chain_a": fa[t]["chain"],
+                "chain_b": fb[t]["chain"],
+                "events_a": fa[t]["events_committed"],
+                "events_b": fb[t]["events_committed"],
+            }
+            break
+        last_match_ns = t
+    ha, hb = a.get("hosts") or [], b.get("hosts") or []
+    divergent_hosts = [
+        i for i, (x, y) in enumerate(zip(ha, hb)) if x != y
+    ]
+    final_equal = (
+        a.get("final", {}).get("chain") == b.get("final", {}).get("chain")
+    )
+    identical = (
+        final_equal and first is None and not divergent_hosts
+        and len(ha) == len(hb)
+    )
+    out = {
+        "identical": identical,
+        "final_chain_equal": final_equal,
+        "first_divergent_record": first,
+        "divergent_hosts": divergent_hosts,
+        "host_count": (len(ha), len(hb)),
+        "common_windows": len(common),
+        "records": (len(a.get("records", [])), len(b.get("records", []))),
+    }
+    if first is None and not final_equal:
+        # no common frontier disagrees but the ends do: the divergence
+        # happened after the last frontier both runs recorded
+        out["diverged_after_ns"] = last_match_ns
+    return out
+
+
+def diff_digest_vs_checkpoint(doc: dict, ckpt_dir: str) -> dict:
+    """Audit a checkpoint ring against a digest document: the newest
+    readable checkpoint's header chain (written by core/checkpoint.save)
+    must equal the document's chain record at the same frontier —
+    checkpoints and chain records are written at the same handoff
+    boundaries, so a matching frontier exists whenever both came from the
+    same run."""
+    from shadow_tpu.core import checkpoint as ckpt_mod
+
+    entries = ckpt_mod.ring_entries(ckpt_dir)
+    if not entries:
+        raise ValueError(f"{ckpt_dir}: no ring checkpoints to audit")
+    meta = chain = sim_ns = path = None
+    for seq, ns, p in reversed(entries):
+        try:
+            m = ckpt_mod.load_meta(p)
+        except ckpt_mod.CheckpointError:
+            continue
+        audit = m.get("audit")
+        if isinstance(audit, dict) and "chain" in audit:
+            meta, chain, sim_ns, path = m, int(audit["chain"]), ns, p
+            break
+    if meta is None:
+        raise ValueError(
+            f"{ckpt_dir}: no checkpoint carries an audit chain header "
+            f"(written by builds with the digest chain enabled)"
+        )
+    recs = {r["frontier_ns"]: r for r in doc.get("records", [])}
+    at = recs.get(sim_ns)
+    if at is None:
+        # fall back to the newest record at or before the checkpoint time
+        older = [t for t in recs if t <= sim_ns]
+        at = recs[max(older)] if older else None
+    return {
+        "checkpoint": path,
+        "checkpoint_frontier_ns": sim_ns,
+        "checkpoint_chain": chain,
+        "record": at,
+        "match": at is not None and at["chain"] == chain,
+    }

@@ -20,7 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 from flax import struct
 
-BLOCK_VERSION = 3
+BLOCK_VERSION = 4
 
 # --- fixed window-plane slot indices (append-only; never renumber) ---
 WIN_WINDOWS = 0  # window steps executed (one per step() call)
@@ -73,6 +73,11 @@ class ObsBlock:
     # the first commit. Never reset (unlike host.done_t): its min/max
     # spread IS the desynchronization-roughness health metric.
     host_last_t: jnp.ndarray  # [H] i64
+    # Determinism-audit digest chain (obs/audit.py, block v4): rolling-mix
+    # hash of every committed event key (time, src, dst, kind) in per-host
+    # commit order. Rides the pytree, so rollbacks discard speculated
+    # digest state with the rest of the speculated window.
+    host_digest: jnp.ndarray  # [H] i64
 
     @classmethod
     def zeros(cls, num_hosts: int) -> "ObsBlock":
@@ -80,6 +85,7 @@ class ObsBlock:
             win=jnp.zeros((NUM_WIN,), jnp.int64),
             host_events=jnp.zeros((num_hosts,), jnp.int64),
             host_last_t=jnp.full((num_hosts,), -1, jnp.int64),
+            host_digest=jnp.zeros((num_hosts,), jnp.int64),
         )
 
 
@@ -115,11 +121,14 @@ def snapshot(state) -> dict:
     he[gid] = np.asarray(blk.host_events).reshape(-1)
     hl = np.empty_like(np.asarray(blk.host_last_t).reshape(-1))
     hl[gid] = np.asarray(blk.host_last_t).reshape(-1)
+    hd = np.empty_like(np.asarray(blk.host_digest).reshape(-1))
+    hd[gid] = np.asarray(blk.host_digest).reshape(-1)
     return {
         "block_version": BLOCK_VERSION,
         "win": {name: int(win[i]) for i, name in enumerate(WIN_NAMES)},
         "host_events": he,
         "host_last_t": hl,
+        "host_digest": hd,
     }
 
 

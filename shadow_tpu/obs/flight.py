@@ -1,0 +1,213 @@
+"""Virtual-time flight recorder: a device-resident ring of the last R
+committed event records per host.
+
+The digest chain (obs/audit.py) tells you THAT two runs diverged and at
+which window; the flight recorder tells you WHAT the engine was committing
+around that point. Opt-in (`experimental.flight_recorder: {capacity: R}`):
+the ring is a `SimState` field of [H, R] arrays written inside the jitted
+window step by masked one-hot updates — the same select-over-columns write
+the engine's inbox/outbox use (`engine._set_col`); XLA scatters serialize
+on TPU and stay banned, and the masked update IS the per-host
+dynamic-slice write expressed in that idiom. Nothing syncs mid-window: the
+ring is read only at handoff boundaries, where `FlightSpool` flushes the
+records committed since the previous flush to a binary spool file.
+`tools/flight_to_trace.py` converts the spool into a second Perfetto clock
+domain — virtual-time tracks per host — alongside the wall-time spans of
+`--trace-out`.
+
+Because the ring rides the state pytree it also: rolls back with
+speculated state (the spool only ever sees committed records), stacks
+under the fleet's lane axis, shards under islands ([S, H/S, R]), and is
+captured inside every checkpoint — a crashed run's last R events per host
+are in the newest ring entry.
+"""
+
+from __future__ import annotations
+
+import struct as binstruct
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+SPOOL_MAGIC = 0x53544652  # "STFR"
+SPOOL_VERSION = 1
+
+_HDR = binstruct.Struct("<IIII")  # magic, version, num_hosts, capacity
+_FRAME = binstruct.Struct("<qII")  # frontier_ns, n_records, lost
+_REC = binstruct.Struct("<iqiii")  # host, time_ns, src, seq, kind
+
+
+@struct.dataclass
+class FlightRing:
+    """Per-host ring of the last R committed events. `count` is the total
+    committed records per host (never wraps); slot = count % R, so the
+    ring needs no separate cursor and the spool can dedupe flushes by
+    count alone."""
+
+    time: jnp.ndarray  # [H, R] i64
+    src: jnp.ndarray  # [H, R] i32
+    seq: jnp.ndarray  # [H, R] i32
+    kind: jnp.ndarray  # [H, R] i32
+    count: jnp.ndarray  # [H] i64
+
+    @classmethod
+    def zeros(cls, num_hosts: int, capacity: int) -> "FlightRing":
+        if capacity < 1:
+            raise ValueError("flight recorder capacity must be >= 1")
+        return cls(
+            time=jnp.full((num_hosts, capacity), -1, jnp.int64),
+            src=jnp.zeros((num_hosts, capacity), jnp.int32),
+            seq=jnp.zeros((num_hosts, capacity), jnp.int32),
+            kind=jnp.zeros((num_hosts, capacity), jnp.int32),
+            count=jnp.zeros((num_hosts,), jnp.int64),
+        )
+
+    @property
+    def capacity(self) -> int:
+        return self.time.shape[-1]
+
+
+def _put(arr, hit, val):
+    val = jnp.asarray(val, arr.dtype)
+    if val.ndim == arr.ndim - 1:
+        val = val[..., None]
+    return jnp.where(hit, val, arr)
+
+
+def record(ring: FlightRing, mask, time, src, seq, kind) -> FlightRing:
+    """Append one committed event per masked host at its ring cursor —
+    a pure one-hot masked select over [H, R] (no scatter, no sync), fused
+    into the window step. Sequential calls within one micro-step (bulk
+    batches) compose: each call advances the masked hosts' counts."""
+    R = ring.time.shape[-1]
+    slot = (ring.count % R).astype(jnp.int32)
+    cols = jnp.arange(R, dtype=jnp.int32)
+    hit = mask[:, None] & (cols[None, :] == slot[:, None])
+    return ring.replace(
+        time=_put(ring.time, hit, time),
+        src=_put(ring.src, hit, src),
+        seq=_put(ring.seq, hit, seq),
+        kind=_put(ring.kind, hit, kind),
+        count=ring.count + mask.astype(jnp.int64),
+    )
+
+
+class FlightSpool:
+    """Host-side spool writer: at each handoff boundary, drain the ring
+    records committed since the previous flush into a binary frame.
+    Records older than the ring window (more than R commits on one host
+    between flushes) are overwritten on device and counted as `lost` —
+    the flight-recorder contract is "the last R", not "all".
+    """
+
+    def __init__(self, path: str, num_hosts: int, capacity: int):
+        self.path = path
+        self.num_hosts = int(num_hosts)
+        self.capacity = int(capacity)
+        self._last = np.zeros(num_hosts, np.int64)  # flushed count per gid
+        self.frames = 0
+        self.records_written = 0
+        self.records_lost = 0
+        self._f = open(path, "wb")
+        self._f.write(_HDR.pack(
+            SPOOL_MAGIC, SPOOL_VERSION, self.num_hosts, self.capacity
+        ))
+
+    def flush(self, sim, frontier_ns: int) -> int:
+        """One device_get of the ring; emits only records not yet
+        spooled (per-host count delta), in (time, host, seq) order.
+        Returns the number of records written."""
+        fl = getattr(sim.state, "flight", None)
+        if fl is None or self._f is None:
+            return 0
+        blk = jax.device_get(fl)
+        R = self.capacity
+        t = np.asarray(blk.time).reshape(-1, R)
+        s = np.asarray(blk.src).reshape(-1, R)
+        q = np.asarray(blk.seq).reshape(-1, R)
+        k = np.asarray(blk.kind).reshape(-1, R)
+        cnt = np.asarray(blk.count).reshape(-1)
+        gid = np.asarray(
+            jax.device_get(sim.state.host.gid)
+        ).reshape(-1)
+        recs = []
+        lost = 0
+        for row in range(t.shape[0]):
+            g = int(gid[row])
+            n = int(cnt[row])
+            prev = int(self._last[g])
+            start = max(prev, n - R)
+            lost += start - prev
+            for i in range(start, n):
+                sl = i % R
+                recs.append((
+                    g, int(t[row, sl]), int(s[row, sl]),
+                    int(q[row, sl]), int(k[row, sl]),
+                ))
+            self._last[g] = n
+        if not recs and not lost:
+            return 0
+        recs.sort(key=lambda r: (r[1], r[0], r[3]))
+        self._f.write(_FRAME.pack(int(frontier_ns), len(recs), lost))
+        for r in recs:
+            self._f.write(_REC.pack(*r))
+        self._f.flush()
+        self.frames += 1
+        self.records_written += len(recs)
+        self.records_lost += lost
+        return len(recs)
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+    def stats(self) -> dict:
+        return {
+            "frames": self.frames,
+            "records_written": self.records_written,
+            "records_lost": self.records_lost,
+        }
+
+
+def read_spool(path: str) -> dict:
+    """Parse a spool file back into
+    {"num_hosts", "capacity", "frames": [{"frontier_ns", "lost",
+    "records": [(host, time_ns, src, seq, kind), ...]}, ...]}."""
+    with open(path, "rb") as f:
+        raw = f.read()
+    if len(raw) < _HDR.size:
+        raise ValueError(f"{path}: truncated spool header")
+    magic, version, num_hosts, capacity = _HDR.unpack_from(raw, 0)
+    if magic != SPOOL_MAGIC:
+        raise ValueError(f"{path}: not a flight spool (bad magic)")
+    if version != SPOOL_VERSION:
+        raise ValueError(
+            f"{path}: spool version {version} != {SPOOL_VERSION}"
+        )
+    off = _HDR.size
+    frames = []
+    while off < len(raw):
+        if off + _FRAME.size > len(raw):
+            raise ValueError(f"{path}: truncated frame header at {off}")
+        frontier, n, lost = _FRAME.unpack_from(raw, off)
+        off += _FRAME.size
+        need = n * _REC.size
+        if off + need > len(raw):
+            raise ValueError(f"{path}: truncated frame body at {off}")
+        recs = [
+            _REC.unpack_from(raw, off + i * _REC.size) for i in range(n)
+        ]
+        off += need
+        frames.append({
+            "frontier_ns": int(frontier),
+            "lost": int(lost),
+            "records": recs,
+        })
+    return {
+        "num_hosts": int(num_hosts),
+        "capacity": int(capacity),
+        "frames": frames,
+    }

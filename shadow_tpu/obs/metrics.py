@@ -25,9 +25,11 @@ import numpy as np
 
 from shadow_tpu.obs import counters as obs_counters
 
-# v4: optional top-level `fleet` section (fleet.jobs[*] per-job rows) +
-# fleet.* counters; v3: faults.* recovery counters (fault-tolerance plane)
-SCHEMA_VERSION = 4
+# v5: audit.* determinism-audit namespace (digest chain, obs/audit.py) +
+# optional per-job `audit` sub-object on fleet.jobs[*] rows; v4: optional
+# top-level `fleet` section (fleet.jobs[*] per-job rows) + fleet.*
+# counters; v3: faults.* recovery counters (fault-tolerance plane)
+SCHEMA_VERSION = 5
 DOC_KIND = "shadow_tpu.metrics"
 
 # metrics-doc `fleet.jobs[*]` rows must carry at least these keys
@@ -175,6 +177,17 @@ def validate_metrics_doc(doc: dict) -> None:
                     f"fleet.jobs[{i}] must carry keys "
                     f"{sorted(_FLEET_JOB_KEYS)}"
                 )
+            audit = row.get("audit")
+            if audit:
+                # schema v5: a job's determinism-audit sub-object must at
+                # least carry its integer digest chain (obs/audit.py)
+                if not isinstance(audit, dict) or not isinstance(
+                    audit.get("chain"), int
+                ) or isinstance(audit.get("chain"), bool):
+                    raise ValueError(
+                        f"fleet.jobs[{i}].audit must carry an integer "
+                        f"`chain` (the job's digest-chain value)"
+                    )
 
 
 def _sub_counter(reg: MetricsRegistry, sub, prefix: str, fields) -> None:
@@ -204,6 +217,22 @@ def snapshot_device(sim, reg: MetricsRegistry) -> None:
         reg.gauge_set("obs.host_events_mean", float(he.mean()))
         for k, v in obs_counters.vtime_stats(snap["host_last_t"]).items():
             reg.gauge_set(f"vtime.{k}", v)
+        if "host_digest" in snap:
+            # determinism audit (schema v5): the combined digest chain +
+            # block version; per-handoff records ride --digest-out
+            from shadow_tpu.obs import audit as audit_mod
+
+            reg.gauge_set(
+                "audit.chain", audit_mod.combine(snap["host_digest"])
+            )
+            reg.gauge_set("audit.block_version", int(snap["block_version"]))
+    trail = getattr(sim, "audit", None)
+    if trail is not None:
+        reg.counter_set("audit.records", len(trail.records))
+    spool = getattr(sim, "flight_spool", None)
+    if spool is not None:
+        for k, v in spool.stats().items():
+            reg.counter_set(f"audit.flight_{k}", int(v))
     subs = sim.state.subs
     nic = subs.get("nic")
     if nic is not None:

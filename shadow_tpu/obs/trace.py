@@ -6,8 +6,15 @@ for spans, instant ("i") events for markers, and counter ("C") events for
 progress series. Timestamps are microseconds since tracer creation.
 
 The tracer is driver-plane only (wall time, host process); device-plane
-telemetry lives in obs/counters.py. Spans nest by call structure:
+telemetry lives in obs/counters.py, and virtual-time tracks come from the
+flight recorder (obs/flight.py + tools/flight_to_trace.py, which emits a
+second clock domain on its own pid). Spans nest by call structure:
 round -> window -> dispatch / host-exchange / spill.
+
+Thread ids: solo drivers emit everything on tid 0. Fleet runs give every
+lane its own tid (lane index + 1; tid 0 is the driver) and name the
+threads via "M" metadata events, so a sweep's per-job lifecycles render
+as separate rows instead of interleaving into one.
 """
 
 from __future__ import annotations
@@ -17,21 +24,21 @@ import time
 from contextlib import contextmanager
 
 FORMAT = "chrome-trace-events"
-VERSION = 1
+VERSION = 2  # v2: per-tid events + thread_name metadata (fleet lanes)
 
 
 class ChromeTracer:
     """Collects trace events in memory; write() dumps the JSON document.
 
-    Single-threaded by design (the drivers are): every span lands on one
-    tid and nests by strict LIFO, which is exactly what the complete-event
-    renderer expects.
-    """
+    Single-threaded by design (the drivers are): spans nest by strict
+    LIFO per tid, which is exactly what the complete-event renderer
+    expects. `tid` routes events onto named rows (fleet lanes)."""
 
     def __init__(self, process_name: str = "shadow_tpu"):
         self._t0 = time.perf_counter()
         self.events: list[dict] = []
         self._depth = 0
+        self._named_tids: set[tuple[int, int]] = set()
         self.events.append({
             "name": "process_name", "ph": "M", "pid": 0, "tid": 0,
             "args": {"name": process_name},
@@ -40,8 +47,19 @@ class ChromeTracer:
     def _now_us(self) -> float:
         return (time.perf_counter() - self._t0) * 1e6
 
+    def thread_name(self, tid: int, name: str, pid: int = 0) -> None:
+        """Name a thread row once via an "M" metadata event (the fleet
+        names tid 0 "driver" and each lane "lane <j>")."""
+        if (pid, tid) in self._named_tids:
+            return
+        self._named_tids.add((pid, tid))
+        self.events.append({
+            "name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+            "args": {"name": name},
+        })
+
     @contextmanager
-    def span(self, name: str, cat: str = "sim", **args):
+    def span(self, name: str, cat: str = "sim", tid: int = 0, **args):
         """Nestable wall-time span emitted as one complete ("X") event."""
         t0 = self._now_us()
         self._depth += 1
@@ -50,32 +68,46 @@ class ChromeTracer:
         finally:
             self._depth -= 1
             ev = {
-                "name": name, "cat": cat, "ph": "X", "pid": 0, "tid": 0,
+                "name": name, "cat": cat, "ph": "X", "pid": 0, "tid": tid,
                 "ts": t0, "dur": self._now_us() - t0,
             }
             if args:
                 ev["args"] = args
             self.events.append(ev)
 
-    def instant(self, name: str, cat: str = "sim", **args) -> None:
+    def complete(self, name: str, ts_us: float, dur_us: float,
+                 cat: str = "sim", tid: int = 0, **args) -> None:
+        """An explicit complete ("X") event with caller-supplied bounds —
+        the fleet emits one per job residency (admit -> harvest) on the
+        lane's tid."""
         ev = {
-            "name": name, "cat": cat, "ph": "i", "s": "t",
-            "pid": 0, "tid": 0, "ts": self._now_us(),
+            "name": name, "cat": cat, "ph": "X", "pid": 0, "tid": tid,
+            "ts": float(ts_us), "dur": float(dur_us),
         }
         if args:
             ev["args"] = args
         self.events.append(ev)
 
-    def fault(self, name: str, **args) -> None:
+    def instant(self, name: str, cat: str = "sim", tid: int = 0,
+                **args) -> None:
+        ev = {
+            "name": name, "cat": cat, "ph": "i", "s": "t",
+            "pid": 0, "tid": tid, "ts": self._now_us(),
+        }
+        if args:
+            ev["args"] = args
+        self.events.append(ev)
+
+    def fault(self, name: str, tid: int = 0, **args) -> None:
         """Fault-plane marker (injection fired, quarantine, checkpoint
         fallback): an instant event under its own category so Perfetto
         can filter recovery actions from the sim timeline."""
-        self.instant(name, cat="fault", **args)
+        self.instant(name, cat="fault", tid=tid, **args)
 
-    def counter(self, name: str, values: dict) -> None:
+    def counter(self, name: str, values: dict, tid: int = 0) -> None:
         """Counter ("C") sample: Perfetto draws each key as a series."""
         self.events.append({
-            "name": name, "ph": "C", "pid": 0, "tid": 0,
+            "name": name, "ph": "C", "pid": 0, "tid": tid,
             "ts": self._now_us(), "args": dict(values),
         })
 

@@ -206,6 +206,14 @@ def islandize_state(state: SimState, S: int, C_shard: int) -> SimState:
             .at[0].set(obs.win),
             host_events=obs.host_events.reshape((S, Hl)),
             host_last_t=obs.host_last_t.reshape((S, Hl)),
+            host_digest=obs.host_digest.reshape((S, Hl)),
+        )
+    flight = state.flight
+    if flight is not None:
+        # flight ring rows are host-indexed: block-partition like every
+        # other host leaf ([H, R] -> [S, Hl, R], count [H] -> [S, Hl])
+        flight = jax.tree.map(
+            lambda x: _split_host_leaf(x, S, H), flight
         )
     bcast = lambda v: jnp.broadcast_to(jnp.asarray(v), (S,))  # noqa: E731
     return state.replace(
@@ -214,6 +222,7 @@ def islandize_state(state: SimState, S: int, C_shard: int) -> SimState:
         subs=subs,
         counters=counters,
         obs=obs,
+        flight=flight,
         rng_keys=state.rng_keys.reshape((S, Hl) + state.rng_keys.shape[1:]),
         now=bcast(state.now),
         xmit_min=bcast(state.xmit_min),
@@ -379,6 +388,7 @@ class IslandSimulation(Simulation):
                 bulk_self_excluded=self._bulk_self_excluded,
                 payload_words=self._payload_words,
                 island=sp,
+                audit=self._audit_digest,
                 _force_path=force_path,
             )
 
@@ -566,8 +576,14 @@ class IslandSimulation(Simulation):
                 self.state.obs.replace(
                     host_events=perm(self.state.obs.host_events),
                     host_last_t=perm(self.state.obs.host_last_t),
+                    host_digest=perm(self.state.obs.host_digest),
                 )
                 if self.state.obs is not None
+                else None
+            ),
+            flight=(
+                jax.tree.map(perm, self.state.flight)
+                if self.state.flight is not None
                 else None
             ),
             rng_keys=perm(self.state.rng_keys),
@@ -675,6 +691,7 @@ class IslandSimulation(Simulation):
             self.windows_run += int(np.max(np.asarray(w)))
             if obs is not None:
                 obs.round_done(self)
+            self._audit_tick(mn)
             # gearing: a red-zone early exit upshifts (one pool re-sort)
             # before the spill tier would pay host drain round-trips
             shifted = self._gear_tick(occ, press=press)
@@ -732,6 +749,8 @@ class IslandSimulation(Simulation):
             with metrics_mod.span(obs, "dispatch", windows=1):
                 self.state, mn = self._step(self.state, self.params, ws, we)
             self._gear_note_dispatch()
+            if self._audit_active():
+                self._audit_tick(int(np.min(np.asarray(mn))))
             windows += 1
             self.windows_run += 1
         return windows
@@ -946,6 +965,7 @@ class IslandSimulation(Simulation):
             windows += 1
             if obs is not None:
                 obs.round_done(self)
+            self._audit_tick(min_next)
             if self._fault_plane_active():
                 self._handoff_tick(min_next)
                 min_next = int(jax.device_get(jnp.min(self.state.pool.time)))

@@ -257,6 +257,8 @@ def build_simulation(source) -> Simulation:
         bulk_self_excluded=bulk_self_excluded,
         obs_counters=cfg.experimental.obs_counters,
         pool_gears=cfg.experimental.pool_gears,
+        audit_digest=cfg.experimental.audit_digest,
+        flight_capacity=cfg.experimental.flight_recorder,
     )
     # attach build artifacts for inspection/observability
     sim.config = cfg

@@ -1,0 +1,455 @@
+"""Determinism audit plane (ISSUE 5): in-kernel digest chains, the
+virtual-time flight recorder, and the divergence bisector.
+
+The load-bearing property is CHAIN PARITY across the whole engine matrix
+— conservative vs optimistic, global vs islands, fleet lane vs solo,
+checkpoint/resume vs uninterrupted — asserted on the per-host digest rows
+(order-dependent per host) and the combined chain (order-independent
+across hosts). Plus the host-side surfaces: the digest document +
+validator, tools/diff_digest.py pinpointing a forged divergence, the
+flight-recorder ring/spool/trace pipeline, and the sweep CLI path with
+--metrics-out/--trace-out (schema v5, per-lane trace tids).
+"""
+
+import copy
+import importlib.util
+import json
+import pathlib
+
+import jax
+import numpy as np
+import pytest
+
+from shadow_tpu.obs import audit as audit_mod
+from shadow_tpu.obs import flight as flight_mod
+from shadow_tpu.sim import build_simulation
+
+NS_PER_SEC = 1_000_000_000
+
+_UDP_GML = """\
+graph [
+  node [ id 0 bandwidth_down "100 Mbit" bandwidth_up "100 Mbit" ]
+  edge [ source 0 target 0 latency "10 ms" packet_loss 0.0 ]
+]
+"""
+
+_PHOLD_GML = """\
+graph [
+  node [ id 0 bandwidth_down "81920 Kibit" bandwidth_up "81920 Kibit" ]
+  edge [ source 0 target 0 latency "50 ms" packet_loss 0.0 ]
+]
+"""
+
+
+def _udp_cfg(**exp):
+    """Tiny udp_flood scenario (loop-path windows): 1 server + 3 clients."""
+    return {
+        "general": {"stop_time": 3, "seed": 2},
+        "network": {"graph": {"type": "gml", "inline": _UDP_GML}},
+        "experimental": {
+            "event_capacity": 2048,
+            "events_per_host_per_window": 8,
+            **exp,
+        },
+        "hosts": {
+            "server": {"app_model": "udp_flood",
+                       "app_options": {"role": "server"}},
+            "client": {"quantity": 3, "app_model": "udp_flood",
+                       "app_options": {"interval": "100 ms", "size": 600,
+                                       "runtime": 1}},
+        },
+    }
+
+
+def _phold_cfg(seed=7, stop="1.5 s", hosts=8, **exp):
+    """Tiny PHOLD scenario (matrix-path windows)."""
+    return {
+        "general": {"stop_time": stop, "seed": seed},
+        "network": {"graph": {"type": "gml", "inline": _PHOLD_GML}},
+        "experimental": {
+            "event_capacity": 1024,
+            "events_per_host_per_window": 8,
+            "outbox_slots": 8,
+            "inbox_slots": 4,
+            **exp,
+        },
+        "hosts": {
+            "peer": {
+                "quantity": hosts,
+                "app_model": "phold",
+                "app_options": {"msgload": 2, "runtime": 2,
+                                "start_time": "100 ms"},
+            }
+        },
+    }
+
+
+def _digests(sim):
+    snap = sim.obs_snapshot()
+    return snap["host_digest"], audit_mod.combine(snap["host_digest"])
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, pathlib.Path(__file__).parent.parent / "tools" / f"{name}.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# chain parity across the engine matrix
+# ---------------------------------------------------------------------------
+
+
+def test_digest_parity_conservative_vs_optimistic():
+    cons = build_simulation(_udp_cfg())
+    cons.run()
+    opt = build_simulation(_udp_cfg())
+    opt.run_optimistic()
+    dc, cc = _digests(cons)
+    do, co = _digests(opt)
+    assert np.any(dc != 0), "digest chain never folded"
+    assert np.array_equal(dc, do)
+    assert cc == co != 0
+
+
+def test_digest_parity_global_vs_islands():
+    g = build_simulation(_udp_cfg())
+    g.run()
+    i = build_simulation(_udp_cfg(num_shards=2, exchange_slots=16))
+    i.run()
+    dg, cg = _digests(g)
+    di, ci = _digests(i)
+    # per-host sub-chains come back in GLOBAL host order; the combine is
+    # order-independent, so shard layout cannot move the value
+    assert np.array_equal(dg, di)
+    assert cg == ci != 0
+
+
+def test_digest_parity_islands_conservative_vs_optimistic():
+    a = build_simulation(_udp_cfg(num_shards=2, exchange_slots=16))
+    a.run()
+    b = build_simulation(_udp_cfg(num_shards=2, exchange_slots=16))
+    b.run_optimistic()
+    da, ca = _digests(a)
+    db, cb = _digests(b)
+    assert np.array_equal(da, db)
+    assert ca == cb != 0
+
+
+def test_digest_parity_phold_matrix_global_vs_islands():
+    """PHOLD dispatches the matrix fast path (pinned under vmap islands,
+    cond-selected on the global engine): a window folded by either path
+    must chain identically."""
+    g = build_simulation(_phold_cfg())
+    g.run()
+    i = build_simulation(_phold_cfg(num_shards=2, exchange_slots=16))
+    i.run()
+    dg, cg = _digests(g)
+    di, ci = _digests(i)
+    assert np.array_equal(dg, di)
+    assert cg == ci != 0
+
+
+def test_digest_checkpoint_resume_parity(tmp_path):
+    """A run resumed from a mid-run ring checkpoint must end on the exact
+    chain of the uninterrupted run, and the checkpoint header carries the
+    chain at its boundary (the diff tool's --checkpoint input)."""
+    from shadow_tpu.core import checkpoint as ckpt_mod
+
+    full = build_simulation(_udp_cfg())
+    full.run()
+    d_full, c_full = _digests(full)
+
+    d = tmp_path / "ring"
+    part = build_simulation(_udp_cfg())
+    part.configure_auto_checkpoint(str(d), NS_PER_SEC, retain=3)
+    part.run(until=int(1.6 * NS_PER_SEC))
+    entries = ckpt_mod.ring_entries(str(d))
+    assert entries, "no ring checkpoint written"
+    meta = ckpt_mod.load_meta(entries[-1][2])
+    assert isinstance(meta.get("audit", {}).get("chain"), int)
+
+    res = build_simulation(_udp_cfg())
+    info = res.resume_from(str(d))
+    assert info["fallbacks"] == 0
+    # the restored state's chain equals the checkpoint header's
+    assert res.audit_chain() == meta["audit"]["chain"]
+    res.run()
+    d_res, c_res = _digests(res)
+    assert np.array_equal(d_full, d_res)
+    assert c_full == c_res != 0
+
+
+def test_digest_compiles_out_with_audit_disabled():
+    sim = build_simulation(_udp_cfg(audit_digest=False))
+    sim.run(until=NS_PER_SEC)
+    d, c = _digests(sim)
+    assert not np.any(d)
+    with pytest.raises(ValueError, match="obs block"):
+        build_simulation(_udp_cfg(obs_counters=False)).attach_audit()
+
+
+# ---------------------------------------------------------------------------
+# digest document + divergence bisector
+# ---------------------------------------------------------------------------
+
+
+def _run_with_trail(cfg, **run_kw):
+    sim = build_simulation(cfg)
+    sim.attach_audit(meta={"seed": cfg["general"]["seed"]})
+    sim.run(**run_kw)
+    return sim
+
+
+def test_digest_document_and_validator(tmp_path):
+    sim = _run_with_trail(_udp_cfg(), windows_per_dispatch=8)
+    doc = sim.write_digest(str(tmp_path / "a.digest.json"))
+    audit_mod.validate_digest_doc(doc)  # dump() already validated; explicit
+    assert doc["records"], "no chain records at handoff boundaries"
+    assert doc["final"]["chain"] == sim.audit_chain() != 0
+    assert doc["final"]["events_committed"] == \
+        sim.counters()["events_committed"]
+    assert len(doc["hosts"]) == sim.num_hosts
+    # frontiers never regress, and are clamped to the stop time
+    fr = [r["frontier_ns"] for r in doc["records"]]
+    assert fr == sorted(fr) and fr[-1] <= sim.stop_time
+    with pytest.raises(ValueError, match="schema_version"):
+        audit_mod.validate_digest_doc({**doc, "schema_version": 99})
+    bad = copy.deepcopy(doc)
+    del bad["records"][0]["chain"]
+    with pytest.raises(ValueError, match="record"):
+        audit_mod.validate_digest_doc(bad)
+    with pytest.raises(ValueError, match="hosts"):
+        audit_mod.validate_digest_doc({**doc, "hosts": ["x"]})
+
+
+def test_diff_digest_tool_pinpoints_forged_window(tmp_path, capsys):
+    """Two seeded reruns diff identical (rc 0); forging one mid-run
+    record + one host sub-chain is pinpointed to the exact window and
+    host (rc 1) — the full-rerun bisect collapsed to one invocation."""
+    p0, p1 = tmp_path / "a.json", tmp_path / "b.json"
+    _run_with_trail(_udp_cfg(), windows_per_dispatch=8).write_digest(str(p0))
+    _run_with_trail(_udp_cfg(), windows_per_dispatch=8).write_digest(str(p1))
+    tool = _load_tool("diff_digest")
+    assert tool.main([str(p0), str(p1)]) == 0
+
+    doc = json.loads(p1.read_text())
+    k = len(doc["records"]) // 2
+    assert k > 0, "need several handoff records to bisect"
+    doc["records"][k]["chain"] ^= 0x5A5A
+    doc["hosts"][2] ^= 0x5A5A
+    forged = tmp_path / "forged.json"
+    forged.write_text(json.dumps(doc))
+    capsys.readouterr()
+    assert tool.main([str(p0), str(forged), "--json"]) == 1
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["first_divergent_record"]["seq_b"] == k
+    assert rep["first_divergent_record"]["frontier_ns"] == \
+        doc["records"][k]["frontier_ns"]
+    assert rep["divergent_hosts"] == [2]
+
+
+def test_diff_digest_tool_audits_checkpoints(tmp_path):
+    d = tmp_path / "ring"
+    sim = build_simulation(_udp_cfg())
+    sim.attach_audit()
+    sim.configure_auto_checkpoint(str(d), NS_PER_SEC, retain=3)
+    sim.run()
+    digest = tmp_path / "run.digest.json"
+    sim.write_digest(str(digest))
+    tool = _load_tool("diff_digest")
+    assert tool.main([str(digest), "--checkpoint", str(d)]) == 0
+    # a digest from a DIFFERENT history must not match the ring (a seed
+    # change alone is not enough: lossless udp_flood draws no RNG, so its
+    # event stream — and therefore its chain — is seed-invariant)
+    other = tmp_path / "other.digest.json"
+    cfg = _udp_cfg()
+    cfg["hosts"]["client"]["app_options"]["interval"] = "90 ms"
+    _run_with_trail(cfg).write_digest(str(other))
+    assert tool.main([str(other), "--checkpoint", str(d)]) == 1
+
+
+# ---------------------------------------------------------------------------
+# flight recorder: ring, spool, virtual-time trace
+# ---------------------------------------------------------------------------
+
+
+def test_flight_recorder_ring_and_spool(tmp_path):
+    spool_path = tmp_path / "run.flight.spool"
+    sim = build_simulation(_udp_cfg(flight_recorder=16))
+    sim.attach_flight_spool(str(spool_path))
+    sim.run_stepwise()  # per-window handoffs: every record spools
+    fl = jax.device_get(sim.state.flight)
+    snap = sim.obs_snapshot()
+    cnt = np.asarray(fl.count)
+    assert np.array_equal(cnt, snap["host_events"])
+    # the newest ring record per host is the host's frontier event
+    R = sim.state.flight.capacity
+    t = np.asarray(fl.time)
+    for h in range(sim.num_hosts):
+        if cnt[h]:
+            assert t[h, (cnt[h] - 1) % R] == snap["host_last_t"][h]
+    sim.flight_spool.flush(sim, sim.stop_time)
+    sim.flight_spool.close()
+    spool = flight_mod.read_spool(str(spool_path))
+    assert spool["capacity"] == 16
+    assert sum(f["lost"] for f in spool["frames"]) == 0
+    per_host: dict[int, list[int]] = {}
+    for f in spool["frames"]:
+        for host, t_ns, src, seq, kind in f["records"]:
+            per_host.setdefault(host, []).append(t_ns)
+    for h in range(sim.num_hosts):
+        got = per_host.get(h, [])
+        assert len(got) == int(cnt[h]), f"host {h} spooled {len(got)}"
+        assert got == sorted(got), "per-host virtual time regressed"
+
+    # spool -> second Perfetto clock domain (virtual-time tracks per host)
+    tool = _load_tool("flight_to_trace")
+    out = tmp_path / "flight.trace.json"
+    assert tool.main([str(spool_path), "-o", str(out)]) == 0
+    doc = json.loads(out.read_text())
+    evs = doc["traceEvents"]
+    names = [e for e in evs if e.get("ph") == "M"
+             and e["name"] == "thread_name"]
+    assert {e["tid"] for e in names} == set(per_host)
+    marks = [e for e in evs if e.get("ph") == "i"]
+    assert len(marks) == sum(len(v) for v in per_host.values())
+    assert all(e["pid"] == 1 for e in marks)
+    # merge with a wall-time trace: both clock domains in one document
+    wall = tmp_path / "wall.trace.json"
+    wall.write_text(json.dumps({"traceEvents": [
+        {"name": "dispatch", "ph": "X", "pid": 0, "tid": 0,
+         "ts": 0.0, "dur": 5.0},
+    ]}))
+    merged = tmp_path / "merged.trace.json"
+    assert tool.main([str(spool_path), "-o", str(merged),
+                      "--merge", str(wall)]) == 0
+    mdoc = json.loads(merged.read_text())
+    pids = {e["pid"] for e in mdoc["traceEvents"]}
+    assert pids == {0, 1}
+
+
+def test_flight_rollbacks_discard_speculated_records():
+    """Optimistic rollbacks drop speculated ring writes with the rest of
+    the speculated pytree: the committed ring equals the conservative
+    run's bit-for-bit."""
+    a = build_simulation(_udp_cfg(flight_recorder=16))
+    a.run()
+    b = build_simulation(_udp_cfg(flight_recorder=16))
+    b.run_optimistic()
+    fa, fb = jax.device_get(a.state.flight), jax.device_get(b.state.flight)
+    assert np.array_equal(np.asarray(fa.count), np.asarray(fb.count))
+    assert np.array_equal(np.asarray(fa.time), np.asarray(fb.time))
+    assert np.array_equal(np.asarray(fa.src), np.asarray(fb.src))
+
+
+def test_flight_requires_compiled_ring():
+    sim = build_simulation(_udp_cfg())  # no flight_recorder
+    with pytest.raises(ValueError, match="flight_recorder"):
+        sim.attach_flight_spool("/tmp/unused.spool")
+
+
+# ---------------------------------------------------------------------------
+# satellites: trace_summary forms, validate_metrics CLI, sweep CLI path
+# ---------------------------------------------------------------------------
+
+
+def test_trace_summary_bare_array_and_json(tmp_path, capsys):
+    events = [
+        {"name": "dispatch", "ph": "X", "pid": 0, "tid": 0,
+         "ts": 0.0, "dur": 1500.0},
+        {"name": "dispatch", "ph": "X", "pid": 0, "tid": 0,
+         "ts": 2000.0, "dur": 500.0},
+        {"name": "rollback", "ph": "i", "pid": 0, "tid": 0, "ts": 3.0},
+    ]
+    mod = _load_tool("trace_summary")
+    rows, other = mod.summarize(events)  # bare-array form, no wrapper
+    assert rows[0]["name"] == "dispatch" and rows[0]["count"] == 2
+    assert other == {"instant:rollback": 1}
+    p = tmp_path / "bare.trace.json"
+    p.write_text(json.dumps(events))
+    capsys.readouterr()
+    assert mod.main([str(p), "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["spans"][0]["count"] == 2
+    assert doc["spans"][0]["total_ms"] == pytest.approx(2.0)
+    assert doc["markers"] == {"instant:rollback": 1}
+    with pytest.raises(ValueError):
+        mod.summarize({"not": "a trace"})
+
+
+def test_validate_metrics_cli(tmp_path, capsys):
+    from shadow_tpu.obs import metrics as obs_metrics
+
+    reg = obs_metrics.MetricsRegistry()
+    reg.counter_set("engine.events_committed", 3)
+    good = tmp_path / "good.json"
+    reg.dump(str(good))
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({**json.loads(good.read_text()),
+                               "schema_version": 99}))
+    tool = _load_tool("validate_metrics")
+    assert tool.main([str(good)]) == 0
+    assert tool.main([str(bad)]) == 1
+    assert tool.main([str(good), str(bad)]) == 1
+    assert tool.main([str(tmp_path / "missing.json")]) == 1
+    capsys.readouterr()
+
+
+def test_sweep_cli_metrics_trace_and_digest_parity(tmp_path, capsys):
+    """The sweep CLI path (today only the solo CLI was exercised): a
+    3-job sweep through 2 lanes with --metrics-out + --trace-out must
+    produce a schema-v5 document whose per-job audit.digest chains equal
+    the solo runs', and a trace whose lanes ride their own named tids."""
+    from shadow_tpu.fleet.cli import main as sweep_main
+    from shadow_tpu.obs import metrics as obs_metrics
+
+    seeds = [5, 6, 7]
+    base = _phold_cfg(seed=seeds[0], stop="700 ms")
+    sweep_doc = {
+        **base,
+        "sweep": {"name": "aud", "lanes": 2,
+                  "matrix": {"general.seed": seeds}},
+    }
+    import yaml
+
+    cfg = tmp_path / "sweep.yaml"
+    cfg.write_text(yaml.safe_dump(sweep_doc))
+    m_out = tmp_path / "fleet.metrics.json"
+    t_out = tmp_path / "fleet.trace.json"
+    rc = sweep_main([str(cfg), "--metrics-out", str(m_out),
+                     "--trace-out", str(t_out)])
+    capsys.readouterr()
+    assert rc == 0
+
+    doc = json.loads(m_out.read_text())
+    obs_metrics.validate_metrics_doc(doc)
+    assert doc["schema_version"] == 5
+    rows = doc["fleet"]["jobs"]
+    assert len(rows) == 3 and all(r["status"] == "done" for r in rows)
+    for row, seed in zip(rows, seeds):
+        solo = build_simulation(_phold_cfg(seed=seed, stop="700 ms"))
+        solo.run()
+        assert row["audit"]["chain"] == solo.audit_chain() != 0, row["name"]
+
+    trace = json.loads(t_out.read_text())
+    evs = trace["traceEvents"]
+    names = {
+        (e["tid"], e["args"]["name"]) for e in evs
+        if e.get("ph") == "M" and e["name"] == "thread_name"
+    }
+    assert (0, "driver") in names
+    assert (1, "lane 0") in names and (2, "lane 1") in names
+    jobs = [e for e in evs if e.get("ph") == "X" and e.get("cat") == "job"]
+    assert len(jobs) == 3  # one residency span per job, on its lane's tid
+    assert {e["tid"] for e in jobs} <= {1, 2}
+    assert {e["args"]["status"] for e in jobs} == {"done"}
+    admits = [e for e in evs if e.get("ph") == "i" and e["name"] == "admit"]
+    assert len(admits) == 3 and all(e["tid"] in (1, 2) for e in admits)
+    assert any(
+        e.get("ph") == "X" and e["name"] == "dispatch" and e["tid"] == 0
+        for e in evs
+    )

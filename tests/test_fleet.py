@@ -339,7 +339,7 @@ def test_fleet_checkpoint_resume(tmp_path):
         assert g["frontier_ns"] == w["frontier_ns"], name
 
 
-def test_metrics_schema_v4_fleet_section():
+def test_metrics_schema_v5_fleet_section():
     from shadow_tpu.obs import metrics as obs_metrics
 
     jobs = _jobs(n=2)
@@ -349,12 +349,21 @@ def test_metrics_schema_v4_fleet_section():
     obs_metrics.snapshot_fleet(fleet, reg)
     doc = reg.to_doc()
     obs_metrics.validate_metrics_doc(doc)
-    assert doc["schema_version"] == 4
+    assert doc["schema_version"] == 5
     rows = doc["fleet"]["jobs"]
     assert len(rows) == 2
     assert all(r["status"] == "done" for r in rows)
+    # schema v5: every harvested row carries its determinism-audit chain
+    assert all(isinstance(r["audit"].get("chain"), int) for r in rows)
     assert doc["counters"]["fleet.kernel_traces"] == 1
-    # the validator actually gates the fleet rows
+    # the validator actually gates the audit sub-object...
+    import copy as _copy
+
+    bad = _copy.deepcopy(doc)
+    bad["fleet"]["jobs"][0]["audit"] = {"bogus": 1}
+    with pytest.raises(ValueError, match="audit"):
+        obs_metrics.validate_metrics_doc(bad)
+    # ...and still gates the base fleet rows
     rows[0].pop("frontier_ns")
     with pytest.raises(ValueError, match="fleet.jobs"):
         obs_metrics.validate_metrics_doc(doc)

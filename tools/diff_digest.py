@@ -1,0 +1,130 @@
+#!/usr/bin/env python3
+"""Divergence bisector: compare two determinism-audit digest documents
+(--digest-out output), or a digest document against a checkpoint ring.
+
+Two runs that committed bit-identical histories carry identical digest
+chains; this tool turns "the runs disagree" into the FIRST divergent
+window (aligned by virtual-time frontier, so different dispatch chunking
+or a mid-run resume still compare) and the exact hosts whose sub-chains
+differ — one invocation instead of a full-rerun bisect.
+
+Usage:
+  python tools/diff_digest.py a.digest.json b.digest.json
+  python tools/diff_digest.py a.digest.json --checkpoint ckpt-dir/
+  ... [--json]
+
+Exit status: 0 identical / checkpoint matches, 1 divergent, 2 bad input.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def _load(path: str) -> dict:
+    from shadow_tpu.obs.audit import validate_digest_doc
+
+    with open(path) as f:
+        doc = json.load(f)
+    validate_digest_doc(doc)
+    return doc
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("digest_a", help="digest JSON written by --digest-out")
+    ap.add_argument("digest_b", nargs="?",
+                    help="second digest JSON to compare against")
+    ap.add_argument("--checkpoint", metavar="DIR",
+                    help="audit digest_a against the newest readable "
+                         "checkpoint in DIR instead of a second document")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report on stdout")
+    args = ap.parse_args(argv)
+    if bool(args.digest_b) == bool(args.checkpoint):
+        print("error: pass exactly one of a second digest file or "
+              "--checkpoint DIR", file=sys.stderr)
+        return 2
+
+    from shadow_tpu.obs.audit import (
+        diff_digest_docs,
+        diff_digest_vs_checkpoint,
+    )
+
+    try:
+        a = _load(args.digest_a)
+        if args.checkpoint:
+            rep = diff_digest_vs_checkpoint(a, args.checkpoint)
+            if args.json:
+                print(json.dumps(rep, indent=1))
+            elif rep["match"]:
+                print(
+                    f"checkpoint {os.path.basename(rep['checkpoint'])} "
+                    f"matches the digest chain at frontier "
+                    f"{rep['checkpoint_frontier_ns']} ns "
+                    f"(chain {rep['checkpoint_chain']:#018x})"
+                )
+            else:
+                rec = rep["record"]
+                got = f"{rec['chain']:#018x}" if rec else "no record"
+                print(
+                    f"DIVERGENT: checkpoint chain "
+                    f"{rep['checkpoint_chain']:#018x} at frontier "
+                    f"{rep['checkpoint_frontier_ns']} ns vs digest "
+                    f"document {got}"
+                )
+            return 0 if rep["match"] else 1
+        b = _load(args.digest_b)
+    except (OSError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    rep = diff_digest_docs(a, b)
+    if args.json:
+        print(json.dumps(rep, indent=1))
+        return 0 if rep["identical"] else 1
+    if rep["identical"]:
+        print(
+            f"identical: {rep['common_windows']} common window(s), final "
+            f"chain {a['final']['chain']:#018x}, "
+            f"{rep['host_count'][0]} host sub-chains equal"
+        )
+        return 0
+    first = rep["first_divergent_record"]
+    if first is not None:
+        print(
+            f"DIVERGENT at window frontier {first['frontier_ns']} ns "
+            f"(record {first['seq_a']} vs {first['seq_b']}): chain "
+            f"{first['chain_a']:#018x} != {first['chain_b']:#018x} "
+            f"({first['events_a']} vs {first['events_b']} events "
+            f"committed)"
+        )
+    elif "diverged_after_ns" in rep:
+        print(
+            f"DIVERGENT after frontier {rep['diverged_after_ns']} ns "
+            f"(every common window matches; the final chains differ)"
+        )
+    else:
+        print("DIVERGENT: final chains differ")
+    if rep["divergent_hosts"]:
+        hs = rep["divergent_hosts"]
+        shown = ", ".join(str(h) for h in hs[:16])
+        more = f" (+{len(hs) - 16} more)" if len(hs) > 16 else ""
+        print(f"hosts whose sub-chains differ: {shown}{more}")
+    if rep["host_count"][0] != rep["host_count"][1]:
+        print(
+            f"host counts differ: {rep['host_count'][0]} vs "
+            f"{rep['host_count'][1]} (different configs?)"
+        )
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,130 @@
+#!/usr/bin/env python3
+"""Convert a flight-recorder spool (--flight-out) into a Perfetto trace.
+
+The spool holds committed event records in VIRTUAL time; this tool emits
+them as a second clock domain — pid 1 ("virtual time"), one named thread
+per simulated host, timestamps = event time in microseconds of sim time —
+so Perfetto renders per-host virtual-time tracks. With --merge, the
+events are appended to an existing wall-time trace (--trace-out output,
+pid 0), giving both clock domains side by side in one document.
+
+Usage:
+  python tools/flight_to_trace.py run.flight.spool -o flight.trace.json
+  python tools/flight_to_trace.py run.flight.spool --merge run.trace.json \
+      -o combined.trace.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+VIRTUAL_PID = 1
+
+
+def spool_to_events(spool: dict) -> list[dict]:
+    """Flight records -> trace events on the virtual-time clock domain."""
+    events = [{
+        "name": "process_name", "ph": "M", "pid": VIRTUAL_PID, "tid": 0,
+        "args": {"name": "virtual time (flight recorder)"},
+    }]
+    named: set[int] = set()
+    n_lost = 0
+    for frame in spool["frames"]:
+        n_lost += frame["lost"]
+        for host, t_ns, src, seq, kind in frame["records"]:
+            if host not in named:
+                named.add(host)
+                events.append({
+                    "name": "thread_name", "ph": "M", "pid": VIRTUAL_PID,
+                    "tid": host, "args": {"name": f"host {host}"},
+                })
+            events.append({
+                "name": f"k{kind}", "cat": "vtime", "ph": "i", "s": "t",
+                "pid": VIRTUAL_PID, "tid": host, "ts": t_ns / 1e3,
+                "args": {"src": src, "seq": seq, "kind": kind,
+                         "time_ns": t_ns},
+            })
+    if n_lost:
+        # the ring's overwrite budget: surface it so a sparse track is
+        # read as "overwritten", not "idle"
+        events.append({
+            "name": "flight_records_lost", "ph": "i", "s": "g",
+            "pid": VIRTUAL_PID, "tid": 0, "ts": 0.0,
+            "args": {"lost": n_lost},
+        })
+    return events
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("spool", help="flight spool written by --flight-out")
+    ap.add_argument("-o", "--out", required=True,
+                    help="output trace JSON path")
+    ap.add_argument("--merge", metavar="TRACE_JSON",
+                    help="existing wall-time trace (--trace-out output) "
+                         "to merge the virtual-time tracks into")
+    args = ap.parse_args(argv)
+
+    from shadow_tpu.obs.flight import read_spool
+
+    try:
+        spool = read_spool(args.spool)
+    except (OSError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    events = spool_to_events(spool)
+
+    doc = {
+        "displayTimeUnit": "ms",
+        "metadata": {
+            "format": "chrome-trace-events",
+            "clock_domains": ["virtual"],
+            "flight_capacity": spool["capacity"],
+        },
+        "traceEvents": events,
+    }
+    if args.merge:
+        try:
+            with open(args.merge) as f:
+                wall = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"error: --merge {args.merge}: {e}", file=sys.stderr)
+            return 2
+        # accept both the object form and the bare-array form
+        wall_events = (
+            wall if isinstance(wall, list) else wall.get("traceEvents")
+        )
+        if not isinstance(wall_events, list):
+            print(
+                f"error: --merge {args.merge}: not a Chrome trace-event "
+                f"document", file=sys.stderr,
+            )
+            return 2
+        doc["traceEvents"] = list(wall_events) + events
+        if isinstance(wall, dict) and isinstance(wall.get("metadata"), dict):
+            md = dict(wall["metadata"])
+            md["clock_domains"] = ["wall", "virtual"]
+            md["flight_capacity"] = spool["capacity"]
+            doc["metadata"] = md
+    with open(args.out, "w") as f:
+        json.dump(doc, f)
+        f.write("\n")
+    n = sum(len(fr["records"]) for fr in spool["frames"])
+    print(
+        f"{args.out}: {n} virtual-time records across "
+        f"{len(spool['frames'])} frame(s), "
+        f"{len({e['tid'] for e in events if e.get('ph') == 'i'})} host "
+        f"track(s)", file=sys.stderr,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

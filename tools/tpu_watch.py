@@ -43,6 +43,7 @@ SLEEP_S = 240.0
 # managed-plane rows.
 STAGES = [
     ("phold_16k", [PY, "bench.py"], False, 5400),
+    ("audit_smoke", [PY, "bench.py", "--audit-smoke"], False, 7200),
     ("stages_10k", [PY, "bench.py", "--stages"], False, 10800),
     ("stages_50k", [PY, "bench.py", "--stages-50k"], False, 14400),
     ("stages_100k", [PY, "bench.py", "--stages-100k"], False, 10800),
@@ -92,6 +93,27 @@ def done_stages() -> set[str]:
     return done
 
 
+def gate_metrics_artifact(path: str) -> bool:
+    """Schema-gate a metrics artifact at capture time (subprocess so a
+    validator crash never takes the watcher down): True iff the document
+    validates against obs.metrics' schema."""
+    if not os.path.isabs(path):
+        path = os.path.join(REPO, path)
+    if not os.path.exists(path):
+        return False
+    try:
+        proc = subprocess.run(
+            [PY, os.path.join(REPO, "tools", "validate_metrics.py"),
+             "-q", path],
+            timeout=120, capture_output=True, text=True, cwd=REPO,
+        )
+    except subprocess.TimeoutExpired:
+        return False
+    if proc.returncode != 0 and proc.stderr:
+        sys.stderr.write(proc.stderr[-500:] + "\n")
+    return proc.returncode == 0
+
+
 def record(stage: str, rc: int, lines: list[str], wall: float) -> None:
     with open(LIVE, "a") as f:
         wrote = False
@@ -107,6 +129,11 @@ def record(stage: str, rc: int, lines: list[str], wall: float) -> None:
             rec["_rc"] = rc
             rec["_wall_s"] = round(wall, 1)
             rec["_ts"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+            # stage lines that point at a metrics artifact are schema-
+            # gated the moment they are captured (tools/validate_metrics)
+            mp = rec.get("metrics_out")
+            if isinstance(mp, str) and mp:
+                rec["_metrics_schema_ok"] = gate_metrics_artifact(mp)
             f.write(json.dumps(rec) + "\n")
             wrote = True
         if not wrote:

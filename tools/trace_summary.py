@@ -3,9 +3,12 @@
 
 Aggregates complete ("X") span events by name — count, total/mean/max
 wall milliseconds — and prints the top spans, widest first. Instant and
-counter events are tallied but not timed.
+counter events are tallied but not timed. Accepts both trace-event forms
+the spec allows: the object form ({"traceEvents": [...]}) and the bare
+JSON array form ([...]). With --json the summary is machine-readable, so
+CI can diff span stats across runs.
 
-Usage:  python tools/trace_summary.py shadow.trace.json [-n TOP]
+Usage:  python tools/trace_summary.py shadow.trace.json [-n TOP] [--json]
 """
 
 from __future__ import annotations
@@ -15,11 +18,19 @@ import json
 import sys
 
 
-def summarize(doc: dict) -> tuple[list[dict], dict[str, int]]:
-    events = doc.get("traceEvents")
+def summarize(doc) -> tuple[list[dict], dict[str, int]]:
+    # the trace-event spec allows two top-level forms: the object form
+    # with a traceEvents array, and the bare array form (events only)
+    if isinstance(doc, list):
+        events = doc
+    elif isinstance(doc, dict):
+        events = doc.get("traceEvents")
+    else:
+        events = None
     if not isinstance(events, list):
         raise ValueError(
-            "not a Chrome trace-event document (no traceEvents array)"
+            "not a Chrome trace-event document (neither a traceEvents "
+            "object nor a bare event array)"
         )
     spans: dict[str, dict] = {}
     other: dict[str, int] = {}
@@ -56,6 +67,9 @@ def main(argv=None) -> int:
     ap.add_argument("trace", help="trace JSON written by --trace-out")
     ap.add_argument("-n", "--top", type=int, default=20,
                     help="spans to print (default 20)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output (spans + marker tallies) "
+                         "so CI can diff span stats")
     args = ap.parse_args(argv)
     try:
         with open(args.trace) as f:
@@ -64,6 +78,13 @@ def main(argv=None) -> int:
     except (OSError, ValueError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
+    if args.as_json:
+        print(json.dumps({
+            "spans": rows[: args.top],
+            "span_kinds": len(rows),
+            "markers": dict(sorted(other.items())),
+        }, indent=1))
+        return 0
     if not rows:
         print("no span events in trace")
         return 0
