@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import json
 import os
+import random
 import subprocess
 import sys
 import time
@@ -37,15 +38,30 @@ def wait_for_backend(max_wait_s: float = 1500.0, probe_timeout_s: float = 240.0)
     Round 3 ended with BENCH recording rc=1 because the TPU worker was down
     at capture time and the bench burned its one attempt on a dead backend.
     Probe in a SUBPROCESS (a hung backend must not hang the bench), retry
-    with backoff up to max_wait_s, and return True/False rather than
-    raising so callers can decide what a dead backend costs them. Each
-    attempt is recorded in _PROBE_LOG for the failure artifact.
+    with jittered exponential backoff up to max_wait_s, and return
+    True/False rather than raising so callers can decide what a dead
+    backend costs them. Each attempt is recorded in _PROBE_LOG for the
+    failure artifact.
+
+    Budget accounting (BENCH_r05: probe 6 launched with 84 s of budget and
+    overran to −166 s): every probe's subprocess timeout is CLAMPED to the
+    remaining budget, so exhaustion is detected on time, never a full
+    probe_timeout_s late. The sleep between probes is jittered exponential
+    (not a fixed interval), so a fleet of benches never hammers a
+    recovering worker in lockstep.
     """
     deadline = time.monotonic() + max_wait_s
     attempt = 0
+    backoff_s = 10.0
     _PROBE_LOG.clear()
     while True:
         attempt += 1
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            return False
+        # the FINAL probe never overruns the budget (min floor keeps a
+        # probe long enough to boot a healthy backend)
+        timeout_s = min(probe_timeout_s, max(5.0, remaining))
         t0 = time.monotonic()
         try:
             # The probe must verify WHICH platform answered: with the TPU
@@ -59,7 +75,7 @@ def wait_for_backend(max_wait_s: float = 1500.0, probe_timeout_s: float = 240.0)
                  "jnp.ones(8).sum().block_until_ready();"
                  "print('BACKEND_OK', jax.default_backend(),"
                  " len(jax.devices()))"],
-                timeout=probe_timeout_s, capture_output=True, text=True,
+                timeout=timeout_s, capture_output=True, text=True,
             )
             if proc.returncode == 0 and "BACKEND_OK" in proc.stdout:
                 platform = proc.stdout.split("BACKEND_OK", 1)[1].split()[0]
@@ -74,11 +90,12 @@ def wait_for_backend(max_wait_s: float = 1500.0, probe_timeout_s: float = 240.0)
             else:
                 err = (proc.stdout + proc.stderr)[-300:]
         except subprocess.TimeoutExpired:
-            err = f"probe timed out after {probe_timeout_s}s"
+            err = f"probe timed out after {timeout_s:.0f}s"
         remaining = deadline - time.monotonic()
         _PROBE_LOG.append({
             "attempt": attempt, "ok": False,
             "wall_s": round(time.monotonic() - t0, 1),
+            "timeout_s": round(timeout_s, 1),
             "error": str(err)[-300:],
         })
         print(
@@ -88,16 +105,26 @@ def wait_for_backend(max_wait_s: float = 1500.0, probe_timeout_s: float = 240.0)
         )
         if remaining <= 0:
             return False
-        time.sleep(min(60.0, max(10.0, remaining / 10)))
+        # jittered exponential backoff (±50%), clamped to the budget
+        time.sleep(min(remaining, backoff_s * (0.5 + random.random())))
+        backoff_s = min(backoff_s * 2, 120.0)
+
+
+class BackendUnavailable(RuntimeError):
+    """The backend died mid-run and the probe budget is exhausted: the
+    round's result is the structured ok:false artifact, not a traceback
+    (r05 recorded rc:1 on this path; main() now records rc 0 here too)."""
 
 
 def _with_backend_retry(fn, *args, **kw):
     """Run one benchmark stage; if the backend dies mid-run (worker crash,
     tunnel drop), wait for it to come back and retry ONCE."""
+    from shadow_tpu.core.supervisor import BACKEND_LOST, classify_failure
+
     try:
         return fn(*args, **kw)
     except RuntimeError as e:
-        if "UNAVAILABLE" not in str(e) and "backend" not in str(e).lower():
+        if classify_failure(e) != BACKEND_LOST:
             raise
         print(f"# stage hit backend failure: {e!r}; waiting for recovery",
               file=sys.stderr, flush=True)
@@ -112,7 +139,7 @@ def _with_backend_retry(fn, *args, **kw):
         except Exception as reset_err:  # best effort
             print(f"# backend reset failed: {reset_err!r}", file=sys.stderr)
         if not wait_for_backend():
-            raise
+            raise BackendUnavailable(str(e)) from e
         return fn(*args, **kw)
 
 
@@ -822,7 +849,118 @@ def shard_sweep(shards=(1, 2, 4, 8), out_path: str | None = None):
     return results
 
 
+def stage_resilience_smoke(num_hosts: int = 1024, msgload: int = 2,
+                           stop_s: int = 2):
+    """Backend-survivability gate (ISSUE 6 acceptance): a deterministic
+    `kill_backend` injection mid-run must (a) drain to a crash-consistent
+    checkpoint whose resumed run ends on the uninterrupted run's exact
+    audit digest chain, and (b) complete in-process under
+    `--on-backend-loss cpu` with the same chain, with the failover's wall
+    overhead recorded. Writes a schema-v6 metrics artifact carrying the
+    resilience.* namespace so tools/tpu_watch.py schema-gates the line at
+    capture. CPU-deterministic by design (the injection IS the outage)."""
+    import tempfile
+
+    import jax
+
+    from shadow_tpu.core.supervisor import BackendLost, BackendSupervisor
+    from shadow_tpu.faults import plan as plan_mod
+    from shadow_tpu.flagship import build_phold_flagship
+    from shadow_tpu.obs import metrics as obs_metrics
+
+    def build():
+        return build_phold_flagship(
+            num_hosts, msgload=msgload, stop_s=stop_s, runtime_s=stop_s,
+        )
+
+    kill_at = [{"at": "1 s", "op": "kill_backend"}]
+
+    # uninterrupted baseline
+    t0 = time.perf_counter()
+    ref = build()
+    ref.run(windows_per_dispatch=4)
+    jax.block_until_ready(ref.state.pool.time)
+    wall_base = time.perf_counter() - t0
+    base_chain = ref.audit_chain()
+    base_events = ref.counters()["events_committed"]
+
+    with tempfile.TemporaryDirectory(prefix="resilience_smoke_") as td:
+        # (a) kill mid-run under policy abort: drain, then resume
+        sim = build()
+        sim.checkpoint_dir = td
+        sim.attach_supervisor(BackendSupervisor(policy="abort"))
+        sim.attach_faults(plan_mod.parse_fault_plan(kill_at))
+        drained = False
+        try:
+            sim.run(windows_per_dispatch=4)
+        except BackendLost:
+            drained = True
+        resumed = build()
+        resumed.resume_from(td)
+        resumed.run(windows_per_dispatch=4)
+        resume_chain_equal = (
+            drained and resumed.audit_chain() == base_chain
+            and resumed.counters()["events_committed"] == base_events
+        )
+
+    # (b) kill under policy cpu: degraded-mode failover completes the run
+    t0 = time.perf_counter()
+    sim = build()
+    sup = BackendSupervisor(policy="cpu", recheck_every=4)
+    sim.attach_supervisor(sup)
+    sim.attach_faults(plan_mod.parse_fault_plan(
+        [{"at": "1 s", "op": "kill_backend", "recover_after": 1}]
+    ))
+    sim.run(windows_per_dispatch=4)
+    jax.block_until_ready(sim.state.pool.time)
+    wall_failover = time.perf_counter() - t0
+    failover_chain_equal = sim.audit_chain() == base_chain
+    rstats = sim.resilience_stats()
+
+    metrics_path = os.path.join(_REPO, "resilience_smoke.metrics.json")
+    session = obs_metrics.ObsSession()
+    session.finalize(sim)
+    doc = session.metrics.dump(metrics_path, meta={
+        "stage": "resilience_smoke", "hosts": num_hosts,
+    })
+    obs_metrics.validate_metrics_doc(doc)
+    resilience_recorded = (
+        doc["counters"].get("resilience.drains", 0) >= 1
+        and doc["counters"].get("resilience.failovers", 0) >= 1
+    )
+
+    return {
+        "stage": "resilience_smoke",
+        "platform": jax.default_backend(),
+        "hosts": num_hosts,
+        "chain": int(base_chain),
+        "wall_base_s": round(wall_base, 3),
+        "wall_failover_s": round(wall_failover, 3),
+        "failover_overhead_pct": round(
+            (wall_failover - wall_base) / wall_base * 100.0, 2
+        ) if wall_base > 0 else 0.0,
+        "drained": drained,
+        "resume_chain_equal": resume_chain_equal,
+        "failover_chain_equal": failover_chain_equal,
+        "resilience": {k: int(v) for k, v in sorted(rstats.items())},
+        "metrics_out": os.path.relpath(metrics_path, _REPO),
+        "gate_resume": resume_chain_equal,
+        "gate_failover": failover_chain_equal,
+        "gate": bool(
+            resume_chain_equal and failover_chain_equal
+            and resilience_recorded
+        ),
+    }
+
+
 def main():
+    if "--resilience-smoke" in sys.argv:
+        # backend-survivability gate: deterministic kill_backend → drain /
+        # resume / CPU failover with bit-identical audit chains. CPU-
+        # deterministic (the injection is the outage), so no backend wait.
+        os.environ.setdefault("SHADOW_TPU_BENCH_ALLOW_CPU", "1")
+        print(json.dumps(stage_resilience_smoke()), flush=True)
+        return
     if "--fault-smoke" in sys.argv:
         # fault-tolerance gate: quarantine-mode run with one injected
         # process kill completes rc=0 and records faults.* metrics.
@@ -843,16 +981,33 @@ def main():
         # the requested platform — printed LAST so the stored output tail
         # stays machine-parseable (BENCH_r03-r05 recorded rc=1 text tails
         # only), and exit 0: the artifact IS the result of this round.
-        print(json.dumps({
-            "metric": "backend_unavailable", "value": 0, "unit": "none",
-            "vs_baseline": 0,
-            "ok": False,
-            "reason": "backend_unavailable",
-            "platform": os.environ.get("JAX_PLATFORMS", "unknown"),
-            "probe_timeline": _PROBE_LOG,
-        }), flush=True)
+        _emit_backend_unavailable()
         return
 
+    try:
+        _run_stages()
+    except BackendUnavailable as e:
+        # Backend died MID-run and the recovery probe budget ran out: the
+        # exhaustion artifact carries ok:false with rc 0 on this path too
+        # (r05 still recorded rc:1 here).
+        _emit_backend_unavailable(detail=str(e))
+
+
+def _emit_backend_unavailable(detail: str | None = None) -> None:
+    artifact = {
+        "metric": "backend_unavailable", "value": 0, "unit": "none",
+        "vs_baseline": 0,
+        "ok": False,
+        "reason": "backend_unavailable",
+        "platform": os.environ.get("JAX_PLATFORMS", "unknown"),
+        "probe_timeline": _PROBE_LOG,
+    }
+    if detail:
+        artifact["detail"] = detail[-300:]
+    print(json.dumps(artifact), flush=True)
+
+
+def _run_stages():
     if "--stages" in sys.argv:
         # staged measurement configs (BASELINE.md 2-3); one JSON line each
         print(json.dumps(_with_backend_retry(stage_udp_flood)))

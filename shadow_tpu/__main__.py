@@ -95,6 +95,16 @@ def _build_parser() -> argparse.ArgumentParser:
              "config's faults.inject list",
     )
     p.add_argument(
+        "--on-backend-loss", choices=("wait", "cpu", "abort"),
+        help="override faults.on_backend_loss: survive accelerator loss "
+             "mid-run by draining the committed frontier to a crash-"
+             "consistent checkpoint and then either re-probing until the "
+             "backend returns (wait, hot resume), failing over to the "
+             "CPU backend (cpu, upshifting back on recovery), or "
+             "aborting after the drain (abort; finish with --resume); "
+             "device plane only (docs/fault_tolerance.md §Backend loss)",
+    )
+    p.add_argument(
         "--on-proc-failure", choices=("abort", "quarantine"),
         help="override faults.on_proc_failure: what the supervisor does "
              "when a managed process wedges — abort the run, or "
@@ -151,6 +161,8 @@ def _apply_overrides(cfg, args) -> None:
         cfg.faults.plan = args.fault_plan
     if args.on_proc_failure is not None:
         cfg.faults.on_proc_failure = args.on_proc_failure
+    if args.on_backend_loss is not None:
+        cfg.faults.on_backend_loss = args.on_backend_loss
 
 
 def _dump_config(cfg) -> str:
@@ -296,6 +308,25 @@ def _run_device_plane(
     faults = cfg.faults.load_faults()
     if faults:
         sim.attach_faults(faults)
+    if cfg.faults.on_backend_loss is not None:
+        # backend supervision (core/supervisor.py): drain to a checkpoint
+        # on accelerator loss, then recover per policy. The drain target
+        # defaults into the data directory so a loss is survivable even
+        # without --checkpoint-every.
+        from shadow_tpu.core.supervisor import BackendSupervisor
+
+        drain_dir = checkpoint_dir or str(
+            pathlib.Path(data_dir or cfg.general.data_directory)
+            / "checkpoints"
+        )
+        sup = sim.supervisor
+        if sup is None:
+            sup = BackendSupervisor(cfg.faults.on_backend_loss)
+            sim.attach_supervisor(sup)
+        else:  # auto-attached by attach_faults (backend ops in the plan)
+            sup.policy = cfg.faults.on_backend_loss
+        if sup.drain_dir is None:
+            sup.drain_dir = drain_dir
     if resume:
         from shadow_tpu.core.checkpoint import CheckpointError
 
@@ -325,28 +356,36 @@ def _run_device_plane(
             checkpoint_retain,
         )
     t0 = time.monotonic()
-    if progress:
-        import jax
+    from shadow_tpu.core.supervisor import BackendLost
 
-        stop = sim.stop_time
-        hb = max(cfg.general.heartbeat_interval, sim.runahead)
-        next_hb = hb
-        while True:
-            sim.run(until=next_hb)
-            jax.block_until_ready(sim.state.pool.time)
-            now = min(next_hb, stop)
-            c = sim.counters()
-            print(
-                f"heartbeat: sim {now / 1e9:.3f}s / {stop / 1e9:.3f}s, "
-                f"{c['events_committed']} events committed, "
-                f"wall {time.monotonic() - t0:.1f}s",
-                flush=True,
-            )
-            if now >= stop:
-                break
-            next_hb += hb
-    else:
-        sim.run()
+    try:
+        if progress:
+            import jax
+
+            stop = sim.stop_time
+            hb = max(cfg.general.heartbeat_interval, sim.runahead)
+            next_hb = hb
+            while True:
+                sim.run(until=next_hb)
+                jax.block_until_ready(sim.state.pool.time)
+                now = min(next_hb, stop)
+                c = sim.counters()
+                print(
+                    f"heartbeat: sim {now / 1e9:.3f}s / {stop / 1e9:.3f}s, "
+                    f"{c['events_committed']} events committed, "
+                    f"wall {time.monotonic() - t0:.1f}s",
+                    flush=True,
+                )
+                if now >= stop:
+                    break
+                next_hb += hb
+        else:
+            sim.run()
+    except BackendLost as e:
+        # the supervisor already drained to a checkpoint (when a drain
+        # directory was available) — this run is resumable, not lost
+        print(f"error: {e}", file=sys.stderr)
+        return 3
     wall = time.monotonic() - t0
     c = sim.counters()
     print(

@@ -67,10 +67,13 @@ def _digest(arrays: dict) -> str:
     return h.hexdigest()
 
 
-def save(sim, path: str) -> None:
+def save(sim, path: str, extra_meta: dict | None = None) -> None:
     """Write sim.state (and metadata) to `path` as an .npz archive,
     atomically: tmp file + fsync + rename (crash mid-save never leaves a
-    torn archive under `path`)."""
+    torn archive under `path`). `extra_meta` keys merge into the header —
+    the backend supervisor records its drain reason/policy there
+    (`__meta__.drain`, core/supervisor.py) so an operator can tell a
+    scheduled ring entry from an emergency drain."""
     pairs, _ = _leaf_paths(sim.state)
     arrays = {}
     for key, leaf in pairs:
@@ -106,6 +109,8 @@ def save(sim, path: str) -> None:
                 np.asarray(jax.device_get(ob.host_digest))
             ),
         }
+    if extra_meta:
+        meta.update(extra_meta)
     meta["digest"] = _digest(arrays)
     arrays["__meta__"] = np.frombuffer(
         json.dumps(meta).encode(), dtype=np.uint8
@@ -275,12 +280,13 @@ def ring_entries(ckpt_dir: str) -> list[tuple[int, int, str]]:
 
 
 def save_ring(sim, ckpt_dir: str, seq: int, sim_ns: int,
-              retain: int = 3) -> tuple[str, int]:
+              retain: int = 3, extra_meta: dict | None = None,
+              ) -> tuple[str, int]:
     """Write one ring checkpoint ckpt-<seq>-<sim_ns>.npz and prune the
     oldest entries beyond `retain`. Returns (path, pruned_count)."""
     os.makedirs(ckpt_dir, exist_ok=True)
     path = os.path.join(ckpt_dir, f"ckpt-{seq:06d}-{sim_ns}.npz")
-    save(sim, path)
+    save(sim, path, extra_meta=extra_meta)
     pruned = 0
     entries = ring_entries(ckpt_dir)
     for _, _, old in entries[:max(0, len(entries) - max(1, retain))]:

@@ -454,12 +454,20 @@ class FaultOptions:
     # escalation ladder: extra timed waits (doubling backoff) before a
     # non-responsive managed process is declared wedged
     ipc_timeout_retries: int = 1
+    # what the backend supervisor (core/supervisor.py) does when the
+    # ACCELERATOR is lost mid-run: wait (drain to checkpoint, re-probe
+    # until it returns, hot-resume), cpu (drain, re-lower the kernels on
+    # the CPU backend and keep advancing, upshift back on recovery), or
+    # abort (drain, then raise — resume with --resume). None = supervision
+    # only arms when the fault plan carries backend ops (then abort).
+    on_backend_loss: Optional[str] = None
 
     @classmethod
     def from_dict(cls, d: dict) -> "FaultOptions":
         _check_fields(
             "faults", d,
-            {"plan", "inject", "on_proc_failure", "ipc_timeout_retries"},
+            {"plan", "inject", "on_proc_failure", "ipc_timeout_retries",
+             "on_backend_loss"},
         )
         out = cls()
         if d.get("plan") is not None:
@@ -485,6 +493,14 @@ class FaultOptions:
             out.ipc_timeout_retries = int(d["ipc_timeout_retries"])
             if out.ipc_timeout_retries < 0:
                 raise ConfigError("faults.ipc_timeout_retries must be >= 0")
+        if d.get("on_backend_loss") is not None:
+            v = str(d["on_backend_loss"]).lower()
+            if v not in ("wait", "cpu", "abort"):
+                raise ConfigError(
+                    f"faults.on_backend_loss must be wait|cpu|abort, "
+                    f"got {v!r}"
+                )
+            out.on_backend_loss = v
         return out
 
     def load_faults(self) -> list:

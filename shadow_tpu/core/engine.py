@@ -1669,6 +1669,12 @@ class Simulation:
         self.fault_injector = None
         self._dead_hosts: set[int] = set()
         self._force_spill = False
+        # Backend supervision (core/supervisor.py): every driver dispatch
+        # routes through _sv(); with no supervisor attached that is a
+        # direct call — zero overhead, pre-supervisor behavior. The
+        # failover flag re-lowers kernels on the CPU backend (_jit).
+        self.supervisor = None
+        self._cpu_failover = False
         self.checkpoint_dir: str | None = None
         self.checkpoint_every_ns = 0
         self.checkpoint_retain = 3
@@ -1701,10 +1707,29 @@ class Simulation:
         )
         return {
             "step_fn": step,
-            "step": jax.jit(step),
-            "run_to": jax.jit(self._make_run_to(step, spec.hi)),
-            "attempt": jax.jit(self._make_attempt(step)),
+            "step": self._jit(step),
+            "run_to": self._jit(self._make_run_to(step, spec.hi)),
+            "attempt": self._jit(self._make_attempt(step)),
         }
+
+    def _jit(self, fn):
+        """jit honoring degraded-mode failover (core/supervisor.py): with
+        the supervisor in CPU failover, kernels re-lower on the CPU
+        backend so the simulation keeps advancing while the accelerator
+        is gone; the default path is a plain jax.jit."""
+        jf = jax.jit(fn)
+        if not getattr(self, "_cpu_failover", False):
+            return jf
+        try:
+            dev = jax.devices("cpu")[0]
+        except RuntimeError:
+            return jf
+
+        def on_cpu(*args):
+            with jax.default_device(dev):
+                return jf(*args)
+
+        return on_cpu
 
     def _bind_gear(self) -> None:
         spec = self._gear_ladder[self._gear]
@@ -1833,10 +1858,15 @@ class Simulation:
             ws = min_next
             we = min(ws + self.runahead, stop_at)
             with metrics_mod.span(obs, "dispatch", windows=1):
-                self.state, mn = self._step(self.state, self.params, ws, we)
+
+                def _dispatch(ws=ws, we=we):
+                    st, mn = self._step(self.state, self.params, ws, we)
+                    return st, int(mn)
+
+                self.state, mn = self._sv("step", _dispatch)
             self._gear_note_dispatch()
             if self._audit_active():
-                self._audit_tick(int(mn))
+                self._audit_tick(mn)
             windows += 1
         return windows
 
@@ -1918,8 +1948,14 @@ class Simulation:
             with metrics_mod.span(obs, "window", factor=factor):
                 while True:  # attempt [ws, we) in ONE dispatch; shrink on violation
                     with metrics_mod.span(obs, "dispatch"):
-                        st, mn, viol = self._attempt(base, self.params, ws, we)
-                        viol = int(viol)
+
+                        def _dispatch(ws=ws, we=we):
+                            st, mn, viol = self._attempt(
+                                base, self.params, ws, we
+                            )
+                            return st, int(mn), int(viol)
+
+                        st, mn, viol = self._sv("attempt", _dispatch)
                         self._gear_note_dispatch()
                     if we <= ws + cons and viol < int(simtime.NEVER):
                         # A conservative-width window is violation-free BY
@@ -2012,10 +2048,17 @@ class Simulation:
                 # hand off at the next injection/checkpoint mark
                 stop_at = min(stop_at, self._fault_mark())
             with metrics_mod.span(obs, "dispatch", windows=wpd):
-                self.state, mn, press, occ = self._run_to(
-                    self.state, self.params, stop_at, wpd
-                )
-                mn, press, occ = int(mn), bool(press), int(occ)
+
+                def _dispatch(stop_at=stop_at, wpd=wpd):
+                    st, mn, press, occ = self._run_to(
+                        self.state, self.params, stop_at, wpd
+                    )
+                    # blocking fetches INSIDE the supervised call: async-
+                    # dispatch errors must surface here, not at a later
+                    # unsupervised sync
+                    return st, int(mn), bool(press), int(occ)
+
+                self.state, mn, press, occ = self._sv("run_to", _dispatch)
             self._gear_note_dispatch()
             if obs is not None:
                 obs.round_done(self)
@@ -2044,10 +2087,116 @@ class Simulation:
     def attach_faults(self, faults) -> None:
         """Arm a parsed fault plan (list of faults.plan.Fault). Device and
         file ops execute at handoff boundaries; proc ops are not valid on
-        the device plane (the builder/CLI routes those to ProcessDriver)."""
+        the device plane (the builder/CLI routes those to ProcessDriver).
+        Backend ops (kill_backend / stall_backend) drive the supervision
+        state machine — a default supervisor (policy `abort`) is attached
+        when the plan carries them and none is armed yet."""
         from shadow_tpu.faults import FaultInjector
+        from shadow_tpu.faults import plan as plan_mod
 
         self.fault_injector = FaultInjector(faults) if faults else None
+        if faults and self.supervisor is None and any(
+            f.op in plan_mod.BACKEND_OPS for f in faults
+        ):
+            from shadow_tpu.core.supervisor import BackendSupervisor
+
+            self.attach_supervisor(BackendSupervisor())
+
+    # -- backend supervision (core/supervisor.py) --
+
+    def attach_supervisor(self, supervisor) -> None:
+        """Arm backend supervision: every subsequent driver dispatch goes
+        through supervisor.call — deadline watchdog, classified retries,
+        and drain-to-checkpoint + wait/cpu/abort recovery on loss."""
+        supervisor.bind(self)
+        self.supervisor = supervisor
+
+    def _sv(self, label: str, thunk):
+        """Run one dispatch thunk, supervised when a supervisor is
+        attached (a direct call otherwise — the zero-overhead default)."""
+        if self.supervisor is None:
+            return thunk()
+        return self.supervisor.call(label, thunk)
+
+    def _rebind_kernels(self) -> None:
+        """Drop every compiled kernel and rebind the active gear — the
+        hot-resume step after a backend returns (stale executables point
+        at the dead client) and the re-lowering step entering/leaving CPU
+        failover. The optimistic attempt kernel is re-ensured when a
+        lazily-compiling engine (islands) had one bound."""
+        had_attempt = getattr(self, "_attempt", None) is not None
+        self._gear_fns = {}
+        self._bind_gear()
+        ensure = getattr(self, "_ensure_optimistic", None)
+        if had_attempt and self._attempt is None and ensure is not None:
+            ensure()
+
+    def _enter_cpu_failover(self) -> None:
+        """Degraded-mode failover: move state/params to the CPU backend
+        and re-lower the window kernels there. The simulation keeps
+        advancing (slower); results are bit-identical — the kernels are
+        pure integer programs, and the audit chain proves it."""
+        if getattr(self, "mode", None) == "shard_map":
+            raise RuntimeError(
+                "CPU failover is not available under shard_map islands "
+                "(the mesh IS the lost device set); use --on-backend-loss "
+                "wait or abort"
+            )
+        try:
+            dev = jax.devices("cpu")[0]
+        except RuntimeError as e:
+            raise RuntimeError(f"no CPU backend to fail over to: {e}") from e
+        self.state = jax.device_put(jax.device_get(self.state), dev)
+        self.params = jax.device_put(jax.device_get(self.params), dev)
+        self._cpu_failover = True
+        self._rebind_kernels()
+
+    def _exit_cpu_failover(self) -> None:
+        """Upshift back to the recovered primary backend: move state home
+        and rebind the primary kernels."""
+        self._cpu_failover = False
+        self.state = jax.device_put(jax.device_get(self.state))
+        self.params = jax.device_put(jax.device_get(self.params))
+        self._rebind_kernels()
+
+    def _drain_to_checkpoint(self, reason: str,
+                             ckpt_dir: str | None = None) -> str | None:
+        """Flush the committed frontier to a crash-consistent ring
+        checkpoint with drain-reason metadata (the supervisor's first act
+        on backend loss). `self.state` at a dispatch boundary is the last
+        committed pytree — the failed dispatch never assigned — so the
+        drain is exactly the crash-consistent checkpoint path, audit
+        chain included. Returns the path, or None when no checkpoint
+        directory is configured (in-memory recovery still proceeds)."""
+        from shadow_tpu.core import checkpoint as ckpt_mod
+
+        d = ckpt_dir or self.checkpoint_dir
+        if not d:
+            return None
+        mn = int(np.min(np.asarray(jax.device_get(self.state.pool.time))))
+        t = max(0, min(mn, self.stop_time))
+        sup = self.supervisor
+        path, pruned = ckpt_mod.save_ring(
+            self, d, self._ckpt_seq, t, self.checkpoint_retain,
+            extra_meta={"drain": {
+                "reason": reason,
+                "policy": sup.policy if sup is not None else "abort",
+                "frontier_ns": t,
+            }},
+        )
+        self._ckpt_seq += 1
+        self.fault_counters["checkpoints_written"] += 1
+        self.fault_counters["checkpoints_pruned"] += pruned
+        obs = self.obs_session
+        if obs is not None and obs.tracer:
+            obs.tracer.fault("drain_checkpoint", sim_ns=t, reason=reason)
+        return path
+
+    def resilience_stats(self) -> dict:
+        """Supervisor telemetry for the metrics `resilience.*` namespace
+        (schema v6); {} when no supervisor is attached."""
+        sup = self.supervisor
+        return sup.stats() if sup is not None else {}
 
     def configure_auto_checkpoint(
         self, ckpt_dir: str, every_ns: int, retain: int = 3
@@ -2076,6 +2225,18 @@ class Simulation:
 
         info = ckpt_mod.resume_latest(self, ckpt_dir)
         self.fault_counters["resume_fallbacks"] += info["fallbacks"]
+        # Backend injections at or before the restored frontier already
+        # happened — the outage was the very reason this run is resuming.
+        # Marking them fired stops a re-attached plan from re-draining the
+        # resumed run the moment it dispatches.
+        inj = self.fault_injector
+        if inj is not None:
+            from shadow_tpu.faults import plan as plan_mod
+
+            for f in inj.faults:
+                if (not f.fired and f.op in plan_mod.BACKEND_OPS
+                        and f.at_ns <= info["sim_ns"]):
+                    inj.mark_fired(f)
         return info
 
     def _resolve_host_id(self, host) -> int:
@@ -2169,6 +2330,23 @@ class Simulation:
                     obs.tracer.fault(
                         "fault_injection", op=f.op, at_ns=f.at_ns
                     )
+            for f in inj.due(mn, plan_mod.BACKEND_OPS):
+                # backend ops drive the supervisor's state machine; the
+                # NEXT supervised dispatch sees the simulated loss/stall
+                sup = self.supervisor
+                if sup is None:
+                    from shadow_tpu.core.supervisor import BackendSupervisor
+
+                    sup = BackendSupervisor()
+                    self.attach_supervisor(sup)
+                if f.op == "kill_backend":
+                    sup.inject_kill(f.recover_after)
+                else:  # stall_backend
+                    sup.inject_stall(f.count)
+                if obs is not None and obs.tracer:
+                    obs.tracer.fault(
+                        "fault_injection", op=f.op, at_ns=f.at_ns
+                    )
         if self._dead_hosts and not drained_this_tick:
             # recurring drain: exchange-deferred / late-emitted rows for
             # dead hosts are cancelled before the next window runs
@@ -2212,7 +2390,10 @@ class Simulation:
         if inj is not None:
             from shadow_tpu.faults import plan as plan_mod
 
-            ops = plan_mod.DEVICE_OPS | plan_mod.FILE_OPS
+            ops = (
+                plan_mod.DEVICE_OPS | plan_mod.FILE_OPS
+                | plan_mod.BACKEND_OPS
+            )
             for f in inj.faults:
                 if not f.fired and f.op in ops:
                     mark = min(mark, f.at_ns)

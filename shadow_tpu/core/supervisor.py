@@ -1,0 +1,400 @@
+"""Backend supervision: survive accelerator loss instead of aborting.
+
+Three straight bench rounds (BENCH_r03-r05) died to `backend_unavailable`:
+a flaky device at probe time aborted the whole run, and a device lost
+MID-run lost everything since the last manual checkpoint. This module
+converts every driver's hard-abort path into a supervised state machine:
+
+    HEALTHY ──transient error──▶ RETRY (bounded, jittered exp. backoff)
+       │                            │ retries exhausted
+       │ deadline misses ≥ limit    ▼
+       ├──────────▶ SUSPECT ──probe fails──▶ LOST
+       │                └─probe ok─▶ HEALTHY
+       ▼ classified backend loss
+      LOST ──▶ DRAIN (flush state to a crash-consistent checkpoint,
+       │        audit chain + drain-reason metadata riding the header)
+       ▼
+     policy `wait`  re-probe loop (jittered backoff) until the backend
+                    answers, rebind the compiled kernels, re-dispatch —
+                    hot resume, nothing lost;
+     policy `cpu`   degraded-mode failover: move state to the CPU
+                    backend, re-lower the window kernels there, keep the
+                    simulation advancing; opportunistic probes upshift
+                    back to the primary when it recovers;
+     policy `abort` raise BackendLost AFTER the drain checkpoint — the
+                    run dies but `--resume` finishes it bit-exactly.
+
+Every dispatch goes through `BackendSupervisor.call(label, thunk)`. The
+thunk re-reads the driver's bound kernels on each attempt, so a recovery
+that rebinds (`sim._rebind_kernels()`) is picked up transparently; the
+window step is a pure function of (state, params, window), so
+re-executing an interrupted dispatch is always safe.
+
+The deadline watchdog mirrors the bounded-lag stall detection of the
+asynchronous conservative protocol (cs/0409032, PAPERS.md): a dispatch
+that falls behind its deadline is a SIGNAL to act on (count it, probe the
+backend after `stall_limit` consecutive misses), not something to hang
+on. Watchdog jitter only perturbs wall-clock scheduling — simulation
+results stay bit-identical because recovery replays pure functions, and
+the audit digest chain (obs/audit.py) proves it.
+
+Deterministic testing on CPU rides the fault plane (shadow_tpu/faults):
+`kill_backend` / `stall_backend` injections fire at handoff boundaries
+and drive this state machine without any real device dying
+(tests/test_resilience.py chaos matrix, bench.py --resilience-smoke).
+"""
+
+from __future__ import annotations
+
+import random
+import time
+
+# ---------------------------------------------------------------------------
+# failure classification
+# ---------------------------------------------------------------------------
+
+TRANSIENT = "transient"
+BACKEND_LOST = "backend_lost"
+FATAL = "fatal"
+
+# Substrings (lowercased) that mark a dispatch error as a dead/unreachable
+# backend: the PJRT client's UNAVAILABLE family, tunnel/worker drops, and
+# the runtime watchdog's own verdicts. Deliberately conservative — an
+# unrecognized error stays FATAL and propagates (misclassifying a real bug
+# as backend loss would send the supervisor into a pointless drain loop).
+_LOST_MARKERS = (
+    "unavailable",
+    "backend_unavailable",
+    "failed to connect",
+    "connection reset",
+    "connection refused",
+    "socket closed",
+    "broken pipe",
+    "device lost",
+    "device or resource busy",
+    "initialize backend",
+    "core halted",
+    "tpu driver",
+    "worker exited",
+    "heartbeat timeout",
+)
+
+# Errors worth a bounded in-place retry before escalating: queue pressure
+# and interrupted collectives that a healthy backend shakes off.
+_TRANSIENT_MARKERS = (
+    "resource_exhausted",
+    "resource exhausted",
+    "aborted",
+    "cancelled",
+    "temporarily",
+    "try again",
+    "retry",
+)
+
+
+class BackendLost(RuntimeError):
+    """The accelerator backend is gone and the active policy cannot (or
+    chose not to) recover in-process. The drain checkpoint — when a
+    checkpoint directory is configured — was written before this raise."""
+
+
+def classify_failure(exc: BaseException) -> str:
+    """TRANSIENT (bounded retry), BACKEND_LOST (drain + policy), or FATAL
+    (re-raise: a real bug, not an infrastructure failure)."""
+    if isinstance(exc, BackendLost):
+        return BACKEND_LOST
+    msg = f"{type(exc).__name__}: {exc}".lower()
+    for marker in _TRANSIENT_MARKERS:
+        if marker in msg:
+            return TRANSIENT
+    for marker in _LOST_MARKERS:
+        if marker in msg:
+            return BACKEND_LOST
+    return FATAL
+
+
+def _default_probe() -> bool:
+    """One trivial dispatch against the default backend. Real deployments
+    that fear a HANGING (not erroring) backend should pass a subprocess
+    prober (bench.wait_for_backend is one); in-process keeps the library
+    dependency-free."""
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        jnp.zeros((), jnp.int32).block_until_ready()
+        return True
+    except Exception:
+        return False
+
+
+class BackendSupervisor:
+    """Wraps device dispatches in a deadline watchdog with classified
+    failure handling. One per run; bind to the driving Simulation /
+    IslandSimulation / FleetSimulation with ``sim.attach_supervisor``.
+
+    The bound sim must duck-type four recovery hooks:
+      _drain_to_checkpoint(reason, ckpt_dir=None)  flush state + metadata
+      _rebind_kernels()                            fresh compiled kernels
+      _enter_cpu_failover() / _exit_cpu_failover() degraded-mode swap
+
+    ``sleep`` / ``clock`` are injectable for tests (wall scheduling only —
+    never simulation results).
+    """
+
+    POLICIES = ("wait", "cpu", "abort")
+
+    def __init__(
+        self,
+        policy: str = "abort",
+        *,
+        max_retries: int = 3,
+        backoff_base_s: float = 0.05,
+        backoff_cap_s: float = 5.0,
+        dispatch_deadline_s: float = 300.0,
+        stall_limit: int = 3,
+        probe_budget_s: float = 900.0,
+        probe_interval_s: float = 5.0,
+        probe_interval_cap_s: float = 60.0,
+        recheck_every: int = 8,
+        max_drains: int = 16,
+        drain_dir: str | None = None,
+        probe_fn=None,
+        seed: int = 0,
+        sleep=time.sleep,
+        clock=time.monotonic,
+    ):
+        if policy not in self.POLICIES:
+            raise ValueError(
+                f"on_backend_loss policy must be one of {self.POLICIES}, "
+                f"got {policy!r}"
+            )
+        self.policy = policy
+        self.max_retries = int(max_retries)
+        self.backoff_base_s = float(backoff_base_s)
+        self.backoff_cap_s = float(backoff_cap_s)
+        self.dispatch_deadline_s = float(dispatch_deadline_s)
+        self.stall_limit = max(1, int(stall_limit))
+        self.probe_budget_s = float(probe_budget_s)
+        self.probe_interval_s = float(probe_interval_s)
+        self.probe_interval_cap_s = float(probe_interval_cap_s)
+        self.recheck_every = max(1, int(recheck_every))
+        self.max_drains = int(max_drains)
+        self.drain_dir = drain_dir
+        self._probe_fn = probe_fn or _default_probe
+        # jitter decorrelates probe herds across a fleet of runs; wall
+        # scheduling only — simulation results never depend on it
+        self._rng = random.Random(seed)
+        self._sleep = sleep
+        self._clock = clock
+        self._sim = None
+        self._dead = False
+        self.failover = False  # running on the CPU fallback backend
+        self._consec_stalls = 0
+        self._since_recheck = 0
+        self._down_since: float | None = None
+        # injected faults (shadow_tpu/faults kill_backend / stall_backend):
+        # None = no kill injection armed; an int counts FAILED probes until
+        # the simulated backend answers again (-1 = never recovers)
+        self._inject_probes_left: int | None = None
+        self._inject_stalls = 0
+        self.counters = {
+            "dispatches": 0,
+            "retries": 0,
+            "backoffs": 0,
+            "stalls": 0,
+            "probes": 0,
+            "backend_losses": 0,
+            "drains": 0,
+            "failovers": 0,
+            "failbacks": 0,
+            "hot_resumes": 0,
+            "downtime_ns": 0,
+        }
+
+    # -- binding + fault-plane injection hooks --
+
+    def bind(self, sim) -> None:
+        self._sim = sim
+
+    def inject_kill(self, recover_after: int | None = None) -> None:
+        """Simulate backend loss (the `kill_backend` fault op): the next
+        supervised dispatch drains; probes fail `recover_after` times
+        before the backend "answers" again (None = stays down)."""
+        self._dead = True
+        self.counters["backend_losses"] += 1
+        self._inject_probes_left = (
+            -1 if recover_after is None else max(0, int(recover_after))
+        )
+
+    def inject_stall(self, count: int = 1) -> None:
+        """Simulate `count` dispatches missing the deadline (the
+        `stall_backend` fault op) — exercises the stall→probe ladder
+        without any real slowness."""
+        self._inject_stalls += max(1, int(count))
+
+    # -- probing --
+
+    def probe(self) -> bool:
+        self.counters["probes"] += 1
+        if self._inject_probes_left is not None:
+            if self._inject_probes_left == 0:
+                self._inject_probes_left = None  # simulated recovery
+                return True
+            if self._inject_probes_left > 0:
+                self._inject_probes_left -= 1
+            return False
+        return bool(self._probe_fn())
+
+    # -- the supervised dispatch --
+
+    def call(self, label: str, thunk):
+        """Run one device dispatch to completion under supervision.
+
+        `thunk` takes no arguments, performs the dispatch INCLUDING the
+        blocking host fetches (so async-dispatch errors surface here, not
+        at a later unsupervised sync), and must re-read the driver's
+        bound kernel attributes — recovery rebinds them.
+        """
+        retries = 0
+        while True:
+            if self._dead:
+                self._recover(label)  # raises under policy `abort`
+            if self.failover:
+                self._maybe_failback()
+            self.counters["dispatches"] += 1
+            t0 = self._clock()
+            try:
+                out = thunk()
+            except Exception as exc:  # noqa: BLE001 — classified below
+                kind = classify_failure(exc)
+                if kind == TRANSIENT and retries < self.max_retries:
+                    retries += 1
+                    self.counters["retries"] += 1
+                    self._backoff(retries)
+                    continue
+                if kind == FATAL:
+                    raise
+                # backend loss, or transient retries exhausted (a backend
+                # that cannot absorb a bounded retry burst is not healthy)
+                self._dead = True
+                self.counters["backend_losses"] += 1
+                self._note_down()
+                continue
+            elapsed = self._clock() - t0
+            if self._inject_stalls > 0:
+                self._inject_stalls -= 1
+                elapsed = self.dispatch_deadline_s + elapsed
+            if elapsed > self.dispatch_deadline_s:
+                # bounded-lag signal (cs/0409032): a deadline miss is a
+                # signal to act on, not to hang on — the result is valid
+                # (the dispatch DID complete), but consecutive misses
+                # trigger a probe, and a failed probe declares the
+                # backend lost before the next dispatch wedges forever.
+                self.counters["stalls"] += 1
+                self._consec_stalls += 1
+                if self._consec_stalls >= self.stall_limit:
+                    self._consec_stalls = 0
+                    if not self.probe():
+                        self._dead = True
+                        self.counters["backend_losses"] += 1
+                        self._note_down()
+                        continue
+            else:
+                self._consec_stalls = 0
+            return out
+
+    # -- loss handling: drain, then the configured policy --
+
+    def _recover(self, label: str) -> None:
+        sim = self._sim
+        if sim is None:
+            raise BackendLost(
+                f"backend lost at dispatch {label!r} with no bound sim "
+                f"(attach_supervisor first)"
+            )
+        self._note_down()
+        if self.counters["drains"] >= self.max_drains:
+            raise BackendLost(
+                f"backend lost {self.counters['drains']} times; giving up "
+                f"(max_drains={self.max_drains})"
+            )
+        self.counters["drains"] += 1
+        path = sim._drain_to_checkpoint(
+            f"backend_lost:{label}", ckpt_dir=self.drain_dir
+        )
+        if self.policy == "abort":
+            note = f"; drained to {path}" if path else ""
+            raise BackendLost(
+                f"backend lost at dispatch {label!r} "
+                f"(policy abort{note}; resume with --resume)"
+            )
+        if self.policy == "cpu":
+            sim._enter_cpu_failover()
+            self.failover = True
+            self.counters["failovers"] += 1
+            self._since_recheck = 0
+            self._dead = False
+            return
+        # policy `wait`: hot resume — re-probe with jittered backoff
+        # until the backend returns, then rebind the compiled kernels
+        deadline = self._clock() + self.probe_budget_s
+        delay = self.probe_interval_s
+        while not self.probe():
+            if self._clock() >= deadline:
+                raise BackendLost(
+                    f"backend did not return within the "
+                    f"{self.probe_budget_s:.0f}s probe budget at dispatch "
+                    f"{label!r} (drained to {path}; resume with --resume)"
+                )
+            self.counters["backoffs"] += 1
+            self._sleep(self._jitter(delay))
+            delay = min(delay * 2, self.probe_interval_cap_s)
+        sim._rebind_kernels()
+        self._dead = False
+        self.counters["hot_resumes"] += 1
+        self._note_up()
+
+    def _maybe_failback(self) -> None:
+        """In CPU failover, opportunistically probe the primary every
+        `recheck_every` dispatches; upshift back when it answers."""
+        self._since_recheck += 1
+        if self._since_recheck < self.recheck_every:
+            return
+        self._since_recheck = 0
+        if self.probe():
+            self._sim._exit_cpu_failover()
+            self.failover = False
+            self.counters["failbacks"] += 1
+            self._note_up()
+
+    # -- wall bookkeeping --
+
+    def _note_down(self) -> None:
+        if self._down_since is None:
+            self._down_since = self._clock()
+
+    def _note_up(self) -> None:
+        if self._down_since is not None:
+            self.counters["downtime_ns"] += int(
+                (self._clock() - self._down_since) * 1e9
+            )
+            self._down_since = None
+
+    def _backoff(self, attempt: int) -> None:
+        self.counters["backoffs"] += 1
+        delay = min(
+            self.backoff_base_s * (2 ** (attempt - 1)), self.backoff_cap_s
+        )
+        self._sleep(self._jitter(delay))
+
+    def _jitter(self, delay: float) -> float:
+        """±50% decorrelation so a fleet of supervisors never probes a
+        recovering worker in lockstep."""
+        return delay * (0.5 + self._rng.random())
+
+    def stats(self) -> dict:
+        """The `resilience.*` metrics namespace (schema v6)."""
+        d = dict(self.counters)
+        d["failover_active"] = int(self.failover)
+        return d

@@ -6,9 +6,12 @@ ever runs by accident is a fault path that silently rots. This package
 makes failure a first-class, *scheduled* input: a fault plan is a list of
 virtual-time-keyed injections (kill/wedge a managed process, refuse an IPC
 reply, corrupt a checkpoint file, force a pool-overflow spill, kill a
-device host) executed at deterministic points — the driver's event heap on
-the managed plane, handoff boundaries on the device plane — so two runs
-with the same plan are bit-identical.
+device host, kill or stall the ACCELERATOR BACKEND itself) executed at
+deterministic points — the driver's event heap on the managed plane,
+handoff boundaries on the device plane — so two runs with the same plan
+are bit-identical. Backend ops drive the supervision state machine
+(core/supervisor.py): device loss becomes deterministically testable on
+CPU, and recovery is provably exact via the audit digest chain.
 
   plan.py      fault-plan schema: parse/validate JSON documents and the
                `faults:` config section's inline list
@@ -17,6 +20,7 @@ with the same plan are bit-identical.
 """
 
 from shadow_tpu.faults.plan import (  # noqa: F401
+    BACKEND_OPS,
     DEVICE_OPS,
     FILE_OPS,
     PROC_OPS,

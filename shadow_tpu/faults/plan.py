@@ -12,6 +12,8 @@ A plan is a JSON document (``--fault-plan plan.json``) or the inline
         {"at": "1 s", "op": "refuse_ipc",   "proc": "client.0", "count": 1},
         {"at": "3 s", "op": "kill_host",    "host": 3},
         {"at": "1 s", "op": "force_spill"},
+        {"at": "2 s", "op": "kill_backend", "recover_after": 2},
+        {"at": "2 s", "op": "stall_backend", "count": 3},
         {"at": "4 s", "op": "corrupt_file", "path": "ckpt-*.npz",
          "mode": "flip"}
       ]
@@ -33,6 +35,22 @@ seconds). Ops are split by execution plane:
                 kill_host   quarantine the host id/name: its pending pool
                             events drain at every subsequent handoff
                 force_spill force one pool-overflow spill episode
+  BACKEND_OPS executed at the same device handoff boundaries, but
+              targeting the ACCELERATOR rather than a simulated host —
+              they drive the backend supervision state machine
+              (core/supervisor.py) so device loss is deterministically
+              testable on CPU:
+                kill_backend   declare the backend dead; the next
+                               supervised dispatch drains to a
+                               checkpoint and the --on-backend-loss
+                               policy takes over; `recover_after` = N
+                               failed probes before the simulated
+                               backend answers again (absent = stays
+                               down)
+                stall_backend  the next `count` dispatches appear to
+                               miss the supervisor's deadline — the
+                               bounded-lag stall ladder escalates to a
+                               probe
   FILE_OPS    executed by whichever plane runs, at the same points:
                 corrupt_file  truncate/flip/delete files matching a glob
                               (checkpoint or spill artifacts) — proves
@@ -56,8 +74,9 @@ PLAN_SCHEMA_VERSION = 1
 
 PROC_OPS = frozenset({"kill_proc", "wedge_proc", "refuse_ipc"})
 DEVICE_OPS = frozenset({"kill_host", "force_spill"})
+BACKEND_OPS = frozenset({"kill_backend", "stall_backend"})
 FILE_OPS = frozenset({"corrupt_file"})
-ALL_OPS = PROC_OPS | DEVICE_OPS | FILE_OPS
+ALL_OPS = PROC_OPS | DEVICE_OPS | BACKEND_OPS | FILE_OPS
 
 CORRUPT_MODES = ("truncate", "flip", "delete")
 
@@ -68,6 +87,8 @@ _FIELDS = {
     "refuse_ipc": ({"proc"}, {"count"}),
     "kill_host": ({"host"}, set()),
     "force_spill": (set(), set()),
+    "kill_backend": (set(), {"recover_after"}),
+    "stall_backend": (set(), {"count"}),
     "corrupt_file": ({"path"}, {"mode", "dir"}),
 }
 
@@ -87,6 +108,9 @@ class Fault:
     proc: Optional[str] = None
     host: Optional[int | str] = None
     count: int = 1
+    # kill_backend: failed supervisor probes before the simulated backend
+    # answers again; None = the outage never self-heals (abort/resume path)
+    recover_after: Optional[int] = None
     path: Optional[str] = None
     mode: str = "truncate"
     dir: Optional[str] = None
@@ -136,6 +160,12 @@ def _parse_entry(i: int, d: dict) -> Fault:
         f.count = int(d["count"])
         if f.count < 1:
             raise FaultPlanError(f"faults[{i}] ({op}): count must be >= 1")
+    if "recover_after" in d and d["recover_after"] is not None:
+        f.recover_after = int(d["recover_after"])
+        if f.recover_after < 0:
+            raise FaultPlanError(
+                f"faults[{i}] ({op}): recover_after must be >= 0"
+            )
     if "path" in d:
         f.path = str(d["path"])
     if "dir" in d and d["dir"] is not None:

@@ -51,9 +51,12 @@ def _job_file(name: str) -> str:
     return f"job-{name}.npz"
 
 
-def save_fleet(fleet, ckpt_dir: str) -> str:
+def save_fleet(fleet, ckpt_dir: str, extra_meta: dict | None = None) -> str:
     """Write every running lane's slice + the manifest. Returns the
-    manifest path."""
+    manifest path. `extra_meta` keys merge into the manifest — the
+    backend supervisor records its drain reason there
+    (core/supervisor.py) so `sweep --resume` after an outage is
+    distinguishable from a scheduled checkpoint."""
     os.makedirs(ckpt_dir, exist_ok=True)
     jobs = []
     for rec in fleet.sched.records:
@@ -86,6 +89,8 @@ def save_fleet(fleet, ckpt_dir: str) -> str:
         "stats": fleet.fleet_stats(),
         "jobs": jobs,
     }
+    if extra_meta:
+        manifest.update(extra_meta)
     path = os.path.join(ckpt_dir, MANIFEST)
     tmp = f"{path}.tmp.{os.getpid()}"
     try:

@@ -50,7 +50,7 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--metrics-out", metavar="PATH",
-        help="write the fleet metrics document (schema v5: fleet.jobs[*] "
+        help="write the fleet metrics document (schema v6: fleet.jobs[*] "
              "per-job rows incl. audit.chain digests) as JSON",
     )
     p.add_argument(
@@ -74,6 +74,14 @@ def build_parser() -> argparse.ArgumentParser:
              "directory (completed jobs keep their results; running "
              "lanes restore their slices)",
     )
+    p.add_argument(
+        "--on-backend-loss", choices=("wait", "cpu", "abort"),
+        help="survive accelerator loss mid-sweep (core/supervisor.py): "
+             "drain every running lane to the fleet checkpoint, pause "
+             "admission, then re-probe until the backend returns (wait), "
+             "fail over to the CPU backend (cpu), or abort after the "
+             "drain (abort; requeued lanes finish via --resume)",
+    )
     return p
 
 
@@ -82,6 +90,7 @@ def main(argv: list[str] | None = None) -> int:
     from shadow_tpu.core import units
     from shadow_tpu.core.checkpoint import CheckpointError
     from shadow_tpu.core.config import ConfigError, FleetOptions, load_config
+    from shadow_tpu.core.supervisor import BackendLost as BackendLostError
     from shadow_tpu.fleet import (
         FleetError,
         SweepError,
@@ -166,6 +175,13 @@ def main(argv: list[str] | None = None) -> int:
                 if args.trace_out else None
             )
             fleet.attach_obs(session)
+        if args.on_backend_loss:
+            from shadow_tpu.core.supervisor import BackendSupervisor
+
+            sup = BackendSupervisor(
+                args.on_backend_loss, drain_dir=ckpt_dir
+            )
+            fleet.attach_supervisor(sup)
         if sync == "optimistic":
             fleet.run_optimistic()
         else:
@@ -173,6 +189,9 @@ def main(argv: list[str] | None = None) -> int:
     except (FleetError, SweepError, ConfigError, CheckpointError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
+    except BackendLostError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 3
     wall = time.monotonic() - t0
 
     failed = 0

@@ -134,6 +134,15 @@ class FleetSimulation:
         self._ckpt_next_t = self.checkpoint_every_ns or int(NEVER)
         self.kernel_traces = 0
         self.gear_shifts = 0
+        # Backend supervision (core/supervisor.py): dispatches route
+        # through _sv(); a drain pauses admission until recovery. Backend
+        # fault injections (kill_backend/stall_backend) are FLEET-scoped —
+        # the accelerator serves every lane — and fire against the fleet
+        # frontier, unlike the per-job kill_host plans.
+        self.supervisor = None
+        self._cpu_failover = False
+        self._admission_paused = False
+        self._backend_faults: list = []
         # Telemetry session (obs/metrics.ObsSession): attached by the
         # sweep CLI (--metrics-out/--trace-out) via attach_obs. Fleet
         # traces give each lane its own tid (lane index + 1; tid 0 is the
@@ -270,7 +279,25 @@ class FleetSimulation:
             self.kernel_traces += 1
             return fn(*args)
 
-        return jax.jit(counted)
+        return self._jit(counted)
+
+    def _jit(self, fn):
+        """jit honoring supervisor CPU failover: while the accelerator is
+        gone, fleet kernels re-lower on the CPU backend and the sweep
+        keeps advancing (core/supervisor.py)."""
+        jf = jax.jit(fn)
+        if not self._cpu_failover:
+            return jf
+        try:
+            dev = jax.devices("cpu")[0]
+        except RuntimeError:
+            return jf
+
+        def on_cpu(*args):
+            with jax.default_device(dev):
+                return jf(*args)
+
+        return on_cpu
 
     def _build_gear_fns(self, spec: gearbox.GearSpec) -> dict:
         step = self._lane_step(spec)
@@ -336,6 +363,160 @@ class FleetSimulation:
         if self._shifter is not None:
             self._shifter.reset()
         self._bind_gear()
+
+    # ------------------------------------------------------------------
+    # backend supervision (core/supervisor.py): drain pauses admission,
+    # in-flight lanes requeue for the resumed sweep, recovery resumes it
+    # ------------------------------------------------------------------
+
+    def attach_supervisor(self, supervisor) -> None:
+        supervisor.bind(self)
+        self.supervisor = supervisor
+
+    def _sv(self, label: str, thunk):
+        if self.supervisor is None:
+            return thunk()
+        return self.supervisor.call(label, thunk)
+
+    def attach_faults(self, faults) -> None:
+        """Arm FLEET-scoped backend injections (kill_backend /
+        stall_backend only — per-job plans carry kill_host, validated by
+        fleet/sweep.py). They fire at the handoff whose fleet frontier
+        (min over active lanes) reaches `at`, driving the supervision
+        state machine so a whole-sweep device loss is deterministically
+        testable on CPU."""
+        from shadow_tpu.faults import plan as plan_mod
+
+        for f in faults:
+            if f.op not in plan_mod.BACKEND_OPS:
+                raise FleetError(
+                    f"fleet-level fault plans support backend ops only "
+                    f"({sorted(plan_mod.BACKEND_OPS)}); {f.op!r} belongs "
+                    f"in a per-job plan"
+                )
+        self._backend_faults = sorted(faults, key=lambda f: (f.at_ns, f.seq))
+        if self._backend_faults and self.supervisor is None:
+            from shadow_tpu.core.supervisor import BackendSupervisor
+
+            self.attach_supervisor(BackendSupervisor())
+
+    def _backend_fault_mark(self) -> int:
+        """Earliest unfired backend injection: dispatches clamp their
+        stop here so the loss lands at a deterministic frontier."""
+        for f in self._backend_faults:
+            if not f.fired:
+                return f.at_ns
+        return int(NEVER)
+
+    def _backend_fault_tick(self, mn: np.ndarray) -> None:
+        active = [
+            mn[j] for j in range(self.lanes)
+            if self.sched.lane_job[j] is not None
+        ]
+        if not active:
+            return
+        frontier = int(min(active))
+        for f in self._backend_faults:
+            if f.fired or f.at_ns > frontier:
+                continue
+            f.fired = True
+            sup = self.supervisor
+            if f.op == "kill_backend":
+                sup.inject_kill(f.recover_after)
+            else:  # stall_backend
+                sup.inject_stall(f.count)
+            obs = self.obs_session
+            if obs is not None and obs.tracer is not None:
+                obs.tracer.fault("fault_injection", op=f.op, at_ns=f.at_ns)
+
+    def _rebind_kernels(self) -> None:
+        """Fresh compiled kernels for the active gear (hot resume /
+        failover re-lowering); re-ensures the optimistic attempt kernel
+        when one was bound, and reopens admission — the drained sweep
+        resumes."""
+        had_attempt = self._attempt is not None
+        self._gear_fns = {}
+        self._bind_gear()
+        if had_attempt and self._attempt is None:
+            self._ensure_attempt()
+        self._admission_paused = False
+
+    def _enter_cpu_failover(self) -> None:
+        if self._islands and self.template.mode == "shard_map":
+            raise RuntimeError(
+                "CPU failover is not available under shard_map islands; "
+                "use --on-backend-loss wait or abort"
+            )
+        try:
+            dev = jax.devices("cpu")[0]
+        except RuntimeError as e:
+            raise RuntimeError(f"no CPU backend to fail over to: {e}") from e
+        self.state = jax.device_put(jax.device_get(self.state), dev)
+        self.params = jax.device_put(jax.device_get(self.params), dev)
+        self._cpu_failover = True
+        self._rebind_kernels()
+
+    def _exit_cpu_failover(self) -> None:
+        self._cpu_failover = False
+        self.state = jax.device_put(jax.device_get(self.state))
+        self.params = jax.device_put(jax.device_get(self.params))
+        self._rebind_kernels()
+
+    def _drain_to_checkpoint(self, reason: str,
+                             ckpt_dir: str | None = None) -> str | None:
+        """Backend-loss drain: pause admission, flush every running
+        lane's slice + the manifest (fleet/checkpoint.py) with the drain
+        reason, and — under policy `abort` — requeue the in-flight jobs
+        so the scheduler truth matches reality (nothing is running on a
+        dead backend; the saved slices let `sweep --resume` restore their
+        progress instead of re-running them)."""
+        self._admission_paused = True
+        sup = self.supervisor
+        policy = sup.policy if sup is not None else "abort"
+        d = ckpt_dir or self.checkpoint_dir
+        path = None
+        if d:
+            from shadow_tpu.fleet import checkpoint as fleet_ckpt
+
+            path = fleet_ckpt.save_fleet(self, d, extra_meta={"drain": {
+                "reason": reason, "policy": policy,
+            }})
+        obs = self.obs_session
+        if obs is not None and obs.tracer is not None:
+            obs.tracer.fault("drain_checkpoint", reason=reason)
+        if policy == "abort":
+            for j in range(self.lanes):
+                if self.sched.lane_job[j] is not None:
+                    self.sched.requeue(j, reason="backend drain")
+        return path
+
+    def resilience_stats(self) -> dict:
+        """The `resilience.*` metrics namespace (schema v6): supervisor
+        counters plus the scheduler's reclaim/requeue totals."""
+        sup = self.supervisor
+        d = sup.stats() if sup is not None else {}
+        d["lane_reclaims"] = self.sched.lane_reclaims
+        d["jobs_requeued"] = self.sched.jobs_requeued
+        return d
+
+    def _reclaim_expired(self) -> bool:
+        """Free lanes whose job blew its wall-clock deadline NOW — before
+        the next dispatch would ride the dead job along — and hand each
+        freed lane straight to the admission queue (`lane_reclaims`)."""
+        changed = False
+        for j in range(self.lanes):
+            rec = self.sched.lane_job[j]
+            if rec is None or not rec.deadline_exceeded():
+                continue
+            self._kill_lane(j)
+            self._harvest(
+                j, TIMEOUT,
+                f"wall deadline {rec.spec.deadline_s}s exceeded",
+            )
+            self.sched.lane_reclaims += 1
+            self._admit_next(j)
+            changed = True
+        return changed
 
     # ------------------------------------------------------------------
     # telemetry session + per-lane trace rows
@@ -453,6 +634,10 @@ class FleetSimulation:
         state, clear the admission gate (upshifting the fleet gear if the
         job's initial rows demand it), and write the lane slice. The
         compiled kernel is untouched — compile once, reuse the lane."""
+        if self._admission_paused:
+            # backend drain in progress: no new work enters until the
+            # supervisor's recovery reopens admission (_rebind_kernels)
+            return False
         rec = self.sched.peek()
         if rec is None:
             return False
@@ -466,8 +651,12 @@ class FleetSimulation:
             self._shift_gear(want)
         _align_gear(sim, self._gear)
         try:
-            self.state = state_mod.set_lane(self.state, lane, sim.state)
-            self.params = state_mod.set_lane(self.params, lane, sim.params)
+            def _swap():
+                st = state_mod.set_lane(self.state, lane, sim.state)
+                pr = state_mod.set_lane(self.params, lane, sim.params)
+                return st, pr
+
+            self.state, self.params = self._sv("lane_swap", _swap)
         except ValueError as e:
             raise FleetError(f"job {rec.name!r}: {e}") from e
         self._runahead[lane] = sim.runahead
@@ -572,6 +761,9 @@ class FleetSimulation:
                     j, TIMEOUT,
                     f"wall deadline {rec.spec.deadline_s}s exceeded",
                 )
+                # the lane goes straight to the admission queue below —
+                # never parked until another harvest pass
+                self.sched.lane_reclaims += 1
                 changed = True
             elif press[j] and self._gear >= self._ladder[-1].level:
                 # red zone at the top gear with no spill tier: the lane
@@ -625,21 +817,37 @@ class FleetSimulation:
         while not self.sched.all_terminal():
             if max_dispatches is not None and dispatches >= max_dispatches:
                 break
-            eff_stop = np.minimum(self._stop, self._fault_marks())
+            # expired-deadline lanes free up BEFORE the dispatch — a dead
+            # job never rides another dispatch holding its lane
+            self._reclaim_expired()
+            if self.sched.all_terminal():
+                break
+            eff_stop = np.minimum(
+                np.minimum(self._stop, self._fault_marks()),
+                self._backend_fault_mark(),
+            )
             with metrics_mod.span(obs, "dispatch", windows=wpd):
-                out = self._run_to(
-                    self.state, self.params,
-                    jnp.asarray(self._runahead), jnp.asarray(eff_stop), wpd,
-                )
-                self.state = out[0]
-                mn = np.asarray(jax.device_get(out[1])).reshape(
-                    self.lanes, -1).min(axis=1)
-                press = np.asarray(jax.device_get(out[2])).reshape(
-                    self.lanes, -1).any(axis=1)
-                occ = int(np.max(np.asarray(jax.device_get(out[3]))))
+
+                def _dispatch(eff_stop=eff_stop, wpd=wpd):
+                    out = self._run_to(
+                        self.state, self.params,
+                        jnp.asarray(self._runahead), jnp.asarray(eff_stop),
+                        wpd,
+                    )
+                    return (
+                        out[0],
+                        np.asarray(jax.device_get(out[1])).reshape(
+                            self.lanes, -1).min(axis=1),
+                        np.asarray(jax.device_get(out[2])).reshape(
+                            self.lanes, -1).any(axis=1),
+                        int(np.max(np.asarray(jax.device_get(out[3])))),
+                    )
+
+                self.state, mn, press, occ = self._sv("run_to", _dispatch)
             dispatches += 1
             if obs is not None:
                 obs.round_done(self)
+            self._backend_fault_tick(mn)
             changed = self._handoff(mn, press)
             if self._shifter is not None:
                 new = self._shifter.observe(
@@ -680,25 +888,40 @@ class FleetSimulation:
         obs = self.obs_session
         if not self._islands:
             with metrics_mod.span(obs, "dispatch"):
-                st, mn, viol = self._attempt(base, self.params, ws_d, we_d)
-                return (
-                    st,
-                    np.array(jax.device_get(mn), np.int64),
-                    np.array(jax.device_get(viol), np.int64),
-                )
+
+                def _dispatch():
+                    st, mn, viol = self._attempt(
+                        base, self.params, ws_d, we_d
+                    )
+                    return (
+                        st,
+                        np.array(jax.device_get(mn), np.int64),
+                        np.array(jax.device_get(viol), np.int64),
+                    )
+
+                return self._sv("attempt", _dispatch)
         st = base
         mn = ws.copy()
         viol = np.full(self.lanes, int(NEVER), np.int64)
         k = 0
         while True:
             with metrics_mod.span(obs, "dispatch"):
-                st, mn_d, viol_d = self._attempt(
-                    st, self.params, jnp.asarray(np.maximum(mn, ws)), we_d
-                )
-            mn = np.asarray(jax.device_get(mn_d)).reshape(
-                self.lanes, -1).min(axis=1)
-            viol = np.minimum(viol, np.asarray(jax.device_get(viol_d)).reshape(
-                self.lanes, -1).min(axis=1))
+
+                def _substep(st=st, lo=jnp.asarray(np.maximum(mn, ws))):
+                    s2, mn_d, viol_d = self._attempt(
+                        st, self.params, lo, we_d
+                    )
+                    return (
+                        s2,
+                        np.asarray(jax.device_get(mn_d)),
+                        np.asarray(jax.device_get(viol_d)),
+                    )
+
+                st, mn_d, viol_d = self._sv("attempt", _substep)
+            mn = mn_d.reshape(self.lanes, -1).min(axis=1)
+            viol = np.minimum(
+                viol, viol_d.reshape(self.lanes, -1).min(axis=1)
+            )
             k += 1
             need = (mn < we) & (viol >= int(NEVER))
             if not need.any():
@@ -739,6 +962,10 @@ class FleetSimulation:
         while not self.sched.all_terminal():
             if max_rounds is not None and rounds >= max_rounds:
                 break
+            if self._reclaim_expired():
+                mn = self._lane_min_times()
+                if self.sched.all_terminal():
+                    break
             cons = self._runahead
             stop = self._stop
             ws = mn.copy()
@@ -806,6 +1033,7 @@ class FleetSimulation:
             rounds += 1
             if self.obs_session is not None:
                 self.obs_session.round_done(self)
+            self._backend_fault_tick(mn)
             if adaptive:
                 for j in range(L):
                     if not idle[j]:

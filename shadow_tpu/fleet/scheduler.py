@@ -109,6 +109,11 @@ class FleetScheduler:
         self._next = 0  # queue cursor (records are admitted in order)
         self.lane_swaps = 0
         self.admission_upshifts = 0
+        # resilience plane (core/supervisor.py / ISSUE 6): lanes freed at
+        # the wall-clock deadline and handed straight to admission, and
+        # in-flight jobs returned to the queue by a backend drain
+        self.lane_reclaims = 0
+        self.jobs_requeued = 0
 
     # -- queue --
 
@@ -161,6 +166,24 @@ class FleetScheduler:
         self.lane_job[lane] = None
         return record
 
+    def requeue(self, lane: int, reason: str = "") -> JobRecord:
+        """Return a RUNNING job to the queue (backend drain: the lane's
+        progress survives in the drain checkpoint's per-job slice, so the
+        resumed sweep restores it rather than re-running from scratch).
+        The queue cursor rewinds so the job re-admits in declaration
+        order."""
+        record = self.lane_job[lane]
+        if record is None:
+            raise RuntimeError(f"lane {lane} is already free")
+        record.status = QUEUED
+        record.reason = reason
+        record.lane = None
+        record.admitted_wall = None
+        self.lane_job[lane] = None
+        self.jobs_requeued += 1
+        self._next = min(self._next, self.records.index(record))
+        return record
+
     # -- introspection --
 
     def running(self) -> list[JobRecord]:
@@ -183,4 +206,6 @@ class FleetScheduler:
             "lanes": self.lanes,
             "lane_swaps": self.lane_swaps,
             "admission_upshifts": self.admission_upshifts,
+            "lane_reclaims": self.lane_reclaims,
+            "jobs_requeued": self.jobs_requeued,
         }

@@ -25,11 +25,13 @@ import numpy as np
 
 from shadow_tpu.obs import counters as obs_counters
 
-# v5: audit.* determinism-audit namespace (digest chain, obs/audit.py) +
-# optional per-job `audit` sub-object on fleet.jobs[*] rows; v4: optional
-# top-level `fleet` section (fleet.jobs[*] per-job rows) + fleet.*
-# counters; v3: faults.* recovery counters (fault-tolerance plane)
-SCHEMA_VERSION = 5
+# v6: resilience.* backend-supervision namespace (core/supervisor.py:
+# retries, backoffs, stalls, drains, failovers, downtime_ns, fleet lane
+# reclaims); v5: audit.* determinism-audit namespace (digest chain,
+# obs/audit.py) + optional per-job `audit` sub-object on fleet.jobs[*]
+# rows; v4: optional top-level `fleet` section (fleet.jobs[*] per-job
+# rows) + fleet.* counters; v3: faults.* recovery counters
+SCHEMA_VERSION = 6
 DOC_KIND = "shadow_tpu.metrics"
 
 # metrics-doc `fleet.jobs[*]` rows must carry at least these keys
@@ -154,6 +156,9 @@ def validate_metrics_doc(doc: dict) -> None:
     for k, v in doc["counters"].items():
         if not isinstance(v, int) or isinstance(v, bool):
             raise ValueError(f"counter {k!r} must be an integer, got {v!r}")
+        if k.startswith("resilience.") and v < 0:
+            # schema v6: backend-supervision counters are monotonic tallies
+            raise ValueError(f"resilience counter {k!r} must be >= 0, got {v}")
     for k, v in doc["gauges"].items():
         if not isinstance(v, (int, float)) or isinstance(v, bool):
             raise ValueError(f"gauge {k!r} must be a number, got {v!r}")
@@ -269,6 +274,12 @@ def snapshot_device(sim, reg: MetricsRegistry) -> None:
     if fault_stats is not None:
         for k, v in fault_stats().items():
             reg.counter_set(f"faults.{k}", int(v))
+    # backend supervision (schema v6): retries/backoffs/stalls/drains/
+    # failovers/downtime from the attached supervisor (core/supervisor.py)
+    res_stats = getattr(sim, "resilience_stats", None)
+    if res_stats is not None:
+        for k, v in res_stats().items():
+            reg.counter_set(f"resilience.{k}", int(v))
 
 
 def snapshot_fleet(fleet, reg: MetricsRegistry) -> None:
@@ -285,6 +296,12 @@ def snapshot_fleet(fleet, reg: MetricsRegistry) -> None:
         reg.counter_set(f"fleet.{k}", int(stats.get(k, 0)))
     reg.gauge_set("fleet.lanes", int(stats.get("lanes", 0)))
     reg.gauge_set("fleet.gear_level", int(stats.get("gear_level", 0)))
+    # backend supervision (schema v6): supervisor counters + the
+    # scheduler's deadline lane reclaims / drain requeues
+    res_stats = getattr(fleet, "resilience_stats", None)
+    if res_stats is not None:
+        for k, v in res_stats().items():
+            reg.counter_set(f"resilience.{k}", int(v))
     reg.section_set("fleet", {
         "lanes": int(stats.get("lanes", 0)),
         "lane_swaps": int(stats.get("lane_swaps", 0)),

@@ -395,7 +395,10 @@ class IslandSimulation(Simulation):
         self._step_builder = build_step
 
         if mode == "vmap":
-            self._wrap = lambda fn, n=1: jax.jit(jax.vmap(
+            # self._jit honors supervisor CPU failover (core/supervisor):
+            # kernels re-lower on the CPU backend while the accelerator
+            # is gone
+            self._wrap = lambda fn, n=1: self._jit(jax.vmap(
                 fn, in_axes=(0, None, None, None), axis_name=AXIS
             ))
         else:  # shard_map: _wrap is defined below with the mesh in scope
@@ -449,7 +452,7 @@ class IslandSimulation(Simulation):
                     # disabled for these wrappers
                     **no_check,
                 )
-                return jax.jit(wrapped)
+                return self._jit(wrapped)
 
             self._wrap = sm
         # drop the GLOBAL-layout kernels super().__init__ bound and rebind
@@ -681,12 +684,22 @@ class IslandSimulation(Simulation):
                 # hand off at the next injection/checkpoint mark
                 stop_at = min(stop_at, self._fault_mark())
             with metrics_mod.span(obs, "dispatch", windows=wpd):
-                self.state, mn, press, occ, w = self._run_to(
-                    self.state, self.params, stop_at, wpd
+
+                def _dispatch(stop_at=stop_at, wpd=wpd):
+                    st, mn, press, occ, w = self._run_to(
+                        self.state, self.params, stop_at, wpd
+                    )
+                    return (
+                        st,
+                        int(np.min(np.asarray(jax.device_get(mn)))),
+                        bool(np.max(np.asarray(jax.device_get(press)))),
+                        int(np.max(np.asarray(jax.device_get(occ)))),
+                        w,
+                    )
+
+                self.state, mn, press, occ, w = self._sv(
+                    "run_to", _dispatch
                 )
-                mn = int(np.min(np.asarray(mn)))
-                press = bool(np.max(np.asarray(press)))
-                occ = int(np.max(np.asarray(occ)))
             self._gear_note_dispatch()
             self.windows_run += int(np.max(np.asarray(w)))
             if obs is not None:
@@ -747,10 +760,15 @@ class IslandSimulation(Simulation):
             ))
             we = min(ws + self.runahead, stop_at, clamp)
             with metrics_mod.span(obs, "dispatch", windows=1):
-                self.state, mn = self._step(self.state, self.params, ws, we)
+
+                def _dispatch(ws=ws, we=we):
+                    st, mn = self._step(self.state, self.params, ws, we)
+                    return st, int(np.min(np.asarray(jax.device_get(mn))))
+
+                self.state, mn = self._sv("step", _dispatch)
             self._gear_note_dispatch()
             if self._audit_active():
-                self._audit_tick(int(np.min(np.asarray(mn))))
+                self._audit_tick(mn)
             windows += 1
             self.windows_run += 1
         return windows
@@ -884,10 +902,16 @@ class IslandSimulation(Simulation):
                 # in-transit deferred row parked AT the frontier: null
                 # conservative window to retry the exchange
                 with metrics_mod.span(obs, "dispatch", null_window=1):
-                    self.state, mn = self._step(
-                        self.state, self.params, ws, ws
-                    )
-                    min_next = int(np.min(np.asarray(mn)))
+
+                    def _null(ws=ws):
+                        st, mn = self._step(
+                            self.state, self.params, ws, ws
+                        )
+                        return st, int(
+                            np.min(np.asarray(jax.device_get(mn)))
+                        )
+
+                    self.state, min_next = self._sv("step", _null)
                 self.state = obs_mod.bump_win(
                     self.state, obs_mod.WIN_OPT_STALLS
                 )
@@ -920,11 +944,22 @@ class IslandSimulation(Simulation):
                         # reached frontier, retry from the snapshot
                         break
                     with metrics_mod.span(obs, "dispatch"):
-                        st, mn, vl = self._attempt(
-                            st, self.params, max(mn_i, ws), we
-                        )
-                        mn_i = int(np.min(np.asarray(mn)))
-                        viol = int(np.min(np.asarray(vl)))
+
+                        def _dispatch(st=st, lo=max(mn_i, ws), we=we):
+                            s2, mn, vl = self._attempt(
+                                st, self.params, lo, we
+                            )
+                            return (
+                                s2,
+                                int(np.min(np.asarray(
+                                    jax.device_get(mn)
+                                ))),
+                                int(np.min(np.asarray(
+                                    jax.device_get(vl)
+                                ))),
+                            )
+
+                        st, mn_i, viol = self._sv("attempt", _dispatch)
                         self._gear_note_dispatch()
                     k += 1
                 if viol >= never and mn_i < we and k >= _MAX_SUBSTEPS:

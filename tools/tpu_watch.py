@@ -44,6 +44,8 @@ SLEEP_S = 240.0
 STAGES = [
     ("phold_16k", [PY, "bench.py"], False, 5400),
     ("audit_smoke", [PY, "bench.py", "--audit-smoke"], False, 7200),
+    ("resilience_smoke", [PY, "bench.py", "--resilience-smoke"],
+     False, 7200),
     ("stages_10k", [PY, "bench.py", "--stages"], False, 10800),
     ("stages_50k", [PY, "bench.py", "--stages-50k"], False, 14400),
     ("stages_100k", [PY, "bench.py", "--stages-100k"], False, 10800),
