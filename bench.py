@@ -953,7 +953,55 @@ def stage_resilience_smoke(num_hosts: int = 1024, msgload: int = 2,
     }
 
 
+def stage_lint_smoke():
+    """shadowlint gate (ISSUE 7 acceptance): the STL0xx AST rule set over
+    the default scope must report ZERO non-baselined violations, and a
+    tiny geared driver run must show no kernel retraces (one lowering per
+    bound kernel — the compile-cache-miss perf-bug class from r03–r05).
+    Pure CPU (AST walk + one tiny compile), so no backend wait."""
+    from shadow_tpu.analysis import hlo_audit, linter
+    from shadow_tpu.flagship import build_phold_flagship
+
+    paths = [os.path.join(_REPO, p) for p in ("shadow_tpu", "tools", "bench.py")]
+    findings = linter.lint_paths(paths, _REPO)
+    baseline = linter.load_baseline(os.path.join(_REPO, linter.BASELINE_NAME))
+    new, old = linter.split_baselined(findings, baseline)
+    scanned = list(linter.iter_python_files(paths))
+    doc = linter.findings_doc(new, old, scanned)
+    report_path = os.path.join(_REPO, "lint_smoke.report.json")
+    with open(report_path, "w") as f:
+        json.dump(doc, f, indent=1)
+        f.write("\n")
+
+    # retrace smoke: a geared conservative run + an optimistic run, every
+    # bound kernel lowered at most once (hlo_audit retrace detector)
+    sim = build_phold_flagship(
+        64, msgload=2, stop_s=2, runtime_s=2, seed=3, event_capacity=4096,
+        pool_gears=2)
+    sim.run()
+    retrace = hlo_audit.retrace_report(sim)
+    return {
+        "stage": "lint_smoke",
+        "files_scanned": len(scanned),
+        "findings_new": len(new),
+        "findings_grandfathered": len(old),
+        "by_code": doc["counts"]["by_code"],
+        "retrace_ok": bool(retrace["ok"]),
+        "kernel_compiles": int(retrace["compiles_total"]),
+        "report_out": os.path.relpath(report_path, _REPO),
+        "gate_lint": not new,
+        "gate_retrace": bool(retrace["ok"]),
+        "gate": bool(not new and retrace["ok"]),
+    }
+
+
 def main():
+    if "--lint-smoke" in sys.argv:
+        # static-analysis gate: shadowlint clean + no kernel retraces.
+        # AST + one tiny CPU compile — no accelerator, so no backend wait.
+        os.environ.setdefault("SHADOW_TPU_BENCH_ALLOW_CPU", "1")
+        print(json.dumps(stage_lint_smoke()), flush=True)
+        return
     if "--resilience-smoke" in sys.argv:
         # backend-survivability gate: deterministic kill_backend → drain /
         # resume / CPU failover with bit-identical audit chains. CPU-

@@ -1,0 +1,31 @@
+"""shadowlint: the device-purity & determinism static-analysis plane.
+
+Two layers guard the invariants every PR silently depends on:
+
+  * an AST rule engine (`rules.py` + `linter.py`, rule codes ``STL0xx``)
+    that classifies modules as **kernel** (compiled into device window
+    programs) vs **host** and bans the constructs that break Shadow's
+    determinism promise — wall clocks and ambient RNG in kernel code,
+    unseeded RNG construction outside ``core/rng.py``'s fold-in lineage,
+    traced-value coercion/branching inside jitted bodies, unaudited
+    callbacks, unsorted dict iteration feeding pytrees, and metric keys
+    outside the ``tools/validate_metrics.py`` namespace schema;
+  * a compiled-kernel auditor (`hlo_audit.py`) that lowers every
+    registered window-kernel variant ({conservative, optimistic} ×
+    {global, islands, fleet} × gear tiers) to optimized HLO and asserts
+    the op bans (no scatter, no serializing gather, bounded sort rows),
+    plus a retrace detector that makes "one sweep = one compile" a
+    statically gated property.
+
+Entry points: ``tools/shadowlint.py`` (CLI), ``bench.py --lint-smoke``
+(gate), ``tests/test_analysis.py`` (tier-1).  See
+docs/static_analysis.md for the rule catalog and workflows.
+"""
+
+from shadow_tpu.analysis.linter import (  # noqa: F401
+    Finding,
+    classify_module,
+    lint_file,
+    lint_paths,
+    lint_source,
+)

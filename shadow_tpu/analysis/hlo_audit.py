@@ -1,0 +1,366 @@
+"""Layer 2 of shadowlint: the compiled-kernel auditor.
+
+The AST rules (rules.py) gate what the *source* says; this module gates
+what XLA actually *compiles*.  It generalizes the gearbox HLO guard that
+used to live in tests/test_gearbox.py to every registered window-kernel
+variant — {conservative, optimistic} × {global, islands, fleet} × gear
+tiers — lowering each to OPTIMIZED HLO (raw StableHLO still carries
+jax's constant-column ``.at[].set`` scatters, which XLA canonicalizes to
+dynamic-update-slices; only what survives optimization can serialize)
+and asserting the engine's op contract:
+
+  * **no scatter** — engine.py's stated ban (a scatter serializes on
+    TPU and breaks the all-SoA update discipline);
+  * **no serializing gather** — take_along_axis-shaped per-element
+    fetches out of >=2-D operands; whole-row gathers and 1-D host-table
+    lookups stay vectorized and are allowed;
+  * **bounded sort rows** — every sort's row count stays within the
+    structural bound of the variant's gear (pool capacity + the dense
+    window/outbox blocks); a sort beyond it means a shape regression
+    re-grew the very volume the gearbox exists to shrink.
+
+Plus the **retrace detector**: after a driver run, every bound jitted
+kernel must have been lowered at most once (per gear) — the fleet's
+"one sweep = one compile" invariant (PR 4's ``kernel_traces``), now a
+statically gated property for ALL engines.  A second trace of the same
+kernel means dtype/weak-type/shape drift in driver arguments — the
+silent compile-cache-miss perf-bug class from the r03–r05 bench rounds.
+
+Used by tests/test_analysis.py (tier-1 + slow matrix cells) and
+re-exported to tests/test_gearbox.py so there is exactly one copy of
+the HLO-parsing logic.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Callable
+
+# A window end comfortably past the first windows of any tiny model; the
+# value only shapes traced scalars, never the compiled program.
+DEFAULT_WIN_END = 50_000_000
+
+SYNC_MODES = ("conservative", "optimistic")
+LAYOUTS = ("global", "islands", "fleet")
+
+
+class HloAuditError(AssertionError):
+    """A compiled window kernel violates the op contract."""
+
+
+class RetraceError(AssertionError):
+    """A bound kernel was lowered more than once during a driver run."""
+
+
+# ---------------------------------------------------------------------------
+# HLO text checks (migrated from tests/test_gearbox.py — single copy)
+# ---------------------------------------------------------------------------
+
+
+def kernel_hlo(sim, win_end: int = DEFAULT_WIN_END) -> str:
+    """The OPTIMIZED HLO of the bound jitted window step: what actually
+    runs for this sim's active gear (global and islands layouts — the
+    bound ``_step`` has the same (state, params, ws, we) signature in
+    both)."""
+    return (
+        sim._step.lower(sim.state, sim.params, 0, win_end)
+        .compile()
+        .as_text()
+    )
+
+
+def gather_is_serializing(line: str) -> bool:
+    """take_along_axis-shaped gather: every slice is a single element out
+    of a >=2-D operand — a per-element fetch that serializes on TPU
+    (engine.py's stated ban).  Whole-row gathers and 1-D host-table
+    lookups stay vectorized and are the module's bread and butter."""
+    ss = re.search(r"slice_sizes=\{([0-9,]*)\}", line)
+    if ss is None or not ss.group(1):
+        return False
+    sizes = [int(x) for x in ss.group(1).split(",")]
+    operand = re.search(r"gather\(\s*\w+\[([0-9,]*)\]", line)
+    if operand is None:
+        return False
+    rank = len([d for d in operand.group(1).split(",") if d])
+    return all(s == 1 for s in sizes) and rank >= 2
+
+
+def scatter_lines(hlo: str) -> list[str]:
+    return [
+        ln.strip()[:120]
+        for ln in hlo.splitlines()
+        if re.search(r"= .*\bscatter\(", ln)
+    ]
+
+
+def serializing_gather_lines(hlo: str) -> list[str]:
+    return [
+        ln.strip()[:120]
+        for ln in hlo.splitlines()
+        if re.search(r"= .*\bgather\(", ln) and gather_is_serializing(ln)
+    ]
+
+
+def sort_rows(hlo: str) -> list[int]:
+    """Row count (last dim) of every sort in the program."""
+    rows = []
+    for line in hlo.splitlines():
+        if re.search(r"\bsort\(", line) and "= " in line:
+            m = re.search(r"\[([0-9,]+)\]", line)
+            if m:
+                rows.append(int(m.group(1).split(",")[-1]))
+    return rows
+
+
+def audit_hlo(
+    hlo: str,
+    max_sort_rows: int | None = None,
+    max_serializing_gathers: int = 0,
+) -> list[str]:
+    """The op-contract violations in one optimized-HLO program (empty
+    list = clean).
+
+    `max_serializing_gathers` is the variant's documented allowance for
+    the ONE unavoidable by-dst lookup (engine.py: the speculation-
+    violation check reads `done_t[dst]`).  Solo-global kernels read a
+    1-D host table — invisible to the rank>=2 heuristic — but the lane/
+    shard vmap of the fleet and islands layouts batches the same lookup
+    into a rank>=2 gather.  The allowance pins the count, so any NEW
+    per-element fetch still fails the audit."""
+    violations: list[str] = []
+    for ln in scatter_lines(hlo):
+        violations.append(f"scatter survived to the compiled kernel: {ln}")
+    sg = serializing_gather_lines(hlo)
+    if len(sg) > max_serializing_gathers:
+        for ln in sg:
+            violations.append(
+                f"serializing gather ({len(sg)} found, "
+                f"{max_serializing_gathers} allowed): {ln}"
+            )
+    if max_sort_rows is not None:
+        for rows in sort_rows(hlo):
+            if rows > max_sort_rows:
+                violations.append(
+                    f"sort of {rows} rows exceeds the structural bound "
+                    f"{max_sort_rows} (shape regression re-grew the sort "
+                    f"volume)"
+                )
+    return violations
+
+
+# ---------------------------------------------------------------------------
+# the variant matrix
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class KernelVariant:
+    """One cell of the window-kernel matrix: a zero-argument `lower`
+    thunk producing the cell's optimized HLO, plus the structural sort
+    bound for its gear."""
+
+    sync: str  # conservative | optimistic
+    layout: str  # global | islands | fleet
+    gear: int
+    label: str
+    max_sort_rows: int
+    # allowance for the documented by-dst done_t lookups (audit_hlo)
+    max_serializing_gathers: int
+    lower: Callable[[], str] = field(repr=False)
+
+    def hlo(self) -> str:
+        return self.lower()
+
+    def audit(self) -> list[str]:
+        return [
+            f"{self.label}: {v}"
+            for v in audit_hlo(
+                self.hlo(),
+                max_sort_rows=self.max_sort_rows,
+                max_serializing_gathers=self.max_serializing_gathers,
+            )
+        ]
+
+
+def _sort_bound(spec, num_hosts: int, outbox: int) -> int:
+    """Structural row bound for a gear's sorts.  The largest sort any
+    window pipeline runs is the merge: pool leftovers (<= capacity) plus
+    the emission block (<= H × max(K, O) rows per emission record, a
+    handful of records).  4× slack keeps the bound far from incidental
+    padding while still catching quadratic/regression blowups."""
+    return 4 * (spec.capacity + num_hosts * max(spec.K, outbox, 1))
+
+
+def _gear_levels(ladder, gears) -> list[int]:
+    if gears is None:
+        return [s.level for s in ladder]
+    return [s.level for s in ladder if s.level in set(gears)]
+
+
+def _bind_gear(sim, level: int) -> None:
+    if sim._gear != level:
+        sim._shift_gear(level)
+
+
+def variants_for_sim(sim, layout: str, *, sync_modes=SYNC_MODES,
+                     gears=None, win_end: int = DEFAULT_WIN_END
+                     ) -> list[KernelVariant]:
+    """Matrix cells for a built Simulation / IslandSimulation: the bound
+    window-step kernel (conservative) and the optimistic attempt /
+    sub-step kernel, per requested gear tier."""
+    out: list[KernelVariant] = []
+    for level in _gear_levels(sim._gear_ladder, gears):
+        spec = sim._gear_ladder[level]
+        bound = _sort_bound(spec, sim.num_hosts, sim.O)
+        for sync in sync_modes:
+            def lower(sim=sim, level=level, sync=sync):
+                _bind_gear(sim, level)
+                if sync == "conservative":
+                    fn = sim._gear_fns[level]["step"]
+                else:
+                    fn = sim._gear_fns[level]["attempt"]
+                    if fn is None:  # islands compile the sub-step lazily
+                        sim._ensure_optimistic()
+                        fn = sim._gear_fns[level]["attempt"]
+                return (
+                    fn.lower(sim.state, sim.params, 0, win_end)
+                    .compile()
+                    .as_text()
+                )
+
+            # islands-optimistic carries the two shard-batched done_t
+            # lookups (emission check + assemble's arrival check); the
+            # solo-global ones are 1-D and invisible to the heuristic
+            allow = 2 if (layout == "islands" and sync == "optimistic") else 0
+            out.append(KernelVariant(
+                sync=sync, layout=layout, gear=level,
+                label=f"{layout}/{sync}/gear{level}",
+                max_sort_rows=bound, max_serializing_gathers=allow,
+                lower=lower,
+            ))
+    return out
+
+
+def variants_for_fleet(fleet, *, sync_modes=SYNC_MODES, gears=None,
+                       win_end: int = DEFAULT_WIN_END) -> list[KernelVariant]:
+    """Matrix cells for a FleetSimulation: the vmapped run_to (what the
+    conservative sweep dispatches) and the vmapped per-lane attempt."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    t = fleet.template
+    out: list[KernelVariant] = []
+    for level in _gear_levels(fleet._ladder, gears):
+        spec = fleet._ladder[level]
+        bound = _sort_bound(spec, t.num_hosts, t.O)
+        for sync in sync_modes:
+            def lower(fleet=fleet, level=level, sync=sync):
+                if fleet._gear != level:
+                    fleet._shift_gear(level)
+                L = fleet.lanes
+                we = jnp.full((L,), win_end, jnp.int64)
+                if sync == "conservative":
+                    fn = fleet._gear_fns[level]["run_to"]
+                    lowered = fn.lower(
+                        fleet.state, fleet.params,
+                        jnp.asarray(np.asarray(fleet._runahead)), we, 8,
+                    )
+                else:
+                    fleet._ensure_attempt()
+                    fn = fleet._gear_fns[level]["attempt"]
+                    lowered = fn.lower(
+                        fleet.state, fleet.params,
+                        jnp.zeros((L,), jnp.int64), we,
+                    )
+                return lowered.compile().as_text()
+
+            # the lane vmap batches the template's by-dst done_t lookups
+            # into rank>=2 gathers: islands templates carry two under
+            # optimistic sync (compiled out under conservative); global
+            # templates always compile the lax.cond'd check (one gather)
+            if fleet._islands:
+                allow = 2 if sync == "optimistic" else 0
+            else:
+                allow = 1
+            out.append(KernelVariant(
+                sync=sync, layout="fleet", gear=level,
+                label=f"fleet/{sync}/gear{level}",
+                max_sort_rows=bound, max_serializing_gathers=allow,
+                lower=lower,
+            ))
+    return out
+
+
+def audit_variants(variants: list[KernelVariant]) -> dict[str, list[str]]:
+    """Run the op-contract audit over every cell; {label: violations}."""
+    return {v.label: v.audit() for v in variants}
+
+
+def assert_variants_clean(variants: list[KernelVariant]) -> None:
+    bad = {k: v for k, v in audit_variants(variants).items() if v}
+    if bad:
+        lines = [x for vs in bad.values() for x in vs]
+        raise HloAuditError(
+            f"{len(lines)} op-contract violation(s) across "
+            f"{len(bad)} kernel variant(s):\n" + "\n".join(lines)
+        )
+
+
+# ---------------------------------------------------------------------------
+# retrace detector
+# ---------------------------------------------------------------------------
+
+
+def kernel_cache_sizes(sim) -> dict[str, int]:
+    """Per-kernel compiled-trace counts for a Simulation /
+    IslandSimulation / FleetSimulation after a driver run: label ->
+    number of lowerings the bound jit accumulated.  0 = never dispatched
+    (lazy), 1 = the expected single compile, >=2 = a retrace (argument
+    dtype/weak-type/shape drift across dispatches)."""
+    out: dict[str, int] = {}
+    for level in sorted(sim._gear_fns):
+        for name, fn in sorted(sim._gear_fns[level].items()):
+            if name == "step_fn" or fn is None:
+                continue
+            size = getattr(fn, "_cache_size", None)
+            if not callable(size):
+                import jax
+
+                raise RetraceError(
+                    f"kernel gear{level}.{name} exposes no _cache_size "
+                    f"(jax {jax.__version__}): the retrace detector needs "
+                    f"the jit trace-cache introspection API"
+                )
+            out[f"gear{level}.{name}"] = int(size())
+    return out
+
+
+def retrace_report(sim, max_per_kernel: int = 1) -> dict:
+    """The retrace-detector report for a driver smoke run."""
+    sizes = kernel_cache_sizes(sim)
+    retraced = {k: n for k, n in sizes.items() if n > max_per_kernel}
+    rep = {
+        "kernels": sizes,
+        "compiles_total": sum(sizes.values()),
+        "retraced": retraced,
+        "ok": not retraced,
+    }
+    traces = getattr(sim, "kernel_traces", None)
+    if traces is not None:  # fleet: the PR-4 audit metric rides along
+        rep["kernel_traces"] = int(traces)
+    return rep
+
+
+def assert_no_retrace(sim, max_per_kernel: int = 1) -> dict:
+    """Fail on unexpected recompiles: every bound kernel must have been
+    lowered at most `max_per_kernel` times across the run."""
+    rep = retrace_report(sim, max_per_kernel)
+    if not rep["ok"]:
+        raise RetraceError(
+            f"kernel retrace(s) detected (expected <= {max_per_kernel} "
+            f"lowering(s) per kernel): {rep['retraced']} — driver "
+            f"arguments drifted in dtype/weak-type/shape between "
+            f"dispatches"
+        )
+    return rep

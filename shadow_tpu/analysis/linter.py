@@ -1,0 +1,250 @@
+"""shadowlint driver: file walking, kernel/host classification, ``# noqa``
+suppression, and the baseline (grandfathering) workflow.
+
+Classification (the kernel/host module map, docs/static_analysis.md):
+a **kernel** module contributes code that is traced into device window
+programs — its text is subject to the full purity rule set.  Everything
+else is **host** (drivers, schedulers, config, tools): only the
+module-agnostic rules (seed lineage STL003, metric keys STL008) apply.
+``shadow_tpu/obs/metrics.py``'s ``time.time()`` is the canonical host
+example: wall-clock metadata on a host-side registry is fine — the
+classification allowlists it structurally instead of per-line.
+
+Suppression: append ``# noqa: STL0xx`` (or a bare ``# noqa``) to the
+flagged line.  Baseline: ``.shadowlint_baseline.json`` at the repo root
+grandfathers pre-existing findings by (path, code, normalized source
+line) fingerprint — stable across unrelated line-number churn; new code
+can never hide behind it because any new finding is a new fingerprint.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+import json
+import os
+import re
+from dataclasses import asdict, dataclass
+
+from shadow_tpu.analysis import rules as rules_mod
+
+BASELINE_NAME = ".shadowlint_baseline.json"
+BASELINE_VERSION = 1
+
+# The kernel/host module map (repo-relative, forward slashes).  These
+# modules produce code that is traced into compiled device programs.
+KERNEL_MODULE_PATTERNS = (
+    "shadow_tpu/core/engine.py",
+    "shadow_tpu/core/state.py",
+    "shadow_tpu/core/soa.py",
+    "shadow_tpu/core/spill.py",
+    "shadow_tpu/core/gearbox.py",
+    "shadow_tpu/net/*.py",
+    "shadow_tpu/obs/counters.py",
+    "shadow_tpu/obs/audit.py",
+    "shadow_tpu/obs/flight.py",
+    "shadow_tpu/parallel/*.py",
+    "shadow_tpu/fleet/engine.py",
+)
+
+_NOQA_RE = re.compile(
+    r"#\s*noqa(?::\s*(?P<codes>[A-Z]+[0-9]+(?:\s*,\s*[A-Z]+[0-9]+)*))?",
+    re.IGNORECASE,
+)
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str  # repo-relative
+    line: int
+    col: int
+    code: str
+    message: str
+    text: str  # stripped source line (fingerprint component)
+
+    def fingerprint(self) -> tuple[str, str, str]:
+        return (self.path, self.code, self.text)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col + 1}: {self.code} {self.message}"
+
+
+def classify_module(relpath: str) -> str:
+    """'kernel' or 'host' for a repo-relative path."""
+    p = relpath.replace(os.sep, "/")
+    for pat in KERNEL_MODULE_PATTERNS:
+        if fnmatch.fnmatch(p, pat):
+            return "kernel"
+    return "host"
+
+
+def _suppressed(line_text: str, code: str) -> bool:
+    m = _NOQA_RE.search(line_text)
+    if not m:
+        return False
+    codes = m.group("codes")
+    if codes is None:
+        return True  # bare `# noqa` silences everything on the line
+    return code.upper() in {c.strip().upper() for c in codes.split(",")}
+
+
+def lint_source(
+    src: str,
+    relpath: str,
+    kind: str | None = None,
+    select: set[str] | None = None,
+) -> list[Finding]:
+    """Lint one module's source text.  `kind` overrides classification
+    (fixture tests lint snippets "as if" kernel/host); `select` restricts
+    to a subset of rule codes."""
+    relpath = relpath.replace(os.sep, "/")
+    if kind is None:
+        kind = classify_module(relpath)
+    tree = ast.parse(src, filename=relpath)
+    imports = rules_mod.build_imports(tree)
+    parents = rules_mod.build_parents(tree)
+    ctx = rules_mod.RuleContext(
+        tree=tree,
+        relpath=relpath,
+        kind=kind,
+        imports=imports,
+        parents=parents,
+        traced=rules_mod.find_traced_functions(tree, imports, parents),
+    )
+    lines = src.splitlines()
+    out: list[Finding] = []
+    for rule in rules_mod.RULES:
+        if select is not None and rule.code not in select:
+            continue
+        if rule.kernel_only and kind != "kernel":
+            continue
+        for raw in rule.fn(ctx):
+            text = (
+                lines[raw.line - 1] if 0 < raw.line <= len(lines) else ""
+            )
+            if _suppressed(text, raw.code):
+                continue
+            out.append(
+                Finding(
+                    path=relpath, line=raw.line, col=raw.col,
+                    code=raw.code, message=raw.message, text=text.strip(),
+                )
+            )
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.code))
+    return out
+
+
+def lint_file(path: str, root: str, select: set[str] | None = None) -> list[Finding]:
+    relpath = os.path.relpath(os.path.abspath(path), os.path.abspath(root))
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    return lint_source(src, relpath, select=select)
+
+
+def iter_python_files(paths: list[str]):
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                yield p
+        else:
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = sorted(
+                    d for d in dirnames
+                    if d not in {"__pycache__", ".git", ".jax_cache"}
+                )
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        yield os.path.join(dirpath, fn)
+
+
+def lint_paths(
+    paths: list[str], root: str, select: set[str] | None = None
+) -> list[Finding]:
+    out: list[Finding] = []
+    for path in iter_python_files(paths):
+        out.extend(lint_file(path, root, select=select))
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.code))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# baseline (grandfathered findings)
+# ---------------------------------------------------------------------------
+
+
+def load_baseline(path: str) -> dict[tuple[str, str, str], int]:
+    """Fingerprint -> grandfathered count.  Missing file = empty baseline."""
+    if not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    if doc.get("version") != BASELINE_VERSION:
+        raise ValueError(
+            f"baseline {path}: version {doc.get('version')!r} != "
+            f"{BASELINE_VERSION}"
+        )
+    out: dict[tuple[str, str, str], int] = {}
+    for e in doc.get("entries", []):
+        key = (e["path"], e["code"], e["text"])
+        out[key] = out.get(key, 0) + int(e.get("count", 1))
+    return out
+
+
+def split_baselined(
+    findings: list[Finding], baseline: dict[tuple[str, str, str], int]
+) -> tuple[list[Finding], list[Finding]]:
+    """(new, grandfathered): each fingerprint absorbs up to its
+    baselined count of findings; the rest are new."""
+    remaining = dict(baseline)
+    new: list[Finding] = []
+    old: list[Finding] = []
+    for f in findings:
+        k = f.fingerprint()
+        if remaining.get(k, 0) > 0:
+            remaining[k] -= 1
+            old.append(f)
+        else:
+            new.append(f)
+    return new, old
+
+
+def write_baseline(findings: list[Finding], path: str) -> dict:
+    counts: dict[tuple[str, str, str], int] = {}
+    for f in findings:
+        counts[f.fingerprint()] = counts.get(f.fingerprint(), 0) + 1
+    doc = {
+        "version": BASELINE_VERSION,
+        "entries": [
+            {"path": p, "code": c, "text": t, "count": n}
+            for (p, c, t), n in sorted(counts.items())
+        ],
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=1)
+        f.write("\n")
+    return doc
+
+
+def findings_doc(
+    new: list[Finding], grandfathered: list[Finding], scanned: list[str]
+) -> dict:
+    """The machine-readable report (`tools/shadowlint.py --format json`)."""
+    by_code: dict[str, int] = {}
+    for f in new:
+        by_code[f.code] = by_code.get(f.code, 0) + 1
+    return {
+        "kind": "shadow_tpu.shadowlint",
+        "schema_version": 1,
+        "ok": not new,
+        "files_scanned": len(scanned),
+        "findings": [asdict(f) for f in new],
+        "grandfathered": [asdict(f) for f in grandfathered],
+        "counts": {
+            "new": len(new),
+            "grandfathered": len(grandfathered),
+            "by_code": dict(sorted(by_code.items())),
+        },
+        "rules": {
+            r.code: r.summary for r in rules_mod.RULES
+        },
+    }

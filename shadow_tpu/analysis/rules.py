@@ -1,0 +1,565 @@
+"""AST rules for shadowlint (codes STL0xx).
+
+Each rule is a function ``rule(ctx) -> Iterable[RawFinding]`` registered
+in ``RULES``; ``linter.py`` owns file walking, module classification,
+``# noqa`` suppression, and the baseline workflow.  Rules see a
+``RuleContext`` carrying the parsed tree, an import-resolution map, and
+the module classification — so a call like ``np.random.uniform(...)``
+resolves to ``numpy.random.uniform`` no matter the alias.
+
+Rule catalog (docs/static_analysis.md is the user-facing copy):
+
+  STL001  wall-clock read in a kernel module
+  STL002  ambient (non fold-in) randomness in a kernel module
+  STL003  unseeded RNG construction / PRNGKey outside core/rng.py
+  STL004  float()/int()/bool() coercion of a traced value in a jitted body
+  STL005  Python branching on a traced value in a jitted body
+  STL006  host callback / jax.debug in a kernel module without allowlist
+  STL007  unsorted dict iteration feeding pytree construction (kernel)
+  STL008  metric key outside the validate_metrics namespace schema
+
+Adding a rule: write ``def rule_stl0xx(ctx)``, append a ``Rule`` row to
+``RULES``, add a firing fixture to tests/test_analysis.py, and document
+the code in docs/static_analysis.md.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Callable, Iterable, Iterator, NamedTuple
+
+# ---------------------------------------------------------------------------
+# rule plumbing
+# ---------------------------------------------------------------------------
+
+
+class RawFinding(NamedTuple):
+    """A rule hit before suppression/baseline filtering (linter.py turns
+    these into `Finding`s with path/text attached)."""
+
+    line: int
+    col: int
+    code: str
+    message: str
+
+
+class Rule(NamedTuple):
+    code: str
+    summary: str
+    kernel_only: bool
+    fn: Callable[["RuleContext"], Iterable[RawFinding]]
+
+
+@dataclass
+class RuleContext:
+    tree: ast.AST
+    relpath: str  # repo-relative, forward slashes
+    kind: str  # "kernel" | "host"
+    imports: dict[str, str]  # local name -> dotted module/object it names
+    parents: dict[ast.AST, ast.AST]
+    traced: set[ast.AST]  # FunctionDef/Lambda nodes that run under trace
+
+
+# Callbacks a kernel module may legitimately carry: (relpath, callable)
+# pairs.  Empty on purpose — the tree is callback-free today; additions
+# must name the exact site so a review sees them.
+CALLBACK_ALLOWLIST: set[tuple[str, str]] = set()
+
+_WALL_CLOCK = {
+    "time.time", "time.time_ns", "time.monotonic", "time.monotonic_ns",
+    "time.perf_counter", "time.perf_counter_ns", "time.process_time",
+    "time.clock_gettime", "datetime.datetime.now", "datetime.datetime.utcnow",
+    "datetime.date.today",
+}
+
+# ambient RNG roots banned from kernel modules (STL002): any call whose
+# resolved dotted name starts with one of these
+_AMBIENT_RNG_PREFIXES = (
+    "random.", "numpy.random.", "os.urandom", "secrets.", "uuid.uuid4",
+)
+
+_TRACE_ENTRIES = {
+    "jax.jit", "jax.pmap", "jax.vmap", "jax.checkpoint", "jax.remat",
+    "jax.lax.while_loop", "jax.lax.cond", "jax.lax.scan",
+    "jax.lax.fori_loop", "jax.lax.switch", "jax.lax.map",
+    "jax.lax.associative_scan", "jax.custom_jvp", "jax.custom_vjp",
+}
+
+_CALLBACKS = {
+    "jax.pure_callback", "jax.experimental.io_callback",
+    "jax.debug.print", "jax.debug.callback", "jax.debug.breakpoint",
+    "jax.experimental.host_callback.call",
+    "jax.experimental.host_callback.id_tap",
+}
+
+
+# ---------------------------------------------------------------------------
+# shared AST helpers
+# ---------------------------------------------------------------------------
+
+
+def build_imports(tree: ast.AST) -> dict[str, str]:
+    """Map local names to the dotted thing they import.
+
+    ``import numpy as np``          -> {"np": "numpy"}
+    ``from jax import lax``         -> {"lax": "jax.lax"}
+    ``from time import time as t``  -> {"t": "time.time"}
+    """
+    out: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                out[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0]
+                )
+        elif isinstance(node, ast.ImportFrom) and node.module and not node.level:
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                out[a.asname or a.name] = f"{node.module}.{a.name}"
+    return out
+
+
+def build_parents(tree: ast.AST) -> dict[ast.AST, ast.AST]:
+    parents: dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+def resolve_name(
+    node: ast.AST, imports: dict[str, str], require_import: bool = False
+) -> str | None:
+    """Dotted name of an expression, with its head resolved through the
+    import map: ``np.random.uniform`` -> ``numpy.random.uniform``.
+    With ``require_import`` the head must actually be imported — so a
+    local variable that happens to be named ``time`` never matches the
+    stdlib module."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    if require_import and node.id not in imports:
+        return None
+    head = imports.get(node.id, node.id)
+    return ".".join([head] + list(reversed(parts)))
+
+
+def _func_scope(node: ast.AST, parents) -> ast.AST | None:
+    """Nearest enclosing function/lambda (or None at module level)."""
+    node = parents.get(node)
+    while node is not None and not isinstance(
+        node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+    ):
+        node = parents.get(node)
+    return node
+
+
+def find_traced_functions(
+    tree: ast.AST, imports: dict[str, str], parents
+) -> set[ast.AST]:
+    """Function/Lambda nodes whose bodies execute under a jax trace:
+
+      * passed (by local name, or as an inline lambda) to a trace entry
+        point — jit/vmap/lax.while_loop/cond/scan/... ;
+      * decorated with one (``@jax.jit`` / ``@partial(jax.jit, ...)``);
+      * defined inside any of the above (a helper def'd in a traced body
+        runs under the same trace).
+    """
+    # name -> defs, per enclosing scope, for by-name argument resolution
+    defs: dict[tuple[ast.AST | None, str], list[ast.AST]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault((_func_scope(node, parents), node.name), []).append(node)
+
+    traced: set[ast.AST] = set()
+
+    def mark_arg(arg: ast.AST, scope: ast.AST | None) -> None:
+        if isinstance(arg, ast.Lambda):
+            traced.add(arg)
+        elif isinstance(arg, ast.Name):
+            s = scope
+            while True:
+                for d in defs.get((s, arg.id), ()):
+                    traced.add(d)
+                if s is None:
+                    break
+                s = _func_scope(s, parents)
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            name = resolve_name(node.func, imports)
+            if name in _TRACE_ENTRIES:
+                scope = _func_scope(node, parents)
+                for arg in list(node.args) + [k.value for k in node.keywords]:
+                    mark_arg(arg, scope)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                name = resolve_name(target, imports)
+                if name in _TRACE_ENTRIES or (
+                    isinstance(dec, ast.Call)
+                    and name in {"functools.partial", "partial"}
+                    and dec.args
+                    and resolve_name(dec.args[0], imports) in _TRACE_ENTRIES
+                ):
+                    traced.add(node)
+
+    # propagate into nested defs/lambdas
+    changed = True
+    while changed:
+        changed = False
+        for node in ast.walk(tree):
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ) and node not in traced:
+                s = _func_scope(node, parents)
+                if s is not None and s in traced:
+                    traced.add(node)
+                    changed = True
+    return traced
+
+
+def _traced_scope_chain(node: ast.AST, ctx: RuleContext) -> list[ast.AST]:
+    """Enclosing traced functions of `node`, innermost first (empty when
+    the node is not inside a traced body)."""
+    chain = []
+    fn = _func_scope(node, ctx.parents)
+    while fn is not None:
+        if fn in ctx.traced:
+            chain.append(fn)
+        fn = _func_scope(fn, ctx.parents)
+    return chain
+
+
+def _traced_local_names(fns: Iterable[ast.AST], parents) -> set[str]:
+    """Names that carry traced values inside the given traced functions:
+    their parameters plus every name assigned within their bodies.
+    (Closure names from non-traced factory scopes stay out — branching
+    on those is trace-time configuration, which is legitimate.)"""
+    names: set[str] = set()
+    fns = set(fns)
+    for fn in fns:
+        a = fn.args
+        for arg in (
+            list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)
+            + ([a.vararg] if a.vararg else []) + ([a.kwarg] if a.kwarg else [])
+        ):
+            names.add(arg.arg)
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                targets = (
+                    node.targets if isinstance(node, ast.Assign) else [node.target]
+                )
+                for t in targets:
+                    for n in ast.walk(t):
+                        if isinstance(n, ast.Name):
+                            names.add(n.id)
+            elif isinstance(node, (ast.For, ast.comprehension)):
+                for n in ast.walk(node.target):
+                    if isinstance(n, ast.Name):
+                        names.add(n.id)
+    return names
+
+
+def _is_static_expr(node: ast.AST) -> bool:
+    """Constant-foldable at trace time: literals and arithmetic on them."""
+    return all(
+        isinstance(
+            n,
+            (
+                ast.Constant, ast.BinOp, ast.UnaryOp, ast.BoolOp, ast.Compare,
+                ast.Tuple, ast.List, ast.operator, ast.unaryop, ast.boolop,
+                ast.cmpop, ast.Load,
+            ),
+        )
+        for n in ast.walk(node)
+    )
+
+
+# ---------------------------------------------------------------------------
+# the rules
+# ---------------------------------------------------------------------------
+
+
+def rule_stl001(ctx: RuleContext) -> Iterator[RawFinding]:
+    """Wall-clock reads in kernel modules: device kernels must be pure
+    functions of (state, params, window) — host time leaking in breaks
+    replay and the audit digest chain."""
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call):
+            name = resolve_name(node.func, ctx.imports, require_import=True)
+            if name in _WALL_CLOCK:
+                yield RawFinding(
+                    node.lineno, node.col_offset, "STL001",
+                    f"wall-clock read `{name}()` in kernel module "
+                    f"(kernel code must be pure in (state, params, window))",
+                )
+
+
+def rule_stl002(ctx: RuleContext) -> Iterator[RawFinding]:
+    """Ambient randomness in kernel modules: every random decision must
+    come from core/rng.py's (seed, host, counter) fold-in lineage."""
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call):
+            name = resolve_name(node.func, ctx.imports, require_import=True)
+            if name is None:
+                continue
+            if name.startswith("jax.random."):
+                continue  # the sanctioned device lineage (STL003 gates keys)
+            if any(
+                name == p.rstrip(".") or name.startswith(p)
+                for p in _AMBIENT_RNG_PREFIXES
+            ):
+                yield RawFinding(
+                    node.lineno, node.col_offset, "STL002",
+                    f"ambient randomness `{name}` in kernel module — use "
+                    f"core/rng.py's fold-in lineage",
+                )
+
+
+def rule_stl003(ctx: RuleContext) -> Iterator[RawFinding]:
+    """Unseeded RNG construction (any module) and PRNGKey construction
+    outside core/rng.py.  Seed lineage must be rooted in the experiment
+    seed: `random.Random()` with no argument seeds from OS entropy, and
+    a stray PRNGKey(...) forks a second, unaudited device lineage."""
+    in_rng = ctx.relpath.endswith("core/rng.py")
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = resolve_name(node.func, ctx.imports, require_import=True)
+        if name in {"random.Random", "random.SystemRandom",
+                    "numpy.random.default_rng", "numpy.random.RandomState"}:
+            if not node.args and not node.keywords:
+                yield RawFinding(
+                    node.lineno, node.col_offset, "STL003",
+                    f"unseeded `{name}()` — derive the seed from the "
+                    f"experiment master seed",
+                )
+        elif name in {"jax.random.PRNGKey", "jax.random.key"} and not in_rng:
+            yield RawFinding(
+                node.lineno, node.col_offset, "STL003",
+                f"`{name}` outside core/rng.py — root all device "
+                f"randomness in rng.host_keys' fold-in lineage",
+            )
+        elif name in {"dataclasses.field", "field"}:
+            for kw in node.keywords:
+                if kw.arg == "default_factory" and resolve_name(
+                    kw.value, ctx.imports, require_import=True
+                ) in {"random.Random", "random.SystemRandom",
+                      "numpy.random.default_rng"}:
+                    yield RawFinding(
+                        node.lineno, node.col_offset, "STL003",
+                        "unseeded RNG default_factory — the field seeds "
+                        "from OS entropy on construction",
+                    )
+
+
+def rule_stl004(ctx: RuleContext) -> Iterator[RawFinding]:
+    """float()/int()/bool() inside a traced body concretizes a traced
+    value: at best a TracerBoolConversionError at trace time, at worst a
+    silent constant baked in from the tracer's aval."""
+    rebound = {
+        n.name for n in ast.walk(ctx.tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        and n.name in {"float", "int", "bool"}
+    }
+    for node in ast.walk(ctx.tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id in {"float", "int", "bool"} - rebound
+            and node.args
+            and not _is_static_expr(node.args[0])
+            and _traced_scope_chain(node, ctx)
+        ):
+            yield RawFinding(
+                node.lineno, node.col_offset, "STL004",
+                f"`{node.func.id}()` coercion inside a jitted body — "
+                f"concretizes a traced value (use .astype / lax ops)",
+            )
+
+
+def _static_container_names(fns: Iterable[ast.AST]) -> set[str]:
+    """Names assigned a list/tuple/dict display or comprehension inside
+    the given functions: their *truthiness* (length) is static at trace
+    time even when the elements are traced arrays."""
+    names: set[str] = set()
+    for fn in fns:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value,
+                (ast.List, ast.Tuple, ast.Dict, ast.Set, ast.ListComp,
+                 ast.DictComp, ast.SetComp),
+            ):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        names.add(t.id)
+    return names
+
+
+def _suspect_test_names(test: ast.AST) -> set[str]:
+    """Names in a branch test that could carry traced *values*.  Skips
+    the trace-time-static idioms: identity comparisons (`x is None`
+    pytree-structure checks) and isinstance/hasattr/getattr/callable/len
+    calls (lengths and attrs of traced arrays are static)."""
+    names: set[str] = set()
+
+    def visit(n: ast.AST) -> None:
+        if isinstance(n, ast.Compare) and all(
+            isinstance(op, (ast.Is, ast.IsNot)) for op in n.ops
+        ):
+            return
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Name) and (
+            n.func.id in {"isinstance", "hasattr", "getattr", "callable",
+                          "len"}
+        ):
+            return
+        if isinstance(n, ast.Name):
+            names.add(n.id)
+        for c in ast.iter_child_nodes(n):
+            visit(c)
+
+    visit(test)
+    return names
+
+
+def rule_stl005(ctx: RuleContext) -> Iterator[RawFinding]:
+    """Python `if`/`while` on a traced value inside a jitted body — the
+    branch is resolved once at trace time (or fails to trace); use
+    jnp.where / lax.cond.  Branching on factory-closure configuration,
+    pytree structure (`x is None`), or static container lengths is fine:
+    only names carrying traced values inside the traced scope count."""
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.If, ast.While, ast.IfExp)):
+            continue
+        chain = _traced_scope_chain(node, ctx)
+        if not chain:
+            continue
+        local = _traced_local_names(chain, ctx.parents)
+        local -= _static_container_names(chain)
+        test_names = _suspect_test_names(node.test)
+        if test_names & local and not _is_static_expr(node.test):
+            kind = {ast.If: "if", ast.While: "while", ast.IfExp: "ternary"}[
+                type(node)
+            ]
+            yield RawFinding(
+                node.lineno, node.col_offset, "STL005",
+                f"Python `{kind}` on a traced value inside a jitted body "
+                f"— use jnp.where / lax.cond / lax.while_loop",
+            )
+
+
+def rule_stl006(ctx: RuleContext) -> Iterator[RawFinding]:
+    """Host callbacks / jax.debug in kernel modules: a callback re-enters
+    Python mid-kernel — nondeterministic ordering under async dispatch
+    and a serialization point on TPU.  Additions must be allowlisted in
+    rules.CALLBACK_ALLOWLIST with the exact (module, callable) site."""
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call):
+            name = resolve_name(node.func, ctx.imports, require_import=True)
+            if name in _CALLBACKS or (
+                name is not None and name.startswith("jax.debug.")
+            ):
+                if (ctx.relpath, name) in CALLBACK_ALLOWLIST:
+                    continue
+                yield RawFinding(
+                    node.lineno, node.col_offset, "STL006",
+                    f"host callback `{name}` in kernel module without a "
+                    f"CALLBACK_ALLOWLIST entry",
+                )
+
+
+def rule_stl007(ctx: RuleContext) -> Iterator[RawFinding]:
+    """Unsorted dict iteration in kernel modules: dict order is insertion
+    order, which upstream config/build wiring does not pin — iteration
+    feeding pytree construction or kernel wiring must sort first."""
+    for node in ast.walk(ctx.tree):
+        iters: list[ast.AST] = []
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            iters = [node.iter]
+        elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                               ast.GeneratorExp)):
+            iters = [g.iter for g in node.generators]
+        for it in iters:
+            if (
+                isinstance(it, ast.Call)
+                and isinstance(it.func, ast.Attribute)
+                and it.func.attr in {"items", "keys", "values"}
+                and not it.args
+            ):
+                yield RawFinding(
+                    it.lineno, it.col_offset, "STL007",
+                    f"unsorted `.{it.func.attr}()` iteration in kernel "
+                    f"module — wrap in sorted(...) so pytree/kernel wiring "
+                    f"order is pinned",
+                )
+
+
+_METRIC_EMITTERS = {"counter_set", "counter_add", "gauge_set", "histogram"}
+
+
+def _literal_key_prefix(node: ast.AST) -> str | None:
+    """Static prefix of a metric-key argument: full value for a str
+    constant, the leading literal run for an f-string.  None when the key
+    has no static prefix (dynamic keys are out of scope here — the
+    runtime validator owns those)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr):
+        out = ""
+        for part in node.values:
+            if isinstance(part, ast.Constant) and isinstance(part.value, str):
+                out += part.value
+            else:
+                break
+        return out or None
+    return None
+
+
+def rule_stl008(ctx: RuleContext) -> Iterator[RawFinding]:
+    """Metric-key namespace discipline: every statically-visible key fed
+    to counter_set/counter_add/gauge_set/histogram must live in a
+    namespace the tools/validate_metrics.py schema knows — the class of
+    schema-drift bug that forced the v2→v6 validator chasing."""
+    from shadow_tpu.obs.metrics import KNOWN_METRIC_NAMESPACES
+
+    for node in ast.walk(ctx.tree):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _METRIC_EMITTERS
+            and node.args
+        ):
+            continue
+        prefix = _literal_key_prefix(node.args[0])
+        if prefix is None or "." not in prefix:
+            # dynamic key or no namespace segment visible — not decidable
+            # statically; wall.* style helpers pass f"{prefix}.{f}"
+            continue
+        ns = prefix.split(".", 1)[0]
+        if ns not in KNOWN_METRIC_NAMESPACES:
+            yield RawFinding(
+                node.args[0].lineno, node.args[0].col_offset, "STL008",
+                f"metric namespace `{ns}.*` is not in the "
+                f"validate_metrics schema (KNOWN_METRIC_NAMESPACES, "
+                f"obs/metrics.py) — register it with a schema bump",
+            )
+
+
+RULES: list[Rule] = [
+    Rule("STL001", "wall-clock read in kernel module", True, rule_stl001),
+    Rule("STL002", "ambient randomness in kernel module", True, rule_stl002),
+    Rule("STL003", "unseeded RNG / stray PRNGKey lineage", False, rule_stl003),
+    Rule("STL004", "traced-value coercion in jitted body", True, rule_stl004),
+    Rule("STL005", "Python branching on traced value", True, rule_stl005),
+    Rule("STL006", "unallowlisted host callback in kernel", True, rule_stl006),
+    Rule("STL007", "unsorted dict iteration in kernel", True, rule_stl007),
+    Rule("STL008", "metric key outside namespace schema", False, rule_stl008),
+]
+
+RULE_INDEX: dict[str, Rule] = {r.code: r for r in RULES}

@@ -1611,7 +1611,7 @@ class Simulation:
                 ),
             )
             seq_init = np.zeros(num_hosts, dtype=np.int32)
-            for s, q in seq_ctr.items():
+            for s, q in sorted(seq_ctr.items()):
                 seq_init[s] = q
         else:
             seq_init = np.zeros(num_hosts, dtype=np.int32)
@@ -2411,7 +2411,7 @@ class Simulation:
 
     def counters(self) -> dict[str, int]:
         c = jax.device_get(self.state.counters)
-        return {k: int(v) for k, v in c.__dict__.items()}
+        return {k: int(v) for k, v in sorted(c.__dict__.items())}
 
     def obs_snapshot(self) -> dict:
         """The device telemetry block (obs/counters.py), normalized across

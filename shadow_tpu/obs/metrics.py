@@ -39,6 +39,31 @@ _FLEET_JOB_KEYS = {
     "name", "status", "events_committed", "windows", "frontier_ns", "wall_s",
 }
 
+# The closed set of dotted-key namespaces a metrics document may carry
+# (docs/observability.md).  This is the single source of truth three
+# consumers share: `validate_metrics_doc(strict_namespaces=True)` /
+# `tools/validate_metrics.py --strict-namespaces` reject documents with
+# keys outside it, and the shadowlint STL008 rule
+# (shadow_tpu/analysis/rules.py) rejects the *emitting line* at lint
+# time — so a new namespace lands here, with a schema-version bump and a
+# docs row, before any code can emit it.
+KNOWN_METRIC_NAMESPACES = frozenset({
+    "engine",      # engine Counters struct (core/state.py)
+    "obs",         # device counter block (obs/counters.py)
+    "net",         # device network planes: net.nic/router/tcp.*
+    "vtime",       # virtual-time roughness gauges
+    "wall",        # driver wall-time histograms
+    "round",       # per-dispatch-round throughput series
+    "spill",       # spill-tier counters
+    "gear",        # gearbox telemetry (schema v2)
+    "faults",      # fault-tolerance plane (schema v3)
+    "fleet",       # scenario-fleet scheduler plane (schema v4)
+    "audit",       # determinism-audit plane (schema v5)
+    "resilience",  # backend supervision (schema v6)
+    "sim",         # build-level gauges (num_hosts, runahead)
+    "bench",       # bench.py gate-local rows
+})
+
 # Histograms keep exact count/sum/min/max plus a bounded sample buffer for
 # percentiles: past the cap, samples are kept with a deterministic stride
 # (every k-th observation) — no RNG, reruns dump identical documents.
@@ -137,10 +162,14 @@ class MetricsRegistry:
 _HIST_KEYS = {"count", "sum", "min", "max", "mean", "p50", "p90", "p99"}
 
 
-def validate_metrics_doc(doc: dict) -> None:
+def validate_metrics_doc(doc: dict, strict_namespaces: bool = False) -> None:
     """Raise ValueError unless `doc` conforms to the documented schema
     (docs/observability.md). The tier-1 smoke test runs this on the
-    --metrics-out output of the flagship tiny config."""
+    --metrics-out output of the flagship tiny config.
+
+    With `strict_namespaces`, every dotted counter/gauge/histogram key
+    must additionally live in KNOWN_METRIC_NAMESPACES — the runtime twin
+    of shadowlint's STL008 static check."""
     if not isinstance(doc, dict):
         raise ValueError("metrics doc must be a JSON object")
     if doc.get("kind") != DOC_KIND:
@@ -167,6 +196,15 @@ def validate_metrics_doc(doc: dict) -> None:
             raise ValueError(
                 f"histogram {k!r} must carry keys {sorted(_HIST_KEYS)}"
             )
+    if strict_namespaces:
+        for section in ("counters", "gauges", "histograms"):
+            for k in doc[section]:
+                ns = k.split(".", 1)[0]
+                if "." in k and ns not in KNOWN_METRIC_NAMESPACES:
+                    raise ValueError(
+                        f"{section} key {k!r}: namespace {ns!r} is not in "
+                        f"KNOWN_METRIC_NAMESPACES (obs/metrics.py)"
+                    )
     fleet = doc.get("fleet")
     if fleet is not None:
         # schema v4: fleet runs attach per-job rows (docs/observability.md)

@@ -622,8 +622,11 @@ class SimHost:
     tracker: dict = field(default_factory=_new_tracker)
     pcap_dir: str | None = None  # capture rx/tx packets when set
     # deterministic per-host random stream (getrandom; reference: per-host
-    # nodeSeed from the controller's master RNG, random.c:15-51)
-    rand: random.Random = field(default_factory=random.Random)
+    # nodeSeed from the controller's master RNG, random.c:15-51). add_host
+    # derives the real stream from the controller master seed; the default
+    # is a fixed-seed stream so a directly-constructed SimHost can never
+    # draw OS entropy (shadowlint STL003)
+    rand: random.Random = field(default_factory=lambda: random.Random(0))
     # CPU model (host/cpu.c): simulated processing time not yet applied to
     # the virtual clock
     cpu_unapplied: int = 0
@@ -811,8 +814,10 @@ class ProcessDriver:
             name=name,
             ip=ip if isinstance(ip, int) else ip_from_str(ip),
             index=len(self.hosts),
+            # per-host nodeSeed derived from the controller master seed
+            # (random.c:15-51 analog): same (seed, name) -> same stream
+            rand=random.Random(f"{self.seed}:{name}"),
         )
-        h.rand.seed(f"{self.seed}:{name}")
         self.hosts.append(h)
         self._hosts_by_ip[h.ip] = h
         return h

@@ -19,12 +19,12 @@ most half the top gear's — the mechanism the gearing win comes from.
 """
 
 import hashlib
-import re
 
 import jax
 import numpy as np
 import pytest
 
+from shadow_tpu.analysis import hlo_audit
 from shadow_tpu.core import gearbox, simtime
 from shadow_tpu.core import spill as spill_mod
 from shadow_tpu.core.state import EventPool
@@ -287,44 +287,11 @@ def test_checkpoint_records_and_restores_gear(tmp_path):
 
 
 # ---------------------------------------------------------------------------
-# static-analysis guards: the op ban and the sort-volume mechanism
+# static-analysis guards: the op ban and the sort-volume mechanism.
+# The HLO-parsing logic lives in shadow_tpu/analysis/hlo_audit.py (the
+# shared compiled-kernel auditor — tests/test_analysis.py runs the full
+# variant matrix); these tests keep the gearbox-local claims.
 # ---------------------------------------------------------------------------
-
-
-def _kernel_hlo(sim) -> str:
-    """The OPTIMIZED HLO of the jitted window step: what actually runs.
-    (Raw StableHLO still carries jax's constant-column .at[].set scatters,
-    which XLA canonicalizes to dynamic-update-slices — only what survives
-    optimization can serialize.)"""
-    return jax.jit(sim._step_fn).lower(
-        sim.state, sim.params, 0, 50_000_000
-    ).compile().as_text()
-
-
-def _gather_is_serializing(line: str) -> bool:
-    """take_along_axis-shaped gather: every slice is a single element out
-    of a >=2-D operand — a per-element fetch that serializes on TPU
-    (engine.py's stated ban). Whole-row gathers and 1-D host-table
-    lookups stay vectorized and are the module's bread and butter."""
-    ss = re.search(r"slice_sizes=\{([0-9,]*)\}", line)
-    if ss is None or not ss.group(1):
-        return False
-    sizes = [int(x) for x in ss.group(1).split(",")]
-    operand = re.search(r"gather\(\s*\w+\[([0-9,]*)\]", line)
-    if operand is None:
-        return False
-    rank = len([d for d in operand.group(1).split(",") if d])
-    return all(s == 1 for s in sizes) and rank >= 2
-
-
-def _sort_rows(hlo: str) -> list[int]:
-    rows = []
-    for line in hlo.splitlines():
-        if re.search(r"\bsort\(", line) and "= " in line:
-            m = re.search(r"\[([0-9,]+)\]", line)
-            if m:
-                rows.append(int(m.group(1).split(",")[-1]))
-    return rows
 
 
 def test_window_kernel_bans_scatter_and_serializing_gather():
@@ -333,16 +300,9 @@ def test_window_kernel_bans_scatter_and_serializing_gather():
         64, msgload=2, stop_s=2, runtime_s=2, seed=3, event_capacity=4096)
     flood = build_simulation(_flood_cfg(1, 1024))
     for name, sim in (("phold", phold), ("flood", flood)):
-        hlo = _kernel_hlo(sim)
-        bad_scatter = [ln.strip()[:120] for ln in hlo.splitlines()
-                       if re.search(r"= .*\bscatter\(", ln)]
-        assert not bad_scatter, \
-            f"{name}: scatter survived to the compiled window kernel " \
-            f"(engine.py ban): {bad_scatter}"
-        bad = [ln.strip()[:120] for ln in hlo.splitlines()
-               if re.search(r"= .*\bgather\(", ln)
-               and _gather_is_serializing(ln)]
-        assert not bad, f"{name}: serializing gather(s): {bad}"
+        hlo = hlo_audit.kernel_hlo(sim)
+        violations = hlo_audit.audit_hlo(hlo)
+        assert not violations, f"{name}: {violations}"
 
 
 def test_low_gear_sort_rows_at_most_half_of_top():
@@ -350,7 +310,7 @@ def test_low_gear_sort_rows_at_most_half_of_top():
         64, msgload=2, stop_s=2, runtime_s=2, seed=3, event_capacity=8192,
         pool_gears=3)
     assert sim._gear == 0
-    low = max(_sort_rows(_kernel_hlo(sim)))
+    low = max(hlo_audit.sort_rows(hlo_audit.kernel_hlo(sim)))
     sim._shift_gear(len(sim._gear_ladder) - 1)
-    top = max(_sort_rows(_kernel_hlo(sim)))
+    top = max(hlo_audit.sort_rows(hlo_audit.kernel_hlo(sim)))
     assert low * 2 <= top, f"low gear sorts {low} rows vs top {top}"

@@ -1,0 +1,104 @@
+#!/usr/bin/env python3
+"""shadowlint CLI: device-purity & determinism static analysis.
+
+Runs the STL0xx AST rule set (shadow_tpu/analysis) over the tree —
+default scope: shadow_tpu/, tools/, bench.py — and reports findings that
+are neither ``# noqa``-suppressed nor grandfathered by the baseline
+file (.shadowlint_baseline.json at the repo root).
+
+Usage:
+  python tools/shadowlint.py                      # text report
+  python tools/shadowlint.py --format json        # machine-readable
+  python tools/shadowlint.py shadow_tpu/net       # restrict scope
+  python tools/shadowlint.py --select STL003      # one rule
+  python tools/shadowlint.py --no-baseline        # include grandfathered
+  python tools/shadowlint.py --write-baseline     # grandfather the rest
+
+Exit status: 0 when no non-baselined findings, 1 otherwise (2 on a
+parse/usage error).  CI wiring: tools/tpu_watch.py runs the JSON form as
+a capture stage; ``bench.py --lint-smoke`` is the schema'd smoke gate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+DEFAULT_SCOPE = ("shadow_tpu", "tools", "bench.py")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*", metavar="PATH",
+                    help=f"files/dirs to lint (default: {' '.join(DEFAULT_SCOPE)})")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--select", action="append", metavar="STL0xx",
+                    help="restrict to these rule codes (repeatable)")
+    ap.add_argument("--baseline", default=None, metavar="FILE",
+                    help="baseline file (default: <repo>/.shadowlint_baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline: report grandfathered findings too")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write every current finding to the baseline file and exit 0")
+    ap.add_argument("-q", "--quiet", action="store_true",
+                    help="suppress the per-finding lines (summary only)")
+    args = ap.parse_args(argv)
+
+    from shadow_tpu.analysis import linter
+
+    paths = args.paths or [os.path.join(_REPO, p) for p in DEFAULT_SCOPE]
+    select = (
+        {c.strip().upper() for c in args.select} if args.select else None
+    )
+    if select is not None:
+        from shadow_tpu.analysis.rules import RULE_INDEX
+
+        unknown = select - set(RULE_INDEX)
+        if unknown:
+            print(f"unknown rule code(s): {sorted(unknown)}", file=sys.stderr)
+            return 2
+
+    try:
+        findings = linter.lint_paths(paths, _REPO, select=select)
+    except (SyntaxError, OSError) as e:
+        print(f"shadowlint: {e}", file=sys.stderr)
+        return 2
+
+    baseline_path = args.baseline or os.path.join(_REPO, linter.BASELINE_NAME)
+    if args.write_baseline:
+        doc = linter.write_baseline(findings, baseline_path)
+        print(
+            f"wrote {len(doc['entries'])} baseline entr"
+            f"{'y' if len(doc['entries']) == 1 else 'ies'} to {baseline_path}"
+        )
+        return 0
+
+    baseline = (
+        {} if args.no_baseline else linter.load_baseline(baseline_path)
+    )
+    new, old = linter.split_baselined(findings, baseline)
+    scanned = list(linter.iter_python_files(paths))
+    doc = linter.findings_doc(new, old, scanned)
+
+    if args.format == "json":
+        # one line: tools/tpu_watch.py captures stage output line-wise
+        json.dump(doc, sys.stdout)
+        sys.stdout.write("\n")
+    else:
+        if not args.quiet:
+            for f in new:
+                print(f.render())
+        print(
+            f"shadowlint: {len(new)} finding(s), "
+            f"{len(old)} grandfathered, {len(scanned)} file(s) scanned"
+        )
+    return 0 if not new else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

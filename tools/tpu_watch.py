@@ -42,6 +42,12 @@ SLEEP_S = 240.0
 # thing captured"), then scale rows, then islands-gated sweeps, then the
 # managed-plane rows.
 STAGES = [
+    # static-analysis gate first: pure CPU (AST walk + one tiny compile),
+    # so it lands a row even while the accelerator is still flaky, and
+    # every later capture runs against a lint-clean tree
+    ("lint_smoke", [PY, "bench.py", "--lint-smoke"], False, 1800),
+    ("shadowlint_json", [PY, "tools/shadowlint.py", "--format", "json"],
+     False, 600),
     ("phold_16k", [PY, "bench.py"], False, 5400),
     ("audit_smoke", [PY, "bench.py", "--audit-smoke"], False, 7200),
     ("resilience_smoke", [PY, "bench.py", "--resilience-smoke"],

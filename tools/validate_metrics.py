@@ -31,6 +31,10 @@ def main(argv=None) -> int:
                     help="metrics documents written by --metrics-out")
     ap.add_argument("-q", "--quiet", action="store_true",
                     help="suppress per-file ok lines (errors still print)")
+    ap.add_argument("--strict-namespaces", action="store_true",
+                    help="additionally require every dotted metric key to "
+                         "live in KNOWN_METRIC_NAMESPACES (obs/metrics.py) "
+                         "— the runtime twin of shadowlint STL008")
     args = ap.parse_args(argv)
 
     from shadow_tpu.obs.metrics import validate_metrics_doc
@@ -40,7 +44,9 @@ def main(argv=None) -> int:
         try:
             with open(path) as f:
                 doc = json.load(f)
-            validate_metrics_doc(doc)
+            validate_metrics_doc(
+                doc, strict_namespaces=args.strict_namespaces
+            )
         except (OSError, ValueError) as e:
             print(f"{path}: INVALID: {e}", file=sys.stderr)
             rc = 1
