@@ -145,17 +145,19 @@ def _with_backend_retry(fn, *args, **kw):
 
 def _enable_compile_cache():
     """Persistent XLA compile cache: the staged configs compile multi-minute
-    programs; cache them next to the repo so reruns start in seconds."""
-    import jax
+    programs; cache them so reruns start in seconds. The root is SHARED
+    with the serve daemon's AOT kernel cache (shadow_tpu/serve/kcache.py
+    cache_root: $SHADOW_TPU_CACHE_DIR, else .jax_cache next to the repo),
+    so daemon and bench warm each other. Corrupt/zero-length entries —
+    the residue of a process killed mid-write — are evicted up front
+    instead of letting JAX raise when it deserializes one mid-run."""
+    from shadow_tpu.serve.kcache import enable_xla_cache
 
-    cache = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
-    # Create the directory up front: PJRT's lazy mkdir races when several
-    # bench processes (or a bench and a test run) cold-start on a fresh
-    # checkout at once.
-    os.makedirs(cache, exist_ok=True)
-    jax.config.update("jax_compilation_cache_dir", cache)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
-    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    cache, evicted = enable_xla_cache()
+    if evicted:
+        print(f"# compile cache: evicted {evicted} corrupt entr"
+              f"{'y' if evicted == 1 else 'ies'} from {cache}",
+              file=sys.stderr)
 
 
 _enable_compile_cache()
@@ -953,6 +955,130 @@ def stage_resilience_smoke(num_hosts: int = 1024, msgload: int = 2,
     }
 
 
+_SERVE_SMOKE_SWEEP = {
+    "sweep": {
+        "name": "serve-smoke",
+        "lanes": 2,
+        "matrix": {
+            "general.seed": [11, 12, 13, 14],
+            "general.stop_time": ["900 ms", "1.4 s"],
+        },
+    },
+    "fleet": {"windows_per_dispatch": 2},
+}
+
+
+def stage_serve_smoke(num_hosts: int = 64, msgload: int = 2):
+    """Sim-as-a-service gate (ISSUE 8 acceptance): submit a sweep to the
+    daemon, SIGKILL it mid-sweep, restart it with the same state dir,
+    and require (a) the journal-replayed sweep to finish with per-job
+    audit digest chains bit-identical (and identically ordered) to an
+    uninterrupted in-process fleet run, and (b) the restarted daemon to
+    perform ZERO window-kernel Python traces — every fleet shape binds
+    from the AOT cache the first incarnation exported. Writes the
+    daemon's schema-v7 serve.* metrics document as the stage artifact.
+    CPU-deterministic (the kill is wall-clock-timed but the chains are
+    virtual-time functions, so WHERE it lands never changes the bar)."""
+    import tempfile
+
+    from shadow_tpu.fleet import build_fleet, load_sweep
+    from shadow_tpu.obs import metrics as obs_metrics
+    from shadow_tpu.serve.client import ServeClient, ServeClientError
+
+    doc = {
+        **_fleet_smoke_job(seed=1, stop_s=1.0, num_hosts=num_hosts,
+                           msgload=msgload),
+        **{k: json.loads(json.dumps(v))
+           for k, v in _SERVE_SMOKE_SWEEP.items()},
+    }
+
+    with tempfile.TemporaryDirectory(prefix="serve_smoke_") as td:
+        state_dir = os.path.join(td, "state")
+        cache_dir = os.path.join(td, "cache")  # fresh: cold → warm is real
+        sock = os.path.join(state_dir, "serve.sock")
+        env = {**os.environ, "SHADOW_TPU_CACHE_DIR": cache_dir}
+
+        def start():
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "shadow_tpu", "serve",
+                 "--state-dir", state_dir,
+                 "--checkpoint-every-dispatches", "1"],
+                env=env, cwd=_REPO,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            )
+            client = ServeClient(sock, timeout=30)
+            client.wait_ready(timeout_s=120)
+            return proc, client
+
+        t0 = time.perf_counter()
+        proc, client = start()
+        sid = client.submit(doc)["id"]
+        killed_at = None
+        while True:
+            info = client.sweep(sid)
+            progress = info.get("progress") or {}
+            if info["status"] in ("done", "failed"):
+                break  # too fast to kill mid-run; gate still meaningful
+            if progress.get("jobs_done", 0) >= 2:
+                killed_at = dict(progress)
+                break
+            time.sleep(0.1)
+        proc.kill()
+        proc.wait()
+
+        proc, client = start()
+        info = client.wait(sid, timeout_s=600)
+        stats = info["stats"] or {}
+        metrics_doc = client.metrics()
+        try:
+            client.drain()
+        except ServeClientError:
+            pass
+        proc.wait(timeout=60)
+        wall = time.perf_counter() - t0
+
+    metrics_path = os.path.join(_REPO, "serve_smoke.metrics.json")
+    with open(metrics_path, "w") as f:
+        json.dump(metrics_doc, f, indent=1)
+        f.write("\n")
+    obs_metrics.validate_metrics_doc(metrics_doc)
+
+    # uninterrupted reference: the same sweep as one in-process fleet
+    jobs, _ = load_sweep(json.loads(json.dumps(doc)))
+    ref = build_fleet(jobs, lanes=2, windows_per_dispatch=2)
+    ref.run()
+    ref_rows = ref.results()
+    rows = info.get("results") or []
+    chains_equal = (
+        [r["name"] for r in rows] == [r["name"] for r in ref_rows]
+        and [r.get("audit", {}).get("chain") for r in rows]
+        == [r["audit"]["chain"] for r in ref_rows]
+    )
+    zero_recompiles = stats.get("kernel_traces", -1) == 0
+    serve_counters = {
+        k: v for k, v in metrics_doc["counters"].items()
+        if k.startswith("serve.")
+    }
+    return {
+        "stage": "serve_smoke",
+        "hosts": num_hosts,
+        "jobs": len(ref_rows),
+        "killed_at": killed_at,
+        "status": info["status"],
+        "wall_s": round(wall, 3),
+        "chains_equal": chains_equal,
+        "restart_kernel_traces": stats.get("kernel_traces"),
+        "serve": serve_counters,
+        "metrics_out": os.path.relpath(metrics_path, _REPO),
+        "gate_chains": bool(chains_equal and info["status"] == "done"),
+        "gate_zero_recompiles": bool(zero_recompiles),
+        "gate": bool(
+            chains_equal and info["status"] == "done" and zero_recompiles
+            and killed_at is not None
+        ),
+    }
+
+
 def stage_lint_smoke():
     """shadowlint gate (ISSUE 7 acceptance): the STL0xx AST rule set over
     the default scope must report ZERO non-baselined violations, and a
@@ -1001,6 +1127,14 @@ def main():
         # AST + one tiny CPU compile — no accelerator, so no backend wait.
         os.environ.setdefault("SHADOW_TPU_BENCH_ALLOW_CPU", "1")
         print(json.dumps(stage_lint_smoke()), flush=True)
+        return
+    if "--serve-smoke" in sys.argv:
+        # sim-as-a-service gate: submit → SIGKILL the daemon → restart →
+        # journal replay finishes the sweep with bit-identical audit
+        # chains and ZERO kernel retraces off the warm AOT cache. CPU-
+        # deterministic by design, so no backend wait.
+        os.environ.setdefault("SHADOW_TPU_BENCH_ALLOW_CPU", "1")
+        print(json.dumps(stage_serve_smoke()), flush=True)
         return
     if "--resilience-smoke" in sys.argv:
         # backend-survivability gate: deterministic kill_backend → drain /

@@ -451,6 +451,13 @@ def main(argv: list[str] | None = None) -> int:
         from shadow_tpu.fleet.cli import main as sweep_main
 
         return sweep_main(argv[1:])
+    if argv and argv[0] == "serve":
+        # crash-safe sim-as-a-service daemon (shadow_tpu/serve): journaled
+        # sweep queue + AOT kernel cache + graceful drain; operators talk
+        # to it with tools/shadowctl.py — `python -m shadow_tpu serve -h`
+        from shadow_tpu.serve.cli import main as serve_main
+
+        return serve_main(argv[1:])
     args = _build_parser().parse_args(argv)
     from shadow_tpu.core.config import ConfigError, load_config
 

@@ -138,19 +138,31 @@ def save(sim, path: str, extra_meta: dict | None = None) -> None:
 def _open_checkpoint(path: str):
     """np.load with every failure class collapsed to CheckpointError:
     callers (and the resume fallback) see one clean exception type instead
-    of zipfile/KeyError/json internals."""
+    of zipfile/KeyError/json internals. A zero-length or mid-write-
+    truncated file lands here (np.load raises EOFError / BadZipFile on
+    them), as does a file whose bytes parse as a bare .npy array rather
+    than an .npz archive — resume_latest falls back past all of them."""
     try:
-        return np.load(path)
+        z = np.load(path)
     except (zipfile.BadZipFile, zlib.error, OSError, ValueError,
             EOFError) as e:
         raise CheckpointError(f"{path}: unreadable archive: {e}") from e
+    if not isinstance(z, np.lib.npyio.NpzFile):
+        raise CheckpointError(
+            f"{path}: not an .npz archive (loaded as {type(z).__name__}; "
+            f"overwritten or corrupt checkpoint)"
+        )
+    return z
 
 
 def load_meta(path: str) -> dict:
     with _open_checkpoint(path) as z:
         try:
             raw = z["__meta__"]
-        except (KeyError, zipfile.BadZipFile, zlib.error, EOFError) as e:
+        except (KeyError, zipfile.BadZipFile, zlib.error, EOFError,
+                OSError, ValueError) as e:
+            # ValueError covers a torn .npy member header inside a zip
+            # whose directory survived the truncation
             raise CheckpointError(
                 f"{path}: missing or unreadable __meta__ entry"
             ) from e
@@ -247,7 +259,13 @@ def restore(sim, path: str) -> None:
             )
         new_leaves = []
         for key, leaf in pairs:
-            arr = z[key]
+            try:
+                arr = z[key]
+            except (zipfile.BadZipFile, zlib.error, EOFError, OSError,
+                    ValueError, KeyError) as e:
+                raise CheckpointError(
+                    f"{path}: leaf {key} unreadable: {e}"
+                ) from e
             if arr.shape != leaf.shape or arr.dtype != np.asarray(leaf).dtype:
                 raise CheckpointError(
                     f"leaf {key}: checkpoint {arr.shape}/{arr.dtype} vs sim "

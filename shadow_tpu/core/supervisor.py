@@ -113,19 +113,23 @@ def classify_failure(exc: BaseException) -> str:
     return FATAL
 
 
-def _default_probe() -> bool:
-    """One trivial dispatch against the default backend. Real deployments
-    that fear a HANGING (not erroring) backend should pass a subprocess
-    prober (bench.wait_for_backend is one); in-process keeps the library
-    dependency-free."""
+def probe_backend() -> bool:
+    """One trivial dispatch against the default backend — the shared
+    liveness probe: the supervisor's SUSPECT→LOST check and the serve
+    daemon's `/healthz` both use it, so a probe verdict means the same
+    thing everywhere. Real deployments that fear a HANGING (not erroring)
+    backend should pass a subprocess prober (bench.wait_for_backend is
+    one); in-process keeps the library dependency-free."""
     try:
-        import jax
         import jax.numpy as jnp
 
         jnp.zeros((), jnp.int32).block_until_ready()
         return True
     except Exception:
         return False
+
+
+_default_probe = probe_backend  # supervisor-internal historical name
 
 
 class BackendSupervisor:

@@ -213,6 +213,21 @@ def parse_fault_plan(entries: list) -> list[Fault]:
     return out
 
 
+def check_backend_ops(faults: list[Fault]) -> list[Fault]:
+    """Require every injection to be a BACKEND op (kill_backend /
+    stall_backend) — the only class a daemon-level chaos plan may carry:
+    proc/device/file ops are run-scoped and belong in a job's own config
+    (shadow_tpu/serve validates submissions with this)."""
+    for f in faults:
+        if f.op not in BACKEND_OPS:
+            raise FaultPlanError(
+                f"daemon-level fault plans support backend ops only "
+                f"({sorted(BACKEND_OPS)}); {f.op!r} belongs in a job "
+                f"config's faults section"
+            )
+    return faults
+
+
 def load_fault_plan(path: str) -> list[Fault]:
     """Load and validate a fault-plan JSON file."""
     try:

@@ -59,10 +59,11 @@ def save_fleet(fleet, ckpt_dir: str, extra_meta: dict | None = None) -> str:
     distinguishable from a scheduled checkpoint."""
     os.makedirs(ckpt_dir, exist_ok=True)
     jobs = []
-    for rec in fleet.sched.records:
+    for rec in sorted(fleet.sched.records, key=lambda r: r.submit_idx):
         entry = {
             "spec": rec.spec.to_json(),
             "status": rec.status,
+            "order": rec.submit_idx,
             "summary": rec.summary(),
         }
         if rec.status == sched_mod.RUNNING and rec.lane is not None:
@@ -131,14 +132,18 @@ def load_manifest(ckpt_dir: str) -> dict:
     return doc
 
 
-def resume_fleet(ckpt_dir: str, **fleet_kw):
+def resume_fleet(ckpt_dir: str, lanes: int | None = None, **fleet_kw):
     """Rebuild a FleetSimulation from a fleet checkpoint directory.
 
     Job order in the rebuilt fleet: formerly-running jobs first (their
     lanes restore from the saved slices), then the still-queued jobs;
     completed jobs are carried as terminal records with their recorded
     results. Slice restores go through core/checkpoint.restore, so a
-    corrupt slice fails with a clean CheckpointError naming the job."""
+    corrupt slice fails with a clean CheckpointError naming the job.
+
+    `lanes` overrides the manifest's lane count (the sweep CLI's
+    --lanes; None keeps the recorded width); either way the rebuilt
+    fleet never opens more lanes than it has unfinished jobs."""
     from shadow_tpu.fleet.engine import FleetSimulation, _align_gear, \
         _build_solo
 
@@ -156,15 +161,24 @@ def resume_fleet(ckpt_dir: str, **fleet_kw):
             f"nothing to resume"
         )
     specs = [JobSpec.from_json(e["spec"]) for e in unfinished + terminal]
-    lanes = min(int(doc["lanes"]), len(unfinished))
+    want = int(doc["lanes"]) if lanes is None else int(lanes)
+    lanes = min(want, len(unfinished))
     fleet_kw.setdefault("checkpoint_dir", ckpt_dir)
     fleet = FleetSimulation(specs, lanes=lanes, **fleet_kw)
     fleet._ckpt_next_t = int(doc.get("ckpt_next_t", fleet._ckpt_next_t))
 
+    # every record reports at its ORIGINAL submission position, even
+    # though the rebuilt internal order is running-jobs-first (results()
+    # sorts by submit_idx, so resumed results match an uninterrupted run
+    # row for row)
+    by_name = {r.name: r for r in fleet.sched.records}
+    for i, e in enumerate(doc["jobs"]):
+        rec = by_name[e["spec"]["name"]]
+        rec.submit_idx = int(e.get("order", i))
+
     # restore formerly-running lanes (the constructor admitted the first
     # `lanes` unfinished jobs in order, so each running entry's record is
     # already in a lane — find it and overwrite the fresh state)
-    by_name = {r.name: r for r in fleet.sched.records}
     for e in running:
         rec = by_name[e["spec"]["name"]]
         if rec.lane is None:
@@ -192,4 +206,9 @@ def resume_fleet(ckpt_dir: str, **fleet_kw):
         rec.wall_s = s.get("wall_s", 0.0)
         rec.counters = dict(s.get("counters", {}))
         rec.faults = dict(s.get("faults", {}))
+        # the job's determinism-audit chain must survive the restart:
+        # the serve daemon's crash-recovery bar is chain equality with an
+        # uninterrupted run ACROSS every job, including ones that
+        # finished before the crash
+        rec.audit = dict(s.get("audit", {}))
     return fleet

@@ -50,7 +50,7 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--metrics-out", metavar="PATH",
-        help="write the fleet metrics document (schema v6: fleet.jobs[*] "
+        help="write the fleet metrics document (schema v7: fleet.jobs[*] "
              "per-job rows incl. audit.chain digests) as JSON",
     )
     p.add_argument(

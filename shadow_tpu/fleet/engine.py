@@ -143,6 +143,12 @@ class FleetSimulation:
         self._cpu_failover = False
         self._admission_paused = False
         self._backend_faults: list = []
+        # AOT kernel cache (serve/kcache.py): when attached, fleet window
+        # kernels bind from serialized exports on disk — a warm restart
+        # re-binds every known shape with ZERO Python traces
+        # (kernel_traces stays 0, the serve-smoke gated property).
+        self.kernel_cache = None
+        self._kc_digest = None
         # Telemetry session (obs/metrics.ObsSession): attached by the
         # sweep CLI (--metrics-out/--trace-out) via attach_obs. Fleet
         # traces give each lane its own tid (lane index + 1; tid 0 is the
@@ -281,6 +287,73 @@ class FleetSimulation:
 
         return self._jit(counted)
 
+    def attach_kernel_cache(self, kcache) -> None:
+        """Bind an AOT kernel cache (serve/kcache.py) BEFORE the first
+        dispatch: subsequent kernel binds consult the cache and only
+        trace on a miss (exporting + persisting the artifact so the next
+        process hits). The cache key folds in the template job's kernel-
+        shaping config digest, so kernel-compatible sweeps share entries
+        while any shape/handler change misses safely."""
+        from shadow_tpu.serve.kcache import kernel_config_digest
+
+        self.kernel_cache = kcache
+        self._kc_digest = kernel_config_digest(
+            self.sched.records[0].spec.config
+        )
+        # re-bind the active gear through the cache (build bound the jit
+        # path before the cache existed; nothing has been traced yet when
+        # this is called pre-dispatch, so the swap is free)
+        self._gear_fns = {}
+        self._bind_gear()
+
+    def _kernel(self, tag: str, fn):
+        """Cache-aware kernel bind: with no cache (or during CPU
+        failover, whose re-lowered kernels are transient) this is plain
+        counted jit; with one, the first call looks the export up by
+        (config digest, tag, arg avals) and only traces on a miss.
+
+        Export serialization cannot carry the repo's custom pytree nodes
+        (SimState/EventPool/...), so the exported artifact is the LEAF-
+        FLATTENED kernel: flat arrays in, flat arrays out. No treedef
+        needs to survive on disk because every fleet kernel returns
+        (state', *scalar_extras) where state' has exactly the INPUT
+        state's structure — the call-time wrapper re-folds the leading
+        leaves with the live treedef and passes the extras through."""
+        kc = self.kernel_cache
+        if kc is None or self._cpu_failover:
+            return self._counted(fn)
+        holder: dict = {}
+
+        def call(*args):
+            flat, in_tree = jax.tree_util.tree_flatten(args)
+            bound = holder.get("fn")
+            if bound is None:
+                state_def = jax.tree_util.tree_structure(args[0])
+                key = kc.key(self._kc_digest, tag, flat)
+                ex = kc.get(key)
+                if ex is None:
+
+                    def flat_fn(*leaves):
+                        out = fn(*jax.tree_util.tree_unflatten(
+                            in_tree, leaves
+                        ))
+                        return tuple(jax.tree_util.tree_leaves(out))
+
+                    self.kernel_traces += 1
+                    ex = kc.export_and_put(key, flat_fn, flat)
+                jf = jax.jit(ex.call)
+                n = state_def.num_leaves
+
+                def bound(leaves, _jf=jf, _n=n, _sd=state_def):
+                    out = _jf(*leaves)
+                    st = jax.tree_util.tree_unflatten(_sd, out[:_n])
+                    return (st, *out[_n:])
+
+                holder["fn"] = bound
+            return holder["fn"](flat)
+
+        return call
+
     def _jit(self, fn):
         """jit honoring supervisor CPU failover: while the accelerator is
         gone, fleet kernels re-lower on the CPU backend and the sweep
@@ -311,7 +384,7 @@ class FleetSimulation:
             inner = engine_mod.make_run_to(step, spec.hi)
         run_to = jax.vmap(inner, in_axes=(0, 0, 0, 0, None))
         return {
-            "run_to": self._counted(run_to),
+            "run_to": self._kernel(f"run_to:g{spec.level}", run_to),
             "attempt": None,  # compiled lazily by run_optimistic
         }
 
@@ -343,7 +416,7 @@ class FleetSimulation:
             )
         att = jax.vmap(inner, in_axes=(0, 0, 0, 0))
         self._attempt = self._gear_fns[spec.level]["attempt"] = \
-            self._counted(att)
+            self._kernel(f"attempt:g{spec.level}", att)
 
     def _shift_gear(self, level: int) -> None:
         """Move EVERY lane's pool to `level`'s capacity (one batched
@@ -1067,11 +1140,14 @@ class FleetSimulation:
 
     def results(self) -> list[dict]:
         """Per-job result rows (metrics schema v4 `fleet.jobs[*]`), in
-        job declaration order."""
-        return [r.summary() for r in self.sched.records]
+        job DECLARATION order — stable across checkpoint/resume even
+        though a resumed fleet's internal records list is rebuilt
+        running-jobs-first (each record carries its original
+        submit_idx)."""
+        return [r.summary() for r in self.records()]
 
     def records(self) -> list[JobRecord]:
-        return list(self.sched.records)
+        return sorted(self.sched.records, key=lambda r: r.submit_idx)
 
     def fleet_stats(self) -> dict:
         spec = self._ladder[self._gear]

@@ -38,6 +38,10 @@ class JobRecord:
     status: str = QUEUED
     lane: Optional[int] = None
     reason: str = ""  # failure/timeout detail
+    # original submission position: results/manifests report in THIS
+    # order even when a resumed fleet's internal records list is
+    # rebuilt running-jobs-first (fleet/checkpoint.resume_fleet)
+    submit_idx: int = -1
     admitted_wall: Optional[float] = None
     wall_s: float = 0.0
     # harvested at completion (device reads at the handoff boundary):
@@ -100,7 +104,9 @@ class FleetScheduler:
     def __init__(self, jobs: list[JobSpec], lanes: int):
         if lanes < 1:
             raise ValueError("fleet needs at least one lane")
-        self.records = [JobRecord(spec=j) for j in jobs]
+        self.records = [
+            JobRecord(spec=j, submit_idx=i) for i, j in enumerate(jobs)
+        ]
         self._by_name = {r.name: r for r in self.records}
         if len(self._by_name) != len(self.records):
             raise ValueError("duplicate job names in fleet")
@@ -117,7 +123,25 @@ class FleetScheduler:
 
     # -- queue --
 
+    def submit(self, spec: JobSpec) -> JobRecord:
+        """Append a new job to the queue tail (daemon-plane dynamic
+        submission, shadow_tpu/serve). Submission order IS the records
+        order, so FIFO admission needs no extra bookkeeping."""
+        if spec.name in self._by_name:
+            raise ValueError(f"duplicate job name {spec.name!r}")
+        rec = JobRecord(
+            spec=spec,
+            submit_idx=1 + max(r.submit_idx for r in self.records),
+        )
+        self.records.append(rec)
+        self._by_name[rec.name] = rec
+        return rec
+
     def pending(self) -> list[JobRecord]:
+        """QUEUED records in admission (= submission) order. Requeued
+        jobs appear at their ORIGINAL position, never at the tail: the
+        records list is submission-ordered and the cursor rewinds on
+        requeue, so this scan is the FIFO truth."""
         return [r for r in self.records[self._next:] if r.status == QUEUED]
 
     def peek(self) -> Optional[JobRecord]:
@@ -170,8 +194,12 @@ class FleetScheduler:
         """Return a RUNNING job to the queue (backend drain: the lane's
         progress survives in the drain checkpoint's per-job slice, so the
         resumed sweep restores it rather than re-running from scratch).
-        The queue cursor rewinds so the job re-admits in declaration
-        order."""
+        The queue cursor rewinds to the job's ORIGINAL submission index
+        so it re-admits in FIFO order, ahead of every later submission —
+        never at the queue tail. The rewind is identity-based: JobRecord
+        is a value-comparing dataclass, and `list.index` under value
+        equality could match a different record (or trip over harvested
+        array payloads), silently mis-positioning the cursor."""
         record = self.lane_job[lane]
         if record is None:
             raise RuntimeError(f"lane {lane} is already free")
@@ -181,7 +209,10 @@ class FleetScheduler:
         record.admitted_wall = None
         self.lane_job[lane] = None
         self.jobs_requeued += 1
-        self._next = min(self._next, self.records.index(record))
+        idx = next(
+            i for i, r in enumerate(self.records) if r is record
+        )
+        self._next = min(self._next, idx)
         return record
 
     # -- introspection --

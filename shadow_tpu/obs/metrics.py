@@ -25,13 +25,16 @@ import numpy as np
 
 from shadow_tpu.obs import counters as obs_counters
 
-# v6: resilience.* backend-supervision namespace (core/supervisor.py:
-# retries, backoffs, stalls, drains, failovers, downtime_ns, fleet lane
-# reclaims); v5: audit.* determinism-audit namespace (digest chain,
-# obs/audit.py) + optional per-job `audit` sub-object on fleet.jobs[*]
-# rows; v4: optional top-level `fleet` section (fleet.jobs[*] per-job
-# rows) + fleet.* counters; v3: faults.* recovery counters
-SCHEMA_VERSION = 6
+# v7: serve.* sim-as-a-service namespace (shadow_tpu/serve: journal
+# records/replays, admission sheds, kernel-cache hits/misses/evictions,
+# drains); v6: resilience.* backend-supervision namespace
+# (core/supervisor.py: retries, backoffs, stalls, drains, failovers,
+# downtime_ns, fleet lane reclaims); v5: audit.* determinism-audit
+# namespace (digest chain, obs/audit.py) + optional per-job `audit`
+# sub-object on fleet.jobs[*] rows; v4: optional top-level `fleet`
+# section (fleet.jobs[*] per-job rows) + fleet.* counters; v3: faults.*
+# recovery counters
+SCHEMA_VERSION = 7
 DOC_KIND = "shadow_tpu.metrics"
 
 # metrics-doc `fleet.jobs[*]` rows must carry at least these keys
@@ -60,6 +63,7 @@ KNOWN_METRIC_NAMESPACES = frozenset({
     "fleet",       # scenario-fleet scheduler plane (schema v4)
     "audit",       # determinism-audit plane (schema v5)
     "resilience",  # backend supervision (schema v6)
+    "serve",       # sim-as-a-service daemon plane (schema v7)
     "sim",         # build-level gauges (num_hosts, runahead)
     "bench",       # bench.py gate-local rows
 })
@@ -188,6 +192,9 @@ def validate_metrics_doc(doc: dict, strict_namespaces: bool = False) -> None:
         if k.startswith("resilience.") and v < 0:
             # schema v6: backend-supervision counters are monotonic tallies
             raise ValueError(f"resilience counter {k!r} must be >= 0, got {v}")
+        if k.startswith("serve.") and v < 0:
+            # schema v7: daemon-plane counters are monotonic tallies too
+            raise ValueError(f"serve counter {k!r} must be >= 0, got {v}")
     for k, v in doc["gauges"].items():
         if not isinstance(v, (int, float)) or isinstance(v, bool):
             raise ValueError(f"gauge {k!r} must be a number, got {v!r}")

@@ -1,0 +1,27 @@
+"""Sim-as-a-service: the crash-safe fleet daemon (docs/serving.md).
+
+- `serve.daemon`   the resident multi-tenant daemon (journaled queue,
+                   graceful drain, admission quotas, /healthz)
+- `serve.journal`  write-ahead job journal (CRC-framed, fsync'd, replay)
+- `serve.kcache`   AOT window-kernel cache (jax.export artifacts keyed
+                   by config digest / gear / avals / jaxlib version)
+- `serve.client`   HTTP-over-unix-socket client (tools/shadowctl.py)
+"""
+
+from shadow_tpu.serve.journal import Journal, JournalError, JournalState
+from shadow_tpu.serve.kcache import (
+    KernelCache,
+    cache_root,
+    kernel_config_digest,
+    sweep_corrupt_entries,
+)
+
+__all__ = [
+    "Journal",
+    "JournalError",
+    "JournalState",
+    "KernelCache",
+    "cache_root",
+    "kernel_config_digest",
+    "sweep_corrupt_entries",
+]

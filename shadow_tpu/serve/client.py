@@ -1,0 +1,152 @@
+"""HTTP-over-unix-socket client for the serve daemon.
+
+`tools/shadowctl.py` wraps this for operators; tests and bench.py's
+--serve-smoke gate use it directly. Every method returns the decoded
+JSON body; `submit` surfaces admission backpressure (HTTP 429) as a
+`Shed` exception carrying the daemon's Retry-After hint rather than a
+silent retry loop — the CALLER owns the retry policy.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import socket
+import time
+
+
+class ServeClientError(RuntimeError):
+    pass
+
+
+class Shed(ServeClientError):
+    """Admission refused the sweep (quota / queue depth / draining)."""
+
+    def __init__(self, body: dict):
+        super().__init__(
+            f"submission shed ({body.get('shed')}); retry after "
+            f"{body.get('retry_after_s')}s"
+        )
+        self.body = body
+        self.retry_after_s = float(body.get("retry_after_s") or 1)
+
+
+class _UnixHTTPConnection(http.client.HTTPConnection):
+    def __init__(self, path: str, timeout: float):
+        super().__init__("localhost", timeout=timeout)
+        self._unix_path = path
+
+    def connect(self):
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.settimeout(self.timeout)
+        s.connect(self._unix_path)
+        self.sock = s
+
+
+class ServeClient:
+    def __init__(self, socket_path: str, timeout: float = 60.0):
+        self.socket_path = socket_path
+        self.timeout = float(timeout)
+
+    def request(self, method: str, path: str,
+                body: dict | None = None) -> tuple[int, dict]:
+        conn = _UnixHTTPConnection(self.socket_path, self.timeout)
+        try:
+            payload = json.dumps(body).encode() if body is not None else None
+            headers = {"Content-Type": "application/json"} if payload else {}
+            conn.request(method, path, body=payload, headers=headers)
+            resp = conn.getresponse()
+            raw = resp.read()
+            try:
+                doc = json.loads(raw.decode() or "{}")
+            except (UnicodeDecodeError, json.JSONDecodeError) as e:
+                raise ServeClientError(
+                    f"{method} {path}: non-JSON response ({raw[:120]!r})"
+                ) from e
+            return resp.status, doc
+        except (ConnectionError, socket.timeout, FileNotFoundError,
+                OSError) as e:
+            raise ServeClientError(
+                f"{method} {path}: daemon unreachable at "
+                f"{self.socket_path}: {e}"
+            ) from e
+        finally:
+            conn.close()
+
+    # -- typed surface --
+
+    def wait_ready(self, timeout_s: float = 30.0,
+                   poll_s: float = 0.1) -> dict:
+        """Poll /healthz until the daemon answers (a freshly restarted
+        daemon may still be binding; a SIGKILLed one leaves a stale
+        socket file, so existence of the path proves nothing). Returns
+        the first health document."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            try:
+                return self.health()
+            except ServeClientError:
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(poll_s)
+
+    def health(self) -> dict:
+        status, doc = self.request("GET", "/healthz")
+        if status != 200:
+            raise ServeClientError(f"/healthz returned {status}: {doc}")
+        return doc
+
+    def metrics(self) -> dict:
+        status, doc = self.request("GET", "/metricz")
+        if status != 200:
+            raise ServeClientError(f"/metricz returned {status}: {doc}")
+        return doc
+
+    def submit(self, sweep_doc: dict, tenant: str = "default",
+               backend_faults: list | None = None) -> dict:
+        payload: dict = {"sweep": sweep_doc, "tenant": tenant}
+        if backend_faults:
+            payload["backend_faults"] = backend_faults
+        status, doc = self.request("POST", "/v1/sweeps", payload)
+        if status == 429:
+            raise Shed(doc)
+        if status != 200:
+            raise ServeClientError(
+                f"submit refused ({status}): {doc.get('error', doc)}"
+            )
+        return doc
+
+    def sweeps(self) -> list[dict]:
+        status, doc = self.request("GET", "/v1/sweeps")
+        if status != 200:
+            raise ServeClientError(f"/v1/sweeps returned {status}")
+        return doc["sweeps"]
+
+    def sweep(self, sid: str) -> dict:
+        status, doc = self.request("GET", f"/v1/sweeps/{sid}")
+        if status == 404:
+            raise ServeClientError(doc.get("error", f"no sweep {sid}"))
+        return doc
+
+    def drain(self) -> dict:
+        status, doc = self.request("POST", "/v1/drain", {})
+        if status != 200:
+            raise ServeClientError(f"/v1/drain returned {status}")
+        return doc
+
+    def wait(self, sid: str, timeout_s: float = 600.0,
+             poll_s: float = 0.25) -> dict:
+        """Block until the sweep settles (done/failed); returns its final
+        info. Raises ServeClientError on timeout — never spins forever
+        against a wedged daemon."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            info = self.sweep(sid)
+            if info["status"] in ("done", "failed"):
+                return info
+            if time.monotonic() >= deadline:
+                raise ServeClientError(
+                    f"sweep {sid} still {info['status']!r} after "
+                    f"{timeout_s:.0f}s"
+                )
+            time.sleep(poll_s)

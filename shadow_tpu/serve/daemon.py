@@ -1,0 +1,616 @@
+"""The sim-as-a-service daemon: a crash-safe, multi-tenant fleet host.
+
+``python -m shadow_tpu serve --state-dir DIR`` starts a resident process
+that accepts sweep jobs over a local HTTP-over-unix-socket API
+(tools/shadowctl.py is the operator client) and owns a fleet scheduler
+across restarts. Three mechanisms make its own death a non-event:
+
+1. **Write-ahead journal** (serve/journal.py): every scheduler
+   transition — submit, admit, drain, requeue, complete — is fsync'd to
+   an append-only CRC-framed log before it takes effect. `kill -9` the
+   daemon, restart it, and replay re-queues unfinished sweeps and
+   re-attaches in-flight fleets via their checkpoint directories; the
+   finished sweep's per-job audit digest chains are bit-identical to an
+   uninterrupted run (tests/test_serve.py, bench.py --serve-smoke).
+
+2. **AOT kernel cache** (serve/kcache.py): fleet window kernels bind
+   from serialized exports keyed by (config digest, gear, avals, jaxlib
+   version). A warm restart re-binds every known fleet shape with ZERO
+   Python traces — `kernel_traces` stays 0 — and a corrupt or
+   version-skewed entry is evicted and recompiled, never trusted.
+
+3. **Graceful degradation**: SIGTERM drains the running fleet to its
+   checkpoint (one dispatch of latency, then a clean exit whose journal
+   DRAIN record lets the next boot resume); admission applies per-tenant
+   quotas and queue-depth backpressure (HTTP 429 with a Retry-After
+   derived from scheduler occupancy: queue depth x the EWMA sweep wall
+   time); `/healthz` reports backend liveness (the supervisor probe of
+   core/supervisor.py — the cs/0409032 bounded-lag signal), queue depth,
+   and journal lag. Backend loss mid-sweep rides the PR-6 supervision
+   plane: the fleet drains, the sweep is journaled REQUEUE, and the
+   worker retries it — `kill_backend` fault plans submitted with a sweep
+   drive this end to end in chaos tests.
+
+Metrics ride the schema-v7 `serve.*` namespace (obs/metrics.py), dumped
+to `<state-dir>/serve.metrics.json` at every sweep settlement.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import socketserver
+import threading
+import time
+from http.server import BaseHTTPRequestHandler
+
+from shadow_tpu.serve import journal as journal_mod
+from shadow_tpu.serve.kcache import KernelCache, cache_root
+
+JOURNAL_NAME = "journal.wal"
+METRICS_NAME = "serve.metrics.json"
+
+# EWMA seed for the Retry-After estimate before any sweep has finished
+_DEFAULT_SWEEP_WALL_S = 30.0
+_EWMA_ALPHA = 0.3
+
+
+class ServeError(ValueError):
+    pass
+
+
+class ServeOptions:
+    """Daemon configuration (CLI flags / ServeOptions kwargs)."""
+
+    def __init__(
+        self,
+        state_dir: str,
+        socket_path: str | None = None,
+        lanes: int | None = None,
+        max_queue_depth: int = 16,
+        default_quota: int = 8,
+        tenant_quotas: dict[str, int] | None = None,
+        checkpoint_every_dispatches: int = 4,
+        cache_dir: str | None = None,
+    ):
+        self.state_dir = os.path.abspath(state_dir)
+        self.socket_path = socket_path or os.path.join(
+            self.state_dir, "serve.sock"
+        )
+        self.lanes = lanes
+        self.max_queue_depth = int(max_queue_depth)
+        self.default_quota = int(default_quota)
+        self.tenant_quotas = dict(tenant_quotas or {})
+        self.checkpoint_every_dispatches = max(
+            1, int(checkpoint_every_dispatches)
+        )
+        self.cache_dir = cache_dir or cache_root()
+
+
+class ShadowDaemon:
+    """One resident daemon: journal + queue + worker + API server."""
+
+    def __init__(self, opts: ServeOptions):
+        self.opts = opts
+        os.makedirs(opts.state_dir, exist_ok=True)
+        self._lock = threading.Lock()
+        self._wake = threading.Condition(self._lock)
+        self._draining = threading.Event()
+        self.journal = journal_mod.Journal(
+            os.path.join(opts.state_dir, JOURNAL_NAME)
+        )
+        self.kcache = KernelCache(opts.cache_dir)
+        self.counters = {
+            "sweeps_submitted": 0,
+            "sweeps_completed": 0,
+            "sweeps_failed": 0,
+            "sweeps_requeued": 0,
+            "sweeps_drained": 0,
+            "jobs_completed": 0,
+            "sheds": 0,
+            "journal_replays": 0,
+            "kernel_traces": 0,
+        }
+        # replay: fold the journal into scheduler-plane truth
+        st = self.journal.state()
+        self.sweeps: dict[str, dict] = {
+            sid: dict(st.sweeps[sid]) for sid in st.order
+        }
+        self._order: list[str] = list(st.order)
+        self._queue: list[str] = [s["id"] for s in st.unfinished()]
+        if self._queue or self.journal.torn_tail_dropped:
+            self.counters["journal_replays"] = 1
+        self._seq = len(self._order)
+        self._running: str | None = None
+        self._avg_sweep_wall_s = _DEFAULT_SWEEP_WALL_S
+        self._server: socketserver.ThreadingMixIn | None = None
+        self._started = threading.Event()
+
+    # ------------------------------------------------------------------
+    # admission (HTTP thread)
+    # ------------------------------------------------------------------
+
+    def _tenant_load(self, tenant: str) -> int:
+        return sum(
+            1 for s in self.sweeps.values()
+            if s["tenant"] == tenant
+            and s["status"] in ("queued", "running", "drained")
+        )
+
+    def retry_after_s(self) -> int:
+        """Backpressure hint: how long until a queue slot likely frees —
+        queue depth (sweeps ahead) x the EWMA completed-sweep wall."""
+        depth = len(self._queue) + (1 if self._running else 0)
+        return max(1, int(round(depth * self._avg_sweep_wall_s)))
+
+    def submit(self, doc: dict, tenant: str = "default",
+               backend_faults: list | None = None) -> dict:
+        """Validate + journal + enqueue one sweep. Raises ServeError
+        (HTTP 400) on a bad document; returns {"shed": ...} (HTTP 429)
+        when admission refuses it."""
+        from shadow_tpu.fleet import SweepError, load_sweep
+
+        with self._lock:
+            if self._draining.is_set():
+                self.counters["sheds"] += 1
+                return {"shed": "draining", "retry_after_s": 30}
+            depth = len(self._queue) + (1 if self._running else 0)
+            if depth >= self.opts.max_queue_depth:
+                self.counters["sheds"] += 1
+                return {
+                    "shed": "queue_full",
+                    "queue_depth": depth,
+                    "retry_after_s": self.retry_after_s(),
+                }
+            quota = self.opts.tenant_quotas.get(
+                tenant, self.opts.default_quota
+            )
+            if self._tenant_load(tenant) >= quota:
+                self.counters["sheds"] += 1
+                return {
+                    "shed": "tenant_quota",
+                    "quota": quota,
+                    "retry_after_s": self.retry_after_s(),
+                }
+        # expansion/validation is pure host work: do it OUTSIDE the lock
+        # (a slow config build must not block /healthz), and fail the
+        # submission here with the offending job named — never mid-fleet
+        try:
+            jobs, _ = load_sweep(doc)
+        except (SweepError, ValueError) as e:
+            raise ServeError(str(e)) from e
+        if backend_faults:
+            from shadow_tpu.faults import plan as plan_mod
+
+            plan_mod.check_backend_ops(
+                plan_mod.parse_fault_plan(backend_faults)
+            )
+        with self._lock:
+            sid = f"s{self._seq:06d}"
+            self._seq += 1
+            self.journal.append(
+                journal_mod.SUBMIT, id=sid, tenant=tenant, doc=doc,
+                backend_faults=backend_faults or [],
+            )
+            self.sweeps[sid] = {
+                "id": sid, "tenant": tenant, "doc": doc,
+                "status": "queued", "ckpt_dir": None, "results": None,
+                "admits": 0, "backend_faults": backend_faults or [],
+            }
+            self._order.append(sid)
+            self._queue.append(sid)
+            self.counters["sweeps_submitted"] += 1
+            self._wake.notify_all()
+            return {"id": sid, "jobs": len(jobs),
+                    "queue_position": len(self._queue) - 1}
+
+    # ------------------------------------------------------------------
+    # introspection (HTTP thread)
+    # ------------------------------------------------------------------
+
+    def health(self) -> dict:
+        from shadow_tpu.core.supervisor import probe_backend
+
+        import jax
+
+        probe_ok = probe_backend()
+        with self._lock:
+            by_status: dict[str, int] = {}
+            for s in self.sweeps.values():
+                by_status[s["status"]] = by_status.get(s["status"], 0) + 1
+            return {
+                "ok": probe_ok and not self._draining.is_set(),
+                "draining": self._draining.is_set(),
+                "backend": {
+                    "platform": jax.default_backend(),
+                    "probe_ok": probe_ok,
+                },
+                "queue": {
+                    "depth": len(self._queue),
+                    "running": self._running,
+                    "sweeps": by_status,
+                },
+                "journal": {
+                    "records": len(self.journal.records),
+                    "lag": self.journal.lag(),
+                    "torn_tail_dropped": self.journal.torn_tail_dropped,
+                },
+                "kcache": self.kcache.stats(),
+                "retry_after_s": self.retry_after_s(),
+            }
+
+    def sweep_info(self, sid: str) -> dict | None:
+        with self._lock:
+            s = self.sweeps.get(sid)
+            return dict(s) if s is not None else None
+
+    def sweep_list(self) -> list[dict]:
+        with self._lock:
+            return [
+                {k: self.sweeps[sid][k]
+                 for k in ("id", "tenant", "status")}
+                | {"progress": self.sweeps[sid].get("progress")}
+                for sid in self._order
+            ]
+
+    def metrics_doc(self) -> dict:
+        from shadow_tpu.obs import metrics as obs_metrics
+
+        reg = obs_metrics.MetricsRegistry()
+        with self._lock:
+            for k, v in self.counters.items():
+                reg.counter_set(f"serve.{k}", int(v))
+            for k, v in self.kcache.stats_counters.items():
+                reg.counter_set(f"serve.kcache_{k}", int(v))
+            reg.counter_set(
+                "serve.journal_records", len(self.journal.records)
+            )
+            reg.gauge_set("serve.queue_depth", len(self._queue))
+            reg.gauge_set("serve.journal_lag", self.journal.lag())
+            reg.gauge_set(
+                "serve.draining", int(self._draining.is_set())
+            )
+            reg.gauge_set("serve.kcache_entries", self.kcache.entries())
+        return reg.to_doc(meta={"daemon": "shadow_tpu serve"})
+
+    def _dump_metrics(self) -> None:
+        doc = self.metrics_doc()
+        path = os.path.join(self.opts.state_dir, METRICS_NAME)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1)
+            f.write("\n")
+        os.replace(tmp, path)
+
+    # ------------------------------------------------------------------
+    # the worker (main thread): one sweep at a time, drained on SIGTERM
+    # ------------------------------------------------------------------
+
+    def drain(self) -> None:
+        """Initiate graceful shutdown: the worker flushes the running
+        fleet to its checkpoint, journals DRAIN, and exits. Runs from
+        signal handlers (which execute ON the worker thread, possibly
+        while it holds the lock), so the wake-up is best-effort
+        non-blocking — the worker polls the event every slice anyway."""
+        self._draining.set()
+        if self._lock.acquire(blocking=False):
+            try:
+                self._wake.notify_all()
+            finally:
+                self._lock.release()
+
+    def _sweep_ckpt_dir(self, sid: str) -> str:
+        return os.path.join(self.opts.state_dir, "sweeps", sid)
+
+    def _build_or_resume(self, s: dict):
+        """A FleetSimulation for the sweep: re-attached from its
+        checkpoint directory when a previous incarnation left slices
+        there, else built fresh from the journaled document."""
+        from shadow_tpu.core.checkpoint import CheckpointError
+        from shadow_tpu.core.config import load_config
+        from shadow_tpu.fleet import build_fleet, load_sweep, resume_fleet
+        from shadow_tpu.fleet import checkpoint as fleet_ckpt
+
+        ckpt_dir = self._sweep_ckpt_dir(s["id"])
+        jobs, sweep_opts = load_sweep(s["doc"])
+        fopts = load_config(jobs[0].config).fleet
+        lanes = self.opts.lanes or (
+            int(sweep_opts["lanes"]) if sweep_opts.get("lanes")
+            else (fopts.lanes or None)
+        )
+        if os.path.exists(os.path.join(ckpt_dir, fleet_ckpt.MANIFEST)):
+            try:
+                fleet = resume_fleet(
+                    ckpt_dir, lanes=lanes,
+                    windows_per_dispatch=fopts.windows_per_dispatch,
+                )
+            except CheckpointError as e:
+                if "already terminal" in str(e):
+                    # the crash landed between the final manifest write
+                    # and the COMPLETE record: the results are all in the
+                    # manifest — settle from it without re-running
+                    doc = fleet_ckpt.load_manifest(ckpt_dir)
+                    return None, doc, fopts
+                raise
+        else:
+            fleet = build_fleet(jobs, lanes=lanes,
+                                windows_per_dispatch=fopts.windows_per_dispatch,
+                                checkpoint_dir=ckpt_dir)
+        fleet.attach_kernel_cache(self.kcache)
+        if s.get("backend_faults"):
+            from shadow_tpu.faults import plan as plan_mod
+
+            fleet.attach_faults(
+                plan_mod.parse_fault_plan(s["backend_faults"])
+            )
+        return fleet, None, fopts
+
+    def _publish_progress(self, sid: str, fleet) -> None:
+        st = fleet.sched.stats()
+        with self._lock:
+            self.sweeps[sid]["progress"] = {
+                "jobs_done": st["jobs_done"],
+                "jobs_running": st["jobs_running"],
+                "jobs_queued": st["jobs_queued"],
+                "kernel_traces": fleet.kernel_traces,
+            }
+
+    def _run_sweep(self, sid: str) -> None:
+        from shadow_tpu.core.checkpoint import CheckpointError
+        from shadow_tpu.core.supervisor import BackendLost
+        from shadow_tpu.fleet import FleetError, SweepError, save_fleet
+
+        s = self.sweeps[sid]
+        ckpt_dir = self._sweep_ckpt_dir(sid)
+        t0 = time.monotonic()
+        with self._lock:
+            self._running = sid
+            s["status"] = "running"
+            s["ckpt_dir"] = ckpt_dir
+            self.journal.append(
+                journal_mod.ADMIT, id=sid, ckpt_dir=ckpt_dir
+            )
+        fleet = None
+        try:
+            fleet, settled_manifest, fopts = self._build_or_resume(s)
+            if fleet is None:
+                self._settle_from_manifest(sid, settled_manifest)
+                return
+            # first manifest BEFORE the first dispatch: a kill landing
+            # anywhere after this point re-attaches instead of rebuilding
+            save_fleet(fleet, ckpt_dir)
+            optimistic = fopts.sync == "optimistic"
+            slices = 0
+            while not fleet.sched.all_terminal():
+                if self._draining.is_set():
+                    save_fleet(fleet, ckpt_dir)
+                    with self._lock:
+                        s["status"] = "drained"
+                        self.journal.append(journal_mod.DRAIN, id=sid)
+                        self.counters["sweeps_drained"] += 1
+                        self._running = None
+                    return
+                if optimistic:
+                    fleet.run_optimistic(max_rounds=1)
+                else:
+                    fleet.run(max_dispatches=1)
+                slices += 1
+                self._publish_progress(sid, fleet)
+                if slices % self.opts.checkpoint_every_dispatches == 0:
+                    save_fleet(fleet, ckpt_dir)
+            save_fleet(fleet, ckpt_dir)
+            self._settle(sid, fleet, time.monotonic() - t0)
+        except BackendLost:
+            # the supervision plane already drained the fleet to its
+            # checkpoint (save_fleet BEFORE requeueing the lanes, so the
+            # slices survive — re-saving here would overwrite them with
+            # sliceless QUEUED rows); hand the sweep back FIFO
+            with self._lock:
+                s["status"] = "queued"
+                self.journal.append(
+                    journal_mod.REQUEUE, id=sid, reason="backend_lost"
+                )
+                self.counters["sweeps_requeued"] += 1
+                self._queue.insert(0, sid)
+                self._running = None
+        except (FleetError, SweepError, CheckpointError, ValueError) as e:
+            with self._lock:
+                s["status"] = "failed"
+                s["results"] = {"error": str(e)}
+                self.journal.append(
+                    journal_mod.COMPLETE, id=sid, ok=False,
+                    results={"error": str(e)},
+                )
+                self.counters["sweeps_failed"] += 1
+                self._running = None
+            self._dump_metrics()
+
+    def _settle(self, sid: str, fleet, wall_s: float) -> None:
+        rows = fleet.results()
+        stats = fleet.fleet_stats()
+        stats["wall_s"] = round(wall_s, 3)
+        stats["resilience"] = fleet.resilience_stats()
+        ok = fleet.ok()
+        with self._lock:
+            s = self.sweeps[sid]
+            s["status"] = "done" if ok else "failed"
+            s["results"] = rows
+            s["stats"] = stats
+            self.journal.append(
+                journal_mod.COMPLETE, id=sid, ok=ok, results=rows,
+                stats=stats,
+            )
+            self.counters["sweeps_completed" if ok else "sweeps_failed"] += 1
+            self.counters["jobs_completed"] += stats["jobs_done"]
+            self.counters["kernel_traces"] += fleet.kernel_traces
+            self._avg_sweep_wall_s = (
+                (1 - _EWMA_ALPHA) * self._avg_sweep_wall_s
+                + _EWMA_ALPHA * max(wall_s, 0.001)
+            )
+            self._running = None
+        self._dump_metrics()
+
+    def _settle_from_manifest(self, sid: str, manifest: dict) -> None:
+        """Every job in the re-attached manifest is already terminal
+        (the previous incarnation died after its final save, before the
+        COMPLETE record): settle from the recorded summaries."""
+        rows = [e["summary"] for e in manifest["jobs"]]
+        ok = all(r["status"] == "done" for r in rows)
+        with self._lock:
+            s = self.sweeps[sid]
+            s["status"] = "done" if ok else "failed"
+            s["results"] = rows
+            s["stats"] = manifest.get("stats")
+            self.journal.append(
+                journal_mod.COMPLETE, id=sid, ok=ok, results=rows,
+                stats=manifest.get("stats"),
+            )
+            self.counters["sweeps_completed" if ok else "sweeps_failed"] += 1
+            self._running = None
+        self._dump_metrics()
+
+    def _worker(self) -> None:
+        while not self._draining.is_set():
+            with self._lock:
+                sid = self._queue.pop(0) if self._queue else None
+                if sid is None:
+                    self._wake.wait(timeout=0.25)
+                    continue
+            self._run_sweep(sid)
+
+    # ------------------------------------------------------------------
+    # the API server (background thread, unix socket)
+    # ------------------------------------------------------------------
+
+    def _make_server(self):
+        daemon = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            # unix sockets have no peer (host, port) pair
+            def address_string(self):  # pragma: no cover - logging only
+                return "unix"
+
+            def log_message(self, *a):  # quiet by default
+                pass
+
+            def _reply(self, code: int, body: dict,
+                       headers: dict | None = None) -> None:
+                blob = (json.dumps(body) + "\n").encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(blob)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(blob)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    return self._reply(200, daemon.health())
+                if self.path == "/metricz":
+                    return self._reply(200, daemon.metrics_doc())
+                if self.path == "/v1/sweeps":
+                    return self._reply(200, {"sweeps": daemon.sweep_list()})
+                if self.path.startswith("/v1/sweeps/"):
+                    sid = self.path.rsplit("/", 1)[-1]
+                    info = daemon.sweep_info(sid)
+                    if info is None:
+                        return self._reply(
+                            404, {"error": f"no sweep {sid!r}"}
+                        )
+                    info.pop("doc", None)  # results, not the input blob
+                    return self._reply(200, info)
+                return self._reply(404, {"error": "unknown path"})
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                raw = self.rfile.read(n) if n else b"{}"
+                try:
+                    payload = json.loads(raw.decode() or "{}")
+                except (UnicodeDecodeError, json.JSONDecodeError):
+                    return self._reply(400, {"error": "body is not JSON"})
+                if self.path == "/v1/drain":
+                    daemon.drain()
+                    return self._reply(200, {"draining": True})
+                if self.path == "/v1/sweeps":
+                    doc = payload.get("sweep")
+                    if not isinstance(doc, dict):
+                        return self._reply(
+                            400,
+                            {"error": "payload needs a `sweep` document"},
+                        )
+                    try:
+                        out = daemon.submit(
+                            doc,
+                            tenant=str(payload.get("tenant", "default")),
+                            backend_faults=payload.get("backend_faults"),
+                        )
+                    except ServeError as e:
+                        return self._reply(400, {"error": str(e)})
+                    if "shed" in out:
+                        return self._reply(
+                            429, out,
+                            headers={
+                                "Retry-After": str(out["retry_after_s"]),
+                            },
+                        )
+                    return self._reply(200, out)
+                return self._reply(404, {"error": "unknown path"})
+
+        class Server(socketserver.ThreadingMixIn,
+                     socketserver.UnixStreamServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        sock = self.opts.socket_path
+        os.makedirs(os.path.dirname(os.path.abspath(sock)), exist_ok=True)
+        if os.path.exists(sock):
+            os.unlink(sock)  # stale socket from a killed incarnation
+        return Server(sock, Handler)
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    def serve_forever(self, install_signals: bool = True) -> int:
+        """Run until drained (SIGTERM / POST /v1/drain). Returns 0 on a
+        graceful exit; the journal records how far every sweep got."""
+        from shadow_tpu.serve.kcache import enable_xla_cache
+
+        # AOT entries skip Python re-traces; the XLA persistent cache
+        # (same root, shared with bench.py) skips the StableHLO→binary
+        # compile of a deserialized artifact — together a warm restart
+        # redispatches in milliseconds
+        enable_xla_cache(self.opts.cache_dir)
+        self._server = self._make_server()
+        if install_signals:
+            signal.signal(signal.SIGTERM, lambda *_: self.drain())
+            signal.signal(signal.SIGINT, lambda *_: self.drain())
+        th = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+        th.start()
+        self._started.set()
+        print(
+            f"serve: listening on {self.opts.socket_path} "
+            f"(state {self.opts.state_dir}, "
+            f"{len(self._queue)} sweep(s) replayed into the queue)",
+            flush=True,
+        )
+        try:
+            self._worker()
+        finally:
+            self._server.shutdown()
+            self._server.server_close()
+            try:
+                os.unlink(self.opts.socket_path)
+            except OSError:
+                pass
+            self._dump_metrics()
+            self.journal.close()
+        print("serve: drained, exiting", flush=True)
+        return 0

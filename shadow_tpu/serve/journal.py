@@ -1,0 +1,209 @@
+"""Write-ahead job journal: the daemon's crash-durable source of truth.
+
+The serve daemon (shadow_tpu/serve/daemon.py) must treat its own death —
+`kill -9`, OOM, node reboot — as a non-event: restart replays the journal
+and the fleet finishes every accepted sweep with audit digest chains
+bit-identical to an uninterrupted run. That works because every
+scheduler-plane transition is journaled BEFORE it takes effect:
+
+    SUBMIT   a sweep was accepted (the full sweep document rides the
+             record — replay needs no other file to re-expand the jobs)
+    ADMIT    the worker started running it (its checkpoint directory is
+             recorded, so replay knows where the fleet slices live)
+    DRAIN    a graceful shutdown flushed the running fleet to its
+             checkpoint (SIGTERM path); replay resumes from the slices
+    REQUEUE  an admitted sweep was returned to the queue (backend loss
+             under policy abort, or an operator requeue)
+    COMPLETE the sweep finished; per-job results (including each job's
+             `audit.chain` digest) ride the record
+
+Framing: append-only binary records, each `!II` (payload length, CRC32)
+followed by the JSON payload, fsync'd per append. A SIGKILL mid-append
+leaves a torn tail frame whose length field overruns the file or whose
+CRC fails — replay stops cleanly at the first bad frame and reports it as
+`torn_tail`, exactly the crash-consistency contract of a WAL. A bad frame
+can never be followed by a good one (appends are sequential and fsync'd),
+so stopping is lossless.
+
+Replay folds the records into per-sweep state (`JournalState`): queued /
+running / done / failed sweeps in submission order. "Journal lag" — the
+health signal `/healthz` reports — is the number of records appended
+since the last COMPLETE: how far the durable tip has run ahead of
+fully-settled state.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import zlib
+
+_FRAME = struct.Struct("!II")  # (payload_len, crc32(payload))
+_MAX_RECORD = 64 << 20  # one sweep doc will never be 64 MiB; torn-length guard
+
+SUBMIT = "submit"
+ADMIT = "admit"
+DRAIN = "drain"
+REQUEUE = "requeue"
+COMPLETE = "complete"
+
+RECORD_TYPES = (SUBMIT, ADMIT, DRAIN, REQUEUE, COMPLETE)
+
+
+class JournalError(ValueError):
+    pass
+
+
+class Journal:
+    """Append-only CRC-framed record log with fsync-per-append."""
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        existing = scan(path) if os.path.exists(path) else None
+        if existing is not None and existing["truncated_at"] is not None:
+            # drop the torn tail frame so the next append starts at a
+            # clean frame boundary (otherwise the old partial frame would
+            # corrupt every record appended after it)
+            with open(path, "r+b") as f:
+                f.truncate(existing["truncated_at"])
+        self._records = existing["records"] if existing else []
+        self._seq = (
+            self._records[-1]["seq"] + 1 if self._records else 0
+        )
+        self._f = open(path, "ab")
+        self.torn_tail_dropped = bool(
+            existing and existing["truncated_at"] is not None
+        )
+
+    # -- writes --
+
+    def append(self, rtype: str, **fields) -> dict:
+        if rtype not in RECORD_TYPES:
+            raise JournalError(f"unknown journal record type {rtype!r}")
+        rec = {"type": rtype, "seq": self._seq, **fields}
+        payload = json.dumps(rec, sort_keys=True).encode()
+        self._f.write(_FRAME.pack(len(payload), zlib.crc32(payload)))
+        self._f.write(payload)
+        self._f.flush()
+        os.fsync(self._f.fileno())
+        self._seq += 1
+        self._records.append(rec)
+        return rec
+
+    def close(self) -> None:
+        self._f.close()
+
+    # -- reads --
+
+    @property
+    def records(self) -> list[dict]:
+        return list(self._records)
+
+    def state(self) -> "JournalState":
+        return JournalState(self._records)
+
+    def lag(self) -> int:
+        """Records appended since the last COMPLETE (0 for a settled
+        journal): the `/healthz` journal-lag gauge."""
+        lag = 0
+        for rec in reversed(self._records):
+            if rec["type"] == COMPLETE:
+                break
+            lag += 1
+        return lag
+
+
+def scan(path: str) -> dict:
+    """Read every intact frame of a journal file.
+
+    Returns {"records": [...], "truncated_at": byte_offset | None}:
+    `truncated_at` is the offset of the first torn/corrupt frame (the
+    SIGKILL-mid-append tail), None when the file ends on a clean frame
+    boundary. Raises JournalError only on I/O failure opening the file.
+    """
+    try:
+        blob = open(path, "rb").read()
+    except OSError as e:
+        raise JournalError(f"{path}: unreadable journal: {e}") from e
+    records: list[dict] = []
+    off = 0
+    n = len(blob)
+    while off < n:
+        if off + _FRAME.size > n:
+            return {"records": records, "truncated_at": off}
+        length, crc = _FRAME.unpack_from(blob, off)
+        start = off + _FRAME.size
+        if length > _MAX_RECORD or start + length > n:
+            return {"records": records, "truncated_at": off}
+        payload = blob[start:start + length]
+        if zlib.crc32(payload) != crc:
+            return {"records": records, "truncated_at": off}
+        try:
+            rec = json.loads(payload.decode())
+        except (UnicodeDecodeError, json.JSONDecodeError):
+            return {"records": records, "truncated_at": off}
+        if not isinstance(rec, dict) or rec.get("type") not in RECORD_TYPES:
+            return {"records": records, "truncated_at": off}
+        records.append(rec)
+        off = start + length
+    return {"records": records, "truncated_at": None}
+
+
+class JournalState:
+    """The folded scheduler-plane truth a replayed journal describes."""
+
+    def __init__(self, records: list[dict]):
+        self.sweeps: dict[str, dict] = {}
+        self.order: list[str] = []  # submission order
+        for rec in records:
+            self._apply(rec)
+
+    def _apply(self, rec: dict) -> None:
+        t = rec["type"]
+        sid = rec.get("id")
+        if t == SUBMIT:
+            if sid in self.sweeps:
+                return  # replayed duplicate; first submit wins
+            self.sweeps[sid] = {
+                "id": sid,
+                "tenant": rec.get("tenant", "default"),
+                "doc": rec.get("doc"),
+                "status": "queued",
+                "ckpt_dir": None,
+                "results": None,
+                "admits": 0,
+            }
+            self.order.append(sid)
+        elif sid in self.sweeps:
+            s = self.sweeps[sid]
+            if t == ADMIT:
+                s["status"] = "running"
+                s["ckpt_dir"] = rec.get("ckpt_dir")
+                s["admits"] += 1
+            elif t == DRAIN:
+                s["status"] = "drained"
+            elif t == REQUEUE:
+                s["status"] = "queued"
+            elif t == COMPLETE:
+                s["status"] = "done" if rec.get("ok") else "failed"
+                s["results"] = rec.get("results")
+                s["stats"] = rec.get("stats")
+
+    def unfinished(self) -> list[dict]:
+        """Sweeps the restarted daemon must pick back up, in submission
+        order: queued ones re-run from their journaled document; running
+        or drained ones re-attach via their fleet checkpoint directory
+        (falling back to a from-scratch re-run when the crash landed
+        before the first checkpoint reached disk)."""
+        return [
+            self.sweeps[sid] for sid in self.order
+            if self.sweeps[sid]["status"] in ("queued", "running", "drained")
+        ]
+
+    def completed(self) -> list[dict]:
+        return [
+            self.sweeps[sid] for sid in self.order
+            if self.sweeps[sid]["status"] in ("done", "failed")
+        ]

@@ -40,6 +40,10 @@ _BUDGET_TIER = {
     "test_observability": 2, "test_net_stack": 2, "test_bridge": 2,
     "test_sim_build": 3, "test_spill": 3, "test_optimistic": 3,
     "test_audit": 3, "test_resilience": 3, "test_analysis": 3,
+    # the serve chaos choreography is an acceptance gate: it must land
+    # BEFORE the compile-heavy parity matrices so a budget truncation
+    # never silently skips it
+    "test_serve": 3,
     # minutes: multi-engine parity matrices / many-shape compiles
     "test_gearbox": 4, "test_islands": 4, "test_rebalance": 4,
     "test_sharding": 4, "test_tcp": 4, "test_fleet": 4, "test_tgen": 5,

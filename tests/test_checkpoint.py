@@ -236,6 +236,60 @@ def test_ring_fallback_restores_previous_good(tmp_path):
     assert _states_equal(ref.state, resumed.state)
 
 
+def test_ring_fallback_past_truncated_and_empty_entries(tmp_path):
+    """A zero-length ring entry (open() crashed before any write reached
+    disk) and a mid-write-truncated one must BOTH collapse to the clean
+    CheckpointError fallback path — never a raw zipfile/numpy traceback —
+    and resume still lands on the older intact entry bit-exactly."""
+    ref = build_simulation(YAML)
+    ref.run()
+
+    d = str(tmp_path / "ring")
+    sim = build_simulation(YAML)
+    sim.run(until=1 * simtime.NS_PER_SEC)
+    ck.save_ring(sim, d, 0, 1 * simtime.NS_PER_SEC, retain=4)
+    sim.run(until=2 * simtime.NS_PER_SEC)
+    ck.save_ring(sim, d, 1, 2 * simtime.NS_PER_SEC, retain=4)
+    sim.run(until=3 * simtime.NS_PER_SEC)
+    ck.save_ring(sim, d, 2, 3 * simtime.NS_PER_SEC, retain=4)
+
+    entries = ck.ring_entries(d)
+    # newest entry: zero-length (truncate-to-nothing)
+    open(entries[2][2], "w").close()
+    # second-newest: torn mid-write (keep a prefix only)
+    blob = open(entries[1][2], "rb").read()
+    with open(entries[1][2], "wb") as f:
+        f.write(blob[: len(blob) // 3])
+
+    # both bad entries individually raise the clean error type
+    for _, _, path in (entries[2], entries[1]):
+        with pytest.raises(CheckpointError):
+            ck.verify(path)
+        with pytest.raises(CheckpointError):
+            load_meta(path)
+
+    resumed = build_simulation(YAML)
+    info = resumed.resume_from(d)
+    assert info["fallbacks"] == 2
+    assert info["path"] == entries[0][2]
+    resumed.run()
+    assert resumed.counters() == ref.counters()
+    assert _states_equal(ref.state, resumed.state)
+
+
+def test_non_npz_checkpoint_clean_error(good_ckpt, tmp_path):
+    """A ckpt file overwritten with bare .npy bytes (not an archive) must
+    raise CheckpointError, not an attribute/index error on the NpzFile
+    duck type."""
+    _, good = good_ckpt
+    bad = str(tmp_path / "bare.npz")
+    np.save(open(bad, "wb"), np.arange(16))
+    with pytest.raises(CheckpointError, match="npz"):
+        load_meta(bad)
+    with pytest.raises(CheckpointError):
+        ck.verify(bad)
+
+
 def test_save_is_atomic_no_tmp_left(good_ckpt, tmp_path):
     sim, _ = good_ckpt
     path = str(tmp_path / "atomic.npz")

@@ -161,6 +161,42 @@ def test_fleet_rejects_incompatible_jobs():
         build_fleet(jobs)
 
 
+def test_requeue_reenters_queue_in_submission_order():
+    """Requeued jobs (backend drain / shrunk-fleet resume) must re-enter
+    the pending queue at their ORIGINAL submission position, never at the
+    tail behind later submissions — including jobs submitted dynamically
+    (scheduler.submit, the serve-daemon path) after the requeued job first
+    ran."""
+    from shadow_tpu.fleet.scheduler import FleetScheduler
+
+    specs = [JobSpec(name=n, config={}) for n in ("a", "b", "c", "d")]
+    s = FleetScheduler(specs, lanes=2)
+    s.admit(0, s.peek())  # a
+    s.admit(1, s.peek())  # b
+    # a finishes; c enters its lane — the cursor is now past b
+    s.release(0, "done")
+    s.admit(0, s.peek())  # c
+    # a later tenant submits e while b and c are in flight
+    s.submit(JobSpec(name="e", config={}))
+    # backend drain returns BOTH running jobs to the queue (lane order,
+    # which is NOT submission order: c rides lane 0, b rides lane 1)
+    s.requeue(0, "backend drain")  # c
+    s.requeue(1, "backend drain")  # b
+    assert [r.name for r in s.pending()] == ["b", "c", "d", "e"]
+    # admission drains the queue in exactly that order
+    order = []
+    for lane in (0, 1, 0, 1):
+        rec = s.peek()
+        s.admit(lane, rec)
+        order.append(rec.name)
+        s.release(lane, "done")
+    assert order == ["b", "c", "d", "e"]
+    assert s.jobs_requeued == 2
+    # duplicate dynamic submissions are refused
+    with pytest.raises(ValueError, match="duplicate"):
+        s.submit(JobSpec(name="e", config={}))
+
+
 # ---------------------------------------------------------------------------
 # bit-parity: the acceptance matrix
 # ---------------------------------------------------------------------------
@@ -349,7 +385,7 @@ def test_metrics_schema_v5_fleet_section():
     obs_metrics.snapshot_fleet(fleet, reg)
     doc = reg.to_doc()
     obs_metrics.validate_metrics_doc(doc)
-    assert doc["schema_version"] == 6
+    assert doc["schema_version"] == 7
     rows = doc["fleet"]["jobs"]
     assert len(rows) == 2
     assert all(r["status"] == "done" for r in rows)

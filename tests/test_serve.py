@@ -1,0 +1,412 @@
+"""Sim-as-a-service (shadow_tpu/serve): crash-safe daemon + journal + AOT
+kernel cache.
+
+The load-bearing guarantee is that DAEMON DEATH IS A NON-EVENT: a sweep
+accepted by the daemon finishes — across SIGTERM drains and SIGKILL +
+journal-replay restarts — with per-job audit digest chains bit-identical
+(and identically ordered) to the same sweep run as one uninterrupted
+in-process fleet, and a warm restart re-binds every fleet kernel from
+the AOT cache with zero Python traces. Plus the admission plane: tenant
+quotas and queue-depth backpressure shed with HTTP 429 + Retry-After,
+and /healthz reports the supervisor probe, queue depth, and journal lag.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from shadow_tpu.serve import journal as journal_mod
+from shadow_tpu.serve.client import ServeClient, ServeClientError, Shed
+from shadow_tpu.serve.kcache import KernelCache, kernel_config_digest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+GML = """\
+graph [
+  node [ id 0 bandwidth_down "81920 Kibit" bandwidth_up "81920 Kibit" ]
+  edge [ source 0 target 0 latency "50 ms" packet_loss 0.0 ]
+]
+"""
+
+
+def _sweep_doc(jobs=6, lanes=2):
+    return {
+        "sweep": {
+            "name": "serve-t",
+            "lanes": lanes,
+            "matrix": {
+                "general.seed": list(range(11, 11 + jobs // 2)),
+                "general.stop_time": ["900 ms", "1.4 s"],
+            },
+        },
+        "general": {"stop_time": "1 s", "seed": 1},
+        "network": {"graph": {"type": "gml", "inline": GML}},
+        "experimental": {
+            "event_capacity": 1024,
+            "events_per_host_per_window": 8,
+            "outbox_slots": 8,
+            "inbox_slots": 4,
+        },
+        "fleet": {"windows_per_dispatch": 2},
+        "hosts": {
+            "peer": {
+                "quantity": 8,
+                "app_model": "phold",
+                "app_options": {
+                    "msgload": 2, "runtime": 2, "start_time": "100 ms",
+                },
+            }
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+# journal: framing, torn tails, replay folding
+# ---------------------------------------------------------------------------
+
+
+def test_journal_roundtrip_lag_and_state(tmp_path):
+    path = str(tmp_path / "j.wal")
+    j = journal_mod.Journal(path)
+    j.append(journal_mod.SUBMIT, id="s0", tenant="t", doc={"x": 1})
+    j.append(journal_mod.ADMIT, id="s0", ckpt_dir="/d")
+    j.append(journal_mod.SUBMIT, id="s1", tenant="t", doc={"x": 2})
+    assert j.lag() == 3  # no COMPLETE yet
+    j.append(journal_mod.COMPLETE, id="s0", ok=True,
+             results=[{"name": "a"}])
+    assert j.lag() == 0
+    j.close()
+
+    # a fresh handle replays the same truth
+    j2 = journal_mod.Journal(path)
+    assert not j2.torn_tail_dropped
+    st = j2.state()
+    assert [s["id"] for s in st.completed()] == ["s0"]
+    assert st.sweeps["s0"]["results"] == [{"name": "a"}]
+    assert [s["id"] for s in st.unfinished()] == ["s1"]
+    # seq numbering continues across restarts
+    rec = j2.append(journal_mod.ADMIT, id="s1", ckpt_dir="/d2")
+    assert rec["seq"] == 4
+    j2.close()
+
+
+def test_journal_torn_tail_and_corrupt_frame(tmp_path):
+    path = str(tmp_path / "j.wal")
+    j = journal_mod.Journal(path)
+    j.append(journal_mod.SUBMIT, id="s0", tenant="t", doc={})
+    j.append(journal_mod.SUBMIT, id="s1", tenant="t", doc={})
+    j.close()
+    blob = open(path, "rb").read()
+
+    # SIGKILL mid-append: arbitrary truncation inside the last frame
+    torn = str(tmp_path / "torn.wal")
+    open(torn, "wb").write(blob[:-3])
+    scan = journal_mod.scan(torn)
+    assert [r["id"] for r in scan["records"]] == ["s0"]
+    assert scan["truncated_at"] is not None
+    # reopening drops the torn tail and appends cleanly after it
+    j3 = journal_mod.Journal(torn)
+    assert j3.torn_tail_dropped
+    j3.append(journal_mod.SUBMIT, id="s2", tenant="t", doc={})
+    j3.close()
+    st = journal_mod.Journal(torn).state()
+    assert [s["id"] for s in st.unfinished()] == ["s0", "s2"]
+
+    # a flipped byte inside the last record fails its CRC
+    flip = str(tmp_path / "flip.wal")
+    open(flip, "wb").write(blob[:-5] + bytes([blob[-5] ^ 0xFF]) + blob[-4:])
+    scan = journal_mod.scan(flip)
+    assert [r["id"] for r in scan["records"]] == ["s0"]
+    assert scan["truncated_at"] is not None
+
+    # zero-length journal = empty, not an error
+    empty = str(tmp_path / "empty.wal")
+    open(empty, "wb").close()
+    assert journal_mod.scan(empty) == {"records": [], "truncated_at": None}
+
+
+# ---------------------------------------------------------------------------
+# kernel cache: roundtrip, corruption eviction, version skew, digest keys
+# ---------------------------------------------------------------------------
+
+
+def test_kcache_roundtrip_corruption_and_skew(tmp_path):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    kc = KernelCache(str(tmp_path))
+
+    def fn(x):
+        return x * 2 + 1
+
+    x = jnp.arange(8, dtype=jnp.int64)
+    key = kc.key("cfg", "t", (x,))
+    assert kc.get(key) is None  # cold miss
+    ex = kc.export_and_put(key, fn, (x,))
+    assert np.array_equal(np.asarray(ex.call(x)), np.asarray(fn(x)))
+    assert kc.stats()["puts"] == 1 and kc.stats()["entries"] == 1
+
+    # hit from a fresh handle, bit-identical result
+    kc2 = KernelCache(str(tmp_path))
+    ex2 = kc2.get(key)
+    assert ex2 is not None
+    assert np.array_equal(np.asarray(jax.jit(ex2.call)(x)),
+                          np.asarray(fn(x)))
+
+    # corrupt payload: evicted, reported as a miss, never trusted
+    bin_path, hdr_path = kc2._paths(key)
+    open(bin_path, "wb").write(b"garbage")
+    kc3 = KernelCache(str(tmp_path))
+    assert kc3.get(key) is None
+    assert kc3.stats()["evictions"] == 1
+    assert not os.path.exists(bin_path)
+
+    # version skew: a header written by another jaxlib is evicted too
+    key2 = kc3.key("cfg", "t2", (x,))
+    kc3.export_and_put(key2, fn, (x,))
+    _, hdr2 = kc3._paths(key2)
+    hdr = json.load(open(hdr2))
+    hdr["jaxlib"] = "0.0.0"
+    json.dump(hdr, open(hdr2, "w"))
+    kc4 = KernelCache(str(tmp_path))
+    assert kc4.get(key2) is None
+    assert kc4.stats()["evictions"] == 1
+
+    # distinct avals → distinct keys (a hit is always arg-compatible)
+    assert kc.key("cfg", "t", (x,)) != kc.key(
+        "cfg", "t", (jnp.arange(9, dtype=jnp.int64),)
+    )
+
+    # the kernel-source fingerprint is part of the key: a code upgrade
+    # is a cache miss, never a stale-kernel replay
+    from shadow_tpu.serve import kcache as kcache_mod
+
+    k_before = kc.key("cfg", "t", (x,))
+    old_fp = kcache_mod.kernel_source_fingerprint()
+    assert len(old_fp) == 64
+    try:
+        kcache_mod._SRC_FINGERPRINT = "f" * 64
+        assert kc.key("cfg", "t", (x,)) != k_before
+    finally:
+        kcache_mod._SRC_FINGERPRINT = old_fp
+    assert kc.key("cfg", "t", (x,)) == k_before
+
+
+def test_kernel_config_digest_ignores_data_plane():
+    a = _sweep_doc()
+    b = _sweep_doc()
+    b["general"]["seed"] = 999
+    b["general"]["stop_time"] = "9 s"
+    assert kernel_config_digest(a) == kernel_config_digest(b)
+    c = _sweep_doc()
+    c["experimental"]["event_capacity"] = 2048  # kernel-shaping
+    assert kernel_config_digest(a) != kernel_config_digest(c)
+
+
+def test_serve_modules_classified_host():
+    """serve/ is daemon-plane host code: the kernel purity rule set must
+    not apply to it (and shadowlint keeps the tree clean with zero
+    baseline entries — bench.py --lint-smoke gates that)."""
+    from shadow_tpu.analysis.linter import classify_module
+
+    for mod in ("daemon", "journal", "kcache", "client", "cli"):
+        assert classify_module(f"shadow_tpu/serve/{mod}.py") == "host"
+
+
+def test_sweep_corrupt_entries_evicts_zero_length(tmp_path):
+    from shadow_tpu.serve.kcache import sweep_corrupt_entries
+
+    root = tmp_path / "cache"
+    (root / "aot").mkdir(parents=True)
+    (root / "ok.bin").write_bytes(b"fine")
+    (root / "torn.bin").write_bytes(b"")
+    (root / "aot" / "k-dead.bin").write_bytes(b"")
+    assert sweep_corrupt_entries(str(root)) == 2
+    assert (root / "ok.bin").exists()
+    assert not (root / "torn.bin").exists()
+
+
+# ---------------------------------------------------------------------------
+# the daemon: chaos choreography + admission plane
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def serve_env(tmp_path_factory):
+    """Module-shared cache dir: every daemon the module spawns warms the
+    same XLA + AOT caches, so only the first pays the fleet compile."""
+    cache = tmp_path_factory.mktemp("serve_cache")
+    return {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "SHADOW_TPU_CACHE_DIR": str(cache),
+    }
+
+
+def _spawn(state_dir: str, env: dict, *extra: str):
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "shadow_tpu", "serve",
+         "--state-dir", state_dir, "--checkpoint-every-dispatches", "1",
+         *extra],
+        env=env, cwd=REPO,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    client = ServeClient(os.path.join(state_dir, "serve.sock"), timeout=20)
+    deadline = time.monotonic() + 120
+    while True:
+        if proc.poll() is not None:
+            raise AssertionError(
+                f"daemon died at startup:\n{proc.stdout.read()}"
+            )
+        try:
+            client.health()
+            return proc, client
+        except ServeClientError:
+            if time.monotonic() >= deadline:
+                proc.kill()
+                raise
+            time.sleep(0.1)
+
+
+def _wait_progress(client, sid, jobs_done: int, timeout_s: float = 240.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        info = client.sweep(sid)
+        if info["status"] in ("done", "failed"):
+            return info
+        progress = info.get("progress") or {}
+        if progress.get("jobs_done", 0) >= jobs_done:
+            return info
+        time.sleep(0.1)
+    raise AssertionError(f"sweep {sid} made no progress in {timeout_s}s")
+
+
+@pytest.fixture(scope="module")
+def ref_rows():
+    """The uninterrupted bar: the same sweep as ONE in-process fleet."""
+    from shadow_tpu.fleet import build_fleet, load_sweep
+
+    jobs, _ = load_sweep(_sweep_doc())
+    fleet = build_fleet(jobs, lanes=2, windows_per_dispatch=2)
+    fleet.run()
+    return fleet.results()
+
+
+def test_daemon_chaos_sigterm_drain_then_sigkill_replay(
+    tmp_path, serve_env, ref_rows
+):
+    """The acceptance choreography, both deaths in one sweep's life:
+    SIGTERM mid-sweep (graceful drain to checkpoint, journal DRAIN, rc
+    0) → restart resumes → SIGKILL mid-sweep (no goodbye) → restart
+    replays the journal and finishes. The final results must equal the
+    uninterrupted run's rows CHAIN FOR CHAIN in submission order, and
+    the post-SIGKILL incarnation must bind every fleet kernel from the
+    AOT cache with zero Python traces."""
+    state = str(tmp_path / "state")
+
+    # incarnation 1: accept, make some progress, SIGTERM → graceful drain
+    proc, client = _spawn(state, serve_env)
+    sid = client.submit(_sweep_doc())["id"]
+    info = _wait_progress(client, sid, 1)
+    assert info["status"] != "failed"
+    proc.send_signal(signal.SIGTERM)
+    assert proc.wait(timeout=120) == 0  # drained exit is clean
+    recs = journal_mod.scan(os.path.join(state, "journal.wal"))["records"]
+    types = [r["type"] for r in recs]
+    assert types[:2] == [journal_mod.SUBMIT, journal_mod.ADMIT]
+    if info["status"] != "done":
+        assert journal_mod.DRAIN in types
+
+    # incarnation 2: resumes the drained sweep; SIGKILL it mid-run
+    proc, client = _spawn(state, serve_env)
+    info = _wait_progress(client, sid, 3)
+    proc.kill()
+    proc.wait(timeout=60)
+
+    # incarnation 3: journal replay finishes the sweep
+    proc, client = _spawn(state, serve_env)
+    health = client.health()
+    assert health["journal"]["records"] >= 3
+    info = client.wait(sid, timeout_s=420)
+    assert info["status"] == "done"
+    rows = info["results"]
+    assert [r["name"] for r in rows] == [r["name"] for r in ref_rows]
+    assert [r["audit"]["chain"] for r in rows] == \
+        [r["audit"]["chain"] for r in ref_rows]
+    assert [r["events_committed"] for r in rows] == \
+        [r["events_committed"] for r in ref_rows]
+    # zero window-kernel recompiles for fleet shapes already in the AOT
+    # cache (the kernel_traces-gated property)
+    assert info["stats"]["kernel_traces"] == 0
+
+    # schema-v7 serve.* metrics document
+    from shadow_tpu.obs import metrics as obs_metrics
+
+    doc = client.metrics()
+    obs_metrics.validate_metrics_doc(doc)
+    assert doc["counters"]["serve.journal_replays"] == 1
+    assert doc["counters"]["serve.kcache_hits"] >= 1
+
+    client.drain()
+    assert proc.wait(timeout=120) == 0
+
+
+def test_daemon_admission_quota_shed_and_health(
+    tmp_path, serve_env, ref_rows
+):
+    """Admission backpressure: per-tenant quotas and queue depth shed
+    with HTTP 429 + a Retry-After derived from scheduler occupancy; a
+    malformed sweep document is a 400 naming the problem; /healthz
+    reports the shared supervisor probe and journal lag."""
+    state = str(tmp_path / "state")
+    proc, client = _spawn(
+        state, serve_env,
+        "--max-queue", "2", "--quota", "capped=0",
+    )
+    try:
+        health = client.health()
+        assert health["ok"] and health["backend"]["probe_ok"]
+        assert health["backend"]["platform"] == "cpu"
+        assert health["journal"] == {
+            "records": 0, "lag": 0, "torn_tail_dropped": False,
+        }
+
+        # a zero-quota tenant is shed before any validation work
+        with pytest.raises(Shed) as e:
+            client.submit(_sweep_doc(), tenant="capped")
+        assert e.value.body["shed"] == "tenant_quota"
+        assert e.value.retry_after_s >= 1
+
+        # malformed documents are a 400, never a queued time bomb
+        # (checked while the queue is empty: shed outranks validation)
+        with pytest.raises(ServeClientError, match="sweep"):
+            client.submit({"general": {"stop_time": "1 s"}})
+
+        # fill the queue to max depth, then shed on depth
+        a = client.submit(_sweep_doc(), tenant="alice")
+        b = client.submit(_sweep_doc(), tenant="bob")
+        with pytest.raises(Shed) as e:
+            client.submit(_sweep_doc(), tenant="carol")
+        assert e.value.body["shed"] == "queue_full"
+
+        # the accepted sweeps still finish correctly under all that
+        info = client.wait(a["id"], timeout_s=420)
+        assert info["status"] == "done"
+        assert [r["audit"]["chain"] for r in info["results"]] == \
+            [r["audit"]["chain"] for r in ref_rows]
+        client.wait(b["id"], timeout_s=420)
+        doc = client.metrics()
+        assert doc["counters"]["serve.sheds"] == 2
+        assert doc["counters"]["serve.sweeps_completed"] == 2
+    finally:
+        try:
+            client.drain()
+            proc.wait(timeout=120)
+        except Exception:
+            proc.kill()

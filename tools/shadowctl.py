@@ -1,0 +1,119 @@
+#!/usr/bin/env python3
+"""Operator client for the serve daemon (`python -m shadow_tpu serve`).
+
+Talks HTTP over the daemon's unix socket (docs/serving.md):
+
+    shadowctl.py --socket DIR/serve.sock health
+    shadowctl.py --socket DIR/serve.sock submit sweep.yaml [--tenant t1]
+    shadowctl.py --socket DIR/serve.sock status [SWEEP_ID]
+    shadowctl.py --socket DIR/serve.sock results SWEEP_ID [--wait SECS]
+    shadowctl.py --socket DIR/serve.sock metrics
+    shadowctl.py --socket DIR/serve.sock drain
+
+Exit status: 0 ok; 2 usage / bad sweep document; 3 daemon unreachable;
+4 submission shed (admission backpressure — the printed JSON carries
+`retry_after_s`); 5 the sweep finished with failed jobs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+REPO_ROOT = __import__("os").path.dirname(
+    __import__("os").path.dirname(__import__("os").path.abspath(__file__))
+)
+sys.path.insert(0, REPO_ROOT)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="shadowctl", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    p.add_argument("--socket", required=True, metavar="PATH",
+                   help="the daemon's unix socket (<state-dir>/serve.sock)")
+    p.add_argument("--timeout", type=float, default=60.0, metavar="SECS")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("health", help="GET /healthz")
+    sub.add_parser("metrics", help="GET /metricz (schema-v7 serve.* doc)")
+    sub.add_parser("drain", help="graceful drain: flush the running "
+                   "fleet to its checkpoint and exit")
+    ps = sub.add_parser("submit", help="submit a sweep document")
+    ps.add_argument("sweep", help="sweep YAML (base config + sweep: matrix)")
+    ps.add_argument("--tenant", default="default")
+    ps.add_argument("--fault-plan", metavar="JSON",
+                    help="daemon-level chaos plan (backend ops only: "
+                    "kill_backend/stall_backend) attached to this sweep")
+    pst = sub.add_parser("status", help="list sweeps, or show one")
+    pst.add_argument("id", nargs="?")
+    pr = sub.add_parser("results", help="print a sweep's per-job rows")
+    pr.add_argument("id")
+    pr.add_argument("--wait", type=float, metavar="SECS", default=None,
+                    help="block until the sweep settles (max SECS)")
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    from shadow_tpu.serve.client import (
+        ServeClient, ServeClientError, Shed,
+    )
+
+    client = ServeClient(args.socket, timeout=args.timeout)
+    try:
+        if args.cmd == "health":
+            print(json.dumps(client.health(), indent=1))
+            return 0
+        if args.cmd == "metrics":
+            print(json.dumps(client.metrics(), indent=1))
+            return 0
+        if args.cmd == "drain":
+            print(json.dumps(client.drain()))
+            return 0
+        if args.cmd == "submit":
+            import yaml
+
+            with open(args.sweep) as f:
+                doc = yaml.safe_load(f)
+            faults = None
+            if args.fault_plan:
+                with open(args.fault_plan) as f:
+                    plan = json.load(f)
+                faults = plan["faults"] if isinstance(plan, dict) else plan
+            try:
+                out = client.submit(doc, tenant=args.tenant,
+                                    backend_faults=faults)
+            except Shed as e:
+                print(json.dumps(e.body))
+                return 4
+            print(json.dumps(out))
+            return 0
+        if args.cmd == "status":
+            if args.id:
+                print(json.dumps(client.sweep(args.id), indent=1))
+            else:
+                for row in client.sweeps():
+                    print(json.dumps(row))
+            return 0
+        if args.cmd == "results":
+            info = (
+                client.wait(args.id, timeout_s=args.wait)
+                if args.wait is not None else client.sweep(args.id)
+            )
+            for row in info.get("results") or []:
+                print(json.dumps(row))
+            print(json.dumps(
+                {"id": info["id"], "status": info["status"],
+                 "stats": info.get("stats")},
+            ))
+            return 0 if info["status"] == "done" else 5
+    except (ServeClientError, FileNotFoundError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 3 if "unreachable" in str(e) else 2
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())
