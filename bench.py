@@ -955,6 +955,140 @@ def stage_resilience_smoke(num_hosts: int = 1024, msgload: int = 2,
     }
 
 
+def stage_pressure_smoke(num_hosts: int = 512, msgload: int = 4,
+                         stop_s: int = 2):
+    """Pressure-plane gate (ISSUE 9 acceptance): resource exhaustion must
+    degrade deterministically instead of dying.
+
+    (a) `exhaust_backend` mid-run: the classified RESOURCE_EXHAUSTED
+        drives the degradation ladder (forced gear downshift overriding
+        the red-zone rule, overflow parked on the host spill tier) and
+        the run COMPLETES in-process with the uninterrupted run's exact
+        audit digest chain.
+    (b) the same injection with the ladder DISABLED reproduces the
+        pre-ladder behavior: drain-to-checkpoint + a typed abort
+        (BackendLost) — never a bare RuntimeError.
+    (c) `saturate_pool` mid-window: sustained simulated pool pressure is
+        absorbed by spill-tier escalation; the run completes with the
+        exact chain where a stall used to raise.
+
+    Writes a schema-v8 metrics artifact carrying the pressure.*
+    namespace so tools/tpu_watch.py schema-gates the line at capture.
+    CPU-deterministic by design (the injections ARE the pressure)."""
+    import jax
+
+    from shadow_tpu.core.pressure import (
+        PressureController, PressurePolicy,
+    )
+    from shadow_tpu.core.supervisor import BackendLost, BackendSupervisor
+    from shadow_tpu.faults import plan as plan_mod
+    from shadow_tpu.flagship import build_phold_flagship
+    from shadow_tpu.obs import metrics as obs_metrics
+
+    def build():
+        # occupancy (H x msgload) lands the build at the TOP gear, so the
+        # ladder's forced downshift has a smaller tier to retreat to
+        return build_phold_flagship(
+            num_hosts, msgload=msgload, stop_s=stop_s, runtime_s=stop_s,
+            pool_gears=2,
+        )
+
+    def quiet_supervisor(policy="wait"):
+        return BackendSupervisor(policy, sleep=lambda s: None)
+
+    # uninterrupted baseline
+    t0 = time.perf_counter()
+    ref = build()
+    ref.run(windows_per_dispatch=4)
+    jax.block_until_ready(ref.state.pool.time)
+    wall_base = time.perf_counter() - t0
+    base_chain = ref.audit_chain()
+    base_events = ref.counters()["events_committed"]
+
+    exhaust_plan = [
+        {"at": "1 s", "op": "exhaust_backend", "recover_after": 1}
+    ]
+
+    # (a) exhaust → ladder engages → completes with the exact chain
+    t0 = time.perf_counter()
+    sim = build()
+    sim.attach_supervisor(quiet_supervisor())
+    sim.attach_faults(plan_mod.parse_fault_plan(exhaust_plan))
+    sim.run(windows_per_dispatch=4)
+    jax.block_until_ready(sim.state.pool.time)
+    wall_ladder = time.perf_counter() - t0
+    pstats = sim.pressure_stats()
+    ladder_engaged = (
+        pstats.get("downshifts", 0) + pstats.get("spill_escalations", 0)
+        >= 1
+    )
+    ladder_chain_equal = (
+        sim.audit_chain() == base_chain
+        and sim.counters()["events_committed"] == base_events
+    )
+
+    # (b) control arm — ladder disabled: the pre-ladder outcome, typed
+    control = build()
+    control.attach_pressure(
+        PressureController(PressurePolicy(enabled=False))
+    )
+    control.attach_supervisor(quiet_supervisor(policy="abort"))
+    control.attach_faults(plan_mod.parse_fault_plan(exhaust_plan))
+    control_typed_abort = False
+    try:
+        control.run(windows_per_dispatch=4)
+    except BackendLost:
+        control_typed_abort = True
+
+    # (c) saturate_pool → spill escalation absorbs it, chain identical
+    sat = build()
+    sat.attach_faults(plan_mod.parse_fault_plan(
+        [{"at": "1 s", "op": "saturate_pool", "frac": 0.2}]
+    ))
+    sat.run(windows_per_dispatch=4)
+    sat_chain_equal = (
+        sat.audit_chain() == base_chain
+        and sat.counters()["events_committed"] == base_events
+    )
+    sat_spilled = sat.spill_stats()["spill_episodes"] >= 1
+
+    metrics_path = os.path.join(_REPO, "pressure_smoke.metrics.json")
+    session = obs_metrics.ObsSession()
+    session.finalize(sim)
+    doc = session.metrics.dump(metrics_path, meta={
+        "stage": "pressure_smoke", "hosts": num_hosts,
+    })
+    obs_metrics.validate_metrics_doc(doc, strict_namespaces=True)
+    pressure_recorded = (
+        doc["counters"].get("pressure.ladder_steps", 0) >= 1
+        and "pressure.estimated_bytes" in doc["gauges"]
+    )
+
+    return {
+        "stage": "pressure_smoke",
+        "platform": jax.default_backend(),
+        "hosts": num_hosts,
+        "chain": int(base_chain),
+        "wall_base_s": round(wall_base, 3),
+        "wall_ladder_s": round(wall_ladder, 3),
+        "pressure": {k: int(v) for k, v in sorted(pstats.items())},
+        "ladder_chain_equal": ladder_chain_equal,
+        "control_typed_abort": control_typed_abort,
+        "saturate_chain_equal": sat_chain_equal,
+        "saturate_spill_episodes": int(
+            sat.spill_stats()["spill_episodes"]
+        ),
+        "metrics_out": os.path.relpath(metrics_path, _REPO),
+        "gate_ladder": bool(ladder_engaged and ladder_chain_equal),
+        "gate_control": bool(control_typed_abort),
+        "gate_saturate": bool(sat_chain_equal and sat_spilled),
+        "gate": bool(
+            ladder_engaged and ladder_chain_equal and control_typed_abort
+            and sat_chain_equal and sat_spilled and pressure_recorded
+        ),
+    }
+
+
 _SERVE_SMOKE_SWEEP = {
     "sweep": {
         "name": "serve-smoke",
@@ -1135,6 +1269,14 @@ def main():
         # deterministic by design, so no backend wait.
         os.environ.setdefault("SHADOW_TPU_BENCH_ALLOW_CPU", "1")
         print(json.dumps(stage_serve_smoke()), flush=True)
+        return
+    if "--pressure-smoke" in sys.argv:
+        # pressure-plane gate: exhaust_backend / saturate_pool injections
+        # engage the degradation ladder and the run completes with the
+        # uninterrupted chain. CPU-deterministic (the injection IS the
+        # pressure), so no backend wait.
+        os.environ.setdefault("SHADOW_TPU_BENCH_ALLOW_CPU", "1")
+        print(json.dumps(stage_pressure_smoke()), flush=True)
         return
     if "--resilience-smoke" in sys.argv:
         # backend-survivability gate: deterministic kill_backend → drain /

@@ -393,10 +393,16 @@ def _run_device_plane(
         f"sim {sim.stop_time / 1e9:.3f}s in wall {wall:.3f}s"
     )
     dropped = c.get("pool_overflow_dropped", 0)
+    overflow_advice = None
     if dropped:
+        # actionable, not just a counter (docs/fault_tolerance.md §5):
+        # name the capacity/gearing that would have absorbed the overflow
+        from shadow_tpu.core import pressure as pressure_mod
+
+        hint, overflow_advice = pressure_mod.overflow_advice(sim, dropped)
         print(
-            f"warning: {dropped} events dropped on pool overflow "
-            f"(raise experimental.event_capacity)",
+            f"warning: {dropped} events dropped on pool overflow — "
+            f"{hint}",
             file=sys.stderr,
         )
     fstats = sim.fault_stats()
@@ -409,6 +415,11 @@ def _run_device_plane(
         )
     if session is not None:
         session.finalize(sim)
+        if overflow_advice is not None:
+            # reflect the sizing advice in the metrics doc (schema v8
+            # pressure.* gauges, docs/observability.md)
+            for k, v in overflow_advice.items():
+                session.metrics.gauge_set(f"pressure.{k}", int(v))
         meta = {
             "hosts": sim.num_hosts,
             "stop_time_ns": sim.stop_time,

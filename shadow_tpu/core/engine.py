@@ -46,6 +46,7 @@ import numpy as np
 from flax import struct
 
 from shadow_tpu.core import gearbox
+from shadow_tpu.core import pressure as pressure_mod
 from shadow_tpu.core import rng as rng_mod
 from shadow_tpu.core import simtime, soa
 from shadow_tpu.core import spill as spill_mod
@@ -1675,6 +1676,14 @@ class Simulation:
         # failover flag re-lowers kernels on the CPU backend (_jit).
         self.supervisor = None
         self._cpu_failover = False
+        # Resource-pressure plane (core/pressure.py): None until the
+        # first pressure signal (a stall, an XLA RESOURCE_EXHAUSTED, or a
+        # saturate_pool injection) lazily attaches the default ladder —
+        # the no-pressure path stays attribute-check cheap. Reshaping
+        # ladder rungs (gear downshift) are forbidden while an optimistic
+        # attempt holds a rollback snapshot of the current shapes.
+        self.pressure = None
+        self._pressure_reshape_ok = True
         self.checkpoint_dir: str | None = None
         self.checkpoint_every_ns = 0
         self.checkpoint_retain = 3
@@ -1777,6 +1786,11 @@ class Simulation:
         gear changed. No-op (and no occupancy math) on ungeared builds."""
         if self._shifter is None:
             return False
+        if self.pressure is not None and self.pressure.hold_gear:
+            # forced-downshift hold (pressure ladder): the red-zone
+            # upshift rule is overridden while device memory is tight —
+            # the spill tier absorbs the occupancy instead
+            return False
         new = self._shifter.observe(
             self._gear, int(occ), press=press, margin=margin
         )
@@ -1788,6 +1802,22 @@ class Simulation:
     def _gear_note_dispatch(self) -> None:
         self._gear_dispatches[self._gear] = (
             self._gear_dispatches.get(self._gear, 0) + 1
+        )
+
+    def _live_spill_clamp(self, stop_at: int, wpd: int) -> tuple[int, int]:
+        """Call-time spill clamp for SUPERVISED dispatch thunks: a
+        pressure-ladder rung (forced downshift) can engage the spill tier
+        BETWEEN attempts of one dispatch, after the driver computed its
+        stop time — the retry must then clamp below the earliest parked
+        row (and drop to single-window dispatches) or resident hosts
+        would process past host-parked events and diverge from the
+        oversized-pool run. Identity while the spill tier is empty."""
+        sp = getattr(self, "_spill", None)
+        if sp is None or not sp.count:
+            return stop_at, wpd
+        return (
+            min(stop_at, sp.min_time + self.runahead, min(sp._partial_min)),
+            1,
         )
 
     def _pool_occupancy(self) -> int:
@@ -1845,22 +1875,34 @@ class Simulation:
                     break
                 stall += 1
                 if stall > 2:
-                    raise RuntimeError(
+                    occ = self._pool_occupancy()
+                    cap = self._gear_ladder[self._gear].capacity
+                    if self._pressure_stall(window=min_next, occupancy=occ,
+                                            capacity=cap):
+                        stall = 0  # a ladder rung reshaped the tier
+                        continue
+                    raise self._pool_exhausted(
                         "spill tier cannot make progress: either a single "
                         "timestamp holds more events than the pool fill "
                         "mark, or pool occupancy leaves too little "
                         "headroom for even one window's emissions (the "
                         "pool-headroom gate stalled every host); raise "
-                        "experimental.event_capacity"
+                        "experimental.event_capacity",
+                        window=min_next, occupancy=occ, capacity=cap,
                     )
                 continue
             stall = 0
+            if self.pressure is not None:
+                self.pressure.note_progress()
             ws = min_next
             we = min(ws + self.runahead, stop_at)
             with metrics_mod.span(obs, "dispatch", windows=1):
 
                 def _dispatch(ws=ws, we=we):
-                    st, mn = self._step(self.state, self.params, ws, we)
+                    we, _ = self._live_spill_clamp(we, 1)
+                    st, mn = self._step(
+                        self.state, self.params, ws, max(ws, we)
+                    )
                     return st, int(mn)
 
                 self.state, mn = self._sv("step", _dispatch)
@@ -1945,6 +1987,11 @@ class Simulation:
             we = min(ws + factor * cons, stop)
             base = self.state  # rollback snapshot (done_t already reset)
             rb0 = rollbacks
+            # pressure-ladder rungs that reshape the pool (gear
+            # downshift) are forbidden while `base` pins the compiled
+            # shapes; non-reshaping rungs (spill-fill escalation) stay
+            # available to the supervisor's RESOURCE_EXHAUSTED retries
+            self._pressure_reshape_ok = False
             with metrics_mod.span(obs, "window", factor=factor):
                 while True:  # attempt [ws, we) in ONE dispatch; shrink on violation
                     with metrics_mod.span(obs, "dispatch"):
@@ -1981,11 +2028,14 @@ class Simulation:
             # driver-plane telemetry bumps ride the state replace the loop
             # does anyway (handoff boundary — no sync added); each rollback
             # shrank the window once
+            self._pressure_reshape_ok = True
             st = obs_mod.bump_win(st, obs_mod.WIN_ROLLBACKS, rollbacks - rb0)
             st = obs_mod.bump_win(st, obs_mod.WIN_SHRINKS, rollbacks - rb0)
             self.state = st.replace(host=st.host.replace(done_t=neg1))
             min_next = int(mn)
             windows += 1
+            if self.pressure is not None:
+                self.pressure.note_progress()
             if obs is not None:
                 obs.round_done(self)
             self._audit_tick(min_next)
@@ -2005,9 +2055,20 @@ class Simulation:
         Pressure must fire while the merge can still absorb one window's
         inflow; the fill mark sits below pressure so a rebalance —
         including a partially-resident giant host's admission — exits the
-        red zone and the fused loop keeps running windows."""
+        red zone and the fused loop keeps running windows. The pressure
+        plane (core/pressure.py) scales both marks: injected saturation
+        shrinks them, and memory-ladder escalation halves the fill per
+        notch — identity until a pressure event actually engaged."""
         spec = self._gear_ladder[self._gear]
-        return spec.hi, spec.fill
+        hi, fill = spec.hi, spec.fill
+        cap = getattr(self, "_pressure_fill_cap", None)
+        if cap is not None:
+            # transient override during a forced downshift: park down to
+            # the TARGET gear's fill before the pool re-sorts smaller
+            fill = min(fill, cap)
+        if self.pressure is not None:
+            hi, fill = self.pressure.scaled_marks(hi, fill)
+        return hi, fill
 
     def _spill_store(self):
         if getattr(self, "_spill", None) is None:
@@ -2050,6 +2111,9 @@ class Simulation:
             with metrics_mod.span(obs, "dispatch", windows=wpd):
 
                 def _dispatch(stop_at=stop_at, wpd=wpd):
+                    # per-attempt clamp: a pressure rung may have engaged
+                    # the spill tier since the driver computed stop_at
+                    stop_at, wpd = self._live_spill_clamp(stop_at, wpd)
                     st, mn, press, occ = self._run_to(
                         self.state, self.params, stop_at, wpd
                     )
@@ -2072,14 +2136,22 @@ class Simulation:
                 break
             cur = (mn, spill.count, press)
             if cur == last and mn >= stop_at and not shifted:
-                raise RuntimeError(
+                cap = self._gear_ladder[self._gear].capacity
+                if self._pressure_stall(window=mn, occupancy=occ,
+                                        capacity=cap):
+                    last = None  # a ladder rung reshaped the tier
+                    continue
+                raise self._pool_exhausted(
                     "spill tier cannot make progress: either a single "
                     "timestamp holds more events than the pool fill mark, "
                     "or pool occupancy leaves too little headroom for even "
                     "one window's emissions (the pool-headroom gate "
                     "stalled every host); raise "
-                    "experimental.event_capacity"
+                    "experimental.event_capacity",
+                    window=mn, occupancy=occ, capacity=cap,
                 )
+            elif self.pressure is not None:
+                self.pressure.note_progress()
             last = cur
 
     # -- fault-tolerance plane (shadow_tpu/faults) + auto-checkpointing --
@@ -2197,6 +2269,110 @@ class Simulation:
         (schema v6); {} when no supervisor is attached."""
         sup = self.supervisor
         return sup.stats() if sup is not None else {}
+
+    # -- resource-pressure plane (core/pressure.py) --
+
+    def attach_pressure(self, controller) -> None:
+        """Arm a custom pressure controller/policy; the drivers attach
+        the default ladder lazily on the first pressure signal."""
+        self.pressure = controller
+
+    def _pressure(self):
+        if self.pressure is None:
+            self.pressure = pressure_mod.PressureController()
+        return self.pressure
+
+    def _pressure_ladder_step(self, label: str) -> bool:
+        """One memory-ladder rung for a classified RESOURCE_EXHAUSTED
+        dispatch failure (called by the supervisor between attempts)."""
+        return self._pressure().on_backend_exhausted(self, label)
+
+    def _pressure_stall(self, *, window=None, occupancy=None,
+                        capacity=None) -> bool:
+        """One pool-ladder consultation at a driver stall; True = retry
+        the driver loop (a rung reshaped something)."""
+        return self._pressure().on_pool_exhausted(
+            self, window=window, occupancy=occupancy, capacity=capacity
+        )
+
+    def _pool_exhausted(self, message: str, window=None,
+                        occupancy=None, capacity=None):
+        """Terminal pool exhaustion: drain the committed frontier to the
+        checkpoint ring (when one is configured — the run is resumable at
+        a reshaped config, docs/fault_tolerance.md §5) and build the
+        typed error every driver raises instead of a bare RuntimeError."""
+        path = self._drain_to_checkpoint("pool_exhausted")
+        if path:
+            message += f" (drained to {path}; resume with --resume)"
+        return pressure_mod.PoolExhausted(
+            message, window=window, occupancy=occupancy, capacity=capacity
+        )
+
+    def _pressure_relieve_pool(self, step: int):
+        """The pool-exhaustion rungs, in ladder order. Returns the action
+        name or None when exhausted (core/pressure.py counts them)."""
+        pc = self._pressure()
+        pol = pc.policy
+        # rung 1: forced upshift — more usable pool, unless a memory hold
+        # pins the gear down or no bigger gear exists
+        if (self._shifter is not None and not pc.hold_gear
+                and self._gear < self._gear_ladder[-1].level):
+            self._shift_gear(self._gear + 1)
+            return "upshift"
+        # rung 2 (saturation yield) lives in the controller
+        # rung 3: force one spill episode — the stall may predate any
+        # red-zone crossing (occupancy under the mark can still leave too
+        # little merge headroom for a whole window's inflow)
+        if pol.allow_spill_escalation and not self._force_spill \
+                and step < 1 + pol.max_fill_shrink:
+            self._force_spill = True
+            return "spill_escalation"
+        return None
+
+    def _pressure_relieve_memory(self, step: int):
+        """The memory-exhaustion rungs, in ladder order: forced gear
+        downshift (red-zone rule overridden), then spill-fill escalation.
+        The fleet adds lane eviction; the supervisor's drain + policy is
+        the rung after None."""
+        pc = self._pressure()
+        pol = pc.policy
+        if (pol.allow_downshift and self._pressure_reshape_ok
+                and len(self._gear_ladder) > 1
+                and self._gear > self._gear_ladder[0].level
+                and self._pressure_downshift()):
+            pc.hold_gear = True
+            return "downshift"
+        if pol.allow_spill_escalation and pc.fill_shrink < pol.max_fill_shrink:
+            pc.fill_shrink += 1
+            self._force_spill = True
+            return "spill_escalation"
+        return None
+
+    def _pressure_downshift(self) -> bool:
+        """Forced downshift one gear under memory pressure: park rows
+        beyond the TARGET gear's fill mark on the host spill tier (one
+        manage pass — foreign-row re-routing and whole-host ordering
+        included), then re-sort the pool into the smaller capacity. The
+        resize drops nothing (occupancy <= fill < capacity after the
+        park), so results stay bit-identical — the spill tier's
+        guarantee."""
+        target = self._gear - 1
+        spec = self._gear_ladder[target]
+        spill = self._spill_store()
+        self._force_spill = True
+        self._pressure_fill_cap = max(1, min(spec.fill, spec.hi))
+        try:
+            spill_mod.manage(self, spill, self.stop_time)
+        finally:
+            self._pressure_fill_cap = None
+        self._shift_gear(target)
+        return True
+
+    def pressure_stats(self) -> dict:
+        """Pressure-plane telemetry for the metrics `pressure.*`
+        namespace (schema v8); {} until a pressure signal engaged."""
+        pc = self.pressure
+        return pc.stats() if pc is not None else {}
 
     def configure_auto_checkpoint(
         self, ckpt_dir: str, every_ns: int, retain: int = 3
@@ -2318,6 +2494,15 @@ class Simulation:
                     self.state = obs_mod.bump_win(
                         self.state, obs_mod.WIN_FAULTS
                     )
+                elif f.op == "saturate_pool":
+                    # injected pool saturation (core/pressure.py): scale
+                    # the spill marks by frac from this frontier on; the
+                    # sustained re-force below keeps the episodes coming
+                    self._pressure().saturate(f.frac)
+                    self._force_spill = True
+                    self.state = obs_mod.bump_win(
+                        self.state, obs_mod.WIN_FAULTS
+                    )
                 else:  # corrupt_file
                     touched = inj_mod.corrupt_file(
                         f, default_dir=self.checkpoint_dir
@@ -2341,12 +2526,20 @@ class Simulation:
                     self.attach_supervisor(sup)
                 if f.op == "kill_backend":
                     sup.inject_kill(f.recover_after)
+                elif f.op == "exhaust_backend":
+                    sup.inject_exhaust(f.recover_after)
                 else:  # stall_backend
                     sup.inject_stall(f.count)
                 if obs is not None and obs.tracer:
                     obs.tracer.fault(
                         "fault_injection", op=f.op, at_ns=f.at_ns
                     )
+        if (self.pressure is not None
+                and self.pressure.saturate_frac is not None
+                and self.pressure.saturate_frac < 1.0):
+            # sustained saturation: keep the spill tier engaged so the
+            # scaled marks keep parking rows every handoff
+            self._force_spill = True
         if self._dead_hosts and not drained_this_tick:
             # recurring drain: exchange-deferred / late-emitted rows for
             # dead hosts are cancelled before the next window runs

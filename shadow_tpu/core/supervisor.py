@@ -10,6 +10,12 @@ converts every driver's hard-abort path into a supervised state machine:
        │ deadline misses ≥ limit    ▼
        ├──────────▶ SUSPECT ──probe fails──▶ LOST
        │                └─probe ok─▶ HEALTHY
+       │ RESOURCE_EXHAUSTED (XLA OOM / PoolExhausted)
+       ├──────────▶ PRESSURE ──ladder rung taken──▶ retry dispatch
+       │                └─ladder exhausted─▶ LOST (drain + policy);
+       │                  the degradation ladder is core/pressure.py:
+       │                  forced downshift → spill escalation → fleet
+       │                  lane eviction (docs/fault_tolerance.md §5)
        ▼ classified backend loss
       LOST ──▶ DRAIN (flush state to a crash-consistent checkpoint,
        │        audit chain + drain-reason metadata riding the header)
@@ -49,12 +55,15 @@ from __future__ import annotations
 import random
 import time
 
+from shadow_tpu.core.pressure import PoolExhausted
+
 # ---------------------------------------------------------------------------
 # failure classification
 # ---------------------------------------------------------------------------
 
 TRANSIENT = "transient"
 BACKEND_LOST = "backend_lost"
+RESOURCE_EXHAUSTED = "resource_exhausted"
 FATAL = "fatal"
 
 # Substrings (lowercased) that mark a dispatch error as a dead/unreachable
@@ -79,16 +88,27 @@ _LOST_MARKERS = (
     "heartbeat timeout",
 )
 
-# Errors worth a bounded in-place retry before escalating: queue pressure
-# and interrupted collectives that a healthy backend shakes off.
+# Errors worth a bounded in-place retry before escalating: interrupted
+# collectives and queue hiccups that a healthy backend shakes off.
 _TRANSIENT_MARKERS = (
-    "resource_exhausted",
-    "resource exhausted",
     "aborted",
     "cancelled",
     "temporarily",
     "try again",
     "retry",
+)
+
+# XLA memory-pressure markers: the allocator could not place the dispatch's
+# working set. NOT transient (an identical retry re-OOMs identically) and
+# NOT a loss (the backend is alive) — the pressure ladder (core/pressure.py)
+# reshapes the working set, then the dispatch retries.
+_EXHAUSTED_MARKERS = (
+    "resource_exhausted",
+    "resource exhausted",
+    "out of memory",
+    "failed to allocate",
+    "allocation failure",
+    "hbm oom",
 )
 
 
@@ -99,11 +119,17 @@ class BackendLost(RuntimeError):
 
 
 def classify_failure(exc: BaseException) -> str:
-    """TRANSIENT (bounded retry), BACKEND_LOST (drain + policy), or FATAL
-    (re-raise: a real bug, not an infrastructure failure)."""
+    """TRANSIENT (bounded retry), RESOURCE_EXHAUSTED (pressure ladder),
+    BACKEND_LOST (drain + policy), or FATAL (re-raise: a real bug, not an
+    infrastructure failure)."""
     if isinstance(exc, BackendLost):
         return BACKEND_LOST
+    if isinstance(exc, PoolExhausted):
+        return RESOURCE_EXHAUSTED
     msg = f"{type(exc).__name__}: {exc}".lower()
+    for marker in _EXHAUSTED_MARKERS:
+        if marker in msg:
+            return RESOURCE_EXHAUSTED
     for marker in _TRANSIENT_MARKERS:
         if marker in msg:
             return TRANSIENT
@@ -197,11 +223,16 @@ class BackendSupervisor:
         self._consec_stalls = 0
         self._since_recheck = 0
         self._down_since: float | None = None
-        # injected faults (shadow_tpu/faults kill_backend / stall_backend):
-        # None = no kill injection armed; an int counts FAILED probes until
-        # the simulated backend answers again (-1 = never recovers)
+        # injected faults (shadow_tpu/faults kill_backend / stall_backend /
+        # exhaust_backend): None = no kill injection armed; an int counts
+        # FAILED probes until the simulated backend answers again (-1 =
+        # never recovers). _inject_exhausts counts dispatch attempts that
+        # fail with a simulated XLA RESOURCE_EXHAUSTED before the
+        # allocation "fits" again (the pressure ladder's reshapes are what
+        # make the retries converge).
         self._inject_probes_left: int | None = None
         self._inject_stalls = 0
+        self._inject_exhausts = 0
         self.counters = {
             "dispatches": 0,
             "retries": 0,
@@ -209,6 +240,8 @@ class BackendSupervisor:
             "stalls": 0,
             "probes": 0,
             "backend_losses": 0,
+            "exhaustions": 0,
+            "pressure_steps": 0,
             "drains": 0,
             "failovers": 0,
             "failbacks": 0,
@@ -236,6 +269,14 @@ class BackendSupervisor:
         `stall_backend` fault op) — exercises the stall→probe ladder
         without any real slowness."""
         self._inject_stalls += max(1, int(count))
+
+    def inject_exhaust(self, recover_after: int | None = 1) -> None:
+        """Simulate XLA memory exhaustion (the `exhaust_backend` fault
+        op): the next `recover_after` supervised dispatch attempts fail
+        with a classified RESOURCE_EXHAUSTED — each failure runs one
+        pressure-ladder rung (core/pressure.py), modeling an allocation
+        that fits only after the ladder reshaped the working set."""
+        self._inject_exhausts += max(1, int(recover_after or 1))
 
     # -- probing --
 
@@ -269,6 +310,12 @@ class BackendSupervisor:
             self.counters["dispatches"] += 1
             t0 = self._clock()
             try:
+                if self._inject_exhausts > 0:
+                    self._inject_exhausts -= 1
+                    raise RuntimeError(
+                        "RESOURCE_EXHAUSTED: out of memory allocating "
+                        "window buffers (injected exhaust_backend)"
+                    )
                 out = thunk()
             except Exception as exc:  # noqa: BLE001 — classified below
                 kind = classify_failure(exc)
@@ -279,6 +326,17 @@ class BackendSupervisor:
                     continue
                 if kind == FATAL:
                     raise
+                if kind == RESOURCE_EXHAUSTED:
+                    # memory pressure, not loss: the backend is alive but
+                    # the working set does not fit. Run one degradation-
+                    # ladder rung (core/pressure.py) and retry — the
+                    # thunk re-reads the bound kernels, so a downshift's
+                    # rebind is picked up transparently.
+                    self.counters["exhaustions"] += 1
+                    if self._pressure_step(label, exc):
+                        continue
+                    # ladder exhausted/unavailable: treat as a loss —
+                    # drain to a checkpoint, then the configured policy
                 # backend loss, or transient retries exhausted (a backend
                 # that cannot absorb a bounded retry burst is not healthy)
                 self._dead = True
@@ -307,6 +365,19 @@ class BackendSupervisor:
             else:
                 self._consec_stalls = 0
             return out
+
+    def _pressure_step(self, label: str, exc: BaseException) -> bool:
+        """One memory-ladder rung via the bound sim's pressure plane;
+        False when no sim is bound or its ladder is exhausted (the
+        caller then escalates to the drain + loss-policy path)."""
+        sim = self._sim
+        step = getattr(sim, "_pressure_ladder_step", None)
+        if step is None:
+            return False
+        if step(f"{label}: {exc}"):
+            self.counters["pressure_steps"] += 1
+            return True
+        return False
 
     # -- loss handling: drain, then the configured policy --
 
@@ -398,7 +469,8 @@ class BackendSupervisor:
         return delay * (0.5 + self._rng.random())
 
     def stats(self) -> dict:
-        """The `resilience.*` metrics namespace (schema v6)."""
+        """The `resilience.*` metrics namespace (schema v6; v8 adds the
+        exhaustions / pressure_steps memory-pressure tallies)."""
         d = dict(self.counters)
         d["failover_active"] = int(self.failover)
         return d

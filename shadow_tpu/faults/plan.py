@@ -14,6 +14,8 @@ A plan is a JSON document (``--fault-plan plan.json``) or the inline
         {"at": "1 s", "op": "force_spill"},
         {"at": "2 s", "op": "kill_backend", "recover_after": 2},
         {"at": "2 s", "op": "stall_backend", "count": 3},
+        {"at": "2 s", "op": "exhaust_backend", "recover_after": 1},
+        {"at": "2 s", "op": "saturate_pool", "frac": 0.25},
         {"at": "4 s", "op": "corrupt_file", "path": "ckpt-*.npz",
          "mode": "flip"}
       ]
@@ -35,6 +37,12 @@ seconds). Ops are split by execution plane:
                 kill_host   quarantine the host id/name: its pending pool
                             events drain at every subsequent handoff
                 force_spill force one pool-overflow spill episode
+                saturate_pool simulate sustained pool pressure: scale the
+                            spill-tier marks by `frac` (0 < frac <= 1)
+                            from the injection frontier on — drives the
+                            degradation ladder (core/pressure.py) so
+                            pool saturation is deterministically
+                            testable on CPU
   BACKEND_OPS executed at the same device handoff boundaries, but
               targeting the ACCELERATOR rather than a simulated host —
               they drive the backend supervision state machine
@@ -51,6 +59,13 @@ seconds). Ops are split by execution plane:
                                miss the supervisor's deadline — the
                                bounded-lag stall ladder escalates to a
                                probe
+                exhaust_backend the next `recover_after` supervised
+                               dispatch attempts fail with a classified
+                               XLA RESOURCE_EXHAUSTED — each failure
+                               runs one pressure-ladder rung
+                               (core/pressure.py), modeling an
+                               allocation that fits only after the
+                               ladder reshaped the working set
   FILE_OPS    executed by whichever plane runs, at the same points:
                 corrupt_file  truncate/flip/delete files matching a glob
                               (checkpoint or spill artifacts) — proves
@@ -73,8 +88,10 @@ PLAN_KIND = "shadow_tpu.fault_plan"
 PLAN_SCHEMA_VERSION = 1
 
 PROC_OPS = frozenset({"kill_proc", "wedge_proc", "refuse_ipc"})
-DEVICE_OPS = frozenset({"kill_host", "force_spill"})
-BACKEND_OPS = frozenset({"kill_backend", "stall_backend"})
+DEVICE_OPS = frozenset({"kill_host", "force_spill", "saturate_pool"})
+BACKEND_OPS = frozenset(
+    {"kill_backend", "stall_backend", "exhaust_backend"}
+)
 FILE_OPS = frozenset({"corrupt_file"})
 ALL_OPS = PROC_OPS | DEVICE_OPS | BACKEND_OPS | FILE_OPS
 
@@ -89,6 +106,8 @@ _FIELDS = {
     "force_spill": (set(), set()),
     "kill_backend": (set(), {"recover_after"}),
     "stall_backend": (set(), {"count"}),
+    "exhaust_backend": (set(), {"recover_after"}),
+    "saturate_pool": (set(), {"frac"}),
     "corrupt_file": ({"path"}, {"mode", "dir"}),
 }
 
@@ -109,8 +128,13 @@ class Fault:
     host: Optional[int | str] = None
     count: int = 1
     # kill_backend: failed supervisor probes before the simulated backend
-    # answers again; None = the outage never self-heals (abort/resume path)
+    # answers again; None = the outage never self-heals (abort/resume
+    # path). exhaust_backend: dispatch attempts that fail RESOURCE_
+    # EXHAUSTED before the allocation fits (None = one).
     recover_after: Optional[int] = None
+    # saturate_pool: the factor the spill-tier marks scale by (smaller =
+    # more severe simulated pressure)
+    frac: float = 0.5
     path: Optional[str] = None
     mode: str = "truncate"
     dir: Optional[str] = None
@@ -166,6 +190,18 @@ def _parse_entry(i: int, d: dict) -> Fault:
             raise FaultPlanError(
                 f"faults[{i}] ({op}): recover_after must be >= 0"
             )
+    if "frac" in d:
+        try:
+            f.frac = float(d["frac"])
+        except (TypeError, ValueError):
+            raise FaultPlanError(
+                f"faults[{i}] ({op}): frac must be a number, got "
+                f"{d['frac']!r}"
+            ) from None
+        if not 0.0 < f.frac <= 1.0:
+            raise FaultPlanError(
+                f"faults[{i}] ({op}): frac must be in (0, 1], got {f.frac}"
+            )
     if "path" in d:
         f.path = str(d["path"])
     if "dir" in d and d["dir"] is not None:
@@ -215,15 +251,18 @@ def parse_fault_plan(entries: list) -> list[Fault]:
 
 def check_backend_ops(faults: list[Fault]) -> list[Fault]:
     """Require every injection to be a BACKEND op (kill_backend /
-    stall_backend) — the only class a daemon-level chaos plan may carry:
-    proc/device/file ops are run-scoped and belong in a job's own config
+    stall_backend / exhaust_backend) or saturate_pool — the classes a
+    daemon-level chaos plan may carry (they target the shared
+    accelerator / pressure plane, not one simulated host): proc/device/
+    file ops are run-scoped and belong in a job's own config
     (shadow_tpu/serve validates submissions with this)."""
+    allowed = BACKEND_OPS | {"saturate_pool"}
     for f in faults:
-        if f.op not in BACKEND_OPS:
+        if f.op not in allowed:
             raise FaultPlanError(
-                f"daemon-level fault plans support backend ops only "
-                f"({sorted(BACKEND_OPS)}); {f.op!r} belongs in a job "
-                f"config's faults section"
+                f"daemon-level fault plans support backend + pressure "
+                f"ops only ({sorted(allowed)}); {f.op!r} belongs in a "
+                f"job config's faults section"
             )
     return faults
 

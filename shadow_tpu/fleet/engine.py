@@ -42,6 +42,7 @@ import numpy as np
 
 from shadow_tpu.core import gearbox, simtime
 from shadow_tpu.core import engine as engine_mod
+from shadow_tpu.core import pressure as pressure_mod
 from shadow_tpu.core import state as state_mod
 from shadow_tpu.core.config import load_config
 from shadow_tpu.fleet.scheduler import (
@@ -143,6 +144,13 @@ class FleetSimulation:
         self._cpu_failover = False
         self._admission_paused = False
         self._backend_faults: list = []
+        # Resource-pressure plane (core/pressure.py): lazily attached on
+        # the first pressure signal. Lane eviction holds admission for a
+        # few handoffs so the freed lane actually lowers the resident
+        # set; reshaping rungs are forbidden mid-optimistic-attempt.
+        self.pressure = None
+        self._pressure_reshape_ok = True
+        self._evict_hold = 0
         # AOT kernel cache (serve/kcache.py): when attached, fleet window
         # kernels bind from serialized exports on disk — a warm restart
         # re-binds every known shape with ZERO Python traces
@@ -452,23 +460,26 @@ class FleetSimulation:
         return self.supervisor.call(label, thunk)
 
     def attach_faults(self, faults) -> None:
-        """Arm FLEET-scoped backend injections (kill_backend /
-        stall_backend only — per-job plans carry kill_host, validated by
-        fleet/sweep.py). They fire at the handoff whose fleet frontier
-        (min over active lanes) reaches `at`, driving the supervision
-        state machine so a whole-sweep device loss is deterministically
-        testable on CPU."""
+        """Arm FLEET-scoped injections: backend ops (kill_backend /
+        stall_backend / exhaust_backend) plus saturate_pool — the
+        accelerator and the pressure plane serve every lane, so these
+        fire at the handoff whose fleet frontier (min over active lanes)
+        reaches `at`. Per-job plans carry kill_host only (validated by
+        fleet/sweep.py)."""
         from shadow_tpu.faults import plan as plan_mod
 
+        allowed = plan_mod.BACKEND_OPS | {"saturate_pool"}
         for f in faults:
-            if f.op not in plan_mod.BACKEND_OPS:
+            if f.op not in allowed:
                 raise FleetError(
-                    f"fleet-level fault plans support backend ops only "
-                    f"({sorted(plan_mod.BACKEND_OPS)}); {f.op!r} belongs "
+                    f"fleet-level fault plans support backend + pressure "
+                    f"ops only ({sorted(allowed)}); {f.op!r} belongs "
                     f"in a per-job plan"
                 )
         self._backend_faults = sorted(faults, key=lambda f: (f.at_ns, f.seq))
-        if self._backend_faults and self.supervisor is None:
+        if self.supervisor is None and any(
+            f.op in plan_mod.BACKEND_OPS for f in self._backend_faults
+        ):
             from shadow_tpu.core.supervisor import BackendSupervisor
 
             self.attach_supervisor(BackendSupervisor())
@@ -496,6 +507,13 @@ class FleetSimulation:
             sup = self.supervisor
             if f.op == "kill_backend":
                 sup.inject_kill(f.recover_after)
+            elif f.op == "exhaust_backend":
+                sup.inject_exhaust(f.recover_after)
+            elif f.op == "saturate_pool":
+                # fleet-scoped pool saturation: the controller records
+                # the pressure; the fleet has no spill tier, so relief
+                # is gear headroom / lane eviction via the ladder
+                self._pressure().saturate(f.frac)
             else:  # stall_backend
                 sup.inject_stall(f.count)
             obs = self.obs_session
@@ -571,6 +589,123 @@ class FleetSimulation:
         d["lane_reclaims"] = self.sched.lane_reclaims
         d["jobs_requeued"] = self.sched.jobs_requeued
         return d
+
+    # ------------------------------------------------------------------
+    # resource-pressure plane (core/pressure.py): fleet-shaped rungs
+    # ------------------------------------------------------------------
+
+    def attach_pressure(self, controller) -> None:
+        self.pressure = controller
+
+    def _pressure(self):
+        if self.pressure is None:
+            self.pressure = pressure_mod.PressureController()
+        return self.pressure
+
+    def _pressure_ladder_step(self, label: str) -> bool:
+        return self._pressure().on_backend_exhausted(self, label)
+
+    def _pressure_stall(self, *, window=None, occupancy=None,
+                        capacity=None) -> bool:
+        return self._pressure().on_pool_exhausted(
+            self, window=window, occupancy=occupancy, capacity=capacity
+        )
+
+    def _pool_exhausted(self, message: str, window=None, occupancy=None,
+                        capacity=None):
+        """Terminal pool exhaustion: drain the fleet (slices + manifest,
+        jobs requeued so `sweep --resume` restores them at a reshaped
+        config) and build the typed error — never a bare RuntimeError."""
+        path = self._drain_to_checkpoint("pool_exhausted")
+        if path:
+            message += f" (drained to {path}; resume with sweep --resume)"
+        return pressure_mod.PoolExhausted(
+            message, window=window, occupancy=occupancy, capacity=capacity
+        )
+
+    def _lane_occupancies(self) -> np.ndarray:
+        occ = jnp.sum(self.state.pool.time != NEVER, axis=-1)
+        return np.asarray(jax.device_get(occ)).reshape(
+            self.lanes, -1
+        ).max(axis=1)
+
+    def _pressure_relieve_pool(self, step: int):
+        """Per-lane pools share ONE compiled shape, so more headroom is a
+        fleet-wide upshift; at the top gear, shed the heaviest job rather
+        than the fleet (the existing fail-THIS-job posture)."""
+        pc = self._pressure()
+        if (not pc.hold_gear and self._pressure_reshape_ok
+                and self._gear < self._ladder[-1].level):
+            self._shift_gear(self._gear + 1)
+            return "upshift"
+        if pc.policy.allow_lane_eviction:
+            j = self._heaviest_lane()
+            if j is not None:
+                self._kill_lane(j)
+                self._harvest(
+                    j, FAILED,
+                    "pool pressure: job shed by the degradation ladder "
+                    "(raise experimental.event_capacity for this sweep)",
+                )
+                return "job_shed"
+        return None
+
+    def _pressure_relieve_memory(self, step: int):
+        """Memory rungs, fleet-shaped: forced downshift when every lane's
+        occupancy fits the smaller gear (the fleet has no spill tier to
+        park overflow), else evict the heaviest lane — the freed lane
+        shrinks the resident working set and admission holds."""
+        pc = self._pressure()
+        pol = pc.policy
+        if not self._pressure_reshape_ok:
+            # mid-optimistic-attempt: the rollback snapshot pins both the
+            # compiled shapes AND the lane rows (an eviction's row clear
+            # would be overwritten by the attempt's state) — no safe rung;
+            # the supervisor's drain + recovery path takes over
+            return None
+        if pol.allow_downshift and self._gear > self._ladder[0].level:
+            target = self._ladder[self._gear - 1]
+            if int(self._lane_occupancies().max(initial=0)) <= target.fill:
+                self._shift_gear(target.level)
+                pc.hold_gear = True
+                return "downshift"
+        if pol.allow_lane_eviction and self._pressure_evict_lane():
+            return "lane_eviction"
+        return None
+
+    def _heaviest_lane(self) -> int | None:
+        occ = self._lane_occupancies()
+        best, best_occ = None, -1
+        for j in range(self.lanes):
+            if self.sched.lane_job[j] is None:
+                continue
+            if int(occ[j]) > best_occ:
+                best, best_occ = j, int(occ[j])
+        return best
+
+    def _pressure_evict_lane(self) -> bool:
+        """Requeue the heaviest running job (FleetScheduler.requeue — it
+        re-admits FIFO at its original position) and clear its lane; the
+        eviction hold keeps the freed lane empty for a few handoffs so
+        the resident set actually shrinks. The re-run is bit-identical
+        (jobs are pure functions of their spec)."""
+        j = self._heaviest_lane()
+        if j is None:
+            return False
+        self.sched.requeue(j, reason="pressure eviction")
+        self._kill_lane(j)
+        self._lane_faults[j] = _LaneFaults.empty()
+        self._evict_hold = max(
+            self._evict_hold,
+            self._pressure().policy.eviction_hold_dispatches,
+        )
+        return True
+
+    def pressure_stats(self) -> dict:
+        """The `pressure.*` metrics namespace (schema v8); {} until a
+        pressure signal engaged."""
+        pc = self.pressure
+        return pc.stats() if pc is not None else {}
 
     def _reclaim_expired(self) -> bool:
         """Free lanes whose job blew its wall-clock deadline NOW — before
@@ -711,6 +846,10 @@ class FleetSimulation:
             # backend drain in progress: no new work enters until the
             # supervisor's recovery reopens admission (_rebind_kernels)
             return False
+        if self._evict_hold > 0:
+            # pressure eviction in effect: the freed lane stays empty so
+            # the resident working set actually shrinks (core/pressure.py)
+            return False
         rec = self.sched.peek()
         if rec is None:
             return False
@@ -818,6 +957,8 @@ class FleetSimulation:
         deadlines, pressure kills, checkpoint marks. Returns True when
         any scheduler-visible action happened (the stall guard's
         signal)."""
+        if self._evict_hold > 0:
+            self._evict_hold -= 1
         changed = self._fault_tick(mn)
         if changed:
             mn[:] = self._lane_min_times()  # a drain may move frontiers
@@ -922,7 +1063,9 @@ class FleetSimulation:
                 obs.round_done(self)
             self._backend_fault_tick(mn)
             changed = self._handoff(mn, press)
-            if self._shifter is not None:
+            if self._shifter is not None and not (
+                self.pressure is not None and self.pressure.hold_gear
+            ):
                 new = self._shifter.observe(
                     self._gear, occ, press=bool(press.any())
                 )
@@ -933,12 +1076,23 @@ class FleetSimulation:
                    tuple(len(lf.pending) for lf in self._lane_faults),
                    self._gear)
             if not changed and sig == last_sig:
-                raise RuntimeError(
+                cap = self._ladder[self._gear].capacity
+                if self._pressure_stall(
+                    window=int(mn.min()), occupancy=occ,
+                    capacity=cap,
+                ):
+                    last_sig = None  # a ladder rung reshaped the fleet
+                    continue
+                raise self._pool_exhausted(
                     "fleet cannot make progress: no lane advanced and no "
                     "scheduler action fired (pool occupancy leaves too "
                     "little headroom for even one window's emissions); "
-                    "raise experimental.event_capacity"
+                    "raise experimental.event_capacity",
+                    window=int(mn.min()), occupancy=occ,
+                    capacity=cap,
                 )
+            elif self.pressure is not None:
+                self.pressure.note_progress()
             last_sig = sig
         return dispatches
 
@@ -1001,10 +1155,16 @@ class FleetSimulation:
                 return st, mn, viol
             if k >= _MAX_SUBSTEPS:
                 if (need & (mn <= ws)).any():
-                    raise RuntimeError(
+                    # mid-attempt: the snapshot pins the compiled shapes,
+                    # so no reshaping rung is safe — typed exhaustion
+                    j = int(np.argmax(need & (mn <= ws)))
+                    raise self._pool_exhausted(
                         "optimistic fleet attempt cannot make progress "
                         "(pool-headroom stall); raise "
-                        "experimental.event_capacity"
+                        "experimental.event_capacity",
+                        window=int(ws[j]),
+                        occupancy=int(self._lane_occupancies()[j]),
+                        capacity=self._ladder[self._gear].capacity,
                     )
                 # genuinely enormous window: report the reached frontier;
                 # the caller shrinks those lanes and retries from base
@@ -1064,6 +1224,9 @@ class FleetSimulation:
             we = np.where(stalled, ws, we)
             base = self.state
             rb_round = np.zeros(L, np.int64)
+            # reshaping pressure rungs are unsafe while `base` pins the
+            # compiled shapes (core/pressure.py)
+            self._pressure_reshape_ok = False
             while True:
                 st, mn_a, viol = self._attempt_round(base, ws, we)
                 bad = (viol < never) & ~idle
@@ -1094,6 +1257,7 @@ class FleetSimulation:
                 we = np.where(
                     bad, np.minimum(np.maximum(viol, floor), stop), we
                 )
+            self._pressure_reshape_ok = True
             rollbacks += int(rb_round.sum())
             self.state = st
             for j in np.flatnonzero(rb_round):
@@ -1127,10 +1291,23 @@ class FleetSimulation:
                 mn = self._lane_min_times()
             sig = (tuple(mn), tuple(r.status for r in self.sched.records))
             if not changed and not (mn > ws).any() and sig == last_sig:
-                raise RuntimeError(
+                cap = self._ladder[self._gear].capacity
+                if self._pressure_stall(
+                    window=int(mn.min()),
+                    occupancy=int(self._lane_occupancies().max(initial=0)),
+                    capacity=cap,
+                ):
+                    last_sig = None  # a ladder rung reshaped the fleet
+                    continue
+                raise self._pool_exhausted(
                     "optimistic fleet cannot make progress; raise "
-                    "experimental.event_capacity"
+                    "experimental.event_capacity",
+                    window=int(mn.min()),
+                    occupancy=int(self._lane_occupancies().max(initial=0)),
+                    capacity=cap,
                 )
+            elif self.pressure is not None:
+                self.pressure.note_progress()
             last_sig = sig
         return rounds, rollbacks
 

@@ -25,16 +25,19 @@ import numpy as np
 
 from shadow_tpu.obs import counters as obs_counters
 
-# v7: serve.* sim-as-a-service namespace (shadow_tpu/serve: journal
-# records/replays, admission sheds, kernel-cache hits/misses/evictions,
-# drains); v6: resilience.* backend-supervision namespace
-# (core/supervisor.py: retries, backoffs, stalls, drains, failovers,
-# downtime_ns, fleet lane reclaims); v5: audit.* determinism-audit
-# namespace (digest chain, obs/audit.py) + optional per-job `audit`
-# sub-object on fleet.jobs[*] rows; v4: optional top-level `fleet`
-# section (fleet.jobs[*] per-job rows) + fleet.* counters; v3: faults.*
-# recovery counters
-SCHEMA_VERSION = 7
+# v8: pressure.* resource-pressure namespace (core/pressure.py:
+# degradation-ladder rungs — downshifts/upshifts/spill escalations/lane
+# evictions/job sheds — plus HBM estimate + headroom gauges and memory-
+# shed admission counters on the serve plane); v7: serve.*
+# sim-as-a-service namespace (shadow_tpu/serve: journal records/replays,
+# admission sheds, kernel-cache hits/misses/evictions, drains); v6:
+# resilience.* backend-supervision namespace (core/supervisor.py:
+# retries, backoffs, stalls, drains, failovers, downtime_ns, fleet lane
+# reclaims); v5: audit.* determinism-audit namespace (digest chain,
+# obs/audit.py) + optional per-job `audit` sub-object on fleet.jobs[*]
+# rows; v4: optional top-level `fleet` section (fleet.jobs[*] per-job
+# rows) + fleet.* counters; v3: faults.* recovery counters
+SCHEMA_VERSION = 8
 DOC_KIND = "shadow_tpu.metrics"
 
 # metrics-doc `fleet.jobs[*]` rows must carry at least these keys
@@ -64,6 +67,7 @@ KNOWN_METRIC_NAMESPACES = frozenset({
     "audit",       # determinism-audit plane (schema v5)
     "resilience",  # backend supervision (schema v6)
     "serve",       # sim-as-a-service daemon plane (schema v7)
+    "pressure",    # resource-pressure degradation ladder (schema v8)
     "sim",         # build-level gauges (num_hosts, runahead)
     "bench",       # bench.py gate-local rows
 })
@@ -195,6 +199,11 @@ def validate_metrics_doc(doc: dict, strict_namespaces: bool = False) -> None:
         if k.startswith("serve.") and v < 0:
             # schema v7: daemon-plane counters are monotonic tallies too
             raise ValueError(f"serve counter {k!r} must be >= 0, got {v}")
+        if k.startswith("pressure.") and v < 0:
+            # schema v8: degradation-ladder counters are monotonic tallies
+            raise ValueError(
+                f"pressure counter {k!r} must be >= 0, got {v}"
+            )
     for k, v in doc["gauges"].items():
         if not isinstance(v, (int, float)) or isinstance(v, bool):
             raise ValueError(f"gauge {k!r} must be a number, got {v!r}")
@@ -325,6 +334,32 @@ def snapshot_device(sim, reg: MetricsRegistry) -> None:
     if res_stats is not None:
         for k, v in res_stats().items():
             reg.counter_set(f"resilience.{k}", int(v))
+    _snapshot_pressure(sim, reg)
+
+
+def _snapshot_pressure(sim, reg: MetricsRegistry) -> None:
+    """Resource-pressure plane (schema v8): ladder counters from the
+    attached controller plus the HBM estimate/headroom gauges
+    (core/pressure.py) — the preflight budget the serve daemon's
+    admission compares against."""
+    from shadow_tpu.core import pressure as pressure_mod
+
+    ps = getattr(sim, "pressure_stats", None)
+    if ps is not None:
+        for k, v in ps().items():
+            reg.counter_set(f"pressure.{k}", int(v))
+    pc = getattr(sim, "pressure", None)
+    if pc is not None:
+        for k, v in pc.gauges().items():
+            reg.gauge_set(f"pressure.{k}", v)
+    try:
+        est = pressure_mod.estimate_hbm_bytes(sim)
+    except Exception:
+        return  # estimator is best-effort telemetry, never a run failure
+    reg.gauge_set("pressure.estimated_bytes", int(est["total_bytes"]))
+    hb = pressure_mod.headroom_bytes(est["total_bytes"])
+    if hb is not None:
+        reg.gauge_set("pressure.headroom_bytes", int(hb))
 
 
 def snapshot_fleet(fleet, reg: MetricsRegistry) -> None:
@@ -347,6 +382,7 @@ def snapshot_fleet(fleet, reg: MetricsRegistry) -> None:
     if res_stats is not None:
         for k, v in res_stats().items():
             reg.counter_set(f"resilience.{k}", int(v))
+    _snapshot_pressure(fleet, reg)
     reg.section_set("fleet", {
         "lanes": int(stats.get("lanes", 0)),
         "lane_swaps": int(stats.get("lane_swaps", 0)),

@@ -686,6 +686,9 @@ class IslandSimulation(Simulation):
             with metrics_mod.span(obs, "dispatch", windows=wpd):
 
                 def _dispatch(stop_at=stop_at, wpd=wpd):
+                    # per-attempt clamp: a pressure rung may have engaged
+                    # the spill tier since the driver computed stop_at
+                    stop_at, wpd = self._live_spill_clamp(stop_at, wpd)
                     st, mn, press, occ, w = self._run_to(
                         self.state, self.params, stop_at, wpd
                     )
@@ -714,11 +717,19 @@ class IslandSimulation(Simulation):
                 break
             cur = (mn, spill.count, press)
             if cur == last and mn >= stop_at and not shifted:
-                raise RuntimeError(
+                cap = self._gear_ladder[self._gear].capacity
+                if self._pressure_stall(window=mn, occupancy=occ,
+                                        capacity=cap):
+                    last = None  # a ladder rung reshaped the tier
+                    continue
+                raise self._pool_exhausted(
                     "spill tier cannot make progress (single over-full "
                     "timestamp or no pool headroom for one window's "
-                    "emissions); raise experimental.event_capacity"
+                    "emissions); raise experimental.event_capacity",
+                    window=mn, occupancy=occ, capacity=cap,
                 )
+            elif self.pressure is not None:
+                self.pressure.note_progress()
             last = cur
 
     def run_stepwise(self, until=None) -> int:
@@ -747,13 +758,22 @@ class IslandSimulation(Simulation):
                     break
                 stall += 1
                 if stall > 2:
-                    raise RuntimeError(
+                    occ = self._pool_occupancy()
+                    cap = self._gear_ladder[self._gear].capacity
+                    if self._pressure_stall(window=min_next, occupancy=occ,
+                                            capacity=cap):
+                        stall = 0  # a ladder rung reshaped the tier
+                        continue
+                    raise self._pool_exhausted(
                         "spill tier cannot make progress (single over-full "
                         "timestamp or no pool headroom for one window's "
-                        "emissions); raise experimental.event_capacity"
+                        "emissions); raise experimental.event_capacity",
+                        window=min_next, occupancy=occ, capacity=cap,
                     )
                 continue
             stall = 0
+            if self.pressure is not None:
+                self.pressure.note_progress()
             ws = min_next
             clamp = int(jax.device_get(
                 jnp.min(self.state.exch_deferred_min)
@@ -762,7 +782,10 @@ class IslandSimulation(Simulation):
             with metrics_mod.span(obs, "dispatch", windows=1):
 
                 def _dispatch(ws=ws, we=we):
-                    st, mn = self._step(self.state, self.params, ws, we)
+                    we, _ = self._live_spill_clamp(we, 1)
+                    st, mn = self._step(
+                        self.state, self.params, ws, max(ws, we)
+                    )
                     return st, int(np.min(np.asarray(jax.device_get(mn))))
 
                 self.state, mn = self._sv("step", _dispatch)
@@ -925,6 +948,9 @@ class IslandSimulation(Simulation):
             rb0 = rollbacks
             shrinks = 0
             never = int(simtime.NEVER)
+            # reshaping pressure rungs are unsafe while `base` pins the
+            # compiled shapes (core/pressure.py)
+            self._pressure_reshape_ok = False
             while True:  # attempt [ws, we); shrink on violation
                 # host-driven sub-step loop (see _ensure_optimistic): one
                 # dispatch per sub-step until the window completes or a
@@ -934,11 +960,18 @@ class IslandSimulation(Simulation):
                 while mn_i < we and viol >= never:
                     if k >= _MAX_SUBSTEPS:
                         if mn_i <= ws:
-                            raise RuntimeError(
+                            # mid-attempt: no reshaping rung is safe
+                            # (the snapshot pins the compiled shapes) —
+                            # typed exhaustion, never a bare RuntimeError
+                            raise self._pool_exhausted(
                                 "optimistic attempt cannot make progress "
                                 "(pool-headroom stall: the window commits "
                                 "nothing and its frontier is frozen); "
-                                "raise experimental.event_capacity"
+                                "raise experimental.event_capacity",
+                                window=ws,
+                                occupancy=self._pool_occupancy(),
+                                capacity=self._gear_ladder[
+                                    self._gear].capacity,
                             )
                         # genuinely enormous window: shrink to the
                         # reached frontier, retry from the snapshot
@@ -989,6 +1022,9 @@ class IslandSimulation(Simulation):
                 if obs is not None and obs.tracer:
                     obs.tracer.instant("rollback", viol_ns=viol)
                 we = min(max(viol, floor), stop)
+            self._pressure_reshape_ok = True
+            if self.pressure is not None:
+                self.pressure.note_progress()
             # exchange rounds of the ACCEPTED attempt only: rolled-back
             # sub-steps' exchange counters are discarded with the rollback,
             # and suggest_exchange_slots normalizes sent/windows_run

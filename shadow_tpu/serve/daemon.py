@@ -22,16 +22,20 @@ across restarts. Three mechanisms make its own death a non-event:
 3. **Graceful degradation**: SIGTERM drains the running fleet to its
    checkpoint (one dispatch of latency, then a clean exit whose journal
    DRAIN record lets the next boot resume); admission applies per-tenant
-   quotas and queue-depth backpressure (HTTP 429 with a Retry-After
-   derived from scheduler occupancy: queue depth x the EWMA sweep wall
-   time); `/healthz` reports backend liveness (the supervisor probe of
-   core/supervisor.py — the cs/0409032 bounded-lag signal), queue depth,
-   and journal lag. Backend loss mid-sweep rides the PR-6 supervision
+   quotas, queue-depth backpressure AND memory-aware preflight (a sweep
+   whose estimated HBM footprint exceeds the live headroom —
+   core/pressure.estimate_config_bytes vs device_memory_budget — sheds
+   HTTP 429 `memory_pressure` instead of OOMing mid-run); `/healthz`
+   reports backend liveness (the supervisor probe of core/supervisor.py
+   — the cs/0409032 bounded-lag signal), queue depth, journal lag, the
+   memory-headroom gauges, and the running fleet's pressure-ladder
+   posture (journaled as PRESSURE records as rungs fire). Backend loss mid-sweep rides the PR-6 supervision
    plane: the fleet drains, the sweep is journaled REQUEUE, and the
    worker retries it — `kill_backend` fault plans submitted with a sweep
    drive this end to end in chaos tests.
 
-Metrics ride the schema-v7 `serve.*` namespace (obs/metrics.py), dumped
+Metrics ride the schema-v8 `serve.*` + `pressure.*` namespaces
+(obs/metrics.py), dumped
 to `<state-dir>/serve.metrics.json` at every sweep settlement.
 """
 
@@ -110,9 +114,18 @@ class ShadowDaemon:
             "sweeps_drained": 0,
             "jobs_completed": 0,
             "sheds": 0,
+            "memory_sheds": 0,
+            "pressure_records": 0,
             "journal_replays": 0,
             "kernel_traces": 0,
         }
+        # memory-aware admission (core/pressure.py, docs/serving.md): the
+        # running sweep's preflight HBM estimate, compared against the
+        # device budget when one is known; updated on admit/settle. The
+        # last published pressure-ladder posture rides /healthz.
+        self._running_est_bytes = 0
+        self._last_pressure: dict = {}
+        self._journaled_pressure: dict[str, int] = {}
         # replay: fold the journal into scheduler-plane truth
         st = self.journal.state()
         self.sweeps: dict[str, dict] = {
@@ -144,6 +157,33 @@ class ShadowDaemon:
         queue depth (sweeps ahead) x the EWMA completed-sweep wall."""
         depth = len(self._queue) + (1 if self._running else 0)
         return max(1, int(round(depth * self._avg_sweep_wall_s)))
+
+    def _memory_view(self) -> dict:
+        """The /healthz memory-headroom gauges (docs/serving.md): device
+        budget, the running sweep's preflight estimate, and live
+        headroom (nulls when the backend reports no limit)."""
+        from shadow_tpu.core import pressure as pressure_mod
+
+        budget = pressure_mod.device_memory_budget()
+        return {
+            "budget_bytes": budget,
+            "estimated_running_bytes": int(self._running_est_bytes),
+            "headroom_bytes": (
+                budget - int(self._running_est_bytes)
+                if budget is not None else None
+            ),
+        }
+
+    @staticmethod
+    def _estimate_sweep_bytes(jobs, lanes) -> int:
+        """Preflight footprint of a sweep: per-job config estimate x the
+        lane count it will occupy (core/pressure.estimate_config_bytes)."""
+        from shadow_tpu.core.config import load_config
+        from shadow_tpu.core import pressure as pressure_mod
+
+        cfg = load_config(jobs[0].config)
+        L = min(len(jobs), lanes) if lanes else len(jobs)
+        return pressure_mod.estimate_config_bytes(cfg, lanes=L)
 
     def submit(self, doc: dict, tenant: str = "default",
                backend_faults: list | None = None) -> dict:
@@ -178,7 +218,7 @@ class ShadowDaemon:
         # (a slow config build must not block /healthz), and fail the
         # submission here with the offending job named — never mid-fleet
         try:
-            jobs, _ = load_sweep(doc)
+            jobs, sweep_opts = load_sweep(doc)
         except (SweepError, ValueError) as e:
             raise ServeError(str(e)) from e
         if backend_faults:
@@ -187,7 +227,32 @@ class ShadowDaemon:
             plan_mod.check_backend_ops(
                 plan_mod.parse_fault_plan(backend_faults)
             )
+        # memory-aware admission (docs/serving.md): preflight the sweep's
+        # HBM footprint against the live headroom — a sweep the device
+        # cannot place sheds NOW with a 429, instead of OOMing mid-run
+        from shadow_tpu.core import pressure as pressure_mod
+
+        lanes = self.opts.lanes or (
+            int(sweep_opts["lanes"]) if sweep_opts.get("lanes") else None
+        )
+        try:
+            est_bytes = self._estimate_sweep_bytes(jobs, lanes)
+        except (ValueError, OSError):
+            est_bytes = 0  # advisory: a truly bad config failed above
+        budget = pressure_mod.device_memory_budget()
         with self._lock:
+            if budget is not None \
+                    and est_bytes > budget - self._running_est_bytes:
+                self.counters["sheds"] += 1
+                self.counters["memory_sheds"] += 1
+                return {
+                    "shed": "memory_pressure",
+                    "estimated_bytes": int(est_bytes),
+                    "headroom_bytes": int(
+                        budget - self._running_est_bytes
+                    ),
+                    "retry_after_s": self.retry_after_s(),
+                }
             sid = f"s{self._seq:06d}"
             self._seq += 1
             self.journal.append(
@@ -238,6 +303,8 @@ class ShadowDaemon:
                     "torn_tail_dropped": self.journal.torn_tail_dropped,
                 },
                 "kcache": self.kcache.stats(),
+                "memory": self._memory_view(),
+                "pressure": dict(self._last_pressure),
                 "retry_after_s": self.retry_after_s(),
             }
 
@@ -273,6 +340,18 @@ class ShadowDaemon:
                 "serve.draining", int(self._draining.is_set())
             )
             reg.gauge_set("serve.kcache_entries", self.kcache.entries())
+            # pressure plane (schema v8): the memory-headroom gauges the
+            # memory-aware admission compares, + ladder posture counters
+            mem = self._memory_view()
+            reg.gauge_set(
+                "pressure.estimated_bytes", mem["estimated_running_bytes"]
+            )
+            if mem["headroom_bytes"] is not None:
+                reg.gauge_set(
+                    "pressure.headroom_bytes", mem["headroom_bytes"]
+                )
+            for k, v in self._last_pressure.items():
+                reg.counter_set(f"pressure.{k}", int(v))
         return reg.to_doc(meta={"daemon": "shadow_tpu serve"})
 
     def _dump_metrics(self) -> None:
@@ -349,13 +428,25 @@ class ShadowDaemon:
 
     def _publish_progress(self, sid: str, fleet) -> None:
         st = fleet.sched.stats()
+        pst = fleet.pressure_stats()
         with self._lock:
             self.sweeps[sid]["progress"] = {
                 "jobs_done": st["jobs_done"],
                 "jobs_running": st["jobs_running"],
                 "jobs_queued": st["jobs_queued"],
                 "kernel_traces": fleet.kernel_traces,
+                "pressure_steps": int(pst.get("ladder_steps", 0)),
             }
+            self._last_pressure = pst
+            # journal each new batch of ladder rungs: a post-mortem can
+            # see WHEN the sweep started degrading even if we die next
+            steps = int(pst.get("ladder_steps", 0))
+            if steps > self._journaled_pressure.get(sid, 0):
+                self._journaled_pressure[sid] = steps
+                self.journal.append(
+                    journal_mod.PRESSURE, id=sid, steps=steps, counters=pst
+                )
+                self.counters["pressure_records"] += 1
 
     def _run_sweep(self, sid: str) -> None:
         from shadow_tpu.core.checkpoint import CheckpointError
@@ -378,6 +469,16 @@ class ShadowDaemon:
             if fleet is None:
                 self._settle_from_manifest(sid, settled_manifest)
                 return
+            # the live footprint the admission check subtracts from the
+            # device budget while this sweep runs (docs/serving.md)
+            from shadow_tpu.core import pressure as pressure_mod
+
+            try:
+                self._running_est_bytes = pressure_mod.estimate_hbm_bytes(
+                    fleet
+                )["total_bytes"]
+            except Exception:
+                self._running_est_bytes = 0
             # first manifest BEFORE the first dispatch: a kill landing
             # anywhere after this point re-attaches instead of rebuilding
             save_fleet(fleet, ckpt_dir)
@@ -426,6 +527,8 @@ class ShadowDaemon:
                 self.counters["sweeps_failed"] += 1
                 self._running = None
             self._dump_metrics()
+        finally:
+            self._running_est_bytes = 0
 
     def _settle(self, sid: str, fleet, wall_s: float) -> None:
         rows = fleet.results()

@@ -14,6 +14,10 @@ scheduler-plane transition is journaled BEFORE it takes effect:
              checkpoint (SIGTERM path); replay resumes from the slices
     REQUEUE  an admitted sweep was returned to the queue (backend loss
              under policy abort, or an operator requeue)
+    PRESSURE the running fleet's degradation ladder took rungs
+             (core/pressure.py): the cumulative pressure counters ride
+             the record, so a post-mortem can see WHEN a sweep started
+             degrading even if the daemon later died
     COMPLETE the sweep finished; per-job results (including each job's
              `audit.chain` digest) ride the record
 
@@ -46,9 +50,10 @@ SUBMIT = "submit"
 ADMIT = "admit"
 DRAIN = "drain"
 REQUEUE = "requeue"
+PRESSURE = "pressure"
 COMPLETE = "complete"
 
-RECORD_TYPES = (SUBMIT, ADMIT, DRAIN, REQUEUE, COMPLETE)
+RECORD_TYPES = (SUBMIT, ADMIT, DRAIN, REQUEUE, PRESSURE, COMPLETE)
 
 
 class JournalError(ValueError):
@@ -186,6 +191,10 @@ class JournalState:
                 s["status"] = "drained"
             elif t == REQUEUE:
                 s["status"] = "queued"
+            elif t == PRESSURE:
+                # informational: latest ladder posture; never a status
+                # transition (the sweep keeps running degraded)
+                s["pressure"] = rec.get("counters")
             elif t == COMPLETE:
                 s["status"] = "done" if rec.get("ok") else "failed"
                 s["results"] = rec.get("results")

@@ -40,6 +40,9 @@ _BUDGET_TIER = {
     "test_observability": 2, "test_net_stack": 2, "test_bridge": 2,
     "test_sim_build": 3, "test_spill": 3, "test_optimistic": 3,
     "test_audit": 3, "test_resilience": 3, "test_analysis": 3,
+    # the pressure chaos matrix is an acceptance gate: before the
+    # compile-heavy parity matrices, like test_serve
+    "test_pressure": 3,
     # the serve chaos choreography is an acceptance gate: it must land
     # BEFORE the compile-heavy parity matrices so a budget truncation
     # never silently skips it

@@ -153,6 +153,9 @@ def test_kernel_vs_host_classification():
         "shadow_tpu/obs/metrics.py",
         "shadow_tpu/procs/driver.py", "shadow_tpu/core/config.py",
         "shadow_tpu/fleet/scheduler.py", "shadow_tpu/faults/injector.py",
+        # the pressure ladder (ISSUE 9) is pure host bookkeeping: every
+        # rung executes at a dispatch boundary, nothing is ever traced
+        "shadow_tpu/core/pressure.py",
         "tools/shadowlint.py", "bench.py",
     ]
     for p in kernels:
@@ -361,6 +364,31 @@ def test_driver_smoke_run_has_no_retraces():
     rep = hlo_audit.assert_no_retrace(sim)
     assert rep["compiles_total"] == 1  # ONE run_to lowering for the run
     assert rep["kernels"]["gear0.run_to"] == 1
+
+
+def test_pressure_ladder_catch_paths_are_retrace_free():
+    """ISSUE 9 regression: driver catch-paths stay retrace-free — a
+    pressure-ladder engagement must not re-lower an already-bound kernel
+    per rung. Spill-escalation rungs reuse the bound gear's kernel, so a
+    run that absorbed TWO separate exhaustion episodes still shows one
+    lowering per bound kernel (a downshift binding a NEW gear is one
+    fresh compile, not a retrace — the detector's per-kernel cap covers
+    both)."""
+    from shadow_tpu.core.supervisor import BackendSupervisor
+    from shadow_tpu.faults import plan as plan_mod
+
+    sim = _tiny_phold()
+    sim.attach_supervisor(
+        BackendSupervisor("wait", sleep=lambda s: None)
+    )
+    sim.attach_faults(plan_mod.parse_fault_plan([
+        {"at": "500 ms", "op": "exhaust_backend", "recover_after": 1},
+        {"at": "1500 ms", "op": "exhaust_backend", "recover_after": 1},
+    ]))
+    sim.run()
+    assert sim.pressure_stats()["ladder_steps"] == 2
+    rep = hlo_audit.assert_no_retrace(sim)
+    assert rep["compiles_total"] == 1  # both rungs reused the bound kernel
 
 
 def test_retrace_detector_catches_dtype_drift():

@@ -24,6 +24,7 @@ from shadow_tpu.core.supervisor import (
     BackendLost,
     BackendSupervisor,
     FATAL,
+    RESOURCE_EXHAUSTED,
     TRANSIENT,
     classify_failure,
 )
@@ -107,7 +108,11 @@ def test_classify_failure():
     assert classify_failure(RuntimeError("connection reset by peer")) \
         == BACKEND_LOST
     assert classify_failure(BackendLost("x")) == BACKEND_LOST
+    # schema-v8 pressure plane: XLA OOM is its own class now — the
+    # degradation ladder handles it, not a blind retry (PR 9)
     assert classify_failure(RuntimeError("RESOURCE_EXHAUSTED: hbm")) \
+        == RESOURCE_EXHAUSTED
+    assert classify_failure(RuntimeError("ABORTED: collective")) \
         == TRANSIENT
     assert classify_failure(ValueError("shape mismatch")) == FATAL
     assert classify_failure(RuntimeError("speculation violation")) == FATAL
@@ -496,7 +501,7 @@ def test_metrics_schema_v6_resilience_namespace():
     reg = obs_metrics.MetricsRegistry()
     obs_metrics.snapshot_device(sim, reg)
     doc = reg.to_doc()
-    assert doc["schema_version"] == 7
+    assert doc["schema_version"] == 8
     obs_metrics.validate_metrics_doc(doc)
     assert doc["counters"]["resilience.drains"] == 1
     assert doc["counters"]["resilience.failovers"] == 1

@@ -345,7 +345,7 @@ def test_daemon_chaos_sigterm_drain_then_sigkill_replay(
     # cache (the kernel_traces-gated property)
     assert info["stats"]["kernel_traces"] == 0
 
-    # schema-v7 serve.* metrics document
+    # schema-v8 serve.* metrics document
     from shadow_tpu.obs import metrics as obs_metrics
 
     doc = client.metrics()

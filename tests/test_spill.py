@@ -103,6 +103,37 @@ def test_undersized_islands_pool_matches():
 
 
 @pytest.mark.quick
+def test_saturate_pool_spill_escalation_preserves_order_and_chain():
+    """Pressure plane (ISSUE 9): a `saturate_pool` injection mid-run
+    scales the spill marks down and forces sustained spill escalation —
+    the run must still commit the identical events in the identical
+    per-host order (the audit digest chain folds commit order, so chain
+    equality IS the order proof), with the same app-level results as the
+    unsaturated control."""
+    from shadow_tpu.faults import plan as plan_mod
+
+    control = build_simulation(_cfg(1 << 13))
+    control.run_stepwise()
+    cc = control.counters()
+    chain = control.audit_chain()
+    assert control.spill_stats()["spill_episodes"] == 0  # sized fine
+
+    sat = build_simulation(_cfg(1 << 13))
+    sat.attach_faults(plan_mod.parse_fault_plan(
+        [{"at": "500 ms", "op": "saturate_pool", "frac": 0.05}]
+    ))
+    sat.run_stepwise()
+    st = sat.spill_stats()
+    assert st["spill_episodes"] > 0, "saturation never engaged the spill"
+    assert st["spill_resident"] == 0, "spill must fully drain by stop"
+    assert sat.pressure_stats()["saturations"] == 1
+    assert sat.audit_chain() == chain
+    for k in _KEYS:
+        assert cc[k] == sat.counters()[k], k
+    assert (_recv(control) == _recv(sat)).all()
+
+
+@pytest.mark.quick
 def test_spill_under_exchange_backpressure_matches():
     """Deferral × spill combined (ADVICE r4, high): exchange_slots=1 keeps
     cross-shard rows IN TRANSIT across windows while the undersized pool

@@ -37,15 +37,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--timeout", type=float, default=60.0, metavar="SECS")
     sub = p.add_subparsers(dest="cmd", required=True)
     sub.add_parser("health", help="GET /healthz")
-    sub.add_parser("metrics", help="GET /metricz (schema-v7 serve.* doc)")
+    sub.add_parser("metrics", help="GET /metricz (schema-v8 serve.* + pressure.* doc)")
     sub.add_parser("drain", help="graceful drain: flush the running "
                    "fleet to its checkpoint and exit")
     ps = sub.add_parser("submit", help="submit a sweep document")
     ps.add_argument("sweep", help="sweep YAML (base config + sweep: matrix)")
     ps.add_argument("--tenant", default="default")
     ps.add_argument("--fault-plan", metavar="JSON",
-                    help="daemon-level chaos plan (backend ops only: "
-                    "kill_backend/stall_backend) attached to this sweep")
+                    help="daemon-level chaos plan (backend + pressure "
+                    "ops: kill_backend/stall_backend/exhaust_backend/"
+                    "saturate_pool) attached to this sweep")
     pst = sub.add_parser("status", help="list sweeps, or show one")
     pst.add_argument("id", nargs="?")
     pr = sub.add_parser("results", help="print a sweep's per-job rows")
@@ -94,6 +95,21 @@ def main(argv: list[str] | None = None) -> int:
             if args.id:
                 print(json.dumps(client.sweep(args.id), indent=1))
             else:
+                # lead with the daemon's live posture: memory headroom +
+                # pressure-ladder gauges from /healthz (docs/serving.md)
+                h = client.health()
+                print(json.dumps({
+                    "health": {
+                        "ok": h.get("ok"),
+                        "queue_depth": h.get("queue", {}).get("depth"),
+                        "memory": h.get("memory"),
+                        "pressure": {
+                            k: v
+                            for k, v in (h.get("pressure") or {}).items()
+                            if v
+                        },
+                    }
+                }))
                 for row in client.sweeps():
                     print(json.dumps(row))
             return 0

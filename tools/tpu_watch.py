@@ -53,6 +53,7 @@ STAGES = [
     ("resilience_smoke", [PY, "bench.py", "--resilience-smoke"],
      False, 7200),
     ("serve_smoke", [PY, "bench.py", "--serve-smoke"], False, 7200),
+    ("pressure_smoke", [PY, "bench.py", "--pressure-smoke"], False, 7200),
     ("stages_10k", [PY, "bench.py", "--stages"], False, 10800),
     ("stages_50k", [PY, "bench.py", "--stages-50k"], False, 14400),
     ("stages_100k", [PY, "bench.py", "--stages-100k"], False, 10800),

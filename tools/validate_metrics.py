@@ -2,7 +2,8 @@
 """Validate metrics JSON documents against the reference schema.
 
 A standalone CLI wrapper over `obs.metrics.validate_metrics_doc`
-(docs/observability.md, schema v7 — v7 added the `serve.*`
+(docs/observability.md, schema v8 — v8 added the `pressure.*`
+resource-pressure namespace; v7 added the `serve.*`
 sim-as-a-service daemon namespace): CI and tools/tpu_watch.py gate every
 captured metrics artifact with this at capture time, so a schema
 regression is caught on the line that produced it, not months later by a
