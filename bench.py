@@ -1089,6 +1089,156 @@ def stage_pressure_smoke(num_hosts: int = 512, msgload: int = 4,
     }
 
 
+def _async_smoke_gml(shards: int, per: int, seed: int = 7) -> str:
+    """The async-smoke topology: one vertex per host; DECOHERED
+    intra-shard latencies (events stop clustering on a shared lattice, so
+    the barrier can't batch different shards' windows together) with
+    shard 0 drawn from a faster band (the DELIBERATE imbalance: it needs
+    ~2x the windows of any other shard and serializes the barrier
+    driver); cross-shard latencies large and distinct — the generous
+    lookahead that lets every other shard run its own windows
+    concurrently instead of idling through shard 0's."""
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    n = shards * per
+
+    def band(a: int, b: int) -> tuple[int, int]:
+        if a // per != b // per:
+            return 700000, 900000  # cross-shard: the generous lookahead
+        return (5000, 120000) if a // per == 0 else (60000, 250000)
+
+    lines = ["graph ["]
+    for v in range(n):
+        lines.append(f"  node [ id {v} ]")
+    for a in range(n):
+        for b in range(a, n):
+            lo, hi = band(a, b)
+            lines.append(
+                f'  edge [ source {a} target {b} latency '
+                f'"{int(rng.randint(lo, hi))} us" ]'
+            )
+    lines.append("]")
+    return "\n".join(lines)
+
+
+def stage_async_smoke(shards: int = 4, hosts_per_shard: int = 4,
+                      stop_s: int = 30, span: int = 2):
+    """Async conservative-sync gate (ISSUE 10 acceptance): a deliberately
+    imbalanced islands workload (locality-biased PHOLD on a decohered
+    topology whose shard 0 runs a ~2x faster event timescale) driven by
+    the barrier loop vs the per-shard-frontier async loop
+    (parallel/islands.make_shard_run_to_async). Gates:
+
+      * async wall < barrier wall, with the mechanism pinned by the
+        superstep ratio (async needs strictly fewer device-loop
+        iterations — the barrier serializes the union of all shards'
+        windows, async overlaps them);
+      * the global audit digest chain is BIT-IDENTICAL to the barrier
+        run's (and committed events equal) — asynchrony changed the
+        schedule, never the simulation;
+      * the schema-v9 metrics artifact records async.* and validates
+        under --strict-namespaces.
+
+    CPU-deterministic by design (both arms run the same CPU backend), so
+    no backend wait."""
+    import jax
+
+    from shadow_tpu.core import simtime
+    from shadow_tpu.obs import metrics as obs_metrics
+    from shadow_tpu.sim import build_simulation
+
+    gml = _async_smoke_gml(shards, hosts_per_shard)
+
+    def cfg(async_on: bool) -> dict:
+        hosts = {}
+        for v in range(shards * hosts_per_shard):
+            hosts[f"h{v:02d}"] = {
+                "quantity": 1, "network_node_id": v, "app_model": "phold",
+                "app_options": {
+                    "msgload": 1, "runtime": stop_s - 1, "local_span": span,
+                },
+            }
+        return {
+            "general": {"stop_time": stop_s, "seed": 42},
+            "network": {"graph": {"type": "gml", "inline": gml}},
+            "experimental": {
+                "event_capacity": 2048, "events_per_host_per_window": 8,
+                "outbox_slots": 8, "inbox_slots": 4,
+                "num_shards": shards, "exchange_slots": 32,
+                "async_islands": async_on,
+            },
+            "hosts": hosts,
+        }
+
+    def run_arm(async_on: bool):
+        sim = build_simulation(cfg(async_on))
+        # warm through compile + the aligned start burst, then time the
+        # steady decohered region
+        sim.run(until=2 * simtime.NS_PER_SEC, windows_per_dispatch=4096)
+        jax.block_until_ready(sim.state.pool.time)
+        t0 = time.perf_counter()
+        sim.run(windows_per_dispatch=4096)
+        jax.block_until_ready(sim.state.pool.time)
+        return sim, time.perf_counter() - t0
+
+    # interleave arms to decorrelate machine drift from the comparison
+    barrier, w_b = run_arm(False)
+    async_sim, w_a = run_arm(True)
+    w_b = min(w_b, run_arm(False)[1])
+    w_a = min(w_a, run_arm(True)[1])
+
+    chain_equal = barrier.audit_chain() == async_sim.audit_chain()
+    ev_b = barrier.counters()["events_committed"]
+    ev_a = async_sim.counters()["events_committed"]
+    steps_b, steps_a = barrier.windows_run, async_sim.windows_run
+    astats = async_sim.async_stats() or {}
+
+    metrics_path = os.path.join(_REPO, "async_smoke.metrics.json")
+    session = obs_metrics.ObsSession()
+    session.finalize(async_sim)
+    doc = session.metrics.dump(metrics_path, meta={
+        "stage": "async_smoke", "hosts": shards * hosts_per_shard,
+        "shards": shards,
+    })
+    obs_metrics.validate_metrics_doc(doc, strict_namespaces=True)
+    async_recorded = (
+        doc["counters"].get("async.supersteps", 0) > 0
+        and "async.frontier_spread_max_ns" in doc["gauges"]
+    )
+
+    gate_wall = w_a < w_b
+    gate_steps = steps_a < steps_b
+    gate_chain = bool(chain_equal and ev_a == ev_b)
+    return {
+        "stage": "async_smoke",
+        "platform": jax.default_backend(),
+        "hosts": shards * hosts_per_shard,
+        "shards": shards,
+        "events": int(ev_a),
+        "events_equal": ev_a == ev_b,
+        "chain": int(async_sim.audit_chain()),
+        "chain_equal": chain_equal,
+        "supersteps_barrier": int(steps_b),
+        "supersteps_async": int(steps_a),
+        "superstep_ratio": round(steps_b / max(steps_a, 1), 2),
+        "wall_barrier_s": round(w_b, 3),
+        "wall_async_s": round(w_a, 3),
+        "wall_ratio": round(w_b / w_a, 2) if w_a else 0.0,
+        "async": {k: int(v) for k, v in sorted(astats.items())},
+        "frontier_spread_max_ns": int(
+            doc["gauges"].get("async.frontier_spread_max_ns", -1)
+        ),
+        "metrics_out": os.path.relpath(metrics_path, _REPO),
+        "gate_wall": gate_wall,
+        "gate_supersteps": gate_steps,
+        "gate_chain": gate_chain,
+        "gate": bool(
+            gate_wall and gate_steps and gate_chain and async_recorded
+        ),
+    }
+
+
 _SERVE_SMOKE_SWEEP = {
     "sweep": {
         "name": "serve-smoke",
@@ -1269,6 +1419,14 @@ def main():
         # deterministic by design, so no backend wait.
         os.environ.setdefault("SHADOW_TPU_BENCH_ALLOW_CPU", "1")
         print(json.dumps(stage_serve_smoke()), flush=True)
+        return
+    if "--async-smoke" in sys.argv:
+        # async conservative-sync gate: per-shard frontiers beat the
+        # window barrier on an imbalanced islands workload with a
+        # bit-identical audit chain. Both arms run the same backend, so
+        # the comparison is CPU-deterministic — no backend wait.
+        os.environ.setdefault("SHADOW_TPU_BENCH_ALLOW_CPU", "1")
+        print(json.dumps(stage_async_smoke()), flush=True)
         return
     if "--pressure-smoke" in sys.argv:
         # pressure-plane gate: exhaust_backend / saturate_pool injections

@@ -239,6 +239,31 @@ def variants_for_sim(sim, layout: str, *, sync_modes=SYNC_MODES,
                 max_sort_rows=bound, max_serializing_gathers=allow,
                 lower=lower,
             ))
+        # async conservative loop (parallel/islands.make_shard_run_to_async):
+        # the fused per-shard-frontier kernel an async islands build
+        # actually dispatches — the frontier all_gather and horizon math
+        # must not smuggle in a scatter/serializing gather, and the loop
+        # body's sorts are the same step sorts (same structural bound)
+        if "conservative" in sync_modes and getattr(sim, "_async", False):
+            def lower_async(sim=sim, level=level):
+                _bind_gear(sim, level)
+                fn = sim._gear_fns[level]["run_to_async"]
+                return (
+                    fn.lower(
+                        sim.state, sim.params, sim._async_runahead,
+                        sim._async_look_in, sim._async_spread,
+                        win_end, 8,
+                    )
+                    .compile()
+                    .as_text()
+                )
+
+            out.append(KernelVariant(
+                sync="async", layout=layout, gear=level,
+                label=f"{layout}/async/gear{level}",
+                max_sort_rows=bound, max_serializing_gathers=0,
+                lower=lower_async,
+            ))
     return out
 
 
@@ -262,10 +287,20 @@ def variants_for_fleet(fleet, *, sync_modes=SYNC_MODES, gears=None,
                 we = jnp.full((L,), win_end, jnp.int64)
                 if sync == "conservative":
                     fn = fleet._gear_fns[level]["run_to"]
-                    lowered = fn.lower(
-                        fleet.state, fleet.params,
-                        jnp.asarray(np.asarray(fleet._runahead)), we, 8,
-                    )
+                    if getattr(fleet, "_async", False):
+                        # async fleets dispatch the per-shard-frontier
+                        # loop: per-lane width/lookahead/spread stacks
+                        lowered = fn.lower(
+                            fleet.state, fleet.params,
+                            jnp.asarray(fleet._async_runahead),
+                            jnp.asarray(fleet._async_look),
+                            jnp.asarray(fleet._async_spread), we, 8,
+                        )
+                    else:
+                        lowered = fn.lower(
+                            fleet.state, fleet.params,
+                            jnp.asarray(np.asarray(fleet._runahead)), we, 8,
+                        )
                 else:
                     fleet._ensure_attempt()
                     fn = fleet._gear_fns[level]["attempt"]

@@ -109,6 +109,16 @@ def save(sim, path: str, extra_meta: dict | None = None) -> None:
                 np.asarray(jax.device_get(ob.host_digest))
             ),
         }
+    # Async conservative sync (parallel/islands.py): the derived
+    # per-shard window widths / lookahead critical link / last frontier
+    # surface ride the header so an operator can audit a resumed run's
+    # async posture — informational only (resume re-derives frontiers
+    # from pool state, so the restart is always safe).
+    am = getattr(sim, "_async_meta", None)
+    if am is not None:
+        a = am()
+        if a:
+            meta["async"] = a
     if extra_meta:
         meta.update(extra_meta)
     meta["digest"] = _digest(arrays)

@@ -189,6 +189,15 @@ class ExperimentalOptions:
     num_shards: int = 1
     exchange_slots: int = 0  # 0 = auto-size
     island_mode: str = "vmap"  # "vmap" | "shard_map"
+    # Asynchronous conservative sync (cs/0409032): the fused islands
+    # driver advances per-shard virtual-time frontiers bounded by
+    # topology-derived lookahead instead of one fleet-wide window
+    # barrier; false restores the lockstep barrier loop. async_spread
+    # bounds how far (ns of virtual time) any shard may run ahead of the
+    # slowest before yielding its slot (roughness suppression,
+    # cond-mat/0302050); 0 auto-derives from the lookahead matrix.
+    async_islands: bool = True
+    async_spread: int = 0
     # Between-window host->shard re-sharding on load skew (the P3
     # work-stealing replacement, scheduler_policy_host_steal.c analog).
     rebalance: bool = False
@@ -287,6 +296,16 @@ class ExperimentalOptions:
                 )
         if "rebalance" in d:
             out.rebalance = bool(d["rebalance"])
+        if "async_islands" in d:
+            out.async_islands = bool(d["async_islands"])
+        if d.get("async_spread") is not None:
+            out.async_spread = units.parse_time_ns(
+                d["async_spread"], default_unit="ns"
+            )
+            if out.async_spread < 0:
+                raise ConfigError(
+                    "experimental.async_spread must be >= 0 ns"
+                )
         if "island_mode" in d:
             v = str(d["island_mode"]).lower()
             if v not in ("vmap", "shard_map"):

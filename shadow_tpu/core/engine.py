@@ -2014,10 +2014,11 @@ class Simulation:
                         raise RuntimeError(
                             f"speculation violation at t={viol} inside a "
                             f"conservative-width window [{ws}, {we}): the "
-                            f"conservative-width invariant is broken "
-                            f"(runahead {cons} exceeds a real path "
-                            f"latency, or a handler emitted into the "
-                            f"past); refusing to commit"
+                            f"conservative-width invariant is broken — "
+                            f"runahead {cons} ns exceeds a real path "
+                            f"latency ({self._runahead_bound_hint()}), or "
+                            f"a handler emitted into the past; refusing "
+                            f"to commit"
                         )
                     if viol >= int(simtime.NEVER) or we <= ws + cons:
                         break
@@ -2047,6 +2048,21 @@ class Simulation:
                     factor, streak, rollbacks > rb0, window_factor
                 )
         return windows, rollbacks
+
+    def _runahead_bound_hint(self) -> str:
+        """The actually-safe runahead bound for conservative-width
+        violation errors: the minimum finite baked path latency. The
+        islands engine overrides this with the partition-derived
+        cross-shard lookahead (parallel/lookahead.py), naming the
+        critical shard link."""
+        lat = np.asarray(jax.device_get(self.params.latency_vv))
+        finite = lat[lat < int(simtime.NEVER)]
+        if finite.size == 0:
+            return "the topology bakes no finite path latency"
+        return (
+            f"minimum baked topology path latency is {int(finite.min())} "
+            f"ns; set experimental.runahead <= {int(finite.min())} ns"
+        )
 
     # -- host-spill tier (core/spill.py): the pool never silently drops --
     def _spill_marks(self) -> tuple[int, int]:

@@ -184,6 +184,64 @@ class GearShifter:
         return None
 
 
+class ShardGearShifter:
+    """Per-shard gearing for the ASYNC islands driver (parallel/islands):
+    each shard carries its own hysteresis ladder state — occupancy target,
+    red-zone demand, and downshift streak — updated at its own dispatch
+    boundaries from the per-shard occupancy vector the async kernel
+    returns, instead of one fleet-wide state fed the pmax'd occupancy.
+
+    The compiled tier is the ENVELOPE (max of the per-shard levels):
+    under vmap every shard shares one compiled pool shape, so a single
+    hot shard still sizes the batch — but a burst on one shard no longer
+    resets every other shard's downshift streak, and the envelope drops
+    as soon as EVERY shard's own ladder state allows it (the fleet-wide
+    shifter had to watch the max-occupancy signal cross the threshold
+    for `down_after` consecutive dispatches regardless of which shard
+    produced each sample).
+    """
+
+    def __init__(self, ladder: list[GearSpec], num_shards: int,
+                 down_after: int = DOWN_AFTER):
+        self.ladder = ladder
+        self.S = int(num_shards)
+        self.down_after = int(down_after)
+        self.levels = [ladder[-1].level] * self.S
+        self._streak = [0] * self.S
+
+    def reset(self) -> None:
+        self._streak = [0] * self.S
+
+    def seed(self, level: int) -> None:
+        """Align every shard's ladder state to the bound envelope (build
+        time / checkpoint restore)."""
+        self.levels = [int(level)] * self.S
+        self.reset()
+
+    def observe(self, level: int, occs, press=None,
+                margin: int = 1) -> int | None:
+        """One dispatch-boundary decision from the [S] occupancy vector
+        (and optional [S] red-zone press flags). Returns the envelope
+        level to shift the compiled tier to, or None to stay."""
+        top = self.ladder[-1].level
+        for s in range(self.S):
+            want = target_level(self.ladder, int(occs[s]), margin)
+            if press is not None and bool(press[s]) and self.levels[s] < top:
+                want = max(want, self.levels[s] + 1)
+            if want > self.levels[s]:
+                self.levels[s] = want
+                self._streak[s] = 0
+            elif want < self.levels[s]:
+                self._streak[s] += 1
+                if self._streak[s] >= self.down_after:
+                    self.levels[s] -= 1
+                    self._streak[s] = 0
+            else:
+                self._streak[s] = 0
+        envelope = max(self.levels)
+        return envelope if envelope != level else None
+
+
 def resize_pool(pool: EventPool, capacity: int):
     """Move an event pool between gear capacities at a handoff boundary.
 
@@ -201,7 +259,16 @@ def resize_pool(pool: EventPool, capacity: int):
     pool_overflow_dropped regardless so a decision-rule bug can never
     silently lose events.
     """
-    C = pool.capacity
+    # capacity axis is the LAST one: this runs on the host-side batched
+    # layouts ([S, C] islands, [L, ..., C] fleet), where EventPool's
+    # .capacity property (shape[0] — the kernel-side per-shard contract)
+    # would read the batch dim instead. With that bug every islands or
+    # fleet gear shift "grew" toward a capacity compared against S/L, so
+    # pools inflated on every shift in either direction — bit-exact
+    # (extra NEVER rows) but re-growing the sort volume the gearbox
+    # exists to shrink (caught by the ISSUE-10 per-shard-gear retrace
+    # test: the inflated pool shape forced a kernel re-lowering).
+    C = pool.time.shape[-1]
     if capacity == C:
         return pool, jnp.zeros(pool.time.shape[:-1], jnp.int64)
     PP = pool.payload.shape[-1]

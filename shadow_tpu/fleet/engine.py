@@ -201,6 +201,29 @@ class FleetSimulation:
             raise FleetError(str(e)) from e
         self._runahead = np.array([s.runahead for s in sims], np.int64)
         self._stop = np.array([s.stop_time for s in sims], np.int64)
+        # Asynchronous conservative sync (parallel/islands.py): islands
+        # jobs built with async_islands carry per-shard window widths and
+        # an in-edge lookahead matrix; the fleet stacks them per lane —
+        # traced inputs, so a lane swap never recompiles — and the sweep
+        # gets BOTH axes of asynchrony: per-lane (runahead, stop) bounds
+        # outside, per-shard frontiers inside.
+        self._async = bool(self._islands and getattr(t, "_async", False))
+        if self._async:
+            self._async_runahead = np.stack([
+                np.asarray(jax.device_get(s._async_runahead)) for s in sims
+            ])
+            self._async_look = np.stack([
+                np.asarray(jax.device_get(s._async_look_in)) for s in sims
+            ])
+            self._async_spread = np.array(
+                [int(s._async_spread) for s in sims], np.int64
+            )
+            self._async_counters = {
+                "dispatches": 0, "supersteps": 0, "shard_windows": 0,
+                "yields": 0, "blocked_on_neighbor": 0,
+            }
+            self._async_spread_max = 0
+            self._async_frontier = None
         self._lane_faults = [
             self._resolve_faults(s) for s in sims
         ]
@@ -225,6 +248,13 @@ class FleetSimulation:
             raise FleetError(
                 f"fleet jobs disagree on host count ({sim.num_hosts} vs "
                 f"{t.num_hosts}); host topology compiles into the kernel"
+            )
+        if self._islands and bool(getattr(sim, "_async", False)) != bool(
+            getattr(t, "_async", False)
+        ):
+            raise FleetError(
+                "fleet jobs mix sync modes (async_islands vs barrier); "
+                "the sweep must hold experimental.async_islands fixed"
             )
         lt = [(s.capacity, s.K) for s in t._gear_ladder]
         ls = [(s.capacity, s.K) for s in sim._gear_ladder]
@@ -382,15 +412,26 @@ class FleetSimulation:
 
     def _build_gear_fns(self, spec: gearbox.GearSpec) -> dict:
         step = self._lane_step(spec)
-        if self._islands:
+        if self._islands and self._async:
+            # async conservative loop: vmap-of-jobs outside, shards
+            # inside; per-lane [S] runahead / [S, S] lookahead / spread
+            # stack one more leading axis
+            lane = islands_mod.make_shard_run_to_async(step, spec.hi)
+            inner = jax.vmap(
+                lane, in_axes=(0, None, 0, 0, None, None, None),
+                axis_name=islands_mod.AXIS,
+            )
+            run_to = jax.vmap(inner, in_axes=(0, 0, 0, 0, 0, 0, None))
+        elif self._islands:
             lane = islands_mod.make_shard_run_to(step, spec.hi)
             inner = jax.vmap(
                 lane, in_axes=(0, None, None, None, None),
                 axis_name=islands_mod.AXIS,
             )
+            run_to = jax.vmap(inner, in_axes=(0, 0, 0, 0, None))
         else:
             inner = engine_mod.make_run_to(step, spec.hi)
-        run_to = jax.vmap(inner, in_axes=(0, 0, 0, 0, None))
+            run_to = jax.vmap(inner, in_axes=(0, 0, 0, 0, None))
         return {
             "run_to": self._kernel(f"run_to:g{spec.level}", run_to),
             "attempt": None,  # compiled lazily by run_optimistic
@@ -873,6 +914,12 @@ class FleetSimulation:
             raise FleetError(f"job {rec.name!r}: {e}") from e
         self._runahead[lane] = sim.runahead
         self._stop[lane] = sim.stop_time
+        if self._async:
+            self._async_runahead[lane] = np.asarray(
+                jax.device_get(sim._async_runahead))
+            self._async_look[lane] = np.asarray(
+                jax.device_get(sim._async_look_in))
+            self._async_spread[lane] = int(sim._async_spread)
         self._lane_faults[lane] = self._resolve_faults(sim)
         self.sched.admit(lane, rec)
         self.sched.lane_swaps += 1
@@ -1043,11 +1090,32 @@ class FleetSimulation:
             with metrics_mod.span(obs, "dispatch", windows=wpd):
 
                 def _dispatch(eff_stop=eff_stop, wpd=wpd):
-                    out = self._run_to(
-                        self.state, self.params,
-                        jnp.asarray(self._runahead), jnp.asarray(eff_stop),
-                        wpd,
-                    )
+                    if self._async:
+                        out = self._run_to(
+                            self.state, self.params,
+                            jnp.asarray(self._async_runahead),
+                            jnp.asarray(self._async_look),
+                            jnp.asarray(self._async_spread),
+                            jnp.asarray(eff_stop), wpd,
+                        )
+                    else:
+                        out = self._run_to(
+                            self.state, self.params,
+                            jnp.asarray(self._runahead),
+                            jnp.asarray(eff_stop), wpd,
+                        )
+                    extra = None
+                    if self._async:
+                        # frontier [L, S] + fleet-summed async counters
+                        extra = (
+                            np.asarray(jax.device_get(out[5])).reshape(
+                                self.lanes, -1),
+                            int(np.max(np.asarray(jax.device_get(out[6])))),
+                            int(np.sum(np.asarray(jax.device_get(out[7])))),
+                            int(np.sum(np.asarray(jax.device_get(out[8])))),
+                            int(np.sum(np.asarray(jax.device_get(out[9])))),
+                            int(np.max(np.asarray(jax.device_get(out[4])))),
+                        )
                     return (
                         out[0],
                         np.asarray(jax.device_get(out[1])).reshape(
@@ -1055,9 +1123,23 @@ class FleetSimulation:
                         np.asarray(jax.device_get(out[2])).reshape(
                             self.lanes, -1).any(axis=1),
                         int(np.max(np.asarray(jax.device_get(out[3])))),
+                        extra,
                     )
 
-                self.state, mn, press, occ = self._sv("run_to", _dispatch)
+                self.state, mn, press, occ, ainfo = self._sv(
+                    "run_to", _dispatch
+                )
+            if ainfo is not None:
+                c = self._async_counters
+                c["dispatches"] += 1
+                c["supersteps"] += ainfo[5]
+                c["shard_windows"] += ainfo[2]
+                c["yields"] += ainfo[3]
+                c["blocked_on_neighbor"] += ainfo[4]
+                self._async_spread_max = max(
+                    self._async_spread_max, ainfo[1]
+                )
+                self._async_frontier = ainfo[0]
             dispatches += 1
             if obs is not None:
                 obs.round_done(self)
@@ -1337,6 +1419,25 @@ class FleetSimulation:
             "islands": self._islands,
         })
         return st
+
+    def async_stats(self) -> dict[str, int] | None:
+        """Fleet-summed async-sync counters (schema v9 `async.*`); None
+        for barrier or non-islands fleets."""
+        if not self._async:
+            return None
+        return dict(self._async_counters)
+
+    def async_gauges(self) -> dict[str, int] | None:
+        if not self._async:
+            return None
+        g = {
+            "spread_bound_ns": int(np.max(self._async_spread)),
+            "frontier_spread_max_ns": int(self._async_spread_max),
+        }
+        if self._async_frontier is not None:
+            g["frontier_min_ns"] = int(self._async_frontier.min())
+            g["frontier_max_ns"] = int(self._async_frontier.max())
+        return g
 
     def ok(self) -> bool:
         return all(r.status == DONE for r in self.sched.records)

@@ -45,12 +45,26 @@ class PholdApp:
         runtime: int = 5 * simtime.NS_PER_SEC,
         hot_frac: float = 0.0,
         hot_share: float = 0.0,
+        local_span: int = 0,
     ):
         self.num_hosts = num_hosts
         self.msgload = msgload
         self.size_bytes = size_bytes
         self.start_time = start_time
         self.stop_sending = start_time + runtime
+        # Locality-biased variant (the async-sync benchmark shape, and
+        # the communication structure of relay-mesh workloads): forwards
+        # target a ring neighborhood of +-local_span host ids around the
+        # sender instead of the uniform all-to-all. 0 = classic PHOLD.
+        self.local_span = int(local_span)
+        if self.local_span < 0 or self.local_span >= num_hosts:
+            raise ValueError(
+                "phold local_span must be in [0, num_hosts)"
+            )
+        if self.local_span and (hot_frac > 0 or hot_share > 0):
+            raise ValueError(
+                "phold local_span and hot_frac/hot_share are exclusive"
+            )
         # Skewed-destination variant (the work-stealing benchmark shape,
         # scheduler_policy_host_steal.c's raison d'etre): hot_share of
         # all messages target the first hot_frac of hosts. hot_frac 0 =
@@ -126,8 +140,18 @@ class PholdApp:
     def _pick_dst(self, u, my_id):
         """Map one uniform draw to a destination. Uniform mode skips self
         exactly like the reference's `(me + 1 + rand%(H-1)) %% H`; the hot
-        variant splits the unit interval at hot_share."""
+        variant splits the unit interval at hot_share; the local_span
+        variant draws a nonzero ring offset in [-span, span]."""
         H = self.num_hosts
+        if self.local_span > 0:
+            span = self.local_span
+            off = jnp.clip(
+                jnp.floor(u * (2 * span)).astype(jnp.int32), 0, 2 * span - 1
+            ) - span
+            off = off + (off >= 0)  # skip 0: offsets in [-span..-1, 1..span]
+            return ((jnp.asarray(my_id, jnp.int32) + off) % H).astype(
+                jnp.int32
+            )
         if self.hot_n > 0:
             hs = self.hot_share
             nh = self.hot_n

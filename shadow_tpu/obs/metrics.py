@@ -25,10 +25,14 @@ import numpy as np
 
 from shadow_tpu.obs import counters as obs_counters
 
-# v8: pressure.* resource-pressure namespace (core/pressure.py:
-# degradation-ladder rungs — downshifts/upshifts/spill escalations/lane
-# evictions/job sheds — plus HBM estimate + headroom gauges and memory-
-# shed admission counters on the serve plane); v7: serve.*
+# v9: async.* asynchronous-conservative-sync namespace
+# (parallel/islands.py + parallel/lookahead.py: superstep/shard-window/
+# yield/blocked-on-neighbor counters plus frontier spread, spread-bound
+# and lookahead gauges); v8: pressure.* resource-pressure namespace
+# (core/pressure.py: degradation-ladder rungs — downshifts/upshifts/
+# spill escalations/lane evictions/job sheds — plus HBM estimate +
+# headroom gauges and memory-shed admission counters on the serve
+# plane); v7: serve.*
 # sim-as-a-service namespace (shadow_tpu/serve: journal records/replays,
 # admission sheds, kernel-cache hits/misses/evictions, drains); v6:
 # resilience.* backend-supervision namespace (core/supervisor.py:
@@ -37,7 +41,7 @@ from shadow_tpu.obs import counters as obs_counters
 # obs/audit.py) + optional per-job `audit` sub-object on fleet.jobs[*]
 # rows; v4: optional top-level `fleet` section (fleet.jobs[*] per-job
 # rows) + fleet.* counters; v3: faults.* recovery counters
-SCHEMA_VERSION = 8
+SCHEMA_VERSION = 9
 DOC_KIND = "shadow_tpu.metrics"
 
 # metrics-doc `fleet.jobs[*]` rows must carry at least these keys
@@ -68,6 +72,7 @@ KNOWN_METRIC_NAMESPACES = frozenset({
     "resilience",  # backend supervision (schema v6)
     "serve",       # sim-as-a-service daemon plane (schema v7)
     "pressure",    # resource-pressure degradation ladder (schema v8)
+    "async",       # asynchronous conservative sync (schema v9)
     "sim",         # build-level gauges (num_hosts, runahead)
     "bench",       # bench.py gate-local rows
 })
@@ -204,6 +209,11 @@ def validate_metrics_doc(doc: dict, strict_namespaces: bool = False) -> None:
             raise ValueError(
                 f"pressure counter {k!r} must be >= 0, got {v}"
             )
+        if k.startswith("async.") and v < 0:
+            # schema v9: async-sync counters are monotonic tallies
+            raise ValueError(
+                f"async counter {k!r} must be >= 0, got {v}"
+            )
     for k, v in doc["gauges"].items():
         if not isinstance(v, (int, float)) or isinstance(v, bool):
             raise ValueError(f"gauge {k!r} must be a number, got {v!r}")
@@ -335,6 +345,26 @@ def snapshot_device(sim, reg: MetricsRegistry) -> None:
         for k, v in res_stats().items():
             reg.counter_set(f"resilience.{k}", int(v))
     _snapshot_pressure(sim, reg)
+    _snapshot_async(sim, reg)
+
+
+def _snapshot_async(sim, reg: MetricsRegistry) -> None:
+    """Asynchronous-conservative-sync plane (schema v9): superstep /
+    shard-window / yield / blocked-on-neighbor counters plus frontier
+    spread and lookahead gauges, from the islands driver or the fleet
+    (parallel/islands.py async_stats/async_gauges; None = barrier)."""
+    ast = getattr(sim, "async_stats", None)
+    if ast is not None:
+        stats = ast()
+        if stats:
+            for k, v in stats.items():
+                reg.counter_set(f"async.{k}", int(v))
+    ag = getattr(sim, "async_gauges", None)
+    if ag is not None:
+        gauges = ag()
+        if gauges:
+            for k, v in gauges.items():
+                reg.gauge_set(f"async.{k}", v)
 
 
 def _snapshot_pressure(sim, reg: MetricsRegistry) -> None:
@@ -383,6 +413,7 @@ def snapshot_fleet(fleet, reg: MetricsRegistry) -> None:
         for k, v in res_stats().items():
             reg.counter_set(f"resilience.{k}", int(v))
     _snapshot_pressure(fleet, reg)
+    _snapshot_async(fleet, reg)
     reg.section_set("fleet", {
         "lanes": int(stats.get("lanes", 0)),
         "lane_swaps": int(stats.get("lane_swaps", 0)),

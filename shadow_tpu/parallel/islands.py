@@ -50,6 +50,7 @@ from shadow_tpu.core import spill as spill_mod
 from shadow_tpu.core.engine import IslandSpec, Simulation, make_window_step
 from shadow_tpu.core.spill import HostSpill
 from shadow_tpu.core.state import Counters, EventPool, SimState
+from shadow_tpu.parallel import lookahead as lookahead_mod
 
 AXIS = "islands"
 
@@ -113,6 +114,162 @@ def make_shard_run_to(step, hi: int, axis: str = AXIS):
         # FULLEST shard (every shard's pool compiles the same capacity)
         occ = jax.lax.pmax(_occ(state), axis)
         return state, mn, _press(state) > 0, occ, w
+
+    return run_to
+
+
+def make_shard_run_to_async(step, hi: int, axis: str = AXIS):
+    """Build run_to(state, params, runahead, look_in, spread, stop,
+    max_windows) -> (state, min_next, pressed, occupancy, windows,
+    frontier, spread_max, steps, yields, blocked) — the ASYNCHRONOUS
+    conservative window loop (cs/0409032) for ONE shard of the islands
+    engine; wrap with vmap(axis_name=axis) over the shard axis (or
+    shard_map) to get the full kernel.
+
+    Where make_shard_run_to's barrier loop advances every shard to one
+    fleet-wide frontier per window (ws = pmin of all local minima), each
+    shard here carries its OWN virtual-time frontier in the loop carry
+    and steps its own window [mn_local, mn_local + runahead_local)
+    whenever its next local event lies below its safe horizon
+
+        horizon_i = min over in-neighbors j of  frontier[j] + look_in[j]
+
+    with the lookahead matrix derived from the baked topology at
+    partition time (parallel/lookahead.py). Shards with no admissible
+    work run a NULL window at their frontier — under vmap every shard
+    rides the batched step anyway, so the bounded all_to_all exchange
+    retries deferred rows every superstep — and advance their frontier
+    to the horizon (the async protocol's null-message advance). When NO
+    shard can step, every frontier jumps to the global next-event time
+    (all future events derive from events at or after it): the barrier
+    driver's ws = global-min gap jump, recovered for idle regions.
+
+    Roughness suppression (cond-mat/0302050): a shard more than `spread`
+    ns above the minimum frontier yields its slot (a null window),
+    keeping the virtual-time surface flat so run-ahead pool/exchange
+    buffering stays bounded; the minimum-frontier shard can never yield,
+    so progress is unconditional. `runahead` (per shard), `look_in`
+    ([S] in-edge lookahead, NEVER = unconstrained), `spread` and `stop`
+    are all TRACED — the fleet passes per-lane values, and a rebalance
+    re-derives the matrix without recompiling.
+
+    The conservative invariant, per superstep: shard j's emissions in
+    [ws_j, we_j) land at or after ws_j + L[j->i] >= frontier[j] +
+    look_in[j->i] >= horizon_i >= we_i, so nothing i processes this
+    superstep can be overtaken by an in-flight delivery; deferred
+    exchange rows are pinned by the pmin'd exch_deferred_min clamp
+    exactly as in the barrier loop. Committed per-host event order is
+    identical to the barrier schedule, so the audit digest chain is
+    bit-identical (tests/test_async_sync.py).
+    """
+
+    NEV = jnp.int64(simtime.NEVER)
+
+    def _occ(state):
+        return jnp.sum(state.pool.time != simtime.NEVER)
+
+    def _press(state):
+        return jax.lax.pmax((_occ(state) >= hi).astype(jnp.int32), axis)
+
+    def run_to(state, params, runahead, look_in, spread, stop, max_windows):
+        runahead = jnp.asarray(runahead, jnp.int64)
+        look_in = jnp.asarray(look_in, jnp.int64)
+        spread = jnp.asarray(spread, jnp.int64)
+        stop = jnp.asarray(stop, jnp.int64)
+        max_windows = jnp.asarray(max_windows, jnp.int32)
+
+        def _horizon(frontier, state):
+            allF = jax.lax.all_gather(frontier, axis)  # [S]
+            # F_j + L[j->i], guarded against i64 overflow (NEVER is the
+            # i64 max): an unreachable edge, or a neighbor already at
+            # stop (it will never emit below stop + L), is unconstraining
+            nocon = (look_in >= NEV) | (allF >= stop)
+            bound = jnp.min(jnp.where(nocon, NEV, allF + look_in))
+            defer = jax.lax.pmin(state.exch_deferred_min, axis)
+            return jnp.minimum(jnp.minimum(bound, defer), stop), allF
+
+        def cond(c):
+            state, frontier, mn, w, _ = c
+            live = jax.lax.pmin(frontier, axis) < stop
+            return live & (w < max_windows) & (_press(state) == 0)
+
+        def body(c):
+            state, frontier, mn, w, stats = c
+            spread_max, steps, yields, blocked = stats
+            hz, allF = _horizon(frontier, state)
+            minF = jnp.min(allF)
+            spread_max = jnp.maximum(spread_max, jnp.max(allF) - minF)
+            mn_all = jax.lax.pmin(mn, axis)
+            has_work = (mn < hz) & (mn < stop)
+            # roughness suppression (cond-mat/0302050): a shard whose
+            # frontier — or whose NEXT window — sits more than `spread`
+            # above the minimum frontier yields its slot; the minimum-
+            # frontier shard can never lag, so progress is unconditional
+            cap = minF + spread
+            lag = (frontier > cap) | (mn > cap)
+            stepped = has_work & ~lag
+            ws = jnp.where(stepped, mn, frontier)
+            we = jnp.where(
+                stepped,
+                jnp.minimum(jnp.minimum(ws + runahead, hz), stop),
+                ws,
+            )
+            state, mn2 = step(state, params, ws, jnp.maximum(we, ws))
+            # frontier advance — for every non-yielding shard, as far as
+            # all three bounds allow: min(local min after the step,
+            # horizon, roughness cap). A stepped shard that cleared its
+            # pool leaps straight past the window end toward its next
+            # event (the null-message advance fused into the same
+            # superstep); rank-deferred in-window leftovers hold it at
+            # mn2 < we; an idle shard advances to its horizon; a
+            # yielding shard holds. Exchange arrivals of THIS superstep
+            # land at or after the pre-step horizon, so min(mn2, hz)
+            # never overtakes one.
+            raw = jnp.where(
+                has_work & lag, frontier, jnp.minimum(mn2, hz)
+            )
+            adv = jnp.minimum(raw, jnp.maximum(frontier, cap))
+            clipped = raw > adv  # null-advance suppressed by the cap
+            any_step = jax.lax.pmax(stepped.astype(jnp.int32), axis) > 0
+            # gap jump, exempt from the cap: it raises the MINIMUM
+            # frontier too, so the surface moves up flat
+            adv = jnp.where(
+                any_step, adv,
+                jnp.maximum(adv, jnp.minimum(mn_all, stop)),
+            )
+            frontier = jnp.maximum(frontier, jnp.minimum(adv, stop))
+            one = jnp.int64(1)
+            zero = jnp.int64(0)
+            stats = (
+                spread_max,
+                steps + jnp.where(stepped, one, zero),
+                yields + jnp.where((has_work & lag) | clipped, one, zero),
+                blocked + jnp.where((mn < stop) & (mn >= hz), one, zero),
+            )
+            return state, frontier, mn2, w + 1, stats
+
+        mn0 = jnp.min(state.pool.time)
+        # per-dispatch frontier re-derivation from pool state alone: no
+        # event below min_j(mn_j + L[j->i]) can ever arrive at shard i,
+        # so the restart is safe after any host-side interruption (spill
+        # manage, fault drain, checkpoint resume, gear resize)
+        allmn = jax.lax.all_gather(mn0, axis)
+        nocon0 = (look_in >= NEV) | (allmn >= NEV)
+        f0 = jnp.minimum(
+            jnp.minimum(
+                mn0, jnp.min(jnp.where(nocon0, NEV, allmn + look_in))
+            ),
+            stop,
+        )
+        z = jnp.int64(0)
+        state, frontier, mn, w, stats = jax.lax.while_loop(
+            cond, body, (state, f0, mn0, jnp.int32(0), (z, z, z, z))
+        )
+        spread_max, steps, yields, blocked = stats
+        return (
+            state, jax.lax.pmin(mn, axis), _press(state) > 0, _occ(state),
+            w, frontier, spread_max, steps, yields, blocked,
+        )
 
     return run_to
 
@@ -261,13 +418,23 @@ class IslandSimulation(Simulation):
 
     def __init__(self, *, num_shards: int, exchange_slots: int = 0,
                  mode: str = "vmap", force_path: str | None = None,
-                 rebalance: bool = False, pool_gears: int = 1, **kw):
+                 rebalance: bool = False, pool_gears: int = 1,
+                 async_sync: bool = True, async_spread: int = 0, **kw):
         if mode not in ("vmap", "shard_map"):
             raise ValueError(f"unknown islands mode {mode!r}")
         self.num_shards = int(num_shards)
         self.mode = mode
         self.rebalance_enabled = bool(rebalance)
         self.rebalances = 0
+        # Asynchronous conservative sync (cs/0409032): the fused
+        # conservative driver runs per-shard virtual-time frontiers with
+        # topology-derived lookahead instead of the lockstep window
+        # barrier. experimental.async_islands: false restores the
+        # barrier loop (the bench comparison arm).
+        self._async = bool(async_sync)
+        if int(async_spread) < 0:
+            raise ValueError("async_spread must be >= 0 ns (0 = auto)")
+        self._async_spread_cfg = int(async_spread)
         H = kw["num_hosts"]
         S = self.num_shards
         if H % S:
@@ -314,6 +481,25 @@ class IslandSimulation(Simulation):
         kw["pool_gears"] = 1  # global build first (islandized below); the
         # islands ladder replaces the global one with per-shard capacities
         super().__init__(**kw)
+
+        # Topology-derived async-sync bounds (parallel/lookahead.py):
+        # per-shard-pair lookahead matrix + per-shard safe window widths,
+        # re-derived (never recompiled — the kernel takes them as traced
+        # arguments) whenever the host->shard assignment changes
+        # (rebalance_now / resume of a rebalanced layout).
+        self._latency_np = np.asarray(
+            jax.device_get(self.params.latency_vv))
+        self._host_vertex_g = np.asarray(kw["host_vertex"], dtype=np.int64)
+        self._lookahead = lookahead_mod.derive(
+            self._latency_np, self._host_vertex_g, S
+        )
+        self._refresh_async_args()
+        self._async_counters = {
+            "dispatches": 0, "supersteps": 0, "shard_windows": 0,
+            "yields": 0, "blocked_on_neighbor": 0,
+        }
+        self._async_spread_max = 0
+        self._async_frontier = None
 
         spec = IslandSpec(
             axis=AXIS, num_shards=S, exchange_slots=self.exchange_slots,
@@ -366,6 +552,18 @@ class IslandSimulation(Simulation):
             if len(self._gear_ladder) > 1
             else None
         )
+        # Per-shard gears for the async driver (gearbox.ShardGearShifter):
+        # each shard's ladder state advances at its own dispatch
+        # boundaries from the per-shard occupancy vector; the compiled
+        # tier is the envelope (vmap shares one pool shape). The scalar
+        # shifter stays bound for the barrier/stepwise/optimistic paths.
+        self._shard_shifter = (
+            gearbox.ShardGearShifter(self._gear_ladder, S)
+            if self._async and len(self._gear_ladder) > 1
+            else None
+        )
+        if self._shard_shifter is not None:
+            self._shard_shifter.seed(self._gear)
         self._gear_shifts = 0
         self._gear_dispatches = {}
         self._C_shard = self._gear_ladder[self._gear].capacity
@@ -397,10 +595,18 @@ class IslandSimulation(Simulation):
         if mode == "vmap":
             # self._jit honors supervisor CPU failover (core/supervisor):
             # kernels re-lower on the CPU backend while the accelerator
-            # is gone
-            self._wrap = lambda fn, n=1: self._jit(jax.vmap(
-                fn, in_axes=(0, None, None, None), axis_name=AXIS
-            ))
+            # is gone. `rest_shard` marks which trailing kernel arguments
+            # (after state, params) carry per-shard data — the async
+            # loop's [S] runahead vector and [S, S] lookahead matrix.
+            def _wrap(fn, n=1, rest_shard=(False, False)):
+                in_axes = (0, None) + tuple(
+                    0 if sh else None for sh in rest_shard
+                )
+                return self._jit(jax.vmap(
+                    fn, in_axes=in_axes, axis_name=AXIS
+                ))
+
+            self._wrap = _wrap
         else:  # shard_map: _wrap is defined below with the mesh in scope
             from jax.sharding import Mesh, PartitionSpec as P
 
@@ -433,16 +639,22 @@ class IslandSimulation(Simulation):
             )
             params_spec = jax.tree.map(lambda _: P(), self.params)
 
-            def sm(fn, n_scalar_out):
-                def body(state, params, a, b):
-                    out = fn(_sq(state), params, a, b)
+            def sm(fn, n_scalar_out, rest_shard=(False, False)):
+                def body(state, params, *rest):
+                    vals = [
+                        jax.tree.map(lambda x: x[0], r) if sh else r
+                        for r, sh in zip(rest, rest_shard)
+                    ]
+                    out = fn(_sq(state), params, *vals)
                     return (_unsq(out[0]),) + tuple(
                         o[None] for o in out[1:]
                     )
 
                 wrapped = shard_map(
                     body, mesh=mesh,
-                    in_specs=(state_spec, params_spec, P(), P()),
+                    in_specs=(state_spec, params_spec) + tuple(
+                        P(AXIS) if sh else P() for sh in rest_shard
+                    ),
                     out_specs=(state_spec,) + (P(AXIS),) * n_scalar_out,
                     # the fused while_loops carry pmin-reduced scalars back
                     # into varying state fields (e.g. state.now ← window
@@ -478,7 +690,7 @@ class IslandSimulation(Simulation):
         def run_to(state, params, stop, max_windows):
             return lane_run_to(state, params, runahead, stop, max_windows)
 
-        return {
+        fns = {
             "step_fn": step,
             "step": self._wrap(step_shard, 1),
             "run_to": self._wrap(run_to, 4),
@@ -486,16 +698,168 @@ class IslandSimulation(Simulation):
             # (_ensure_optimistic): conservative runs never pay for it
             "attempt": None,
         }
+        if self._async:
+            # the async conservative loop: per-shard [S] runahead and
+            # [S, S] in-edge lookahead ride as per-shard traced inputs
+            fns["run_to_async"] = self._wrap(
+                make_shard_run_to_async(step, spec.hi), 9,
+                rest_shard=(True, True, False, False, False),
+            )
+        return fns
+
+    def _bind_gear(self) -> None:
+        super()._bind_gear()
+        fns = self._gear_fns.get(self._gear_ladder[self._gear].level)
+        self._run_to_async = (fns or {}).get("run_to_async")
 
     def _shift_gear(self, level: int) -> None:
         super()._shift_gear(level)
         self._C_shard = self._gear_ladder[level].capacity
+        if getattr(self, "_shard_shifter", None) is not None:
+            # re-align the per-shard ladder state to the new envelope
+            # (pressure downshifts and scalar-path shifts bypass it)
+            self._shard_shifter.seed(level)
 
     def _pool_occupancy(self) -> int:
         """Gearing decision signal: live rows on the FULLEST shard."""
         return int(jnp.max(
             jnp.sum(self.state.pool.time != simtime.NEVER, axis=-1)
         ))
+
+    # ---- asynchronous conservative sync (cs/0409032) plumbing ----
+
+    def _refresh_async_args(self) -> None:
+        """(Re)build the traced async-kernel inputs from the current
+        lookahead spec: per-shard window widths, the in-edge lookahead
+        view, and the roughness-suppression spread bound (configured, or
+        auto-derived — parallel/lookahead.auto_spread)."""
+        spec = self._lookahead
+        self._async_runahead = jnp.asarray(
+            lookahead_mod.shard_runahead(spec, self.runahead)
+        )
+        self._async_look_in = jnp.asarray(
+            lookahead_mod.in_edge_matrix(spec)
+        )
+        self._async_spread = jnp.int64(
+            self._async_spread_cfg
+            or lookahead_mod.auto_spread(spec, self.runahead)
+        )
+
+    def _note_async_dispatch(self, ainfo, supersteps: int) -> None:
+        frontier, spread_max, steps, yields, blocked = ainfo
+        c = self._async_counters
+        c["dispatches"] += 1
+        c["supersteps"] += supersteps
+        c["shard_windows"] += steps
+        c["yields"] += yields
+        c["blocked_on_neighbor"] += blocked
+        self._async_spread_max = max(self._async_spread_max, spread_max)
+        self._async_frontier = frontier
+
+    def _gear_tick_async(self, occ_v: np.ndarray) -> bool:
+        """Per-shard gearing decision from the async kernel's occupancy
+        vector; returns True iff the envelope (compiled tier) changed."""
+        if self._shard_shifter is None:
+            return False
+        if self.pressure is not None and self.pressure.hold_gear:
+            return False
+        hi = self._gear_ladder[self._gear].hi
+        new = self._shard_shifter.observe(
+            self._gear, occ_v, press=(occ_v >= hi)
+        )
+        if new is None:
+            return False
+        self._shift_gear(new)
+        return True
+
+    def async_stats(self) -> dict[str, int] | None:
+        """Async-sync counters for the metrics registry (schema v9
+        `async.*`); None when the barrier driver is configured."""
+        if not self._async:
+            return None
+        return dict(self._async_counters)
+
+    def async_gauges(self) -> dict[str, int] | None:
+        """Async-sync gauges: the spread bound, the maximum observed
+        frontier spread, the last dispatch's frontier extent, and the
+        per-shard gear envelope."""
+        if not self._async:
+            return None
+        spec = self._lookahead
+        g = {
+            "spread_bound_ns": int(self._async_spread),
+            "frontier_spread_max_ns": int(self._async_spread_max),
+            "min_cross_lookahead_ns": (
+                int(spec.min_cross)
+                if spec.min_cross < int(simtime.NEVER) else -1
+            ),
+        }
+        if self._async_frontier is not None:
+            g["frontier_min_ns"] = int(self._async_frontier.min())
+            g["frontier_max_ns"] = int(self._async_frontier.max())
+        if self._shard_shifter is not None:
+            g["gear_level_min"] = int(min(self._shard_shifter.levels))
+            g["gear_level_max"] = int(max(self._shard_shifter.levels))
+        return g
+
+    def _async_meta(self) -> dict | None:
+        """Checkpoint-header async block (core/checkpoint.save): the
+        derived bounds and last-observed frontier surface, so an operator
+        can audit a resumed run's async posture without replaying it.
+        Informational — resume re-derives frontiers from pool state."""
+        if not self._async:
+            return None
+        m = {
+            "spread_ns": int(self._async_spread),
+            "runahead_ns": [int(x) for x in np.asarray(
+                jax.device_get(self._async_runahead))],
+        }
+        spec = self._lookahead
+        if spec.min_cross < int(simtime.NEVER):
+            m["min_cross_lookahead_ns"] = int(spec.min_cross)
+            m["critical_link"] = list(spec.critical)
+        if self._async_frontier is not None:
+            m["frontier_ns"] = [int(x) for x in self._async_frontier]
+        if self._shard_shifter is not None:
+            m["gear_levels"] = [int(x) for x in self._shard_shifter.levels]
+        return m
+
+    def _runahead_bound_hint(self) -> str:
+        """The derived safe bounds, for runahead-violation errors: the
+        minimum cross-shard path latency (the async lookahead) and the
+        minimum intra-shard latency — the tighter of the two is the
+        largest safe experimental.runahead."""
+        spec = self._lookahead
+        never = int(simtime.NEVER)
+        intra = int(spec.intra.min()) if spec.intra.size else never
+        parts = []
+        if spec.min_cross < never:
+            j, i = spec.critical
+            parts.append(
+                f"derived minimum cross-shard path latency (the safe "
+                f"lookahead) is {int(spec.min_cross)} ns on shard link "
+                f"{j}->{i}"
+            )
+        if intra < never:
+            parts.append(f"minimum intra-shard path latency is {intra} ns")
+        if not parts:
+            return "the topology bakes no finite path latency"
+        safe = min(int(spec.min_cross), intra)
+        parts.append(f"set experimental.runahead <= {safe} ns")
+        return "; ".join(parts)
+
+    def resume_from(self, ckpt_dir: str) -> dict:
+        info = super().resume_from(ckpt_dir)
+        if self._async and self.rebalance_enabled:
+            # the restored params carry the layout's slot_of table; the
+            # lookahead matrix must describe THAT assignment
+            slot = np.asarray(jax.device_get(self.params.slot_of))
+            self._lookahead = lookahead_mod.derive(
+                self._latency_np, self._host_vertex_g, self.num_shards,
+                assignment=slot,
+            )
+            self._refresh_async_args()
+        return info
 
     # ---- between-window re-sharding (the P3 work-stealing replacement,
     # scheduler_policy_host_steal.c:1-562 / logical_processor.rs:43-54) ----
@@ -648,6 +1012,15 @@ class IslandSimulation(Simulation):
             slot_of=jnp.asarray(new_slot)
         )
         self.rebalances += 1
+        if self._async:
+            # the permuted host->shard assignment changes which latencies
+            # bound each shard pair; re-derive (traced inputs — the
+            # compiled async kernel is untouched)
+            self._lookahead = lookahead_mod.derive(
+                self._latency_np, self._host_vertex_g, self.num_shards,
+                assignment=new_slot,
+            )
+            self._refresh_async_args()
 
     def _maybe_rebalance(self) -> None:
         """Skew trigger: rebalance when the heaviest shard holds 2x the
@@ -689,33 +1062,62 @@ class IslandSimulation(Simulation):
                     # per-attempt clamp: a pressure rung may have engaged
                     # the spill tier since the driver computed stop_at
                     stop_at, wpd = self._live_spill_clamp(stop_at, wpd)
-                    st, mn, press, occ, w = self._run_to(
-                        self.state, self.params, stop_at, wpd
-                    )
+                    if self._async:
+                        (st, mn, press, occ, w, fr, sp, stp, yld,
+                         blk) = self._run_to_async(
+                            self.state, self.params,
+                            self._async_runahead, self._async_look_in,
+                            self._async_spread, stop_at, wpd,
+                        )
+                        extra = (
+                            np.asarray(jax.device_get(fr)).reshape(-1),
+                            int(np.max(np.asarray(jax.device_get(sp)))),
+                            int(np.sum(np.asarray(jax.device_get(stp)))),
+                            int(np.sum(np.asarray(jax.device_get(yld)))),
+                            int(np.sum(np.asarray(jax.device_get(blk)))),
+                        )
+                    else:
+                        st, mn, press, occ, w = self._run_to(
+                            self.state, self.params, stop_at, wpd
+                        )
+                        extra = None
                     return (
                         st,
                         int(np.min(np.asarray(jax.device_get(mn)))),
                         bool(np.max(np.asarray(jax.device_get(press)))),
-                        int(np.max(np.asarray(jax.device_get(occ)))),
+                        np.asarray(jax.device_get(occ)).reshape(-1),
                         w,
+                        extra,
                     )
 
-                self.state, mn, press, occ, w = self._sv(
+                self.state, mn, press, occ_v, w, ainfo = self._sv(
                     "run_to", _dispatch
                 )
+            occ = int(occ_v.max())
             self._gear_note_dispatch()
             self.windows_run += int(np.max(np.asarray(w)))
+            if ainfo is not None:
+                self._note_async_dispatch(
+                    ainfo, int(np.max(np.asarray(jax.device_get(w))))
+                )
             if obs is not None:
                 obs.round_done(self)
             self._audit_tick(mn)
             # gearing: a red-zone early exit upshifts (one pool re-sort)
-            # before the spill tier would pay host drain round-trips
-            shifted = self._gear_tick(occ, press=press)
+            # before the spill tier would pay host drain round-trips;
+            # under async the decision is PER SHARD from the occupancy
+            # vector (gearbox.ShardGearShifter), each shard's ladder
+            # state advancing at its own dispatch boundary
+            if self._async and self._shard_shifter is not None:
+                shifted = self._gear_tick_async(occ_v)
+            else:
+                shifted = self._gear_tick(occ, press=press)
             if self._fault_plane_active():
                 self._handoff_tick(mn)
             if mn >= stop and spill.min_time >= stop and not press:
                 break
-            cur = (mn, spill.count, press)
+            fr_min = int(ainfo[0].min()) if ainfo is not None else None
+            cur = (mn, spill.count, press, fr_min)
             if cur == last and mn >= stop_at and not shifted:
                 cap = self._gear_ladder[self._gear].capacity
                 if self._pressure_stall(window=mn, occupancy=occ,
@@ -1010,10 +1412,10 @@ class IslandSimulation(Simulation):
                     raise RuntimeError(
                         f"speculation violation at t={viol} inside a "
                         f"floor-width window [{ws}, {we}) (floor {floor}): "
-                        f"the conservative-width invariant is broken "
-                        f"(runahead {cons} exceeds a real path latency, or "
-                        f"a handler emitted into the past); refusing to "
-                        f"commit"
+                        f"the conservative-width invariant is broken — "
+                        f"runahead {cons} ns exceeds a real path latency "
+                        f"({self._runahead_bound_hint()}), or a handler "
+                        f"emitted into the past; refusing to commit"
                     )
                 if viol >= never or we <= floor:
                     break

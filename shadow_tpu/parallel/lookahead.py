@@ -1,0 +1,156 @@
+"""Topology-derived cross-shard lookahead for asynchronous conservative sync.
+
+The async conservative protocol (cs/0409032, PAPERS.md) lets shard i
+advance whenever its local virtual time is below every in-neighbor's
+frontier plus the LINK LOOKAHEAD of that edge:
+
+    horizon_i = min over shards j != i of  frontier[j] + L[j -> i]
+
+where L[j -> i] is the minimum simulated latency any event emitted by a
+host of shard j can take to reach a host of shard i. On this engine every
+cross-host delivery is one emission delayed by the baked PATH latency
+(net/link.py: deliver at now + latency_vv[src_vertex, dst_vertex]), so the
+exact per-edge lookahead is a pure function of the topology bake and the
+host -> shard assignment:
+
+    L[j -> i] = min over (a in hosts_j, b in hosts_i) latency_vv[v(a), v(b)]
+
+This module derives that [S, S] matrix (host-side numpy at partition
+time — it never rides a kernel; the drivers pass it as a TRACED argument
+so a rebalance or a fleet lane swap never recompiles). The diagonal is
+the INTRA-shard minimum, which doubles as the shard's safe local window
+width (the per-shard runahead): emissions between hosts of one shard land
+at or after window end whenever the window is no wider than it.
+
+An unreachable pair (latency NEVER) imposes no constraint: the protocol's
+constraint graph is the direct-communication graph, and transitive
+influence is already carried hop-by-hop by the frontier rule.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from shadow_tpu.core import simtime
+
+NEVER = int(simtime.NEVER)
+
+# Per-shard window widths are clamped below the packed sort key's
+# window-relative time field (core/engine._DT_BITS = 44 bits): a derived
+# intra-shard lookahead of NEVER (single-host shard on a cross-only
+# graph) must never widen a window past what the extraction keys can
+# order exactly. Half the field keeps every in-window dt comfortably
+# inside the 2^44 ns span.
+WIDTH_CAP = (1 << 43) - 1
+
+
+@dataclasses.dataclass(frozen=True)
+class LookaheadSpec:
+    """The derived async-sync bounds for one shard partition.
+
+    matrix[j, i]  min path latency from any host of shard j to any host
+                  of shard i (i64 ns; NEVER = no direct path). The
+                  diagonal holds the intra-shard minimum (including
+                  self-sends via latency_vv[v, v]).
+    intra[i]      matrix[i, i] — the shard's safe local window width.
+    min_cross     minimum finite off-diagonal entry (NEVER if the shards
+                  never talk): the critical link that bounds async slack
+                  fleet-wide.
+    critical      (src_shard, dst_shard) of min_cross, or (-1, -1).
+    """
+
+    matrix: np.ndarray
+    intra: np.ndarray
+    min_cross: int
+    critical: tuple[int, int]
+
+    @property
+    def num_shards(self) -> int:
+        return int(self.matrix.shape[0])
+
+
+def shard_of_hosts(num_hosts: int, num_shards: int,
+                   assignment: np.ndarray | None = None) -> np.ndarray:
+    """[H] shard index per GLOBAL host id. Contiguous block partition by
+    default; `assignment` is the rebalancer's host -> slot table
+    (parallel/islands.rebalance_now), under which shard = slot // (H/S)."""
+    Hl = num_hosts // num_shards
+    if assignment is None:
+        return np.arange(num_hosts, dtype=np.int64) // Hl
+    return np.asarray(assignment, dtype=np.int64) // Hl
+
+
+def derive(latency_vv: np.ndarray, host_vertex: np.ndarray, num_shards: int,
+           assignment: np.ndarray | None = None) -> LookaheadSpec:
+    """Derive the per-shard-pair lookahead matrix at partition time.
+
+    latency_vv   [U, U] baked path latencies (NEVER = unreachable)
+    host_vertex  [H] host -> used-vertex index
+    assignment   optional host -> slot table (post-rebalance layouts)
+    """
+    lat = np.asarray(latency_vv, dtype=np.int64)
+    hv = np.asarray(host_vertex, dtype=np.int64)
+    H = hv.shape[0]
+    S = int(num_shards)
+    if S <= 0 or H % S:
+        raise ValueError(
+            f"num_hosts {H} must divide by num_shards {S}"
+        )
+    shard = shard_of_hosts(H, S, assignment)
+    # vertex sets per shard (U is small; hosts collapse onto vertices)
+    verts = [np.unique(hv[shard == s]) for s in range(S)]
+    m = np.full((S, S), NEVER, dtype=np.int64)
+    for j in range(S):
+        for i in range(S):
+            sub = lat[np.ix_(verts[j], verts[i])]
+            if sub.size:
+                m[j, i] = int(sub.min())
+    finite_cross = [
+        (int(m[j, i]), j, i)
+        for j in range(S) for i in range(S)
+        if j != i and m[j, i] < NEVER
+    ]
+    if finite_cross:
+        mc, cj, ci = min(finite_cross)
+        critical = (cj, ci)
+    else:
+        mc, critical = NEVER, (-1, -1)
+    return LookaheadSpec(
+        matrix=m, intra=np.diagonal(m).copy(), min_cross=mc,
+        critical=critical,
+    )
+
+
+def shard_runahead(spec: LookaheadSpec, base_runahead: int) -> np.ndarray:
+    """[S] safe per-shard window widths: never narrower than the
+    configured global runahead (sub-minimum explicit runaheads are a perf
+    choice, not a safety bound), widened to the shard's intra-shard
+    minimum latency where that is provably exact, and capped below the
+    packed sort key's window span (WIDTH_CAP)."""
+    w = np.maximum(spec.intra, int(base_runahead))
+    return np.clip(w, 1, WIDTH_CAP).astype(np.int64)
+
+
+def in_edge_matrix(spec: LookaheadSpec) -> np.ndarray:
+    """[S(dst-major), S(src)] lookahead view the async kernel consumes:
+    row i holds shard i's IN-edge lookaheads L[j -> i] with the diagonal
+    masked to NEVER (a shard's own frontier never bounds its horizon —
+    local safety is the per-shard window width)."""
+    m = spec.matrix.T.copy()
+    np.fill_diagonal(m, NEVER)
+    return m
+
+
+def auto_spread(spec: LookaheadSpec, base_runahead: int) -> int:
+    """Default roughness-suppression bound (cond-mat/0302050): wide
+    enough that lookahead-limited asynchrony is never throttled (8x the
+    largest finite lookahead, off-diagonal or intra), tight enough that
+    frontier spread — and with it the exchange/pool buffering for
+    run-ahead rows — stays bounded. Falls back to 64x the global
+    runahead on cross-silent partitions."""
+    finite = spec.matrix[spec.matrix < NEVER]
+    if finite.size:
+        return int(min(8 * int(finite.max()), WIDTH_CAP))
+    return int(min(64 * int(base_runahead), WIDTH_CAP))

@@ -104,6 +104,7 @@ def build_simulation(source) -> Simulation:
             runtime=units.parse_time_ns(opts.get("runtime", 5)),
             hot_frac=float(opts.get("hot_frac", 0.0)),
             hot_share=float(opts.get("hot_share", 0.0)),
+            local_span=int(opts.get("local_span", 0)),
         )
         handlers.update(app.handlers())
         subs[PholdApp.SUB] = app.init_sub()
@@ -230,6 +231,8 @@ def build_simulation(source) -> Simulation:
             exchange_slots=cfg.experimental.exchange_slots,
             mode=cfg.experimental.island_mode,
             rebalance=cfg.experimental.rebalance,
+            async_sync=cfg.experimental.async_islands,
+            async_spread=cfg.experimental.async_spread,
             # matrix-capable sims pin the matrix path: under vmap a
             # lax.cond with a batched predicate executes BOTH branches
             force_path="matrix" if matrix_handlers else None,

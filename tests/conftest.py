@@ -47,6 +47,9 @@ _BUDGET_TIER = {
     # BEFORE the compile-heavy parity matrices so a budget truncation
     # never silently skips it
     "test_serve": 3,
+    # the async-sync chain-equality matrix is the ISSUE 10 acceptance
+    # gate: same rule — ahead of the compile-heavy tier-4 matrices
+    "test_async_sync": 3,
     # minutes: multi-engine parity matrices / many-shape compiles
     "test_gearbox": 4, "test_islands": 4, "test_rebalance": 4,
     "test_sharding": 4, "test_tcp": 4, "test_fleet": 4, "test_tgen": 5,

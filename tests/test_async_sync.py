@@ -1,0 +1,423 @@
+"""Asynchronous conservative sync (ISSUE 10): chain-equality regression
+matrix across {conservative, optimistic} x {global, islands, fleet},
+roughness suppression, lookahead derivation, per-shard gears, and the
+reporting tool.
+
+The load-bearing property: the async per-shard-frontier driver
+(parallel/islands.make_shard_run_to_async) changes the SCHEDULE — never
+the simulation. Every cell of the sync/layout matrix must reproduce the
+global conservative engine's audit digest chain bit-for-bit, and the
+roughness-suppression bound (cond-mat/0302050) must hold under an
+adversarially skewed event load.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from shadow_tpu.core import simtime
+from shadow_tpu.parallel import lookahead as lookahead_mod
+from shadow_tpu.sim import build_simulation
+
+NEVER = int(simtime.NEVER)
+
+
+def _decohered_gml(shards, per, seed=7, fast_shard0=False):
+    """One vertex per host; decohered intra-shard latencies (no shared
+    lattice, so shard windows interleave), large distinct cross-shard
+    latencies (generous lookahead). fast_shard0 draws shard 0 from a
+    faster band — the deliberately imbalanced load."""
+    rng = np.random.RandomState(seed)
+    n = shards * per
+
+    def band(a, b):
+        if a // per != b // per:
+            return 700000, 900000
+        if fast_shard0 and a // per == 0:
+            return 5000, 60000
+        return 30000, 250000
+
+    lines = ["graph ["]
+    for v in range(n):
+        lines.append(f"  node [ id {v} ]")
+    for a in range(n):
+        for b in range(a, n):
+            lo, hi = band(a, b)
+            lines.append(
+                f'  edge [ source {a} target {b} latency '
+                f'"{int(rng.randint(lo, hi))} us" ]'
+            )
+    lines.append("]")
+    return "\n".join(lines)
+
+
+def _cfg(shards=2, per=2, stop=6, span=1, seed=11, fast_shard0=False,
+         **exp):
+    hosts = {}
+    for v in range(shards * per):
+        hosts[f"h{v:02d}"] = {
+            "quantity": 1, "network_node_id": v, "app_model": "phold",
+            "app_options": {"msgload": 1, "runtime": stop - 1,
+                            "local_span": span},
+        }
+    experimental = {
+        "event_capacity": 1024, "events_per_host_per_window": 8,
+        "outbox_slots": 8, "inbox_slots": 4,
+    }
+    experimental.update(exp)
+    return {
+        "general": {"stop_time": stop, "seed": seed},
+        "network": {"graph": {"type": "gml", "inline": _decohered_gml(
+            shards, per, fast_shard0=fast_shard0)}},
+        "experimental": experimental,
+        "hosts": hosts,
+    }
+
+
+def _islands_exp(shards=2, **kw):
+    d = {"num_shards": shards, "exchange_slots": 16}
+    d.update(kw)
+    return d
+
+
+# ---------------------------------------------------------------------------
+# the acceptance matrix: every sync x layout cell chains like the global
+# conservative engine
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def reference():
+    """Global conservative engine: the chain every cell must match."""
+    sim = build_simulation(_cfg())
+    sim.run(windows_per_dispatch=512)
+    return sim.audit_chain(), sim.counters()["events_committed"]
+
+
+def test_global_optimistic_matches(reference):
+    chain, ev = reference
+    sim = build_simulation(_cfg())
+    sim.run_optimistic()
+    assert sim.audit_chain() == chain
+    assert sim.counters()["events_committed"] == ev
+
+
+def test_islands_barrier_matches(reference):
+    chain, ev = reference
+    sim = build_simulation(_cfg(**_islands_exp(async_islands=False)))
+    assert sim._async is False
+    sim.run(windows_per_dispatch=512)
+    assert sim.audit_chain() == chain
+    assert sim.counters()["events_committed"] == ev
+
+
+def test_islands_async_matches(reference):
+    chain, ev = reference
+    sim = build_simulation(_cfg(**_islands_exp()))
+    assert sim._async is True  # async is the default islands driver
+    sim.run(windows_per_dispatch=512)
+    assert sim.audit_chain() == chain
+    assert sim.counters()["events_committed"] == ev
+    stats = sim.async_stats()
+    assert stats["supersteps"] > 0
+    assert stats["shard_windows"] > 0
+
+
+def test_islands_optimistic_matches(reference):
+    chain, ev = reference
+    sim = build_simulation(_cfg(**_islands_exp()))
+    sim.run_optimistic()
+    assert sim.audit_chain() == chain
+    assert sim.counters()["events_committed"] == ev
+
+
+def _fleet(async_on, optimistic=False):
+    from shadow_tpu.fleet import JobSpec, build_fleet
+
+    cfg = _cfg(**_islands_exp(async_islands=async_on))
+    jobs = [JobSpec("a", cfg), JobSpec("b", dict(cfg))]
+    fleet = build_fleet(jobs)
+    if optimistic:
+        fleet.run_optimistic()
+    else:
+        fleet.run()
+    assert fleet.ok()
+    return fleet
+
+
+def test_fleet_barrier_matches(reference):
+    chain, ev = reference
+    fleet = _fleet(async_on=False)
+    for row in fleet.results():
+        assert row["audit"]["chain"] == chain, row["name"]
+        assert row["events_committed"] == ev
+
+
+def test_fleet_async_matches(reference):
+    chain, ev = reference
+    fleet = _fleet(async_on=True)
+    assert fleet._async
+    for row in fleet.results():
+        assert row["audit"]["chain"] == chain, row["name"]
+        assert row["events_committed"] == ev
+    assert fleet.async_stats()["supersteps"] > 0
+    # both axes of asynchrony: per-lane frontier matrix rode back
+    assert fleet._async_frontier is not None
+    assert fleet._async_frontier.shape == (fleet.lanes, 2)
+
+
+def test_fleet_optimistic_matches(reference):
+    chain, ev = reference
+    fleet = _fleet(async_on=True, optimistic=True)
+    for row in fleet.results():
+        assert row["audit"]["chain"] == chain, row["name"]
+        assert row["events_committed"] == ev
+
+
+def test_fleet_refuses_mixed_sync_modes():
+    """async_islands is a kernel-shaping field: the sweep validator
+    rejects a mixed fleet up front (and FleetSimulation._check_compat
+    backstops direct construction)."""
+    from shadow_tpu.fleet import JobSpec, build_fleet
+    from shadow_tpu.fleet.sweep import SweepError
+
+    a = _cfg(**_islands_exp(async_islands=True))
+    b = _cfg(**_islands_exp(async_islands=False))
+    with pytest.raises(SweepError, match="async_islands"):
+        build_fleet([JobSpec("a", a), JobSpec("b", b)])
+
+
+# ---------------------------------------------------------------------------
+# roughness suppression (cond-mat/0302050)
+# ---------------------------------------------------------------------------
+
+
+def test_roughness_spread_stays_bounded_under_skew():
+    """Adversarially skewed load: shard 0 runs a much faster event
+    timescale, so the other shards would sprint arbitrarily far ahead of
+    it under pure lookahead slack. With a tight spread bound they must
+    yield instead, the observed frontier spread must stay within
+    bound + one window width, and the chain must still be bit-identical
+    to the barrier run (yields change the schedule, never the sim)."""
+    base = _cfg(shards=2, per=2, stop=8, fast_shard0=True,
+                **_islands_exp(async_islands=False))
+    barrier = build_simulation(base)
+    barrier.run(windows_per_dispatch=512)
+
+    spread = 150_000_000  # 150 ms: far below the ~800 ms lookahead slack
+    tight = build_simulation(_cfg(
+        shards=2, per=2, stop=8, fast_shard0=True,
+        **_islands_exp(async_spread=spread),
+    ))
+    assert int(tight._async_spread) == spread
+    tight.run(windows_per_dispatch=512)
+
+    assert tight.audit_chain() == barrier.audit_chain()
+    stats = tight.async_stats()
+    assert stats["yields"] > 0, "suppression never engaged"
+    width = int(np.max(np.asarray(tight._async_runahead)))
+    gauges = tight.async_gauges()
+    assert gauges["frontier_spread_max_ns"] <= spread + width, (
+        gauges["frontier_spread_max_ns"], spread, width
+    )
+
+
+def test_loose_spread_runs_further_ahead():
+    """Control arm: the auto (loose) bound lets the fast shards spread
+    beyond the tight bound — proving the tight run's flat frontier
+    surface came from suppression, not from the workload."""
+    loose = build_simulation(_cfg(
+        shards=2, per=2, stop=8, fast_shard0=True, **_islands_exp(),
+    ))
+    loose.run(windows_per_dispatch=512)
+    g = loose.async_gauges()
+    assert loose.async_stats()["yields"] == 0
+    assert g["frontier_spread_max_ns"] > 150_000_000 + int(
+        np.max(np.asarray(loose._async_runahead))
+    )
+
+
+# ---------------------------------------------------------------------------
+# lookahead derivation (parallel/lookahead.py)
+# ---------------------------------------------------------------------------
+
+
+def test_lookahead_derive_block_partition():
+    # 4 hosts on 4 vertices, 2 shards: lookahead = min over the cross
+    # block; diagonal = intra minimum; unreachable pairs unconstrained
+    lat = np.full((4, 4), NEVER, np.int64)
+    lat[0, 1] = lat[1, 0] = 10
+    lat[2, 3] = lat[3, 2] = 20
+    lat[0, 2] = 100
+    lat[1, 3] = 70
+    spec = lookahead_mod.derive(lat, np.arange(4), 2)
+    assert spec.matrix[0, 0] == 10 and spec.matrix[1, 1] == 20
+    assert spec.matrix[0, 1] == 70  # min(lat[0,2]=100, lat[1,3]=70)
+    assert spec.matrix[1, 0] == NEVER  # no back edges: unconstrained
+    assert spec.min_cross == 70 and spec.critical == (0, 1)
+    ie = lookahead_mod.in_edge_matrix(spec)
+    assert ie[0, 0] == NEVER and ie[1, 1] == NEVER  # self never binds
+    assert ie[1, 0] == 70  # shard 1's in-edge from shard 0
+
+
+def test_lookahead_assignment_permutation():
+    # rebalance moves host 1 into shard 1: the intra/cross minima follow
+    lat = np.array([[5, 10], [10, 5]], np.int64)
+    hv = np.array([0, 0, 1, 1])
+    block = lookahead_mod.derive(lat, hv, 2)
+    assert block.matrix[0, 0] == 5 and block.matrix[0, 1] == 10
+    mixed = lookahead_mod.derive(
+        lat, hv, 2, assignment=np.array([0, 2, 1, 3])
+    )
+    # each shard now holds one host of each vertex: every pair sees the
+    # full matrix minimum
+    assert mixed.matrix[0, 0] == 5 and mixed.matrix[0, 1] == 5
+
+
+def test_shard_runahead_floor_and_cap():
+    lat = np.full((2, 2), NEVER, np.int64)
+    lat[0, 1] = lat[1, 0] = 50
+    spec = lookahead_mod.derive(lat, np.array([0, 0, 1, 1]), 2)
+    # intra NEVER (no intra path): width clamps to the sort-key cap,
+    # never overflows; the floor is the configured runahead
+    w = lookahead_mod.shard_runahead(spec, 50)
+    assert (w == lookahead_mod.WIDTH_CAP).all()
+    lat[0, 0] = lat[1, 1] = 7
+    spec = lookahead_mod.derive(lat, np.array([0, 0, 1, 1]), 2)
+    assert (lookahead_mod.shard_runahead(spec, 30) == 30).all()  # floor
+    assert (lookahead_mod.shard_runahead(spec, 3) == 7).all()  # intra
+
+
+def test_derived_lookahead_in_runahead_error_hint():
+    sim = build_simulation(_cfg(**_islands_exp()))
+    hint = sim._runahead_bound_hint()
+    assert "cross-shard path latency" in hint
+    assert "experimental.runahead" in hint
+
+
+# ---------------------------------------------------------------------------
+# per-shard gears (gearbox.ShardGearShifter)
+# ---------------------------------------------------------------------------
+
+
+def test_shard_gear_shifter_envelope():
+    from shadow_tpu.core.gearbox import GearSpec, ShardGearShifter
+
+    ladder = [
+        GearSpec(0, 256, 8, hi=200, fill=150, up=175),
+        GearSpec(1, 512, 8, hi=400, fill=300, up=350),
+    ]
+    sh = ShardGearShifter(ladder, 2, down_after=2)
+    sh.seed(0)
+    # one hot shard raises the envelope immediately
+    assert sh.observe(0, [10, 180]) == 1
+    sh.seed(1)
+    # a burst on shard 1 must NOT reset shard 0's downshift streak
+    assert sh.observe(1, [10, 300]) is None
+    assert sh.observe(1, [10, 300]) is None
+    # shard 0's level dropped after its own streak, but the envelope
+    # stays up while shard 1 still needs the big gear
+    assert sh.levels[0] == 0 and sh.levels[1] == 1
+    # shard 1 cools: after ITS streak the envelope finally drops
+    assert sh.observe(1, [10, 10]) is None
+    assert sh.observe(1, [10, 10]) == 0
+
+
+def test_shard_gear_press_forces_envelope_up():
+    from shadow_tpu.core.gearbox import GearSpec, ShardGearShifter
+
+    ladder = [
+        GearSpec(0, 256, 8, hi=200, fill=150, up=175),
+        GearSpec(1, 512, 8, hi=400, fill=300, up=350),
+    ]
+    sh = ShardGearShifter(ladder, 2)
+    sh.seed(0)
+    assert sh.observe(0, [10, 10], press=[False, True]) == 1
+
+
+# ---------------------------------------------------------------------------
+# telemetry + checkpoint carry
+# ---------------------------------------------------------------------------
+
+
+def test_async_metrics_schema_v9(tmp_path):
+    from shadow_tpu.obs import metrics as obs_metrics
+
+    sim = build_simulation(_cfg(**_islands_exp()))
+    sim.run(windows_per_dispatch=512)
+    session = obs_metrics.ObsSession()
+    session.finalize(sim)
+    doc = session.metrics.dump(str(tmp_path / "m.json"))
+    obs_metrics.validate_metrics_doc(doc, strict_namespaces=True)
+    assert doc["schema_version"] == 9
+    assert doc["counters"]["async.supersteps"] > 0
+    assert doc["counters"]["async.shard_windows"] > 0
+    assert "async.frontier_spread_max_ns" in doc["gauges"]
+    assert "async.spread_bound_ns" in doc["gauges"]
+    # negative async counters are rejected (monotonic tallies)
+    bad = json.loads(json.dumps(doc))
+    bad["counters"]["async.supersteps"] = -1
+    with pytest.raises(ValueError, match="async counter"):
+        obs_metrics.validate_metrics_doc(bad)
+
+
+def test_barrier_run_emits_no_async_keys(tmp_path):
+    from shadow_tpu.obs import metrics as obs_metrics
+
+    sim = build_simulation(_cfg(**_islands_exp(async_islands=False)))
+    sim.run(windows_per_dispatch=512)
+    session = obs_metrics.ObsSession()
+    session.finalize(sim)
+    doc = session.metrics.dump(str(tmp_path / "m.json"))
+    assert not any(k.startswith("async.") for k in doc["counters"])
+    assert not any(k.startswith("async.") for k in doc["gauges"])
+
+
+def test_checkpoint_header_carries_async_block(tmp_path):
+    from shadow_tpu.core import checkpoint as ckpt_mod
+
+    sim = build_simulation(_cfg(**_islands_exp()))
+    sim.run(until=3 * simtime.NS_PER_SEC, windows_per_dispatch=512)
+    now = int(np.max(np.asarray(sim.state.now)))
+    path, _ = ckpt_mod.save_ring(sim, str(tmp_path), seq=0, sim_ns=now)
+    meta = ckpt_mod.load_meta(path)
+    a = meta["async"]
+    assert a["spread_ns"] == int(sim._async_spread)
+    assert len(a["runahead_ns"]) == sim.num_shards
+    assert "min_cross_lookahead_ns" in a
+    assert len(a["frontier_ns"]) == sim.num_shards
+    # resume reproduces the uninterrupted chain (frontiers re-derive
+    # from pool state — the restart-safety property)
+    res = build_simulation(_cfg(**_islands_exp()))
+    res.resume_from(str(tmp_path))
+    res.run(windows_per_dispatch=512)
+    full = build_simulation(_cfg(**_islands_exp()))
+    full.run(windows_per_dispatch=512)
+    assert res.audit_chain() == full.audit_chain()
+
+
+# ---------------------------------------------------------------------------
+# tools/lookahead_report.py
+# ---------------------------------------------------------------------------
+
+
+def test_lookahead_report_tool(tmp_path, capsys):
+    import yaml
+
+    from tools import lookahead_report
+
+    p = tmp_path / "cfg.yaml"
+    p.write_text(yaml.safe_dump(_cfg(**_islands_exp())))
+    assert lookahead_report.main([str(p)]) == 0
+    out = capsys.readouterr().out
+    assert "critical link" in out and "lookahead matrix" in out
+    assert lookahead_report.main([str(p), "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["num_shards"] == 2
+    assert doc["min_cross_ns"] is not None
+    assert len(doc["matrix_ns"]) == 2
+    assert doc["critical_link"] is not None
+    # bad inputs exit 2 with a one-line diagnosis, never a traceback
+    assert lookahead_report.main([str(tmp_path / "missing.yaml")]) == 2
+    assert lookahead_report.main([str(p), "--shards", "0"]) == 2

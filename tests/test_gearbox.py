@@ -181,6 +181,39 @@ def test_resize_pool_grow_shrink_roundtrip():
     assert np.array_equal(np.sort(kept[kept != simtime.NEVER]), t[:32])
 
 
+def test_resize_pool_batched_layouts():
+    """ISSUE 10 regression: on the host-side BATCHED layouts ([S, C]
+    islands shards, [L, C] fleet lanes) the capacity axis is the LAST
+    one. The old code read EventPool.capacity (shape[0] — the kernel's
+    per-shard contract), compared the target against S/L, and so every
+    islands/fleet gear shift inflated the pool instead of resizing it —
+    bit-exact but sort-volume-bloating, and a forced kernel re-lowering
+    per shift (caught by the async per-shard-gear retrace test)."""
+    import jax.numpy as jnp
+
+    S, C = 2, 64
+    pool = EventPool(
+        time=jnp.full((S, C), simtime.NEVER, jnp.int64),
+        dst=jnp.zeros((S, C), jnp.int32), src=jnp.zeros((S, C), jnp.int32),
+        seq=jnp.zeros((S, C), jnp.int32), kind=jnp.zeros((S, C), jnp.int32),
+        payload=jnp.zeros((S, C, 1), jnp.int64),
+    )
+    pool = pool.replace(time=pool.time.at[:, :8].set(
+        jnp.arange(1, S * 8 + 1, dtype=jnp.int64).reshape(S, 8)
+    ))
+    big, dropped = gearbox.resize_pool(pool, 128)
+    assert big.time.shape == (S, 128)
+    assert np.asarray(dropped).tolist() == [0, 0]
+    back, dropped = gearbox.resize_pool(big, 64)
+    assert back.time.shape == (S, 64)
+    assert np.asarray(dropped).tolist() == [0, 0]
+    # shrink below per-shard occupancy: earliest kept, rest counted PER
+    # leading dim
+    tight, dropped = gearbox.resize_pool(pool, 4)
+    assert tight.time.shape == (S, 4)
+    assert np.asarray(dropped).tolist() == [4, 4]
+
+
 # ---------------------------------------------------------------------------
 # gearing parity: geared == fixed, both sync modes, both engines
 # ---------------------------------------------------------------------------

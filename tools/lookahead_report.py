@@ -1,0 +1,162 @@
+#!/usr/bin/env python
+"""Per-shard-pair lookahead report for asynchronous conservative sync.
+
+Given a simulation config, derive and print the [S, S] lookahead matrix
+the async islands driver runs under (parallel/lookahead.py): entry
+(j, i) is the minimum baked path latency from any host of shard j to any
+host of shard i — how far shard i may safely run ahead of shard j's
+frontier. The diagonal is each shard's intra-shard minimum (its safe
+local window width), and the CRITICAL LINK — the minimum off-diagonal
+entry — is the edge that bounds async slack fleet-wide: raising that one
+latency (or re-partitioning hosts so the chatty pair lands in one shard,
+the ROADMAP's min-cut placement item) buys the most asynchrony.
+
+  python tools/lookahead_report.py config.yaml [--shards S] [--json]
+
+--shards overrides experimental.num_shards (the partition to analyze;
+the config's host count must divide by it). --json emits one machine-
+readable object instead of the table. Exit 0 on success, 2 with a
+one-line diagnosis on a bad config — never a traceback.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def _fmt_ns(v: int, never: int) -> str:
+    if v >= never:
+        return "-"
+    if v % 1_000_000 == 0:
+        return f"{v // 1_000_000}ms"
+    if v % 1_000 == 0:
+        return f"{v // 1_000}us"
+    return f"{v}ns"
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = sys.argv[1:] if argv is None else list(argv)
+    as_json = "--json" in args
+    if as_json:
+        args.remove("--json")
+    shards = None
+    if "--shards" in args:
+        i = args.index("--shards")
+        try:
+            shards = int(args[i + 1])
+        except (IndexError, ValueError):
+            print("--shards needs an integer", file=sys.stderr)
+            return 2
+        del args[i:i + 2]
+    if len(args) != 1 or args[0] in ("-h", "--help"):
+        print(__doc__.strip(), file=sys.stderr)
+        return 0 if args and args[0] in ("-h", "--help") else 2
+
+    import numpy as np
+
+    from shadow_tpu.core import simtime
+    from shadow_tpu.core.config import ConfigError, load_config
+    from shadow_tpu.parallel import lookahead as lookahead_mod
+    from shadow_tpu.routing.topology import Topology
+
+    path = args[0]
+    try:
+        cfg = load_config(path)
+    except FileNotFoundError:
+        print(f"{path}: no such file", file=sys.stderr)
+        return 2
+    except (ConfigError, ValueError) as e:
+        print(f"{path}: {e}", file=sys.stderr)
+        return 2
+    S = shards if shards is not None else cfg.experimental.num_shards
+    if S < 1:
+        print(f"{path}: num_shards must be >= 1, got {S}", file=sys.stderr)
+        return 2
+    try:
+        topo = Topology.from_gml(
+            cfg.graph_gml(), cfg.network.use_shortest_path
+        )
+        for i, h in enumerate(cfg.hosts):
+            topo.attach_host(
+                i,
+                ip_address_hint=h.ip_address_hint,
+                city_code_hint=h.city_code_hint,
+                country_code_hint=h.country_code_hint,
+                network_node_id=h.network_node_id,
+            )
+        baked = topo.bake()
+        spec = lookahead_mod.derive(
+            baked.latency_vv, baked.host_vertex, S
+        )
+    except (ValueError, KeyError) as e:
+        print(f"{path}: {e}", file=sys.stderr)
+        return 2
+
+    never = int(simtime.NEVER)
+    widths = lookahead_mod.shard_runahead(spec, baked.min_latency_ns)
+    if as_json:
+        doc = {
+            "kind": "shadow_tpu.lookahead",
+            "num_shards": S,
+            "num_hosts": len(cfg.hosts),
+            "matrix_ns": [
+                [int(v) if v < never else None for v in row]
+                for row in spec.matrix
+            ],
+            "intra_ns": [int(v) if v < never else None for v in spec.intra],
+            "shard_runahead_ns": [int(v) for v in widths],
+            "min_cross_ns": (
+                int(spec.min_cross) if spec.min_cross < never else None
+            ),
+            "critical_link": (
+                list(spec.critical) if spec.min_cross < never else None
+            ),
+            "global_runahead_ns": int(baked.min_latency_ns),
+            "auto_spread_ns": lookahead_mod.auto_spread(
+                spec, baked.min_latency_ns
+            ),
+        }
+        print(json.dumps(doc, indent=1))
+        return 0
+
+    print(f"lookahead matrix ({S} shards, {len(cfg.hosts)} hosts; "
+          f"row=src shard, col=dst shard; '-' = no direct path):")
+    hdr = "      " + "".join(f"{i:>10d}" for i in range(S))
+    print(hdr)
+    for j in range(S):
+        row = "".join(
+            f"{_fmt_ns(int(spec.matrix[j, i]), never):>10}"
+            for i in range(S)
+        )
+        print(f"  {j:>3d} {row}")
+    print()
+    print("per-shard safe window widths (intra minimum, floored at the "
+          "configured runahead):")
+    for s in range(S):
+        print(f"  shard {s}: {_fmt_ns(int(widths[s]), never)}")
+    print()
+    if spec.min_cross < never:
+        j, i = spec.critical
+        print(f"critical link: shard {j} -> shard {i} at "
+              f"{_fmt_ns(int(spec.min_cross), never)} — this latency "
+              f"bounds how far any shard may run ahead; re-partitioning "
+              f"the chatty pair into one shard (min-cut placement) or "
+              f"raising it buys the most async slack")
+    else:
+        print("critical link: none — no shard pair communicates "
+              "directly; shards are fully decoupled")
+    print(f"global conservative runahead (barrier window width): "
+          f"{_fmt_ns(int(baked.min_latency_ns), never)}")
+    print(f"auto roughness spread bound: "
+          f"{_fmt_ns(lookahead_mod.auto_spread(spec, baked.min_latency_ns), never)}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
