@@ -156,9 +156,13 @@ def make_shard_run_to_async(step, hi: int, axis: str = AXIS):
     The conservative invariant, per superstep: shard j's emissions in
     [ws_j, we_j) land at or after ws_j + L[j->i] >= frontier[j] +
     look_in[j->i] >= horizon_i >= we_i, so nothing i processes this
-    superstep can be overtaken by an in-flight delivery; deferred
-    exchange rows are pinned by the pmin'd exch_deferred_min clamp
-    exactly as in the barrier loop. Committed per-host event order is
+    superstep can be overtaken by an in-flight delivery. That LBTS
+    argument covers only events still to be EMITTED; a deferred
+    exchange row has already been emitted and paid its path latency —
+    it lands at its pool time, NOT at source-frontier + L — so both
+    the running horizon and the initial frontier f0 must additionally
+    min against the gathered exch_deferred_min (the earliest
+    in-transit row fleet-wide). Committed per-host event order is
     identical to the barrier schedule, so the audit digest chain is
     bit-identical (tests/test_async_sync.py).
     """
@@ -249,15 +253,26 @@ def make_shard_run_to_async(step, hi: int, axis: str = AXIS):
             return state, frontier, mn2, w + 1, stats
 
         mn0 = jnp.min(state.pool.time)
-        # per-dispatch frontier re-derivation from pool state alone: no
-        # event below min_j(mn_j + L[j->i]) can ever arrive at shard i,
-        # so the restart is safe after any host-side interruption (spill
-        # manage, fault drain, checkpoint resume, gear resize)
+        # per-dispatch frontier re-derivation from pool state alone, so
+        # the restart is safe after any host-side interruption (spill
+        # manage, fault drain, checkpoint resume, gear resize). Two
+        # bounds, both required: events still TO BE EMITTED by shard j
+        # cannot arrive at i below mn_j + L[j->i]; events ALREADY
+        # emitted but in transit (deferred exchange rows) have paid
+        # their path latency and land at their pool time — they are
+        # bounded only by the gathered exch_deferred_min, exactly as in
+        # _horizon. Omitting the deferred clamp would charge an
+        # in-transit row its link latency a second time and initialize
+        # the destination frontier past the row's landing time — a
+        # silent causality violation once the row lands.
         allmn = jax.lax.all_gather(mn0, axis)
         nocon0 = (look_in >= NEV) | (allmn >= NEV)
         f0 = jnp.minimum(
             jnp.minimum(
-                mn0, jnp.min(jnp.where(nocon0, NEV, allmn + look_in))
+                jnp.minimum(
+                    mn0, jnp.min(jnp.where(nocon0, NEV, allmn + look_in))
+                ),
+                jax.lax.pmin(state.exch_deferred_min, axis),
             ),
             stop,
         )
@@ -715,10 +730,16 @@ class IslandSimulation(Simulation):
     def _shift_gear(self, level: int) -> None:
         super()._shift_gear(level)
         self._C_shard = self._gear_ladder[level].capacity
-        if getattr(self, "_shard_shifter", None) is not None:
-            # re-align the per-shard ladder state to the new envelope
-            # (pressure downshifts and scalar-path shifts bypass it)
-            self._shard_shifter.seed(level)
+        sh = getattr(self, "_shard_shifter", None)
+        # a shard-shifter-initiated shift (_gear_tick_async) already has
+        # level == max(levels): the per-shard ladder states PRODUCED the
+        # new envelope, so keep them — seeding here would hoist every
+        # cool shard to the envelope and clear its downshift streak,
+        # reverting to exactly the fleet-wide behavior the shard shifter
+        # removes. Only shifts that bypassed it (pressure downshifts,
+        # scalar-path shifts, checkpoint restore) need the re-alignment.
+        if sh is not None and level != max(sh.levels):
+            sh.seed(level)
 
     def _pool_occupancy(self) -> int:
         """Gearing decision signal: live rows on the FULLEST shard."""

@@ -223,6 +223,54 @@ def test_roughness_spread_stays_bounded_under_skew():
     )
 
 
+def test_initial_frontier_clamped_by_deferred_exchange():
+    """The per-dispatch initial frontier f0 must min against the gathered
+    exch_deferred_min, exactly like the in-loop horizon: an in-transit
+    deferred row has already paid its path latency and lands at its pool
+    time, so deriving f0 from min_j(mn_j + L[j->i]) alone charges the
+    link a second time and can initialize a frontier PAST the landing
+    time — frontier is a monotone max in the carry, so once the row
+    lands the destination emits below its advertised bound and a
+    neighbor's committed window can swallow the arrival (silent: the
+    conservative loop has no arrival check). max_windows=0 skips the
+    loop body, so the returned frontier IS f0; a pending deferred row
+    below every pool event must clamp every shard's f0 to it."""
+    import jax.numpy as jnp
+
+    sim = build_simulation(_cfg(**_islands_exp()))
+    assert sim._async is True
+    mn0 = int(np.asarray(sim.state.pool.time).min())
+    t_d = mn0 - 50_000  # in-transit row earlier than all pool events
+    state = sim.state.replace(
+        exch_deferred_min=jnp.asarray(
+            [t_d] + [NEVER] * (sim.num_shards - 1), jnp.int64
+        )
+    )
+    out = sim._run_to_async(
+        state, sim.params, sim._async_runahead, sim._async_look_in,
+        sim._async_spread, sim.stop_time, 0,
+    )
+    frontier = np.asarray(out[5]).reshape(-1)
+    assert (frontier == t_d).all(), frontier
+
+
+def test_deferred_exchange_across_dispatch_boundary(reference):
+    """Integration arm of the f0-clamp regression: exchange_slots=1 plus
+    tiny dispatches force deferred rows to be in flight across many
+    dispatch boundaries (each re-deriving f0 from pool state); the run
+    must stay bit-identical to the barrier schedule and must actually
+    have deferred."""
+    chain, ev = reference
+    sim = build_simulation(_cfg(**_islands_exp(exchange_slots=1)))
+    assert sim._async is True
+    sim.run(windows_per_dispatch=2)
+    assert sim.counters()["exchange_deferred"] > 0, (
+        "workload never deferred — the regression path was not exercised"
+    )
+    assert sim.counters()["events_committed"] == ev
+    assert sim.audit_chain() == chain
+
+
 def test_loose_spread_runs_further_ahead():
     """Control arm: the auto (loose) bound lets the fast shards spread
     beyond the tight bound — proving the tight run's flat frontier
@@ -334,6 +382,28 @@ def test_shard_gear_press_forces_envelope_up():
     sh = ShardGearShifter(ladder, 2)
     sh.seed(0)
     assert sh.observe(0, [10, 10], press=[False, True]) == 1
+
+
+def test_shifter_initiated_shift_keeps_per_shard_levels():
+    """_shift_gear must not re-seed the shard shifter for envelope
+    changes the shifter itself produced (level == max(levels)): seeding
+    hoists every cool shard to the envelope and clears its downshift
+    streak, reverting to fleet-wide gearing at each shift boundary.
+    External shifts (pressure downshift, scalar path, restore) still
+    re-align."""
+    sim = build_simulation(_cfg(**_islands_exp(pool_gears=2)))
+    sh = sim._shard_shifter
+    assert sh is not None
+    low = sim._gear_ladder[0].level
+    top = sim._gear_ladder[-1].level
+    sh.levels = [low, top]
+    sh._streak = [1, 0]
+    sim._shift_gear(top)  # shifter-initiated: envelope == max(levels)
+    assert sh.levels == [low, top]
+    assert sh._streak == [1, 0]
+    sim._shift_gear(low)  # external downshift below the envelope
+    assert sh.levels == [low, low]
+    assert sh._streak == [0, 0]
 
 
 # ---------------------------------------------------------------------------
