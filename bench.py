@@ -1239,6 +1239,200 @@ def stage_async_smoke(shards: int = 4, hosts_per_shard: int = 4,
     }
 
 
+def _balance_smoke_gml(shards: int, per: int, seed: int = 7) -> str:
+    """The balance-smoke topology: one vertex per host, decohered
+    UNIFORM intra-shard latency bands (no structurally fast shard — the
+    hotness must come from the `skew_hosts` injection, not the graph)
+    and large distinct cross-shard latencies (generous lookahead, so the
+    only thing that throttles the healthy shards is a laggard's
+    frontier)."""
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    n = shards * per
+
+    def band(a: int, b: int) -> tuple[int, int]:
+        if a // per != b // per:
+            return 700000, 900000
+        return 30000, 250000
+
+    lines = ["graph ["]
+    for v in range(n):
+        lines.append(f"  node [ id {v} ]")
+    for a in range(n):
+        for b in range(a, n):
+            lo, hi = band(a, b)
+            lines.append(
+                f'  edge [ source {a} target {b} latency '
+                f'"{int(rng.randint(lo, hi))} us" ]'
+            )
+    lines.append("]")
+    return "\n".join(lines)
+
+
+def stage_balance_smoke(shards: int = 4, per: int = 4, stop_s: int = 10,
+                        skew_at_s: int = 2, settle_s: int = 4):
+    """Self-balancing fleet gate (ISSUE 11 acceptance): a hot-shard
+    workload DRIVEN by a `skew_hosts` injection — destination-biased
+    PHOLD (half of all traffic targets shard 0's hosts) whose pending
+    events are replicated 6x at t=2s — run three ways:
+
+      control   balancer off: shard 0 stays the chronic frontier
+                laggard for the rest of the run;
+      balanced  balancer on: the hot shard is detected (occupancy +
+                laggard hysteresis), the assignment refined by min-cut,
+                and hosts migrated live through the traced-lookahead
+                seam;
+      rollback  balancer on with a FORCED mid-migration failure on the
+                first attempt (ShardBalancer.inject_failure_next): the
+                move must roll back to the pre-move layout + cooldown.
+
+    Gates: the balanced arm shows LOWER post-settle frontier spread and
+    FEWER blocked_on_neighbor supersteps than control; all three arms'
+    audit digest chains are BIT-IDENTICAL (migrations and rollbacks
+    change the schedule, never the simulation); at least one migration
+    committed and the rollback arm rolled back; the balanced run is
+    retrace-free (migrations never recompile — hlo_audit.retrace_report
+    gate); and the schema-v10 metrics artifact records balance.* and
+    validates under --strict-namespaces. CPU-deterministic by design."""
+    import jax
+
+    from shadow_tpu.analysis import hlo_audit
+    from shadow_tpu.core import simtime
+    from shadow_tpu.obs import metrics as obs_metrics
+    from shadow_tpu.sim import build_simulation
+
+    gml = _balance_smoke_gml(shards, per)
+    n = shards * per
+
+    def cfg(balancer: bool) -> dict:
+        hosts = {}
+        for v in range(n):
+            hosts[f"h{v:02d}"] = {
+                "quantity": 1, "network_node_id": v, "app_model": "phold",
+                "app_options": {
+                    "msgload": 2, "runtime": stop_s - 1,
+                    # persistent destination bias: half of ALL forwards
+                    # target shard 0's hosts, so the skew_hosts
+                    # amplification keeps re-concentrating there until
+                    # (unless) the balancer spreads those hosts out
+                    "hot_frac": per / n, "hot_share": 0.5,
+                },
+            }
+        return {
+            "general": {"stop_time": stop_s, "seed": 42},
+            "network": {"graph": {"type": "gml", "inline": gml}},
+            "experimental": {
+                "event_capacity": 4096, "events_per_host_per_window": 8,
+                "outbox_slots": 8, "inbox_slots": 4,
+                "num_shards": shards, "exchange_slots": 32,
+                "rebalance": True,  # control arm compiles the same
+                # slot_of-routing kernel, so the comparison is balancer
+                # policy only, never kernel shape
+                "balancer": balancer,
+                "balance_streak": 3, "balance_cooldown": 8,
+                "balance_hot_ratio": 1.5,
+            },
+            "hosts": hosts,
+            "faults": {"inject": [{
+                "at": f"{skew_at_s} s", "op": "skew_hosts",
+                "span": [0, per], "factor": 6,
+            }]},
+        }
+
+    settle_ns = (skew_at_s + settle_s) * simtime.NS_PER_SEC
+
+    def run_arm(mode: str):
+        sim = build_simulation(cfg(mode != "control"))
+        sim.attach_faults(sim.config.faults.load_faults())
+        if mode == "rollback":
+            sim.balancer.inject_failure_next()
+        # phase 1: pre-skew + skew + the balancer's detection/migration
+        # window; phase 2 (post-settle) is what the gates measure
+        sim.run(until=settle_ns, windows_per_dispatch=16)
+        blocked0 = (sim.async_stats() or {}).get("blocked_on_neighbor", 0)
+        sim.reset_frontier_spread()
+        sim.run(windows_per_dispatch=16)
+        blocked2 = (
+            (sim.async_stats() or {}).get("blocked_on_neighbor", 0)
+            - blocked0
+        )
+        spread2 = (sim.async_gauges() or {}).get(
+            "frontier_spread_max_ns", -1
+        )
+        return sim, blocked2, spread2
+
+    control, blocked_c, spread_c = run_arm("control")
+    balanced, blocked_b, spread_b = run_arm("balanced")
+    rollback, blocked_r, _ = run_arm("rollback")
+
+    chain = balanced.audit_chain()
+    chains_equal = (
+        chain == control.audit_chain() == rollback.audit_chain()
+    )
+    ev = balanced.counters()["events_committed"]
+    events_equal = (
+        ev == control.counters()["events_committed"]
+        == rollback.counters()["events_committed"]
+    )
+    bstats = balanced.balance_stats() or {}
+    rstats = rollback.balance_stats() or {}
+    retrace = hlo_audit.retrace_report(balanced)
+
+    metrics_path = os.path.join(_REPO, "balance_smoke.metrics.json")
+    session = obs_metrics.ObsSession()
+    session.finalize(balanced)
+    doc = session.metrics.dump(metrics_path, meta={
+        "stage": "balance_smoke", "hosts": n, "shards": shards,
+    })
+    obs_metrics.validate_metrics_doc(doc, strict_namespaces=True)
+    balance_recorded = (
+        doc["counters"].get("balance.migrations", 0) > 0
+        and "balance.state" in doc["gauges"]
+    )
+
+    gate_blocked = blocked_b < blocked_c
+    gate_spread = 0 <= spread_b < spread_c
+    gate_chain = bool(chains_equal and events_equal)
+    gate_heal = bstats.get("migrations", 0) >= 1
+    gate_rollback = rstats.get("rollbacks", 0) >= 1
+    return {
+        "stage": "balance_smoke",
+        "platform": jax.default_backend(),
+        "hosts": n,
+        "shards": shards,
+        "events": int(ev),
+        "chain": int(chain),
+        "chain_equal": bool(chains_equal),
+        "events_equal": bool(events_equal),
+        "skewed_rows": int(
+            balanced.fault_stats().get("events_skewed", 0)
+        ),
+        "migrations": int(bstats.get("migrations", 0)),
+        "hosts_moved": int(bstats.get("hosts_moved", 0)),
+        "rollbacks_in_rollback_arm": int(rstats.get("rollbacks", 0)),
+        "blocked_control": int(blocked_c),
+        "blocked_balanced": int(blocked_b),
+        "blocked_rollback_arm": int(blocked_r),
+        "spread_control_ns": int(spread_c),
+        "spread_balanced_ns": int(spread_b),
+        "shard_loads_control": [int(x) for x in control.shard_loads()],
+        "shard_loads_balanced": [int(x) for x in balanced.shard_loads()],
+        "retrace_ok": bool(retrace["ok"]),
+        "kernel_compiles": int(retrace["compiles_total"]),
+        "metrics_out": os.path.relpath(metrics_path, _REPO),
+        "gate_blocked": bool(gate_blocked),
+        "gate_spread": bool(gate_spread),
+        "gate_chain": gate_chain,
+        "gate_heal": bool(gate_heal),
+        "gate_rollback": bool(gate_rollback),
+        "gate": bool(
+            gate_blocked and gate_spread and gate_chain and gate_heal
+            and gate_rollback and retrace["ok"] and balance_recorded
+        ),
+    }
+
+
 _SERVE_SMOKE_SWEEP = {
     "sweep": {
         "name": "serve-smoke",
@@ -1427,6 +1621,15 @@ def main():
         # the comparison is CPU-deterministic — no backend wait.
         os.environ.setdefault("SHADOW_TPU_BENCH_ALLOW_CPU", "1")
         print(json.dumps(stage_async_smoke()), flush=True)
+        return
+    if "--balance-smoke" in sys.argv:
+        # self-balancing gate: a skew_hosts-driven hot shard is detected
+        # and healed by a verified live migration — lower frontier
+        # spread + fewer blocked supersteps than the balancer-off arm,
+        # bit-identical chains (incl. a forced mid-migration rollback),
+        # zero retraces. All arms share one CPU backend — no backend wait.
+        os.environ.setdefault("SHADOW_TPU_BENCH_ALLOW_CPU", "1")
+        print(json.dumps(stage_balance_smoke()), flush=True)
         return
     if "--pressure-smoke" in sys.argv:
         # pressure-plane gate: exhaust_backend / saturate_pool injections

@@ -119,6 +119,17 @@ def save(sim, path: str, extra_meta: dict | None = None) -> None:
         a = am()
         if a:
             meta["async"] = a
+    # Self-balancing plane (parallel/balancer.py): the LIVE host->slot
+    # assignment and controller posture ride the header, so a migrated
+    # layout survives drain-to-checkpoint and an operator can audit it
+    # without replay. Restore rebuilds the routing table from the state's
+    # own gid rows (the _post_restore hook below), so the block is also
+    # what re-arms an in-progress cooldown on resume.
+    bm = getattr(sim, "_balance_meta", None)
+    if bm is not None:
+        b = bm()
+        if b:
+            meta["balance"] = b
     if extra_meta:
         meta.update(extra_meta)
     meta["digest"] = _digest(arrays)
@@ -283,6 +294,13 @@ def restore(sim, path: str) -> None:
                 )
             new_leaves.append(jax.numpy.asarray(arr))
     sim.state = jax.tree_util.tree_unflatten(treedef, new_leaves)
+    # layout-derived runtime state (islands slot_of routing table, async
+    # lookahead, balancer posture) lives outside the state pytree; give
+    # the sim a chance to re-sync it against the restored leaves — a
+    # checkpoint taken after a live migration restores PERMUTED host rows
+    hook = getattr(sim, "_post_restore", None)
+    if hook is not None:
+        hook(meta)
 
 
 # ---------------------------------------------------------------------------

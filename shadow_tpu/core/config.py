@@ -201,6 +201,18 @@ class ExperimentalOptions:
     # Between-window host->shard re-sharding on load skew (the P3
     # work-stealing replacement, scheduler_policy_host_steal.c analog).
     rebalance: bool = False
+    # Self-balancing fleet (parallel/balancer.py): the closed-loop
+    # hot-shard controller — detect a chronic frontier laggard with
+    # skewed resident load, refine the host->shard assignment by greedy
+    # min-cut, migrate live at a dispatch boundary with a verified digest
+    # chain, roll back + cool down on any mid-migration failure. Implies
+    # `rebalance` (the slot_of routing seam). The balance_* knobs are the
+    # hysteresis guards (docs/fault_tolerance.md §6).
+    balancer: bool = False
+    balance_hot_ratio: float = 1.5
+    balance_streak: int = 3
+    balance_cooldown: int = 8
+    balance_max_moves: int = 8
     inbox_slots: int = 8  # B: per-host intra-window self-event slots
     outbox_slots: int = 64  # O: per-host emission slots per window
     # CPU model (host/cpu.c analog): simulated processing cost per syscall
@@ -296,6 +308,23 @@ class ExperimentalOptions:
                 )
         if "rebalance" in d:
             out.rebalance = bool(d["rebalance"])
+        if "balancer" in d:
+            out.balancer = bool(d["balancer"])
+        for name in ("balance_streak", "balance_cooldown",
+                     "balance_max_moves"):
+            if name in d:
+                setattr(out, name, int(d[name]))
+                if getattr(out, name) < 1:
+                    raise ConfigError(
+                        f"experimental.{name} must be >= 1"
+                    )
+        if "balance_hot_ratio" in d:
+            out.balance_hot_ratio = float(d["balance_hot_ratio"])
+            if out.balance_hot_ratio <= 1.0:
+                raise ConfigError(
+                    "experimental.balance_hot_ratio must be > 1.0 (a "
+                    "ratio at/below the mean would trigger constantly)"
+                )
         if "async_islands" in d:
             out.async_islands = bool(d["async_islands"])
         if d.get("async_spread") is not None:

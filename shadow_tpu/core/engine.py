@@ -2420,13 +2420,19 @@ class Simulation:
         # Backend injections at or before the restored frontier already
         # happened — the outage was the very reason this run is resuming.
         # Marking them fired stops a re-attached plan from re-draining the
-        # resumed run the moment it dispatches.
+        # resumed run the moment it dispatches. skew_hosts joins them:
+        # its effect (the replicated pool rows) is IN the restored state,
+        # so re-firing would double-inject and diverge from the
+        # uninterrupted chain. kill_host deliberately stays re-fireable —
+        # quarantine is idempotent, and re-firing rebuilds the dead-host
+        # set (runtime state no checkpoint carries).
         inj = self.fault_injector
         if inj is not None:
             from shadow_tpu.faults import plan as plan_mod
 
+            replayed = plan_mod.BACKEND_OPS | {"skew_hosts"}
             for f in inj.faults:
-                if (not f.fired and f.op in plan_mod.BACKEND_OPS
+                if (not f.fired and f.op in replayed
                         and f.at_ns <= info["sim_ns"]):
                     inj.mark_fired(f)
         return info
@@ -2487,6 +2493,75 @@ class Simulation:
             self.state = obs_mod.bump_win(self.state, obs_mod.WIN_FAULTS)
         return n
 
+    def skew_hosts(self, hosts, factor: int) -> int:
+        """Deterministic traffic-skew injection (the ``skew_hosts`` fault
+        op): multiply the selected hosts' event rates by `factor` from
+        this handoff boundary on, by replicating each host's pending pool
+        rows `factor - 1` times (copies one nanosecond apart — a strict
+        total order on every engine layout; faults/injector.skew_pool_np).
+        Runs at handoff boundaries only, where the dispatch clamp
+        (_fault_mark) has pinned every frontier — including the async
+        islands per-shard frontiers — at or below the injection time, so
+        a copy (which inherits a pending event's time, at or after its
+        owner shard's frontier) can never violate causality. Copies that
+        do not fit the pool park on the spill tier (late, never lost).
+        Quarantined hosts are skipped. Returns rows injected."""
+        from shadow_tpu.faults import injector as inj_mod
+
+        if factor < 2:
+            return 0
+        ids = [self._resolve_host_id(h) for h in hosts]
+        pool = self.state.pool
+        cols = [
+            np.array(jax.device_get(c)) for c in (
+                pool.time, pool.dst, pool.src, pool.seq, pool.kind,
+                pool.payload,
+            )
+        ]
+        flat = cols[0].ndim == 1  # global [C] layout vs islands [S, C]
+        if flat:
+            cols = [c[None] for c in cols]
+        (t, d, s, q, k, p), made, overflow = inj_mod.skew_pool_np(
+            cols, ids, factor, dead=self._dead_hosts
+        )
+        parked = 0
+        if overflow:
+            sp = self._spill_store()
+            for r, rows in sorted(overflow.items()):
+                parked += sp.park(r, rows)
+            self._force_spill = True  # manage() re-admits parked rows
+        if flat:
+            t, d, s, q, k, p = (c[0] for c in (t, d, s, q, k, p))
+        self.state = self.state.replace(pool=pool.replace(
+            time=jnp.asarray(t), dst=jnp.asarray(d), src=jnp.asarray(s),
+            seq=jnp.asarray(q), kind=jnp.asarray(k),
+            payload=jnp.asarray(p),
+        ))
+        self.fault_counters["events_skewed"] = (
+            self.fault_counters.get("events_skewed", 0) + made + parked
+        )
+        self.state = obs_mod.bump_win(self.state, obs_mod.WIN_FAULTS)
+        obs = self.obs_session
+        if obs is not None and obs.tracer:
+            obs.tracer.fault(
+                "skew_hosts", hosts=len(ids), factor=int(factor),
+                injected=made + parked,
+            )
+        return made + parked
+
+    def _skew_fault_ids(self, f) -> list:
+        """Resolve a skew_hosts fault's host selection (id/name list or
+        [first, count] span) against this sim's host table."""
+        if f.span is not None:
+            first, count = f.span
+            if first >= self.num_hosts:
+                raise ValueError(
+                    f"skew_hosts: span start {first} out of range "
+                    f"[0, {self.num_hosts})"
+                )
+            return list(range(first, min(first + count, self.num_hosts)))
+        return list(f.hosts or [])
+
     def _handoff_tick(self, mn: int) -> None:
         """The fault-plane + auto-checkpoint hook every driver calls at
         its handoff boundary (state synced, `mn` = committed frontier):
@@ -2505,6 +2580,8 @@ class Simulation:
                 if f.op == "kill_host":
                     self.quarantine_host(f.host)
                     drained_this_tick = True
+                elif f.op == "skew_hosts":
+                    self.skew_hosts(self._skew_fault_ids(f), f.factor)
                 elif f.op == "force_spill":
                     self._force_spill = True
                     self.state = obs_mod.bump_win(

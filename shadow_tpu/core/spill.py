@@ -178,6 +178,23 @@ class HostSpill:
         )
         return t, d, s, q, k, p
 
+    def park(self, shard: int, rows) -> int:
+        """Fault plane (engine.skew_hosts overflow): merge externally
+        built (t, d, s, q, k, p[PP]) columns into one shard's parked set,
+        re-establishing the (time, dst, src, seq) order invariant — the
+        rows re-enter the pool through the normal rebalance path, late
+        but never lost. Returns rows parked."""
+        n = rows[0].shape[0]
+        if n == 0:
+            return 0
+        merged = [
+            np.concatenate([a, b]) for a, b in zip(self._rows[shard], rows)
+        ]
+        order = self._order(merged[0], merged[1], merged[2], merged[3])
+        self._rows[shard] = tuple(c[order] for c in merged)
+        self.drained_total += n
+        return n
+
     def drain_hosts(self, dead) -> int:
         """Fault plane (engine.quarantine_host): drop every parked row
         destined to a dead host, all shards. Returns rows dropped. The

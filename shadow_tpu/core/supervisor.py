@@ -278,6 +278,14 @@ class BackendSupervisor:
         that fits only after the ladder reshaped the working set."""
         self._inject_exhausts += max(1, int(recover_after or 1))
 
+    @property
+    def degraded(self) -> bool:
+        """True while the backend is lost or the run is on the CPU
+        fallback — the interlock signal elective reshapes (the shard
+        balancer's live migrations, parallel/balancer.py) consult: no
+        optional work while survival machinery is driving."""
+        return self._dead or self.failover
+
     # -- probing --
 
     def probe(self) -> bool:

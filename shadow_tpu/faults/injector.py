@@ -13,6 +13,8 @@ from __future__ import annotations
 import glob
 import os
 
+import numpy as np
+
 from shadow_tpu.faults import plan as plan_mod
 
 
@@ -57,6 +59,62 @@ class FaultInjector:
         for op, n in sorted(self.counts.items()):
             d[f"injected_{op}"] = n
         return d
+
+
+def skew_pool_np(cols, host_ids, factor: int, dead=frozenset()):
+    """Execute one skew_hosts fault on host-side pool columns: replicate
+    every pending row destined to a selected host `factor - 1` times, each
+    copy one nanosecond after the last (a strict total order with the
+    original — (time, dst, src, seq) keys never collide, so extraction
+    order is unambiguous on every engine layout). Deterministic: pure
+    array arithmetic, no RNG.
+
+    `cols` is (time, dst, src, seq, kind, payload) numpy arrays with a
+    leading row axis — [1, C] for the global pool, [S, C] per shard under
+    islands (a copy stays in its original's row: same dst, same owner
+    shard). Copies land in the row's free (NEVER) slots; rows that do not
+    fit come back as per-leading-row overflow column tuples for the
+    caller's spill tier (late, never lost — the engine parks them; the
+    fleet, which has no spill tier, counts them dropped).
+
+    Returns (cols, made, overflow) — the mutated columns, total copies
+    placed in the pool, and {row_index: column-tuple} overflow.
+    """
+    from shadow_tpu.core import simtime
+
+    NEVER = np.int64(simtime.NEVER)
+    t, d, s, q, k, p = (np.array(c) for c in cols)
+    ids = np.asarray(sorted(int(h) for h in set(host_ids) - set(dead)),
+                     np.int64)
+    made = 0
+    overflow: dict[int, tuple] = {}
+    if ids.size == 0 or factor < 2:
+        return (t, d, s, q, k, p), made, overflow
+    R = t.shape[0]
+    for r in range(R):
+        live = t[r] != NEVER
+        sel = np.flatnonzero(live & np.isin(d[r], ids))
+        if sel.size == 0:
+            continue
+        reps = np.repeat(sel, factor - 1)
+        # copy k of a row sits at time + k: unique keys, same window-ish
+        off = np.tile(np.arange(1, factor, dtype=np.int64), sel.size)
+        new = (t[r][reps] + off, d[r][reps], s[r][reps], q[r][reps],
+               k[r][reps], p[r][reps])
+        free = np.flatnonzero(~live)
+        n_fit = min(free.size, reps.size)
+        if n_fit:
+            slots = free[:n_fit]
+            t[r][slots] = new[0][:n_fit]
+            d[r][slots] = new[1][:n_fit]
+            s[r][slots] = new[2][:n_fit]
+            q[r][slots] = new[3][:n_fit]
+            k[r][slots] = new[4][:n_fit]
+            p[r][slots] = new[5][:n_fit]
+            made += n_fit
+        if n_fit < reps.size:
+            overflow[r] = tuple(c[n_fit:] for c in new)
+    return (t, d, s, q, k, p), made, overflow
 
 
 def corrupt_file(f: plan_mod.Fault, default_dir: str | None = None) -> list[str]:

@@ -11,6 +11,7 @@ A plan is a JSON document (``--fault-plan plan.json``) or the inline
         {"at": "2 s", "op": "wedge_proc",   "proc": "client.0"},
         {"at": "1 s", "op": "refuse_ipc",   "proc": "client.0", "count": 1},
         {"at": "3 s", "op": "kill_host",    "host": 3},
+        {"at": "4 s", "op": "skew_hosts",   "span": [0, 4], "factor": 6},
         {"at": "1 s", "op": "force_spill"},
         {"at": "2 s", "op": "kill_backend", "recover_after": 2},
         {"at": "2 s", "op": "stall_backend", "count": 3},
@@ -36,6 +37,23 @@ seconds). Ops are split by execution plane:
               whose committed frontier reaches ``at``:
                 kill_host   quarantine the host id/name: its pending pool
                             events drain at every subsequent handoff
+                skew_hosts  deterministic traffic skew: multiply the
+                            selected hosts' event rates by `factor` from
+                            virtual time `at` on, by replicating their
+                            pending pool rows (factor−1 copies, each one
+                            nanosecond apart — a strict total order, no
+                            RNG). Select with `hosts` (id/name list) or
+                            `span` ([first, count] of global host ids).
+                            Fires at the handoff boundary whose committed
+                            frontier reaches `at`, which the dispatch
+                            clamp pins exactly — and under the async
+                            islands driver every per-shard frontier is
+                            clamped at or below `at` there, so the
+                            injection is fleet-frontier-safe (copies
+                            inherit pending-event times, which no shard
+                            has run past). The chaos input the
+                            self-balancing plane heals (bench.py
+                            --balance-smoke), and usable standalone
                 force_spill force one pool-overflow spill episode
                 saturate_pool simulate sustained pool pressure: scale the
                             spill-tier marks by `frac` (0 < frac <= 1)
@@ -88,7 +106,9 @@ PLAN_KIND = "shadow_tpu.fault_plan"
 PLAN_SCHEMA_VERSION = 1
 
 PROC_OPS = frozenset({"kill_proc", "wedge_proc", "refuse_ipc"})
-DEVICE_OPS = frozenset({"kill_host", "force_spill", "saturate_pool"})
+DEVICE_OPS = frozenset(
+    {"kill_host", "skew_hosts", "force_spill", "saturate_pool"}
+)
 BACKEND_OPS = frozenset(
     {"kill_backend", "stall_backend", "exhaust_backend"}
 )
@@ -103,6 +123,7 @@ _FIELDS = {
     "wedge_proc": ({"proc"}, set()),
     "refuse_ipc": ({"proc"}, {"count"}),
     "kill_host": ({"host"}, set()),
+    "skew_hosts": (set(), {"hosts", "span", "factor"}),
     "force_spill": (set(), set()),
     "kill_backend": (set(), {"recover_after"}),
     "stall_backend": (set(), {"count"}),
@@ -135,6 +156,11 @@ class Fault:
     # saturate_pool: the factor the spill-tier marks scale by (smaller =
     # more severe simulated pressure)
     frac: float = 0.5
+    # skew_hosts: the selected hosts (id/name list, or [first, count]
+    # span of global host ids) and the rate multiplier
+    hosts: Optional[list] = None
+    span: Optional[list] = None
+    factor: int = 2
     path: Optional[str] = None
     mode: str = "truncate"
     dir: Optional[str] = None
@@ -201,6 +227,44 @@ def _parse_entry(i: int, d: dict) -> Fault:
         if not 0.0 < f.frac <= 1.0:
             raise FaultPlanError(
                 f"faults[{i}] ({op}): frac must be in (0, 1], got {f.frac}"
+            )
+    if op == "skew_hosts":
+        if ("hosts" in d) == ("span" in d):
+            raise FaultPlanError(
+                f"faults[{i}] (skew_hosts): exactly one of `hosts` "
+                f"(id/name list) or `span` ([first, count]) is required"
+            )
+        if "hosts" in d:
+            if not isinstance(d["hosts"], list) or not d["hosts"]:
+                raise FaultPlanError(
+                    f"faults[{i}] (skew_hosts): `hosts` must be a "
+                    f"non-empty list of host ids/names"
+                )
+            f.hosts = [
+                h if isinstance(h, int) else str(h) for h in d["hosts"]
+            ]
+        else:
+            sp = d["span"]
+            if (not isinstance(sp, list) or len(sp) != 2
+                    or not all(isinstance(x, int) for x in sp)
+                    or sp[0] < 0 or sp[1] < 1):
+                raise FaultPlanError(
+                    f"faults[{i}] (skew_hosts): `span` must be "
+                    f"[first >= 0, count >= 1], got {sp!r}"
+                )
+            f.span = [int(sp[0]), int(sp[1])]
+        if "factor" in d:
+            try:
+                f.factor = int(d["factor"])
+            except (TypeError, ValueError):
+                raise FaultPlanError(
+                    f"faults[{i}] (skew_hosts): factor must be an "
+                    f"integer, got {d['factor']!r}"
+                ) from None
+        if f.factor < 2:
+            raise FaultPlanError(
+                f"faults[{i}] (skew_hosts): factor must be >= 2 "
+                f"(1 is a no-op), got {f.factor}"
             )
     if "path" in d:
         f.path = str(d["path"])

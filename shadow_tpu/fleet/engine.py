@@ -68,10 +68,13 @@ class FleetError(ValueError):
 
 @dataclasses.dataclass
 class _LaneFaults:
-    """Job-scoped fault plane: resolved kill_host injections + the lane's
-    dead-host set (drained recurringly, the crashed-host semantic)."""
+    """Job-scoped fault plane: resolved kill_host / skew_hosts injections
+    + the lane's dead-host set (drained recurringly, the crashed-host
+    semantic)."""
 
-    pending: list  # [(at_ns, host_id)] sorted, unfired
+    # [(at_ns, op, payload)] sorted, unfired: payload is the host id for
+    # kill_host, ([host_ids], factor) for skew_hosts
+    pending: list
     dead: set
     stats: dict
 
@@ -272,20 +275,32 @@ class FleetSimulation:
         return int(np.max(np.asarray(jax.device_get(occ))))
 
     def _resolve_faults(self, sim) -> _LaneFaults:
-        """Resolve the job's fault plan (kill_host only; validated by
-        fleet/sweep.py) into (at_ns, host_id) pairs against ITS config's
-        host names — job-scoped: the injections only ever touch this
-        lane."""
+        """Resolve the job's fault plan (kill_host / skew_hosts; validated
+        by fleet/sweep.py) into (at_ns, op, payload) records against ITS
+        config's host names — job-scoped: the injections only ever touch
+        this lane."""
         lf = _LaneFaults.empty()
         cfg = getattr(sim, "config", None)
         faults = cfg.faults.load_faults() if cfg is not None else []
         for f in faults:
-            if f.op != "kill_host":  # validated earlier; belt-and-braces
-                raise FleetError(
-                    f"fleet fault plans support kill_host only, got {f.op!r}"
+            if f.op == "kill_host":
+                lf.pending.append(
+                    (int(f.at_ns), "kill_host", sim._resolve_host_id(f.host))
                 )
-            lf.pending.append((int(f.at_ns), sim._resolve_host_id(f.host)))
-        lf.pending.sort()
+            elif f.op == "skew_hosts":
+                ids = [
+                    sim._resolve_host_id(h)
+                    for h in sim._skew_fault_ids(f)
+                ]
+                lf.pending.append(
+                    (int(f.at_ns), "skew_hosts", (ids, int(f.factor)))
+                )
+            else:  # validated earlier; belt-and-braces
+                raise FleetError(
+                    f"fleet fault plans support kill_host/skew_hosts "
+                    f"only, got {f.op!r}"
+                )
+        lf.pending.sort(key=lambda r: r[0])
         return lf
 
     # ------------------------------------------------------------------
@@ -875,6 +890,10 @@ class FleetSimulation:
         if self.keep_final_subs:
             rec.subs = jax.device_get(lane_state.subs)
         self._lane_faults[lane] = _LaneFaults.empty()
+        if status == DONE:
+            # fold the observed event count into the packing estimator's
+            # rate EWMA (fleet/scheduler.calibrate)
+            self.sched.calibrate(rec)
         self._trace_harvest(lane, rec)
         return rec
 
@@ -891,7 +910,10 @@ class FleetSimulation:
             # pressure eviction in effect: the freed lane stays empty so
             # the resident working set actually shrinks (core/pressure.py)
             return False
-        rec = self.sched.peek()
+        # predicted-load packing / lane stealing (self-balancing plane):
+        # under "load" packing the freed lane takes the heaviest pending
+        # job instead of the FIFO head (fleet/scheduler.pick)
+        rec = self.sched.pick(lane)
         if rec is None:
             return False
         sim = _build_solo(rec.spec)
@@ -982,21 +1004,77 @@ class FleetSimulation:
                 continue
             lf = self._lane_faults[j]
             while lf.pending and lf.pending[0][0] <= mn[j]:
-                _, hid = lf.pending.pop(0)
+                _, op, payload = lf.pending.pop(0)
                 lf.stats["injections_fired"] = \
                     lf.stats.get("injections_fired", 0) + 1
-                if hid not in lf.dead:
-                    lf.dead.add(hid)
-                    lf.stats["hosts_quarantined"] = \
-                        lf.stats.get("hosts_quarantined", 0) + 1
-                    obs = self.obs_session
+                obs = self.obs_session
+                if op == "skew_hosts":
+                    ids, factor = payload
+                    n = self._skew_lane(j, ids, factor)
+                    lf.stats["events_skewed"] = \
+                        lf.stats.get("events_skewed", 0) + n
+                    changed = True
                     if obs is not None and obs.tracer is not None:
                         obs.tracer.fault(
-                            "kill_host", tid=j + 1, host=hid, lane=j
+                            "skew_hosts", tid=j + 1, lane=j,
+                            hosts=len(ids), factor=factor, injected=n,
+                        )
+                elif payload not in lf.dead:
+                    lf.dead.add(payload)
+                    lf.stats["hosts_quarantined"] = \
+                        lf.stats.get("hosts_quarantined", 0) + 1
+                    if obs is not None and obs.tracer is not None:
+                        obs.tracer.fault(
+                            "kill_host", tid=j + 1, host=payload, lane=j
                         )
             if lf.dead and self._drain_lane_dead(j):
                 changed = True
         return changed
+
+    def _skew_lane(self, lane: int, ids: list[int], factor: int) -> int:
+        """Apply one skew_hosts injection to a single lane's pool slice
+        (faults/injector.skew_pool_np — the solo engines' replication,
+        lane-scoped). The per-lane dispatch clamp (_fault_marks) pinned
+        this lane's frontiers at or below the injection time, so copies
+        (which inherit pending-event times) are frontier-safe. The fleet
+        has no spill tier: copies that do not fit the lane's pool are
+        counted dropped (`skew_overflow_dropped`) — deterministic, so
+        chain-parity arms see identical drops."""
+        from shadow_tpu.faults import injector as inj_mod
+
+        lf = self._lane_faults[lane]
+        pool = self.state.pool
+        cols = [
+            np.array(jax.device_get(c[lane])) for c in (
+                pool.time, pool.dst, pool.src, pool.seq, pool.kind,
+                pool.payload,
+            )
+        ]
+        flat = cols[0].ndim == 1  # global lanes [C] vs islands [S, C]
+        if flat:
+            cols = [c[None] for c in cols]
+        out, made, overflow = inj_mod.skew_pool_np(
+            cols, ids, factor, dead=lf.dead
+        )
+        t, d, s, q, k, p = (
+            (c[0] for c in out) if flat else out
+        )
+        self.state = self.state.replace(pool=pool.replace(
+            time=pool.time.at[lane].set(jnp.asarray(t)),
+            dst=pool.dst.at[lane].set(jnp.asarray(d)),
+            src=pool.src.at[lane].set(jnp.asarray(s)),
+            seq=pool.seq.at[lane].set(jnp.asarray(q)),
+            kind=pool.kind.at[lane].set(jnp.asarray(k)),
+            payload=pool.payload.at[lane].set(jnp.asarray(p)),
+        ))
+        dropped = sum(
+            rows[0].shape[0] for _, rows in sorted(overflow.items())
+        )
+        if dropped:
+            lf.stats["skew_overflow_dropped"] = \
+                lf.stats.get("skew_overflow_dropped", 0) + dropped
+        self._bump_lane_win(lane, obs_mod.WIN_FAULTS)
+        return made
 
     def _handoff(self, mn: np.ndarray, press: np.ndarray) -> bool:
         """Everything the host does between dispatches: job-scoped fault
@@ -1438,6 +1516,45 @@ class FleetSimulation:
             g["frontier_min_ns"] = int(self._async_frontier.min())
             g["frontier_max_ns"] = int(self._async_frontier.max())
         return g
+
+    def async_posture(self) -> dict:
+        """Operator-facing async posture for the serve daemon's /healthz
+        (docs/serving.md): the live frontier spread and WHICH (lane,
+        shard) is the laggard — the hot-shard signal `shadowctl status`
+        surfaces without grepping metrics JSON. {} for barrier fleets or
+        before the first async dispatch."""
+        if not self._async or self._async_frontier is None:
+            return {}
+        f = np.asarray(self._async_frontier)
+        lane, shard = np.unravel_index(int(np.argmin(f)), f.shape)
+        return {
+            "frontier_spread_ns": int(f.max() - f.min()),
+            "frontier_spread_max_ns": int(self._async_spread_max),
+            "laggard_lane": int(lane),
+            "laggard_shard": int(shard),
+        }
+
+    def balance_stats(self) -> dict[str, int] | None:
+        """Fleet-side balance plane (schema v10 `balance.*`): the
+        scheduler's predicted-load packing + lane-steal tallies; None
+        under plain FIFO with no decisions taken (solo sweeps carry no
+        balance keys)."""
+        s = self.sched
+        if s.packing == "fifo" and not s.pack_decisions:
+            return None
+        return {
+            "pack_decisions": int(s.pack_decisions),
+            "lane_steals": int(s.lane_steals),
+        }
+
+    def balance_gauges(self) -> dict | None:
+        s = self.sched
+        if s.packing == "fifo" and not s.pack_decisions:
+            return None
+        return {
+            "packing_load": int(s.packing == "load"),
+            "calibrated_rate": float(s.rate_ewma or 0.0),
+        }
 
     def ok(self) -> bool:
         return all(r.status == DONE for r in self.sched.records)

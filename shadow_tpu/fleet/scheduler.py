@@ -120,6 +120,20 @@ class FleetScheduler:
         # in-flight jobs returned to the queue by a backend drain
         self.lane_reclaims = 0
         self.jobs_requeued = 0
+        # self-balancing plane (ISSUE 11, metrics schema v10 balance.*):
+        # "load" packing hands a freed lane the HEAVIEST pending job by
+        # predicted load (LPT — lanes level out instead of draining
+        # FIFO), so a lane that finishes early effectively steals the
+        # biggest remaining job ahead of its queue position. The serve
+        # daemon enables it; solo sweeps keep strict FIFO.
+        self.packing = "fifo"  # "fifo" | "load"
+        self.pack_decisions = 0
+        self.lane_steals = 0
+        self._cost_cache: dict[str, float] = {}
+        # PHOLD-calibrated rate: EWMA of (events committed / predicted
+        # load units) over finished jobs — turns the static config proxy
+        # into an events estimate for telemetry and Retry-After hints
+        self.rate_ewma: Optional[float] = None
 
     # -- queue --
 
@@ -151,6 +165,72 @@ class FleetScheduler:
                 return r
             self._next += 1
         return None
+
+    # -- predicted-load packing (self-balancing plane, ISSUE 11) --
+
+    def predicted_load(self, record: JobRecord) -> float:
+        """Static per-job load proxy from the job's config — host count x
+        message load x simulated seconds (the PHOLD event-population
+        model; `estimate_hbm_bytes`-style preflight, but for event WORK
+        rather than memory). Cached per job name; multiplied by the
+        calibrated rate EWMA when one exists. Coarse on purpose: packing
+        only needs a total order, and a bad estimate costs placement
+        quality, never correctness."""
+        c = self._cost_cache.get(record.name)
+        if c is None:
+            try:
+                from shadow_tpu.core.config import load_config
+
+                cfg = load_config(record.spec.config)
+                H = sum(
+                    int(getattr(h, "quantity", 1)) for h in cfg.hosts
+                )
+                msgload = 1
+                for h in cfg.hosts:
+                    if h.app_model == "phold":
+                        msgload = int(h.app_options.get("msgload", 1))
+                        break
+                c = float(H * max(1, msgload)) * (
+                    cfg.general.stop_time / 1e9
+                )
+            except (ValueError, OSError):
+                c = 1.0  # unparseable config fails at admission anyway
+            self._cost_cache[record.name] = c
+        return c * (self.rate_ewma if self.rate_ewma else 1.0)
+
+    def calibrate(self, record: JobRecord) -> None:
+        """Fold one finished job's observed events into the rate EWMA
+        (called by the fleet after harvest, when the counters are in)."""
+        base = self._cost_cache.get(record.name)
+        if not base or record.events_committed <= 0:
+            return
+        rate = record.events_committed / base
+        self.rate_ewma = (
+            rate if self.rate_ewma is None
+            else 0.7 * self.rate_ewma + 0.3 * rate
+        )
+
+    def pick(self, lane: int) -> Optional[JobRecord]:
+        """The job a freed lane should admit: the FIFO head by default;
+        under "load" packing, the heaviest pending job by predicted load
+        (LPT onto the lane that freed first — lanes level out, and the
+        sweep's makespan stops being hostage to a heavy tail job parked
+        behind light ones). Taking a job from deeper in the queue is the
+        lane-level steal (`lane_steals`); deterministic tiebreak by
+        submission order."""
+        head = self.peek()
+        if head is None or self.packing != "load":
+            return head
+        pend = self.pending()
+        if len(pend) <= 1:
+            return head
+        best = max(
+            pend, key=lambda r: (self.predicted_load(r), -r.submit_idx)
+        )
+        self.pack_decisions += 1
+        if best is not head:
+            self.lane_steals += 1
+        return best
 
     # -- admission --
 
@@ -239,4 +319,6 @@ class FleetScheduler:
             "admission_upshifts": self.admission_upshifts,
             "lane_reclaims": self.lane_reclaims,
             "jobs_requeued": self.jobs_requeued,
+            "pack_decisions": self.pack_decisions,
+            "lane_steals": self.lane_steals,
         }

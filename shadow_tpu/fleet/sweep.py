@@ -249,11 +249,11 @@ def validate_jobs(jobs: list[JobSpec]) -> None:
                 f"process run)"
             )
         for f in cfg.faults.load_faults():
-            if f.op != "kill_host":
+            if f.op not in ("kill_host", "skew_hosts"):
                 raise SweepError(
                     f"job {job.name!r}: fleet fault plans support the "
-                    f"device-plane `kill_host` op only (got {f.op!r}); "
-                    f"proc/file ops need a solo run"
+                    f"device-plane `kill_host` / `skew_hosts` ops only "
+                    f"(got {f.op!r}); proc/file ops need a solo run"
                 )
     check_kernel_compat(jobs)
 

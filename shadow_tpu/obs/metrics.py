@@ -25,7 +25,10 @@ import numpy as np
 
 from shadow_tpu.obs import counters as obs_counters
 
-# v9: async.* asynchronous-conservative-sync namespace
+# v10: balance.* self-balancing-fleet namespace (parallel/balancer.py:
+# verified live migrations / rollbacks / interlock holds plus controller
+# posture gauges, and the fleet scheduler's load-packing + lane-steal
+# counters); v9: async.* asynchronous-conservative-sync namespace
 # (parallel/islands.py + parallel/lookahead.py: superstep/shard-window/
 # yield/blocked-on-neighbor counters plus frontier spread, spread-bound
 # and lookahead gauges); v8: pressure.* resource-pressure namespace
@@ -41,7 +44,7 @@ from shadow_tpu.obs import counters as obs_counters
 # obs/audit.py) + optional per-job `audit` sub-object on fleet.jobs[*]
 # rows; v4: optional top-level `fleet` section (fleet.jobs[*] per-job
 # rows) + fleet.* counters; v3: faults.* recovery counters
-SCHEMA_VERSION = 9
+SCHEMA_VERSION = 10
 DOC_KIND = "shadow_tpu.metrics"
 
 # metrics-doc `fleet.jobs[*]` rows must carry at least these keys
@@ -73,6 +76,7 @@ KNOWN_METRIC_NAMESPACES = frozenset({
     "serve",       # sim-as-a-service daemon plane (schema v7)
     "pressure",    # resource-pressure degradation ladder (schema v8)
     "async",       # asynchronous conservative sync (schema v9)
+    "balance",     # self-balancing fleet plane (schema v10)
     "sim",         # build-level gauges (num_hosts, runahead)
     "bench",       # bench.py gate-local rows
 })
@@ -214,6 +218,11 @@ def validate_metrics_doc(doc: dict, strict_namespaces: bool = False) -> None:
             raise ValueError(
                 f"async counter {k!r} must be >= 0, got {v}"
             )
+        if k.startswith("balance.") and v < 0:
+            # schema v10: self-balancing counters are monotonic tallies
+            raise ValueError(
+                f"balance counter {k!r} must be >= 0, got {v}"
+            )
     for k, v in doc["gauges"].items():
         if not isinstance(v, (int, float)) or isinstance(v, bool):
             raise ValueError(f"gauge {k!r} must be a number, got {v!r}")
@@ -346,6 +355,26 @@ def snapshot_device(sim, reg: MetricsRegistry) -> None:
             reg.counter_set(f"resilience.{k}", int(v))
     _snapshot_pressure(sim, reg)
     _snapshot_async(sim, reg)
+    _snapshot_balance(sim, reg)
+
+
+def _snapshot_balance(sim, reg: MetricsRegistry) -> None:
+    """Self-balancing plane (schema v10): migration / rollback / hold
+    counters plus controller posture gauges, from the islands balancer
+    (parallel/balancer.py) or the fleet scheduler's packing + stealing
+    tallies (fleet/scheduler.py; None/absent = no balance plane)."""
+    bs = getattr(sim, "balance_stats", None)
+    if bs is not None:
+        stats = bs()
+        if stats:
+            for k, v in stats.items():
+                reg.counter_set(f"balance.{k}", int(v))
+    bg = getattr(sim, "balance_gauges", None)
+    if bg is not None:
+        gauges = bg()
+        if gauges:
+            for k, v in gauges.items():
+                reg.gauge_set(f"balance.{k}", v)
 
 
 def _snapshot_async(sim, reg: MetricsRegistry) -> None:
@@ -414,6 +443,7 @@ def snapshot_fleet(fleet, reg: MetricsRegistry) -> None:
             reg.counter_set(f"resilience.{k}", int(v))
     _snapshot_pressure(fleet, reg)
     _snapshot_async(fleet, reg)
+    _snapshot_balance(fleet, reg)
     reg.section_set("fleet", {
         "lanes": int(stats.get("lanes", 0)),
         "lane_swaps": int(stats.get("lane_swaps", 0)),

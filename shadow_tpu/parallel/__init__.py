@@ -15,6 +15,10 @@ Multi-host (DCN) runs use the same annotations over a multi-process mesh —
 the window kernel is oblivious to where the collectives ride.
 """
 
+from shadow_tpu.parallel.balancer import (  # noqa: F401
+    BalancerPolicy,
+    ShardBalancer,
+)
 from shadow_tpu.parallel.islands import IslandSimulation  # noqa: F401
 from shadow_tpu.parallel.mesh import (  # noqa: F401
     host_mesh,

@@ -1,0 +1,447 @@
+"""Self-balancing fleet: closed-loop hot-shard healing (ISSUE 11).
+
+The async plane (parallel/islands.py) MEASURES imbalance — per-shard
+frontiers, occupancy vectors, blocked-on-neighbor supersteps — and the
+traced lookahead matrix plus the slot_of routing table make live
+re-partitioning recompile-free (rebalance_now). This module closes the
+loop: an online controller that watches the async posture at every
+dispatch boundary, and when one shard stays hot — the frontier laggard
+with chronically skewed resident load, exactly what a `skew_hosts`
+injection or a bursty production tenant produces — recomputes the
+host→shard assignment by greedy min-cut refinement (PARSIR's
+per-processor partition refinement, PAPERS.md: move boundary hosts off
+the hot shard while keeping lookahead-critical links intra-shard) and
+migrates at the next boundary through the existing traced-lookahead
+seam.
+
+Every migration is VERIFY-THEN-COMMIT: the pre-move digest chain and
+committed-event count are captured, the permutation is applied, and the
+post-move chain must extend the pre-move chain exactly (a host→shard
+permutation commits nothing and the combine is order-independent, so
+any difference is a divergence). A divergence — or a mid-migration
+failure of any kind (backend loss during the state fetch, a pressure
+rung firing) — rolls the simulation back to the pre-move snapshot and
+enters a cooldown instead of oscillating. The balancer also YIELDS to
+the other robustness planes: it never migrates during a pressure-ladder
+episode, mid-optimistic-attempt, or while the backend supervisor is
+degraded (holds are counted, never silently dropped).
+
+Determinism: a migration permutes the layout only — per-host event
+order, RNG streams and sequence numbering key on GLOBAL host ids — so a
+balanced run's audit digest chain is bit-identical to the balancer-off
+run (bench.py --balance-smoke gates this, with a forced mid-migration
+rollback arm). This is a HOST module: nothing here is ever traced.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from shadow_tpu.core import simtime
+
+NEVER = int(simtime.NEVER)
+
+# balance.state gauge encoding (docs/observability.md v10)
+STATE_STABLE = 0
+STATE_MIGRATING = 1
+STATE_COOLDOWN = 2
+
+_STATE_NAMES = {
+    STATE_STABLE: "stable",
+    STATE_MIGRATING: "migrating",
+    STATE_COOLDOWN: "cooldown",
+}
+
+
+@dataclasses.dataclass
+class BalancerPolicy:
+    """Knobs for the closed loop (docs/fault_tolerance.md §6).
+
+    hot_ratio       a shard is hot when its resident load exceeds this
+                    multiple of the mean shard load
+    min_skew_rows   AND leads the lightest shard by at least this many
+                    rows (noise floor: tiny absolute skews never trigger)
+    streak          consecutive hot dispatches before a migration fires
+                    (the hysteresis guard)
+    cooldown        dispatches to sit out after any migration, rollback
+                    or refinement no-op — the anti-oscillation clamp
+    max_moves       boundary-host swaps per migration
+    candidates      hosts considered per side of each swap (top loaded
+                    on the hot shard x least loaded on the target)
+    """
+
+    hot_ratio: float = 1.5
+    min_skew_rows: int = 32
+    streak: int = 3
+    cooldown: int = 8
+    max_moves: int = 8
+    candidates: int = 8
+
+
+class HotnessDetector:
+    """Pure hysteresis detector over the per-dispatch async posture.
+
+    A shard is HOT when its resident occupancy exceeds ``hot_ratio`` x
+    the mean (and the absolute skew clears the noise floor) AND — when
+    the async driver's frontier vector is available — it is the frontier
+    laggard (ties pass: at a clamped boundary every frontier sits at the
+    dispatch stop). The same shard must stay hot for ``streak``
+    consecutive dispatches before `observe` returns it; any other
+    outcome resets the streak, so transient bursts never migrate.
+    """
+
+    def __init__(self, policy: BalancerPolicy):
+        self.policy = policy
+        self._shard = -1
+        self._streak = 0
+
+    def reset(self) -> None:
+        self._shard = -1
+        self._streak = 0
+
+    @property
+    def streak(self) -> int:
+        return self._streak
+
+    def observe(self, occ, frontier=None) -> int | None:
+        occ = np.asarray(occ, np.float64)
+        hot = int(np.argmax(occ))
+        mean = float(occ.mean())
+        is_hot = (
+            mean > 0.0
+            and occ[hot] > self.policy.hot_ratio * mean
+            and occ[hot] - occ.min() >= self.policy.min_skew_rows
+        )
+        if is_hot and frontier is not None:
+            f = np.asarray(frontier, np.int64)
+            # the hot shard must also be the virtual-time laggard (or
+            # tied with it) — load skew the schedule absorbs is not worth
+            # a migration
+            is_hot = bool(f[hot] <= f.min())
+        if not is_hot:
+            self.reset()
+            return None
+        if hot != self._shard:
+            self._shard, self._streak = hot, 1
+        else:
+            self._streak += 1
+        if self._streak < self.policy.streak:
+            return None
+        self.reset()
+        return hot
+
+
+# ---------------------------------------------------------------------------
+# min-cut refinement (PARSIR-style per-processor partition refinement)
+# ---------------------------------------------------------------------------
+
+
+def _affinity_vv(latency_vv: np.ndarray) -> np.ndarray:
+    """Vertex-pair communication affinity: inverse baked path latency
+    (1e6/ns — microseconds of slack per event), 0 for unreachable pairs.
+    Low-latency links carry the most affinity, so a cut that severs them
+    costs the most — exactly the links whose severing would collapse the
+    derived cross-shard lookahead (parallel/lookahead.py min_cross)."""
+    lat = np.asarray(latency_vv, np.float64)
+    with np.errstate(divide="ignore"):
+        aff = 1e6 / np.maximum(lat, 1.0)
+    aff[np.asarray(latency_vv, np.int64) >= NEVER] = 0.0
+    return aff
+
+
+def host_affinity(latency_vv: np.ndarray, host_vertex: np.ndarray
+                  ) -> np.ndarray:
+    """[H, H] symmetrized host-pair affinity (O(H^2) floats — computed
+    only when a migration actually triggers, never per dispatch)."""
+    hv = np.asarray(host_vertex, np.int64)
+    aff = _affinity_vv(latency_vv)[np.ix_(hv, hv)]
+    return aff + aff.T
+
+
+def cut_cost(shard_of: np.ndarray, latency_vv: np.ndarray,
+             host_vertex: np.ndarray) -> float:
+    """Total affinity crossing shard boundaries under `shard_of` ([H]
+    shard index per global host id) — the objective the refinement holds
+    down and `tools/lookahead_report.py --assignment` prints for offline
+    review of a proposed assignment."""
+    shard = np.asarray(shard_of, np.int64)
+    aff = host_affinity(latency_vv, host_vertex)
+    cross = shard[:, None] != shard[None, :]
+    return float(aff[cross].sum() / 2.0)  # symmetrized: halve
+
+
+def refine_assignment(
+    load: np.ndarray,
+    cur_slot: np.ndarray,
+    num_shards: int,
+    hot: int,
+    latency_vv: np.ndarray,
+    host_vertex: np.ndarray,
+    policy: BalancerPolicy | None = None,
+) -> tuple[np.ndarray, int, float, float]:
+    """Greedy min-cut refinement of the host→slot assignment.
+
+    Slot counts per shard are FIXED (the compiled layout holds H/S rows
+    per shard), so every move is a SWAP: a heavy host on the hot shard
+    exchanges slots with a light host on the currently lightest shard.
+    Swap selection is load-first, cut-aware: among candidate pairs whose
+    load gain is at least half the best available, take the one with the
+    smallest cut-cost increase — boundary hosts (low affinity to their
+    own shard) move first, and a host carrying a lookahead-critical
+    intra-shard link effectively never does. Stops when the hot shard's
+    load falls back under the hot_ratio band, or after max_moves, or
+    when no candidate swap still sheds load.
+
+    Returns (new_slot, moves, cut_before, cut_after).
+    """
+    policy = policy or BalancerPolicy()
+    load = np.asarray(load, np.int64)
+    slot = np.array(cur_slot, np.int32)
+    H = slot.shape[0]
+    S = int(num_shards)
+    Hl = H // S
+    shard_of = slot // Hl
+    aff = host_affinity(latency_vv, host_vertex)
+    cut0 = cut_before = float(
+        aff[shard_of[:, None] != shard_of[None, :]].sum() / 2.0
+    )
+    cut = cut0
+
+    def shard_loads():
+        return np.bincount(shard_of, weights=load, minlength=S)
+
+    moves = 0
+    # settle just under the trigger band, not to perfect flatness: a
+    # target tighter than the detector's own threshold would re-trigger
+    # on the first post-migration wobble
+    for _ in range(policy.max_moves):
+        sl = shard_loads()
+        mean = sl.mean()
+        if sl[hot] <= max(policy.hot_ratio * mean, mean + 1):
+            break
+        target = int(np.argmin(sl))
+        if target == hot:
+            break
+        hot_hosts = np.flatnonzero(shard_of == hot)
+        cold_hosts = np.flatnonzero(shard_of == target)
+        cand_h = hot_hosts[np.argsort(-load[hot_hosts], kind="stable")][
+            :policy.candidates]
+        cand_c = cold_hosts[np.argsort(load[cold_hosts], kind="stable")][
+            :policy.candidates]
+        best = None  # (cut_delta, -gain, h, c)
+        gain_best = 0
+        pairs = []
+        for h in cand_h:
+            for c in cand_c:
+                gain = int(load[h] - load[c])
+                if gain <= 0:
+                    continue
+                gain_best = max(gain_best, gain)
+                pairs.append((int(h), int(c), gain))
+        for h, c, gain in pairs:
+            if gain * 2 < gain_best:
+                continue  # load-first: only near-best shedders compete
+            in_hot = shard_of == hot
+            in_tgt = shard_of == target
+            aff_h_hot = aff[h, in_hot].sum() - aff[h, h]
+            aff_h_tgt = aff[h, in_tgt].sum() - aff[h, c]
+            aff_c_tgt = aff[c, in_tgt].sum() - aff[c, c]
+            aff_c_hot = aff[c, in_hot].sum() - aff[c, h]
+            delta = (aff_h_hot - aff_h_tgt) + (aff_c_tgt - aff_c_hot)
+            key = (delta, -gain, h, c)
+            if best is None or key < best:
+                best = key
+        if best is None:
+            break
+        delta, _, h, c = best
+        slot[h], slot[c] = slot[c], slot[h]
+        shard_of[h], shard_of[c] = target, hot
+        cut += delta
+        moves += 1
+    return slot, moves, cut_before, float(cut)
+
+
+# ---------------------------------------------------------------------------
+# the controller
+# ---------------------------------------------------------------------------
+
+
+class ShardBalancer:
+    """The closed-loop controller, one per IslandSimulation (attach via
+    ``sim.attach_balancer`` / ``experimental.balancer: true``). The
+    driver calls ``observe`` at every fused-dispatch boundary with the
+    per-shard occupancy vector and (under the async driver) the frontier
+    surface; everything else — detection hysteresis, interlocks,
+    refinement, verified migration, rollback, cooldown — happens here.
+    """
+
+    def __init__(self, policy: BalancerPolicy | None = None):
+        self.policy = policy or BalancerPolicy()
+        self.detector = HotnessDetector(self.policy)
+        self.state = STATE_STABLE
+        self._cooldown = 0
+        self._fail_next = False  # test/bench hook: forced mid-migration
+        # failure on the next attempt (exercises the rollback path)
+        self.last_hot = -1
+        self.last_moves = 0
+        self.last_cut_before = 0.0
+        self.last_cut_after = 0.0
+        self.last_reason = ""
+        self.counters = {
+            "migrations": 0,
+            "rollbacks": 0,
+            "holds": 0,
+            "cooldown_dispatches": 0,
+            "refine_noops": 0,
+            "hosts_moved": 0,
+        }
+
+    # -- test/bench hook --
+
+    def inject_failure_next(self) -> None:
+        """Force the next migration attempt to fail mid-move (after the
+        hotness trigger, before commit) — the --balance-smoke rollback
+        arm and the rollback regression test drive this."""
+        self._fail_next = True
+
+    @property
+    def state_name(self) -> str:
+        return _STATE_NAMES[self.state]
+
+    # -- interlocks: the balancer yields to every other robustness plane --
+
+    def _held(self, sim) -> bool:
+        pc = getattr(sim, "pressure", None)
+        if pc is not None and (
+            pc.hold_gear
+            or pc.fill_shrink > 0
+            or pc._stall_steps > 0
+            or (pc.saturate_frac is not None and pc.saturate_frac < 1.0)
+        ):
+            return True  # pressure-ladder episode in progress
+        if not getattr(sim, "_pressure_reshape_ok", True):
+            return True  # mid-optimistic-attempt snapshot pins the layout
+        sup = getattr(sim, "supervisor", None)
+        if sup is not None and sup.degraded:
+            return True  # backend lost / CPU failover: no elective moves
+        return False
+
+    # -- the per-dispatch hook --
+
+    def observe(self, sim, occ, frontier=None) -> bool:
+        """One dispatch-boundary observation; True iff a migration
+        committed. Called by IslandSimulation.run at the handoff
+        boundary (state synced, spill manage done for the dispatch)."""
+        if self._cooldown > 0:
+            self._cooldown -= 1
+            self.counters["cooldown_dispatches"] += 1
+            if self._cooldown == 0:
+                self.state = STATE_STABLE
+            return False
+        if self._held(sim):
+            self.counters["holds"] += 1
+            self.detector.reset()
+            return False
+        hot = self.detector.observe(occ, frontier)
+        if hot is None:
+            return False
+        return self._migrate(sim, hot)
+
+    def _migrate(self, sim, hot: int) -> bool:
+        """Refine + verify-then-commit one migration at this boundary."""
+        import jax
+
+        self.last_hot = hot
+        load = sim.host_loads()
+        cur_slot = np.asarray(jax.device_get(sim.params.slot_of))
+        new_slot, moves, cut0, cut1 = refine_assignment(
+            load, cur_slot, sim.num_shards, hot,
+            sim._latency_np, sim._host_vertex_g, self.policy,
+        )
+        self.last_cut_before, self.last_cut_after = cut0, cut1
+        if moves == 0:
+            # refinement found nothing to shed (single over-heavy host,
+            # or every swap loses load): cool down rather than re-scoring
+            # the same posture every dispatch
+            self.counters["refine_noops"] += 1
+            self._enter_cooldown("refine_noop")
+            return False
+        pre_chain = sim.audit_chain()
+        pre_events = sim.counters()["events_committed"]
+        snap = sim._balance_snapshot()
+        self.state = STATE_MIGRATING
+        try:
+            if self._fail_next:
+                self._fail_next = False
+                raise RuntimeError(
+                    "injected mid-migration failure (balance test hook)"
+                )
+            sim.migrate_hosts(new_slot)
+            ok = (
+                sim.audit_chain() == pre_chain
+                and sim.counters()["events_committed"] == pre_events
+            )
+            reason = "" if ok else "digest chain diverged"
+        except Exception as e:  # noqa: BLE001 — rollback-or-die is the
+            # contract: a mid-migration backend loss or pressure signal
+            # must leave the PRE-move layout running (the next dispatch's
+            # supervisor handles a genuinely dead backend)
+            ok, reason = False, f"{type(e).__name__}: {e}"
+        if not ok:
+            sim._balance_rollback(snap)
+            self.counters["rollbacks"] += 1
+            self._enter_cooldown(reason)
+            return False
+        self.last_moves = moves
+        self.counters["migrations"] += 1
+        self.counters["hosts_moved"] += 2 * moves  # each move is a swap
+        obs = getattr(sim, "obs_session", None)
+        if obs is not None and obs.tracer:
+            obs.tracer.fault(
+                "balance_migration", hot_shard=hot, moves=moves,
+            )
+        self._enter_cooldown("")
+        return True
+
+    def _enter_cooldown(self, reason: str) -> None:
+        self.last_reason = reason
+        self._cooldown = max(1, self.policy.cooldown)
+        self.state = STATE_COOLDOWN
+
+    # -- telemetry (metrics schema v10 `balance.*`) + checkpoint carry --
+
+    def stats(self) -> dict[str, int]:
+        return dict(self.counters)
+
+    def gauges(self) -> dict:
+        return {
+            "state": int(self.state),
+            "hot_shard": int(self.last_hot),
+            "streak": int(self.detector.streak),
+            "cooldown_left": int(self._cooldown),
+            "last_moves": int(self.last_moves),
+            "last_cut_before": float(self.last_cut_before),
+            "last_cut_after": float(self.last_cut_after),
+        }
+
+    def meta(self) -> dict:
+        """Checkpoint `__meta__.balance` sub-block: controller posture,
+        restored by IslandSimulation on resume so a resumed run neither
+        forgets an active cooldown nor re-fires instantly."""
+        return {
+            "state": self.state_name,
+            "cooldown_left": int(self._cooldown),
+            "counters": dict(self.counters),
+        }
+
+    def restore_meta(self, m: dict) -> None:
+        self._cooldown = max(0, int(m.get("cooldown_left", 0)))
+        self.state = (
+            STATE_COOLDOWN if self._cooldown else STATE_STABLE
+        )
+        for k, v in sorted((m.get("counters") or {}).items()):
+            if k in self.counters:
+                self.counters[k] = int(v)
+        self.detector.reset()

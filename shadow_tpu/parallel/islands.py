@@ -434,12 +434,15 @@ class IslandSimulation(Simulation):
     def __init__(self, *, num_shards: int, exchange_slots: int = 0,
                  mode: str = "vmap", force_path: str | None = None,
                  rebalance: bool = False, pool_gears: int = 1,
-                 async_sync: bool = True, async_spread: int = 0, **kw):
+                 async_sync: bool = True, async_spread: int = 0,
+                 balancer: bool = False, balancer_policy=None, **kw):
         if mode not in ("vmap", "shard_map"):
             raise ValueError(f"unknown islands mode {mode!r}")
         self.num_shards = int(num_shards)
         self.mode = mode
-        self.rebalance_enabled = bool(rebalance)
+        # the balancer migrates through the slot_of routing seam, so
+        # enabling it implies the rebalance-capable kernel
+        self.rebalance_enabled = bool(rebalance) or bool(balancer)
         self.rebalances = 0
         # Asynchronous conservative sync (cs/0409032): the fused
         # conservative driver runs per-shard virtual-time frontiers with
@@ -688,6 +691,15 @@ class IslandSimulation(Simulation):
         self._gear_fns = {}
         self._bind_gear()
         self.windows_run = 0  # dispatched windows (suggest_exchange_slots)
+        # Self-balancing plane (parallel/balancer.py): the closed-loop
+        # hot-shard controller, consulted at every fused-dispatch
+        # boundary by run(). None = detection-only telemetry (the async
+        # posture still rides metrics; nothing acts on it).
+        self.balancer = None
+        if balancer:
+            from shadow_tpu.parallel import balancer as balancer_mod
+
+            self.balancer = balancer_mod.ShardBalancer(balancer_policy)
 
     def _build_gear_fns(self, spec: gearbox.GearSpec) -> dict:
         if getattr(self, "_step_builder", None) is None:
@@ -800,6 +812,13 @@ class IslandSimulation(Simulation):
             return None
         return dict(self._async_counters)
 
+    def reset_frontier_spread(self) -> None:
+        """Zero the max-observed frontier-spread gauge — phase-windowed
+        measurement (bench.py --balance-smoke gates on the spread AFTER
+        the balancer had its chance to heal, not the whole-run max that
+        the pre-migration transient dominates)."""
+        self._async_spread_max = 0
+
     def async_gauges(self) -> dict[str, int] | None:
         """Async-sync gauges: the spread bound, the maximum observed
         frontier spread, the last dispatch's frontier extent, and the
@@ -869,18 +888,79 @@ class IslandSimulation(Simulation):
         parts.append(f"set experimental.runahead <= {safe} ns")
         return "; ".join(parts)
 
-    def resume_from(self, ckpt_dir: str) -> dict:
-        info = super().resume_from(ckpt_dir)
-        if self._async and self.rebalance_enabled:
-            # the restored params carry the layout's slot_of table; the
-            # lookahead matrix must describe THAT assignment
-            slot = np.asarray(jax.device_get(self.params.slot_of))
-            self._lookahead = lookahead_mod.derive(
-                self._latency_np, self._host_vertex_g, self.num_shards,
-                assignment=slot,
+    # ---- self-balancing plane (parallel/balancer.py) ----
+
+    def attach_balancer(self, balancer) -> None:
+        """Arm (or replace) the closed-loop hot-shard controller; needs
+        the rebalance-capable kernel (slot_of routing)."""
+        if not self.rebalance_enabled:
+            raise RuntimeError(
+                "attach_balancer needs rebalance=True or balancer=True "
+                "at build time (the slot_of routing table compiles in)"
             )
-            self._refresh_async_args()
-        return info
+        self.balancer = balancer
+
+    def balance_stats(self) -> dict[str, int] | None:
+        """Balancer counters for the metrics registry (schema v10
+        `balance.*`); None when no controller is attached."""
+        if self.balancer is None:
+            return None
+        d = self.balancer.stats()
+        d["rebalances"] = int(self.rebalances)
+        return d
+
+    def balance_gauges(self) -> dict | None:
+        if self.balancer is None:
+            return None
+        return self.balancer.gauges()
+
+    def _balance_meta(self) -> dict | None:
+        """Checkpoint-header balance block (core/checkpoint.save): the
+        LIVE host→slot assignment plus the controller posture, so a
+        drain-to-checkpoint persists a migrated layout auditable without
+        replay. Restore rebuilds the routing table from the state's own
+        gid rows (_post_restore) — the assignment here is the operator-
+        facing record, the controller block is what resume re-arms."""
+        if not self.rebalance_enabled:
+            return None
+        slot = np.asarray(jax.device_get(self.params.slot_of))
+        m = {
+            "rebalances": int(self.rebalances),
+            "assignment": [int(x) for x in slot],
+        }
+        if self.balancer is not None:
+            m["controller"] = self.balancer.meta()
+        return m
+
+    def _post_restore(self, meta: dict) -> None:
+        """Re-sync layout-derived runtime state after a checkpoint
+        restore (core/checkpoint.restore calls this once the leaves are
+        in place): the slot_of routing table and the derived async
+        lookahead live OUTSIDE the checkpointed state pytree, but the
+        restored host rows carry their layout in state.host.gid — a
+        checkpoint taken after a live migration restores the permuted
+        rows, so the routing table must be rebuilt from them (without
+        this hook, resuming a migrated run silently misroutes every
+        cross-shard event against a stale identity table)."""
+        if self.rebalance_enabled:
+            gid = np.asarray(
+                jax.device_get(self.state.host.gid)
+            ).reshape(-1)
+            slot = np.empty(self.num_hosts, np.int32)
+            slot[gid] = np.arange(self.num_hosts, dtype=np.int32)
+            self.params = self.params.replace(slot_of=jnp.asarray(slot))
+            if self._async:
+                self._lookahead = lookahead_mod.derive(
+                    self._latency_np, self._host_vertex_g,
+                    self.num_shards, assignment=slot,
+                )
+                self._refresh_async_args()
+        if self._shard_shifter is not None:
+            self._shard_shifter.seed(self._gear)
+        if self.balancer is not None:
+            bm = (meta.get("balance") or {}).get("controller")
+            if bm:
+                self.balancer.restore_meta(bm)
 
     # ---- between-window re-sharding (the P3 work-stealing replacement,
     # scheduler_policy_host_steal.c:1-562 / logical_processor.rs:43-54) ----
@@ -896,6 +976,21 @@ class IslandSimulation(Simulation):
             )
         return occ
 
+    def host_loads(self) -> np.ndarray:
+        """[H] resident event rows per GLOBAL host id (pool + spill, by
+        destination) — the per-host load proxy both the LPT rebalance and
+        the balancer's min-cut refinement consume."""
+        H = self.num_hosts
+        sp = self._spill_store()
+        pt = np.array(jax.device_get(self.state.pool.time)).reshape(-1)
+        pd = np.array(jax.device_get(self.state.pool.dst)).reshape(-1)
+        live = pt != simtime.NEVER
+        load = np.bincount(pd[live], minlength=H).astype(np.int64)
+        for rows in sp._rows:
+            if rows[0].shape[0]:
+                load += np.bincount(rows[1], minlength=H)
+        return load
+
     def rebalance_now(self) -> None:
         """Permute host→shard assignment to even out resident load.
 
@@ -907,24 +1002,9 @@ class IslandSimulation(Simulation):
         observable effect on results (per-host order, RNG streams and seq
         numbering are functions of the GLOBAL host id only).
         """
-        if not self.rebalance_enabled:
-            raise RuntimeError(
-                "rebalance_now() needs rebalance=True at build time: the "
-                "window kernel must compile slot_of-table routing, or the "
-                "permuted layout would silently misroute events"
-            )
         S, Hl = self.num_shards, self.num_hosts // self.num_shards
         H = self.num_hosts
-        sp = self._spill_store()
-
-        # --- per-host resident load from pool + spill (by dst) ---
-        pt = np.array(jax.device_get(self.state.pool.time)).reshape(-1)
-        pd = np.array(jax.device_get(self.state.pool.dst)).reshape(-1)
-        live = pt != simtime.NEVER
-        load = np.bincount(pd[live], minlength=H).astype(np.int64)
-        for rows in sp._rows:
-            if rows[0].shape[0]:
-                load += np.bincount(rows[1], minlength=H)
+        load = self.host_loads()
 
         # --- LPT: heaviest host to the lightest non-full shard ---
         order = np.argsort(-load, kind="stable")
@@ -938,6 +1018,77 @@ class IslandSimulation(Simulation):
             new_slot[h] = s * Hl + shard_fill[s]
             shard_fill[s] += 1
             shard_load[s] += load[h]
+        self._apply_assignment(new_slot)
+
+    def migrate_hosts(self, new_slot) -> None:
+        """Apply an EXPLICIT host→slot assignment (the balancer's min-cut
+        refinement output, parallel/balancer.py): validated — a
+        permutation of range(H) with exactly H/S slots per shard — then
+        applied through the same recompile-free permutation seam as
+        rebalance_now."""
+        S, Hl = self.num_shards, self.num_hosts // self.num_shards
+        H = self.num_hosts
+        new_slot = np.asarray(new_slot, np.int32)
+        if new_slot.shape != (H,) or not np.array_equal(
+            np.sort(new_slot), np.arange(H, dtype=np.int32)
+        ):
+            raise ValueError(
+                f"migrate_hosts needs a permutation of range({H}) "
+                f"(host -> slot); got shape {new_slot.shape}"
+            )
+        del S, Hl  # permutation of range(H) implies H/S slots per shard
+        self._apply_assignment(new_slot)
+
+    def _balance_snapshot(self):
+        """Rollback point for a verify-then-commit migration: state and
+        params are immutable pytrees (references suffice); the spill
+        store and lookahead spec mutate, so they are copied."""
+        sp = self._spill_store()
+        return {
+            "state": self.state,
+            "params": self.params,
+            "spill_rows": [tuple(r) for r in sp._rows],
+            "spill_partial_min": list(sp._partial_min),
+            "spill_drained": sp.drained_total,
+            "lookahead": self._lookahead,
+            "rebalances": self.rebalances,
+        }
+
+    def _balance_rollback(self, snap) -> None:
+        """Restore the pre-migration layout (mid-migration failure or
+        digest divergence — parallel/balancer.py): the pre-move pytrees
+        re-bind wholesale, the spill store's rows roll back, and the
+        async traced inputs re-derive for the restored assignment."""
+        self.state = snap["state"]
+        self.params = snap["params"]
+        sp = self._spill_store()
+        sp._rows = [tuple(r) for r in snap["spill_rows"]]
+        sp._partial_min = list(snap["spill_partial_min"])
+        sp.drained_total = snap["spill_drained"]
+        self._lookahead = snap["lookahead"]
+        self.rebalances = snap["rebalances"]
+        if self._async:
+            self._refresh_async_args()
+        if self._shard_shifter is not None:
+            self._shard_shifter.seed(self._gear)
+
+    def _apply_assignment(self, new_slot: np.ndarray) -> None:
+        """The permutation seam shared by rebalance_now (LPT) and
+        migrate_hosts (balancer refinement): permute host-indexed state,
+        re-route pool + spill rows to their new owner shards, update the
+        slot_of routing table, and re-derive the traced async lookahead —
+        never a recompile."""
+        if not self.rebalance_enabled:
+            raise RuntimeError(
+                "rebalance_now()/migrate_hosts() need rebalance=True (or "
+                "balancer=True) at build time: the window kernel must "
+                "compile slot_of-table routing, or the permuted layout "
+                "would silently misroute events"
+            )
+        S, Hl = self.num_shards, self.num_hosts // self.num_shards
+        H = self.num_hosts
+        sp = self._spill_store()
+        new_slot = np.asarray(new_slot, np.int32)
 
         # --- permute every [S, Hl, ...] host-indexed leaf ---
         gid = np.array(jax.device_get(self.state.host.gid)).reshape(-1)
@@ -1042,6 +1193,11 @@ class IslandSimulation(Simulation):
                 assignment=new_slot,
             )
             self._refresh_async_args()
+        if self._shard_shifter is not None:
+            # per-shard occupancies just shuffled wholesale: the per-shard
+            # ladder states describe the OLD layout — re-align to the
+            # bound envelope (a bypass shift, like checkpoint restore)
+            self._shard_shifter.seed(self._gear)
 
     def _maybe_rebalance(self) -> None:
         """Skew trigger: rebalance when the heaviest shard holds 2x the
@@ -1135,6 +1291,16 @@ class IslandSimulation(Simulation):
                 shifted = self._gear_tick(occ, press=press)
             if self._fault_plane_active():
                 self._handoff_tick(mn)
+            if self.balancer is not None:
+                # closed-loop hot-shard healing (parallel/balancer.py):
+                # detection from the dispatch's own occupancy vector +
+                # frontier surface; a committed migration permutes the
+                # layout through the traced-lookahead seam (no recompile)
+                if self.balancer.observe(
+                    self, occ_v,
+                    ainfo[0] if ainfo is not None else None,
+                ):
+                    shifted = True
             if mn >= stop and spill.min_time >= stop and not press:
                 break
             fr_min = int(ainfo[0].min()) if ainfo is not None else None

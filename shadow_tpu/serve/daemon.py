@@ -116,6 +116,7 @@ class ShadowDaemon:
             "sheds": 0,
             "memory_sheds": 0,
             "pressure_records": 0,
+            "balance_records": 0,
             "journal_replays": 0,
             "kernel_traces": 0,
         }
@@ -126,6 +127,13 @@ class ShadowDaemon:
         self._running_est_bytes = 0
         self._last_pressure: dict = {}
         self._journaled_pressure: dict[str, int] = {}
+        # self-balancing plane (ISSUE 11): the running fleet's balance
+        # posture (lane steals, packing decisions) + async posture
+        # (frontier spread, laggard shard) for /healthz and shadowctl
+        # status; BALANCE journal records mirror the PRESSURE pattern
+        self._last_balance: dict = {}
+        self._last_async: dict = {}
+        self._journaled_balance: dict[str, int] = {}
         # replay: fold the journal into scheduler-plane truth
         st = self.journal.state()
         self.sweeps: dict[str, dict] = {
@@ -305,6 +313,8 @@ class ShadowDaemon:
                 "kcache": self.kcache.stats(),
                 "memory": self._memory_view(),
                 "pressure": dict(self._last_pressure),
+                "balance": dict(self._last_balance),
+                "async": dict(self._last_async),
                 "retry_after_s": self.retry_after_s(),
             }
 
@@ -352,6 +362,14 @@ class ShadowDaemon:
                 )
             for k, v in self._last_pressure.items():
                 reg.counter_set(f"pressure.{k}", int(v))
+            # balance plane (schema v10): the running fleet's packing +
+            # steal tallies ("packing" is a string — gauge-encoded)
+            for k, v in self._last_balance.items():
+                if k == "packing":
+                    reg.gauge_set("balance.packing_load",
+                                  int(v == "load"))
+                else:
+                    reg.counter_set(f"balance.{k}", int(v))
         return reg.to_doc(meta={"daemon": "shadow_tpu serve"})
 
     def _dump_metrics(self) -> None:
@@ -418,6 +436,11 @@ class ShadowDaemon:
                                 windows_per_dispatch=fopts.windows_per_dispatch,
                                 checkpoint_dir=ckpt_dir)
         fleet.attach_kernel_cache(self.kcache)
+        # the daemon is the loop's outer ring (parallel/balancer.py's
+        # inner loop heals shards; this packs whole jobs): freed lanes
+        # take the heaviest pending job by predicted load, and an early-
+        # finishing lane steals ahead of FIFO order (fleet/scheduler.py)
+        fleet.sched.packing = "load"
         if s.get("backend_faults"):
             from shadow_tpu.faults import plan as plan_mod
 
@@ -429,6 +452,7 @@ class ShadowDaemon:
     def _publish_progress(self, sid: str, fleet) -> None:
         st = fleet.sched.stats()
         pst = fleet.pressure_stats()
+        bst = fleet.balance_stats() or {}
         with self._lock:
             self.sweeps[sid]["progress"] = {
                 "jobs_done": st["jobs_done"],
@@ -436,8 +460,13 @@ class ShadowDaemon:
                 "jobs_queued": st["jobs_queued"],
                 "kernel_traces": fleet.kernel_traces,
                 "pressure_steps": int(pst.get("ladder_steps", 0)),
+                "lane_steals": int(st.get("lane_steals", 0)),
             }
             self._last_pressure = pst
+            self._last_balance = {
+                "packing": fleet.sched.packing, **bst,
+            }
+            self._last_async = fleet.async_posture()
             # journal each new batch of ladder rungs: a post-mortem can
             # see WHEN the sweep started degrading even if we die next
             steps = int(pst.get("ladder_steps", 0))
@@ -447,6 +476,15 @@ class ShadowDaemon:
                     journal_mod.PRESSURE, id=sid, steps=steps, counters=pst
                 )
                 self.counters["pressure_records"] += 1
+            # likewise each new balance action (migration, rollback or
+            # lane steal): the journal shows WHEN healing started
+            acts = sum(int(v) for v in bst.values())
+            if acts > self._journaled_balance.get(sid, 0):
+                self._journaled_balance[sid] = acts
+                self.journal.append(
+                    journal_mod.BALANCE, id=sid, actions=acts, counters=bst
+                )
+                self.counters["balance_records"] += 1
 
     def _run_sweep(self, sid: str) -> None:
         from shadow_tpu.core.checkpoint import CheckpointError

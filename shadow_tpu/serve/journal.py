@@ -18,6 +18,11 @@ scheduler-plane transition is journaled BEFORE it takes effect:
              (core/pressure.py): the cumulative pressure counters ride
              the record, so a post-mortem can see WHEN a sweep started
              degrading even if the daemon later died
+    BALANCE  the running fleet's self-balancing plane acted
+             (parallel/balancer.py + fleet/scheduler.py load packing):
+             cumulative migration / rollback / lane-steal counters ride
+             the record, so a post-mortem can see WHEN the daemon began
+             healing a hot shard — and whether a migration rolled back
     COMPLETE the sweep finished; per-job results (including each job's
              `audit.chain` digest) ride the record
 
@@ -51,9 +56,12 @@ ADMIT = "admit"
 DRAIN = "drain"
 REQUEUE = "requeue"
 PRESSURE = "pressure"
+BALANCE = "balance"
 COMPLETE = "complete"
 
-RECORD_TYPES = (SUBMIT, ADMIT, DRAIN, REQUEUE, PRESSURE, COMPLETE)
+RECORD_TYPES = (
+    SUBMIT, ADMIT, DRAIN, REQUEUE, PRESSURE, BALANCE, COMPLETE
+)
 
 
 class JournalError(ValueError):
@@ -195,6 +203,9 @@ class JournalState:
                 # informational: latest ladder posture; never a status
                 # transition (the sweep keeps running degraded)
                 s["pressure"] = rec.get("counters")
+            elif t == BALANCE:
+                # informational: latest self-balancing posture
+                s["balance"] = rec.get("counters")
             elif t == COMPLETE:
                 s["status"] = "done" if rec.get("ok") else "failed"
                 s["results"] = rec.get("results")

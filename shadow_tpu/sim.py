@@ -226,11 +226,23 @@ def build_simulation(source) -> Simulation:
         from shadow_tpu.parallel.islands import IslandSimulation
 
         sim_cls = IslandSimulation
+        balancer_policy = None
+        if cfg.experimental.balancer:
+            from shadow_tpu.parallel.balancer import BalancerPolicy
+
+            balancer_policy = BalancerPolicy(
+                hot_ratio=cfg.experimental.balance_hot_ratio,
+                streak=cfg.experimental.balance_streak,
+                cooldown=cfg.experimental.balance_cooldown,
+                max_moves=cfg.experimental.balance_max_moves,
+            )
         sim_kw = dict(
             num_shards=cfg.experimental.num_shards,
             exchange_slots=cfg.experimental.exchange_slots,
             mode=cfg.experimental.island_mode,
             rebalance=cfg.experimental.rebalance,
+            balancer=cfg.experimental.balancer,
+            balancer_policy=balancer_policy,
             async_sync=cfg.experimental.async_islands,
             async_spread=cfg.experimental.async_spread,
             # matrix-capable sims pin the matrix path: under vmap a

@@ -50,6 +50,8 @@ _BUDGET_TIER = {
     # the async-sync chain-equality matrix is the ISSUE 10 acceptance
     # gate: same rule — ahead of the compile-heavy tier-4 matrices
     "test_async_sync": 3,
+    # the self-balancing acceptance gate (ISSUE 11): same rule
+    "test_balancer": 3,
     # minutes: multi-engine parity matrices / many-shape compiles
     "test_gearbox": 4, "test_islands": 4, "test_rebalance": 4,
     "test_sharding": 4, "test_tcp": 4, "test_fleet": 4, "test_tgen": 5,

@@ -1,0 +1,449 @@
+"""Self-balancing fleet (ISSUE 11): closed-loop hot-shard healing with
+verified live host migration (parallel/balancer.py).
+
+The load-bearing properties:
+
+  * a migration permutes the LAYOUT only — the balanced run's audit
+    digest chain is bit-identical to the balancer-off run, and so is a
+    run whose first migration was forced to fail mid-move (rollback);
+  * the skew_hosts chaos input is itself layout-independent: the same
+    fault plan produces the same chain on the global and islands engines;
+  * a checkpoint taken AFTER a live migration resumes correctly: the
+    slot_of routing table is rebuilt from the restored host rows
+    (core/checkpoint.restore -> IslandSimulation._post_restore), and the
+    resumed run's chain matches the uninterrupted migrated run's.
+"""
+
+import numpy as np
+import pytest
+
+from shadow_tpu.core import simtime
+from shadow_tpu.parallel import balancer as balancer_mod
+from shadow_tpu.parallel.balancer import (
+    BalancerPolicy,
+    HotnessDetector,
+    refine_assignment,
+)
+from shadow_tpu.sim import build_simulation
+
+NEVER = int(simtime.NEVER)
+
+
+def _decohered_gml(shards, per, seed=7):
+    """Uniform decohered intra bands + large cross latencies (the
+    balance-smoke topology: hotness comes from skew_hosts, not the
+    graph)."""
+    rng = np.random.RandomState(seed)
+    n = shards * per
+
+    def band(a, b):
+        if a // per != b // per:
+            return 700000, 900000
+        return 30000, 250000
+
+    lines = ["graph ["]
+    for v in range(n):
+        lines.append(f"  node [ id {v} ]")
+    for a in range(n):
+        for b in range(a, n):
+            lo, hi = band(a, b)
+            lines.append(
+                f'  edge [ source {a} target {b} latency '
+                f'"{int(rng.randint(lo, hi))} us" ]'
+            )
+    lines.append("]")
+    return "\n".join(lines)
+
+
+def _cfg(shards=4, per=4, stop=8, skew_at="2 s", balancer=False,
+         rebalance=True, **exp):
+    n = shards * per
+    hosts = {}
+    for v in range(n):
+        hosts[f"h{v:02d}"] = {
+            "quantity": 1, "network_node_id": v, "app_model": "phold",
+            "app_options": {
+                "msgload": 2, "runtime": stop - 1,
+                # persistent destination bias toward shard 0's hosts —
+                # the skew amplification keeps re-concentrating there
+                "hot_frac": per / n, "hot_share": 0.5,
+            },
+        }
+    experimental = {
+        "event_capacity": 4096, "events_per_host_per_window": 8,
+        "outbox_slots": 8, "inbox_slots": 4,
+        "num_shards": shards, "exchange_slots": 32,
+        "rebalance": rebalance, "balancer": balancer,
+        "balance_streak": 3, "balance_cooldown": 8,
+        "balance_hot_ratio": 1.5,
+    }
+    experimental.update(exp)
+    doc = {
+        "general": {"stop_time": stop, "seed": 42},
+        "network": {"graph": {"type": "gml", "inline": _decohered_gml(
+            shards, per)}},
+        "experimental": experimental,
+        "hosts": hosts,
+    }
+    if skew_at is not None:
+        doc["faults"] = {"inject": [{
+            "at": skew_at, "op": "skew_hosts",
+            "span": [0, per], "factor": 6,
+        }]}
+    return doc
+
+
+def _run(cfg, hook=None, wpd=16):
+    sim = build_simulation(cfg)
+    if cfg.get("faults"):
+        sim.attach_faults(sim.config.faults.load_faults())
+    if hook is not None:
+        hook(sim)
+    sim.run(windows_per_dispatch=wpd)
+    return sim
+
+
+# ---------------------------------------------------------------------------
+# detector + refinement units
+# ---------------------------------------------------------------------------
+
+
+def test_detector_requires_streak_and_resets():
+    det = HotnessDetector(BalancerPolicy(
+        hot_ratio=1.5, min_skew_rows=10, streak=3))
+    hot = [100, 10, 10, 10]
+    assert det.observe(hot) is None  # streak 1
+    assert det.observe(hot) is None  # streak 2
+    # a different shard going hot resets the streak
+    assert det.observe([10, 100, 10, 10]) is None
+    assert det.observe([10, 100, 10, 10]) is None
+    assert det.observe([10, 100, 10, 10]) == 1
+    # a cool dispatch resets too
+    assert det.observe(hot) is None
+    assert det.observe([20, 20, 20, 20]) is None
+    assert det.observe(hot) is None
+    assert det.observe(hot) is None
+    assert det.observe(hot) == 0
+
+
+def test_detector_requires_frontier_laggard():
+    det = HotnessDetector(BalancerPolicy(
+        hot_ratio=1.5, min_skew_rows=10, streak=1))
+    occ = [100, 10, 10, 10]
+    # hot shard running AHEAD of the others is absorbing its load fine
+    assert det.observe(occ, frontier=[500, 100, 100, 100]) is None
+    # hot shard as the laggard (or tied at a clamped boundary) triggers
+    assert det.observe(occ, frontier=[100, 500, 500, 500]) == 0
+    assert det.observe(occ, frontier=[100, 100, 100, 100]) == 0
+
+
+def test_detector_noise_floor():
+    det = HotnessDetector(BalancerPolicy(
+        hot_ratio=1.5, min_skew_rows=50, streak=1))
+    assert det.observe([20, 2, 2, 2]) is None  # skew 18 < 50 rows
+    assert det.observe([80, 2, 2, 2]) == 0
+
+
+def test_refine_flattens_load_and_keeps_shard_sizes():
+    H, S = 16, 4
+    load = np.zeros(H, np.int64)
+    load[:4] = [60, 50, 40, 30]  # shard 0 holds everything
+    load[4:] = 2
+    lat = np.full((H, H), 500_000_000, np.int64)
+    np.fill_diagonal(lat, 1_000_000)
+    slot, moves, cut0, cut1 = refine_assignment(
+        load, np.arange(H), S, 0, lat, np.arange(H),
+        BalancerPolicy(max_moves=8),
+    )
+    assert moves >= 1
+    # still a permutation with exactly H/S slots per shard
+    assert sorted(slot) == list(range(H))
+    shard_of = np.asarray(slot) // (H // S)
+    assert (np.bincount(shard_of, minlength=S) == H // S).all()
+    sl = np.bincount(shard_of, weights=load, minlength=S)
+    skew_before = load[:4].sum() / (load.sum() / S)
+    skew_after = sl.max() / sl.mean()
+    assert sl[0] < load[:4].sum()  # shed something
+    assert skew_after < skew_before * 0.6  # genuinely flattened
+
+
+def test_refine_prefers_low_affinity_boundary_hosts():
+    """Two equally heavy hosts on the hot shard; one is glued to the
+    shard by a low-latency (high-affinity) link — the refinement must
+    move the OTHER one (lookahead-critical links stay intra-shard)."""
+    H, S = 8, 2
+    load = np.array([50, 50, 1, 1, 1, 1, 1, 1], np.int64)
+    lat = np.full((H, H), 100_000_000, np.int64)
+    np.fill_diagonal(lat, 1_000_000)
+    # host 0 <-> host 2: a 1 us lookahead-critical link inside shard 0
+    lat[0, 2] = lat[2, 0] = 1_000
+    slot, moves, cut0, cut1 = refine_assignment(
+        load, np.arange(H), S, 0, lat, np.arange(H),
+        BalancerPolicy(max_moves=1),
+    )
+    shard_of = np.asarray(slot) // (H // S)
+    assert moves == 1
+    assert shard_of[0] == 0, "moved the glued host (cut ignored)"
+    assert shard_of[1] == 1, "the free heavy host should have moved"
+
+
+def test_cut_cost_counts_cross_affinity_only():
+    lat = np.array([[1_000, 1_000, NEVER, NEVER],
+                    [1_000, 1_000, NEVER, NEVER],
+                    [NEVER, NEVER, 1_000, 1_000],
+                    [NEVER, NEVER, 1_000, 1_000]], np.int64)
+    hv = np.arange(4)
+    block = balancer_mod.cut_cost(np.array([0, 0, 1, 1]), lat, hv)
+    split = balancer_mod.cut_cost(np.array([0, 1, 0, 1]), lat, hv)
+    assert block == 0.0  # no finite cross links
+    assert split > 0.0
+
+
+# ---------------------------------------------------------------------------
+# e2e: heal, verify, roll back — chains bit-identical throughout
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def control():
+    sim = _run(_cfg(balancer=False))
+    return sim, sim.audit_chain(), sim.counters()["events_committed"]
+
+
+def test_balancer_heals_hot_shard_chain_identical(control):
+    _, chain, ev = control
+    sim = _run(_cfg(balancer=True))
+    stats = sim.balance_stats()
+    assert stats["migrations"] >= 1
+    assert stats["rollbacks"] == 0
+    assert sim.audit_chain() == chain
+    assert sim.counters()["events_committed"] == ev
+    # healing shows up as less time blocked on the laggard's horizon
+    # (end-state resident loads converge as the run drains, so the
+    # schedule counter is the honest signal; bench --balance-smoke
+    # gates the phase-windowed spread + load flattening)
+    blocked_c = control[0].async_stats()["blocked_on_neighbor"]
+    blocked_b = sim.async_stats()["blocked_on_neighbor"]
+    assert blocked_b < blocked_c, (blocked_b, blocked_c)
+
+
+def test_forced_midmigration_failure_rolls_back(control):
+    _, chain, ev = control
+    sim = _run(
+        _cfg(balancer=True),
+        hook=lambda s: s.balancer.inject_failure_next(),
+    )
+    stats = sim.balance_stats()
+    assert stats["rollbacks"] >= 1
+    assert "injected mid-migration failure" in sim.balancer.last_reason \
+        or sim.balancer.last_reason == ""  # a later migration committed
+    assert sim.audit_chain() == chain
+    assert sim.counters()["events_committed"] == ev
+
+
+def test_balancer_yields_to_pressure_and_supervisor():
+    from shadow_tpu.core.pressure import PressureController
+    from shadow_tpu.core.supervisor import BackendSupervisor
+    from shadow_tpu.parallel.balancer import ShardBalancer
+
+    sim = build_simulation(_cfg(balancer=True, skew_at=None, stop=2))
+    bal = sim.balancer
+    # a real resident skew, so the refinement has load to shed once the
+    # interlocks clear (detection itself runs on the passed vector)
+    sim.skew_hosts([0, 1, 2, 3], 6)
+    hot = np.array([500, 1, 1, 1])
+    # pressure episode: hold, and the detection streak resets
+    sim.pressure = PressureController()
+    sim.pressure.hold_gear = True
+    assert bal.observe(sim, hot) is False
+    assert bal.counters["holds"] == 1
+    sim.pressure.hold_gear = False
+    # degraded supervisor: hold
+    sup = BackendSupervisor()
+    sim.attach_supervisor(sup)
+    sup._dead = True
+    assert bal.observe(sim, hot) is False
+    assert bal.counters["holds"] == 2
+    sup._dead = False
+    # mid-optimistic-attempt: hold
+    sim._pressure_reshape_ok = False
+    assert bal.observe(sim, hot) is False
+    assert bal.counters["holds"] == 3
+    sim._pressure_reshape_ok = True
+    # healthy again: the streak restarts from zero (3 dispatches to go)
+    assert isinstance(bal, ShardBalancer)
+    assert bal.observe(sim, hot) is False
+    assert bal.observe(sim, hot) is False
+    assert bal.observe(sim, hot) is True
+    assert bal.counters["migrations"] == 1
+
+
+# ---------------------------------------------------------------------------
+# skew_hosts: layout-independence of the chaos input itself
+# ---------------------------------------------------------------------------
+
+
+def test_skew_hosts_layout_independent():
+    """The same skew_hosts plan produces the same chain on the global
+    single-pool engine and the islands engine: the injection keys on
+    global host ids and pending-event content only."""
+    g = _run(_cfg(stop=5), wpd=16)
+    # strip islands fields for the global build
+    doc = _cfg(stop=5)
+    doc["experimental"].pop("num_shards")
+    doc["experimental"].pop("exchange_slots")
+    doc["experimental"].pop("rebalance")
+    solo = _run(doc, wpd=16)
+    assert solo.fault_stats()["events_skewed"] > 0
+    assert solo.fault_stats()["events_skewed"] \
+        == g.fault_stats()["events_skewed"]
+    assert solo.audit_chain() == g.audit_chain()
+    assert solo.counters()["events_committed"] \
+        == g.counters()["events_committed"]
+
+
+# ---------------------------------------------------------------------------
+# checkpoint/resume of a migrated layout (the satellite regression:
+# before _post_restore, a resumed migrated run misrouted every
+# cross-shard event against a stale identity slot_of table)
+# ---------------------------------------------------------------------------
+
+
+def test_async_rebalance_survives_kill_and_resume(tmp_path):
+    """Migrate mid-run under the ASYNC driver, auto-checkpoint past the
+    migration, SIGKILL (abandon the process state), --resume in a fresh
+    build, and require the final chain bit-identical to an uninterrupted
+    migrated run."""
+    cfg = _cfg(stop=6, balancer=False)  # explicit migration timing
+
+    full = build_simulation(cfg)
+    full.attach_faults(full.config.faults.load_faults())
+    full.run(until=3 * simtime.NS_PER_SEC, windows_per_dispatch=16)
+    full.rebalance_now()
+    assert full.rebalances == 1
+    full.run(windows_per_dispatch=16)
+    chain_full = full.audit_chain()
+
+    interrupted = build_simulation(cfg)
+    interrupted.attach_faults(interrupted.config.faults.load_faults())
+    interrupted.configure_auto_checkpoint(
+        str(tmp_path), every_ns=simtime.NS_PER_SEC
+    )
+    interrupted.run(until=3 * simtime.NS_PER_SEC,
+                    windows_per_dispatch=16)
+    interrupted.rebalance_now()
+    interrupted.run(until=5 * simtime.NS_PER_SEC,
+                    windows_per_dispatch=16)
+    assert interrupted.fault_counters["checkpoints_written"] >= 1
+    del interrupted  # the SIGKILL: nothing survives but the ring
+
+    res = build_simulation(cfg)
+    res.attach_faults(res.config.faults.load_faults())
+    info = res.resume_from(str(tmp_path))
+    # the restored layout IS migrated: slot_of was rebuilt from the
+    # checkpointed gid rows, not left at the build-time identity
+    slot = np.asarray(res.params.slot_of)
+    assert not np.array_equal(slot, np.arange(res.num_hosts))
+    gid = np.asarray(res.state.host.gid).reshape(-1)
+    assert (gid[slot] == np.arange(res.num_hosts)).all()
+    # the header carries the assignment + rebalance count
+    assert info["meta"]["balance"]["rebalances"] == 1
+    assert info["meta"]["balance"]["assignment"] == [
+        int(x) for x in slot
+    ]
+    res.run(windows_per_dispatch=16)
+    assert res.audit_chain() == chain_full
+
+
+def test_checkpoint_meta_restores_balancer_cooldown(tmp_path):
+    from shadow_tpu.core import checkpoint as ckpt_mod
+
+    sim = build_simulation(_cfg(balancer=True, skew_at=None, stop=2))
+    sim.balancer._enter_cooldown("test")
+    sim.balancer.counters["migrations"] = 3
+    now = int(np.max(np.asarray(sim.state.now)))
+    path, _ = ckpt_mod.save_ring(sim, str(tmp_path), seq=0, sim_ns=now)
+    meta = ckpt_mod.load_meta(path)
+    ctl = meta["balance"]["controller"]
+    assert ctl["state"] == "cooldown"
+    assert ctl["counters"]["migrations"] == 3
+
+    res = build_simulation(_cfg(balancer=True, skew_at=None, stop=2))
+    res.load_checkpoint(path)
+    assert res.balancer.state == balancer_mod.STATE_COOLDOWN
+    assert res.balancer.counters["migrations"] == 3
+
+
+# ---------------------------------------------------------------------------
+# metrics schema v10
+# ---------------------------------------------------------------------------
+
+
+def test_balance_metrics_schema_v10(tmp_path, control):
+    import json
+
+    from shadow_tpu.obs import metrics as obs_metrics
+
+    sim = _run(_cfg(balancer=True))
+    session = obs_metrics.ObsSession()
+    session.finalize(sim)
+    doc = session.metrics.dump(str(tmp_path / "m.json"))
+    obs_metrics.validate_metrics_doc(doc, strict_namespaces=True)
+    assert doc["schema_version"] == 10
+    assert doc["counters"]["balance.migrations"] >= 1
+    assert doc["counters"]["balance.rebalances"] >= 1
+    assert "balance.state" in doc["gauges"]
+    assert "balance.last_cut_after" in doc["gauges"]
+    bad = json.loads(json.dumps(doc))
+    bad["counters"]["balance.migrations"] = -1
+    with pytest.raises(ValueError, match="balance counter"):
+        obs_metrics.validate_metrics_doc(bad)
+    # a balancer-off run emits NO balance keys
+    session2 = obs_metrics.ObsSession()
+    session2.finalize(control[0])
+    doc2 = session2.metrics.dump(str(tmp_path / "m2.json"))
+    assert not any(k.startswith("balance.") for k in doc2["counters"])
+    assert not any(k.startswith("balance.") for k in doc2["gauges"])
+
+
+# ---------------------------------------------------------------------------
+# fleet outer ring: predicted-load packing + lane stealing
+# ---------------------------------------------------------------------------
+
+
+def test_scheduler_load_packing_steals_heaviest():
+    from shadow_tpu.fleet.scheduler import FleetScheduler
+    from shadow_tpu.fleet.sweep import JobSpec
+
+    jobs = [JobSpec(f"j{i}", {"general": {}}) for i in range(4)]
+    sched = FleetScheduler(jobs, lanes=2)
+    # stub the config-derived costs: j2 is by far the heaviest
+    sched._cost_cache = {"j0": 1.0, "j1": 2.0, "j2": 50.0, "j3": 3.0}
+    # FIFO default: head of queue
+    assert sched.pick(0).name == "j0"
+    sched.packing = "load"
+    picked = sched.pick(0)
+    assert picked.name == "j2"
+    assert sched.lane_steals == 1
+    assert sched.pack_decisions == 1
+    sched.admit(0, picked)
+    # next heaviest among the remaining queue
+    assert sched.pick(1).name == "j3"
+    assert sched.lane_steals == 2
+    st = sched.stats()
+    assert st["lane_steals"] == 2 and st["pack_decisions"] == 2
+
+
+def test_scheduler_calibration_ewma():
+    from shadow_tpu.fleet.scheduler import FleetScheduler, JobRecord
+    from shadow_tpu.fleet.sweep import JobSpec
+
+    sched = FleetScheduler([JobSpec("a", {})], lanes=1)
+    sched._cost_cache = {"a": 10.0}
+    rec = JobRecord(spec=JobSpec("a", {}))
+    rec.events_committed = 1000
+    sched.calibrate(rec)
+    assert sched.rate_ewma == pytest.approx(100.0)
+    # the calibrated rate scales the prediction
+    assert sched.predicted_load(sched.records[0]) \
+        == pytest.approx(10.0 * 100.0)

@@ -77,6 +77,9 @@ def test_plan_validation():
             {"at": "1 s", "op": "kill_proc", "proc": "a.0"},
             {"at": "500 ms", "op": "refuse_ipc", "proc": "a.0", "count": 2},
             {"at": 2, "op": "kill_host", "host": 3},
+            {"at": "2 s", "op": "skew_hosts", "span": [0, 4],
+             "factor": 6},
+            {"at": "2 s", "op": "skew_hosts", "hosts": ["relay.0", 7]},
             {"at": "1 s", "op": "force_spill"},
             {"at": "3 s", "op": "corrupt_file", "path": "*.npz",
              "mode": "flip"},
@@ -87,9 +90,12 @@ def test_plan_validation():
     # ordered by (at, declaration index)
     assert [f.op for f in faults] == [
         "refuse_ipc", "kill_proc", "force_spill", "kill_host",
-        "corrupt_file",
+        "skew_hosts", "skew_hosts", "corrupt_file",
     ]
     assert faults[1].at_ns == 1 * NS
+    assert faults[4].span == [0, 4] and faults[4].factor == 6
+    assert faults[5].hosts == ["relay.0", 7]
+    assert faults[5].factor == 2  # the default multiplier
 
     for bad in (
         {**good, "kind": "nope"},
@@ -103,6 +109,17 @@ def test_plan_validation():
                              "mode": "eat"}]},
         {**good, "faults": [{"at": -1, "op": "force_spill"}]},
         {**good, "extra_top": {}},
+        # skew_hosts: exactly one of hosts|span, sane span, factor >= 2
+        {**good, "faults": [{"at": 1, "op": "skew_hosts"}]},
+        {**good, "faults": [{"at": 1, "op": "skew_hosts",
+                             "hosts": [1], "span": [0, 2]}]},
+        {**good, "faults": [{"at": 1, "op": "skew_hosts", "hosts": []}]},
+        {**good, "faults": [{"at": 1, "op": "skew_hosts",
+                             "span": [0, 0]}]},
+        {**good, "faults": [{"at": 1, "op": "skew_hosts",
+                             "span": [-1, 2]}]},
+        {**good, "faults": [{"at": 1, "op": "skew_hosts",
+                             "span": [0, 2], "factor": 1}]},
     ):
         with pytest.raises(plan_mod.FaultPlanError):
             plan_mod.validate_fault_plan_doc(bad)

@@ -12,11 +12,19 @@ latency (or re-partitioning hosts so the chatty pair lands in one shard,
 the ROADMAP's min-cut placement item) buys the most asynchrony.
 
   python tools/lookahead_report.py config.yaml [--shards S] [--json]
+      [--assignment FILE]
 
 --shards overrides experimental.num_shards (the partition to analyze;
-the config's host count must divide by it). --json emits one machine-
-readable object instead of the table. Exit 0 on success, 2 with a
-one-line diagnosis on a bad config — never a traceback.
+the config's host count must divide by it). --assignment FILE analyzes
+a PROPOSED host→shard assignment instead of the contiguous block
+partition: FILE is a JSON array of per-host shard indices (exactly H/S
+hosts per shard). The report then also prints the assignment's CUT COST
+(total cross-shard communication affinity, parallel/balancer.cut_cost)
+next to the block partition's, so a balancer migration — or a hand-
+tuned partition — is reviewable offline before a run commits to it.
+--json emits one machine-readable object instead of the table. Exit 0
+on success, 2 with a one-line diagnosis on a bad input — never a
+traceback.
 """
 
 from __future__ import annotations
@@ -54,6 +62,15 @@ def main(argv: list[str] | None = None) -> int:
             print("--shards needs an integer", file=sys.stderr)
             return 2
         del args[i:i + 2]
+    assignment_path = None
+    if "--assignment" in args:
+        i = args.index("--assignment")
+        try:
+            assignment_path = args[i + 1]
+        except IndexError:
+            print("--assignment needs a JSON file path", file=sys.stderr)
+            return 2
+        del args[i:i + 2]
     if len(args) != 1 or args[0] in ("-h", "--help"):
         print(__doc__.strip(), file=sys.stderr)
         return 0 if args and args[0] in ("-h", "--help") else 2
@@ -62,6 +79,7 @@ def main(argv: list[str] | None = None) -> int:
 
     from shadow_tpu.core import simtime
     from shadow_tpu.core.config import ConfigError, load_config
+    from shadow_tpu.parallel import balancer as balancer_mod
     from shadow_tpu.parallel import lookahead as lookahead_mod
     from shadow_tpu.routing.topology import Topology
 
@@ -91,11 +109,48 @@ def main(argv: list[str] | None = None) -> int:
                 network_node_id=h.network_node_id,
             )
         baked = topo.bake()
+        H = len(cfg.hosts)
+        slots = None
+        shard_of = lookahead_mod.shard_of_hosts(H, S)
+        if assignment_path is not None:
+            with open(assignment_path) as f:
+                proposed = json.load(f)
+            if (not isinstance(proposed, list) or len(proposed) != H
+                    or not all(isinstance(x, int) for x in proposed)):
+                raise ValueError(
+                    f"--assignment must be a JSON array of {H} per-host "
+                    f"shard indices"
+                )
+            counts = np.bincount(
+                np.asarray(proposed, np.int64), minlength=S
+            )
+            if counts.shape[0] > S or (counts != H // S).any():
+                raise ValueError(
+                    f"--assignment must place exactly {H // S} hosts on "
+                    f"each of {S} shards (got counts {counts.tolist()})"
+                )
+            # synthesize the host->slot table the engine would run under
+            # (slots fill per shard in host-id order)
+            slots = np.empty(H, np.int64)
+            fill = np.zeros(S, np.int64)
+            for h, s in enumerate(proposed):
+                slots[h] = s * (H // S) + fill[s]
+                fill[s] += 1
+            shard_of = np.asarray(proposed, np.int64)
         spec = lookahead_mod.derive(
-            baked.latency_vv, baked.host_vertex, S
+            baked.latency_vv, baked.host_vertex, S, assignment=slots
         )
-    except (ValueError, KeyError) as e:
-        print(f"{path}: {e}", file=sys.stderr)
+        cut = balancer_mod.cut_cost(
+            shard_of, baked.latency_vv, baked.host_vertex
+        )
+        cut_block = balancer_mod.cut_cost(
+            lookahead_mod.shard_of_hosts(H, S),
+            baked.latency_vv, baked.host_vertex,
+        )
+    except (ValueError, KeyError, OSError,
+            json.JSONDecodeError) as e:
+        src = assignment_path if assignment_path else path
+        print(f"{src}: {e}", file=sys.stderr)
         return 2
 
     never = int(simtime.NEVER)
@@ -120,6 +175,12 @@ def main(argv: list[str] | None = None) -> int:
             "global_runahead_ns": int(baked.min_latency_ns),
             "auto_spread_ns": lookahead_mod.auto_spread(
                 spec, baked.min_latency_ns
+            ),
+            "cut_cost": round(cut, 3),
+            "cut_cost_block": round(cut_block, 3),
+            "assignment": (
+                None if assignment_path is None
+                else [int(x) for x in shard_of]
             ),
         }
         print(json.dumps(doc, indent=1))
@@ -155,6 +216,15 @@ def main(argv: list[str] | None = None) -> int:
           f"{_fmt_ns(int(baked.min_latency_ns), never)}")
     print(f"auto roughness spread bound: "
           f"{_fmt_ns(lookahead_mod.auto_spread(spec, baked.min_latency_ns), never)}")
+    if assignment_path is not None:
+        delta = cut - cut_block
+        print(f"cut cost of proposed assignment: {cut:.3f} "
+              f"(block partition: {cut_block:.3f}, "
+              f"{'+' if delta >= 0 else ''}{delta:.3f}) — cross-shard "
+              f"communication affinity; lower keeps lookahead-critical "
+              f"links intra-shard")
+    else:
+        print(f"cut cost (block partition): {cut_block:.3f}")
     return 0
 
 

@@ -96,8 +96,18 @@ def main(argv: list[str] | None = None) -> int:
                 print(json.dumps(client.sweep(args.id), indent=1))
             else:
                 # lead with the daemon's live posture: memory headroom +
-                # pressure-ladder gauges from /healthz (docs/serving.md)
+                # pressure-ladder gauges from /healthz (docs/serving.md),
+                # plus the async/balance posture (ISSUE 11) — frontier
+                # spread, WHICH shard is the laggard, and the balance
+                # plane's state — so an operator sees a hot shard here
+                # instead of grepping metrics JSON
                 h = client.health()
+                asy = h.get("async") or {}
+                bal = dict(h.get("balance") or {})
+                if bal and "state" not in bal:
+                    # the outer-ring (packing/steal) posture has no
+                    # migration state machine; say so explicitly
+                    bal["state"] = "stable"
                 print(json.dumps({
                     "health": {
                         "ok": h.get("ok"),
@@ -108,6 +118,13 @@ def main(argv: list[str] | None = None) -> int:
                             for k, v in (h.get("pressure") or {}).items()
                             if v
                         },
+                        "async": {
+                            "frontier_spread_ns":
+                                asy.get("frontier_spread_ns"),
+                            "laggard_shard": asy.get("laggard_shard"),
+                            "laggard_lane": asy.get("laggard_lane"),
+                        } if asy else {},
+                        "balance": bal,
                     }
                 }))
                 for row in client.sweeps():
