@@ -1433,6 +1433,257 @@ def stage_balance_smoke(shards: int = 4, per: int = 4, stop_s: int = 10,
     }
 
 
+def _mesh_smoke_gml(hosts: int, comm: int, offset: int, span: int,
+                    seed: int = 7) -> str:
+    """The mesh-smoke topology: one vertex per host on a ring,
+    DIRECT-EDGE routing only (use_shortest_path false, so the in-edge
+    matrix is genuinely sparse — shortest-path baking would make every
+    shard pair adjacent and reduce ppermute to a ring all_gather).
+    Hosts within ring distance <= span are connected; COMMUNITIES of
+    `comm` contiguous hosts, offset by `offset` from the chip-block
+    boundaries, get fast decohered intra links (the chatty pairs) while
+    community-crossing links are ~15x slower. The block partition
+    therefore splits every community across two chips — its min cross-
+    chip lookahead is the FAST band, so neighbor blocking is chronic —
+    while the min-cut placement re-aligns chips onto communities and
+    only the slow boundary links cross."""
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    lines = ["graph ["]
+    for v in range(hosts):
+        lines.append(f"  node [ id {v} ]")
+    for a in range(hosts):
+        lines.append(
+            f'  edge [ source {a} target {a} latency '
+            f'"{int(rng.randint(2000, 3000))} us" ]'
+        )
+        for d in range(1, span + 1):
+            b = (a + d) % hosts
+            same = ((a - offset) % hosts) // comm == (
+                (b - offset) % hosts) // comm
+            lo, hi = (3000, 6000) if same else (45000, 60000)
+            lines.append(
+                f'  edge [ source {a} target {b} latency '
+                f'"{int(rng.randint(lo, hi))} us" ]'
+            )
+    lines.append("]")
+    return "\n".join(lines)
+
+
+def stage_mesh_smoke(shards: int = 8, per: int = 4, stop_s: int = 8,
+                     span: int = 3):
+    """True multi-chip gate (ISSUE 12 acceptance): the fused async
+    islands driver runs as `shard_map` over an 8-chip virtual CPU mesh
+    with per-chip state placement and NEIGHBOR-ONLY ppermute frontier
+    exchange, against two references:
+
+      vmap      the single-program islands run (one chip, virtual
+                shards) — the bit-identity reference;
+      gather    shard_map with the all_gather frontier exchange and the
+                block placement — the collective-volume/blocking
+                comparison arm.
+
+    Gates: all three audit digest chains BIT-IDENTICAL (mesh execution
+    changes where state lives, never the simulation); ZERO all-gather
+    ops in the optimized HLO of the mesh kernel's frontier exchange
+    (hlo_audit.all_gather_lines; the control arm shows >0); cross-chip
+    collective volume scales with in-edge degree — the ppermute arm's
+    analytic frontier-exchange bytes AND blocked-on-neighbor supersteps
+    both land strictly below the gather arm's (min-cut placement keeps
+    the fast community links intra-chip, so horizons are bounded by the
+    slow boundary links only); and the mesh arm is RETRACE-FREE across
+    a mid-run gear shift and a live host migration (retrace_report ok,
+    zero exchange-schedule rebuilds). Writes the schema-v11 mesh.*
+    metrics artifact, strict-namespace-validated. CPU-deterministic by
+    design (all arms share one backend), so no backend wait."""
+    import numpy as np
+
+    import jax
+
+    from shadow_tpu.analysis import hlo_audit
+    from shadow_tpu.core import simtime
+    from shadow_tpu.obs import metrics as obs_metrics
+    from shadow_tpu.sim import build_simulation
+
+    n = shards * per
+    comm = per  # community size = chip size, offset so blocks split them
+    offset = per // 2
+    gml = _mesh_smoke_gml(n, comm, offset, span)
+
+    def cfg(mode: str, exchange: str, placement: str,
+            pool_gears: int = 1) -> dict:
+        hosts = {}
+        for v in range(n):
+            hosts[f"h{v:02d}"] = {
+                "quantity": 1, "network_node_id": v, "app_model": "phold",
+                "app_options": {
+                    "msgload": 1, "runtime": stop_s - 1, "local_span": span,
+                },
+            }
+        return {
+            "general": {"stop_time": stop_s, "seed": 42},
+            "network": {
+                "graph": {"type": "gml", "inline": gml},
+                "use_shortest_path": False,
+            },
+            "experimental": {
+                "event_capacity": 4096, "events_per_host_per_window": 8,
+                "outbox_slots": 8, "inbox_slots": 4,
+                "num_shards": shards, "exchange_slots": 16,
+                "island_mode": mode, "mesh_exchange": exchange,
+                "placement": placement, "pool_gears": pool_gears,
+                "rebalance": True,
+            },
+            "hosts": hosts,
+        }
+
+    def boundary_swap(sim) -> None:
+        """One live migration that PRESERVES shard-level connectivity:
+        swap a boundary host pair between chips 0 and 1 chosen so every
+        in-edge of the swapped layout still rides the compiled ppermute
+        schedule (exchange_rebuilds must stay 0) — exactly the kind of
+        move the balancer's cut-aware refinement prefers. Deterministic:
+        first covered (a, b) pair in slot order."""
+        from shadow_tpu.parallel import lookahead as lookahead_mod
+
+        slot0 = np.asarray(jax.device_get(sim.params.slot_of))
+        Hl = n // shards
+        host_at = np.empty(n, np.int64)
+        host_at[slot0] = np.arange(n)
+        for sa in range(Hl):
+            for sb in range(Hl, 2 * Hl):
+                a, b = int(host_at[sa]), int(host_at[sb])
+                slot = slot0.copy()
+                slot[a], slot[b] = slot[b], slot[a]
+                spec = lookahead_mod.derive(
+                    sim._latency_np, sim._host_vertex_g, shards,
+                    assignment=slot,
+                )
+                if lookahead_mod.shifts_covered(
+                    spec, sim._async_shifts
+                ):
+                    sim.migrate_hosts(slot)
+                    return
+        raise RuntimeError(
+            "mesh smoke: no connectivity-preserving boundary swap exists"
+        )
+
+    t0 = time.perf_counter()
+    ref = build_simulation(cfg("vmap", "ppermute", "block"))
+    ref.run(windows_per_dispatch=512)
+    chain_ref = ref.audit_chain()
+    ev_ref = ref.counters()["events_committed"]
+
+    gather = build_simulation(cfg("shard_map", "all_gather", "block"))
+    gather.run(windows_per_dispatch=512)
+
+    mesh = build_simulation(
+        cfg("shard_map", "ppermute", "min_cut", pool_gears=2)
+    )
+    # first leg, then a forced gear round-trip + a live migration at the
+    # dispatch boundary — the retrace-freedom chaos the gate requires
+    mesh.run(until=2 * simtime.NS_PER_SEC, windows_per_dispatch=512)
+    top = mesh._gear_ladder[-1].level
+    if top > 0:  # forced round-trip: both tiers' kernels run this smoke
+        other = top - 1 if mesh._gear == top else top
+        here = mesh._gear
+        mesh._shift_gear(other)
+        mesh._shift_gear(here)
+    boundary_swap(mesh)
+    mesh.run(windows_per_dispatch=512)
+
+    chain_equal = (
+        mesh.audit_chain() == chain_ref
+        and gather.audit_chain() == chain_ref
+    )
+    ev_mesh = mesh.counters()["events_committed"]
+    ev_gather = gather.counters()["events_committed"]
+
+    # HLO gate: the mesh kernel's frontier exchange compiles to
+    # collective-permutes only; the gather arm is the positive control
+    def async_hlo(sim):
+        fn = sim._gear_fns[sim._gear]["run_to_async"]
+        return fn.lower(
+            sim.state, sim.params, sim._async_runahead,
+            sim._async_look_in, sim._async_spread,
+            hlo_audit.DEFAULT_WIN_END, 8,
+        ).compile().as_text()
+
+    mesh_ag = len(hlo_audit.all_gather_lines(async_hlo(mesh)))
+    control_ag = len(hlo_audit.all_gather_lines(async_hlo(gather)))
+    retrace = hlo_audit.retrace_report(mesh)
+
+    mstats = mesh.mesh_stats() or {}
+    gstats = gather.mesh_stats() or {}
+    bytes_mesh = mstats.get("frontier_exchange_bytes", 0)
+    bytes_gather = gstats.get("frontier_exchange_bytes", 0)
+    blocked_mesh = (mesh.async_stats() or {}).get("blocked_on_neighbor", 0)
+    blocked_gather = (gather.async_stats() or {}).get(
+        "blocked_on_neighbor", 0)
+
+    metrics_path = os.path.join(_REPO, "mesh_smoke.metrics.json")
+    session = obs_metrics.ObsSession()
+    session.finalize(mesh)
+    doc = session.metrics.dump(metrics_path, meta={
+        "stage": "mesh_smoke", "hosts": n, "chips": shards,
+    })
+    obs_metrics.validate_metrics_doc(doc, strict_namespaces=True)
+    mesh_recorded = (
+        doc["counters"].get("mesh.frontier_exchange_bytes", 0) > 0
+        and doc["gauges"].get("mesh.shard_map") == 1
+        and "mesh.events_per_chip_max" in doc["gauges"]
+    )
+
+    gate_chain = bool(
+        chain_equal and ev_mesh == ev_ref and ev_gather == ev_ref
+    )
+    gate_no_all_gather = mesh_ag == 0 and control_ag > 0
+    gate_volume = bytes_mesh < bytes_gather
+    gate_blocked = blocked_mesh < blocked_gather
+    gate_retrace = bool(
+        retrace["ok"] and mstats.get("exchange_rebuilds", 0) == 0
+    )
+    return {
+        "stage": "mesh_smoke",
+        "platform": jax.default_backend(),
+        "devices": len(jax.devices()),
+        "hosts": n,
+        "chips": shards,
+        "events": int(ev_mesh),
+        "chain": int(mesh.audit_chain()),
+        "chain_equal": chain_equal,
+        "exchange_partners": int(mesh.exchange_partners),
+        "in_degree_max": int(
+            doc["gauges"].get("mesh.in_degree_max", -1)),
+        "all_gathers_mesh": int(mesh_ag),
+        "all_gathers_control": int(control_ag),
+        "frontier_bytes_mesh": int(bytes_mesh),
+        "frontier_bytes_gather": int(bytes_gather),
+        "volume_ratio": round(bytes_gather / max(bytes_mesh, 1), 2),
+        "blocked_mesh": int(blocked_mesh),
+        "blocked_gather": int(blocked_gather),
+        "migrations": int(mesh.rebalances),
+        "gear_shifts": int(mesh._gear_shifts),
+        "exchange_rebuilds": int(mstats.get("exchange_rebuilds", -1)),
+        "cut_cost": doc["gauges"].get("mesh.cut_cost"),
+        "cut_cost_block": doc["gauges"].get("mesh.cut_cost_block"),
+        "kernel_compiles": int(retrace["compiles_total"]),
+        "retraced": {k: int(v) for k, v in retrace["retraced"].items()},
+        "wall_s": round(time.perf_counter() - t0, 3),
+        "metrics_out": os.path.relpath(metrics_path, _REPO),
+        "gate_chain": gate_chain,
+        "gate_no_all_gather": bool(gate_no_all_gather),
+        "gate_volume": bool(gate_volume),
+        "gate_blocked": bool(gate_blocked),
+        "gate_retrace": gate_retrace,
+        "gate": bool(
+            gate_chain and gate_no_all_gather and gate_volume
+            and gate_blocked and gate_retrace and mesh_recorded
+        ),
+    }
+
+
 _SERVE_SMOKE_SWEEP = {
     "sweep": {
         "name": "serve-smoke",
@@ -1621,6 +1872,20 @@ def main():
         # the comparison is CPU-deterministic — no backend wait.
         os.environ.setdefault("SHADOW_TPU_BENCH_ALLOW_CPU", "1")
         print(json.dumps(stage_async_smoke()), flush=True)
+        return
+    if "--mesh-smoke" in sys.argv:
+        # true multi-chip gate: shard_map mesh execution with
+        # neighbor-only ppermute frontier exchange + min-cut placement —
+        # chains bit-identical to the single-program islands run, zero
+        # all-gathers in the mesh kernel, collective volume scaling with
+        # in-edge degree, retrace-free across a gear shift and a live
+        # migration. Runs on 8 VIRTUAL CPU devices (the force must land
+        # before the jax backend initializes), so no backend wait.
+        os.environ.setdefault("SHADOW_TPU_BENCH_ALLOW_CPU", "1")
+        from shadow_tpu.parallel.virtualize import force_cpu_devices
+
+        force_cpu_devices(8, cache_dir=os.path.join(_REPO, ".jax_cache"))
+        print(json.dumps(stage_mesh_smoke()), flush=True)
         return
     if "--balance-smoke" in sys.argv:
         # self-balancing gate: a skew_hosts-driven hot shard is detected

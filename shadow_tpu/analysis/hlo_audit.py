@@ -102,6 +102,21 @@ def serializing_gather_lines(hlo: str) -> list[str]:
     ]
 
 
+def all_gather_lines(hlo: str) -> list[str]:
+    """Every all-gather in the program (incl. async -start forms): the
+    mesh async kernel's frontier exchange must compile to neighbor-only
+    collective-permutes, so its optimized HLO carries ZERO of these —
+    the gated property that makes cross-chip collective volume scale
+    with topology degree instead of mesh size. (The gather arm of the
+    bench comparison, and any GSPMD resharding regression, shows up
+    here.)"""
+    return [
+        ln.strip()[:120]
+        for ln in hlo.splitlines()
+        if re.search(r"= .*\ball-gather(-start)?\(", ln)
+    ]
+
+
 def sort_rows(hlo: str) -> list[int]:
     """Row count (last dim) of every sort in the program."""
     rows = []
@@ -117,6 +132,7 @@ def audit_hlo(
     hlo: str,
     max_sort_rows: int | None = None,
     max_serializing_gathers: int = 0,
+    max_all_gathers: int | None = None,
 ) -> list[str]:
     """The op-contract violations in one optimized-HLO program (empty
     list = clean).
@@ -127,7 +143,11 @@ def audit_hlo(
     1-D host table — invisible to the rank>=2 heuristic — but the lane/
     shard vmap of the fleet and islands layouts batches the same lookup
     into a rank>=2 gather.  The allowance pins the count, so any NEW
-    per-element fetch still fails the audit."""
+    per-element fetch still fails the audit.
+
+    `max_all_gathers` (None = unchecked) pins the all-gather count: 0
+    for the mesh async kernel whose frontier exchange is neighbor-only
+    ppermute (parallel/islands.make_shard_run_to_async shifts arm)."""
     violations: list[str] = []
     for ln in scatter_lines(hlo):
         violations.append(f"scatter survived to the compiled kernel: {ln}")
@@ -138,6 +158,15 @@ def audit_hlo(
                 f"serializing gather ({len(sg)} found, "
                 f"{max_serializing_gathers} allowed): {ln}"
             )
+    if max_all_gathers is not None:
+        ag = all_gather_lines(hlo)
+        if len(ag) > max_all_gathers:
+            for ln in ag:
+                violations.append(
+                    f"all-gather ({len(ag)} found, {max_all_gathers} "
+                    f"allowed — the mesh frontier exchange must ride "
+                    f"neighbor-only ppermute): {ln}"
+                )
     if max_sort_rows is not None:
         for rows in sort_rows(hlo):
             if rows > max_sort_rows:
@@ -168,6 +197,9 @@ class KernelVariant:
     # allowance for the documented by-dst done_t lookups (audit_hlo)
     max_serializing_gathers: int
     lower: Callable[[], str] = field(repr=False)
+    # all-gather pin (audit_hlo): 0 for the mesh/ppermute async kernel,
+    # None = unchecked (vmap lowers collectives to reshapes anyway)
+    max_all_gathers: int | None = None
 
     def hlo(self) -> str:
         return self.lower()
@@ -179,6 +211,7 @@ class KernelVariant:
                 self.hlo(),
                 max_sort_rows=self.max_sort_rows,
                 max_serializing_gathers=self.max_serializing_gathers,
+                max_all_gathers=self.max_all_gathers,
             )
         ]
 
@@ -258,10 +291,19 @@ def variants_for_sim(sim, layout: str, *, sync_modes=SYNC_MODES,
                     .as_text()
                 )
 
+            # ppermute exchange: the compiled frontier exchange must
+            # carry ZERO all-gathers — the mesh gate (meaningful under
+            # shard_map lowering, where collectives survive to HLO;
+            # trivially clean under vmap, where they lower to reshapes)
+            allow_ag = (
+                0 if getattr(sim, "_exchange", None) == "ppermute"
+                else None
+            )
             out.append(KernelVariant(
                 sync="async", layout=layout, gear=level,
                 label=f"{layout}/async/gear{level}",
                 max_sort_rows=bound, max_serializing_gathers=0,
+                max_all_gathers=allow_ag,
                 lower=lower_async,
             ))
     return out

@@ -303,6 +303,85 @@ def restore(sim, path: str) -> None:
         hook(meta)
 
 
+def restore_relayout(sim, path: str) -> None:
+    """Restore a checkpoint whose STATE LAYOUT differs from this build's:
+    a mesh run checkpointed at one shard count resumed on a different
+    mesh size, an islands checkpoint resumed on the global engine, or
+    the reverse. Falls through to the strict `restore` when every leaf
+    already matches (same layout, same gear).
+
+    The config must otherwise agree (host count, app planes, counter
+    block) — only the PARTITION travels. Integrity is verified before
+    any state is touched; the re-layout itself is the sim's
+    `_import_foreign_layout(foreign_state, meta)` hook (the islands
+    engine globalizes by gid — migrated layouts land in canonical
+    order — and re-routes into its own partition; the resumed run's
+    audit chain extends the checkpointed one exactly, which
+    tests/test_mesh.py pins across 8→4→global resume chains)."""
+    meta = verify(path)
+    if meta["num_hosts"] != sim.num_hosts:
+        raise CheckpointError(
+            f"checkpoint has {meta['num_hosts']} hosts, sim has "
+            f"{sim.num_hosts} (only the partition may differ on a "
+            f"relayout resume)"
+        )
+    pairs, treedef = _leaf_paths(sim.state)
+    want = {k for k, _ in pairs}
+    have = set(meta.get("leaves", []))
+    if want != have:
+        missing = sorted(want - have)
+        extra = sorted(have - want)
+        raise CheckpointError(
+            f"state structure mismatch beyond layout: missing "
+            f"{missing[:5]}, unexpected {extra[:5]} (relayout resume "
+            f"needs the same config apart from the partition)"
+        )
+    with _open_checkpoint(path) as z:
+        arrays = {}
+        for key in meta["leaves"]:
+            try:
+                arrays[key] = z[key]
+            except (zipfile.BadZipFile, zlib.error, EOFError, OSError,
+                    ValueError, KeyError) as e:
+                raise CheckpointError(
+                    f"{path}: leaf {key} unreadable: {e}"
+                ) from e
+    if all(
+        arrays[k].shape == leaf.shape
+        and arrays[k].dtype == np.asarray(leaf).dtype
+        for k, leaf in pairs
+    ):
+        restore(sim, path)  # same layout: the strict path (gear rebind)
+        return
+    hook = getattr(sim, "_import_foreign_layout", None)
+    if hook is None:
+        raise CheckpointError(
+            f"{path}: layout differs and this engine has no "
+            f"_import_foreign_layout hook; rebuild with the "
+            f"checkpointed partition"
+        )
+    foreign = jax.tree_util.tree_unflatten(
+        treedef, [arrays[k] for k, _ in pairs]
+    )
+    shapes = {k: np.asarray(leaf).shape for k, leaf in pairs}
+    try:
+        hook(foreign, meta)
+    except ValueError as e:
+        raise CheckpointError(f"{path}: relayout failed: {e}") from e
+    got, _ = _leaf_paths(sim.state)
+    bad = [
+        k for k, leaf in got
+        if np.asarray(leaf).shape != shapes.get(k)
+    ]
+    if bad:
+        raise CheckpointError(
+            f"{path}: relayout produced wrong shapes for {bad[:5]}"
+        )
+    post = getattr(sim, "_post_restore", None)
+    if post is not None:
+        post(meta)
+
+
 # ---------------------------------------------------------------------------
 # auto-checkpoint retention ring (--checkpoint-every / --resume)
 # ---------------------------------------------------------------------------

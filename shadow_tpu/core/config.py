@@ -198,6 +198,18 @@ class ExperimentalOptions:
     # cond-mat/0302050); 0 auto-derives from the lookahead matrix.
     async_islands: bool = True
     async_spread: int = 0
+    # Multi-chip frontier exchange (parallel/islands.py): "ppermute"
+    # replaces the async driver's all_gather with neighbor-only
+    # collective-permute rounds covering the in-edge lookahead matrix
+    # (per-chip volume scales with topology degree, not mesh size);
+    # "all_gather" keeps the gather — the bench comparison arm. Chains
+    # are bit-identical either way.
+    mesh_exchange: str = "ppermute"  # "ppermute" | "all_gather"
+    # Initial host->chip placement: "block" = contiguous global-id
+    # blocks; "min_cut" = greedy affinity clustering at partition time
+    # (parallel/balancer.min_cut_placement) so lookahead-critical
+    # low-latency links land intra-chip (implies `rebalance`).
+    placement: str = "block"  # "block" | "min_cut"
     # Between-window host->shard re-sharding on load skew (the P3
     # work-stealing replacement, scheduler_policy_host_steal.c analog).
     rebalance: bool = False
@@ -340,6 +352,16 @@ class ExperimentalOptions:
             if v not in ("vmap", "shard_map"):
                 raise ConfigError(f"unknown island_mode {v!r}")
             out.island_mode = v
+        if "mesh_exchange" in d:
+            v = str(d["mesh_exchange"]).lower()
+            if v not in ("ppermute", "all_gather"):
+                raise ConfigError(f"unknown mesh_exchange {v!r}")
+            out.mesh_exchange = v
+        if "placement" in d:
+            v = str(d["placement"]).lower()
+            if v not in ("block", "min_cut"):
+                raise ConfigError(f"unknown placement {v!r}")
+            out.placement = v
         if "use_perf_timers" in d:
             out.use_perf_timers = bool(d["use_perf_timers"])
         if "use_shim_log_stamps" in d:

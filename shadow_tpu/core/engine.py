@@ -1842,6 +1842,20 @@ class Simulation:
             },
         }
 
+    def _import_foreign_layout(self, foreign, meta) -> None:
+        """checkpoint.restore_relayout hook: adopt a checkpoint taken in
+        the islands [S, ...] layout into this GLOBAL build — the
+        partition collapses (host rows land by gid, pool rows compact,
+        per-shard counters sum). Per-host order, RNG streams and the
+        audit digest key on global host ids, so the resumed run extends
+        the checkpointed chain exactly. Routes into the CURRENT gear's
+        pool; overflow raises with the capacity hint."""
+        from shadow_tpu.parallel import islands as islands_mod
+
+        self.state = islands_mod.globalize_state(
+            foreign, int(self.state.pool.time.shape[-1])
+        )
+
     def _make_run_to(self, step, hi: int):
         lane = make_run_to(step, hi)
         runahead = jnp.int64(self.runahead)

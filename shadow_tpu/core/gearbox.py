@@ -214,9 +214,28 @@ class ShardGearShifter:
 
     def seed(self, level: int) -> None:
         """Align every shard's ladder state to the bound envelope (build
-        time / checkpoint restore)."""
+        time / layout permutation / fallback checkpoint restore)."""
         self.levels = [int(level)] * self.S
         self.reset()
+
+    def restore(self, levels, envelope: int) -> bool:
+        """Re-arm the PER-SHARD ladder states a checkpoint header
+        recorded (`__meta__.async.gear_levels`): a resumed mesh run keeps
+        each chip's own level instead of hoisting every cool shard to the
+        envelope and forgetting its downshift progress (the flat-seed
+        behavior). Returns False — caller should seed() — when the
+        recorded vector is absent, the wrong width, or inconsistent with
+        the restored envelope (its max must equal the bound tier, or the
+        compiled pool shape would disagree with the decision state)."""
+        if not levels or len(levels) != self.S:
+            return False
+        lv = [int(x) for x in levels]
+        top = self.ladder[-1].level
+        if any(x < 0 or x > top for x in lv) or max(lv) != int(envelope):
+            return False
+        self.levels = lv
+        self.reset()
+        return True
 
     def observe(self, level: int, occs, press=None,
                 margin: int = 1) -> int | None:

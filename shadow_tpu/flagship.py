@@ -27,6 +27,8 @@ def build_phold_flagship(
     num_shards: int = 1,
     island_mode: str = "vmap",
     exchange_slots: int = 0,
+    mesh_exchange: str = "ppermute",
+    placement: str = "block",
     obs_counters: bool = True,
     pool_gears: int = 1,
     audit_digest: bool = True,
@@ -68,6 +70,8 @@ def build_phold_flagship(
             "num_shards": num_shards,
             "island_mode": island_mode,
             "exchange_slots": exchange_slots,
+            "mesh_exchange": mesh_exchange,
+            "placement": placement,
         }
     return build_simulation(
         {

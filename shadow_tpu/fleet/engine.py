@@ -212,6 +212,20 @@ class FleetSimulation:
         # outside, per-shard frontiers inside.
         self._async = bool(self._islands and getattr(t, "_async", False))
         if self._async:
+            # neighbor-only frontier exchange (parallel/lookahead.py):
+            # the compiled ppermute schedule must cover every lane's
+            # in-edges, so the fleet compiles the UNION of the initial
+            # jobs' shift sets (per-edge lookahead VALUES stay per-lane
+            # traced rows); _check_compat refuses a later swap-in whose
+            # topology needs an uncovered shift — structural drift would
+            # otherwise force the recompile the factory seam exists to
+            # avoid. None = the template runs the all_gather arm.
+            self._async_shifts = None
+            if getattr(t, "_exchange", "all_gather") == "ppermute":
+                self._async_shifts = tuple(sorted({
+                    int(d) for s in sims
+                    for d in getattr(s, "_async_shifts", ())
+                }))
             self._async_runahead = np.stack([
                 np.asarray(jax.device_get(s._async_runahead)) for s in sims
             ])
@@ -259,6 +273,25 @@ class FleetSimulation:
                 "fleet jobs mix sync modes (async_islands vs barrier); "
                 "the sweep must hold experimental.async_islands fixed"
             )
+        if self._islands and getattr(self, "_async", False):
+            if getattr(sim, "_exchange", None) != getattr(
+                t, "_exchange", None
+            ):
+                raise FleetError(
+                    "fleet jobs mix frontier-exchange modes (ppermute vs "
+                    "all_gather); the sweep must hold "
+                    "experimental.mesh_exchange fixed"
+                )
+            need = set(getattr(sim, "_async_shifts", ()) or ())
+            have = self._async_shifts
+            if have is not None and not need <= set(have):
+                raise FleetError(
+                    f"job topology needs ppermute shifts "
+                    f"{sorted(need - set(have))} the fleet kernel did not "
+                    f"compile (compiled {list(have)}); the sweep must "
+                    f"hold shard-level connectivity fixed, or run with "
+                    f"experimental.mesh_exchange: all_gather"
+                )
         lt = [(s.capacity, s.K) for s in t._gear_ladder]
         ls = [(s.capacity, s.K) for s in sim._gear_ladder]
         if lt != ls:
@@ -431,7 +464,10 @@ class FleetSimulation:
             # async conservative loop: vmap-of-jobs outside, shards
             # inside; per-lane [S] runahead / [S, S] lookahead / spread
             # stack one more leading axis
-            lane = islands_mod.make_shard_run_to_async(step, spec.hi)
+            lane = islands_mod.make_shard_run_to_async(
+                step, spec.hi, shifts=self._async_shifts,
+                num_shards=self.template.num_shards,
+            )
             inner = jax.vmap(
                 lane, in_axes=(0, None, 0, 0, None, None, None),
                 axis_name=islands_mod.AXIS,

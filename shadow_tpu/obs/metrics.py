@@ -25,6 +25,10 @@ import numpy as np
 
 from shadow_tpu.obs import counters as obs_counters
 
+# v11: mesh.* multi-chip namespace (parallel/{mesh,islands}.py: per-chip
+# committed-event balance, neighbor-only frontier-exchange collective
+# volume + partner counts, placement cut-cost gauges, and exchange-
+# schedule rebuild counters for the shard_map mesh execution plane);
 # v10: balance.* self-balancing-fleet namespace (parallel/balancer.py:
 # verified live migrations / rollbacks / interlock holds plus controller
 # posture gauges, and the fleet scheduler's load-packing + lane-steal
@@ -44,7 +48,7 @@ from shadow_tpu.obs import counters as obs_counters
 # obs/audit.py) + optional per-job `audit` sub-object on fleet.jobs[*]
 # rows; v4: optional top-level `fleet` section (fleet.jobs[*] per-job
 # rows) + fleet.* counters; v3: faults.* recovery counters
-SCHEMA_VERSION = 10
+SCHEMA_VERSION = 11
 DOC_KIND = "shadow_tpu.metrics"
 
 # metrics-doc `fleet.jobs[*]` rows must carry at least these keys
@@ -77,6 +81,7 @@ KNOWN_METRIC_NAMESPACES = frozenset({
     "pressure",    # resource-pressure degradation ladder (schema v8)
     "async",       # asynchronous conservative sync (schema v9)
     "balance",     # self-balancing fleet plane (schema v10)
+    "mesh",        # multi-chip mesh execution plane (schema v11)
     "sim",         # build-level gauges (num_hosts, runahead)
     "bench",       # bench.py gate-local rows
 })
@@ -223,6 +228,11 @@ def validate_metrics_doc(doc: dict, strict_namespaces: bool = False) -> None:
             raise ValueError(
                 f"balance counter {k!r} must be >= 0, got {v}"
             )
+        if k.startswith("mesh.") and v < 0:
+            # schema v11: multi-chip counters are monotonic tallies
+            raise ValueError(
+                f"mesh counter {k!r} must be >= 0, got {v}"
+            )
     for k, v in doc["gauges"].items():
         if not isinstance(v, (int, float)) or isinstance(v, bool):
             raise ValueError(f"gauge {k!r} must be a number, got {v!r}")
@@ -356,6 +366,26 @@ def snapshot_device(sim, reg: MetricsRegistry) -> None:
     _snapshot_pressure(sim, reg)
     _snapshot_async(sim, reg)
     _snapshot_balance(sim, reg)
+    _snapshot_mesh(sim, reg)
+
+
+def _snapshot_mesh(sim, reg: MetricsRegistry) -> None:
+    """Multi-chip mesh plane (schema v11): per-chip committed-event
+    balance, neighbor-only frontier-exchange volume/partners, placement
+    cut cost, and exchange-schedule rebuilds, from the islands runner
+    (parallel/islands.py mesh_stats/mesh_gauges; None = single shard)."""
+    ms = getattr(sim, "mesh_stats", None)
+    if ms is not None:
+        stats = ms()
+        if stats:
+            for k, v in stats.items():
+                reg.counter_set(f"mesh.{k}", int(v))
+    mg = getattr(sim, "mesh_gauges", None)
+    if mg is not None:
+        gauges = mg()
+        if gauges:
+            for k, v in gauges.items():
+                reg.gauge_set(f"mesh.{k}", v)
 
 
 def _snapshot_balance(sim, reg: MetricsRegistry) -> None:
@@ -444,6 +474,7 @@ def snapshot_fleet(fleet, reg: MetricsRegistry) -> None:
     _snapshot_pressure(fleet, reg)
     _snapshot_async(fleet, reg)
     _snapshot_balance(fleet, reg)
+    _snapshot_mesh(fleet, reg)
     reg.section_set("fleet", {
         "lanes": int(stats.get("lanes", 0)),
         "lane_swaps": int(stats.get("lane_swaps", 0)),

@@ -164,12 +164,103 @@ def cut_cost(shard_of: np.ndarray, latency_vv: np.ndarray,
              host_vertex: np.ndarray) -> float:
     """Total affinity crossing shard boundaries under `shard_of` ([H]
     shard index per global host id) — the objective the refinement holds
-    down and `tools/lookahead_report.py --assignment` prints for offline
-    review of a proposed assignment."""
+    down, `tools/lookahead_report.py --assignment/--mesh` prints for
+    offline review, and the mesh telemetry gauges per run.
+
+    Computed at the VERTEX level — hosts collapse onto used vertices, so
+    the cross sum is n'An − Σ_s c_s'A c_s over per-shard vertex counts
+    (identical to the O(H²) host-pair sum, since same-host pairs are
+    always intra-shard and cancel) — O(S·U²) instead of O(H²), cheap
+    enough to gauge every metrics snapshot at dryrun host counts."""
     shard = np.asarray(shard_of, np.int64)
-    aff = host_affinity(latency_vv, host_vertex)
-    cross = shard[:, None] != shard[None, :]
-    return float(aff[cross].sum() / 2.0)  # symmetrized: halve
+    hv = np.asarray(host_vertex, np.int64)
+    aff = _affinity_vv(latency_vv)
+    aff = aff + aff.T  # symmetrized, exactly as host_affinity
+    S = int(shard.max()) + 1 if shard.size else 1
+    cnt = np.zeros((S, aff.shape[0]), np.float64)
+    np.add.at(cnt, (shard, hv), 1.0)
+    n = cnt.sum(axis=0)
+    total = float(n @ aff @ n)
+    intra = float(sum(c @ aff @ c for c in cnt))
+    return (total - intra) / 2.0  # symmetrized: halve
+
+
+def min_cut_placement(latency_vv: np.ndarray, host_vertex: np.ndarray,
+                      num_shards: int) -> np.ndarray:
+    """Build-time min-cut host→chip placement (the PARSIR-style
+    per-processor partition, PAPERS.md; Shadow's host-to-worker
+    assignment): greedy affinity clustering at the VERTEX level — grow
+    each shard by repeatedly pulling in the unassigned vertex with the
+    highest total affinity to the shard's current vertex set, seeding
+    each shard with the strongest remaining community — so low-latency
+    (lookahead-critical) links land intra-chip and the derived
+    cross-shard lookahead (parallel/lookahead.py min_cross) stays as
+    large as a balanced partition allows. Slot counts are FIXED at H/S
+    per shard (the compiled layout); an over-full vertex splits across
+    shards at the boundary.
+
+    Returns the [H] host→slot permutation `IslandSimulation.migrate_hosts`
+    consumes (hosts of one vertex fill slots in global-id order —
+    deterministic for a given topology)."""
+    hv = np.asarray(host_vertex, np.int64)
+    H = hv.shape[0]
+    S = int(num_shards)
+    if S <= 0 or H % S:
+        raise ValueError(f"num_hosts {H} must divide by num_shards {S}")
+    Hl = H // S
+    aff = _affinity_vv(latency_vv)
+    aff = aff + aff.T
+    U = aff.shape[0]
+    # hosts per vertex, in global-id order (deterministic slot filling)
+    hosts_of = [np.flatnonzero(hv == u) for u in range(U)]
+    rem = np.array([len(h) for h in hosts_of], np.int64)
+    taken = [0] * U  # hosts of vertex u already placed
+    slot = np.empty(H, np.int32)
+    prev_in_shard = np.zeros(U, np.float64)
+    for s in range(S):
+        space = Hl
+        in_shard = np.zeros(U, np.float64)  # vertex counts on this shard
+        while space > 0:
+            open_ = rem > 0
+            if in_shard.sum() == 0.0:
+                # seed: prefer the unassigned vertex most affine to the
+                # PREVIOUS chip — consecutive chips then hold adjacent
+                # communities, so the shard-level graph inherits the
+                # topology's shape (a community ring stays a ring and
+                # the ppermute schedule stays 2 shifts wide) instead of
+                # scattering ring edges across arbitrary chip pairs
+                score = aff @ prev_in_shard * open_
+                if float(score.max(initial=0.0)) <= 0.0:
+                    # no tie to the previous chip (first shard, or a
+                    # disconnected component): strongest remaining
+                    # community seeds the next chain
+                    score = aff @ (rem.astype(np.float64)) * open_
+            else:
+                score = aff @ in_shard * open_
+            # ties (e.g. a fully uniform topology) break on vertex id,
+            # so the placement degenerates to the block partition
+            u = int(np.argmax(score + 1e-12 * open_))
+            if not open_[u]:
+                u = int(np.flatnonzero(open_)[0])
+            take = int(min(rem[u], space))
+            hosts = hosts_of[u][taken[u]:taken[u] + take]
+            base = s * Hl + (Hl - space)
+            slot[hosts] = base + np.arange(take, dtype=np.int32)
+            taken[u] += take
+            rem[u] -= take
+            in_shard[u] += take
+            space -= take
+        prev_in_shard = in_shard
+    # never worse than the block partition: greedy growth can lose to
+    # contiguity on topologies whose id order already encodes locality
+    # (a plain ring), so keep whichever cut is lower — the identity
+    # permutation also means "placement off" costs nothing there
+    Hl_slots = np.arange(H, dtype=np.int32)
+    if cut_cost(slot // Hl, latency_vv, hv) >= cut_cost(
+        Hl_slots // Hl, latency_vv, hv
+    ):
+        return Hl_slots
+    return slot
 
 
 def refine_assignment(

@@ -118,13 +118,27 @@ def make_shard_run_to(step, hi: int, axis: str = AXIS):
     return run_to
 
 
-def make_shard_run_to_async(step, hi: int, axis: str = AXIS):
+def make_shard_run_to_async(step, hi: int, axis: str = AXIS,
+                            shifts: tuple[int, ...] | None = None,
+                            num_shards: int | None = None):
     """Build run_to(state, params, runahead, look_in, spread, stop,
     max_windows) -> (state, min_next, pressed, occupancy, windows,
     frontier, spread_max, steps, yields, blocked) — the ASYNCHRONOUS
     conservative window loop (cs/0409032) for ONE shard of the islands
     engine; wrap with vmap(axis_name=axis) over the shard axis (or
     shard_map) to get the full kernel.
+
+    With `shifts` (a static tuple of ring shifts covering every finite
+    in-edge — parallel/lookahead.ppermute_shifts — plus `num_shards`),
+    the frontier/minimum exchange is NEIGHBOR-ONLY: one
+    ``jax.lax.ppermute`` per shift instead of an ``all_gather`` over
+    the shard axis, so per-chip collective volume under shard_map is
+    len(shifts) scalars per superstep (topology degree), not S (mesh
+    size), and the optimized HLO of the mesh kernel carries ZERO
+    all-gather ops (hlo_audit-gated). shifts=None keeps the all_gather
+    exchange — the bench comparison arm. Both arms compute the
+    identical horizon, so committed events and audit chains are
+    bit-identical.
 
     Where make_shard_run_to's barrier loop advances every shard to one
     fleet-wide frontier per window (ws = pmin of all local minima), each
@@ -169,6 +183,17 @@ def make_shard_run_to_async(step, hi: int, axis: str = AXIS):
 
     NEV = jnp.int64(simtime.NEVER)
 
+    if shifts is not None:
+        if num_shards is None:
+            raise ValueError(
+                "make_shard_run_to_async(shifts=...) needs num_shards "
+                "(the ppermute schedule is a static compiled property)"
+            )
+        S = int(num_shards)
+        _perms = [
+            [(j, (j + int(d)) % S) for j in range(S)] for d in shifts
+        ]
+
     def _occ(state):
         return jnp.sum(state.pool.time != simtime.NEVER)
 
@@ -182,15 +207,44 @@ def make_shard_run_to_async(step, hi: int, axis: str = AXIS):
         stop = jnp.asarray(stop, jnp.int64)
         max_windows = jnp.asarray(max_windows, jnp.int32)
 
+        # min over in-neighbors j of vec[j] + L[j->i], guarded against
+        # i64 overflow (NEVER is the i64 max): an unreachable edge, or a
+        # neighbor already at stop (it will never emit below stop + L),
+        # is unconstraining. Two exchanges, one horizon: the all_gather
+        # arm ships every shard's value; the ppermute arm ships only the
+        # covered in-edges (one collective-permute per static shift, the
+        # neighbor's lookahead read from the traced look_in row at
+        # (i - shift) mod S) — identical value, degree-scaled volume.
+        if shifts is None:
+            def _bound(vec):
+                allv = jax.lax.all_gather(vec, axis)  # [S]
+                nocon = (look_in >= NEV) | (allv >= stop)
+                return jnp.min(jnp.where(nocon, NEV, allv + look_in))
+        else:
+            def _bound(vec):
+                i = jax.lax.axis_index(axis)
+                iota = jnp.arange(S, dtype=jnp.int32)
+                acc = NEV
+                for d, perm in zip(shifts, _perms):
+                    recv = jax.lax.ppermute(vec, axis, perm)
+                    # the delivering neighbor's in-edge lookahead, read
+                    # from the traced row by masked reduce (no gather —
+                    # the rank the shard vmap adds would otherwise turn
+                    # an index into a per-element fetch the HLO audit
+                    # bans): non-selected entries are NEVER, so the min
+                    # IS the selected entry
+                    j = jnp.mod(i - int(d), S).astype(jnp.int32)
+                    w = jnp.min(jnp.where(iota == j, look_in, NEV))
+                    nocon = (w >= NEV) | (recv >= stop)
+                    acc = jnp.minimum(
+                        acc, jnp.where(nocon, NEV, recv + w)
+                    )
+                return acc
+
         def _horizon(frontier, state):
-            allF = jax.lax.all_gather(frontier, axis)  # [S]
-            # F_j + L[j->i], guarded against i64 overflow (NEVER is the
-            # i64 max): an unreachable edge, or a neighbor already at
-            # stop (it will never emit below stop + L), is unconstraining
-            nocon = (look_in >= NEV) | (allF >= stop)
-            bound = jnp.min(jnp.where(nocon, NEV, allF + look_in))
+            bound = _bound(frontier)
             defer = jax.lax.pmin(state.exch_deferred_min, axis)
-            return jnp.minimum(jnp.minimum(bound, defer), stop), allF
+            return jnp.minimum(jnp.minimum(bound, defer), stop)
 
         def cond(c):
             state, frontier, mn, w, _ = c
@@ -200,9 +254,10 @@ def make_shard_run_to_async(step, hi: int, axis: str = AXIS):
         def body(c):
             state, frontier, mn, w, stats = c
             spread_max, steps, yields, blocked = stats
-            hz, allF = _horizon(frontier, state)
-            minF = jnp.min(allF)
-            spread_max = jnp.maximum(spread_max, jnp.max(allF) - minF)
+            hz = _horizon(frontier, state)
+            minF = jax.lax.pmin(frontier, axis)
+            maxF = jax.lax.pmax(frontier, axis)
+            spread_max = jnp.maximum(spread_max, maxF - minF)
             mn_all = jax.lax.pmin(mn, axis)
             has_work = (mn < hz) & (mn < stop)
             # roughness suppression (cond-mat/0302050): a shard whose
@@ -264,14 +319,12 @@ def make_shard_run_to_async(step, hi: int, axis: str = AXIS):
         # _horizon. Omitting the deferred clamp would charge an
         # in-transit row its link latency a second time and initialize
         # the destination frontier past the row's landing time — a
-        # silent causality violation once the row lands.
-        allmn = jax.lax.all_gather(mn0, axis)
-        nocon0 = (look_in >= NEV) | (allmn >= NEV)
+        # silent causality violation once the row lands. (_bound treats
+        # a neighbor minimum at/above stop as unconstraining; that term
+        # could only have exceeded stop anyway, and f0 mins with stop.)
         f0 = jnp.minimum(
             jnp.minimum(
-                jnp.minimum(
-                    mn0, jnp.min(jnp.where(nocon0, NEV, allmn + look_in))
-                ),
+                jnp.minimum(mn0, _bound(mn0)),
                 jax.lax.pmin(state.exch_deferred_min, axis),
             ),
             stop,
@@ -408,6 +461,109 @@ def deislandize_host_array(x, *trailing):
     return x.reshape((x.shape[0] * x.shape[1],) + x.shape[2:])
 
 
+def globalize_state(foreign: SimState, pool_capacity: int) -> SimState:
+    """Invert the islands layout: a [S, ...] (possibly migrated) SimState
+    back to the CANONICAL global layout — host rows in global-id order
+    (state.host.gid is the authority; a checkpoint taken after a live
+    migration carries permuted rows), live pool rows compacted into a
+    [pool_capacity] pool in full-event-key order, per-shard counter rows
+    summed, clocks reduced (now = max frontier, xmit_min = min), and the
+    exchange-deferral clamp cleared (every re-routed row is home — no row
+    is in transit in a single global pool).
+
+    This is the checkpoint→resume re-layout seam (core/checkpoint.
+    restore_relayout): a mesh checkpoint resumes on a DIFFERENT mesh size
+    (or on the global engine) by globalizing here and re-islandizing for
+    the target partition. Pure host-side numpy; determinism is free —
+    per-host order, RNG streams and digests key on global host ids, so
+    the audit chain is preserved exactly."""
+    gid = np.asarray(jax.device_get(foreign.host.gid))
+    batched = gid.ndim == 2
+    S_old = gid.shape[0] if batched else 1
+    H = int(gid.reshape(-1).shape[0])
+    flat_gid = gid.reshape(-1)
+    inv = np.empty(H, np.int64)
+    inv[flat_gid] = np.arange(H, dtype=np.int64)
+
+    def canon(x):
+        x = np.asarray(jax.device_get(x))
+        flat = x.reshape((H,) + x.shape[2:]) if batched else x
+        return jnp.asarray(flat[inv])
+
+    def host_like(x):
+        """Host-indexed leaf ([S, Hl, ...] or [H, ...]) → canonical
+        [H, ...]; per-shard scalar rows ([S]) → summed scalar."""
+        x = np.asarray(jax.device_get(x))
+        if batched and x.ndim >= 2 and x.shape[:2] == (S_old, H // S_old):
+            return jnp.asarray(x.reshape((H,) + x.shape[2:])[inv])
+        if batched and x.shape == (S_old,):
+            return jnp.asarray(x.sum())
+        return jnp.asarray(x)
+
+    # --- pool: compact live rows in full-event-key order ---
+    pt = np.asarray(jax.device_get(foreign.pool.time)).reshape(-1)
+    cols = [
+        np.asarray(jax.device_get(c)).reshape((-1,) + c.shape[2:] if batched
+                                              else c.shape)
+        for c in (foreign.pool.dst, foreign.pool.src, foreign.pool.seq,
+                  foreign.pool.kind, foreign.pool.payload)
+    ]
+    live = np.flatnonzero(pt != simtime.NEVER)
+    if live.shape[0] > pool_capacity:
+        raise ValueError(
+            f"{live.shape[0]} live pool rows exceed the target pool "
+            f"capacity {pool_capacity}; raise experimental.event_capacity "
+            f"on the resuming build"
+        )
+    order = live[np.lexsort((
+        cols[2][live], cols[1][live], cols[0][live], pt[live]
+    ))]
+    C = int(pool_capacity)
+    t = np.full((C,), simtime.NEVER, np.int64)
+    n = order.shape[0]
+    t[:n] = pt[order]
+    out_cols = []
+    for c in cols:
+        buf = np.zeros((C,) + c.shape[1:], c.dtype)
+        buf[:n] = c[order]
+        out_cols.append(buf)
+    pool = EventPool(
+        time=jnp.asarray(t), dst=jnp.asarray(out_cols[0]),
+        src=jnp.asarray(out_cols[1]), seq=jnp.asarray(out_cols[2]),
+        kind=jnp.asarray(out_cols[3]), payload=jnp.asarray(out_cols[4]),
+    )
+
+    obs = foreign.obs
+    if obs is not None:
+        obs = obs.replace(
+            # the window-plane row: per-shard bumps sum to the global
+            # engine's counts (islandize's inverse)
+            win=jnp.asarray(np.asarray(
+                jax.device_get(obs.win)
+            ).sum(axis=0) if batched else jax.device_get(obs.win)),
+            host_events=canon(obs.host_events),
+            host_last_t=canon(obs.host_last_t),
+            host_digest=canon(obs.host_digest),
+        )
+    red = lambda x, f: jnp.asarray(  # noqa: E731
+        f(np.asarray(jax.device_get(x))))
+    return foreign.replace(
+        pool=pool,
+        host=jax.tree.map(canon, foreign.host),
+        subs=jax.tree.map(host_like, foreign.subs),
+        counters=jax.tree.map(host_like, foreign.counters),
+        obs=obs,
+        flight=(
+            jax.tree.map(canon, foreign.flight)
+            if foreign.flight is not None else None
+        ),
+        rng_keys=canon(foreign.rng_keys),
+        now=red(foreign.now, np.max),
+        xmit_min=red(foreign.xmit_min, np.min),
+        exch_deferred_min=jnp.asarray(np.int64(simtime.NEVER)),
+    )
+
+
 # ---------------------------------------------------------------------------
 # Runner
 # ---------------------------------------------------------------------------
@@ -425,6 +581,18 @@ class IslandSimulation(Simulation):
                       oversizing re-grows sort volume — see __init__)
       mode            "vmap" (virtual islands, one device) or "shard_map"
                       (one island per mesh device)
+      exchange        async frontier-exchange collective: "ppermute"
+                      (neighbor-only, one collective-permute per static
+                      ring shift covering the in-edge matrix — per-chip
+                      volume scales with topology degree) or "all_gather"
+                      (every shard's frontier every superstep — the
+                      bench comparison arm). Identical horizons, chains
+                      bit-identical.
+      placement       initial host→chip assignment: "block" (contiguous
+                      global-id blocks) or "min_cut" (greedy affinity
+                      clustering, parallel/balancer.min_cut_placement —
+                      lookahead-critical links land intra-chip; implies
+                      the rebalance-capable slot_of kernel)
       force_path      optional engine path pin. Under vmap a lax.cond with
                       a batched predicate executes BOTH branches, so
                       matrix-capable sims (PHOLD) should pin "matrix" —
@@ -435,11 +603,24 @@ class IslandSimulation(Simulation):
                  mode: str = "vmap", force_path: str | None = None,
                  rebalance: bool = False, pool_gears: int = 1,
                  async_sync: bool = True, async_spread: int = 0,
-                 balancer: bool = False, balancer_policy=None, **kw):
+                 balancer: bool = False, balancer_policy=None,
+                 exchange: str = "ppermute", placement: str = "block",
+                 **kw):
         if mode not in ("vmap", "shard_map"):
             raise ValueError(f"unknown islands mode {mode!r}")
+        if exchange not in ("ppermute", "all_gather"):
+            raise ValueError(f"unknown islands exchange {exchange!r}")
+        if placement not in ("block", "min_cut"):
+            raise ValueError(f"unknown islands placement {placement!r}")
         self.num_shards = int(num_shards)
         self.mode = mode
+        self._exchange = exchange
+        self.placement = placement
+        if placement == "min_cut":
+            # the placement permutes host→slot at build time through the
+            # same seam a live rebalance uses, so it needs the slot_of
+            # routing table compiled in
+            rebalance = True
         # the balancer migrates through the slot_of routing seam, so
         # enabling it implies the rebalance-capable kernel
         self.rebalance_enabled = bool(rebalance) or bool(balancer)
@@ -512,6 +693,15 @@ class IslandSimulation(Simulation):
             self._latency_np, self._host_vertex_g, S
         )
         self._refresh_async_args()
+        # the compiled neighbor-exchange schedule: ring ppermute shifts
+        # covering every finite in-edge of the partition (a static
+        # kernel property — lookahead VALUES stay traced). Re-derived
+        # below if a min-cut placement changes shard connectivity;
+        # _ensure_shift_coverage widens it (one counted rebuild) if a
+        # later rebalance ever introduces an uncovered edge.
+        self._async_shifts = lookahead_mod.ppermute_shifts(self._lookahead)
+        self._exchange_rebuilds = 0
+        self._mesh_collective_bytes = 0
         self._async_counters = {
             "dispatches": 0, "supersteps": 0, "shard_windows": 0,
             "yields": 0, "blocked_on_neighbor": 0,
@@ -610,6 +800,7 @@ class IslandSimulation(Simulation):
 
         self._step_builder = build_step
 
+        self.mesh = None
         if mode == "vmap":
             # self._jit honors supervisor CPU failover (core/supervisor):
             # kernels re-lower on the CPU backend while the accelerator
@@ -626,14 +817,14 @@ class IslandSimulation(Simulation):
 
             self._wrap = _wrap
         else:  # shard_map: _wrap is defined below with the mesh in scope
-            from jax.sharding import Mesh, PartitionSpec as P
+            from jax.sharding import PartitionSpec as P
 
-            devs = jax.devices()
-            if len(devs) < S:
-                raise ValueError(
-                    f"shard_map islands need {S} devices, have {len(devs)}"
-                )
-            mesh = Mesh(np.array(devs[:S]), (AXIS,))
+            from shadow_tpu.parallel import mesh as mesh_mod
+
+            # deterministically-ordered device mesh (parallel/mesh.py:
+            # one axis, S chips) — the same construction every process
+            # of a multi-host run resolves to
+            mesh = mesh_mod.host_mesh(S, axis=AXIS)
             self.mesh = mesh
             # jax >= 0.7 exposes jax.shard_map with the varying-manual-axes
             # checker (check_vma); earlier releases ship the experimental
@@ -685,6 +876,33 @@ class IslandSimulation(Simulation):
                 return self._jit(wrapped)
 
             self._wrap = sm
+        # min-cut host->chip placement (parallel/balancer.py): cluster
+        # high-affinity (low-latency) hosts onto one chip at partition
+        # time, through the same slot_of permutation seam a live
+        # rebalance uses — applied BEFORE the kernels bind so the
+        # ppermute schedule compiles against the placed connectivity
+        if placement == "min_cut" and S > 1:
+            from shadow_tpu.parallel import balancer as balancer_mod
+
+            slot = balancer_mod.min_cut_placement(
+                self._latency_np, self._host_vertex_g, S
+            )
+            if not np.array_equal(
+                slot, np.arange(H, dtype=slot.dtype)
+            ):
+                self.migrate_hosts(slot)
+                self.rebalances = 0  # a build-time placement, not a heal
+            # the schedule compiles against the PLACED connectivity (the
+            # kernels bind below), so re-narrow past the transitional
+            # union _ensure_shift_coverage took and zero its counter —
+            # nothing was rebuilt, nothing had compiled yet
+            self._async_shifts = lookahead_mod.ppermute_shifts(
+                self._lookahead
+            )
+            self._exchange_rebuilds = 0
+        # shard_map: pin every [S, ...] state leaf to its chip so the
+        # first dispatch starts resident instead of paying a reshard
+        self._place_state()
         # drop the GLOBAL-layout kernels super().__init__ bound and rebind
         # the islands kernels for the active gear (one compiled set per
         # gear level, cached in _gear_fns like the global engine's)
@@ -727,9 +945,18 @@ class IslandSimulation(Simulation):
         }
         if self._async:
             # the async conservative loop: per-shard [S] runahead and
-            # [S, S] in-edge lookahead ride as per-shard traced inputs
+            # [S, S] in-edge lookahead ride as per-shard traced inputs;
+            # the neighbor-only ppermute schedule (when configured) is a
+            # static closure over the covering ring shifts
+            shifts = (
+                self._async_shifts if self._exchange == "ppermute"
+                else None
+            )
             fns["run_to_async"] = self._wrap(
-                make_shard_run_to_async(step, spec.hi), 9,
+                make_shard_run_to_async(
+                    step, spec.hi, shifts=shifts,
+                    num_shards=self.num_shards,
+                ), 9,
                 rest_shard=(True, True, False, False, False),
             )
         return fns
@@ -752,6 +979,8 @@ class IslandSimulation(Simulation):
         # scalar-path shifts, checkpoint restore) need the re-alignment.
         if sh is not None and level != max(sh.levels):
             sh.seed(level)
+        # the resize re-materialized the pool off-mesh: re-pin per chip
+        self._place_state()
 
     def _pool_occupancy(self) -> int:
         """Gearing decision signal: live rows on the FULLEST shard."""
@@ -788,6 +1017,105 @@ class IslandSimulation(Simulation):
         c["blocked_on_neighbor"] += blocked
         self._async_spread_max = max(self._async_spread_max, spread_max)
         self._async_frontier = frontier
+        # analytic per-chip frontier-exchange volume: every superstep
+        # runs one horizon exchange, plus one f0 exchange per dispatch;
+        # each ships one i64 per partner (len(shifts) under ppermute,
+        # S under the all_gather arm) — the quantity --mesh-smoke gates
+        self._mesh_collective_bytes += (
+            (supersteps + 1) * self.exchange_partners * 8
+        )
+
+    @property
+    def exchange_partners(self) -> int:
+        """Collective partners per chip per frontier exchange: the
+        compiled ppermute schedule's width, or S for the all_gather arm."""
+        if self._exchange == "ppermute":
+            return len(self._async_shifts)
+        return self.num_shards
+
+    def _place_state(self) -> None:
+        """shard_map only: pin every [S, ...] state leaf to its chip
+        (parallel/mesh.shard_island_state). Called after any host-side
+        relayout — build, gear resize, migration, checkpoint restore —
+        so dispatches start chip-resident instead of paying an implicit
+        reshard; a no-op under vmap."""
+        if getattr(self, "mesh", None) is None:
+            return
+        from shadow_tpu.parallel import mesh as mesh_mod
+
+        self.state = mesh_mod.shard_island_state(self.state, self.mesh)
+
+    def _ensure_shift_coverage(self) -> None:
+        """Safety gate after any assignment change: every finite in-edge
+        of the re-derived lookahead must ride a compiled ppermute shift —
+        an uncovered edge would silently drop that neighbor's frontier
+        bound from the horizon (causality, not perf). A value-only
+        rebalance (connectivity preserved — the common case, and what
+        min-cut refinement produces) changes nothing; a structural
+        change widens the schedule and rebuilds the kernel set once
+        (counted in mesh.exchange_rebuilds)."""
+        if not self._async or self._exchange != "ppermute":
+            return
+        req = lookahead_mod.ppermute_shifts(self._lookahead)
+        if set(req) <= set(self._async_shifts):
+            return
+        self._async_shifts = tuple(
+            sorted(set(self._async_shifts) | set(req))
+        )
+        if getattr(self, "_gear_fns", None):
+            self._gear_fns = {}
+            self._bind_gear()
+            self._exchange_rebuilds += 1
+
+    def mesh_stats(self) -> dict[str, int] | None:
+        """Multi-chip counters for the metrics registry (schema v11
+        `mesh.*`); None on single-shard builds."""
+        if self.num_shards <= 1 or not self._async:
+            return None
+        return {
+            "frontier_exchange_bytes": int(self._mesh_collective_bytes),
+            "exchange_rebuilds": int(self._exchange_rebuilds),
+        }
+
+    def mesh_gauges(self) -> dict | None:
+        """Multi-chip gauges (schema v11 `mesh.*`): chip count, the
+        neighbor-exchange schedule width vs the in-edge degree, per-chip
+        committed-event balance, and the placement's cut cost against
+        the block partition's."""
+        if self.num_shards <= 1:
+            return None
+        from shadow_tpu.parallel import balancer as balancer_mod
+
+        ev = np.asarray(jax.device_get(
+            self.state.counters.events_committed
+        )).reshape(-1)
+        deg = lookahead_mod.in_degree(self._lookahead)
+        slot = (
+            np.asarray(jax.device_get(self.params.slot_of))
+            if self.rebalance_enabled
+            else np.arange(self.num_hosts)
+        )
+        Hl = self.num_hosts // self.num_shards
+        g = {
+            "chips": int(self.num_shards),
+            "shard_map": int(self.mode == "shard_map"),
+            "exchange_partners": int(self.exchange_partners),
+            "in_degree_max": int(deg.max()) if deg.size else 0,
+            "events_per_chip_min": int(ev.min()),
+            "events_per_chip_max": int(ev.max()),
+            "events_per_chip_mean": float(ev.mean()),
+            "cut_cost": float(balancer_mod.cut_cost(
+                np.asarray(slot) // Hl, self._latency_np,
+                self._host_vertex_g,
+            )),
+            "cut_cost_block": float(balancer_mod.cut_cost(
+                lookahead_mod.shard_of_hosts(
+                    self.num_hosts, self.num_shards
+                ),
+                self._latency_np, self._host_vertex_g,
+            )),
+        }
+        return g
 
     def _gear_tick_async(self, occ_v: np.ndarray) -> bool:
         """Per-shard gearing decision from the async kernel's occupancy
@@ -932,6 +1260,24 @@ class IslandSimulation(Simulation):
             m["controller"] = self.balancer.meta()
         return m
 
+    def _import_foreign_layout(self, foreign, meta) -> None:
+        """checkpoint.restore_relayout hook: adopt a checkpoint taken at
+        a DIFFERENT partition (another mesh size, or the global engine)
+        into this build — globalize by gid to the canonical order, then
+        re-islandize for this partition (identity block assignment; the
+        _post_restore hook that follows re-derives slot_of/lookahead
+        from the restored rows). Chains/RNG key on global host ids, so
+        the resumed run extends the checkpointed chain exactly."""
+        live = int(np.sum(
+            np.asarray(jax.device_get(foreign.pool.time))
+            != simtime.NEVER
+        ))
+        tmp = globalize_state(foreign, max(live, 1))
+        self.state = islandize_state(
+            tmp, self.num_shards, self._C_shard
+        )
+        self._place_state()
+
     def _post_restore(self, meta: dict) -> None:
         """Re-sync layout-derived runtime state after a checkpoint
         restore (core/checkpoint.restore calls this once the leaves are
@@ -955,8 +1301,15 @@ class IslandSimulation(Simulation):
                     self.num_shards, assignment=slot,
                 )
                 self._refresh_async_args()
+                self._ensure_shift_coverage()
         if self._shard_shifter is not None:
-            self._shard_shifter.seed(self._gear)
+            # restore the per-shard ladder states the checkpoint header
+            # recorded (gearbox.ShardGearShifter.restore); a header
+            # without them (pre-v11, or barrier run) seeds flat
+            levels = (meta.get("async") or {}).get("gear_levels")
+            if not self._shard_shifter.restore(levels, self._gear):
+                self._shard_shifter.seed(self._gear)
+        self._place_state()
         if self.balancer is not None:
             bm = (meta.get("balance") or {}).get("controller")
             if bm:
@@ -1071,6 +1424,7 @@ class IslandSimulation(Simulation):
             self._refresh_async_args()
         if self._shard_shifter is not None:
             self._shard_shifter.seed(self._gear)
+        self._place_state()
 
     def _apply_assignment(self, new_slot: np.ndarray) -> None:
         """The permutation seam shared by rebalance_now (LPT) and
@@ -1193,11 +1547,13 @@ class IslandSimulation(Simulation):
                 assignment=new_slot,
             )
             self._refresh_async_args()
+            self._ensure_shift_coverage()
         if self._shard_shifter is not None:
             # per-shard occupancies just shuffled wholesale: the per-shard
             # ladder states describe the OLD layout — re-align to the
             # bound envelope (a bypass shift, like checkpoint restore)
             self._shard_shifter.seed(self._gear)
+        self._place_state()
 
     def _maybe_rebalance(self) -> None:
         """Skew trigger: rebalance when the heaviest shard holds 2x the

@@ -143,6 +143,53 @@ def in_edge_matrix(spec: LookaheadSpec) -> np.ndarray:
     return m
 
 
+def ppermute_shifts(spec: LookaheadSpec) -> tuple[int, ...]:
+    """The static ring-shift schedule covering every finite in-edge of
+    the partition: shard i needs frontier[j] whenever L[j -> i] is
+    finite, and a ``jax.lax.ppermute`` by shift d delivers exactly the
+    edges (j, j + d mod S) — so the schedule is the sorted set of
+    distinct shifts {(i - j) mod S} over finite off-diagonal entries.
+
+    This is the neighbor-only frontier exchange the mesh driver runs:
+    per superstep each chip sends/receives len(shifts) scalars instead
+    of the all_gather's S, so cross-chip collective volume scales with
+    the TOPOLOGY's shard-level degree, not the mesh size (a bidirected
+    ring is 2 shifts at any S). The schedule is a COMPILED property of
+    the kernel; the per-edge lookahead VALUES stay traced, so a
+    rebalance that preserves shard-level connectivity (shifts_covered)
+    never recompiles."""
+    S = spec.num_shards
+    m = spec.matrix
+    shifts = {
+        (i - j) % S
+        for j in range(S)
+        for i in range(S)
+        if j != i and m[j, i] < NEVER
+    }
+    return tuple(sorted(shifts))
+
+
+def shifts_covered(spec: LookaheadSpec,
+                   shifts: tuple[int, ...]) -> bool:
+    """True iff every finite in-edge of `spec` rides one of the compiled
+    `shifts` — the safety condition a re-derived (post-rebalance)
+    lookahead must meet before the compiled ppermute kernel may keep
+    running: an uncovered edge would silently drop a neighbor's frontier
+    bound from the horizon (a causality hazard, not a perf bug)."""
+    return set(ppermute_shifts(spec)) <= set(int(s) % spec.num_shards
+                                             for s in shifts)
+
+
+def in_degree(spec: LookaheadSpec) -> np.ndarray:
+    """[S] finite in-edge count per destination shard (diagonal
+    excluded) — the per-chip collective-partner count the mesh
+    telemetry reports (`mesh.exchange_partners_max`)."""
+    m = spec.matrix
+    off = m < NEVER
+    np.fill_diagonal(off, False)
+    return off.sum(axis=0).astype(np.int64)
+
+
 def auto_spread(spec: LookaheadSpec, base_runahead: int) -> int:
     """Default roughness-suppression bound (cond-mat/0302050): wide
     enough that lookahead-limited asynchrony is never throttled (8x the

@@ -15,17 +15,46 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 AXIS = "hosts"
 
 
-def host_mesh(n_devices: int | None = None) -> Mesh:
-    """A 1-D mesh over the first n devices (all by default)."""
-    devs = jax.devices()
+def host_mesh(n_devices: int | None = None, axis: str = AXIS,
+              num_hosts: int | None = None) -> Mesh:
+    """A 1-D mesh over the first n devices (all by default).
+
+    Device order is DETERMINISTIC — sorted by (process_index, id) — so
+    every process of a multi-host run (and every restart of this one)
+    resolves the identical chip <-> shard binding; jax.devices() order is
+    already id-sorted on a single process, but that is an implementation
+    detail this function refuses to depend on.
+
+    `num_hosts` (when given) must divide evenly over the mesh: the
+    islands layout holds exactly H/S host rows per chip and PADS NOTHING
+    — an uneven split would give the last chip a short block and break
+    the [S, H/S] reshape. The pad rule is explicit and caller-side: round
+    the host count UP to the next multiple of the mesh size with idle
+    hosts (no app model, no events — they cost one state row each and
+    never run), rather than this layer inventing ghost rows that every
+    per-host plane (RNG streams, digests, flight rings) would then have
+    to mask.
+    """
+    devs = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
     if n_devices is not None:
+        if n_devices < 1:
+            raise ValueError(f"need a positive mesh size, got {n_devices}")
         if len(devs) < n_devices:
             raise ValueError(
                 f"need {n_devices} devices, have {len(devs)} "
                 f"(tests virtualize with xla_force_host_platform_device_count)"
             )
         devs = devs[:n_devices]
-    return Mesh(np.array(devs), (AXIS,))
+    if num_hosts is not None and num_hosts % len(devs):
+        raise ValueError(
+            f"num_hosts {num_hosts} does not divide evenly over the "
+            f"{len(devs)}-device mesh ({num_hosts % len(devs)} hosts "
+            f"left over); pad the host count up to "
+            f"{-(-num_hosts // len(devs)) * len(devs)} with idle hosts "
+            f"(see host_mesh docstring for the pad rule) or pick a mesh "
+            f"size that divides it"
+        )
+    return Mesh(np.array(devs), (axis,))
 
 
 def replicate(mesh: Mesh):
@@ -72,6 +101,33 @@ def shard_params(params, mesh: Mesh):
     into a collective)."""
     repl = replicate(mesh)
     return jax.tree.map(lambda x: jax.device_put(x, repl), params)
+
+
+def shard_island_state(state, mesh: Mesh):
+    """Place an ISLANDIZED SimState (parallel/islands.islandize_state)
+    on the mesh: every leaf is [S, ...]-leading — host rows [S, H/S, ...],
+    pool rows [S, C_shard, ...], per-shard counters/clocks [S] — so one
+    uniform rule pins each shard's block to its chip: shard axis 0 over
+    the mesh axis, everything else replicated within the chip. This is
+    what makes shard_map islands TRUE multi-chip execution: each chip
+    holds only its own H/S hosts and C_shard pool rows (HBM scales out
+    with the mesh), and the window kernel's collectives (bounded
+    all_to_all event exchange, neighbor-only ppermute frontier exchange,
+    pmin reductions) are the only cross-chip traffic."""
+    axis = mesh.axis_names[0]
+
+    def row(x):
+        x = jax.numpy.asarray(x)
+        if x.ndim == 0:  # defensive: a scalar leaf replicates
+            return jax.device_put(x, NamedSharding(mesh, P()))
+        # the BARE P(axis) spec, not P(axis, None, ...): trailing dims
+        # are implicitly replicated either way, but jit's cache keys on
+        # the spec literally — the islands kernel's out_specs use the
+        # bare form, so an explicit-None re-pin would retrace every
+        # kernel on the first dispatch after a gear resize or migration
+        return jax.device_put(x, NamedSharding(mesh, P(axis)))
+
+    return jax.tree.map(row, state)
 
 
 def shard_sim(sim, mesh: Mesh):

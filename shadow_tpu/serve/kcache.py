@@ -53,6 +53,92 @@ def cache_root() -> str:
     )
 
 
+_MACHINE_FP: str | None = None
+_MACHINE_MARKER = "machine.json"
+
+
+def machine_fingerprint() -> str:
+    """Digest of the TARGET MACHINE the compiler lowers for: backend
+    device kind plus (on CPU backends) the host's CPU feature flags.
+
+    XLA:CPU bakes the compile host's feature set (AVX-512 tiers, AMX…)
+    into every artifact; executing an entry compiled on a different
+    machine emits the "Machine type used for XLA:CPU compilation doesn't
+    match the machine type for execution … could lead to execution
+    errors such as SIGILL" warning visible in every MULTICHIP_r0* tail
+    when a cache directory travels between hosts. Folding this digest
+    into the kcache key (and the XLA cache's machine marker,
+    enable_xla_cache) makes a foreign-machine entry a clean miss/evict
+    instead of a warning-spewing hazard."""
+    global _MACHINE_FP
+    if _MACHINE_FP is not None:
+        return _MACHINE_FP
+    import platform
+
+    # Host-derived only — this must NOT touch jax.devices(): it runs at
+    # cache-enable time, which is often BEFORE device virtualization
+    # (bench --mesh-smoke forces an 8-device CPU mesh after import), and
+    # initializing the backend here would pin the real device set. The
+    # accelerator platform already rides the cache key separately
+    # (jax.default_backend() at key time); this digest captures the HOST
+    # the XLA:CPU code generator targets.
+    parts = [platform.machine(), platform.system()]
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):
+                    parts.append(" ".join(sorted(line.split(":", 1)[1]
+                                                 .split())))
+                    break
+    except OSError:
+        pass
+    _MACHINE_FP = hashlib.sha256(
+        "\n".join(parts).encode()
+    ).hexdigest()[:16]
+    return _MACHINE_FP
+
+
+def _sweep_foreign_machine(root: str, fp: str) -> int:
+    """Evict XLA persistent-cache entries compiled on a DIFFERENT
+    machine: the root carries a machine marker; on mismatch every
+    top-level entry (XLA's flat layout) is removed and the marker
+    rewritten — a machine change costs one cold compile, never warning
+    spam or a SIGILL hazard. AOT entries under aot/ are key-guarded by
+    the same fingerprint and evict themselves on read."""
+    marker = os.path.join(root, _MACHINE_MARKER)
+    try:
+        with open(marker) as f:
+            recorded = json.load(f).get("machine")
+    except (OSError, ValueError):
+        recorded = None
+    removed = 0
+    if recorded is not None and recorded != fp:
+        for name in os.listdir(root):
+            p = os.path.join(root, name)
+            if name == _MACHINE_MARKER or not os.path.isfile(p):
+                continue
+            try:
+                os.unlink(p)
+                removed += 1
+            except OSError:
+                pass
+    if recorded != fp:
+        tmp = f"{marker}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w") as f:
+                json.dump({"machine": fp}, f)
+            os.replace(tmp, marker)
+        except OSError:
+            pass
+        finally:
+            if os.path.exists(tmp):
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+    return removed
+
+
 def sweep_corrupt_entries(root: str) -> int:
     """Evict unreadable/zero-length XLA persistent-cache entries so a
     torn write from a killed process never makes jax raise mid-run.
@@ -93,7 +179,8 @@ def enable_xla_cache(root: str | None = None) -> tuple[str, int]:
 
     root = root or cache_root()
     os.makedirs(root, exist_ok=True)
-    evicted = sweep_corrupt_entries(root)
+    evicted = _sweep_foreign_machine(root, machine_fingerprint())
+    evicted += sweep_corrupt_entries(root)
     jax.config.update("jax_compilation_cache_dir", root)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
@@ -186,6 +273,10 @@ class KernelCache:
             "jax": jax.__version__,
             "jaxlib": jaxlib.__version__,
             "platform": jax.default_backend(),
+            # target-machine fingerprint: an XLA:CPU artifact bakes the
+            # compile host's feature set; a cache dir that traveled to a
+            # different machine must miss cleanly, not SIGILL-hazard
+            "machine": machine_fingerprint(),
         }, sort_keys=True)
         return hashlib.sha256(ident.encode()).hexdigest()[:40]
 
@@ -216,6 +307,8 @@ class KernelCache:
                 or hdr.get("sha256") != hashlib.sha256(blob).hexdigest()
                 or hdr.get("jax") != jax.__version__
                 or hdr.get("jaxlib") != jaxlib.__version__
+                or hdr.get("machine", machine_fingerprint())
+                != machine_fingerprint()
             ):
                 raise ValueError("header mismatch")
             ex = jax_export.deserialize(bytearray(blob))
@@ -250,6 +343,7 @@ class KernelCache:
                 "sha256": hashlib.sha256(blob).hexdigest(),
                 "jax": jax.__version__,
                 "jaxlib": jaxlib.__version__,
+                "machine": machine_fingerprint(),
                 "platforms": list(exported.platforms),
                 "bytes": len(blob),
             }, indent=1).encode()),

@@ -245,6 +245,8 @@ def build_simulation(source) -> Simulation:
             balancer_policy=balancer_policy,
             async_sync=cfg.experimental.async_islands,
             async_spread=cfg.experimental.async_spread,
+            exchange=cfg.experimental.mesh_exchange,
+            placement=cfg.experimental.placement,
             # matrix-capable sims pin the matrix path: under vmap a
             # lax.cond with a batched predicate executes BOTH branches
             force_path="matrix" if matrix_handlers else None,

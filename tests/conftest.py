@@ -52,6 +52,10 @@ _BUDGET_TIER = {
     "test_async_sync": 3,
     # the self-balancing acceptance gate (ISSUE 11): same rule
     "test_balancer": 3,
+    # the multi-chip mesh acceptance gate (ISSUE 12): same rule — its
+    # shard_map cells compile more than the vmap tiers but the chain
+    # matrix + relayout resume must land before the tier-4 tail
+    "test_mesh": 3,
     # minutes: multi-engine parity matrices / many-shape compiles
     "test_gearbox": 4, "test_islands": 4, "test_rebalance": 4,
     "test_sharding": 4, "test_tcp": 4, "test_fleet": 4, "test_tgen": 5,

@@ -1,0 +1,461 @@
+"""True multi-chip sharded simulation (ISSUE 12): shard_map mesh
+execution with neighbor-only ppermute frontier exchange and min-cut
+chip placement.
+
+The acceptance surface: chain equality {conservative, optimistic,
+async} × {global, islands, mesh} on 2/4/8 virtual devices, checkpoint →
+resume ACROSS mesh sizes (restore_relayout), host_mesh hardening,
+ppermute shift-schedule units, min-cut placement units, schema-v11
+mesh.* telemetry, and the kcache machine-fingerprint eviction.
+"""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from shadow_tpu.core import checkpoint, simtime
+from shadow_tpu.parallel import balancer as balancer_mod
+from shadow_tpu.parallel import lookahead as lookahead_mod
+from shadow_tpu.parallel import mesh as mesh_mod
+from shadow_tpu.sim import build_simulation
+
+NEVER = int(simtime.NEVER)
+
+
+def _ring_gml(n: int, span: int = 2, seed: int = 3) -> str:
+    """One vertex per host; edges within ring distance <= span with
+    decohered latencies (direct-edge routing keeps the in-edge matrix
+    sparse when use_shortest_path is off)."""
+    rng = np.random.RandomState(seed)
+    lines = ["graph ["]
+    for v in range(n):
+        lines.append(f"  node [ id {v} ]")
+    for a in range(n):
+        lines.append(
+            f'  edge [ source {a} target {a} latency '
+            f'"{int(rng.randint(2000, 3000))} us" ]'
+        )
+        for d in range(1, span + 1):
+            lines.append(
+                f'  edge [ source {a} target {(a + d) % n} latency '
+                f'"{int(rng.randint(30000, 45000))} us" ]'
+            )
+    lines.append("]")
+    return "\n".join(lines)
+
+
+def _cfg(n: int, gml: str, *, shards: int = 1, stop: int = 3,
+         span: int = 2, **exp) -> dict:
+    hosts = {}
+    for v in range(n):
+        hosts[f"h{v:02d}"] = {
+            "quantity": 1, "network_node_id": v, "app_model": "phold",
+            "app_options": {
+                "msgload": 1, "runtime": stop - 1, "local_span": span,
+            },
+        }
+    experimental = {
+        "event_capacity": 1024, "events_per_host_per_window": 8,
+        "outbox_slots": 8, "inbox_slots": 4,
+    }
+    if shards > 1:
+        experimental.update({"num_shards": shards, "exchange_slots": 16})
+    experimental.update(exp)
+    return {
+        "general": {"stop_time": stop, "seed": 11},
+        "network": {"graph": {"type": "gml", "inline": gml}},
+        "experimental": experimental,
+        "hosts": hosts,
+    }
+
+
+N = 16
+GML = _ring_gml(N)
+
+
+@pytest.fixture(scope="module")
+def global_chain():
+    sim = build_simulation(_cfg(N, GML))
+    sim.run()
+    return sim.audit_chain(), sim.counters()["events_committed"]
+
+
+# ---------------------------------------------------------------------------
+# chain-equality matrix: {conservative, optimistic, async} × layouts
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("shards", [2, 4, 8])
+def test_mesh_async_chain_matches_global(global_chain, shards):
+    """The mesh (shard_map) async driver on 2/4/8 chips commits the
+    global engine's exact event stream — ppermute frontier exchange and
+    per-chip placement change where state lives, never the sim."""
+    chain, events = global_chain
+    sim = build_simulation(
+        _cfg(N, GML, shards=shards, island_mode="shard_map")
+    )
+    sim.run()
+    assert sim.audit_chain() == chain
+    assert sim.counters()["events_committed"] == events
+
+
+@pytest.mark.parametrize("sync", ["conservative", "async"])
+def test_islands_vmap_chain_matches_global(global_chain, sync):
+    chain, events = global_chain
+    sim = build_simulation(_cfg(
+        N, GML, shards=4, async_islands=(sync == "async"),
+    ))
+    sim.run()
+    assert sim.audit_chain() == chain
+    assert sim.counters()["events_committed"] == events
+
+
+def test_mesh_conservative_barrier_chain_matches_global(global_chain):
+    chain, events = global_chain
+    sim = build_simulation(_cfg(
+        N, GML, shards=4, island_mode="shard_map", async_islands=False,
+    ))
+    sim.run()
+    assert sim.audit_chain() == chain
+    assert sim.counters()["events_committed"] == events
+
+
+def test_mesh_optimistic_chain_matches_global(global_chain):
+    chain, events = global_chain
+    sim = build_simulation(_cfg(
+        N, GML, shards=2, island_mode="shard_map",
+    ))
+    sim.run_optimistic()
+    assert sim.audit_chain() == chain
+    assert sim.counters()["events_committed"] == events
+
+
+def test_mesh_min_cut_placement_chain_matches_global(global_chain):
+    chain, events = global_chain
+    sim = build_simulation(_cfg(
+        N, GML, shards=4, island_mode="shard_map", placement="min_cut",
+    ))
+    sim.run()
+    assert sim.audit_chain() == chain
+    assert sim.counters()["events_committed"] == events
+
+
+def test_ppermute_matches_all_gather_arm(global_chain):
+    """The two frontier-exchange arms compute identical horizons —
+    supersteps, yields, blocked counts AND chains all equal."""
+    chain, _ = global_chain
+    pp = build_simulation(_cfg(N, GML, shards=4))
+    ag = build_simulation(_cfg(
+        N, GML, shards=4, mesh_exchange="all_gather",
+    ))
+    pp.run()
+    ag.run()
+    assert pp.audit_chain() == ag.audit_chain() == chain
+    assert pp.async_stats() == ag.async_stats()
+
+
+# ---------------------------------------------------------------------------
+# checkpoint → resume across mesh sizes
+# ---------------------------------------------------------------------------
+
+
+def test_checkpoint_resume_across_mesh_sizes(tmp_path, global_chain):
+    """A mesh checkpoint taken at S=4 resumes on a 2-chip mesh AND on
+    the global engine, both finishing with the uninterrupted chain —
+    the restore_relayout seam globalizes by gid and re-routes."""
+    chain, events = global_chain
+    src = build_simulation(_cfg(N, GML, shards=4))
+    src.run(until=1 * simtime.NS_PER_SEC)
+    path = os.path.join(tmp_path, "ck.npz")
+    checkpoint.save(src, path)
+
+    dst2 = build_simulation(_cfg(N, GML, shards=2))
+    checkpoint.restore_relayout(dst2, path)
+    dst2.run()
+    assert dst2.audit_chain() == chain
+    assert dst2.counters()["events_committed"] == events
+
+    dstg = build_simulation(_cfg(N, GML))
+    checkpoint.restore_relayout(dstg, path)
+    dstg.run()
+    assert dstg.audit_chain() == chain
+    assert dstg.counters()["events_committed"] == events
+
+
+def test_restore_relayout_same_layout_falls_through(tmp_path):
+    """Matching layouts take the strict restore path (gear rebind and
+    all) — restore_relayout is a superset, not a fork."""
+    src = build_simulation(_cfg(N, GML, shards=2))
+    src.run(until=1 * simtime.NS_PER_SEC)
+    path = os.path.join(tmp_path, "ck.npz")
+    checkpoint.save(src, path)
+    dst = build_simulation(_cfg(N, GML, shards=2))
+    checkpoint.restore_relayout(dst, path)
+    src.run()
+    dst.run()
+    assert dst.audit_chain() == src.audit_chain()
+
+
+def test_restore_relayout_rejects_host_count_mismatch(tmp_path):
+    src = build_simulation(_cfg(N, GML, shards=2))
+    path = os.path.join(tmp_path, "ck.npz")
+    checkpoint.save(src, path)
+    other = build_simulation(_cfg(8, _ring_gml(8)))
+    with pytest.raises(checkpoint.CheckpointError, match="hosts"):
+        checkpoint.restore_relayout(other, path)
+
+
+# ---------------------------------------------------------------------------
+# host_mesh hardening
+# ---------------------------------------------------------------------------
+
+
+def test_host_mesh_deterministic_device_order():
+    mesh = mesh_mod.host_mesh(8)
+    devs = list(mesh.devices.flat)
+    keys = [(d.process_index, d.id) for d in devs]
+    assert keys == sorted(keys)
+    # stable across calls
+    mesh2 = mesh_mod.host_mesh(8)
+    assert [d.id for d in mesh2.devices.flat] == [d.id for d in devs]
+
+
+def test_host_mesh_uneven_hosts_error_documents_pad_rule():
+    with pytest.raises(ValueError) as ei:
+        mesh_mod.host_mesh(8, num_hosts=12)
+    msg = str(ei.value)
+    assert "12" in msg and "pad" in msg and "16" in msg
+    # evenly divisible passes
+    mesh_mod.host_mesh(8, num_hosts=16)
+    with pytest.raises(ValueError):
+        mesh_mod.host_mesh(0)
+
+
+def test_shard_map_build_places_state_on_mesh():
+    sim = build_simulation(
+        _cfg(N, GML, shards=4, island_mode="shard_map")
+    )
+    sharding = sim.state.pool.time.sharding
+    assert set(getattr(sharding, "mesh").axis_names) == {"islands"}
+    spec = sharding.spec
+    assert spec[0] == "islands"
+
+
+# ---------------------------------------------------------------------------
+# ppermute shift schedule units
+# ---------------------------------------------------------------------------
+
+
+def _spec(matrix) -> lookahead_mod.LookaheadSpec:
+    m = np.asarray(matrix, np.int64)
+    return lookahead_mod.LookaheadSpec(
+        matrix=m, intra=np.diagonal(m).copy(), min_cross=0,
+        critical=(-1, -1),
+    )
+
+
+def test_ppermute_shifts_cover_in_edges_only():
+    # 4-shard bidirected ring: finite edges j <-> j+1 only
+    m = np.full((4, 4), NEVER, np.int64)
+    for j in range(4):
+        m[j, j] = 5
+        m[j, (j + 1) % 4] = 100
+        m[(j + 1) % 4, j] = 100
+    spec = _spec(m)
+    assert lookahead_mod.ppermute_shifts(spec) == (1, 3)
+    assert list(lookahead_mod.in_degree(spec)) == [2, 2, 2, 2]
+    assert lookahead_mod.shifts_covered(spec, (1, 3))
+    assert not lookahead_mod.shifts_covered(spec, (1,))
+    # adding a chord needs a new shift
+    m2 = m.copy()
+    m2[0, 2] = 500
+    assert lookahead_mod.ppermute_shifts(_spec(m2)) == (1, 2, 3)
+
+
+def test_ppermute_shifts_empty_on_decoupled_partition():
+    m = np.full((4, 4), NEVER, np.int64)
+    np.fill_diagonal(m, 7)
+    assert lookahead_mod.ppermute_shifts(_spec(m)) == ()
+    assert lookahead_mod.shifts_covered(_spec(m), ())
+
+
+def test_sparse_topology_shifts_scale_with_degree():
+    """Direct-edge routing on the span-2 host ring: only adjacent chips
+    exchange frontiers — 2 ppermute partners at any mesh size, where
+    all_gather ships S."""
+    cfg = _cfg(N, GML, shards=8, span=2)
+    cfg["network"]["use_shortest_path"] = False
+    sim = build_simulation(cfg)
+    assert sim._async_shifts == (1, 7)
+    assert sim.exchange_partners == 2
+    sim.run()
+    g = build_simulation(_cfg(N, GML))
+    g.run()
+    assert sim.audit_chain() == g.audit_chain()
+
+
+# ---------------------------------------------------------------------------
+# min-cut placement units
+# ---------------------------------------------------------------------------
+
+
+def test_min_cut_placement_beats_block_on_offset_communities():
+    """Communities of 4 hosts offset by 2 from the chip blocks: the
+    block partition splits every community; the placement re-aligns."""
+    H, S = 16, 4
+    hv = np.arange(H, dtype=np.int64)
+    lat = np.full((H, H), NEVER, np.int64)
+    comm = ((hv - 2) % H) // 4
+    for a in range(H):
+        lat[a, a] = 1_000_000
+        for b in range(H):
+            if a != b and comm[a] == comm[b]:
+                lat[a, b] = 2_000_000  # fast chatty intra-community
+            elif abs(a - b) in (1, H - 1):
+                lat[a, b] = 80_000_000  # slow ring boundary
+    slot = balancer_mod.min_cut_placement(lat, hv, S)
+    assert np.array_equal(np.sort(slot), np.arange(H))
+    cut_p = balancer_mod.cut_cost(slot // (H // S), lat, hv)
+    cut_b = balancer_mod.cut_cost(
+        lookahead_mod.shard_of_hosts(H, S), lat, hv
+    )
+    assert cut_p < cut_b
+    # each community lands on one chip
+    shard_of = np.asarray(slot) // (H // S)
+    for c in range(S):
+        assert len(set(shard_of[comm == c])) == 1
+
+
+def test_min_cut_placement_never_worse_than_block():
+    """On a topology whose id order already encodes locality (plain
+    ring), the placement falls back to the identity block partition."""
+    H, S = 16, 4
+    hv = np.arange(H, dtype=np.int64)
+    lat = np.full((H, H), NEVER, np.int64)
+    for a in range(H):
+        lat[a, a] = 1_000_000
+        lat[a, (a + 1) % H] = 10_000_000
+        lat[(a + 1) % H, a] = 10_000_000
+    slot = balancer_mod.min_cut_placement(lat, hv, S)
+    assert np.array_equal(slot, np.arange(H, dtype=slot.dtype))
+
+
+def test_cut_cost_vertex_formula_matches_host_pairs():
+    """The vertex-level cut formula equals the O(H²) host-pair sum."""
+    rng = np.random.RandomState(0)
+    U, H, S = 5, 12, 3
+    hv = rng.randint(0, U, H).astype(np.int64)
+    lat = rng.randint(1_000_000, 90_000_000, (U, U)).astype(np.int64)
+    lat[0, 3] = NEVER
+    shard = rng.randint(0, S, H).astype(np.int64)
+    aff = balancer_mod.host_affinity(lat, hv)
+    cross = shard[:, None] != shard[None, :]
+    want = float(aff[cross].sum() / 2.0)
+    got = balancer_mod.cut_cost(shard, lat, hv)
+    assert got == pytest.approx(want, rel=1e-9)
+
+
+# ---------------------------------------------------------------------------
+# schema v11 mesh.* telemetry
+# ---------------------------------------------------------------------------
+
+
+def test_mesh_metrics_v11(tmp_path):
+    from shadow_tpu.obs import metrics as obs_metrics
+
+    sim = build_simulation(_cfg(N, GML, shards=4))
+    sim.run()
+    session = obs_metrics.ObsSession()
+    session.finalize(sim)
+    doc = session.metrics.dump(os.path.join(tmp_path, "m.json"))
+    assert doc["schema_version"] == 11
+    obs_metrics.validate_metrics_doc(doc, strict_namespaces=True)
+    assert doc["counters"]["mesh.frontier_exchange_bytes"] > 0
+    assert doc["counters"]["mesh.exchange_rebuilds"] == 0
+    g = doc["gauges"]
+    assert g["mesh.chips"] == 4
+    assert g["mesh.exchange_partners"] >= 1
+    assert g["mesh.events_per_chip_max"] >= g["mesh.events_per_chip_min"]
+    assert "mesh.cut_cost" in g and "mesh.cut_cost_block" in g
+
+
+def test_global_run_emits_no_mesh_keys(tmp_path):
+    from shadow_tpu.obs import metrics as obs_metrics
+
+    sim = build_simulation(_cfg(N, GML))
+    sim.run()
+    session = obs_metrics.ObsSession()
+    session.finalize(sim)
+    doc = session.metrics.to_doc()
+    assert not [k for k in doc["counters"] if k.startswith("mesh.")]
+    assert not [k for k in doc["gauges"] if k.startswith("mesh.")]
+
+
+# ---------------------------------------------------------------------------
+# kcache machine fingerprint
+# ---------------------------------------------------------------------------
+
+
+def test_kcache_foreign_machine_entry_evicts(tmp_path):
+    from shadow_tpu.serve import kcache
+
+    root = str(tmp_path / "cache")
+    cache = kcache.KernelCache(root)
+    key = "f" * 40
+    bin_path, hdr_path = cache._paths(key)
+    blob = b"not-an-export"
+    import hashlib
+    import jaxlib
+
+    with open(bin_path, "wb") as f:
+        f.write(blob)
+    with open(hdr_path, "w") as f:
+        json.dump({
+            "header_version": kcache.HEADER_VERSION,
+            "sha256": hashlib.sha256(blob).hexdigest(),
+            "jax": jax.__version__,
+            "jaxlib": jaxlib.__version__,
+            "machine": "somebody-elses-laptop",
+        }, f)
+    assert cache.get(key) is None
+    assert cache.stats_counters["evictions"] == 1
+    assert not os.path.exists(bin_path)
+
+
+def test_xla_cache_machine_marker_sweeps_foreign_entries(tmp_path):
+    from shadow_tpu.serve import kcache
+
+    root = str(tmp_path / "xla")
+    os.makedirs(root)
+    with open(os.path.join(root, "machine.json"), "w") as f:
+        json.dump({"machine": "old-machine"}, f)
+    entry = os.path.join(root, "xla_entry_abc")
+    with open(entry, "wb") as f:
+        f.write(b"\x00" * 64)
+    fp = kcache.machine_fingerprint()
+    removed = kcache._sweep_foreign_machine(root, fp)
+    assert removed == 1 and not os.path.exists(entry)
+    with open(os.path.join(root, "machine.json")) as f:
+        assert json.load(f)["machine"] == fp
+    # same machine: nothing evicted
+    with open(entry, "wb") as f:
+        f.write(b"\x00" * 64)
+    assert kcache._sweep_foreign_machine(root, fp) == 0
+    assert os.path.exists(entry)
+
+
+def test_machine_fingerprint_rides_kernel_cache_key(tmp_path):
+    from shadow_tpu.serve import kcache
+
+    cache = kcache.KernelCache(str(tmp_path / "c"))
+    k1 = cache.key("cfg", "tag", [np.zeros(3)])
+    old = kcache._MACHINE_FP
+    try:
+        kcache._MACHINE_FP = "different-machine"
+        k2 = cache.key("cfg", "tag", [np.zeros(3)])
+    finally:
+        kcache._MACHINE_FP = old
+    assert k1 != k2

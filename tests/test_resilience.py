@@ -501,7 +501,7 @@ def test_metrics_schema_v6_resilience_namespace():
     reg = obs_metrics.MetricsRegistry()
     obs_metrics.snapshot_device(sim, reg)
     doc = reg.to_doc()
-    assert doc["schema_version"] == 10
+    assert doc["schema_version"] == 11
     obs_metrics.validate_metrics_doc(doc)
     assert doc["counters"]["resilience.drains"] == 1
     assert doc["counters"]["resilience.failovers"] == 1

@@ -12,7 +12,15 @@ latency (or re-partitioning hosts so the chatty pair lands in one shard,
 the ROADMAP's min-cut placement item) buys the most asynchrony.
 
   python tools/lookahead_report.py config.yaml [--shards S] [--json]
-      [--assignment FILE]
+      [--assignment FILE] [--mesh]
+
+--mesh adds the multi-chip placement report: per-chip host placement,
+per-link collective partners (each chip's in-edge matrix row — exactly
+the neighbors its ppermute frontier exchange talks to, with the derived
+ring-shift schedule), and the intra- vs cross-chip affinity split of
+the analyzed assignment (block partition, or --assignment's proposal)
+next to the block partition's cross cut — the offline review for a
+min-cut placement before a run commits to it.
 
 --shards overrides experimental.num_shards (the partition to analyze;
 the config's host count must divide by it). --assignment FILE analyzes
@@ -53,6 +61,9 @@ def main(argv: list[str] | None = None) -> int:
     as_json = "--json" in args
     if as_json:
         args.remove("--json")
+    mesh = "--mesh" in args
+    if mesh:
+        args.remove("--mesh")
     shards = None
     if "--shards" in args:
         i = args.index("--shards")
@@ -155,6 +166,45 @@ def main(argv: list[str] | None = None) -> int:
 
     never = int(simtime.NEVER)
     widths = lookahead_mod.shard_runahead(spec, baked.min_latency_ns)
+    mesh_doc = None
+    if mesh:
+        shifts = lookahead_mod.ppermute_shifts(spec)
+        in_edges = lookahead_mod.in_edge_matrix(spec)  # [dst, src]
+        # intra- vs cross-chip affinity split of the analyzed assignment
+        aff = balancer_mod._affinity_vv(baked.latency_vv)
+        aff = aff + aff.T
+        hv = np.asarray(baked.host_vertex, np.int64)
+        cnt = np.zeros((S, aff.shape[0]), np.float64)
+        np.add.at(cnt, (shard_of, hv), 1.0)
+        n_v = cnt.sum(axis=0)
+        diag = float((np.diagonal(aff) * n_v).sum())
+        total = (float(n_v @ aff @ n_v) - diag) / 2.0
+        intra = total - cut
+        chips = []
+        for i in range(S):
+            hosts_i = np.flatnonzero(shard_of == i)
+            partners = [
+                {"src_chip": int(j), "lookahead_ns": int(in_edges[i, j])}
+                for j in range(S)
+                if in_edges[i, j] < never
+            ]
+            chips.append({
+                "chip": i,
+                "hosts": [int(h) for h in hosts_i],
+                "vertices": sorted(
+                    int(v) for v in np.unique(hv[hosts_i])
+                ),
+                "in_edges": partners,
+            })
+        mesh_doc = {
+            "chips": chips,
+            "ppermute_shifts": [int(d) for d in shifts],
+            "exchange_partners": len(shifts),
+            "all_gather_partners": S,
+            "cut_intra": round(intra, 3),
+            "cut_cross": round(cut, 3),
+            "cut_cross_block": round(cut_block, 3),
+        }
     if as_json:
         doc = {
             "kind": "shadow_tpu.lookahead",
@@ -183,6 +233,8 @@ def main(argv: list[str] | None = None) -> int:
                 else [int(x) for x in shard_of]
             ),
         }
+        if mesh_doc is not None:
+            doc["mesh"] = mesh_doc
         print(json.dumps(doc, indent=1))
         return 0
 
@@ -225,6 +277,35 @@ def main(argv: list[str] | None = None) -> int:
               f"links intra-shard")
     else:
         print(f"cut cost (block partition): {cut_block:.3f}")
+    if mesh_doc is not None:
+        print()
+        print(f"mesh placement ({S} chips):")
+        for row in mesh_doc["chips"]:
+            hosts_i = row["hosts"]
+            span = (
+                f"{hosts_i[0]}-{hosts_i[-1]}"
+                if hosts_i == list(range(hosts_i[0], hosts_i[-1] + 1))
+                else ",".join(str(h) for h in hosts_i[:8])
+                + ("…" if len(hosts_i) > 8 else "")
+            )
+            if row["in_edges"]:
+                links = ", ".join(
+                    f"chip {e['src_chip']} "
+                    f"({_fmt_ns(e['lookahead_ns'], never)})"
+                    for e in row["in_edges"]
+                )
+            else:
+                links = "none (fully decoupled)"
+            print(f"  chip {row['chip']}: hosts {span} | receives "
+                  f"frontiers from {links}")
+        print(f"frontier exchange: {mesh_doc['exchange_partners']} "
+              f"ppermute partner(s) per chip per superstep (ring shifts "
+              f"{mesh_doc['ppermute_shifts']}) vs {S} under all_gather")
+        print(f"affinity split: intra-chip {mesh_doc['cut_intra']:.3f} / "
+              f"cross-chip {mesh_doc['cut_cross']:.3f} (block partition "
+              f"cross: {mesh_doc['cut_cross_block']:.3f}) — min-cut "
+              f"placement (experimental.placement: min_cut) moves "
+              f"affinity intra-chip")
     return 0
 
 

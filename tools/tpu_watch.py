@@ -56,6 +56,7 @@ STAGES = [
     ("pressure_smoke", [PY, "bench.py", "--pressure-smoke"], False, 7200),
     ("async_smoke", [PY, "bench.py", "--async-smoke"], False, 7200),
     ("balance_smoke", [PY, "bench.py", "--balance-smoke"], False, 7200),
+    ("mesh_smoke", [PY, "bench.py", "--mesh-smoke"], False, 7200),
     ("stages_10k", [PY, "bench.py", "--stages"], False, 10800),
     ("stages_50k", [PY, "bench.py", "--stages-50k"], False, 14400),
     ("stages_100k", [PY, "bench.py", "--stages-100k"], False, 10800),
