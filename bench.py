@@ -1684,6 +1684,221 @@ def stage_mesh_smoke(shards: int = 8, per: int = 4, stop_s: int = 8,
     }
 
 
+def stage_mesh_resilience_smoke(shards: int = 8, per: int = 7,
+                                stop_s: int = 6, span: int = 3):
+    """Elastic mesh resilience gate (ISSUE 13 acceptance): a kill_chip
+    mid-run on an 8-chip virtual CPU mesh drains to a checkpoint,
+    relayouts onto the 7 surviving chips, CONTINUES, and — once the
+    chip answers probes again — re-expands back to 8 at a dispatch
+    boundary (parallel/elastic.py). Four arms:
+
+      control   the uninterrupted 8-chip shard_map run — the chain
+                reference;
+      elastic   kill_chip {at 2s, chip 3, recovers} under policy
+                `relayout`: drain → 7-chip relayout → re-expand → finish;
+      wait      the same kill_chip under policy `wait` (hot resume on
+                the full mesh once the chip answers) — the control arm
+                proving relayout adds nothing the chain can see;
+      shrink1   a 2-chip mesh losing one chip falls back to the GLOBAL
+                engine (islands.globalize_state), the S→1 endpoint.
+
+    Gates: every arm's audit chain and committed-event total BIT-
+    IDENTICAL to its uninterrupted reference; exactly one counted
+    kernel rebuild per mesh change (relayouts + re_expansions ==
+    kernel_rebuilds − 1, and the re-expanded sim is retrace-free);
+    ZERO all-gathers in the final mesh kernel's optimized HLO (the PR 12
+    pin, unchanged by the elastic plane); drain checkpoints live in the
+    drain-* namespace with the periodic ring intact; and the schema-v12
+    mesh.* artifact strict-validates with the relayout counters
+    recorded. CPU-deterministic (the injection is the outage, probes
+    are countdown-driven), so no backend wait."""
+    import tempfile
+
+    import numpy as np  # noqa: F401 — config helpers below use jax only
+
+    import jax
+
+    from shadow_tpu.analysis import hlo_audit
+    from shadow_tpu.core import checkpoint as ckpt_mod
+    from shadow_tpu.core.supervisor import BackendSupervisor
+    from shadow_tpu.faults import plan as plan_mod
+    from shadow_tpu.obs import metrics as obs_metrics
+    from shadow_tpu.parallel import elastic as elastic_mod
+    from shadow_tpu.sim import build_simulation
+
+    n = shards * per
+    comm = per
+    offset = per // 2
+    gml = _mesh_smoke_gml(n, comm, offset, span)
+
+    def cfg(hosts_n: int, chips: int, graph: str, stop: int) -> dict:
+        hosts = {}
+        for v in range(hosts_n):
+            hosts[f"h{v:02d}"] = {
+                "quantity": 1, "network_node_id": v, "app_model": "phold",
+                "app_options": {
+                    "msgload": 1, "runtime": stop - 1, "local_span": span,
+                },
+            }
+        return {
+            "general": {"stop_time": stop, "seed": 42},
+            "network": {
+                "graph": {"type": "gml", "inline": graph},
+                "use_shortest_path": False,
+            },
+            "experimental": {
+                "event_capacity": 8192, "events_per_host_per_window": 8,
+                "outbox_slots": 8, "inbox_slots": 4,
+                "num_shards": chips, "exchange_slots": 16,
+                "island_mode": "shard_map",
+            },
+            "hosts": hosts,
+        }
+
+    def quiet_sup(policy):
+        return BackendSupervisor(policy, sleep=lambda s: None,
+                                 probe_budget_s=60.0)
+
+    kill = [{"at": "2 s", "op": "kill_chip", "chip": 3,
+             "recover_after": 2}]
+
+    t0 = time.perf_counter()
+    # --- control: uninterrupted 8-chip mesh ---
+    base = cfg(n, shards, gml, stop_s)
+    control = build_simulation(base)
+    control.run(windows_per_dispatch=64)
+    chain_ref = control.audit_chain()
+    ev_ref = control.counters()["events_committed"]
+
+    # --- elastic arm: kill → drain → relayout(7) → re-expand(8) ---
+    with tempfile.TemporaryDirectory(prefix="mesh_resilience_") as td:
+        runner = elastic_mod.ElasticMeshRunner(
+            elastic_mod.config_builder(base), chips=shards, ckpt_dir=td,
+            supervisor=quiet_sup("relayout"),
+            faults=plan_mod.parse_fault_plan(kill),
+            probe_every=1, hysteresis=2, cooldown=1,
+            windows_per_dispatch=32,
+        )
+        mesh = runner.run()
+        chain_elastic = mesh.audit_chain()
+        ev_elastic = mesh.counters()["events_committed"]
+        rstats = runner.stats()
+        # drain-namespace satellite: the drains never touched the
+        # periodic ring's namespace
+        drains = ckpt_mod.ring_entries(td, prefix="drain")
+        gate_drain_ns = len(drains) >= 2  # chip loss + re-expand
+
+        # metrics artifact (schema v12, strict namespaces)
+        metrics_path = os.path.join(
+            _REPO, "mesh_resilience_smoke.metrics.json"
+        )
+        session = obs_metrics.ObsSession()
+        session.finalize(mesh)
+        doc = session.metrics.dump(metrics_path, meta={
+            "stage": "mesh_resilience_smoke", "hosts": n, "chips": shards,
+        })
+        obs_metrics.validate_metrics_doc(doc, strict_namespaces=True)
+        v12_recorded = (
+            doc["schema_version"] == obs_metrics.SCHEMA_VERSION
+            and doc["counters"].get("mesh.relayouts") == 1
+            and doc["counters"].get("mesh.re_expansions") == 1
+            and doc["counters"].get("mesh.chips_lost") == 1
+            and doc["counters"].get("resilience.chip_losses", 0) >= 1
+            and doc["gauges"].get("mesh.chips_up") == shards
+            and doc["gauges"].get("mesh.chips_total") == shards
+        )
+
+        # the PR 12 hlo pin, unchanged: the re-expanded mesh kernel's
+        # frontier exchange still lowers to collective-permutes only
+        fn = mesh._gear_fns[mesh._gear]["run_to_async"]
+        hlo = fn.lower(
+            mesh.state, mesh.params, mesh._async_runahead,
+            mesh._async_look_in, mesh._async_spread,
+            hlo_audit.DEFAULT_WIN_END, 8,
+        ).compile().as_text()
+        mesh_ag = len(hlo_audit.all_gather_lines(hlo))
+        retrace = hlo_audit.retrace_report(mesh)
+
+    # --- wait-policy control arm: hot resume on the full mesh ---
+    waits = build_simulation(base)
+    waits.attach_supervisor(quiet_sup("wait"))
+    waits.attach_faults(plan_mod.parse_fault_plan(kill))
+    waits.run(windows_per_dispatch=32)
+    chain_wait = waits.audit_chain()
+    ev_wait = waits.counters()["events_committed"]
+
+    # --- shrink-to-1 arm: 2 chips → 1 falls back to the global engine ---
+    n1 = 2 * per
+    gml1 = _mesh_smoke_gml(n1, per, per // 2, span)
+    base1 = cfg(n1, 2, gml1, stop_s - 2)
+    ref1 = build_simulation(base1)
+    ref1.run(windows_per_dispatch=64)
+    with tempfile.TemporaryDirectory(prefix="mesh_shrink1_") as td:
+        runner1 = elastic_mod.ElasticMeshRunner(
+            elastic_mod.config_builder(base1), chips=2, ckpt_dir=td,
+            supervisor=quiet_sup("relayout"),
+            faults=plan_mod.parse_fault_plan(
+                [{"at": "1 s", "op": "kill_chip", "chip": 1}]
+            ),
+            windows_per_dispatch=32,
+        )
+        shrunk = runner1.run()
+        gate_shrink1 = (
+            shrunk.audit_chain() == ref1.audit_chain()
+            and shrunk.counters()["events_committed"]
+            == ref1.counters()["events_committed"]
+            and not hasattr(shrunk, "num_shards")  # the global engine
+        )
+
+    gate_chain = bool(
+        chain_elastic == chain_ref and ev_elastic == ev_ref
+        and chain_wait == chain_ref and ev_wait == ev_ref
+    )
+    gate_elastic = (
+        rstats["relayouts"] == 1 and rstats["re_expansions"] == 1
+    )
+    # one counted kernel rebuild per mesh change: the initial build plus
+    # exactly one per relayout/re-expansion, and the final sim retraces
+    # nothing on top of its own build
+    gate_rebuilds = (
+        rstats["kernel_rebuilds"] - 1
+        == rstats["relayouts"] + rstats["re_expansions"]
+        and retrace["ok"]
+    )
+    gate_hlo = mesh_ag == 0
+    return {
+        "stage": "mesh_resilience_smoke",
+        "platform": jax.default_backend(),
+        "devices": len(jax.devices()),
+        "hosts": n,
+        "chips": shards,
+        "events": int(ev_elastic),
+        "chain": int(chain_elastic),
+        "relayouts": int(rstats["relayouts"]),
+        "re_expansions": int(rstats["re_expansions"]),
+        "chips_lost": int(rstats["chips_lost"]),
+        "kernel_rebuilds": int(rstats["kernel_rebuilds"]),
+        "relayout_downtime_ms": round(
+            rstats["relayout_downtime_ns"] / 1e6, 1
+        ),
+        "drain_checkpoints": len(drains),
+        "all_gathers_mesh": int(mesh_ag),
+        "wall_s": round(time.perf_counter() - t0, 3),
+        "metrics_out": os.path.relpath(metrics_path, _REPO),
+        "gate_chain": gate_chain,
+        "gate_elastic": bool(gate_elastic),
+        "gate_rebuilds": bool(gate_rebuilds),
+        "gate_hlo": bool(gate_hlo),
+        "gate_shrink1": bool(gate_shrink1),
+        "gate_drain_namespace": bool(gate_drain_ns),
+        "gate_v12": bool(v12_recorded),
+        "gate": bool(
+            gate_chain and gate_elastic and gate_rebuilds and gate_hlo
+            and gate_shrink1 and gate_drain_ns and v12_recorded
+        ),
+    }
+
+
 _SERVE_SMOKE_SWEEP = {
     "sweep": {
         "name": "serve-smoke",
@@ -1886,6 +2101,19 @@ def main():
 
         force_cpu_devices(8, cache_dir=os.path.join(_REPO, ".jax_cache"))
         print(json.dumps(stage_mesh_smoke()), flush=True)
+        return
+    if "--mesh-resilience-smoke" in sys.argv:
+        # elastic-resilience gate: kill_chip mid-run → drain → relayout
+        # onto the surviving mesh → re-expand on recovery, chains
+        # bit-identical to the uninterrupted run (and to the wait-policy
+        # control arm); the shrink-to-1 arm resumes on the global
+        # engine. Runs on 8 VIRTUAL CPU devices (the force must land
+        # before the jax backend initializes), so no backend wait.
+        os.environ.setdefault("SHADOW_TPU_BENCH_ALLOW_CPU", "1")
+        from shadow_tpu.parallel.virtualize import force_cpu_devices
+
+        force_cpu_devices(8, cache_dir=os.path.join(_REPO, ".jax_cache"))
+        print(json.dumps(stage_mesh_resilience_smoke()), flush=True)
         return
     if "--balance-smoke" in sys.argv:
         # self-balancing gate: a skew_hosts-driven hot shard is detected

@@ -95,14 +95,19 @@ def _build_parser() -> argparse.ArgumentParser:
              "config's faults.inject list",
     )
     p.add_argument(
-        "--on-backend-loss", choices=("wait", "cpu", "abort"),
+        "--on-backend-loss", choices=("wait", "cpu", "abort", "relayout"),
         help="override faults.on_backend_loss: survive accelerator loss "
              "mid-run by draining the committed frontier to a crash-"
              "consistent checkpoint and then either re-probing until the "
              "backend returns (wait, hot resume), failing over to the "
-             "CPU backend (cpu, upshifting back on recovery), or "
-             "aborting after the drain (abort; finish with --resume); "
-             "device plane only (docs/fault_tolerance.md §Backend loss)",
+             "CPU backend (cpu, upshifting back on recovery), "
+             "aborting after the drain (abort; finish with --resume), or "
+             "— on a multi-chip mesh with chip-scoped loss — raising "
+             "ChipLost for an elastic relayout onto the surviving chips "
+             "(relayout; parallel/elastic.py drives the full "
+             "shrink/re-expand loop; a bare CLI run exits resumable "
+             "like abort); device plane only "
+             "(docs/fault_tolerance.md §Backend loss, §7)",
     )
     p.add_argument(
         "--on-proc-failure", choices=("abort", "quarantine"),

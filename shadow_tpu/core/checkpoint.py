@@ -42,9 +42,15 @@ import numpy as np
 
 FORMAT_VERSION = 2
 
-# auto-checkpoint ring entries: ckpt-<seq>-<sim_ns>.npz — seq gives the
-# newest-first order even if two boundaries share a frontier time
-_RING_RE = re.compile(r"^ckpt-(\d{6})-(\d+)\.npz$")
+# auto-checkpoint ring entries: <prefix>-<seq>-<sim_ns>.npz — seq gives
+# the newest-first order even if two boundaries share a frontier time.
+# Two namespaces share one monotonic seq counter: "ckpt" (the periodic
+# retention ring) and "drain" (emergency drain checkpoints — supervisor
+# backend-loss / pool-exhaustion / elastic relayout flushes). Drains
+# rotate only against other drains, so a burst of chip losses can never
+# rotate out the last periodic checkpoint (and vice versa).
+RING_PREFIXES = ("ckpt", "drain")
+_RING_RE = re.compile(r"^(ckpt|drain)-(\d{6})-(\d+)\.npz$")
 
 
 class CheckpointError(ValueError):
@@ -387,9 +393,12 @@ def restore_relayout(sim, path: str) -> None:
 # ---------------------------------------------------------------------------
 
 
-def ring_entries(ckpt_dir: str) -> list[tuple[int, int, str]]:
-    """(seq, sim_ns, path) for every ring entry in `ckpt_dir`, oldest
-    first. Temp files and foreign names are ignored."""
+def ring_entries(ckpt_dir: str,
+                 prefix: str | None = None) -> list[tuple[int, int, str]]:
+    """(seq, sim_ns, path) ring entries in `ckpt_dir`, oldest first —
+    one namespace when `prefix` is given ("ckpt" or "drain"), both
+    otherwise (seq is shared and monotonic across them, so the merged
+    sort IS newest-last). Temp files and foreign names are ignored."""
     out = []
     try:
         names = os.listdir(ckpt_dir)
@@ -397,8 +406,8 @@ def ring_entries(ckpt_dir: str) -> list[tuple[int, int, str]]:
         return []
     for name in names:
         m = _RING_RE.match(name)
-        if m:
-            out.append((int(m.group(1)), int(m.group(2)),
+        if m and (prefix is None or m.group(1) == prefix):
+            out.append((int(m.group(2)), int(m.group(3)),
                         os.path.join(ckpt_dir, name)))
     out.sort()
     return out
@@ -406,14 +415,21 @@ def ring_entries(ckpt_dir: str) -> list[tuple[int, int, str]]:
 
 def save_ring(sim, ckpt_dir: str, seq: int, sim_ns: int,
               retain: int = 3, extra_meta: dict | None = None,
-              ) -> tuple[str, int]:
-    """Write one ring checkpoint ckpt-<seq>-<sim_ns>.npz and prune the
-    oldest entries beyond `retain`. Returns (path, pruned_count)."""
+              prefix: str = "ckpt") -> tuple[str, int]:
+    """Write one ring checkpoint <prefix>-<seq>-<sim_ns>.npz and prune
+    the oldest SAME-NAMESPACE entries beyond `retain` — a drain burst
+    rotates drains only, never the periodic ring (and vice versa).
+    Returns (path, pruned_count)."""
+    if prefix not in RING_PREFIXES:
+        raise ValueError(
+            f"checkpoint ring prefix must be one of {RING_PREFIXES}, "
+            f"got {prefix!r}"
+        )
     os.makedirs(ckpt_dir, exist_ok=True)
-    path = os.path.join(ckpt_dir, f"ckpt-{seq:06d}-{sim_ns}.npz")
+    path = os.path.join(ckpt_dir, f"{prefix}-{seq:06d}-{sim_ns}.npz")
     save(sim, path, extra_meta=extra_meta)
     pruned = 0
-    entries = ring_entries(ckpt_dir)
+    entries = ring_entries(ckpt_dir, prefix=prefix)
     for _, _, old in entries[:max(0, len(entries) - max(1, retain))]:
         os.unlink(old)
         pruned += 1
@@ -422,14 +438,16 @@ def save_ring(sim, ckpt_dir: str, seq: int, sim_ns: int,
 
 def resume_latest(sim, ckpt_dir: str) -> dict:
     """Restore the newest ring checkpoint that passes integrity
-    validation, falling back past corrupt ones (each fallback is counted).
+    validation — periodic AND drain namespaces, newest-first by the
+    shared seq counter — falling back past corrupt ones (each fallback
+    is counted).
     Returns {"path", "meta", "fallbacks", "rejected": [(path, error)]}.
     Raises CheckpointError when no entry validates."""
     entries = ring_entries(ckpt_dir)
     if not entries:
         raise CheckpointError(
             f"{ckpt_dir}: no checkpoints to resume from (expected "
-            f"ckpt-<seq>-<ns>.npz entries)"
+            f"ckpt-<seq>-<ns>.npz or drain-<seq>-<ns>.npz entries)"
         )
     rejected = []
     for seq, sim_ns, path in reversed(entries):

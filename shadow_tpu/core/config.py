@@ -210,6 +210,11 @@ class ExperimentalOptions:
     # (parallel/balancer.min_cut_placement) so lookahead-critical
     # low-latency links land intra-chip (implies `rebalance`).
     placement: str = "block"  # "block" | "min_cut"
+    # Dead chips to build AROUND (elastic mesh resilience,
+    # parallel/elastic.py): indices into the deterministic device order
+    # that the surviving-mesh rebuild must skip. Normally set by the
+    # elastic runner's relayout, not by hand.
+    exclude_chips: tuple = ()
     # Between-window host->shard re-sharding on load skew (the P3
     # work-stealing replacement, scheduler_policy_host_steal.c analog).
     rebalance: bool = False
@@ -352,6 +357,15 @@ class ExperimentalOptions:
             if v not in ("vmap", "shard_map"):
                 raise ConfigError(f"unknown island_mode {v!r}")
             out.island_mode = v
+        if d.get("exclude_chips") is not None:
+            v = d["exclude_chips"]
+            if (not isinstance(v, (list, tuple))
+                    or not all(isinstance(c, int) and c >= 0 for c in v)):
+                raise ConfigError(
+                    "experimental.exclude_chips must be a list of "
+                    "non-negative chip indices"
+                )
+            out.exclude_chips = tuple(int(c) for c in v)
         if "mesh_exchange" in d:
             v = str(d["mesh_exchange"]).lower()
             if v not in ("ppermute", "all_gather"):
@@ -565,9 +579,10 @@ class FaultOptions:
                 raise ConfigError("faults.ipc_timeout_retries must be >= 0")
         if d.get("on_backend_loss") is not None:
             v = str(d["on_backend_loss"]).lower()
-            if v not in ("wait", "cpu", "abort"):
+            if v not in ("wait", "cpu", "abort", "relayout"):
                 raise ConfigError(
-                    f"faults.on_backend_loss must be wait|cpu|abort, "
+                    f"faults.on_backend_loss must be "
+                    f"wait|cpu|abort|relayout, "
                     f"got {v!r}"
                 )
             out.on_backend_loss = v

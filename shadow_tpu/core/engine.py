@@ -1676,6 +1676,10 @@ class Simulation:
         # failover flag re-lowers kernels on the CPU backend (_jit).
         self.supervisor = None
         self._cpu_failover = False
+        # Elastic mesh resilience (parallel/elastic.py): the runner's
+        # dispatch-boundary hook — probes lost chips and signals the
+        # relayout-back-up. None = one attribute check per dispatch.
+        self.elastic = None
         # Resource-pressure plane (core/pressure.py): None until the
         # first pressure signal (a stall, an XLA RESOURCE_EXHAUSTED, or a
         # saturate_pool injection) lazily attaches the default ladder —
@@ -2164,6 +2168,11 @@ class Simulation:
                 self._handoff_tick(mn)
             if mn >= stop and spill.min_time >= stop and not press:
                 break
+            if self.elastic is not None:
+                # elastic re-expansion probe (parallel/elastic.py): may
+                # raise MeshReexpand at this committed boundary — the
+                # runner drains and relayouts onto the recovered mesh
+                self.elastic.on_dispatch(self, mn)
             cur = (mn, spill.count, press)
             if cur == last and mn >= stop_at and not shifted:
                 cap = self._gear_ladder[self._gear].capacity
@@ -2278,6 +2287,9 @@ class Simulation:
         mn = int(np.min(np.asarray(jax.device_get(self.state.pool.time))))
         t = max(0, min(mn, self.stop_time))
         sup = self.supervisor
+        # drains live in their own `drain-*` ring namespace: a burst of
+        # backend/chip losses rotates drains against drains only, never
+        # the periodic ring (core/checkpoint.save_ring prefix rule)
         path, pruned = ckpt_mod.save_ring(
             self, d, self._ckpt_seq, t, self.checkpoint_retain,
             extra_meta={"drain": {
@@ -2285,6 +2297,7 @@ class Simulation:
                 "policy": sup.policy if sup is not None else "abort",
                 "frontier_ns": t,
             }},
+            prefix="drain",
         )
         self._ckpt_seq += 1
         self.fault_counters["checkpoints_written"] += 1
@@ -2633,6 +2646,8 @@ class Simulation:
                     self.attach_supervisor(sup)
                 if f.op == "kill_backend":
                     sup.inject_kill(f.recover_after)
+                elif f.op == "kill_chip":
+                    sup.inject_kill_chip(f.chip, f.recover_after)
                 elif f.op == "exhaust_backend":
                     sup.inject_exhaust(f.recover_after)
                 else:  # stall_backend

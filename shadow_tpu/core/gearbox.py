@@ -226,7 +226,13 @@ class ShardGearShifter:
         behavior). Returns False — caller should seed() — when the
         recorded vector is absent, the wrong width, or inconsistent with
         the restored envelope (its max must equal the bound tier, or the
-        compiled pool shape would disagree with the decision state)."""
+        compiled pool shape would disagree with the decision state).
+        The width check is also the MESH-RESIZE re-seed rule: an elastic
+        relayout (parallel/elastic.py) restores an S_old-chip checkpoint
+        onto an S_new-chip build, whose header vector no longer describes
+        this shard set — the rebuilt mesh seeds flat and re-learns its
+        per-chip levels (tests/test_mesh_resilience.py exercises the
+        4→3→4 round trip under a multi-tier ladder)."""
         if not levels or len(levels) != self.S:
             return False
         lv = [int(x) for x in levels]

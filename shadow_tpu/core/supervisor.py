@@ -28,7 +28,15 @@ converts every driver's hard-abort path into a supervised state machine:
                     simulation advancing; opportunistic probes upshift
                     back to the primary when it recovers;
      policy `abort` raise BackendLost AFTER the drain checkpoint — the
-                    run dies but `--resume` finishes it bit-exactly.
+                    run dies but `--resume` finishes it bit-exactly;
+     policy `relayout`
+                    chip-scoped elastic recovery for multi-chip meshes:
+                    after the drain, raise ChipLost carrying the dead
+                    chip set — the elastic runner (parallel/elastic.py)
+                    rebuilds the mesh over the surviving chips, resumes
+                    via checkpoint.restore_relayout (audit chain
+                    extended exactly), and relayouts back up when the
+                    lost chips answer probes again.
 
 Every dispatch goes through `BackendSupervisor.call(label, thunk)`. The
 thunk re-reads the driver's bound kernels on each attempt, so a recovery
@@ -88,6 +96,36 @@ _LOST_MARKERS = (
     "heartbeat timeout",
 )
 
+# Mesh-collective failure markers: a cross-chip collective (the async
+# driver's ppermute frontier exchange, the event-exchange all_to_all, a
+# pmin all-reduce) died because ONE participant chip is gone, not the
+# whole device set. Checked BEFORE the transient table — several runtimes
+# phrase these as "ABORTED: collective ..." and a bounded retry would
+# spin forever against the same dead peer — and classified BACKEND_LOST
+# (chip-scoped: `chip_scoped` reports which family matched) so the drain
+# + policy machinery runs with the surviving chips still healthy.
+_CHIP_MARKERS = (
+    "ppermute",
+    "collective-permute",
+    "collective_permute",
+    "all-reduce",
+    "all_reduce",
+    "allreduce",
+    "all-gather",
+    "all_gather",
+    "all-to-all",
+    "all_to_all",
+    "collective operation",
+    "collective timeout",
+    "peer failure",
+    "peer unreachable",
+    "remote device",
+    "ici link",
+    "dcn link",
+    "nccl",
+    "participant failed",
+)
+
 # Errors worth a bounded in-place retry before escalating: interrupted
 # collectives and queue hiccups that a healthy backend shakes off.
 _TRANSIENT_MARKERS = (
@@ -118,10 +156,28 @@ class BackendLost(RuntimeError):
     checkpoint directory is configured — was written before this raise."""
 
 
+class ChipLost(BackendLost):
+    """CHIP-SCOPED backend loss under policy `relayout`: one (or a few)
+    chips of a multi-chip mesh died, the surviving chips are healthy,
+    and the drain checkpoint was written. `chips` is the frozenset of
+    lost chip indices (mesh device order); `path` the drain checkpoint
+    (None when no checkpoint directory is configured). The elastic
+    runner (parallel/elastic.py) catches this, rebuilds the mesh over
+    the survivors, and resumes via checkpoint.restore_relayout."""
+
+    def __init__(self, message: str, *, chips=frozenset(),
+                 path: str | None = None):
+        super().__init__(message)
+        self.chips = frozenset(int(c) for c in chips)
+        self.path = path
+
+
 def classify_failure(exc: BaseException) -> str:
     """TRANSIENT (bounded retry), RESOURCE_EXHAUSTED (pressure ladder),
     BACKEND_LOST (drain + policy), or FATAL (re-raise: a real bug, not an
-    infrastructure failure)."""
+    infrastructure failure). Mesh-collective failures (`chip_scoped`)
+    classify BACKEND_LOST — checked before the transient table, so a
+    dead ppermute peer is never retried forever."""
     if isinstance(exc, BackendLost):
         return BACKEND_LOST
     if isinstance(exc, PoolExhausted):
@@ -130,6 +186,9 @@ def classify_failure(exc: BaseException) -> str:
     for marker in _EXHAUSTED_MARKERS:
         if marker in msg:
             return RESOURCE_EXHAUSTED
+    for marker in _CHIP_MARKERS:
+        if marker in msg:
+            return BACKEND_LOST
     for marker in _TRANSIENT_MARKERS:
         if marker in msg:
             return TRANSIENT
@@ -137,6 +196,18 @@ def classify_failure(exc: BaseException) -> str:
         if marker in msg:
             return BACKEND_LOST
     return FATAL
+
+
+def chip_scoped(exc: BaseException) -> bool:
+    """True when `exc` names a mesh-collective failure — loss of ONE
+    participant chip, not the whole device set. The relayout policy uses
+    this (plus the kill_chip injection's explicit chip set, plus a
+    MeshHealth probe sweep) to decide that degrading to the surviving
+    mesh is sound where a whole-backend loss would not be."""
+    if isinstance(exc, ChipLost):
+        return True
+    msg = f"{type(exc).__name__}: {exc}".lower()
+    return any(marker in msg for marker in _CHIP_MARKERS)
 
 
 def probe_backend() -> bool:
@@ -157,6 +228,11 @@ def probe_backend() -> bool:
 
 _default_probe = probe_backend  # supervisor-internal historical name
 
+# _chips_down sentinel for probe-discovered (not injection-driven) dead
+# chips: probing one consults the MeshHealth device prober, never an
+# injection countdown
+_REAL_CHIP = -1
+
 
 class BackendSupervisor:
     """Wraps device dispatches in a deadline watchdog with classified
@@ -172,7 +248,7 @@ class BackendSupervisor:
     never simulation results).
     """
 
-    POLICIES = ("wait", "cpu", "abort")
+    POLICIES = ("wait", "cpu", "abort", "relayout")
 
     def __init__(
         self,
@@ -233,6 +309,13 @@ class BackendSupervisor:
         self._inject_probes_left: int | None = None
         self._inject_stalls = 0
         self._inject_exhausts = 0
+        # chip-scoped loss bookkeeping (policy `relayout`, and per-chip
+        # probing under `wait`): chip index -> remaining FAILED probes
+        # before the simulated chip answers again (None = stays down).
+        # Real (non-injected) chips are probed through the bound
+        # MeshHealth prober (parallel/mesh.py) when one is attached.
+        self._chips_down: dict[int, int | None] = {}
+        self.mesh_health = None
         self.counters = {
             "dispatches": 0,
             "retries": 0,
@@ -247,12 +330,35 @@ class BackendSupervisor:
             "failbacks": 0,
             "hot_resumes": 0,
             "downtime_ns": 0,
+            "chip_losses": 0,
         }
 
     # -- binding + fault-plane injection hooks --
 
     def bind(self, sim) -> None:
         self._sim = sim
+
+    def attach_mesh_health(self, health) -> None:
+        """Bind a per-chip prober (parallel/mesh.MeshHealth): chip
+        probes that are not injection-driven dispatch against the
+        individual device instead of the default backend."""
+        self.mesh_health = health
+
+    def inject_kill_chip(self, chip: int,
+                         recover_after: int | None = None) -> None:
+        """Simulate the loss of ONE mesh chip (the `kill_chip` fault
+        op): the next supervised dispatch fails chip-scoped — under
+        policy `relayout` the drain is followed by a ChipLost carrying
+        the dead chip set (the elastic runner's rebuild signal); under
+        `wait` the probe loop holds until every down chip answers.
+        Probes of this chip fail `recover_after` times before the
+        simulated chip recovers (None = stays down)."""
+        self._dead = True
+        self.counters["backend_losses"] += 1
+        self.counters["chip_losses"] += 1
+        self._chips_down[int(chip)] = (
+            None if recover_after is None else max(0, int(recover_after))
+        )
 
     def inject_kill(self, recover_after: int | None = None) -> None:
         """Simulate backend loss (the `kill_backend` fault op): the next
@@ -290,6 +396,12 @@ class BackendSupervisor:
 
     def probe(self) -> bool:
         self.counters["probes"] += 1
+        if self._chips_down:
+            # chip-scoped outage: the backend answers when every down
+            # chip does (the `wait` policy's hold-until-whole condition)
+            for chip in sorted(self._chips_down):
+                self._probe_chip_raw(chip)
+            return not self._chips_down
         if self._inject_probes_left is not None:
             if self._inject_probes_left == 0:
                 self._inject_probes_left = None  # simulated recovery
@@ -298,6 +410,46 @@ class BackendSupervisor:
                 self._inject_probes_left -= 1
             return False
         return bool(self._probe_fn())
+
+    def probe_chip(self, chip: int) -> bool:
+        """Probe ONE mesh chip — the elastic re-expansion loop's signal
+        (parallel/elastic.py polls lost chips through this and relayouts
+        back up after a hysteresis streak of successes)."""
+        self.counters["probes"] += 1
+        return self._probe_chip_raw(int(chip))
+
+    def _probe_chip_raw(self, chip: int) -> bool:
+        if chip in self._chips_down:
+            left = self._chips_down[chip]
+            if left == _REAL_CHIP:
+                # probe-discovered (not injected) dead chip: ask the
+                # actual device through the MeshHealth prober
+                if self.mesh_health is not None and bool(
+                    self.mesh_health.probe_chip(chip)
+                ):
+                    del self._chips_down[chip]
+                    return True
+                return False
+            if left is None:
+                return False
+            if left <= 0:
+                del self._chips_down[chip]  # simulated chip recovery
+                return True
+            self._chips_down[chip] = left - 1
+            return False
+        if self.mesh_health is not None:
+            return bool(self.mesh_health.probe_chip(chip))
+        return bool(self._probe_fn())
+
+    @property
+    def chips_down(self) -> frozenset[int]:
+        """The currently-known dead chip set (injected or probe-found)."""
+        return frozenset(self._chips_down)
+
+    def mark_chip_down(self, chip: int) -> None:
+        """Record a probe-discovered dead chip (MeshHealth sweep, real
+        hardware path): subsequent probes go to the device itself."""
+        self._chips_down.setdefault(int(chip), _REAL_CHIP)
 
     # -- the supervised dispatch --
 
@@ -349,6 +501,13 @@ class BackendSupervisor:
                 # that cannot absorb a bounded retry burst is not healthy)
                 self._dead = True
                 self.counters["backend_losses"] += 1
+                if chip_scoped(exc) and not self._chips_down:
+                    # a mesh collective died against one peer: find the
+                    # dead participant(s) so the relayout policy can
+                    # degrade to the survivors instead of declaring the
+                    # whole device set gone
+                    self.counters["chip_losses"] += 1
+                    self._sweep_chips()
                 self._note_down()
                 continue
             elapsed = self._clock() - t0
@@ -412,6 +571,23 @@ class BackendSupervisor:
                 f"backend lost at dispatch {label!r} "
                 f"(policy abort{note}; resume with --resume)"
             )
+        if self.policy == "relayout":
+            # chip-scoped elastic recovery: the drain checkpoint is on
+            # disk; hand the dead chip set to the elastic runner
+            # (parallel/elastic.py), which rebuilds the mesh over the
+            # survivors and resumes via checkpoint.restore_relayout.
+            # The survivors are healthy — clear the dead flag so the
+            # re-bound supervisor serves the degraded mesh immediately;
+            # the lost chips stay in _chips_down for re-expansion probes.
+            chips = frozenset(self._chips_down)
+            self._dead = False
+            self._note_up()
+            raise ChipLost(
+                f"chip(s) {sorted(chips) if chips else '?'} lost at "
+                f"dispatch {label!r} (policy relayout; drained to {path}); "
+                f"relayout onto the surviving mesh and resume",
+                chips=chips, path=path,
+            )
         if self.policy == "cpu":
             sim._enter_cpu_failover()
             self.failover = True
@@ -451,6 +627,19 @@ class BackendSupervisor:
             self.counters["failbacks"] += 1
             self._note_up()
 
+    def _sweep_chips(self) -> None:
+        """Probe every mesh chip through the bound MeshHealth prober and
+        mark the non-answering ones down. A no-op without a prober (the
+        deterministic CPU path gets its chip set from kill_chip
+        injections instead)."""
+        mh = self.mesh_health
+        if mh is None:
+            return
+        for chip, up in enumerate(mh.probe_all()):
+            self.counters["probes"] += 1
+            if not up:
+                self.mark_chip_down(chip)
+
     # -- wall bookkeeping --
 
     def _note_down(self) -> None:
@@ -478,7 +667,8 @@ class BackendSupervisor:
 
     def stats(self) -> dict:
         """The `resilience.*` metrics namespace (schema v6; v8 adds the
-        exhaustions / pressure_steps memory-pressure tallies)."""
+        exhaustions / pressure_steps memory-pressure tallies; v12 adds
+        chip_losses — the chip-scoped subset of backend_losses)."""
         d = dict(self.counters)
         d["failover_active"] = int(self.failover)
         return d

@@ -14,6 +14,7 @@ A plan is a JSON document (``--fault-plan plan.json``) or the inline
         {"at": "4 s", "op": "skew_hosts",   "span": [0, 4], "factor": 6},
         {"at": "1 s", "op": "force_spill"},
         {"at": "2 s", "op": "kill_backend", "recover_after": 2},
+        {"at": "2 s", "op": "kill_chip",    "chip": 3, "recover_after": 4},
         {"at": "2 s", "op": "stall_backend", "count": 3},
         {"at": "2 s", "op": "exhaust_backend", "recover_after": 1},
         {"at": "2 s", "op": "saturate_pool", "frac": 0.25},
@@ -84,6 +85,17 @@ seconds). Ops are split by execution plane:
                                (core/pressure.py), modeling an
                                allocation that fits only after the
                                ladder reshaped the working set
+                kill_chip      declare ONE mesh chip dead (chip-scoped
+                               loss, core/supervisor.inject_kill_chip):
+                               under --on-backend-loss relayout the
+                               drain is followed by an elastic relayout
+                               onto the surviving mesh
+                               (parallel/elastic.py); under wait the
+                               probe loop holds until the chip answers.
+                               `chip` = index into the deterministic
+                               mesh device order; `recover_after` = N
+                               failed probes before the simulated chip
+                               answers again (absent = stays down)
   FILE_OPS    executed by whichever plane runs, at the same points:
                 corrupt_file  truncate/flip/delete files matching a glob
                               (checkpoint or spill artifacts) — proves
@@ -110,7 +122,7 @@ DEVICE_OPS = frozenset(
     {"kill_host", "skew_hosts", "force_spill", "saturate_pool"}
 )
 BACKEND_OPS = frozenset(
-    {"kill_backend", "stall_backend", "exhaust_backend"}
+    {"kill_backend", "stall_backend", "exhaust_backend", "kill_chip"}
 )
 FILE_OPS = frozenset({"corrupt_file"})
 ALL_OPS = PROC_OPS | DEVICE_OPS | BACKEND_OPS | FILE_OPS
@@ -128,6 +140,7 @@ _FIELDS = {
     "kill_backend": (set(), {"recover_after"}),
     "stall_backend": (set(), {"count"}),
     "exhaust_backend": (set(), {"recover_after"}),
+    "kill_chip": ({"chip"}, {"recover_after"}),
     "saturate_pool": (set(), {"frac"}),
     "corrupt_file": ({"path"}, {"mode", "dir"}),
 }
@@ -156,6 +169,9 @@ class Fault:
     # saturate_pool: the factor the spill-tier marks scale by (smaller =
     # more severe simulated pressure)
     frac: float = 0.5
+    # kill_chip: the mesh chip index (deterministic device order) to
+    # declare dead
+    chip: Optional[int] = None
     # skew_hosts: the selected hosts (id/name list, or [first, count]
     # span of global host ids) and the rate multiplier
     hosts: Optional[list] = None
@@ -266,6 +282,17 @@ def _parse_entry(i: int, d: dict) -> Fault:
                 f"faults[{i}] (skew_hosts): factor must be >= 2 "
                 f"(1 is a no-op), got {f.factor}"
             )
+    if "chip" in d:
+        if not isinstance(d["chip"], int) or isinstance(d["chip"], bool):
+            raise FaultPlanError(
+                f"faults[{i}] ({op}): chip must be an integer mesh chip "
+                f"index, got {d['chip']!r}"
+            )
+        f.chip = int(d["chip"])
+        if f.chip < 0:
+            raise FaultPlanError(
+                f"faults[{i}] ({op}): chip must be >= 0, got {f.chip}"
+            )
     if "path" in d:
         f.path = str(d["path"])
     if "dir" in d and d["dir"] is not None:
@@ -313,13 +340,18 @@ def parse_fault_plan(entries: list) -> list[Fault]:
     return out
 
 
-def check_backend_ops(faults: list[Fault]) -> list[Fault]:
+def check_backend_ops(faults: list[Fault],
+                      mesh_size: int | None = None) -> list[Fault]:
     """Require every injection to be a BACKEND op (kill_backend /
-    stall_backend / exhaust_backend) or saturate_pool — the classes a
-    daemon-level chaos plan may carry (they target the shared
+    stall_backend / exhaust_backend / kill_chip) or saturate_pool — the
+    classes a daemon-level chaos plan may carry (they target the shared
     accelerator / pressure plane, not one simulated host): proc/device/
     file ops are run-scoped and belong in a job's own config
-    (shadow_tpu/serve validates submissions with this)."""
+    (shadow_tpu/serve validates submissions with this).
+
+    With `mesh_size`, kill_chip targets are additionally bounds-checked
+    against it (a chip index at/past the mesh would declare a chip that
+    does not exist dead — a plan bug, refused up front)."""
     allowed = BACKEND_OPS | {"saturate_pool"}
     for f in faults:
         if f.op not in allowed:
@@ -327,6 +359,12 @@ def check_backend_ops(faults: list[Fault]) -> list[Fault]:
                 f"daemon-level fault plans support backend + pressure "
                 f"ops only ({sorted(allowed)}); {f.op!r} belongs in a "
                 f"job config's faults section"
+            )
+        if (f.op == "kill_chip" and mesh_size is not None
+                and not 0 <= int(f.chip) < int(mesh_size)):
+            raise FaultPlanError(
+                f"kill_chip chip {f.chip} out of range for the "
+                f"{mesh_size}-chip mesh (valid: 0..{int(mesh_size) - 1})"
             )
     return faults
 

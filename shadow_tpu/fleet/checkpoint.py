@@ -132,14 +132,45 @@ def load_manifest(ckpt_dir: str) -> dict:
     return doc
 
 
-def resume_fleet(ckpt_dir: str, lanes: int | None = None, **fleet_kw):
+def _patch_shards(cfg: dict, num_shards: int,
+                  exclude_chips: tuple = ()) -> dict:
+    """Rewrite a job config's partition for a mesh-size-changing resume
+    (elastic shrink/re-expand, parallel/elastic.py): num_shards
+    overridden, dead chips excluded; at 1 the islands keys drop away so
+    the global engine builds (the S→1 endpoint). The slice restore goes
+    through the relayout seam, so the layout change is invisible to the
+    job's results."""
+    import json as _json
+
+    c = _json.loads(_json.dumps(cfg))
+    exp = c.setdefault("experimental", {})
+    if num_shards <= 1:
+        for k in ("num_shards", "exchange_slots", "island_mode",
+                  "mesh_exchange", "placement", "exclude_chips",
+                  "async_spread", "balancer"):
+            exp.pop(k, None)
+        exp["num_shards"] = 1
+    else:
+        exp["num_shards"] = int(num_shards)
+        exp["exclude_chips"] = [int(x) for x in exclude_chips]
+    return c
+
+
+def resume_fleet(ckpt_dir: str, lanes: int | None = None,
+                 num_shards: int | None = None,
+                 exclude_chips: tuple = (), **fleet_kw):
     """Rebuild a FleetSimulation from a fleet checkpoint directory.
 
     Job order in the rebuilt fleet: formerly-running jobs first (their
     lanes restore from the saved slices), then the still-queued jobs;
     completed jobs are carried as terminal records with their recorded
-    results. Slice restores go through core/checkpoint.restore, so a
-    corrupt slice fails with a clean CheckpointError naming the job.
+    results. Slice restores go through core/checkpoint.restore_relayout,
+    so a corrupt slice fails with a clean CheckpointError naming the
+    job — and a slice saved at a DIFFERENT partition (a fleet drained by
+    chip loss, resumed on the shrunk mesh via `num_shards=` /
+    `exclude_chips=`) re-layouts instead of failing: the lane-requeue-
+    on-shrink path of the elastic resilience plane
+    (parallel/elastic.py).
 
     `lanes` overrides the manifest's lane count (the sweep CLI's
     --lanes; None keeps the recorded width); either way the rebuilt
@@ -161,6 +192,9 @@ def resume_fleet(ckpt_dir: str, lanes: int | None = None, **fleet_kw):
             f"nothing to resume"
         )
     specs = [JobSpec.from_json(e["spec"]) for e in unfinished + terminal]
+    if num_shards is not None:
+        for s in specs:
+            s.config = _patch_shards(s.config, num_shards, exclude_chips)
     want = int(doc["lanes"]) if lanes is None else int(lanes)
     lanes = min(want, len(unfinished))
     fleet_kw.setdefault("checkpoint_dir", ckpt_dir)
@@ -184,7 +218,11 @@ def resume_fleet(ckpt_dir: str, lanes: int | None = None, **fleet_kw):
         if rec.lane is None:
             continue  # more running jobs than lanes (shrunk fleet): requeue
         sim = _build_solo(rec.spec)
-        ckpt_mod.restore(sim, os.path.join(ckpt_dir, e["file"]))
+        # relayout-tolerant: a slice saved at another partition (mesh
+        # shrink/re-expand) re-layouts through the same seam checkpoint
+        # resume across mesh sizes uses; same-layout slices fall through
+        # to the strict restore path unchanged
+        ckpt_mod.restore_relayout(sim, os.path.join(ckpt_dir, e["file"]))
         _align_gear(sim, fleet._gear)
         fleet.state = state_mod.set_lane(fleet.state, rec.lane, sim.state)
         fleet.params = state_mod.set_lane(fleet.params, rec.lane, sim.params)

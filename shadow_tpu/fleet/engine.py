@@ -266,6 +266,21 @@ class FleetSimulation:
                 f"fleet jobs disagree on host count ({sim.num_hosts} vs "
                 f"{t.num_hosts}); host topology compiles into the kernel"
             )
+        if self._islands and (
+            sim.num_shards != t.num_shards
+            or getattr(sim, "exclude_chips", ())
+            != getattr(t, "exclude_chips", ())
+        ):
+            raise FleetError(
+                f"fleet jobs disagree on the mesh partition "
+                f"(num_shards {getattr(sim, 'num_shards', 1)} vs "
+                f"{getattr(t, 'num_shards', 1)}, exclude_chips "
+                f"{getattr(sim, 'exclude_chips', ())} vs "
+                f"{getattr(t, 'exclude_chips', ())}); after an elastic "
+                f"relayout every swap-in must be rebuilt for the "
+                f"surviving mesh (fleet/checkpoint.resume_fleet "
+                f"num_shards=)"
+            )
         if self._islands and bool(getattr(sim, "_async", False)) != bool(
             getattr(t, "_async", False)
         ):
@@ -599,6 +614,8 @@ class FleetSimulation:
             sup = self.supervisor
             if f.op == "kill_backend":
                 sup.inject_kill(f.recover_after)
+            elif f.op == "kill_chip":
+                sup.inject_kill_chip(f.chip, f.recover_after)
             elif f.op == "exhaust_backend":
                 sup.inject_exhaust(f.recover_after)
             elif f.op == "saturate_pool":
@@ -649,10 +666,15 @@ class FleetSimulation:
                              ckpt_dir: str | None = None) -> str | None:
         """Backend-loss drain: pause admission, flush every running
         lane's slice + the manifest (fleet/checkpoint.py) with the drain
-        reason, and — under policy `abort` — requeue the in-flight jobs
-        so the scheduler truth matches reality (nothing is running on a
-        dead backend; the saved slices let `sweep --resume` restore their
-        progress instead of re-running them)."""
+        reason, and — under policies `abort` and `relayout` — requeue
+        the in-flight jobs so the scheduler truth matches reality
+        (nothing is running on a dead backend; the saved slices let
+        `sweep --resume` restore their progress instead of re-running
+        them). `relayout` requeues because a fleet cannot reshape its
+        compiled lane × shard program in place: the ChipLost that
+        follows hands the rebuild to the caller, and `resume_fleet
+        (num_shards=...)` restores every lane through the relayout seam
+        on the shrunk mesh — the lane-requeue-on-shrink contract."""
         self._admission_paused = True
         sup = self.supervisor
         policy = sup.policy if sup is not None else "abort"
@@ -667,7 +689,7 @@ class FleetSimulation:
         obs = self.obs_session
         if obs is not None and obs.tracer is not None:
             obs.tracer.fault("drain_checkpoint", reason=reason)
-        if policy == "abort":
+        if policy in ("abort", "relayout"):
             for j in range(self.lanes):
                 if self.sched.lane_job[j] is not None:
                     self.sched.requeue(j, reason="backend drain")
@@ -1569,6 +1591,30 @@ class FleetSimulation:
             "laggard_lane": int(lane),
             "laggard_shard": int(shard),
         }
+
+    def mesh_posture(self) -> dict:
+        """Operator-facing mesh posture for the serve daemon's /healthz
+        and `shadowctl status` (schema v12): chips up/total, the
+        partition shape, the exchange-schedule rebuild count, and —
+        when an elastic runner is attached — the last relayout record.
+        {} for non-islands fleets (no mesh keys on non-mesh runs)."""
+        if not self._islands:
+            return {}
+        t = self.template
+        total = int(t.num_shards) + len(getattr(t, "exclude_chips", ()))
+        p = {
+            "chips_up": int(t.num_shards),
+            "chips_total": total,
+            "shard_map": int(getattr(t, "mode", "") == "shard_map"),
+            "chips_down": sorted(getattr(t, "exclude_chips", ())),
+            "exchange_rebuilds": int(
+                getattr(t, "_exchange_rebuilds", 0)
+            ),
+        }
+        el = getattr(self, "elastic", None)
+        if el is not None:
+            p.update(el.posture())
+        return p
 
     def balance_stats(self) -> dict[str, int] | None:
         """Fleet-side balance plane (schema v10 `balance.*`): the

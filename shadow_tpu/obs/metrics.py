@@ -25,6 +25,11 @@ import numpy as np
 
 from shadow_tpu.obs import counters as obs_counters
 
+# v12: elastic mesh resilience (parallel/elastic.py): mesh.chips_up/
+# chips_total posture gauges, mesh.chips_lost/relayouts/re_expansions/
+# relayout_downtime_ns/kernel_rebuilds/reexpand_holds counters for the
+# drain → relayout → re-expand loop, and resilience.chip_losses (the
+# chip-scoped subset of backend_losses, core/supervisor.py);
 # v11: mesh.* multi-chip namespace (parallel/{mesh,islands}.py: per-chip
 # committed-event balance, neighbor-only frontier-exchange collective
 # volume + partner counts, placement cut-cost gauges, and exchange-
@@ -48,7 +53,7 @@ from shadow_tpu.obs import counters as obs_counters
 # obs/audit.py) + optional per-job `audit` sub-object on fleet.jobs[*]
 # rows; v4: optional top-level `fleet` section (fleet.jobs[*] per-job
 # rows) + fleet.* counters; v3: faults.* recovery counters
-SCHEMA_VERSION = 11
+SCHEMA_VERSION = 12
 DOC_KIND = "shadow_tpu.metrics"
 
 # metrics-doc `fleet.jobs[*]` rows must carry at least these keys
@@ -81,7 +86,8 @@ KNOWN_METRIC_NAMESPACES = frozenset({
     "pressure",    # resource-pressure degradation ladder (schema v8)
     "async",       # asynchronous conservative sync (schema v9)
     "balance",     # self-balancing fleet plane (schema v10)
-    "mesh",        # multi-chip mesh execution plane (schema v11)
+    "mesh",        # multi-chip mesh execution plane (schema v11;
+                   # elastic-resilience rows added in v12)
     "sim",         # build-level gauges (num_hosts, runahead)
     "bench",       # bench.py gate-local rows
 })
@@ -373,7 +379,12 @@ def _snapshot_mesh(sim, reg: MetricsRegistry) -> None:
     """Multi-chip mesh plane (schema v11): per-chip committed-event
     balance, neighbor-only frontier-exchange volume/partners, placement
     cut cost, and exchange-schedule rebuilds, from the islands runner
-    (parallel/islands.py mesh_stats/mesh_gauges; None = single shard)."""
+    (parallel/islands.py mesh_stats/mesh_gauges; None = single shard).
+    Schema v12 adds the elastic-resilience posture from the attached
+    ElasticMeshRunner (parallel/elastic.py): chips up/total gauges and
+    the chip-loss / relayout / re-expansion / downtime counters — these
+    also ride the sim the S→1 endpoint fell back to (the global engine
+    has no mesh_stats, but its elastic hook still reports)."""
     ms = getattr(sim, "mesh_stats", None)
     if ms is not None:
         stats = ms()
@@ -386,6 +397,12 @@ def _snapshot_mesh(sim, reg: MetricsRegistry) -> None:
         if gauges:
             for k, v in gauges.items():
                 reg.gauge_set(f"mesh.{k}", v)
+    el = getattr(sim, "elastic", None)
+    if el is not None:
+        for k, v in el.stats().items():
+            reg.counter_set(f"mesh.{k}", int(v))
+        for k, v in el.gauges().items():
+            reg.gauge_set(f"mesh.{k}", v)
 
 
 def _snapshot_balance(sim, reg: MetricsRegistry) -> None:

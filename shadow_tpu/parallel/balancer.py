@@ -389,6 +389,13 @@ class ShardBalancer:
             "hosts_moved": 0,
         }
 
+    def in_cooldown(self) -> bool:
+        """True while a migration/rollback cooldown is running — the
+        elastic mesh runner's re-expansion interlock (parallel/
+        elastic.py): no elective mesh change while the balancer is
+        settling one of its own."""
+        return self._cooldown > 0
+
     # -- test/bench hook --
 
     def inject_failure_next(self) -> None:

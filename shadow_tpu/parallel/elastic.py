@@ -1,0 +1,408 @@
+"""Elastic mesh resilience: survive chip loss by drain → relayout →
+resume on the surviving mesh, then grow back when the chip returns.
+
+PR 12 made multi-chip execution real (shard_map islands, per-chip state
+pinning, ppermute frontier exchange) but kept the pre-mesh failure
+model: the supervisor (core/supervisor.py) treats ANY backend loss as
+total, so one sick chip in an 8-chip mesh takes down 8 chips' worth of
+simulation — even though `checkpoint.restore_relayout` +
+`islands.globalize_state` already prove bit-exact resume across mesh
+sizes. This module closes that loop. Multi-processor PDES engines treat
+worker count as a deployment knob, not a correctness axis (PARSIR,
+arxiv 2410.00644); here the chip count becomes exactly that.
+
+The state machine, layered over the supervisor's:
+
+    RUNNING ──kill_chip / mesh-collective failure──▶ supervisor drains
+       ▲                                             (drain-* namespace)
+       │                                                   │
+       │                                  policy `relayout`: ChipLost
+       │                                                   ▼
+       │   rebuild over survivors (host_mesh minus the dead chips,
+       │   min-cut placement re-run, ppermute schedule re-derived,
+       │   kernels rebound ONCE) + checkpoint.restore_relayout
+       │                                                   │
+       └────────────── DEGRADED ◀──────────────────────────┘
+                          │ probe lost chips every `probe_every`
+                          │ dispatches; `hysteresis` consecutive
+                          │ successes + cooldown + balancer interlock
+                          ▼
+                     RE-EXPAND: drain ("re_expand") → rebuild at the
+                     next admissible shard count → restore_relayout
+
+Both transitions resume through the SAME relayout seam checkpoint
+resume across mesh sizes uses, so the audit digest chain extends
+exactly — a degraded run, a re-expanded run and an uninterrupted run
+commit the identical event stream (bench.py --mesh-resilience-smoke
+gates it). The S→1 endpoint falls back to the GLOBAL engine: with one
+chip left there is no mesh to shard over, and globalize_state already
+proves that resume chain-identical.
+
+Determinism: the deterministic chaos input is the `kill_chip` fault op
+(faults/plan.py) — fleet-frontier-keyed like every backend op, so the
+loss lands at an exact virtual-time boundary on CPU; probes/hysteresis
+only perturb WALL scheduling (which dispatch boundary the re-expansion
+lands on), never committed events, because every relayout resumes from
+a committed-frontier drain checkpoint.
+
+A SIGKILL at ANY point of a relayout is a non-event: the drain
+checkpoint is on disk before the old mesh is torn down, `resume()`
+rebuilds from the newest ring entry (drain or periodic), and
+`restore_relayout` re-layouts it onto whatever mesh the resuming
+process builds.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from shadow_tpu.core import checkpoint as ckpt_mod
+from shadow_tpu.core.supervisor import BackendSupervisor, ChipLost
+
+
+class MeshReexpand(Exception):
+    """Control-flow signal raised by ElasticMeshRunner.on_dispatch at a
+    committed dispatch boundary: lost chips answered probes for the
+    hysteresis streak, so the runner should drain and relayout back up.
+    Never escapes ElasticMeshRunner.run."""
+
+    def __init__(self, chips: frozenset[int]):
+        super().__init__(f"re-expand onto recovered chip(s) {sorted(chips)}")
+        self.chips = frozenset(chips)
+
+
+def admissible_shards(num_hosts: int, max_shards: int) -> int:
+    """The largest shard count <= max_shards that divides num_hosts —
+    the islands layout pads nothing (mesh.host_mesh), so a 7-survivor
+    mesh can only run 7 shards if H divides by 7; otherwise the run
+    degrades further (and at 1 falls back to the global engine)."""
+    for s in range(min(int(max_shards), int(num_hosts)), 1, -1):
+        if num_hosts % s == 0:
+            return s
+    return 1
+
+
+class ElasticMeshRunner:
+    """Drives a (possibly multi-chip) simulation through chip loss and
+    recovery: drain → relayout onto the surviving mesh → resume →
+    re-expand when the chip answers probes again.
+
+    `build_fn(num_shards, exclude_chips)` must return a FRESH sim built
+    from the same config apart from the partition: an IslandSimulation
+    at `num_shards` > 1 (with `exclude_chips` skipped from the device
+    mesh under shard_map) or the global engine at 1 — `config_builder`
+    builds one from a config dict. The runner owns the supervisor (one
+    instance across every rebuild, so loss counters and the dead-chip
+    probe state survive relayouts) and the checkpoint ring config.
+
+    Interlocks, per re-expansion decision (a relayout is never elective
+    — loss always relayouts — but growing back is):
+      * hysteresis: every lost chip must answer `hysteresis` CONSECUTIVE
+        probes — a flapping chip resets its streak on every miss, so it
+        can never drive a relayout storm;
+      * cooldown: at least `cooldown` dispatches since the last mesh
+        change;
+      * balancer: an armed shard balancer in rollback cooldown, or a
+        degraded/pressured supervisor posture, holds the re-expansion
+        (the same yield rule the balancer itself follows).
+    """
+
+    def __init__(self, build_fn, *, chips: int, ckpt_dir: str,
+                 checkpoint_every_ns: int = 0, retain: int = 3,
+                 supervisor: BackendSupervisor | None = None,
+                 probe_every: int = 2, hysteresis: int = 3,
+                 cooldown: int = 4, faults=None,
+                 windows_per_dispatch: int = 64, clock=time.monotonic):
+        if not ckpt_dir:
+            raise ValueError(
+                "elastic relayout needs a checkpoint directory: the "
+                "drain checkpoint IS the relayout seam"
+            )
+        self._build_fn = build_fn
+        self.chips_total = int(chips)
+        self.ckpt_dir = str(ckpt_dir)
+        self.checkpoint_every_ns = int(checkpoint_every_ns)
+        self.retain = int(retain)
+        self.supervisor = supervisor or BackendSupervisor("relayout")
+        if self.supervisor.policy != "relayout":
+            raise ValueError(
+                f"ElasticMeshRunner needs a policy-`relayout` supervisor "
+                f"(got {self.supervisor.policy!r}); wait/cpu/abort runs "
+                f"attach theirs directly to the sim"
+            )
+        self.probe_every = max(1, int(probe_every))
+        self.hysteresis = max(1, int(hysteresis))
+        self.cooldown = max(0, int(cooldown))
+        self._faults = list(faults) if faults else None
+        self.windows_per_dispatch = int(windows_per_dispatch)
+        self._clock = clock
+        self.sim = None
+        self.down: set[int] = set()
+        self._streak: dict[int, int] = {}  # chip -> consecutive probe oks
+        self._since_probe = 0
+        self._since_change = 0
+        self.counters = {
+            "chips_lost": 0,
+            "relayouts": 0,
+            "re_expansions": 0,
+            "relayout_downtime_ns": 0,
+            "kernel_rebuilds": 0,  # one fresh kernel set per mesh change
+            "reexpand_holds": 0,
+        }
+        self.last_relayout: dict | None = None
+
+    # -- building + bookkeeping ------------------------------------------
+
+    @property
+    def chips_up(self) -> int:
+        return self.chips_total - len(self.down)
+
+    def _target_shards(self) -> int:
+        H = self.sim.num_hosts if self.sim is not None else None
+        if H is None:
+            raise RuntimeError("no sim built yet")
+        return admissible_shards(H, self.chips_up)
+
+    def _attach(self, sim):
+        """Wire the shared supervisor / fault plan / checkpoint ring /
+        dispatch hook into a freshly-built sim."""
+        sim.attach_supervisor(self.supervisor)
+        if self._faults is not None:
+            # ONE injector across rebuilds: fired marks persist, so a
+            # kill_chip that already fired can never re-drain the
+            # relayouted run (mirrors engine.resume_from's replay rule)
+            if getattr(self, "_injector", None) is None:
+                sim.attach_faults(self._faults)
+                self._injector = sim.fault_injector
+            else:
+                sim.fault_injector = self._injector
+        sim.configure_auto_checkpoint(
+            self.ckpt_dir, self.checkpoint_every_ns, self.retain
+        )
+        sim.elastic = self
+        self.sim = sim
+        return sim
+
+    def build(self, num_shards: int | None = None):
+        """Build (or rebuild) the sim for the current chip posture."""
+        if num_shards is None:
+            # initial build: the caller's chip budget (host_mesh checks
+            # divisibility); relayouts derive from the live host count
+            num_shards = (
+                self._target_shards() if self.sim is not None
+                else self.chips_up
+            )
+        sim = self._build_fn(int(num_shards), tuple(sorted(self.down)))
+        self.counters["kernel_rebuilds"] += 1
+        return self._attach(sim)
+
+    def stats(self) -> dict[str, int]:
+        """Counters for the metrics `mesh.*` namespace (schema v12)."""
+        return {k: int(v) for k, v in self.counters.items()}
+
+    def gauges(self) -> dict:
+        g = {
+            "chips_up": int(self.chips_up),
+            "chips_total": int(self.chips_total),
+        }
+        if self.last_relayout is not None:
+            g["last_relayout_ns"] = int(
+                self.last_relayout.get("frontier_ns", -1)
+            )
+        return g
+
+    def posture(self) -> dict:
+        """Operator-facing mesh posture (serve /healthz, shadowctl
+        status): chips up/total, dead set, last relayout record."""
+        return {
+            "chips_up": int(self.chips_up),
+            "chips_total": int(self.chips_total),
+            "chips_down": sorted(self.down),
+            "relayouts": int(self.counters["relayouts"]),
+            "re_expansions": int(self.counters["re_expansions"]),
+            "last_relayout": dict(self.last_relayout or {}),
+        }
+
+    # -- the dispatch-boundary hook (re-expansion probing) ---------------
+
+    def on_dispatch(self, sim, mn: int) -> None:
+        """Called by the driver at every committed dispatch boundary.
+        Probes lost chips on the `probe_every` cadence; when every lost
+        chip has held `hysteresis` consecutive probe successes AND the
+        interlocks clear, raises MeshReexpand (caught by run(), which
+        drains and rebuilds). Cheap no-op while nothing is down."""
+        self._since_change += 1
+        if not self.down:
+            return
+        self._since_probe += 1
+        if self._since_probe < self.probe_every:
+            return
+        self._since_probe = 0
+        recovered = set()
+        for chip in sorted(self.down):
+            if self.supervisor.probe_chip(chip):
+                self._streak[chip] = self._streak.get(chip, 0) + 1
+            else:
+                self._streak[chip] = 0  # flap: the streak restarts
+            if self._streak.get(chip, 0) >= self.hysteresis:
+                recovered.add(chip)
+        if not recovered:
+            return
+        if self._since_change < self.cooldown:
+            self.counters["reexpand_holds"] += 1
+            return
+        bal = getattr(sim, "balancer", None)
+        if bal is not None and getattr(bal, "in_cooldown", lambda: False)():
+            # the balancer just rolled a migration back (or is mid-heal):
+            # no elective mesh change while it cools down
+            self.counters["reexpand_holds"] += 1
+            return
+        if self.supervisor.degraded:
+            self.counters["reexpand_holds"] += 1
+            return
+        raise MeshReexpand(frozenset(recovered))
+
+    # -- the elastic run loop --------------------------------------------
+
+    def run(self, until: int | None = None) -> object:
+        """Run to completion through any number of chip losses and
+        recoveries; returns the final sim (audit chain, counters and
+        metrics snapshots read from it)."""
+        if self.sim is None:
+            self.build(num_shards=None)
+        while True:
+            try:
+                self.sim.run(
+                    until=until,
+                    windows_per_dispatch=self.windows_per_dispatch,
+                )
+                return self.sim
+            except ChipLost as e:
+                self._relayout_down(e)
+            except MeshReexpand as e:
+                self._relayout_up(e)
+
+    def resume(self) -> None:
+        """Crash recovery: rebuild for the current chip posture and
+        restore the newest ring checkpoint (drain or periodic) through
+        the relayout seam — the SIGKILL-mid-relayout path."""
+        entries = ckpt_mod.ring_entries(self.ckpt_dir)
+        if not entries:
+            raise ckpt_mod.CheckpointError(
+                f"{self.ckpt_dir}: nothing to resume from"
+            )
+        sim = self.build(num_shards=None)
+        ckpt_mod.restore_relayout(sim, entries[-1][2])
+        self._mark_replayed(sim)
+
+    def _mark_replayed(self, sim) -> None:
+        """Backend injections at or before the restored frontier already
+        happened (engine.resume_from's rule, applied on the relayout
+        path where restore_relayout cannot know about the injector)."""
+        inj = getattr(sim, "fault_injector", None)
+        if inj is None:
+            return
+        from shadow_tpu.faults import plan as plan_mod
+
+        now = int(np.max(np.asarray(sim.state.now)))
+        for f in inj.faults:
+            if (not f.fired and f.op in plan_mod.BACKEND_OPS
+                    and f.at_ns <= now):
+                inj.mark_fired(f)
+
+    def _relayout_down(self, e: ChipLost) -> None:
+        """Chip loss: adopt the dead set, rebuild over the survivors,
+        resume from the drain checkpoint the supervisor just wrote."""
+        t0 = self._clock()
+        if not e.chips:
+            # no chip attribution (no injection, no MeshHealth): a
+            # whole-backend loss cannot relayout around anything
+            raise e
+        self.counters["chips_lost"] += len(e.chips - self.down)
+        self.down |= set(e.chips)
+        for c in e.chips:
+            self._streak[c] = 0
+        if self.chips_up < 1:
+            raise e  # every chip gone: nothing to relayout onto
+        path = e.path
+        if path is None:
+            raise e  # no drain checkpoint: nothing to resume from
+        old_s = getattr(self.sim, "num_shards", 1)
+        new_s = self._target_shards()
+        sim = self.build(new_s)
+        ckpt_mod.restore_relayout(sim, path)
+        self._mark_replayed(sim)
+        self.counters["relayouts"] += 1
+        self._since_change = 0
+        dt = int((self._clock() - t0) * 1e9)
+        self.counters["relayout_downtime_ns"] += dt
+        self.last_relayout = {
+            "reason": f"chip_lost:{sorted(e.chips)}",
+            "from_shards": int(old_s), "to_shards": int(new_s),
+            "frontier_ns": int(np.max(np.asarray(sim.state.now))),
+            "wall_unix_s": time.time(),
+            "downtime_ns": dt,
+        }
+
+    def _relayout_up(self, e: MeshReexpand) -> None:
+        """Recovery: drain at the committed boundary, rebuild at the
+        larger admissible shard count, resume through the same seam."""
+        t0 = self._clock()
+        path = self.sim._drain_to_checkpoint(
+            f"re_expand:{sorted(e.chips)}"
+        )
+        if path is None:  # pragma: no cover — __init__ requires ckpt_dir
+            raise RuntimeError(
+                "re-expansion needs a checkpoint directory for the "
+                "drain → relayout seam"
+            )
+        self.down -= set(e.chips)
+        for c in e.chips:
+            self._streak.pop(c, None)
+        old_s = getattr(self.sim, "num_shards", 1)
+        new_s = self._target_shards()
+        sim = self.build(new_s)
+        ckpt_mod.restore_relayout(sim, path)
+        self._mark_replayed(sim)
+        self.counters["re_expansions"] += 1
+        self._since_change = 0
+        dt = int((self._clock() - t0) * 1e9)
+        self.counters["relayout_downtime_ns"] += dt
+        self.last_relayout = {
+            "reason": f"re_expand:{sorted(e.chips)}",
+            "from_shards": int(old_s), "to_shards": int(new_s),
+            "frontier_ns": int(np.max(np.asarray(sim.state.now))),
+            "wall_unix_s": time.time(),
+            "downtime_ns": dt,
+        }
+
+
+def config_builder(cfg: dict):
+    """A `build_fn` over a config DICT (the build_simulation input):
+    rebuilds with experimental.num_shards / exclude_chips overridden per
+    relayout. At num_shards == 1 the islands keys drop away and the
+    global engine builds — the S→1 endpoint. The copy is deep via JSON
+    round-trip: configs are plain JSON/YAML data by construction."""
+    import json
+
+    base = json.loads(json.dumps(cfg))
+
+    def build(num_shards: int, exclude_chips: tuple):
+        from shadow_tpu.sim import build_simulation
+
+        c = json.loads(json.dumps(base))
+        exp = c.setdefault("experimental", {})
+        if num_shards <= 1:
+            for k in ("num_shards", "exchange_slots", "island_mode",
+                      "mesh_exchange", "placement", "exclude_chips",
+                      "async_spread", "balancer"):
+                exp.pop(k, None)
+            exp["num_shards"] = 1
+        else:
+            exp["num_shards"] = int(num_shards)
+            exp["exclude_chips"] = [int(c_) for c_ in exclude_chips]
+        return build_simulation(c)
+
+    return build

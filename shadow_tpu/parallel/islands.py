@@ -605,7 +605,7 @@ class IslandSimulation(Simulation):
                  async_sync: bool = True, async_spread: int = 0,
                  balancer: bool = False, balancer_policy=None,
                  exchange: str = "ppermute", placement: str = "block",
-                 **kw):
+                 exclude_chips: tuple = (), **kw):
         if mode not in ("vmap", "shard_map"):
             raise ValueError(f"unknown islands mode {mode!r}")
         if exchange not in ("ppermute", "all_gather"):
@@ -616,6 +616,7 @@ class IslandSimulation(Simulation):
         self.mode = mode
         self._exchange = exchange
         self.placement = placement
+        self.exclude_chips = tuple(int(c) for c in exclude_chips)
         if placement == "min_cut":
             # the placement permutes host→slot at build time through the
             # same seam a live rebalance uses, so it needs the slot_of
@@ -823,8 +824,12 @@ class IslandSimulation(Simulation):
 
             # deterministically-ordered device mesh (parallel/mesh.py:
             # one axis, S chips) — the same construction every process
-            # of a multi-host run resolves to
-            mesh = mesh_mod.host_mesh(S, axis=AXIS)
+            # of a multi-host run resolves to. `exclude_chips` names
+            # dead devices the surviving-mesh rebuild must skip
+            # (elastic resilience, parallel/elastic.py).
+            mesh = mesh_mod.host_mesh(
+                S, axis=AXIS, exclude=tuple(exclude_chips)
+            )
             self.mesh = mesh
             # jax >= 0.7 exposes jax.shard_map with the varying-manual-axes
             # checker (check_vma); earlier releases ship the experimental
@@ -1659,6 +1664,11 @@ class IslandSimulation(Simulation):
                     shifted = True
             if mn >= stop and spill.min_time >= stop and not press:
                 break
+            if self.elastic is not None:
+                # elastic re-expansion probe (parallel/elastic.py): may
+                # raise MeshReexpand at this committed boundary — the
+                # runner drains and relayouts onto the recovered mesh
+                self.elastic.on_dispatch(self, mn)
             fr_min = int(ainfo[0].min()) if ainfo is not None else None
             cur = (mn, spill.count, press, fr_min)
             if cur == last and mn >= stop_at and not shifted:

@@ -16,7 +16,8 @@ AXIS = "hosts"
 
 
 def host_mesh(n_devices: int | None = None, axis: str = AXIS,
-              num_hosts: int | None = None) -> Mesh:
+              num_hosts: int | None = None,
+              exclude: tuple[int, ...] = ()) -> Mesh:
     """A 1-D mesh over the first n devices (all by default).
 
     Device order is DETERMINISTIC — sorted by (process_index, id) — so
@@ -24,6 +25,12 @@ def host_mesh(n_devices: int | None = None, axis: str = AXIS,
     resolves the identical chip <-> shard binding; jax.devices() order is
     already id-sorted on a single process, but that is an implementation
     detail this function refuses to depend on.
+
+    `exclude` names dead chips by index INTO THAT DETERMINISTIC ORDER
+    (the elastic resilience plane's surviving-mesh rebuild,
+    parallel/elastic.py): excluded devices are skipped before the first-n
+    selection, so a mesh of n survivors is built around the holes and
+    every process resolves the identical degraded binding.
 
     `num_hosts` (when given) must divide evenly over the mesh: the
     islands layout holds exactly H/S host rows per chip and PADS NOTHING
@@ -36,6 +43,15 @@ def host_mesh(n_devices: int | None = None, axis: str = AXIS,
     to mask.
     """
     devs = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
+    if exclude:
+        dead = {int(c) for c in exclude}
+        bad = sorted(c for c in dead if not 0 <= c < len(devs))
+        if bad:
+            raise ValueError(
+                f"exclude names chip indices {bad} outside the "
+                f"{len(devs)}-device set"
+            )
+        devs = [d for i, d in enumerate(devs) if i not in dead]
     if n_devices is not None:
         if n_devices < 1:
             raise ValueError(f"need a positive mesh size, got {n_devices}")
@@ -128,6 +144,48 @@ def shard_island_state(state, mesh: Mesh):
         return jax.device_put(x, NamedSharding(mesh, P(axis)))
 
     return jax.tree.map(row, state)
+
+
+class MeshHealth:
+    """Per-chip liveness probing — the supervisor's probe signal
+    (core/supervisor.probe_backend, the cs/0409032 bounded-lag check)
+    run PER DEVICE instead of against the default backend, so one sick
+    chip in an 8-chip mesh reads as one dead chip, not a dead mesh.
+
+    Chips are addressed by index into the deterministic
+    (process_index, id) device order `host_mesh` uses, so a probe
+    verdict and a mesh slot always name the same silicon. `probe_fn`
+    is injectable for tests: it receives the device and returns
+    truthiness (the default dispatches one trivial op pinned to the
+    device and blocks on it)."""
+
+    def __init__(self, n_devices: int | None = None, probe_fn=None):
+        devs = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
+        if n_devices is not None:
+            devs = devs[: int(n_devices)]
+        self.devices = list(devs)
+        self._probe_fn = probe_fn or self._default_probe
+
+    @staticmethod
+    def _default_probe(dev) -> bool:
+        try:
+            jax.device_put(
+                jax.numpy.zeros((), jax.numpy.int32), dev
+            ).block_until_ready()
+            return True
+        except Exception:
+            return False
+
+    def probe_chip(self, chip: int) -> bool:
+        """One liveness probe against chip `chip`; False for an index
+        outside the known device set (a chip that fell off the bus)."""
+        if not 0 <= int(chip) < len(self.devices):
+            return False
+        return bool(self._probe_fn(self.devices[int(chip)]))
+
+    def probe_all(self) -> list[bool]:
+        """The up/down mask over every chip, probe order = mesh order."""
+        return [self.probe_chip(i) for i in range(len(self.devices))]
 
 
 def shard_sim(sim, mesh: Mesh):

@@ -132,6 +132,10 @@ class ShadowDaemon:
         # (frontier spread, laggard shard) for /healthz and shadowctl
         # status; BALANCE journal records mirror the PRESSURE pattern
         self._last_balance: dict = {}
+        # elastic mesh posture (schema v12): chips up/total + last
+        # relayout from the running fleet, for /healthz + /metricz and
+        # the surviving-chip admission budget scale
+        self._last_mesh: dict = {}
         self._last_async: dict = {}
         self._journaled_balance: dict[str, int] = {}
         # replay: fold the journal into scheduler-plane truth
@@ -166,13 +170,28 @@ class ShadowDaemon:
         depth = len(self._queue) + (1 if self._running else 0)
         return max(1, int(round(depth * self._avg_sweep_wall_s)))
 
-    def _memory_view(self) -> dict:
-        """The /healthz memory-headroom gauges (docs/serving.md): device
-        budget, the running sweep's preflight estimate, and live
-        headroom (nulls when the backend reports no limit)."""
+    def _effective_budget(self):
+        """The admission memory budget, scaled to the SURVIVING mesh
+        (schema v12): a fleet degraded to 7 of 8 chips holds 7 chips'
+        HBM, so admission must not fill the dead chip's share — budget ×
+        chips_up / chips_total whenever the mesh posture reports a
+        loss. None when the backend reports no limit."""
         from shadow_tpu.core import pressure as pressure_mod
 
         budget = pressure_mod.device_memory_budget()
+        m = self._last_mesh
+        if (budget is not None and m
+                and int(m.get("chips_total", 0)) > 0):
+            budget = (budget * int(m.get("chips_up", 0))
+                      ) // int(m["chips_total"])
+        return budget
+
+    def _memory_view(self) -> dict:
+        """The /healthz memory-headroom gauges (docs/serving.md): device
+        budget (scaled to the surviving mesh), the running sweep's
+        preflight estimate, and live headroom (nulls when the backend
+        reports no limit)."""
+        budget = self._effective_budget()
         return {
             "budget_bytes": budget,
             "estimated_running_bytes": int(self._running_est_bytes),
@@ -232,14 +251,25 @@ class ShadowDaemon:
         if backend_faults:
             from shadow_tpu.faults import plan as plan_mod
 
-            plan_mod.check_backend_ops(
-                plan_mod.parse_fault_plan(backend_faults)
-            )
+            # kill_chip targets bounds-check against the sweep's own
+            # mesh size (experimental.num_shards; None = no mesh, any
+            # kill_chip is then refused by the range check at size 0).
+            # A bad plan is a CLIENT error: fold it into ServeError so
+            # the HTTP layer answers 400 instead of the handler thread
+            # dying connection-open (pre-elastic the same escape killed
+            # the thread on any malformed backend_faults list).
+            exp = (jobs[0].config.get("experimental") or {})
+            mesh_size = int(exp.get("num_shards", 1) or 1)
+            try:
+                plan_mod.check_backend_ops(
+                    plan_mod.parse_fault_plan(backend_faults),
+                    mesh_size=mesh_size if mesh_size > 1 else None,
+                )
+            except plan_mod.FaultPlanError as e:
+                raise ServeError(f"backend_faults: {e}") from e
         # memory-aware admission (docs/serving.md): preflight the sweep's
         # HBM footprint against the live headroom — a sweep the device
         # cannot place sheds NOW with a 429, instead of OOMing mid-run
-        from shadow_tpu.core import pressure as pressure_mod
-
         lanes = self.opts.lanes or (
             int(sweep_opts["lanes"]) if sweep_opts.get("lanes") else None
         )
@@ -247,7 +277,7 @@ class ShadowDaemon:
             est_bytes = self._estimate_sweep_bytes(jobs, lanes)
         except (ValueError, OSError):
             est_bytes = 0  # advisory: a truly bad config failed above
-        budget = pressure_mod.device_memory_budget()
+        budget = self._effective_budget()
         with self._lock:
             if budget is not None \
                     and est_bytes > budget - self._running_est_bytes:
@@ -315,6 +345,7 @@ class ShadowDaemon:
                 "pressure": dict(self._last_pressure),
                 "balance": dict(self._last_balance),
                 "async": dict(self._last_async),
+                "mesh": dict(self._last_mesh),
                 "retry_after_s": self.retry_after_s(),
             }
 
@@ -362,6 +393,14 @@ class ShadowDaemon:
                 )
             for k, v in self._last_pressure.items():
                 reg.counter_set(f"pressure.{k}", int(v))
+            # mesh plane (schema v12): chips up/total + elastic
+            # relayout posture of the running fleet
+            for k, v in self._last_mesh.items():
+                if k in ("chips_up", "chips_total", "shard_map"):
+                    reg.gauge_set(f"mesh.{k}", int(v))
+                elif k in ("exchange_rebuilds", "relayouts",
+                           "re_expansions"):
+                    reg.counter_set(f"mesh.{k}", int(v))
             # balance plane (schema v10): the running fleet's packing +
             # steal tallies ("packing" is a string — gauge-encoded)
             for k, v in self._last_balance.items():
@@ -467,6 +506,7 @@ class ShadowDaemon:
                 "packing": fleet.sched.packing, **bst,
             }
             self._last_async = fleet.async_posture()
+            self._last_mesh = fleet.mesh_posture()
             # journal each new batch of ladder rungs: a post-mortem can
             # see WHEN the sweep started degrading even if we die next
             steps = int(pst.get("ladder_steps", 0))

@@ -247,6 +247,7 @@ def build_simulation(source) -> Simulation:
             async_spread=cfg.experimental.async_spread,
             exchange=cfg.experimental.mesh_exchange,
             placement=cfg.experimental.placement,
+            exclude_chips=cfg.experimental.exclude_chips,
             # matrix-capable sims pin the matrix path: under vmap a
             # lax.cond with a batched predicate executes BOTH branches
             force_path="matrix" if matrix_handlers else None,

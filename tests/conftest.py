@@ -56,6 +56,9 @@ _BUDGET_TIER = {
     # shard_map cells compile more than the vmap tiers but the chain
     # matrix + relayout resume must land before the tier-4 tail
     "test_mesh": 3,
+    # the elastic-resilience acceptance gate (ISSUE 13): same rule —
+    # the kill_chip chaos matrix must land before the tier-4 tail
+    "test_mesh_resilience": 3,
     # minutes: multi-engine parity matrices / many-shape compiles
     "test_gearbox": 4, "test_islands": 4, "test_rebalance": 4,
     "test_sharding": 4, "test_tcp": 4, "test_fleet": 4, "test_tgen": 5,

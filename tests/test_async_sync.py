@@ -420,7 +420,7 @@ def test_async_metrics_schema_v9(tmp_path):
     session.finalize(sim)
     doc = session.metrics.dump(str(tmp_path / "m.json"))
     obs_metrics.validate_metrics_doc(doc, strict_namespaces=True)
-    assert doc["schema_version"] == 11
+    assert doc["schema_version"] == 12
     assert doc["counters"]["async.supersteps"] > 0
     assert doc["counters"]["async.shard_windows"] > 0
     assert "async.frontier_spread_max_ns" in doc["gauges"]

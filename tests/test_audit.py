@@ -427,7 +427,7 @@ def test_sweep_cli_metrics_trace_and_digest_parity(tmp_path, capsys):
 
     doc = json.loads(m_out.read_text())
     obs_metrics.validate_metrics_doc(doc)
-    assert doc["schema_version"] == 11
+    assert doc["schema_version"] == 12
     rows = doc["fleet"]["jobs"]
     assert len(rows) == 3 and all(r["status"] == "done" for r in rows)
     for row, seed in zip(rows, seeds):

@@ -389,7 +389,7 @@ def test_balance_metrics_schema_v10(tmp_path, control):
     session.finalize(sim)
     doc = session.metrics.dump(str(tmp_path / "m.json"))
     obs_metrics.validate_metrics_doc(doc, strict_namespaces=True)
-    assert doc["schema_version"] == 11
+    assert doc["schema_version"] == 12
     assert doc["counters"]["balance.migrations"] >= 1
     assert doc["counters"]["balance.rebalances"] >= 1
     assert "balance.state" in doc["gauges"]

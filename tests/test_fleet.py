@@ -385,7 +385,7 @@ def test_metrics_schema_v5_fleet_section():
     obs_metrics.snapshot_fleet(fleet, reg)
     doc = reg.to_doc()
     obs_metrics.validate_metrics_doc(doc)
-    assert doc["schema_version"] == 11
+    assert doc["schema_version"] == 12
     rows = doc["fleet"]["jobs"]
     assert len(rows) == 2
     assert all(r["status"] == "done" for r in rows)

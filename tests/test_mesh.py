@@ -371,7 +371,7 @@ def test_mesh_metrics_v11(tmp_path):
     session = obs_metrics.ObsSession()
     session.finalize(sim)
     doc = session.metrics.dump(os.path.join(tmp_path, "m.json"))
-    assert doc["schema_version"] == 11
+    assert doc["schema_version"] == 12
     obs_metrics.validate_metrics_doc(doc, strict_namespaces=True)
     assert doc["counters"]["mesh.frontier_exchange_bytes"] > 0
     assert doc["counters"]["mesh.exchange_rebuilds"] == 0

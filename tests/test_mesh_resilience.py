@@ -1,0 +1,561 @@
+"""Elastic mesh resilience (ISSUE 13): survive chip loss by drain →
+relayout → resume on the surviving mesh, with health probing and
+re-expansion (parallel/elastic.py, core/supervisor.py policy
+`relayout`).
+
+The acceptance surface: kill_chip × {async mesh, fleet-on-mesh} ×
+{relayout, wait + re-expand, abort} all chain-identical to
+uninterrupted runs; SIGKILL during a relayout resumes cleanly from the
+drain checkpoint; flapping-chip hysteresis holds (no relayout storm);
+the shrink-to-1 arm resumes on the GLOBAL engine; drain checkpoints
+live in their own `drain-*` ring namespace (the periodic ring never
+rotates for them); metrics schema v12 validated and absent on non-mesh
+runs. Chips here are vmap-virtual (relayout is a partition property,
+not a device property — test_mesh.py and --mesh-resilience-smoke cover
+shard_map); probes and sleeps are instantaneous injections, so only
+wall scheduling is perturbed — which is exactly the property under
+test."""
+
+import os
+
+import numpy as np
+import pytest
+
+from shadow_tpu.core import checkpoint as ckpt_mod
+from shadow_tpu.core.supervisor import BackendLost, BackendSupervisor, ChipLost
+from shadow_tpu.faults import plan as plan_mod
+from shadow_tpu.parallel import elastic as elastic_mod
+from shadow_tpu.parallel.islands import IslandSimulation
+from shadow_tpu.sim import build_simulation
+
+pytestmark = pytest.mark.quick
+
+
+def _cfg(n=12, shards=4, stop=3, seed=11):
+    hosts = {
+        f"h{v:02d}": {
+            "quantity": 1, "app_model": "phold",
+            "app_options": {"msgload": 1, "runtime": stop - 1},
+        }
+        for v in range(n)
+    }
+    c = {
+        "general": {"stop_time": stop, "seed": seed},
+        "network": {"graph": {"type": "1_gbit_switch"}},
+        "experimental": {"event_capacity": 1024,
+                         "events_per_host_per_window": 8},
+        "hosts": hosts,
+    }
+    if shards > 1:
+        c["experimental"].update(
+            {"num_shards": shards, "exchange_slots": 16}
+        )
+    return c
+
+
+def _quiet_sup(policy, **kw):
+    kw.setdefault("sleep", lambda s: None)
+    kw.setdefault("probe_budget_s", 30.0)
+    return BackendSupervisor(policy, **kw)
+
+
+def _runner(base, td, *, faults, chips=4, **kw):
+    kw.setdefault("supervisor", _quiet_sup("relayout"))
+    kw.setdefault("probe_every", 1)
+    kw.setdefault("hysteresis", 2)
+    kw.setdefault("cooldown", 1)
+    kw.setdefault("windows_per_dispatch", 8)
+    return elastic_mod.ElasticMeshRunner(
+        elastic_mod.config_builder(base), chips=chips, ckpt_dir=str(td),
+        faults=plan_mod.parse_fault_plan(faults), **kw,
+    )
+
+
+_BASE = _cfg()
+
+
+@pytest.fixture(scope="module")
+def baseline():
+    sim = build_simulation(_BASE)
+    sim.run()
+    return sim.audit_chain(), sim.counters()["events_committed"]
+
+
+# ---------------------------------------------------------------------------
+# chaos matrix: kill_chip × async mesh × {relayout, wait+re-expand, abort}
+# ---------------------------------------------------------------------------
+
+
+def test_kill_chip_relayout_degraded_finish(baseline, tmp_path):
+    """Chip stays down: drain → relayout 4→3 → finish degraded, chain
+    and committed events bit-identical to the uninterrupted run."""
+    chain, events = baseline
+    r = _runner(_BASE, tmp_path, faults=[
+        {"at": "1 s", "op": "kill_chip", "chip": 2}  # never recovers
+    ])
+    sim = r.run()
+    assert sim.audit_chain() == chain
+    assert sim.counters()["events_committed"] == events
+    assert isinstance(sim, IslandSimulation) and sim.num_shards == 3
+    assert r.counters["relayouts"] == 1
+    assert r.counters["re_expansions"] == 0
+    assert r.chips_up == 3
+    assert r.supervisor.counters["chip_losses"] == 1
+
+
+def test_kill_chip_relayout_then_reexpand(baseline, tmp_path):
+    """The chip answers probes again: drain → relayout 4→3 → probe
+    hysteresis → re-expand 3→4 at a dispatch boundary — chain identical,
+    one counted kernel rebuild per mesh change. A multi-tier gear
+    ladder rides along: each relayout restores an S_old-width
+    `gear_levels` header onto an S_new build, so the ShardGearShifter
+    re-seeds flat across the resize (gearbox.restore's width rule) —
+    still chain-exact."""
+    chain, events = baseline
+    base = dict(_BASE, experimental={
+        **_BASE["experimental"], "pool_gears": 2,
+    })
+    r = _runner(base, tmp_path, faults=[
+        {"at": "1 s", "op": "kill_chip", "chip": 2, "recover_after": 2}
+    ])
+    sim = r.run()
+    assert sim.audit_chain() == chain
+    assert sim.counters()["events_committed"] == events
+    assert sim.num_shards == 4 and r.chips_up == 4
+    assert r.counters["relayouts"] == 1
+    assert r.counters["re_expansions"] == 1
+    # exactly one fresh kernel set per mesh change (+ the initial build)
+    assert r.counters["kernel_rebuilds"] == 3
+    assert r.last_relayout["reason"].startswith("re_expand:")
+    # the per-shard shifter really did rebuild at the new width
+    assert sim._shard_shifter is not None
+    assert len(sim._shard_shifter.levels) == 4
+
+
+def test_kill_chip_wait_hot_resume(baseline):
+    """Policy `wait` control arm: the whole mesh holds until the chip
+    answers, then hot-resumes in place — no relayout, chain identical."""
+    chain, events = baseline
+    sim = build_simulation(_BASE)
+    sup = _quiet_sup("wait")
+    sim.attach_supervisor(sup)
+    sim.attach_faults(plan_mod.parse_fault_plan(
+        [{"at": "1 s", "op": "kill_chip", "chip": 1, "recover_after": 2}]
+    ))
+    sim.run(windows_per_dispatch=8)
+    assert sim.audit_chain() == chain
+    assert sim.counters()["events_committed"] == events
+    assert sup.counters["hot_resumes"] == 1
+    assert sup.counters["chip_losses"] == 1
+    assert not sup.chips_down
+
+
+def test_kill_chip_abort_drains_then_resumes(baseline, tmp_path):
+    """Policy `abort`: the drain lands in the drain-* namespace, the
+    raise is resumable, and resume_from (which walks BOTH ring
+    namespaces) finishes bit-identically."""
+    chain, events = baseline
+    sim = build_simulation(_BASE)
+    sim.checkpoint_dir = str(tmp_path)
+    sim.attach_supervisor(_quiet_sup("abort"))
+    sim.attach_faults(plan_mod.parse_fault_plan(
+        [{"at": "1 s", "op": "kill_chip", "chip": 0}]
+    ))
+    with pytest.raises(BackendLost, match="drained to"):
+        sim.run(windows_per_dispatch=8)
+    names = os.listdir(tmp_path)
+    assert any(x.startswith("drain-") for x in names)
+    assert not any(x.startswith("ckpt-") for x in names)
+
+    resumed = build_simulation(_BASE)
+    info = resumed.resume_from(str(tmp_path))
+    assert info["fallbacks"] == 0
+    resumed.run()
+    assert resumed.audit_chain() == chain
+    assert resumed.counters()["events_committed"] == events
+
+
+def test_chip_lost_carries_dead_set(tmp_path):
+    """Policy `relayout` without a runner: ChipLost (a BackendLost
+    subclass) surfaces the dead chip set + drain path to the caller."""
+    sim = build_simulation(_BASE)
+    sim.checkpoint_dir = str(tmp_path)
+    sim.attach_supervisor(_quiet_sup("relayout"))
+    sim.attach_faults(plan_mod.parse_fault_plan(
+        [{"at": "1 s", "op": "kill_chip", "chip": 2}]
+    ))
+    with pytest.raises(ChipLost) as e:
+        sim.run(windows_per_dispatch=8)
+    assert e.value.chips == {2}
+    assert e.value.path and os.path.basename(e.value.path).startswith(
+        "drain-"
+    )
+    # the survivors are healthy: the supervisor cleared its dead flag
+    # (the elastic runner re-binds it to the rebuilt sim)
+    assert not sim.supervisor.degraded
+    assert sim.supervisor.chips_down == {2}
+
+
+# ---------------------------------------------------------------------------
+# S→1 endpoint + SIGKILL-mid-relayout + flapping hysteresis
+# ---------------------------------------------------------------------------
+
+
+def test_shrink_to_one_falls_back_to_global_engine(tmp_path):
+    """2 chips losing one leaves no mesh to shard over: the run resumes
+    on the GLOBAL engine (islands.globalize_state), chain-identical."""
+    base = _cfg(n=6, shards=2, seed=7)
+    ref = build_simulation(base)
+    ref.run()
+    r = _runner(base, tmp_path, chips=2, faults=[
+        {"at": "1 s", "op": "kill_chip", "chip": 1}
+    ])
+    sim = r.run()
+    assert not isinstance(sim, IslandSimulation)
+    assert sim.audit_chain() == ref.audit_chain()
+    assert (sim.counters()["events_committed"]
+            == ref.counters()["events_committed"])
+    assert r.counters["relayouts"] == 1
+
+
+def test_sigkill_during_relayout_resumes_from_drain(baseline, tmp_path):
+    """The process dies between the drain and the rebuilt mesh's first
+    dispatch: a fresh runner (fresh process semantics — nothing shared
+    but the checkpoint directory and the plan) resumes from the drain
+    checkpoint and finishes bit-identically, without re-firing the
+    already-fired kill_chip."""
+    chain, events = baseline
+    faults = [{"at": "1 s", "op": "kill_chip", "chip": 2}]
+    sim = build_simulation(_BASE)
+    sim.configure_auto_checkpoint(str(tmp_path), 0)
+    sim.attach_supervisor(_quiet_sup("relayout"))
+    sim.attach_faults(plan_mod.parse_fault_plan(faults))
+    with pytest.raises(ChipLost):
+        sim.run(windows_per_dispatch=8)  # "SIGKILL" lands here
+    del sim
+
+    r2 = _runner(_BASE, tmp_path, faults=faults)
+    r2.down = {2}  # the restarting operator knows the chip is dead
+    r2.supervisor.mark_chip_down(2)
+    r2.resume()
+    sim2 = r2.run()
+    assert sim2.audit_chain() == chain
+    assert sim2.counters()["events_committed"] == events
+    assert sim2.num_shards == 3  # finished degraded; chip never probed up
+
+
+def test_flapping_chip_hysteresis_no_relayout_storm(baseline, tmp_path):
+    """A chip that answers every other probe can NEVER re-expand: the
+    hysteresis streak resets on each miss, so the run finishes degraded
+    with exactly one relayout — no storm."""
+    chain, events = baseline
+    flip = {"n": 0}
+
+    def flapping_probe():
+        flip["n"] += 1
+        return flip["n"] % 2 == 0
+
+    sup = _quiet_sup("relayout", probe_fn=flapping_probe)
+    r = _runner(_BASE, tmp_path, supervisor=sup, hysteresis=3, faults=[
+        # recovers instantly as far as the injection is concerned; the
+        # flapping probe_fn then governs the re-expansion streak
+        {"at": "1 s", "op": "kill_chip", "chip": 2, "recover_after": 0}
+    ])
+    sim = r.run()
+    assert sim.audit_chain() == chain
+    assert sim.counters()["events_committed"] == events
+    assert r.counters["relayouts"] == 1
+    assert r.counters["re_expansions"] == 0  # the streak never held
+    assert flip["n"] >= 3  # the prober really was consulted repeatedly
+
+
+def test_drain_burst_never_rotates_periodic_ring(tmp_path):
+    """ISSUE 13 satellite: N successive drains leave the periodic ring
+    intact — drains rotate only against other drains."""
+    sim = build_simulation(_cfg(shards=1))
+    sim.configure_auto_checkpoint(str(tmp_path), 0, retain=2)
+    # two periodic entries
+    ckpt_mod.save_ring(sim, str(tmp_path), 0, 100, retain=2)
+    ckpt_mod.save_ring(sim, str(tmp_path), 1, 200, retain=2)
+    periodic = {e[2] for e in ckpt_mod.ring_entries(str(tmp_path),
+                                                    prefix="ckpt")}
+    assert len(periodic) == 2
+    # a burst of drains, rotating through the drain namespace
+    sim._ckpt_seq = 2
+    for _ in range(5):
+        path = sim._drain_to_checkpoint("chip_lost:test")
+        assert os.path.basename(path).startswith("drain-")
+    drains = ckpt_mod.ring_entries(str(tmp_path), prefix="drain")
+    assert len(drains) == sim.checkpoint_retain  # drains rotated drains
+    still = {e[2] for e in ckpt_mod.ring_entries(str(tmp_path),
+                                                 prefix="ckpt")}
+    assert still == periodic  # the periodic ring never lost an entry
+    # and the newest entry overall (what resume picks first) is a drain
+    merged = ckpt_mod.ring_entries(str(tmp_path))
+    assert os.path.basename(merged[-1][2]).startswith("drain-")
+
+
+# ---------------------------------------------------------------------------
+# fleet-on-mesh: kill_chip drains + requeues; resume on the shrunk mesh
+# ---------------------------------------------------------------------------
+
+
+def _fleet_job_cfg(seed, stop_s):
+    # only data-plane fields (seed, stop_time) vary across jobs:
+    # app runtime is kernel-shaping, so it stays fixed fleet-wide
+    c = _cfg(n=6, shards=2, stop=2, seed=seed)
+    c["general"]["stop_time"] = stop_s
+    for h in c["hosts"].values():
+        h["app_options"]["runtime"] = 1
+    return c
+
+
+@pytest.fixture(scope="module")
+def fleet_solo_chains():
+    chains = []
+    for i in range(2):
+        s = build_simulation(_fleet_job_cfg(100 + i, 2 + i))
+        s.run()
+        chains.append(s.audit_chain())
+    return chains
+
+
+def test_fleet_kill_chip_requeue_and_resume_shrunk(
+    fleet_solo_chains, tmp_path
+):
+    """Fleet-on-mesh leg: a fleet-level kill_chip under policy
+    `relayout` drains every lane's slice, requeues the in-flight jobs
+    (lane requeue on shrink), and raises ChipLost; `resume_fleet
+    (num_shards=1)` rebuilds the sweep on the shrunk partition and
+    every job's chain still equals its solo run — the slices re-layout
+    through restore_relayout."""
+    from shadow_tpu.fleet import JobSpec, build_fleet, resume_fleet
+
+    fleet = build_fleet(
+        [JobSpec(name=f"j{i}", config=_fleet_job_cfg(100 + i, 2 + i))
+         for i in range(2)],
+        lanes=2, checkpoint_dir=str(tmp_path),
+    )
+    fleet.attach_supervisor(_quiet_sup("relayout"))
+    fleet.attach_faults(plan_mod.parse_fault_plan(
+        [{"at": "1 s", "op": "kill_chip", "chip": 1}]
+    ))
+    with pytest.raises(ChipLost) as e:
+        fleet.run()
+    assert e.value.chips == {1}
+    assert fleet._admission_paused
+    assert fleet.sched.jobs_requeued >= 1  # lane requeue on shrink
+
+    resumed = resume_fleet(str(tmp_path), num_shards=1)
+    resumed.run()
+    assert resumed.ok()
+    by_name = {r.name: r.audit.get("chain")
+               for r in resumed.sched.records}
+    for i in range(2):
+        assert by_name[f"j{i}"] == fleet_solo_chains[i], f"j{i}"
+
+
+def test_fleet_kill_chip_abort_resume_same_mesh(
+    fleet_solo_chains, tmp_path
+):
+    """Fleet-on-mesh + policy abort: kill_chip drains + requeues like
+    any backend loss; `sweep --resume` semantics finish the sweep on
+    the SAME mesh with solo chains — the no-relayout control cell."""
+    from shadow_tpu.fleet import JobSpec, build_fleet, resume_fleet
+
+    fleet = build_fleet(
+        [JobSpec(name=f"j{i}", config=_fleet_job_cfg(100 + i, 2 + i))
+         for i in range(2)],
+        lanes=2, checkpoint_dir=str(tmp_path),
+    )
+    fleet.attach_supervisor(_quiet_sup("abort"))
+    fleet.attach_faults(plan_mod.parse_fault_plan(
+        [{"at": "1 s", "op": "kill_chip", "chip": 0}]
+    ))
+    with pytest.raises(BackendLost):
+        fleet.run()
+    resumed = resume_fleet(str(tmp_path))
+    resumed.run()
+    assert resumed.ok()
+    by_name = {r.name: r.audit.get("chain")
+               for r in resumed.sched.records}
+    for i in range(2):
+        assert by_name[f"j{i}"] == fleet_solo_chains[i], f"j{i}"
+
+
+def test_fleet_kill_chip_wait_recovers_in_process(fleet_solo_chains):
+    """Fleet-on-mesh + policy wait: the sweep holds until the chip
+    answers, then continues in place — chains equal solo."""
+    from shadow_tpu.fleet import JobSpec, build_fleet
+
+    fleet = build_fleet(
+        [JobSpec(name=f"j{i}", config=_fleet_job_cfg(100 + i, 2 + i))
+         for i in range(2)],
+        lanes=2,
+    )
+    sup = _quiet_sup("wait")
+    fleet.attach_supervisor(sup)
+    fleet.attach_faults(plan_mod.parse_fault_plan(
+        [{"at": "1 s", "op": "kill_chip", "chip": 0, "recover_after": 2}]
+    ))
+    fleet.run()
+    assert fleet.ok()
+    assert sup.counters["hot_resumes"] == 1
+    assert sup.counters["chip_losses"] == 1
+    by_name = {r.name: r.audit.get("chain") for r in fleet.sched.records}
+    for i in range(2):
+        assert by_name[f"j{i}"] == fleet_solo_chains[i], f"j{i}"
+
+
+def test_fleet_check_compat_refuses_mixed_partition():
+    """After a relayout every swap-in must be rebuilt for the surviving
+    mesh: _check_compat refuses a job built at the old shard count."""
+    from shadow_tpu.fleet import FleetError, JobSpec, build_fleet
+
+    fleet = build_fleet(
+        [JobSpec(name="a", config=_fleet_job_cfg(1, 2))], lanes=1,
+    )
+    other = build_simulation(_cfg(n=6, shards=3, stop=2, seed=2))
+    with pytest.raises(FleetError, match="mesh partition"):
+        fleet._check_compat(other)
+
+
+# ---------------------------------------------------------------------------
+# kill_chip plan validation + schema v12 telemetry
+# ---------------------------------------------------------------------------
+
+
+def test_kill_chip_plan_validation():
+    good = {
+        "kind": plan_mod.PLAN_KIND,
+        "schema_version": plan_mod.PLAN_SCHEMA_VERSION,
+        "faults": [
+            {"at": "1 s", "op": "kill_chip", "chip": 3},
+            {"at": "1 s", "op": "kill_chip", "chip": 0,
+             "recover_after": 2},
+        ],
+    }
+    plan_mod.validate_fault_plan_doc(good)
+    faults = plan_mod.parse_fault_plan(good["faults"])
+    assert faults[0].chip == 3 and faults[1].chip == 0
+    assert faults[1].recover_after == 2
+    assert all(f.op in plan_mod.BACKEND_OPS for f in faults)
+    plan_mod.check_backend_ops(faults, mesh_size=8)
+    with pytest.raises(plan_mod.FaultPlanError, match="out of range"):
+        plan_mod.check_backend_ops(faults, mesh_size=3)
+    for bad in (
+        [{"at": 1, "op": "kill_chip"}],                      # chip required
+        [{"at": 1, "op": "kill_chip", "chip": -1}],
+        [{"at": 1, "op": "kill_chip", "chip": "x"}],
+        [{"at": 1, "op": "kill_chip", "chip": 1,
+          "recover_after": -1}],
+        [{"at": 1, "op": "kill_chip", "chip": 1, "host": 2}],
+    ):
+        with pytest.raises(plan_mod.FaultPlanError):
+            plan_mod.parse_fault_plan(bad)
+
+
+def test_validate_fault_plan_cli_mesh_size(tmp_path, capsys):
+    """tools/validate_fault_plan.py --mesh-size: clean nonzero exit on a
+    chip index past the mesh, 0 on a valid plan."""
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    try:
+        from tools.validate_fault_plan import main
+    finally:
+        sys.path.pop(0)
+    import json
+
+    p = tmp_path / "plan.json"
+    p.write_text(json.dumps({
+        "kind": plan_mod.PLAN_KIND,
+        "schema_version": plan_mod.PLAN_SCHEMA_VERSION,
+        "faults": [{"at": "1 s", "op": "kill_chip", "chip": 6}],
+    }))
+    assert main([str(p)]) == 0
+    assert main(["--mesh-size", "8", str(p)]) == 0
+    assert main(["--mesh-size", "4", str(p)]) == 2
+    err = capsys.readouterr().err
+    assert "out of range" in err and "INVALID" in err
+    assert main(["--mesh-size", "nope", str(p)]) == 2
+
+
+def test_serve_submit_rejects_out_of_mesh_kill_chip(tmp_path):
+    """Daemon-level chaos plans bounds-check kill_chip against the
+    sweep's own mesh size, and a malformed plan is a clean ServeError
+    (HTTP 400) — not a dead handler thread (the pre-elastic escape)."""
+    from shadow_tpu.serve.daemon import ServeError, ServeOptions, \
+        ShadowDaemon
+
+    daemon = ShadowDaemon(ServeOptions(
+        state_dir=str(tmp_path), cache_dir=str(tmp_path / "cache"),
+    ))
+    doc = {
+        **_fleet_job_cfg(1, 1),
+        "sweep": {"name": "v", "lanes": 1,
+                  "matrix": {"general.seed": [1, 2]}},
+    }
+    with pytest.raises(ServeError, match="out of range"):
+        daemon.submit(doc, backend_faults=[
+            {"at": "0.5 s", "op": "kill_chip", "chip": 7}
+        ])
+    # in-bounds passes admission validation and queues
+    out = daemon.submit(doc, backend_faults=[
+        {"at": "0.5 s", "op": "kill_chip", "chip": 1}
+    ])
+    assert "id" in out
+
+
+def test_metrics_v12_elastic_and_absent_on_non_mesh(baseline, tmp_path):
+    """Schema v12: the elastic run's metrics carry the mesh.* relayout
+    counters + chips_up/chips_total gauges and strict-validate; a
+    non-mesh run's document carries NO mesh keys."""
+    from shadow_tpu.obs import metrics as obs_metrics
+
+    r = _runner(_BASE, tmp_path, faults=[
+        {"at": "1 s", "op": "kill_chip", "chip": 2, "recover_after": 2}
+    ])
+    sim = r.run()
+    reg = obs_metrics.MetricsRegistry()
+    obs_metrics.snapshot_device(sim, reg)
+    doc = reg.to_doc()
+    assert doc["schema_version"] == 12
+    obs_metrics.validate_metrics_doc(doc, strict_namespaces=True)
+    assert doc["counters"]["mesh.relayouts"] == 1
+    assert doc["counters"]["mesh.re_expansions"] == 1
+    assert doc["counters"]["mesh.chips_lost"] == 1
+    assert doc["counters"]["mesh.relayout_downtime_ns"] > 0
+    assert doc["counters"]["resilience.chip_losses"] == 1
+    assert doc["gauges"]["mesh.chips_up"] == 4
+    assert doc["gauges"]["mesh.chips_total"] == 4
+    bad = dict(doc)
+    bad["counters"] = {**doc["counters"], "mesh.relayouts": -1}
+    with pytest.raises(ValueError, match="mesh"):
+        obs_metrics.validate_metrics_doc(bad)
+
+    plain = build_simulation(_cfg(shards=1, stop=2))
+    plain.run()
+    reg2 = obs_metrics.MetricsRegistry()
+    obs_metrics.snapshot_device(plain, reg2)
+    doc2 = reg2.to_doc()
+    assert not any(k.startswith("mesh.") for k in doc2["counters"])
+    assert not any(k.startswith("mesh.") for k in doc2["gauges"])
+
+
+def test_mesh_posture_for_healthz():
+    """FleetSimulation.mesh_posture: chips up/total for /healthz; {} on
+    a non-islands fleet (no mesh keys on non-mesh runs)."""
+    from shadow_tpu.fleet import JobSpec, build_fleet
+
+    fleet = build_fleet(
+        [JobSpec(name="a", config=_fleet_job_cfg(1, 2))], lanes=1,
+    )
+    p = fleet.mesh_posture()
+    assert p["chips_up"] == 2 and p["chips_total"] == 2
+    assert p["shard_map"] == 0 and p["chips_down"] == []
+
+    flat = build_fleet(
+        [JobSpec(name="b", config=_cfg(n=4, shards=1, stop=2))], lanes=1,
+    )
+    assert flat.mesh_posture() == {}

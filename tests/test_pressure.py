@@ -260,7 +260,7 @@ def test_disabled_policy_raises_typed_pool_exhausted(tmp_path):
     assert e.value.capacity == 1024
     assert e.value.occupancy is not None
     assert ctl.counters["gave_up"] >= 1
-    entries = [n for n in os.listdir(tmp_path) if n.startswith("ckpt-")]
+    entries = [n for n in os.listdir(tmp_path) if n.startswith("drain-")]
     assert len(entries) == 1  # drained before raising: resumable
 
 

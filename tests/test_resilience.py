@@ -118,6 +118,43 @@ def test_classify_failure():
     assert classify_failure(RuntimeError("speculation violation")) == FATAL
 
 
+@pytest.mark.parametrize("msg", [
+    # mesh-collective runtime failures: ONE participant chip died.
+    # These must classify BACKEND_LOST (drain + policy) — never
+    # TRANSIENT (a bounded retry against a dead ppermute peer spins
+    # until the retry budget burns) and never FATAL (it is an
+    # infrastructure failure, not a bug) — even when the runtime
+    # phrases them with a transient-sounding prefix.
+    "ABORTED: ppermute participant failed on device 3",
+    "INTERNAL: collective-permute peer unreachable",
+    "ABORTED: all-reduce timed out waiting for peer",
+    "all_gather failed: remote device lost contact",
+    "collective operation aborted: participant failed",
+    "NCCL error: peer failure detected",
+    "ICI link down between chips 2 and 3",
+])
+def test_mesh_collective_failures_classify_chip_scoped(msg):
+    """ISSUE 13 satellite: the chip-scoped marker table, mirroring the
+    backend-lost marker rows above — BACKEND_LOST and chip-scoped."""
+    from shadow_tpu.core.supervisor import chip_scoped
+
+    exc = RuntimeError(msg)
+    assert classify_failure(exc) == BACKEND_LOST
+    assert chip_scoped(exc)
+
+
+def test_generic_transient_stays_transient():
+    """The chip table must not swallow the generic retry class: a bare
+    'ABORTED: collective' (no op-scoped marker) keeps its bounded
+    retry, and plain transients are untouched."""
+    from shadow_tpu.core.supervisor import chip_scoped
+
+    assert classify_failure(RuntimeError("ABORTED: collective")) \
+        == TRANSIENT
+    assert classify_failure(RuntimeError("try again later")) == TRANSIENT
+    assert not chip_scoped(RuntimeError("try again later"))
+
+
 def test_supervisor_transient_retry_then_success():
     sup = _quiet_supervisor("abort")
     calls = {"n": 0}
@@ -206,7 +243,7 @@ def test_kill_backend_drain_resume_chain_identical(yaml, sync, tmp_path):
     ))
     with pytest.raises(BackendLost, match="drained to"):
         _run(sim, sync)
-    entries = [n for n in os.listdir(tmp_path) if n.startswith("ckpt-")]
+    entries = [n for n in os.listdir(tmp_path) if n.startswith("drain-")]
     assert len(entries) == 1
     # drain metadata rides the checkpoint header (core/checkpoint.py)
     from shadow_tpu.core import checkpoint as ckpt_mod
@@ -279,7 +316,7 @@ def test_wait_budget_exhaustion_still_drains(tmp_path):
     ))
     with pytest.raises(BackendLost, match="probe budget"):
         sim.run()
-    assert any(n.startswith("ckpt-") for n in os.listdir(tmp_path))
+    assert any(n.startswith("drain-") for n in os.listdir(tmp_path))
 
 
 def test_stall_backend_escalation_ladder():
@@ -501,7 +538,7 @@ def test_metrics_schema_v6_resilience_namespace():
     reg = obs_metrics.MetricsRegistry()
     obs_metrics.snapshot_device(sim, reg)
     doc = reg.to_doc()
-    assert doc["schema_version"] == 11
+    assert doc["schema_version"] == 12
     obs_metrics.validate_metrics_doc(doc)
     assert doc["counters"]["resilience.drains"] == 1
     assert doc["counters"]["resilience.failovers"] == 1

@@ -104,6 +104,7 @@ def main(argv: list[str] | None = None) -> int:
                 h = client.health()
                 asy = h.get("async") or {}
                 bal = dict(h.get("balance") or {})
+                mesh = dict(h.get("mesh") or {})
                 if bal and "state" not in bal:
                     # the outer-ring (packing/steal) posture has no
                     # migration state machine; say so explicitly
@@ -125,6 +126,22 @@ def main(argv: list[str] | None = None) -> int:
                             "laggard_lane": asy.get("laggard_lane"),
                         } if asy else {},
                         "balance": bal,
+                        # mesh posture (schema v12): chips up/total,
+                        # the dead set, and the last relayout record —
+                        # a degraded mesh is visible HERE, not only in
+                        # the metrics artifact
+                        "mesh": {
+                            "chips": (
+                                f"{mesh.get('chips_up')}/"
+                                f"{mesh.get('chips_total')}"
+                            ),
+                            "chips_down": mesh.get("chips_down"),
+                            "exchange_rebuilds":
+                                mesh.get("exchange_rebuilds"),
+                            "relayouts": mesh.get("relayouts"),
+                            "re_expansions": mesh.get("re_expansions"),
+                            "last_relayout": mesh.get("last_relayout"),
+                        } if mesh else {},
                     }
                 }))
                 for row in client.sweeps():

@@ -57,6 +57,8 @@ STAGES = [
     ("async_smoke", [PY, "bench.py", "--async-smoke"], False, 7200),
     ("balance_smoke", [PY, "bench.py", "--balance-smoke"], False, 7200),
     ("mesh_smoke", [PY, "bench.py", "--mesh-smoke"], False, 7200),
+    ("mesh_resilience_smoke",
+     [PY, "bench.py", "--mesh-resilience-smoke"], False, 7200),
     ("stages_10k", [PY, "bench.py", "--stages"], False, 10800),
     ("stages_50k", [PY, "bench.py", "--stages-50k"], False, 14400),
     ("stages_100k", [PY, "bench.py", "--stages-100k"], False, 10800),

@@ -7,6 +7,11 @@ downstream tooling. Exit 0 on a valid plan, 2 with a one-line diagnosis
 otherwise — never a traceback for malformed input.
 
   python tools/validate_fault_plan.py plan.json [more.json ...]
+  python tools/validate_fault_plan.py --mesh-size 8 chaos.json
+
+--mesh-size N additionally bounds-checks every kill_chip target against
+an N-chip mesh (faults/plan.check_backend_ops's rule): a chip index
+at/past the mesh is a plan bug, refused before any run loads it.
 """
 
 from __future__ import annotations
@@ -20,6 +25,21 @@ def main(argv: list[str] | None = None) -> int:
     if not args or "-h" in args or "--help" in args:
         print(__doc__.strip(), file=sys.stderr)
         return 0 if args else 2
+    mesh_size: int | None = None
+    if "--mesh-size" in args:
+        i = args.index("--mesh-size")
+        try:
+            mesh_size = int(args[i + 1])
+            if mesh_size < 1:
+                raise ValueError
+        except (IndexError, ValueError):
+            print("--mesh-size needs a positive integer chip count",
+                  file=sys.stderr)
+            return 2
+        args = args[:i] + args[i + 2:]
+        if not args:
+            print("--mesh-size given but no plan file(s)", file=sys.stderr)
+            return 2
     from shadow_tpu.faults.plan import (
         FaultPlanError,
         parse_fault_plan,
@@ -42,6 +62,15 @@ def main(argv: list[str] | None = None) -> int:
         try:
             validate_fault_plan_doc(doc)
             faults = parse_fault_plan(doc["faults"])
+            if mesh_size is not None:
+                # bounds-check chip targets without constraining the op
+                # mix (a run-scoped plan may carry device/proc ops too)
+                from shadow_tpu.faults.plan import check_backend_ops
+
+                check_backend_ops(
+                    [fl for fl in faults if fl.op == "kill_chip"],
+                    mesh_size=mesh_size,
+                )
         except FaultPlanError as e:
             print(f"{path}: INVALID: {e}", file=sys.stderr)
             rc = 2
