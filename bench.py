@@ -2024,20 +2024,55 @@ def stage_serve_smoke(num_hosts: int = 64, msgload: int = 2):
 
 
 def stage_lint_smoke():
-    """shadowlint gate (ISSUE 7 acceptance): the STL0xx AST rule set over
-    the default scope must report ZERO non-baselined violations, and a
-    tiny geared driver run must show no kernel retraces (one lowering per
-    bound kernel — the compile-cache-miss perf-bug class from r03–r05).
-    Pure CPU (AST walk + one tiny compile), so no backend wait."""
-    from shadow_tpu.analysis import hlo_audit, linter
+    """shadowlint gate (ISSUE 7 acceptance, extended by ISSUE 14): all
+    FOUR static-analysis passes over the tree must report ZERO
+    non-baselined violations — the STL0xx AST rules, the SLC0xx
+    cross-plane contract auditor, the STH0xx host-thread race lint, and
+    the HLO budget ledger (every kernel variant this box can lower,
+    against shadow_tpu/analysis/hlo_baseline.json) — and a tiny geared
+    driver run must show no kernel retraces (one lowering per bound
+    kernel — the compile-cache-miss perf-bug class from r03–r05).
+    Pure CPU (AST walks + tiny compiles), so no backend wait."""
+    from shadow_tpu.analysis import contracts, hlo_audit, linter, threads
     from shadow_tpu.flagship import build_phold_flagship
 
     paths = [os.path.join(_REPO, p) for p in ("shadow_tpu", "tools", "bench.py")]
     findings = linter.lint_paths(paths, _REPO)
+    findings += contracts.audit_tree(_REPO)
+    findings += threads.lint_threads_paths(_REPO)
+    # the HLO budget ledger: a missing/corrupt baseline is a gate
+    # failure with a remediation hint, not a traceback
+    hlo_problems = []
+    hlo_baseline_ok = True
+    try:
+        hlo_baseline = hlo_audit.load_hlo_baseline(
+            hlo_audit.baseline_path(_REPO)
+        )
+    except hlo_audit.HloBaselineError as e:
+        hlo_baseline_ok = False
+        hlo_problems = [str(e)]
+    if hlo_baseline_ok:
+        ledger = hlo_audit.budget_ledger(
+            hlo_audit.default_ledger_variants()
+        )
+        hlo_problems = hlo_audit.check_ledger(ledger, hlo_baseline)
+    findings += [
+        linter.Finding(
+            path="shadow_tpu/analysis/hlo_baseline.json", line=1, col=0,
+            code="SLH001", message=p, text=p.split(":", 1)[0],
+        )
+        for p in hlo_problems
+    ]
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.code))
     baseline = linter.load_baseline(os.path.join(_REPO, linter.BASELINE_NAME))
     new, old = linter.split_baselined(findings, baseline)
     scanned = list(linter.iter_python_files(paths))
-    doc = linter.findings_doc(new, old, scanned)
+    pass_of = {"STL": "lint", "SLC": "contracts", "STH": "threads",
+               "SLH": "hlo"}
+    passes = {"lint": 0, "contracts": 0, "threads": 0, "hlo": 0}
+    for f in new:
+        passes[pass_of[f.code[:3]]] += 1
+    doc = linter.findings_doc(new, old, scanned, passes=passes)
     report_path = os.path.join(_REPO, "lint_smoke.report.json")
     with open(report_path, "w") as f:
         json.dump(doc, f, indent=1)
@@ -2056,19 +2091,25 @@ def stage_lint_smoke():
         "findings_new": len(new),
         "findings_grandfathered": len(old),
         "by_code": doc["counts"]["by_code"],
+        "passes": passes,
         "retrace_ok": bool(retrace["ok"]),
         "kernel_compiles": int(retrace["compiles_total"]),
         "report_out": os.path.relpath(report_path, _REPO),
-        "gate_lint": not new,
+        "gate_lint": passes["lint"] == 0,
+        "gate_contracts": passes["contracts"] == 0,
+        "gate_threads": passes["threads"] == 0,
+        "gate_hlo_ledger": bool(hlo_baseline_ok and passes["hlo"] == 0),
         "gate_retrace": bool(retrace["ok"]),
-        "gate": bool(not new and retrace["ok"]),
+        "gate": bool(not new and hlo_baseline_ok and retrace["ok"]),
     }
 
 
 def main():
     if "--lint-smoke" in sys.argv:
-        # static-analysis gate: shadowlint clean + no kernel retraces.
-        # AST + one tiny CPU compile — no accelerator, so no backend wait.
+        # static-analysis gate: all four shadowlint passes clean (AST
+        # rules, contract auditor, thread race lint, HLO budget ledger)
+        # + no kernel retraces. AST walks + tiny CPU compiles — no
+        # accelerator, so no backend wait.
         os.environ.setdefault("SHADOW_TPU_BENCH_ALLOW_CPU", "1")
         print(json.dumps(stage_lint_smoke()), flush=True)
         return

@@ -1,6 +1,6 @@
-"""shadowlint: the device-purity & determinism static-analysis plane.
+"""shadowlint: the device-purity, determinism & contract analysis plane.
 
-Two layers guard the invariants every PR silently depends on:
+Five passes guard the invariants every PR silently depends on:
 
   * an AST rule engine (`rules.py` + `linter.py`, rule codes ``STL0xx``)
     that classifies modules as **kernel** (compiled into device window
@@ -15,11 +15,23 @@ Two layers guard the invariants every PR silently depends on:
     {global, islands, fleet} × gear tiers) to optimized HLO and asserts
     the op bans (no scatter, no serializing gather, bounded sort rows),
     plus a retrace detector that makes "one sweep = one compile" a
-    statically gated property.
+    statically gated property;
+  * a cross-plane contract auditor (`contracts.py`, ``SLC0xx``) that
+    cross-checks the hand-maintained registries — metric namespaces,
+    fault-op tables and their injector arms, schema-version literals in
+    docs and tests, config_spec rows, supervisor policies — against
+    every emit/consume site;
+  * a host-thread race lint (`threads.py`, ``STH0xx``) applying
+    Eraser-style declared-guard lock discipline to the thread-bearing
+    host modules (the serve daemon and friends);
+  * an HLO budget ledger (`hlo_audit.py` + ``hlo_baseline.json``,
+    ``SLH001``) diffing each variant's exact collective / sort / gather
+    / buffer budget against a checked-in baseline.
 
-Entry points: ``tools/shadowlint.py`` (CLI), ``bench.py --lint-smoke``
-(gate), ``tests/test_analysis.py`` (tier-1).  See
-docs/static_analysis.md for the rule catalog and workflows.
+Entry points: ``tools/shadowlint.py`` (CLI; ``--contracts``
+``--threads`` ``--hlo``), ``bench.py --lint-smoke`` (gate, all passes),
+``tests/test_analysis.py`` (tier-1).  See docs/static_analysis.md for
+the rule catalogs and the waiver / ledger-regeneration workflows.
 """
 
 from shadow_tpu.analysis.linter import (  # noqa: F401

@@ -1,0 +1,640 @@
+"""Pass 3 of shadowlint: the cross-plane contract auditor (codes SLC0xx).
+
+Every plane added since PR 7 carries hand-maintained contracts that span
+files: the closed metric-namespace table and its schema version
+(``obs/metrics.py``), the fault-op registries and their per-op field
+contracts (``faults/plan.py``) plus the injector arms that execute them
+(``core/engine.py``, ``procs/driver.py``), the supervisor policy set
+(``core/supervisor.py``) re-validated by the config loader, the
+schema-version literals quoted in docs tables and sample documents, and
+the ``docs/config_spec.md`` tables that must mirror what the loader
+actually parses.  Drift between any pair is a silent correctness bug —
+caught today by whichever smoke gate happens to trip, or not at all.
+
+This pass extracts each registry from its single source of truth (the
+constants are plain data, imported directly) and statically cross-checks
+every emit/consume site against it:
+
+  SLC001  metric emitter writes a namespace outside KNOWN_METRIC_NAMESPACES
+  SLC002  registered metric namespace with no statically-visible emitter
+  SLC003  fault op with no injector-handler arm in its executing plane
+  SLC004  fault-op docs table drift (missing or stale row)
+  SLC005  stale schema-version literal (docs sample/heading, test assert)
+  SLC006  config_spec table drift (stale row / undocumented loader key)
+  SLC007  supervisor policy set drift (config validator / docs)
+  SLC008  fault-op registry drift (ALL_OPS vs the _FIELDS validation table)
+
+Every check is a pure function over explicit inputs so the test suite
+can forge drift fixtures; ``audit_tree`` wires the real files in.
+``# noqa: SLC0xx`` suppresses line-anchored findings in .py sources; the
+shared ``.shadowlint_baseline.json`` waiver workflow covers the rest
+(docs findings have no line to annotate).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from shadow_tpu.analysis import linter
+from shadow_tpu.analysis import rules as rules_mod
+from shadow_tpu.analysis.linter import Finding
+
+# Documents whose fenced samples / headings quote a schema version, and
+# the source constant each kind must match (SLC005).
+def doc_schema_versions() -> dict[str, int]:
+    from shadow_tpu.faults import plan as plan_mod
+    from shadow_tpu.obs import audit as audit_mod
+    from shadow_tpu.obs import metrics as metrics_mod
+
+    return {
+        "shadow_tpu.metrics": metrics_mod.SCHEMA_VERSION,
+        "shadow_tpu.fault_plan": plan_mod.PLAN_SCHEMA_VERSION,
+        "shadow_tpu.digest": audit_mod.DIGEST_SCHEMA_VERSION,
+    }
+
+
+# Config-loader fields documented collectively in prose rather than as
+# table rows (docs/config_spec.md): the reference-compatible flag block
+# and the device-network seam subsection.  Everything else must have a
+# row (SLC006).
+CONFIG_PROSE_DOCUMENTED: dict[str, frozenset[str]] = {
+    "experimental": frozenset({
+        "runahead", "interface_buffer", "interface_qdisc",
+        "socket_recv_buffer", "socket_send_buffer",
+        "socket_recv_autotune", "socket_send_autotune",
+        "use_memory_manager", "use_seccomp", "use_syscall_counters",
+        "use_object_counters", "worker_threads", "interpose_method",
+        # "The device-network seam" subsection documents the pair
+        "use_device_network", "use_device_tcp",
+    }),
+}
+
+_METRIC_EMITTERS = ("counter_set", "counter_add", "gauge_set", "histogram")
+
+# Namespace evidence must look like a dotted metric key head.
+_NS_RE = re.compile(r"^([a-z][a-z0-9_]*)\.")
+
+
+def _finding(path: str, line: int, col: int, code: str, message: str,
+             text: str = "") -> Finding:
+    return Finding(path=path, line=line, col=col, code=code,
+                   message=message, text=text)
+
+
+def _line_text(lines: list[str], lineno: int) -> str:
+    return lines[lineno - 1].strip() if 0 < lineno <= len(lines) else ""
+
+
+def _suppress(findings: list[Finding], src_lines: dict[str, list[str]]
+              ) -> list[Finding]:
+    """Apply ``# noqa`` suppression to line-anchored .py findings."""
+    out = []
+    for f in findings:
+        lines = src_lines.get(f.path)
+        if lines is not None:
+            text = _line_text(lines, f.line)
+            if linter._suppressed(text, f.code):
+                continue
+        out.append(f)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# SLC001/SLC002: metric namespace emit sites vs the closed table
+# ---------------------------------------------------------------------------
+
+
+def audit_metric_sources(
+    sources: dict[str, str], known: frozenset[str] | None = None
+) -> list[Finding]:
+    """Cross-check every statically-visible metric emit site against the
+    closed namespace table.  `sources` maps repo-relative path -> source
+    text.  SLC001: an emitter call (`counter_set` / `counter_add` /
+    `gauge_set` / `histogram`) whose key has a static dotted prefix
+    outside the table.  SLC002: a table namespace no scanned module
+    shows evidence of emitting — evidence is an emitter-call prefix OR
+    any string literal argument shaped `ns.rest` (helpers like
+    `_sub_counter(reg, nic, "net.nic", ...)` pass the namespace through
+    an argument, not the emitter call itself)."""
+    if known is None:
+        from shadow_tpu.obs.metrics import KNOWN_METRIC_NAMESPACES
+
+        known = KNOWN_METRIC_NAMESPACES
+    findings: list[Finding] = []
+    evidence: set[str] = set()
+    src_lines: dict[str, list[str]] = {}
+    for relpath in sorted(sources):
+        src = sources[relpath]
+        src_lines[relpath] = src.splitlines()
+        try:
+            tree = ast.parse(src, filename=relpath)
+        except SyntaxError:
+            # the driver surfaces parse errors once (exit 2); skip here
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            # broad evidence: any literal/f-string argument `ns.rest`
+            for arg in list(node.args) + [k.value for k in node.keywords]:
+                prefix = rules_mod._literal_key_prefix(arg)
+                if prefix:
+                    m = _NS_RE.match(prefix)
+                    if m:
+                        evidence.add(m.group(1))
+            # strict check: the emitter methods themselves
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _METRIC_EMITTERS
+                and node.args
+            ):
+                prefix = rules_mod._literal_key_prefix(node.args[0])
+                if prefix is None:
+                    continue
+                m = _NS_RE.match(prefix)
+                if m is None:
+                    continue
+                ns = m.group(1)
+                if ns not in known:
+                    findings.append(_finding(
+                        relpath, node.lineno, node.col_offset, "SLC001",
+                        f"metric emitter writes namespace `{ns}.*` which "
+                        f"is not in KNOWN_METRIC_NAMESPACES "
+                        f"(obs/metrics.py) — register it with a schema "
+                        f"bump and a docs row",
+                        _line_text(src_lines[relpath], node.lineno),
+                    ))
+    for ns in sorted(known - evidence):
+        findings.append(_finding(
+            "shadow_tpu/obs/metrics.py", 1, 0, "SLC002",
+            f"metric namespace `{ns}.*` is registered in "
+            f"KNOWN_METRIC_NAMESPACES but no scanned module emits it — "
+            f"dead table row (drop it with a schema bump) or an emitter "
+            f"the scan cannot see (add a literal-key emit site)",
+            f"namespace:{ns}",
+        ))
+    return _suppress(findings, src_lines)
+
+
+# ---------------------------------------------------------------------------
+# SLC003: fault ops vs injector-handler arms
+# ---------------------------------------------------------------------------
+
+
+def handled_op_strings(src: str) -> set[str]:
+    """String constants a consumer module compares/collects fault ops
+    with: every `f.op == "kill_host"`-style arm, membership tuple, or
+    set literal contributes its strings.  The engine's handler chains
+    name every op explicitly (the final `else` raises on an unhandled
+    op), so presence of the op string is the handler contract."""
+    out: set[str] = set()
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return out
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            out.add(node.value)
+    return out
+
+
+def audit_fault_handlers(
+    consumers: list[tuple[str, str, frozenset[str]]],
+) -> list[Finding]:
+    """`consumers` rows are (relpath, source, ops-this-plane-executes).
+    Every op in the plane set must appear as a string constant in the
+    consumer (the explicit handler arm / scheduling filter)."""
+    findings: list[Finding] = []
+    for relpath, src, ops in consumers:
+        present = handled_op_strings(src)
+        for op in sorted(ops - present):
+            findings.append(_finding(
+                relpath, 1, 0, "SLC003",
+                f"fault op `{op}` has no handler arm in {relpath} — the "
+                f"plan schema (faults/plan.py) registers it for this "
+                f"plane but nothing executes it",
+                f"op:{op}",
+            ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# SLC004: fault-op docs table
+# ---------------------------------------------------------------------------
+
+_DOC_OP_ROW_RE = re.compile(r"^\|\s*`(\w+)`\s*\|")
+
+
+def doc_op_table(md_text: str) -> set[str]:
+    return {
+        m.group(1)
+        for line in md_text.splitlines()
+        if (m := _DOC_OP_ROW_RE.match(line.strip())) is not None
+    }
+
+
+def audit_doc_op_table(
+    md_text: str, relpath: str, all_ops: frozenset[str]
+) -> list[Finding]:
+    rows = doc_op_table(md_text)
+    findings: list[Finding] = []
+    for op in sorted(all_ops - rows):
+        findings.append(_finding(
+            relpath, 1, 0, "SLC004",
+            f"fault op `{op}` has no row in the {relpath} op table — "
+            f"every op in faults/plan.py needs a documented effect",
+            f"op:{op}",
+        ))
+    for op in sorted(rows - all_ops):
+        # rows for non-op keys (config tables share the cell style) are
+        # only stale when they LOOK like ops: restrict to the op table
+        # region by requiring the row to carry a plane column
+        findings.append(_finding(
+            relpath, 1, 0, "SLC004",
+            f"docs table row `{op}` names an op faults/plan.py does not "
+            f"register — stale row (the op was removed or renamed)",
+            f"stale:{op}",
+        ))
+    return findings
+
+
+def extract_op_table_region(md_text: str) -> str:
+    """The §1 ops-by-plane table: rows between the `| op | plane |`
+    header and the next blank-line/heading break."""
+    lines = md_text.splitlines()
+    out: list[str] = []
+    in_table = False
+    for line in lines:
+        s = line.strip()
+        if re.match(r"^\|\s*op\s*\|\s*plane\s*\|", s):
+            in_table = True
+            continue
+        if in_table:
+            if not s.startswith("|"):
+                break
+            out.append(line)
+    return "\n".join(out)
+
+
+# ---------------------------------------------------------------------------
+# SLC005: schema-version literals in docs and tests
+# ---------------------------------------------------------------------------
+
+_DOC_KIND_RE = re.compile(r'"kind":\s*"(shadow_tpu\.\w+)"')
+_DOC_VER_RE = re.compile(r'"schema_version":\s*(\d+)')
+_DOC_INLINE_VER_RE = re.compile(r"`schema_version`\s+(\d+)")
+
+
+def audit_doc_schema_versions(
+    md_text: str, relpath: str, versions: dict[str, int],
+    inline_kind: str | None = None,
+) -> list[Finding]:
+    """Fenced samples: a `"kind": "shadow_tpu.X"` line binds the nearest
+    `"schema_version": N` (within 8 lines either side) to X's source
+    constant.  `inline_kind` additionally checks bare
+    `` `schema_version` N `` mentions (observability.md's headings)
+    against that kind's constant."""
+    lines = md_text.splitlines()
+    findings: list[Finding] = []
+    kind_at = [
+        (i, m.group(1))
+        for i, ln in enumerate(lines)
+        if (m := _DOC_KIND_RE.search(ln)) is not None
+    ]
+    for i, kind in kind_at:
+        if kind not in versions:
+            continue
+        want = versions[kind]
+        window = sorted(
+            range(max(0, i - 8), min(len(lines), i + 9)),
+            key=lambda j: (abs(j - i), j),
+        )
+        for j in window:
+            m = _DOC_VER_RE.search(lines[j])
+            if m is None:
+                continue
+            got = int(m.group(1))
+            if got != want:
+                findings.append(_finding(
+                    relpath, j + 1, 0, "SLC005",
+                    f"sample document quotes {kind} schema_version "
+                    f"{got}, but the source constant is {want} — stale "
+                    f"docs literal",
+                    lines[j].strip(),
+                ))
+            break  # nearest version line only
+    if inline_kind is not None and inline_kind in versions:
+        want = versions[inline_kind]
+        for i, ln in enumerate(lines):
+            for m in _DOC_INLINE_VER_RE.finditer(ln):
+                got = int(m.group(1))
+                if got != want:
+                    findings.append(_finding(
+                        relpath, i + 1, 0, "SLC005",
+                        f"doc text quotes `schema_version` {got}, but "
+                        f"the {inline_kind} source constant is {want}",
+                        ln.strip(),
+                    ))
+    return findings
+
+
+def audit_test_version_literals(src: str, relpath: str) -> list[Finding]:
+    """A test that asserts `doc["schema_version"] == <int literal>` has
+    to be hand-edited on every schema bump — six files' worth per bump
+    before this pass existed.  The shared helper
+    (tests/_contracts.assert_current_metrics_schema) imports the source
+    constant instead; any remaining literal comparison is drift bait."""
+    findings: list[Finding] = []
+    try:
+        tree = ast.parse(src, filename=relpath)
+    except SyntaxError:
+        return findings
+    lines = src.splitlines()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Compare):
+            continue
+        sides = [node.left] + list(node.comparators)
+        has_key = any(
+            isinstance(s, ast.Subscript)
+            and isinstance(s.slice, ast.Constant)
+            and s.slice.value == "schema_version"
+            for s in sides
+        )
+        has_literal = any(
+            isinstance(s, ast.Constant) and isinstance(s.value, int)
+            and not isinstance(s.value, bool)
+            for s in sides
+        )
+        if has_key and has_literal:
+            findings.append(_finding(
+                relpath, node.lineno, node.col_offset, "SLC005",
+                "hard-coded schema-version literal in a test — import "
+                "the source constant (or use "
+                "tests/_contracts.assert_current_metrics_schema) so a "
+                "schema bump cannot strand it",
+                _line_text(lines, node.lineno),
+            ))
+    return _suppress(findings, {relpath: lines})
+
+
+# ---------------------------------------------------------------------------
+# SLC006: config_spec.md tables vs the loader's dataclass fields
+# ---------------------------------------------------------------------------
+
+_SPEC_SECTION_RE = re.compile(r"^###\s+`(\w+)`")
+_SPEC_ROW_RE = re.compile(r"^\|\s*`(\w+)`\s*\|")
+
+
+def config_spec_rows(md_text: str) -> dict[str, set[str]]:
+    """Per-section documented field rows of docs/config_spec.md."""
+    out: dict[str, set[str]] = {}
+    section = None
+    for line in md_text.splitlines():
+        s = line.strip()
+        m = _SPEC_SECTION_RE.match(s)
+        if m:
+            section = m.group(1)
+            continue
+        if s.startswith("#"):
+            section = None
+            continue
+        if section is not None:
+            m = _SPEC_ROW_RE.match(s)
+            if m and m.group(1) not in ("field",):
+                out.setdefault(section, set()).add(m.group(1))
+    return out
+
+
+def audit_config_spec(
+    md_text: str, relpath: str,
+    fields_by_section: dict[str, set[str]] | None = None,
+    prose_documented: dict[str, frozenset[str]] | None = None,
+) -> list[Finding]:
+    if fields_by_section is None:
+        import dataclasses
+
+        from shadow_tpu.core import config as config_mod
+
+        fields_by_section = {
+            "general": {
+                f.name for f in dataclasses.fields(config_mod.GeneralOptions)
+            },
+            "experimental": {
+                f.name
+                for f in dataclasses.fields(config_mod.ExperimentalOptions)
+            },
+            "fleet": {
+                f.name for f in dataclasses.fields(config_mod.FleetOptions)
+            },
+        }
+    if prose_documented is None:
+        prose_documented = CONFIG_PROSE_DOCUMENTED
+    rows = config_spec_rows(md_text)
+    findings: list[Finding] = []
+    for section, fields in sorted(fields_by_section.items()):
+        documented = rows.get(section, set())
+        prose = prose_documented.get(section, frozenset())
+        for key in sorted(documented - fields):
+            findings.append(_finding(
+                relpath, 1, 0, "SLC006",
+                f"{relpath} documents `{section}.{key}` but the config "
+                f"loader (core/config.py) parses no such field — stale "
+                f"row",
+                f"stale:{section}.{key}",
+            ))
+        for key in sorted(fields - documented - prose):
+            findings.append(_finding(
+                relpath, 1, 0, "SLC006",
+                f"config loader parses `{section}.{key}` but {relpath} "
+                f"has no row for it — undocumented knob",
+                f"missing:{section}.{key}",
+            ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# SLC007: supervisor policy set vs config validator and docs
+# ---------------------------------------------------------------------------
+
+
+def config_policy_literals(config_src: str) -> set[str] | None:
+    """The on_backend_loss validation tuple in core/config.py: the
+    string-tuple comparator that contains "wait" (the policy set's
+    signature member).  None when no such tuple is found."""
+    try:
+        tree = ast.parse(config_src)
+    except SyntaxError:
+        return None
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Compare):
+            continue
+        for comp in node.comparators:
+            if isinstance(comp, (ast.Tuple, ast.List, ast.Set)) and all(
+                isinstance(e, ast.Constant) and isinstance(e.value, str)
+                for e in comp.elts
+            ):
+                vals = {e.value for e in comp.elts}
+                if "wait" in vals:
+                    return vals
+    return None
+
+
+def audit_policy_sets(
+    config_src: str, config_relpath: str, policies: tuple[str, ...],
+    docs_text: str = "", docs_relpath: str = "",
+) -> list[Finding]:
+    findings: list[Finding] = []
+    lits = config_policy_literals(config_src)
+    if lits is None:
+        findings.append(_finding(
+            config_relpath, 1, 0, "SLC007",
+            "could not locate the on_backend_loss policy validation "
+            "tuple in the config loader — the policy contract check "
+            "needs its literal set",
+            "policies:unlocatable",
+        ))
+    elif lits != set(policies):
+        findings.append(_finding(
+            config_relpath, 1, 0, "SLC007",
+            f"config loader validates on_backend_loss against "
+            f"{sorted(lits)} but supervisor.POLICIES is "
+            f"{sorted(policies)} — the sets drifted",
+            f"policies:{','.join(sorted(lits ^ set(policies)))}",
+        ))
+    if docs_text:
+        for pol in sorted(set(policies)):
+            if pol not in docs_text:
+                findings.append(_finding(
+                    docs_relpath or config_relpath, 1, 0, "SLC007",
+                    f"supervisor policy `{pol}` is never mentioned in "
+                    f"{docs_relpath} — undocumented --on-backend-loss "
+                    f"arm",
+                    f"policy:{pol}",
+                ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# SLC008: the fault-plan registry's own consistency
+# ---------------------------------------------------------------------------
+
+
+def audit_plan_registry(
+    all_ops: frozenset[str], field_table_ops: set[str]
+) -> list[Finding]:
+    findings: list[Finding] = []
+    path = "shadow_tpu/faults/plan.py"
+    for op in sorted(all_ops - field_table_ops):
+        findings.append(_finding(
+            path, 1, 0, "SLC008",
+            f"fault op `{op}` is registered in ALL_OPS but has no "
+            f"_FIELDS validation row — parse would KeyError on first "
+            f"use",
+            f"op:{op}",
+        ))
+    for op in sorted(field_table_ops - all_ops):
+        findings.append(_finding(
+            path, 1, 0, "SLC008",
+            f"_FIELDS validates op `{op}` that no plane set registers "
+            f"— dead validation row",
+            f"stale:{op}",
+        ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# the whole-tree audit
+# ---------------------------------------------------------------------------
+
+
+def _read(root: str, relpath: str) -> str:
+    with open(os.path.join(root, relpath), encoding="utf-8") as f:
+        return f.read()
+
+
+def audit_tree(root: str) -> list[Finding]:
+    """Run every contract check over the real tree.  Raises SyntaxError
+    (for the CLI's exit-2 path) only from the linter's own file walk;
+    unparseable files inside a sub-check are skipped there because the
+    STL pass already surfaces them."""
+    from shadow_tpu.faults import plan as plan_mod
+
+    findings: list[Finding] = []
+
+    # SLC001/SLC002 over the metric-emitting scope
+    py_sources: dict[str, str] = {}
+    for path in linter.iter_python_files(
+        [os.path.join(root, p) for p in ("shadow_tpu", "tools", "bench.py")]
+    ):
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        with open(path, encoding="utf-8") as f:
+            py_sources[rel] = f.read()
+    findings += audit_metric_sources(py_sources)
+
+    # SLC003: handler arms per executing plane
+    engine_rel = "shadow_tpu/core/engine.py"
+    driver_rel = "shadow_tpu/procs/driver.py"
+    findings += audit_fault_handlers([
+        (engine_rel, py_sources.get(engine_rel, ""),
+         plan_mod.DEVICE_OPS | plan_mod.BACKEND_OPS | plan_mod.FILE_OPS),
+        (driver_rel, py_sources.get(driver_rel, ""),
+         plan_mod.PROC_OPS | plan_mod.FILE_OPS | frozenset({"kill_host"})),
+    ])
+
+    # SLC004: the fault-op docs table
+    ft_md = _read(root, "docs/fault_tolerance.md")
+    findings += audit_doc_op_table(
+        extract_op_table_region(ft_md), "docs/fault_tolerance.md",
+        plan_mod.ALL_OPS,
+    )
+
+    # SLC005: docs samples + headings, then test literals
+    versions = doc_schema_versions()
+    findings += audit_doc_schema_versions(
+        _read(root, "docs/observability.md"), "docs/observability.md",
+        versions, inline_kind="shadow_tpu.metrics",
+    )
+    findings += audit_doc_schema_versions(
+        ft_md, "docs/fault_tolerance.md", versions,
+    )
+    tests_dir = os.path.join(root, "tests")
+    if os.path.isdir(tests_dir):
+        for path in linter.iter_python_files([tests_dir]):
+            rel = os.path.relpath(path, root).replace(os.sep, "/")
+            with open(path, encoding="utf-8") as f:
+                findings += audit_test_version_literals(f.read(), rel)
+
+    # SLC006: config_spec tables vs loader fields
+    findings += audit_config_spec(
+        _read(root, "docs/config_spec.md"), "docs/config_spec.md",
+    )
+
+    # SLC007: policy sets
+    from shadow_tpu.core.supervisor import BackendSupervisor
+
+    findings += audit_policy_sets(
+        py_sources.get("shadow_tpu/core/config.py", ""),
+        "shadow_tpu/core/config.py", BackendSupervisor.POLICIES,
+        docs_text=ft_md, docs_relpath="docs/fault_tolerance.md",
+    )
+
+    # SLC008: the plan registry itself
+    findings += audit_plan_registry(
+        plan_mod.ALL_OPS, set(plan_mod._FIELDS),
+    )
+
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.code))
+    return findings
+
+
+CONTRACT_RULES = {
+    "SLC001": "metric emitter outside the namespace table",
+    "SLC002": "registered metric namespace with no emitter",
+    "SLC003": "fault op with no injector-handler arm",
+    "SLC004": "fault-op docs table drift",
+    "SLC005": "stale schema-version literal",
+    "SLC006": "config_spec table drift",
+    "SLC007": "supervisor policy set drift",
+    "SLC008": "fault-op registry drift",
+}

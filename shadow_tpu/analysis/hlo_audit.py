@@ -33,6 +33,8 @@ the HLO-parsing logic.
 
 from __future__ import annotations
 
+import json
+import os
 import re
 from dataclasses import dataclass, field
 from typing import Callable
@@ -87,18 +89,21 @@ def gather_is_serializing(line: str) -> bool:
 
 
 def scatter_lines(hlo: str) -> list[str]:
+    # (?<!-): a `reduce-scatter` collective is not the banned op
     return [
         ln.strip()[:120]
         for ln in hlo.splitlines()
-        if re.search(r"= .*\bscatter\(", ln)
+        if re.search(r"= .*(?<!-)\bscatter\(", ln)
     ]
 
 
 def serializing_gather_lines(hlo: str) -> list[str]:
+    # (?<!-): an `all-gather` collective is not a fetch gather
     return [
         ln.strip()[:120]
         for ln in hlo.splitlines()
-        if re.search(r"= .*\bgather\(", ln) and gather_is_serializing(ln)
+        if re.search(r"= .*(?<!-)\bgather\(", ln)
+        and gather_is_serializing(ln)
     ]
 
 
@@ -382,6 +387,293 @@ def assert_variants_clean(variants: list[KernelVariant]) -> None:
             f"{len(lines)} op-contract violation(s) across "
             f"{len(bad)} kernel variant(s):\n" + "\n".join(lines)
         )
+
+
+# ---------------------------------------------------------------------------
+# the HLO budget ledger (ISSUE 14): per-variant op accounting vs a
+# checked-in baseline
+# ---------------------------------------------------------------------------
+#
+# The point asserts above gate *classes* of violation (any scatter, any
+# new serializing gather, sorts past a structural bound).  The ledger
+# gates *drift*: an exact per-variant account of collective counts by
+# kind, sort count + row volume, gather/scatter counts, and estimated
+# buffer bytes, checked against shadow_tpu/analysis/hlo_baseline.json.
+# A lowering regression — a new all-gather on the mesh path, a
+# sort-volume blowup that still fits under the 4x structural slack —
+# fails with a field-level diff against the ledger instead of slipping
+# under a hand-pinned allowance.  Regenerate legitimately (an intended
+# kernel change) with:
+#
+#   python tools/shadowlint.py --hlo --write-hlo-baseline --virtual-devices 8
+#
+# (the virtual-device force lets the mesh/shard_map cells lower on a CPU
+# box; without it those cells are skipped and their baseline entries
+# kept).
+
+HLO_BASELINE_NAME = "hlo_baseline.json"
+HLO_BASELINE_VERSION = 1
+
+COLLECTIVE_KINDS = (
+    "all-gather", "all-reduce", "reduce-scatter", "collective-permute",
+    "all-to-all",
+)
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8, "f64": 8,
+    "c64": 8, "c128": 16,
+}
+
+_SHAPE_RE = re.compile(
+    r"\b(pred|s8|u8|s16|u16|f16|bf16|s32|u32|f32|s64|u64|f64|c64|c128)"
+    r"\[([0-9,]*)\]"
+)
+
+
+class HloBaselineError(ValueError):
+    """The checked-in HLO baseline is missing, corrupt or version-skewed
+    (the CLI maps this to exit 2 with a regeneration hint)."""
+
+
+def _shape_token_bytes(dtype: str, dims: str) -> int:
+    n = 1
+    for d in dims.split(","):
+        if d:
+            n *= int(d)
+    return n * _DTYPE_BYTES[dtype]
+
+
+def collective_counts(hlo: str) -> dict[str, int]:
+    """Per-kind collective-op counts (the async `-start` form counts,
+    the `-done` completion of the same op does not)."""
+    counts = {k: 0 for k in COLLECTIVE_KINDS}
+    for line in hlo.splitlines():
+        if "= " not in line:
+            continue
+        for kind in COLLECTIVE_KINDS:
+            if re.search(rf"= .*\b{kind}(-start)?\(", line):
+                counts[kind] += 1
+    return {k: v for k, v in counts.items() if v}
+
+
+def estimate_buffer_bytes(hlo: str) -> dict[str, int]:
+    """Peak-buffer proxies parsed from the optimized-HLO text: the
+    entry parameters' total (resident state the kernel is bound over)
+    and the largest single tensor any instruction materializes (the
+    dominant working-set term — sort temporaries and exchange buffers
+    show up here).  Proxies, not an allocator replay: they move when and
+    only when the compiled program's shapes move, which is exactly the
+    regression signal the ledger wants."""
+    param_bytes = 0
+    largest = 0
+    for line in hlo.splitlines():
+        if "= " not in line:
+            continue
+        line_best = 0
+        for m in _SHAPE_RE.finditer(line):
+            line_best = max(
+                line_best, _shape_token_bytes(m.group(1), m.group(2))
+            )
+        largest = max(largest, line_best)
+        if re.search(r"\bparameter\(\d+\)", line):
+            m = _SHAPE_RE.search(line)
+            if m:
+                param_bytes += _shape_token_bytes(m.group(1), m.group(2))
+    return {"param_bytes": param_bytes, "largest_tensor_bytes": largest}
+
+
+def hlo_budget(hlo: str) -> dict:
+    """The ledger row for one compiled program."""
+    rows = sort_rows(hlo)
+    return {
+        "collectives": collective_counts(hlo),
+        "sorts": len(rows),
+        "sort_rows": sum(rows),
+        "gathers": len([
+            ln for ln in hlo.splitlines()
+            if re.search(r"= .*(?<!-)\bgather\(", ln)
+        ]),
+        "serializing_gathers": len(serializing_gather_lines(hlo)),
+        "scatters": len(scatter_lines(hlo)),
+        **estimate_buffer_bytes(hlo),
+    }
+
+
+_EXACT_BUDGET_KEYS = (
+    "sorts", "sort_rows", "gathers", "serializing_gathers", "scatters",
+)
+_BYTES_BUDGET_KEYS = ("param_bytes", "largest_tensor_bytes")
+
+
+def diff_budget(label: str, cur: dict, base: dict,
+                bytes_tol: float = 0.25) -> list[str]:
+    """Field-level differences of one variant's budget against its
+    ledger entry.  Count fields compare exactly; the byte proxies
+    tolerate `bytes_tol` relative drift (layout/padding jitter across
+    compiler point releases must not cry wolf)."""
+    out = []
+    kinds = sorted(set(cur.get("collectives", {}))
+                   | set(base.get("collectives", {})))
+    for kind in kinds:
+        c = cur.get("collectives", {}).get(kind, 0)
+        b = base.get("collectives", {}).get(kind, 0)
+        if c != b:
+            out.append(
+                f"{label}: {kind} count {c} != ledger {b}"
+                + (" (a NEW collective on this path)" if c > b else
+                   " (ledger is stale — regenerate to ratchet down)")
+            )
+    for key in _EXACT_BUDGET_KEYS:
+        c, b = cur.get(key, 0), base.get(key, 0)
+        if c != b:
+            out.append(f"{label}: {key} {c} != ledger {b}")
+    for key in _BYTES_BUDGET_KEYS:
+        c, b = cur.get(key, 0), base.get(key, 0)
+        lo, hi = b * (1 - bytes_tol), b * (1 + bytes_tol)
+        if not (lo <= c <= hi):
+            out.append(
+                f"{label}: {key} {c} outside ledger {b} "
+                f"(±{int(bytes_tol * 100)}%)"
+            )
+    return out
+
+
+def budget_ledger(variants: list[KernelVariant]) -> dict[str, dict]:
+    """{label: budget} over the variant cells (one compile each)."""
+    return {v.label: hlo_budget(v.hlo()) for v in variants}
+
+
+def baseline_path(root: str | None = None) -> str:
+    if root is not None:
+        return os.path.join(
+            root, "shadow_tpu", "analysis", HLO_BASELINE_NAME
+        )
+    return os.path.join(os.path.dirname(__file__), HLO_BASELINE_NAME)
+
+
+def load_hlo_baseline(path: str | None = None) -> dict[str, dict]:
+    path = path or baseline_path()
+    if not os.path.exists(path):
+        raise HloBaselineError(
+            f"HLO baseline {path} is missing — regenerate with "
+            f"`python tools/shadowlint.py --hlo --write-hlo-baseline "
+            f"--virtual-devices 8`"
+        )
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        raise HloBaselineError(
+            f"HLO baseline {path} is unreadable ({e}) — regenerate with "
+            f"`python tools/shadowlint.py --hlo --write-hlo-baseline`"
+        ) from e
+    if doc.get("version") != HLO_BASELINE_VERSION:
+        raise HloBaselineError(
+            f"HLO baseline {path}: version {doc.get('version')!r} != "
+            f"{HLO_BASELINE_VERSION} — regenerate with "
+            f"`python tools/shadowlint.py --hlo --write-hlo-baseline`"
+        )
+    return doc.get("entries", {})
+
+
+def write_hlo_baseline(ledger: dict[str, dict],
+                       path: str | None = None) -> dict:
+    import jax
+
+    path = path or baseline_path()
+    doc = {
+        "version": HLO_BASELINE_VERSION,
+        # informational only (never compared): the toolchain the budgets
+        # were captured under, so a diff after a jax upgrade reads right
+        "jax": jax.__version__,
+        "entries": {k: ledger[k] for k in sorted(ledger)},
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return doc
+
+
+def check_ledger(
+    ledger: dict[str, dict], baseline: dict[str, dict],
+    bytes_tol: float = 0.25,
+) -> list[str]:
+    """Every lowered variant against its ledger entry.  Variants in the
+    baseline but not lowered in THIS environment (mesh cells on a
+    single-device box) are skipped — each environment audits what it can
+    compile; tests and the smoke gates between them cover the union."""
+    problems: list[str] = []
+    for label in sorted(ledger):
+        if label not in baseline:
+            problems.append(
+                f"{label}: variant has no ledger entry — a new kernel "
+                f"cell landed without regenerating hlo_baseline.json "
+                f"(`python tools/shadowlint.py --hlo "
+                f"--write-hlo-baseline`)"
+            )
+            continue
+        problems.extend(
+            diff_budget(label, ledger[label], baseline[label], bytes_tol)
+        )
+    return problems
+
+
+def default_ledger_variants(include_mesh: bool | None = None
+                            ) -> list[KernelVariant]:
+    """The canonical tiny builds whose kernels the ledger accounts:
+    {conservative, optimistic} x {global, islands, fleet} x gear plus
+    the async islands loop, and — when >= 2 devices are visible — the
+    shard_map mesh cells whose frontier exchange must stay
+    neighbor-only.  Builder parameters are pinned HERE so budgets are
+    comparable across the test process, the bench gate, and the
+    regeneration CLI."""
+    import jax
+
+    from shadow_tpu.flagship import SELF_LOOP_50MS_GML, build_phold_flagship
+    from shadow_tpu.fleet import JobSpec, build_fleet
+
+    if include_mesh is None:
+        include_mesh = len(jax.devices()) >= 2
+
+    def tiny(**kw):
+        return build_phold_flagship(
+            32, msgload=2, stop_s=2, runtime_s=2, seed=3,
+            event_capacity=2048, pool_gears=2, **kw)
+
+    def fleet_cfg(seed):
+        return {
+            "general": {"stop_time": "1 s", "seed": seed},
+            "network": {
+                "graph": {"type": "gml", "inline": SELF_LOOP_50MS_GML}
+            },
+            "experimental": {
+                "event_capacity": 1024, "events_per_host_per_window": 8,
+                "outbox_slots": 8, "inbox_slots": 4, "pool_gears": 2,
+            },
+            "hosts": {"peer": {
+                "quantity": 8, "app_model": "phold",
+                "app_options": {"msgload": 2, "runtime": 2,
+                                "start_time": "100 ms"},
+            }},
+        }
+
+    out: list[KernelVariant] = []
+    out += variants_for_sim(tiny(), "global")
+    out += variants_for_sim(
+        tiny(num_shards=2, exchange_slots=16), "islands")
+    out += variants_for_fleet(build_fleet(
+        [JobSpec("a", fleet_cfg(1)), JobSpec("b", fleet_cfg(2))]))
+    if include_mesh:
+        # the mesh hot path: shard_map lowering, where collectives
+        # survive to HLO — the cells whose all-gather count the ledger
+        # (and audit_hlo's zero-pin) must hold at 0
+        out += variants_for_sim(
+            tiny(num_shards=2, exchange_slots=16,
+                 island_mode="shard_map"),
+            "mesh", sync_modes=("conservative",),
+        )
+    return out
 
 
 # ---------------------------------------------------------------------------

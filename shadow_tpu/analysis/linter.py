@@ -30,6 +30,8 @@ from shadow_tpu.analysis import rules as rules_mod
 
 BASELINE_NAME = ".shadowlint_baseline.json"
 BASELINE_VERSION = 1
+# the findings_doc JSON report: v2 added the per-pass `passes` counts
+REPORT_SCHEMA_VERSION = 2
 
 # The kernel/host module map (repo-relative, forward slashes).  These
 # modules produce code that is traced into compiled device programs.
@@ -239,15 +241,21 @@ def write_baseline(findings: list[Finding], path: str) -> dict:
 
 
 def findings_doc(
-    new: list[Finding], grandfathered: list[Finding], scanned: list[str]
+    new: list[Finding], grandfathered: list[Finding], scanned: list[str],
+    passes: dict[str, int] | None = None,
 ) -> dict:
-    """The machine-readable report (`tools/shadowlint.py --format json`)."""
+    """The machine-readable report (`tools/shadowlint.py --format json`).
+
+    Schema v2 (ISSUE 14): `passes` carries per-pass NEW-finding counts —
+    {"lint": n, "contracts": n, "threads": n, "hlo": n} for whichever
+    passes ran — alongside the flat findings list; v1 documents carried
+    the lint pass only and no `passes` object."""
     by_code: dict[str, int] = {}
     for f in new:
         by_code[f.code] = by_code.get(f.code, 0) + 1
     return {
         "kind": "shadow_tpu.shadowlint",
-        "schema_version": 1,
+        "schema_version": REPORT_SCHEMA_VERSION,
         "ok": not new,
         "files_scanned": len(scanned),
         "findings": [asdict(f) for f in new],
@@ -257,6 +265,7 @@ def findings_doc(
             "grandfathered": len(grandfathered),
             "by_code": dict(sorted(by_code.items())),
         },
+        "passes": dict(sorted((passes or {"lint": len(new)}).items())),
         "rules": {
             r.code: r.summary for r in rules_mod.RULES
         },

@@ -1,0 +1,439 @@
+"""Pass 4 of shadowlint: the host-thread race lint (codes STH0xx).
+
+The serve daemon made the host side a real multi-threaded program: an
+HTTP handler pool, a worker loop, and POSIX signal handlers all touch
+the same scheduler state, with mutual exclusion maintained by hand.  The
+device plane's determinism story ends at the handoff boundary — a torn
+queue or a lost journal record on the host corrupts a run just as surely
+as a kernel race would.
+
+This pass applies Eraser-style *declared-guard* discipline statically
+(Savage et al.'s lockset idea, restricted to what an AST can see) over
+the declared thread-bearing host modules:
+
+  STH001  write to a lock-guarded attribute outside the lock
+  STH002  condition wait/notify without holding the condition's lock
+  STH003  signal-handler method touches non-Event shared state
+  STH004  `lock.acquire(blocking=False)` — silently skips mutual
+          exclusion when contended (the drain-path smell class)
+
+Model, per class in a scanned module:
+
+* **Locks** are attributes assigned ``threading.Lock()`` / ``RLock()``
+  in ``__init__``; **conditions** are ``threading.Condition(...)``
+  (holding a condition counts as holding its lock); **events** are
+  ``threading.Event()`` (atomic, safe anywhere — the one thing a signal
+  handler may touch).
+* A class participates when it spawns a thread (``threading.Thread``),
+  installs a signal handler, or declares a lock.
+* The **guarded set** is inferred from the class's own discipline: any
+  attribute accessed at least once under a ``with <lock>:`` block is
+  declared guarded; writes to it anywhere else must hold the lock too.
+  (Reads outside the lock are out of scope — too many benign
+  racy-read-then-lock-and-check idioms; the write side is where state
+  tears.)
+* A method whose every intra-class call site sits inside a locked
+  region is a **locked-context** method (``retry_after_s`` called only
+  from ``with self._lock`` bodies); its accesses count as held.
+  ``__init__`` is construction-time single-threaded and exempt.
+* Locked regions: ``with self._lock`` / ``with self._wake`` bodies, the
+  body of ``if self._lock.acquire(timeout=...):``, and statements
+  between a blocking ``.acquire()`` call and the matching
+  ``.release()`` in the same block.
+
+Suppression: ``# noqa: STH0xx`` on the flagged line, or a
+``DECLARED_SAFE`` entry naming (module, class) -> attributes that are
+intentionally lock-free (reviewed owner-thread-only state).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+
+from shadow_tpu.analysis import linter
+from shadow_tpu.analysis.linter import Finding
+from shadow_tpu.analysis.rules import build_imports, resolve_name
+
+# The thread-bearing host modules (repo-relative).  Modules without a
+# lock-declaring class scan clean by construction — they stay listed so
+# the day one of them grows a thread, the discipline applies.
+THREAD_MODULES = (
+    "shadow_tpu/serve/daemon.py",
+    "shadow_tpu/serve/journal.py",
+    "shadow_tpu/fleet/scheduler.py",
+    "shadow_tpu/core/supervisor.py",
+    "shadow_tpu/parallel/elastic.py",
+)
+
+# (relpath, classname) -> attrs intentionally shared without the lock.
+# Empty on purpose: additions must name the exact site so review sees
+# them (the CALLBACK_ALLOWLIST posture).
+DECLARED_SAFE: dict[tuple[str, str], frozenset[str]] = {}
+
+_MUTATORS = {
+    "append", "extend", "insert", "remove", "pop", "popleft", "clear",
+    "update", "setdefault", "add", "discard", "sort", "reverse",
+}
+_COND_OPS = {"wait", "wait_for", "notify", "notify_all"}
+
+_LOCK_CTORS = {"threading.Lock", "threading.RLock"}
+_COND_CTORS = {"threading.Condition"}
+_EVENT_CTORS = {"threading.Event"}
+_THREAD_CTORS = {"threading.Thread"}
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    """`self.<attr>` -> attr name (else None)."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+@dataclass
+class ClassModel:
+    name: str
+    node: ast.ClassDef
+    locks: set[str] = field(default_factory=set)
+    conds: set[str] = field(default_factory=set)
+    events: set[str] = field(default_factory=set)
+    spawns_threads: bool = False
+    handler_methods: set[str] = field(default_factory=set)
+    methods: dict[str, ast.AST] = field(default_factory=dict)
+
+    def lock_like(self) -> set[str]:
+        return self.locks | self.conds
+
+
+@dataclass
+class _Access:
+    node: ast.AST
+    attr: str
+    kind: str  # "write" | "mutate" | "read" | "cond" | "acquire_nb"
+    held: bool
+    method: str
+
+
+def _is_lock_expr(model: ClassModel, node: ast.AST) -> bool:
+    a = _self_attr(node)
+    return a is not None and a in model.lock_like()
+
+
+def _acquire_is_blocking(call: ast.Call) -> bool:
+    """False only for `.acquire(blocking=False)` / `.acquire(False)`."""
+    for kw in call.keywords:
+        if kw.arg == "blocking" and isinstance(kw.value, ast.Constant):
+            return bool(kw.value.value)
+    if call.args and isinstance(call.args[0], ast.Constant):
+        return bool(call.args[0].value)
+    return True
+
+
+def _collect_model(tree: ast.AST, imports: dict[str, str]) -> list[ClassModel]:
+    models = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        model = ClassModel(name=node.name, node=node)
+        for item in node.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                model.methods[item.name] = item
+        init = model.methods.get("__init__")
+        if init is not None:
+            for n in ast.walk(init):
+                if not (isinstance(n, ast.Assign) and isinstance(
+                        n.value, ast.Call)):
+                    continue
+                ctor = resolve_name(n.value.func, imports)
+                for t in n.targets:
+                    attr = _self_attr(t)
+                    if attr is None:
+                        continue
+                    if ctor in _LOCK_CTORS:
+                        model.locks.add(attr)
+                    elif ctor in _COND_CTORS:
+                        model.conds.add(attr)
+                    elif ctor in _EVENT_CTORS:
+                        model.events.add(attr)
+        for n in ast.walk(node):
+            if isinstance(n, ast.Call):
+                name = resolve_name(n.func, imports)
+                if name in _THREAD_CTORS:
+                    model.spawns_threads = True
+                elif name == "signal.signal" and len(n.args) >= 2:
+                    h = n.args[1]
+                    if isinstance(h, ast.Lambda) and isinstance(
+                            h.body, ast.Call):
+                        attr = _self_attr(h.body.func)
+                        if attr:
+                            model.handler_methods.add(attr)
+                    else:
+                        attr = _self_attr(h)
+                        if attr:
+                            model.handler_methods.add(attr)
+        models.append(model)
+    return models
+
+
+def _walk_method(model: ClassModel, mname: str, fn: ast.AST,
+                 out: list[_Access]) -> None:
+    """Record attribute accesses with lock-held status.  Linear walk of
+    each statement list tracking manual acquire()/release() pairs; with-
+    blocks and `if lock.acquire(...):` bodies set held for their suite."""
+
+    def expr_accesses(node: ast.AST, held: bool) -> None:
+        for n in ast.walk(node):
+            if isinstance(n, ast.Call):
+                attr = _self_attr(n.func.value) if isinstance(
+                    n.func, ast.Attribute) else None
+                if attr is not None:
+                    meth = n.func.attr
+                    if attr in model.lock_like() and meth == "acquire" \
+                            and not _acquire_is_blocking(n):
+                        out.append(_Access(n, attr, "acquire_nb", held,
+                                           mname))
+                    elif attr in model.conds and meth in _COND_OPS:
+                        out.append(_Access(n, attr, "cond", held, mname))
+                    elif meth in _MUTATORS and attr not in model.lock_like():
+                        out.append(_Access(n, attr, "mutate", held, mname))
+            elif isinstance(n, ast.Attribute) and isinstance(
+                    n.ctx, ast.Load):
+                attr = _self_attr(n)
+                if attr is not None:
+                    out.append(_Access(n, attr, "read", held, mname))
+
+    def target_accesses(t: ast.AST, node: ast.AST, held: bool) -> None:
+        attr = _self_attr(t)
+        if attr is not None:
+            out.append(_Access(node, attr, "write", held, mname))
+            return
+        if isinstance(t, ast.Subscript):
+            # self.d[k] = v / self.d[k] += 1: a mutation of self.d
+            attr = _self_attr(t.value)
+            if attr is not None:
+                out.append(_Access(node, attr, "mutate", held, mname))
+        if isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                target_accesses(e, node, held)
+
+    def walk_body(body: list[ast.stmt], held: bool) -> None:
+        held_here = held
+        for stmt in body:
+            walk_stmt(stmt, held_here)
+            # manual acquire/release tracking within this suite
+            if isinstance(stmt, ast.Expr) and isinstance(
+                    stmt.value, ast.Call):
+                call = stmt.value
+                if isinstance(call.func, ast.Attribute) and _is_lock_expr(
+                        model, call.func.value):
+                    if call.func.attr == "acquire" and \
+                            _acquire_is_blocking(call):
+                        held_here = True
+                    elif call.func.attr == "release":
+                        held_here = held
+
+    def walk_stmt(stmt: ast.stmt, held: bool) -> None:
+        if isinstance(stmt, ast.With):
+            locked = held or any(
+                _is_lock_expr(model, item.context_expr)
+                for item in stmt.items
+            )
+            for item in stmt.items:
+                expr_accesses(item.context_expr, held)
+            walk_body(stmt.body, locked)
+        elif isinstance(stmt, ast.If):
+            test_locks = False
+            if isinstance(stmt.test, ast.Call) and isinstance(
+                    stmt.test.func, ast.Attribute):
+                if (_is_lock_expr(model, stmt.test.func.value)
+                        and stmt.test.func.attr == "acquire"
+                        and _acquire_is_blocking(stmt.test)):
+                    test_locks = True
+            expr_accesses(stmt.test, held)
+            walk_body(stmt.body, held or test_locks)
+            walk_body(stmt.orelse, held)
+        elif isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = (
+                stmt.targets if isinstance(stmt, ast.Assign)
+                else [stmt.target]
+            )
+            for t in targets:
+                target_accesses(t, stmt, held)
+            if getattr(stmt, "value", None) is not None:
+                expr_accesses(stmt.value, held)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            expr_accesses(stmt.iter, held)
+            walk_body(stmt.body, held)
+            walk_body(stmt.orelse, held)
+        elif isinstance(stmt, ast.While):
+            expr_accesses(stmt.test, held)
+            walk_body(stmt.body, held)
+            walk_body(stmt.orelse, held)
+        elif isinstance(stmt, ast.Try):
+            walk_body(stmt.body, held)
+            for h in stmt.handlers:
+                walk_body(h.body, held)
+            walk_body(stmt.orelse, held)
+            walk_body(stmt.finalbody, held)
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+            pass  # nested defs analyzed only via their own call sites
+        else:
+            expr_accesses(stmt, held)
+
+    walk_body(fn.body, False)
+
+
+def _analyze_class(model: ClassModel, relpath: str,
+                   declared_safe: frozenset[str]) -> list[Finding]:
+    accesses: list[_Access] = []
+    for mname, fn in model.methods.items():
+        if mname == "__init__":
+            continue
+        _walk_method(model, mname, fn, accesses)
+
+    # locked-context methods: every intra-class call site (a
+    # `self.<method>` load) sits inside a locked region, directly or via
+    # a caller that is itself locked-context — fixpoint over the class
+    method_sites: dict[str, list[_Access]] = {}
+    for a in accesses:
+        if a.kind == "read" and a.attr in model.methods:
+            method_sites.setdefault(a.attr, []).append(a)
+    locked_ctx: set[str] = set()
+    changed = True
+    while changed:
+        changed = False
+        for m, sites in method_sites.items():
+            if m in locked_ctx:
+                continue
+            if sites and all(
+                a.held or a.method in locked_ctx for a in sites
+            ):
+                locked_ctx.add(m)
+                changed = True
+
+    def effective_held(a: _Access) -> bool:
+        return a.held or a.method in locked_ctx
+
+    special = model.lock_like() | model.events
+    guarded = {
+        a.attr for a in accesses
+        if effective_held(a) and a.attr not in special
+        and a.attr not in model.methods
+    } - declared_safe
+
+    findings: list[Finding] = []
+    for a in accesses:
+        if a.kind == "acquire_nb":
+            findings.append(Finding(
+                path=relpath, line=a.node.lineno, col=a.node.col_offset,
+                code="STH004",
+                message=(
+                    f"`{model.name}.{a.attr}.acquire(blocking=False)` "
+                    f"silently skips mutual exclusion when contended — "
+                    f"use `with {a.attr}` or a bounded "
+                    f"`acquire(timeout=...)`"
+                ),
+                text="",
+            ))
+        elif a.kind == "cond" and not effective_held(a):
+            findings.append(Finding(
+                path=relpath, line=a.node.lineno, col=a.node.col_offset,
+                code="STH002",
+                message=(
+                    f"condition wait/notify on `{a.attr}` outside its "
+                    f"lock in {model.name}.{a.method} — both require "
+                    f"the condition's lock held"
+                ),
+                text="",
+            ))
+        elif a.kind in ("write", "mutate") and a.attr in guarded \
+                and not effective_held(a):
+            findings.append(Finding(
+                path=relpath, line=a.node.lineno, col=a.node.col_offset,
+                code="STH001",
+                message=(
+                    f"write to `{model.name}.{a.attr}` outside the "
+                    f"declared lock in {a.method}() — the attribute is "
+                    f"lock-guarded elsewhere in the class"
+                ),
+                text="",
+            ))
+
+    # STH003: handler methods may only touch Events / declared-safe state
+    for h in sorted(model.handler_methods):
+        fn = model.methods.get(h)
+        if fn is None:
+            continue
+        for a in accesses:
+            if a.method != h or a.kind not in ("write", "mutate"):
+                continue
+            if a.attr in model.events or a.attr in declared_safe:
+                continue
+            if effective_held(a):
+                continue  # lock held: the handler did it properly
+            findings.append(Finding(
+                path=relpath, line=a.node.lineno, col=a.node.col_offset,
+                code="STH003",
+                message=(
+                    f"signal handler `{model.name}.{h}` writes "
+                    f"`self.{a.attr}` — handlers may only touch Events "
+                    f"and declared-safe state (they interrupt the worker "
+                    f"mid-critical-section)"
+                ),
+                text="",
+            ))
+    return findings
+
+
+def lint_threads_source(src: str, relpath: str) -> list[Finding]:
+    """Race-lint one module's source (fixture entry point)."""
+    relpath = relpath.replace(os.sep, "/")
+    tree = ast.parse(src, filename=relpath)
+    imports = build_imports(tree)
+    lines = src.splitlines()
+    findings: list[Finding] = []
+    for model in _collect_model(tree, imports):
+        if not (model.locks or model.conds or model.spawns_threads
+                or model.handler_methods):
+            continue
+        if not model.lock_like():
+            continue  # no declared guard to check against
+        safe = DECLARED_SAFE.get((relpath, model.name), frozenset())
+        findings.extend(_analyze_class(model, relpath, safe))
+    out = []
+    for f in findings:
+        text = (
+            lines[f.line - 1].strip() if 0 < f.line <= len(lines) else ""
+        )
+        if linter._suppressed(text, f.code):
+            continue
+        out.append(Finding(path=f.path, line=f.line, col=f.col,
+                           code=f.code, message=f.message, text=text))
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.code))
+    return out
+
+
+def lint_threads_paths(root: str, modules=THREAD_MODULES) -> list[Finding]:
+    findings: list[Finding] = []
+    for rel in modules:
+        path = os.path.join(root, rel)
+        if not os.path.exists(path):
+            continue
+        with open(path, encoding="utf-8") as f:
+            findings.extend(lint_threads_source(f.read(), rel))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.code))
+    return findings
+
+
+THREAD_RULES = {
+    "STH001": "guarded-attribute write outside the lock",
+    "STH002": "condition wait/notify without its lock",
+    "STH003": "signal handler touches non-Event state",
+    "STH004": "non-blocking lock acquire skips exclusion",
+}

@@ -2623,13 +2623,21 @@ class Simulation:
                     self.state = obs_mod.bump_win(
                         self.state, obs_mod.WIN_FAULTS
                     )
-                else:  # corrupt_file
+                elif f.op == "corrupt_file":
                     touched = inj_mod.corrupt_file(
                         f, default_dir=self.checkpoint_dir
                     )
                     self.fault_counters["files_corrupted"] += len(touched)
                     self.state = obs_mod.bump_win(
                         self.state, obs_mod.WIN_FAULTS
+                    )
+                else:
+                    # every DEVICE/FILE op must carry an explicit arm —
+                    # the contract auditor (analysis/contracts.py SLC003)
+                    # checks each registered op is named here, so a new
+                    # plan op cannot silently fall through
+                    raise RuntimeError(
+                        f"fault op {f.op!r} has no device-plane handler"
                     )
                 if obs is not None and obs.tracer:
                     obs.tracer.fault(
@@ -2650,8 +2658,13 @@ class Simulation:
                     sup.inject_kill_chip(f.chip, f.recover_after)
                 elif f.op == "exhaust_backend":
                     sup.inject_exhaust(f.recover_after)
-                else:  # stall_backend
+                elif f.op == "stall_backend":
                     sup.inject_stall(f.count)
+                else:
+                    # explicit arms only (contracts.py SLC003, as above)
+                    raise RuntimeError(
+                        f"fault op {f.op!r} has no backend handler"
+                    )
                 if obs is not None and obs.tracer:
                     obs.tracer.fault(
                         "fault_injection", op=f.op, at_ns=f.at_ns

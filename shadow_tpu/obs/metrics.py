@@ -25,6 +25,10 @@ import numpy as np
 
 from shadow_tpu.obs import counters as obs_counters
 
+# v13: dropped the never-emitted `bench` namespace from the closed
+# table — the contract auditor (analysis/contracts.py SLC002) requires
+# every registered namespace to have a statically-visible emitter, and
+# no gate ever wrote a bench.* key;
 # v12: elastic mesh resilience (parallel/elastic.py): mesh.chips_up/
 # chips_total posture gauges, mesh.chips_lost/relayouts/re_expansions/
 # relayout_downtime_ns/kernel_rebuilds/reexpand_holds counters for the
@@ -53,7 +57,7 @@ from shadow_tpu.obs import counters as obs_counters
 # obs/audit.py) + optional per-job `audit` sub-object on fleet.jobs[*]
 # rows; v4: optional top-level `fleet` section (fleet.jobs[*] per-job
 # rows) + fleet.* counters; v3: faults.* recovery counters
-SCHEMA_VERSION = 12
+SCHEMA_VERSION = 13
 DOC_KIND = "shadow_tpu.metrics"
 
 # metrics-doc `fleet.jobs[*]` rows must carry at least these keys
@@ -89,7 +93,6 @@ KNOWN_METRIC_NAMESPACES = frozenset({
     "mesh",        # multi-chip mesh execution plane (schema v11;
                    # elastic-resilience rows added in v12)
     "sim",         # build-level gauges (num_hosts, runahead)
-    "bench",       # bench.py gate-local rows
 })
 
 # Histograms keep exact count/sum/min/max plus a bounded sample buffer for

@@ -427,11 +427,17 @@ class ShadowDaemon:
     def drain(self) -> None:
         """Initiate graceful shutdown: the worker flushes the running
         fleet to its checkpoint, journals DRAIN, and exits. Runs from
-        signal handlers (which execute ON the worker thread, possibly
-        while it holds the lock), so the wake-up is best-effort
-        non-blocking — the worker polls the event every slice anyway."""
+        signal handlers, which execute ON the worker thread — possibly
+        while it holds the lock, so an unbounded blocking acquire could
+        deadlock against ourselves and a non-blocking one silently skips
+        the wake-up whenever an HTTP thread holds the lock (the race the
+        STH004 lint flags). A bounded acquire gets both: mutual
+        exclusion whenever the lock frees within the timeout, and a
+        guaranteed return either way — the worker polls the event every
+        0.25 s slice, so a skipped notify only delays, never loses, the
+        drain."""
         self._draining.set()
-        if self._lock.acquire(blocking=False):
+        if self._lock.acquire(timeout=1.0):
             try:
                 self._wake.notify_all()
             finally:
@@ -552,11 +558,11 @@ class ShadowDaemon:
             from shadow_tpu.core import pressure as pressure_mod
 
             try:
-                self._running_est_bytes = pressure_mod.estimate_hbm_bytes(
-                    fleet
-                )["total_bytes"]
+                est = pressure_mod.estimate_hbm_bytes(fleet)["total_bytes"]
             except Exception:
-                self._running_est_bytes = 0
+                est = 0
+            with self._lock:
+                self._running_est_bytes = est
             # first manifest BEFORE the first dispatch: a kill landing
             # anywhere after this point re-attaches instead of rebuilding
             save_fleet(fleet, ckpt_dir)
@@ -606,7 +612,8 @@ class ShadowDaemon:
                 self._running = None
             self._dump_metrics()
         finally:
-            self._running_est_bytes = 0
+            with self._lock:
+                self._running_est_bytes = 0
 
     def _settle(self, sid: str, fleet, wall_s: float) -> None:
         rows = fleet.results()

@@ -1,0 +1,19 @@
+"""Shared cross-plane contract helpers for the test suite.
+
+Before the contract auditor landed, every metrics schema bump hand-edited
+`assert doc["schema_version"] == N` in six test files (and whichever one
+was missed shipped stale).  Tests assert against the SOURCE constants
+through these helpers instead; `analysis/contracts.py` rule SLC005 flags
+any hard-coded literal comparison that creeps back in.
+"""
+
+from shadow_tpu.obs.metrics import DOC_KIND, SCHEMA_VERSION
+
+
+def assert_current_metrics_schema(doc: dict) -> None:
+    """The document is a current-schema metrics dump (kind + version
+    match the obs/metrics.py source constants)."""
+    assert doc.get("kind") == DOC_KIND, doc.get("kind")
+    assert doc.get("schema_version") == SCHEMA_VERSION, (
+        doc.get("schema_version"), SCHEMA_VERSION,
+    )

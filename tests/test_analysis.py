@@ -13,6 +13,13 @@ costs a window-kernel compile; tier-1 keeps one representative cell),
 and the retrace detector: one lowering per bound kernel across a driver
 run, with a forged dtype-drift retrace caught.
 
+Layers 3–5 (ISSUE 14): the cross-plane contract auditor (SLC0xx,
+contracts.py) with forged-drift fixtures per rule, the host-thread race
+lint (STH0xx, threads.py) with forged-race fixtures, and the HLO budget
+ledger (hlo_baseline.json) with a forged-regression diff — each firing
+exactly its rule code, with silent clean-tree controls, plus the
+load-bearing gates: the real tree audits clean under all three.
+
 Satellite regression: ProcessDriver per-host RNG streams are pure
 functions of (controller seed, host name) — the driver.py:626 unseeded
 default_factory bug class.
@@ -23,7 +30,7 @@ import os
 
 import pytest
 
-from shadow_tpu.analysis import hlo_audit, linter
+from shadow_tpu.analysis import contracts, hlo_audit, linter, threads
 from shadow_tpu.analysis.rules import RULES
 from shadow_tpu.flagship import build_phold_flagship
 
@@ -474,3 +481,456 @@ def test_fleet_sweep_is_one_compile():
     # the fleet smoke gate asserts on agrees)
     assert rep["compiles_total"] == 1
     assert rep["kernel_traces"] == 1
+
+
+# ---------------------------------------------------------------------------
+# layer 3: the cross-plane contract auditor (forged drift per rule code)
+# ---------------------------------------------------------------------------
+
+
+def _slc_codes(findings):
+    return [f.code for f in findings]
+
+
+@pytest.mark.quick
+def test_slc001_unregistered_namespace_emitter_fires():
+    known = frozenset({"engine"})
+    firing = (
+        "def f(reg):\n"
+        "    reg.counter_set('engine.ok', 1)\n"
+        "    reg.gauge_set('bogus.key', 1)\n"
+    )
+    out = contracts.audit_metric_sources({"x.py": firing}, known=known)
+    assert _slc_codes(out) == ["SLC001"]
+    control = "def f(reg):\n    reg.counter_set('engine.ok', 1)\n"
+    assert contracts.audit_metric_sources({"x.py": control}, known=known) == []
+
+
+@pytest.mark.quick
+def test_slc002_namespace_without_emitter_fires():
+    known = frozenset({"engine", "ghost"})
+    src = "def f(reg):\n    reg.counter_set('engine.ok', 1)\n"
+    out = contracts.audit_metric_sources({"x.py": src}, known=known)
+    assert _slc_codes(out) == ["SLC002"]
+    assert "ghost" in out[0].message
+    # helper-argument evidence counts: the `_sub_counter` prefix idiom
+    helper = (
+        "def f(reg, sub):\n"
+        "    reg.counter_set('engine.ok', 1)\n"
+        "    helper(reg, sub, 'ghost.nic')\n"
+    )
+    assert contracts.audit_metric_sources({"x.py": helper}, known=known) == []
+
+
+@pytest.mark.quick
+def test_slc003_fault_op_missing_handler_fires():
+    src = 'def tick(f):\n    if f.op == "kill_host":\n        pass\n'
+    out = contracts.audit_fault_handlers(
+        [("eng.py", src, frozenset({"kill_host", "skew_hosts"}))]
+    )
+    assert _slc_codes(out) == ["SLC003"]
+    assert "skew_hosts" in out[0].message
+    assert contracts.audit_fault_handlers(
+        [("eng.py", src, frozenset({"kill_host"}))]
+    ) == []
+
+
+@pytest.mark.quick
+def test_slc004_docs_op_table_drift_fires():
+    table = "| `kill_host` | device | quarantine |\n"
+    out = contracts.audit_doc_op_table(
+        table, "docs/x.md", frozenset({"kill_host", "skew_hosts"})
+    )
+    assert _slc_codes(out) == ["SLC004"]
+    stale = table + "| `vanished_op` | device | gone |\n"
+    out = contracts.audit_doc_op_table(
+        stale, "docs/x.md", frozenset({"kill_host"})
+    )
+    assert _slc_codes(out) == ["SLC004"] and "vanished_op" in out[0].message
+
+
+@pytest.mark.quick
+def test_slc005_stale_doc_sample_and_test_literal_fire():
+    md = (
+        "```json\n"
+        '{"kind": "shadow_tpu.metrics",\n'
+        ' "schema_version": 11}\n'
+        "```\n"
+    )
+    out = contracts.audit_doc_schema_versions(
+        md, "docs/x.md", {"shadow_tpu.metrics": 12}
+    )
+    assert _slc_codes(out) == ["SLC005"]
+    ok = md.replace("11", "12")
+    assert contracts.audit_doc_schema_versions(
+        ok, "docs/x.md", {"shadow_tpu.metrics": 12}
+    ) == []
+    # the test-literal arm: any hard-coded comparison is drift bait
+    src = "def test_x(doc):\n    assert doc['schema_version'] == 11\n"
+    out = contracts.audit_test_version_literals(src, "tests/test_x.py")
+    assert _slc_codes(out) == ["SLC005"]
+    helper = (
+        "from shadow_tpu.obs.metrics import SCHEMA_VERSION\n"
+        "def test_x(doc):\n"
+        "    assert doc['schema_version'] == SCHEMA_VERSION\n"
+    )
+    assert contracts.audit_test_version_literals(
+        helper, "tests/test_x.py") == []
+
+
+@pytest.mark.quick
+def test_slc006_config_spec_drift_fires():
+    md = (
+        "### `general`\n\n"
+        "| field | default | meaning |\n|---|---|---|\n"
+        "| `stop_time` | — | end |\n"
+        "| `vanished` | — | stale |\n"
+    )
+    out = contracts.audit_config_spec(
+        md, "docs/config_spec.md",
+        fields_by_section={"general": {"stop_time", "seed"}},
+        prose_documented={},
+    )
+    assert sorted(_slc_codes(out)) == ["SLC006", "SLC006"]
+    texts = " ".join(f.message for f in out)
+    assert "vanished" in texts and "seed" in texts
+    ok = md.replace("| `vanished` | — | stale |\n",
+                    "| `seed` | 1 | master seed |\n")
+    assert contracts.audit_config_spec(
+        ok, "docs/config_spec.md",
+        fields_by_section={"general": {"stop_time", "seed"}},
+        prose_documented={},
+    ) == []
+
+
+@pytest.mark.quick
+def test_slc007_policy_set_drift_fires():
+    src = 'if v not in ("wait", "cpu", "abort"):\n    raise ValueError(v)\n'
+    out = contracts.audit_policy_sets(
+        src, "cfg.py", ("wait", "cpu", "abort", "relayout")
+    )
+    assert _slc_codes(out) == ["SLC007"]
+    ok = src.replace('"abort"', '"abort", "relayout"')
+    assert contracts.audit_policy_sets(
+        ok, "cfg.py", ("wait", "cpu", "abort", "relayout")) == []
+
+
+@pytest.mark.quick
+def test_slc008_plan_registry_drift_fires():
+    out = contracts.audit_plan_registry(
+        frozenset({"kill_host", "new_op"}), {"kill_host"}
+    )
+    assert _slc_codes(out) == ["SLC008"] and "new_op" in out[0].message
+    out = contracts.audit_plan_registry(
+        frozenset({"kill_host"}), {"kill_host", "dead_row"}
+    )
+    assert _slc_codes(out) == ["SLC008"] and "dead_row" in out[0].message
+    assert contracts.audit_plan_registry(
+        frozenset({"kill_host"}), {"kill_host"}) == []
+
+
+@pytest.mark.quick
+def test_every_contract_rule_has_a_firing_fixture():
+    import re as re_mod
+
+    src = open(__file__, encoding="utf-8").read()
+    covered = set(re_mod.findall(r"def test_(slc\d+)_", src))
+    assert {c.lower() for c in contracts.CONTRACT_RULES} == covered
+
+
+def test_contract_auditor_tree_is_clean():
+    # the load-bearing gate: zero drift findings across the real tree
+    out = contracts.audit_tree(REPO)
+    assert not out, "cross-plane contract drift:\n" + "\n".join(
+        f.render() for f in out)
+
+
+# ---------------------------------------------------------------------------
+# layer 4: the host-thread race lint (forged races per rule code)
+# ---------------------------------------------------------------------------
+
+_TH_PREAMBLE = "import signal\nimport threading\n\n"
+
+# a class whose discipline is correct: every guarded access under the
+# lock, handler touches only the Event, bounded acquire on the wake path
+_TH_CLEAN = _TH_PREAMBLE + """\
+class Daemon:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._wake = threading.Condition(self._lock)
+        self._stop = threading.Event()
+        self.queue = []
+        signal.signal(signal.SIGTERM, lambda *_: self.drain())
+
+    def submit(self, x):
+        with self._lock:
+            self.queue.append(x)
+            self._wake.notify_all()
+
+    def worker(self):
+        with self._lock:
+            while not self.queue:
+                self._wake.wait(timeout=0.25)
+            return self.queue.pop(0)
+
+    def drain(self):
+        self._stop.set()
+        if self._lock.acquire(timeout=1.0):
+            try:
+                self._wake.notify_all()
+            finally:
+                self._lock.release()
+"""
+
+
+@pytest.mark.quick
+def test_thread_lint_clean_class_is_silent():
+    assert threads.lint_threads_source(_TH_CLEAN, "serve/d.py") == []
+
+
+@pytest.mark.quick
+def test_sth001_unguarded_write_fires():
+    src = _TH_CLEAN + """\
+
+    def racy(self):
+        self.queue.append(99)
+"""
+    out = threads.lint_threads_source(src, "serve/d.py")
+    assert [f.code for f in out] == ["STH001"]
+    assert "queue" in out[0].message
+
+
+@pytest.mark.quick
+def test_sth002_condition_wait_without_lock_fires():
+    src = _TH_CLEAN + """\
+
+    def impatient(self):
+        self._wake.wait(timeout=1.0)
+"""
+    out = threads.lint_threads_source(src, "serve/d.py")
+    assert [f.code for f in out] == ["STH002"]
+
+
+@pytest.mark.quick
+def test_sth003_handler_touching_shared_state_fires():
+    src = _TH_PREAMBLE + """\
+class Daemon:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self.state = {}
+        signal.signal(signal.SIGTERM, lambda *_: self.on_term())
+
+    def on_term(self):
+        self._stop.set()
+        self.state["dirty"] = True
+"""
+    out = threads.lint_threads_source(src, "serve/d.py")
+    assert [f.code for f in out] == ["STH003"]
+
+
+@pytest.mark.quick
+def test_sth004_nonblocking_acquire_fires():
+    src = _TH_PREAMBLE + """\
+class Daemon:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def skippy(self):
+        if self._lock.acquire(blocking=False):
+            self._lock.release()
+"""
+    out = threads.lint_threads_source(src, "serve/d.py")
+    assert [f.code for f in out] == ["STH004"]
+
+
+@pytest.mark.quick
+def test_thread_lint_locked_context_methods_are_not_flagged():
+    # a method called ONLY under the lock may touch guarded state
+    # lock-free itself (the daemon's retry_after_s idiom)
+    src = _TH_CLEAN + """\
+
+    def _depth(self):
+        return len(self.queue)
+
+    def info(self):
+        with self._lock:
+            return self._depth()
+"""
+    assert threads.lint_threads_source(src, "serve/d.py") == []
+
+
+@pytest.mark.quick
+def test_thread_lint_noqa_suppresses():
+    src = _TH_CLEAN + """\
+
+    def racy(self):
+        self.queue.append(99)  # noqa: STH001
+"""
+    assert threads.lint_threads_source(src, "serve/d.py") == []
+
+
+@pytest.mark.quick
+def test_every_thread_rule_has_a_firing_fixture():
+    import re as re_mod
+
+    src = open(__file__, encoding="utf-8").read()
+    covered = set(re_mod.findall(r"def test_(sth\d+)_", src))
+    assert {c.lower() for c in threads.THREAD_RULES} == covered
+
+
+def test_thread_lint_tree_is_clean():
+    # the load-bearing gate: the declared thread-bearing modules hold
+    # their lock discipline (the daemon's drain-path smell is FIXED)
+    out = threads.lint_threads_paths(REPO)
+    assert not out, "host-thread race findings:\n" + "\n".join(
+        f.render() for f in out)
+
+
+# ---------------------------------------------------------------------------
+# layer 5: the HLO budget ledger
+# ---------------------------------------------------------------------------
+
+_FORGED_LEDGER_HLO = "\n".join([
+    "  %p0 = s64[4,256]{1,0} parameter(0)",
+    "  %ag = s64[8,256]{1,0} all-gather(s64[4,256] %p0), dimensions={0}",
+    "  %s1 = s64[4,100]{1,0} sort(s64[4,100] %a), dimensions={1}",
+    "  %g = s64[8,2]{1,0} gather(s64[8,16]{1,0} %t, s32[8,2,2] %i), "
+    "slice_sizes={1,1}",
+    "  %cp = s64[4,256]{1,0} collective-permute(s64[4,256] %p0)",
+])
+
+
+@pytest.mark.quick
+def test_hlo_budget_accounts_forged_program():
+    b = hlo_audit.hlo_budget(_FORGED_LEDGER_HLO)
+    assert b["collectives"] == {"all-gather": 1, "collective-permute": 1}
+    assert b["sorts"] == 1 and b["sort_rows"] == 100
+    assert b["gathers"] == 1 and b["serializing_gathers"] == 1
+    assert b["scatters"] == 0
+    assert b["param_bytes"] == 4 * 256 * 8
+    assert b["largest_tensor_bytes"] == 8 * 256 * 8
+
+
+@pytest.mark.quick
+def test_ledger_diff_catches_regression_and_staleness():
+    base = hlo_audit.hlo_budget(_FORGED_LEDGER_HLO)
+    cur = json.loads(json.dumps(base))
+    assert hlo_audit.diff_budget("cell", cur, base) == []
+    # a NEW all-gather on the path: the mesh-regression class
+    cur["collectives"]["all-gather"] += 1
+    out = hlo_audit.diff_budget("cell", cur, base)
+    assert len(out) == 1 and "NEW collective" in out[0]
+    # sort-volume blowup inside the structural slack still diffs
+    cur = json.loads(json.dumps(base))
+    cur["sort_rows"] *= 2
+    assert any("sort_rows" in p for p in
+               hlo_audit.diff_budget("cell", cur, base))
+    # byte proxies tolerate layout jitter, fail real growth
+    cur = json.loads(json.dumps(base))
+    cur["largest_tensor_bytes"] = int(base["largest_tensor_bytes"] * 1.1)
+    assert hlo_audit.diff_budget("cell", cur, base) == []
+    cur["largest_tensor_bytes"] = int(base["largest_tensor_bytes"] * 2)
+    assert any("largest_tensor_bytes" in p for p in
+               hlo_audit.diff_budget("cell", cur, base))
+
+
+@pytest.mark.quick
+def test_ledger_missing_entry_and_missing_baseline_are_loud(tmp_path):
+    base = {"known/cell": hlo_audit.hlo_budget(_FORGED_LEDGER_HLO)}
+    out = hlo_audit.check_ledger(
+        {"new/cell": hlo_audit.hlo_budget(_FORGED_LEDGER_HLO)}, base
+    )
+    assert len(out) == 1 and "no ledger entry" in out[0]
+    # baseline entries this environment cannot lower are skipped
+    assert hlo_audit.check_ledger({}, base) == []
+    with pytest.raises(hlo_audit.HloBaselineError, match="regenerate"):
+        hlo_audit.load_hlo_baseline(str(tmp_path / "absent.json"))
+
+
+def test_ledger_representative_cell_matches_baseline():
+    """Tier-1 ledger gate: one representative cell lowers to EXACTLY its
+    checked-in budget (the full matrix runs in the slow tier)."""
+    baseline = hlo_audit.load_hlo_baseline()
+    vs = hlo_audit.default_ledger_variants(include_mesh=False)
+    v = next(x for x in vs if x.label == "global/conservative/gear0")
+    cur = hlo_audit.hlo_budget(v.hlo())
+    assert hlo_audit.diff_budget(v.label, cur, baseline[v.label]) == []
+
+
+@pytest.mark.slow
+def test_ledger_covers_every_variant_and_gates_mesh_all_gathers():
+    """ISSUE 14 acceptance: the checked-in ledger covers every kernel
+    variant hlo_audit lowers today (this process sees 8 virtual devices,
+    so the mesh/shard_map cells lower too), every cell matches its
+    budget, and the mesh hot path still compiles with ZERO all-gathers."""
+    baseline = hlo_audit.load_hlo_baseline()
+    vs = hlo_audit.default_ledger_variants(include_mesh=True)
+    ledger = hlo_audit.budget_ledger(vs)
+    assert set(ledger) == set(baseline)
+    problems = hlo_audit.check_ledger(ledger, baseline)
+    assert not problems, "\n".join(problems)
+    mesh_async = [k for k in ledger if k.startswith("mesh/async/")]
+    assert mesh_async
+    for k in mesh_async:
+        assert ledger[k]["collectives"].get("all-gather", 0) == 0, k
+        assert ledger[k]["collectives"].get("collective-permute", 0) > 0, k
+
+
+# ---------------------------------------------------------------------------
+# CLI failure modes: exit 2 + a one-line remediation hint, never a traceback
+# ---------------------------------------------------------------------------
+
+
+def _shadowlint_main():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "shadowlint_cli", os.path.join(REPO, "tools", "shadowlint.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.mark.quick
+def test_cli_exit2_on_unparseable_source(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text("def broken(:\n")
+    rc = _shadowlint_main().main([str(bad)])
+    assert rc == 2
+    err = capsys.readouterr().err
+    assert "hint:" in err and "syntax" in err
+
+
+@pytest.mark.quick
+def test_cli_exit2_on_unknown_rule_code(capsys):
+    rc = _shadowlint_main().main(["--select", "STL999"])
+    assert rc == 2
+    assert "hint:" in capsys.readouterr().err
+
+
+@pytest.mark.quick
+def test_cli_exit2_on_missing_hlo_baseline(tmp_path, capsys, monkeypatch):
+    # the baseline loads BEFORE any variant compiles, so this is fast
+    mod = _shadowlint_main()
+    monkeypatch.setattr(
+        hlo_audit, "baseline_path",
+        lambda root=None: str(tmp_path / "absent.json"),
+    )
+    rc = mod.main(["--hlo"])
+    assert rc == 2
+    err = capsys.readouterr().err
+    assert "hint:" in err and "--write-hlo-baseline" in err
+
+
+@pytest.mark.quick
+def test_cli_json_reports_per_pass_counts(tmp_path, capsys):
+    good = tmp_path / "ok.py"
+    good.write_text("x = 1\n")
+    rc = _shadowlint_main().main(
+        [str(good), "--threads", "--format", "json"])
+    out = capsys.readouterr().out
+    doc = json.loads(out)
+    assert rc == 0 and doc["ok"] is True
+    assert doc["passes"] == {"lint": 0, "threads": 0}
+    assert doc["schema_version"] == linter.REPORT_SCHEMA_VERSION

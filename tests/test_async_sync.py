@@ -16,6 +16,8 @@ import json
 import numpy as np
 import pytest
 
+from _contracts import assert_current_metrics_schema
+
 from shadow_tpu.core import simtime
 from shadow_tpu.parallel import lookahead as lookahead_mod
 from shadow_tpu.sim import build_simulation
@@ -420,7 +422,7 @@ def test_async_metrics_schema_v9(tmp_path):
     session.finalize(sim)
     doc = session.metrics.dump(str(tmp_path / "m.json"))
     obs_metrics.validate_metrics_doc(doc, strict_namespaces=True)
-    assert doc["schema_version"] == 12
+    assert_current_metrics_schema(doc)
     assert doc["counters"]["async.supersteps"] > 0
     assert doc["counters"]["async.shard_windows"] > 0
     assert "async.frontier_spread_max_ns" in doc["gauges"]

@@ -20,6 +20,8 @@ import jax
 import numpy as np
 import pytest
 
+from _contracts import assert_current_metrics_schema
+
 from shadow_tpu.obs import audit as audit_mod
 from shadow_tpu.obs import flight as flight_mod
 from shadow_tpu.sim import build_simulation
@@ -427,7 +429,7 @@ def test_sweep_cli_metrics_trace_and_digest_parity(tmp_path, capsys):
 
     doc = json.loads(m_out.read_text())
     obs_metrics.validate_metrics_doc(doc)
-    assert doc["schema_version"] == 12
+    assert_current_metrics_schema(doc)
     rows = doc["fleet"]["jobs"]
     assert len(rows) == 3 and all(r["status"] == "done" for r in rows)
     for row, seed in zip(rows, seeds):

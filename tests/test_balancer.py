@@ -17,6 +17,8 @@ The load-bearing properties:
 import numpy as np
 import pytest
 
+from _contracts import assert_current_metrics_schema
+
 from shadow_tpu.core import simtime
 from shadow_tpu.parallel import balancer as balancer_mod
 from shadow_tpu.parallel.balancer import (
@@ -389,7 +391,7 @@ def test_balance_metrics_schema_v10(tmp_path, control):
     session.finalize(sim)
     doc = session.metrics.dump(str(tmp_path / "m.json"))
     obs_metrics.validate_metrics_doc(doc, strict_namespaces=True)
-    assert doc["schema_version"] == 12
+    assert_current_metrics_schema(doc)
     assert doc["counters"]["balance.migrations"] >= 1
     assert doc["counters"]["balance.rebalances"] >= 1
     assert "balance.state" in doc["gauges"]

@@ -14,6 +14,8 @@ import jax
 import numpy as np
 import pytest
 
+from _contracts import assert_current_metrics_schema
+
 from shadow_tpu.fleet import (
     FleetError,
     JobSpec,
@@ -385,7 +387,7 @@ def test_metrics_schema_v5_fleet_section():
     obs_metrics.snapshot_fleet(fleet, reg)
     doc = reg.to_doc()
     obs_metrics.validate_metrics_doc(doc)
-    assert doc["schema_version"] == 12
+    assert_current_metrics_schema(doc)
     rows = doc["fleet"]["jobs"]
     assert len(rows) == 2
     assert all(r["status"] == "done" for r in rows)

@@ -16,6 +16,8 @@ import jax
 import numpy as np
 import pytest
 
+from _contracts import assert_current_metrics_schema
+
 from shadow_tpu.core import checkpoint, simtime
 from shadow_tpu.parallel import balancer as balancer_mod
 from shadow_tpu.parallel import lookahead as lookahead_mod
@@ -371,7 +373,7 @@ def test_mesh_metrics_v11(tmp_path):
     session = obs_metrics.ObsSession()
     session.finalize(sim)
     doc = session.metrics.dump(os.path.join(tmp_path, "m.json"))
-    assert doc["schema_version"] == 12
+    assert_current_metrics_schema(doc)
     obs_metrics.validate_metrics_doc(doc, strict_namespaces=True)
     assert doc["counters"]["mesh.frontier_exchange_bytes"] > 0
     assert doc["counters"]["mesh.exchange_rebuilds"] == 0

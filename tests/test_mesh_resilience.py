@@ -21,6 +21,8 @@ import os
 import numpy as np
 import pytest
 
+from _contracts import assert_current_metrics_schema
+
 from shadow_tpu.core import checkpoint as ckpt_mod
 from shadow_tpu.core.supervisor import BackendLost, BackendSupervisor, ChipLost
 from shadow_tpu.faults import plan as plan_mod
@@ -520,7 +522,7 @@ def test_metrics_v12_elastic_and_absent_on_non_mesh(baseline, tmp_path):
     reg = obs_metrics.MetricsRegistry()
     obs_metrics.snapshot_device(sim, reg)
     doc = reg.to_doc()
-    assert doc["schema_version"] == 12
+    assert_current_metrics_schema(doc)
     obs_metrics.validate_metrics_doc(doc, strict_namespaces=True)
     assert doc["counters"]["mesh.relayouts"] == 1
     assert doc["counters"]["mesh.re_expansions"] == 1

@@ -19,6 +19,8 @@ import sys
 
 import pytest
 
+from _contracts import assert_current_metrics_schema
+
 from shadow_tpu.core.supervisor import (
     BACKEND_LOST,
     BackendLost,
@@ -538,7 +540,7 @@ def test_metrics_schema_v6_resilience_namespace():
     reg = obs_metrics.MetricsRegistry()
     obs_metrics.snapshot_device(sim, reg)
     doc = reg.to_doc()
-    assert doc["schema_version"] == 12
+    assert_current_metrics_schema(doc)
     obs_metrics.validate_metrics_doc(doc)
     assert doc["counters"]["resilience.drains"] == 1
     assert doc["counters"]["resilience.failovers"] == 1

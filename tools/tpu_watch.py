@@ -45,8 +45,13 @@ STAGES = [
     # static-analysis gate first: pure CPU (AST walk + one tiny compile),
     # so it lands a row even while the accelerator is still flaky, and
     # every later capture runs against a lint-clean tree
-    ("lint_smoke", [PY, "bench.py", "--lint-smoke"], False, 1800),
-    ("shadowlint_json", [PY, "tools/shadowlint.py", "--format", "json"],
+    ("lint_smoke", [PY, "bench.py", "--lint-smoke"], False, 3600),
+    # all source-level passes in one stage: AST rules + cross-plane
+    # contract auditor + host-thread race lint (the HLO ledger rides the
+    # lint_smoke gate above, which pays the variant compiles once)
+    ("shadowlint_json",
+     [PY, "tools/shadowlint.py", "--contracts", "--threads",
+      "--format", "json"],
      False, 600),
     ("phold_16k", [PY, "bench.py"], False, 5400),
     ("audit_smoke", [PY, "bench.py", "--audit-smoke"], False, 7200),
