@@ -2023,6 +2023,211 @@ def stage_serve_smoke(num_hosts: int = 64, msgload: int = 2):
     }
 
 
+def stage_pipeline_smoke(hosts: int = 256, msgload: int = 2,
+                         stop_s: int = 12, wpd: int = 4,
+                         drain_ms: float = 40.0):
+    """Pipelined CPU↔TPU handoff gate (ISSUE 15 acceptance).
+
+    Four chain-equality arms prove the two-slot pipeline changes WHEN
+    dispatches are enqueued, never what they compute: {conservative,
+    optimistic, async-islands, fleet} each run pipelined AND serial
+    (`experimental.pipelined_dispatch: false`), audit chains + committed
+    events bit-identical per pair.
+
+    The wall-clock arm runs a HANDOFF-HEAVY conservative workload: short
+    fused dispatches (small windows_per_dispatch) with a per-handoff
+    host-drain model attached through `Simulation.add_handoff_hook` — a
+    blocking wait of `drain_ms` standing in for the managed-plane
+    syscall drain (procs/bridge.py waits on child-process IPC between
+    windows; the pure-device bench has no children, so the wait is
+    modeled, and it is WAIT, not host compute — exactly the latency
+    class the pipeline hides). Serial pays device + drain per boundary;
+    pipelined pays max(device, drain) — the gate demands >= 1.2x.
+
+    Also gated: the schema-v14 metrics artifact (pipeline.* recorded,
+    strict-validated), zero kernel retraces on the pipelined arm with
+    the same compile count as the serial arm (pipelining must not add a
+    compile), and trace-derived overlap efficiency > 0 (the
+    issue/await/host_drain spans tools/trace_summary.py reads).
+
+    CPU-deterministic (both arms share one backend), so no backend
+    wait."""
+    import importlib.util
+    import jax
+
+    from shadow_tpu.core import simtime
+    from shadow_tpu.analysis import hlo_audit
+    from shadow_tpu.flagship import build_phold_flagship
+    from shadow_tpu.fleet import JobSpec, build_fleet
+    from shadow_tpu.obs import metrics as obs_metrics
+    from shadow_tpu.obs.trace import ChromeTracer
+    from shadow_tpu.sim import build_simulation
+
+    _enable_compile_cache()
+
+    # ---- chain-equality arms (small, shared shapes) ----
+    gml = _async_smoke_gml(2, 4)
+
+    def small_cfg(pipelined, **exp):
+        hosts_d = {}
+        for v in range(8):
+            hosts_d[f"h{v:02d}"] = {
+                "quantity": 1, "network_node_id": v, "app_model": "phold",
+                "app_options": {"msgload": 1, "runtime": 6,
+                                "local_span": 2},
+            }
+        experimental = {
+            "event_capacity": 1024, "events_per_host_per_window": 8,
+            "outbox_slots": 8, "inbox_slots": 4,
+            "pipelined_dispatch": pipelined,
+        }
+        experimental.update(exp)
+        return {
+            "general": {"stop_time": 8, "seed": 42},
+            "network": {"graph": {"type": "gml", "inline": gml}},
+            "experimental": experimental,
+            "hosts": hosts_d,
+        }
+
+    def chain_of(sim):
+        return int(sim.audit_chain()), int(
+            sim.counters()["events_committed"]
+        )
+
+    arms = {}
+
+    def pair(name, runner, mk):
+        piped, serial = mk(True), mk(False)
+        runner(piped)
+        runner(serial)
+        cp, cs = chain_of(piped), chain_of(serial)
+        arms[name] = {
+            "chain": cp[0], "events": cp[1], "equal": cp == cs,
+        }
+        return piped
+
+    pair("conservative", lambda s: s.run(windows_per_dispatch=8),
+         lambda p: build_simulation(small_cfg(p)))
+    pair("optimistic", lambda s: s.run_optimistic(),
+         lambda p: build_simulation(small_cfg(p)))
+    pair("async_islands", lambda s: s.run(windows_per_dispatch=8),
+         lambda p: build_simulation(
+             small_cfg(p, num_shards=2, exchange_slots=16)))
+
+    def mk_fleet(pipelined):
+        jobs = [
+            JobSpec(f"j{i}", small_cfg(pipelined))
+            for i in range(3)
+        ]
+        for i, j in enumerate(jobs):
+            j.config["general"]["seed"] = 42 + i  # data-plane sweep axis
+        return build_fleet(jobs, lanes=2)
+
+    piped_fleet, serial_fleet = mk_fleet(True), mk_fleet(False)
+    piped_fleet.run()
+    serial_fleet.run()
+    rows_p = {r["name"]: r["audit"]["chain"] for r in piped_fleet.results()}
+    rows_s = {r["name"]: r["audit"]["chain"] for r in serial_fleet.results()}
+    arms["fleet"] = {
+        "chain": rows_p.get("j0", 0),
+        "events": sum(
+            r["events_committed"] for r in piped_fleet.results()
+        ),
+        "equal": rows_p == rows_s and bool(rows_p),
+    }
+    gate_chain = all(a["equal"] for a in arms.values())
+
+    # ---- wall-clock arm: handoff-heavy workload + drain model ----
+    drain_s = drain_ms / 1e3
+
+    def drain_model(sim, mn):
+        # the managed-plane syscall-drain stand-in: a blocking WAIT at
+        # every handoff boundary (state untouched — quiet by contract)
+        time.sleep(drain_s)
+
+    def timing_arm(pipelined, tracer=None):
+        sim = build_phold_flagship(
+            hosts, msgload=msgload, stop_s=stop_s, runtime_s=stop_s - 1,
+            seed=7, pipelined_dispatch=pipelined,
+        )
+        sim.obs_session = obs_metrics.ObsSession(tracer=tracer)
+        # warm the compile, then time the steady region with the drain
+        sim.run(until=2 * simtime.NS_PER_SEC, windows_per_dispatch=wpd)
+        sim.add_handoff_hook(drain_model)
+        t0 = time.perf_counter()
+        sim.run(windows_per_dispatch=wpd)
+        wall = time.perf_counter() - t0
+        return sim, wall
+
+    # interleave arms to decorrelate machine drift from the comparison
+    serial_sim, w_s = timing_arm(False)
+    tracer = ChromeTracer()
+    piped_sim, w_p = timing_arm(True, tracer=tracer)
+    w_s = min(w_s, timing_arm(False)[1])
+    w_p = min(w_p, timing_arm(True)[1])
+    timing_equal = chain_of(piped_sim) == chain_of(serial_sim)
+    gate_wall = w_p > 0 and (w_s / w_p) >= 1.2
+
+    # retrace-free: pipelining must not add a compile — one lowering per
+    # bound kernel, and the same compile count as the serial arm
+    retrace_p = hlo_audit.retrace_report(piped_sim)
+    retrace_s = hlo_audit.retrace_report(serial_sim)
+    gate_retrace = bool(
+        retrace_p["ok"]
+        and retrace_p["compiles_total"] == retrace_s["compiles_total"]
+    )
+
+    # trace-derived overlap efficiency (tools/trace_summary.py)
+    spec = importlib.util.spec_from_file_location(
+        "trace_summary", os.path.join(_REPO, "tools", "trace_summary.py")
+    )
+    trace_summary = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(trace_summary)
+    overlap = trace_summary.overlap_stats(tracer.to_doc()) or {}
+
+    # schema-v14 artifact from the pipelined timing arm
+    metrics_path = os.path.join(_REPO, "pipeline_smoke.metrics.json")
+    session = obs_metrics.ObsSession()
+    session.finalize(piped_sim)
+    doc = session.metrics.dump(metrics_path, meta={
+        "stage": "pipeline_smoke", "hosts": hosts,
+        "drain_model_ms": drain_ms,
+    })
+    obs_metrics.validate_metrics_doc(doc, strict_namespaces=True)
+    pstats = piped_sim.pipeline_stats()
+    gate_schema = bool(
+        doc["counters"].get("pipeline.issued_ahead", 0) > 0
+        and doc["counters"].get("pipeline.overlap_ns", 0) > 0
+    )
+
+    return {
+        "stage": "pipeline_smoke",
+        "platform": jax.default_backend(),
+        "hosts": hosts,
+        "windows_per_dispatch": wpd,
+        "host_drain_model_ms": drain_ms,
+        "arms": arms,
+        "timing_chain_equal": bool(timing_equal),
+        "wall_serial_s": round(w_s, 3),
+        "wall_pipelined_s": round(w_p, 3),
+        "wall_ratio": round(w_s / w_p, 2) if w_p else 0.0,
+        "pipeline": {k: int(v) for k, v in sorted(pstats.items())},
+        "overlap_efficiency": round(
+            float(overlap.get("overlap_efficiency", 0.0)), 3
+        ),
+        "kernel_compiles": int(retrace_p["compiles_total"]),
+        "metrics_out": os.path.relpath(metrics_path, _REPO),
+        "gate_chain": bool(gate_chain and timing_equal),
+        "gate_wall": bool(gate_wall),
+        "gate_retrace": gate_retrace,
+        "gate_schema": gate_schema,
+        "gate": bool(
+            gate_chain and timing_equal and gate_wall and gate_retrace
+            and gate_schema
+        ),
+    }
+
+
 def stage_lint_smoke():
     """shadowlint gate (ISSUE 7 acceptance, extended by ISSUE 14): all
     FOUR static-analysis passes over the tree must report ZERO
@@ -2112,6 +2317,16 @@ def main():
         # accelerator, so no backend wait.
         os.environ.setdefault("SHADOW_TPU_BENCH_ALLOW_CPU", "1")
         print(json.dumps(stage_lint_smoke()), flush=True)
+        return
+    if "--pipeline-smoke" in sys.argv:
+        # pipelined-handoff gate: audit chains bit-identical pipelined
+        # vs serial across {conservative, optimistic, async-islands,
+        # fleet}, >= 1.2x wall on a handoff-heavy workload (the modeled
+        # managed-plane drain hidden behind in-flight device work),
+        # schema-v14 artifact, retrace-free. Both arms share one CPU
+        # backend — no backend wait.
+        os.environ.setdefault("SHADOW_TPU_BENCH_ALLOW_CPU", "1")
+        print(json.dumps(stage_pipeline_smoke()), flush=True)
         return
     if "--serve-smoke" in sys.argv:
         # sim-as-a-service gate: submit → SIGKILL the daemon → restart →

@@ -365,14 +365,15 @@ def _run_device_plane(
 
     try:
         if progress:
-            import jax
-
             stop = sim.stop_time
             hb = max(cfg.general.heartbeat_interval, sim.runahead)
             next_hb = hb
             while True:
+                # run() already synchronized at its final handoff (the
+                # committed-frontier fetch); a block_until_ready here
+                # would re-serialize the pipelined dispatch loop for
+                # nothing (core/pipeline.py)
                 sim.run(until=next_hb)
-                jax.block_until_ready(sim.state.pool.time)
                 now = min(next_hb, stop)
                 c = sim.counters()
                 print(

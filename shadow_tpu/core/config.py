@@ -256,6 +256,14 @@ class ExperimentalOptions:
     # virtual-time Perfetto clock domain (tools/flight_to_trace.py).
     # Accepts an integer capacity or {capacity: R}; 0 = compiled out.
     flight_recorder: int = 0
+    # Pipelined CPU↔TPU handoff (core/pipeline.py): the driver loops
+    # double-buffer window dispatches — issue window N+1 asynchronously
+    # while the host drains window N's deliveries, synchronizing only at
+    # the fetch point. Results are bit-identical either way (speculative
+    # issues are recomputed, never reused, whenever a handoff mutates
+    # state); false restores the strictly-serial loop — the bench
+    # comparison arm (bench.py --pipeline-smoke).
+    pipelined_dispatch: bool = True
     # CPU↔TPU seam: route managed-process UDP through the device-stepped
     # network (procs/bridge.py). The BASELINE north-star path.
     use_device_network: bool = False
@@ -285,7 +293,7 @@ class ExperimentalOptions:
                 setattr(out, name, units.parse_bytes(d[name]))
         for name in (
             "use_device_network", "use_device_tcp", "obs_counters",
-            "audit_digest",
+            "audit_digest", "pipelined_dispatch",
             "socket_recv_autotune", "socket_send_autotune", "use_memory_manager",
             "use_seccomp", "use_syscall_counters", "use_object_counters",
         ):

@@ -46,7 +46,9 @@ import numpy as np
 from flax import struct
 
 from shadow_tpu.core import gearbox
+from shadow_tpu.core import pipeline as pipeline_mod
 from shadow_tpu.core import pressure as pressure_mod
+from shadow_tpu.core.supervisor import PendingDispatch
 from shadow_tpu.core import rng as rng_mod
 from shadow_tpu.core import simtime, soa
 from shadow_tpu.core import spill as spill_mod
@@ -1551,6 +1553,7 @@ class Simulation:
         pool_gears: int = 1,
         audit_digest: bool = True,
         flight_capacity: int = 0,
+        pipelined_dispatch: bool = True,
     ):
         # initial_events: (time, dst, src, kind, payload words)
         self.num_hosts = num_hosts
@@ -1676,6 +1679,19 @@ class Simulation:
         # failover flag re-lowers kernels on the CPU backend (_jit).
         self.supervisor = None
         self._cpu_failover = False
+        # Pipelined CPU↔TPU handoff (core/pipeline.py): the drivers
+        # double-buffer dispatches — issue window N+1 asynchronously
+        # while the host drains window N — synchronizing only at the
+        # fetch point. experimental.pipelined_dispatch: false restores
+        # the strictly-serial loop (the bench comparison arm). Stats are
+        # created lazily so serial runs emit no pipeline.* keys.
+        self.pipelined_dispatch = bool(pipelined_dispatch)
+        self._pipeline_stats: dict | None = None
+        # Host handoff hooks: called as fn(sim, frontier_ns) inside every
+        # driver's host-drain phase (after the fault/checkpoint tick) —
+        # the seam for host-side per-handoff work the pipeline overlaps
+        # (the managed-plane syscall-drain analog; bench models it here).
+        self._handoff_hooks: list = []
         # Elastic mesh resilience (parallel/elastic.py): the runner's
         # dispatch-boundary hook — probes lost chips and signals the
         # relayout-back-up. None = one attribute check per dispatch.
@@ -1870,64 +1886,128 @@ class Simulation:
         return run_to
 
     # -- host-driven round loop (one device sync per window; debuggable) --
+
+    def _step_halves(self, ws: int, we: int):
+        """(issue_fn, fetch_fn) halves of one stepwise window dispatch.
+        issue enqueues the jitted step (async — device futures); fetch
+        performs the blocking frontier read. A supervised retry re-runs
+        both halves, re-reading the bound kernel and re-clamping the
+        spill stop per attempt — exactly what the fused thunk did."""
+
+        def issue(ws=ws, we=we):
+            we, _ = self._live_spill_clamp(we, 1)
+            return self._step(self.state, self.params, ws, max(ws, we))
+
+        def fetch(out):
+            st, mn = out
+            return st, int(mn)
+
+        return issue, fetch
+
     def run_stepwise(self, until: int | None = None) -> int:
         stop = self.stop_time if until is None else min(until, self.stop_time)
         spill = self._spill_store()
         obs = self.obs_session
+        pipe = self._pipeline()
         windows = 0
         stall = 0
-        while True:
-            if self._shifter is not None:
-                # gear decision BEFORE spill manage: an upshift absorbs
-                # red-zone pressure without a host drain episode
-                self._gear_tick(self._pool_occupancy())
-            with metrics_mod.span(obs, "spill"):
-                stop_at = spill_mod.manage(self, spill, stop)
-            min_next = int(jnp.min(self.state.pool.time))
-            if self._fault_plane_active():
-                self._handoff_tick(min_next)
-                # a drain may have removed the frontier event
-                min_next = int(jnp.min(self.state.pool.time))
-            if min_next >= stop_at:
-                if min_next >= stop and spill.min_time >= stop:
-                    break
-                stall += 1
-                if stall > 2:
-                    occ = self._pool_occupancy()
-                    cap = self._gear_ladder[self._gear].capacity
-                    if self._pressure_stall(window=min_next, occupancy=occ,
-                                            capacity=cap):
-                        stall = 0  # a ladder rung reshaped the tier
-                        continue
-                    raise self._pool_exhausted(
-                        "spill tier cannot make progress: either a single "
-                        "timestamp holds more events than the pool fill "
-                        "mark, or pool occupancy leaves too little "
-                        "headroom for even one window's emissions (the "
-                        "pool-headroom gate stalled every host); raise "
-                        "experimental.event_capacity",
-                        window=min_next, occupancy=occ, capacity=cap,
-                    )
-                continue
-            stall = 0
-            if self.pressure is not None:
-                self.pressure.note_progress()
-            ws = min_next
-            we = min(ws + self.runahead, stop_at)
-            with metrics_mod.span(obs, "dispatch", windows=1):
-
-                def _dispatch(ws=ws, we=we):
-                    we, _ = self._live_spill_clamp(we, 1)
-                    st, mn = self._step(
-                        self.state, self.params, ws, max(ws, we)
-                    )
-                    return st, int(mn)
-
-                self.state, mn = self._sv("step", _dispatch)
-            self._gear_note_dispatch()
-            if self._audit_active():
-                self._audit_tick(mn)
-            windows += 1
+        # Committed frontier carried from the dispatch's own return value:
+        # re-deriving it with a fresh jnp.min per handoff dispatched one
+        # tiny reduce kernel per window for nothing. None = must derive
+        # from the pool (startup, or after a tick mutated it).
+        min_next = None
+        try:
+            while True:
+                if self._shifter is not None:
+                    # gear decision BEFORE spill manage: an upshift absorbs
+                    # red-zone pressure without a host drain episode
+                    self._gear_tick(self._pool_occupancy())
+                with metrics_mod.span(obs, "spill"):
+                    tok = self.state
+                    stop_at = spill_mod.manage(self, spill, stop)
+                if self.state is not tok or min_next is None:
+                    min_next = int(jnp.min(self.state.pool.time))
+                if self._fault_plane_active():
+                    tok = self.state
+                    self._handoff_tick(min_next)
+                    if self.state is not tok:
+                        # a drain may have removed the frontier event
+                        min_next = int(jnp.min(self.state.pool.time))
+                if min_next >= stop_at:
+                    if min_next >= stop and spill.min_time >= stop:
+                        break
+                    stall += 1
+                    if stall > 2:
+                        occ = self._pool_occupancy()
+                        cap = self._gear_ladder[self._gear].capacity
+                        if self._pressure_stall(window=min_next,
+                                                occupancy=occ,
+                                                capacity=cap):
+                            stall = 0  # a ladder rung reshaped the tier
+                            continue
+                        raise self._pool_exhausted(
+                            "spill tier cannot make progress: either a "
+                            "single timestamp holds more events than the "
+                            "pool fill mark, or pool occupancy leaves too "
+                            "little headroom for even one window's "
+                            "emissions (the pool-headroom gate stalled "
+                            "every host); raise "
+                            "experimental.event_capacity",
+                            window=min_next, occupancy=occ, capacity=cap,
+                        )
+                    continue
+                stall = 0
+                if self.pressure is not None:
+                    self.pressure.note_progress()
+                ws = min_next
+                we = min(ws + self.runahead, stop_at)
+                # adopt the issued-ahead window iff the committed state
+                # and args are exactly what the serial loop would pass
+                # (core/pipeline.py recompute rule)
+                pending = (
+                    pipe.take(self.state, (ws, we))
+                    if pipe is not None else None
+                )
+                if pending is None:
+                    with metrics_mod.span(obs, "dispatch", windows=1):
+                        p = self._sv_issue(
+                            "step", *self._step_halves(ws, we)
+                        )
+                        self.state, mn = self._sv_await(p)
+                else:
+                    with metrics_mod.span(obs, "await", windows=1):
+                        self.state, mn = self._sv_await(pending)
+                self._gear_note_dispatch()
+                min_next = mn
+                # two-slot pipeline: issue window N+1 before draining
+                # window N's handoff — only across a quiet boundary
+                if pipe is not None and mn < stop:
+                    if (not spill.count and not self._force_spill
+                            and self._handoff_quiet(mn)
+                            and not self._sv_disrupted()):
+                        ws2, we2 = mn, min(mn + self.runahead, stop)
+                        with metrics_mod.span(obs, "issue", windows=1):
+                            pipe.put(
+                                self._sv_issue(
+                                    "step", *self._step_halves(ws2, we2)
+                                ),
+                                self.state, (ws2, we2),
+                            )
+                    else:
+                        pipe.forced_drain()
+                with metrics_mod.span(obs, "host_drain"):
+                    if self._audit_active():
+                        self._audit_tick(mn)
+                    self._run_handoff_hooks(mn)
+                if pipe is not None:
+                    if self._sv_disrupted():
+                        pipe.discard()
+                    else:
+                        pipe.invalidate(self.state)
+                windows += 1
+        finally:
+            if pipe is not None:
+                pipe.close()
         return windows
 
     def _make_attempt(self, step):
@@ -1994,78 +2074,141 @@ class Simulation:
             host=self.state.host.replace(done_t=neg1)
         )
         obs = self.obs_session
+        pipe = self._pipeline()
         min_next = int(jnp.min(self.state.pool.time))
-        while min_next < stop:
-            if self._shifter is not None:
-                # margin=2: a speculative window absorbs several windows'
-                # inflow between decision points, so gear selection keeps
-                # double headroom (core/gearbox.target_level)
-                self._gear_tick(self._pool_occupancy(), margin=2)
-            ws = min_next
-            we = min(ws + factor * cons, stop)
-            base = self.state  # rollback snapshot (done_t already reset)
-            rb0 = rollbacks
-            # pressure-ladder rungs that reshape the pool (gear
-            # downshift) are forbidden while `base` pins the compiled
-            # shapes; non-reshaping rungs (spill-fill escalation) stay
-            # available to the supervisor's RESOURCE_EXHAUSTED retries
-            self._pressure_reshape_ok = False
-            with metrics_mod.span(obs, "window", factor=factor):
-                while True:  # attempt [ws, we) in ONE dispatch; shrink on violation
-                    with metrics_mod.span(obs, "dispatch"):
-
-                        def _dispatch(ws=ws, we=we):
-                            st, mn, viol = self._attempt(
-                                base, self.params, ws, we
-                            )
-                            return st, int(mn), int(viol)
-
-                        st, mn, viol = self._sv("attempt", _dispatch)
-                        self._gear_note_dispatch()
-                    if we <= ws + cons and viol < int(simtime.NEVER):
-                        # A conservative-width window is violation-free BY
-                        # CONSTRUCTION (emission time >= ws + runahead >=
-                        # any processed time). A violation here means the
-                        # conservative-width invariant itself is broken —
-                        # committing would silently accept a causally
-                        # -violated window (ADVICE round-5 finding).
-                        raise RuntimeError(
-                            f"speculation violation at t={viol} inside a "
-                            f"conservative-width window [{ws}, {we}): the "
-                            f"conservative-width invariant is broken — "
-                            f"runahead {cons} ns exceeds a real path "
-                            f"latency ({self._runahead_bound_hint()}), or "
-                            f"a handler emitted into the past; refusing "
-                            f"to commit"
-                        )
-                    if viol >= int(simtime.NEVER) or we <= ws + cons:
-                        break
-                    rollbacks += 1
-                    if obs is not None and obs.tracer:
-                        obs.tracer.instant("rollback", viol_ns=viol)
-                    we = max(viol, ws + cons)
-            # driver-plane telemetry bumps ride the state replace the loop
-            # does anyway (handoff boundary — no sync added); each rollback
-            # shrank the window once
-            self._pressure_reshape_ok = True
-            st = obs_mod.bump_win(st, obs_mod.WIN_ROLLBACKS, rollbacks - rb0)
-            st = obs_mod.bump_win(st, obs_mod.WIN_SHRINKS, rollbacks - rb0)
-            self.state = st.replace(host=st.host.replace(done_t=neg1))
-            min_next = int(mn)
-            windows += 1
-            if self.pressure is not None:
-                self.pressure.note_progress()
-            if obs is not None:
-                obs.round_done(self)
-            self._audit_tick(min_next)
-            if self._fault_plane_active():
-                self._handoff_tick(min_next)
-                min_next = int(jnp.min(self.state.pool.time))
-            if adaptive:
-                factor, streak = self.adapt_window_factor(
-                    factor, streak, rollbacks > rb0, window_factor
+        try:
+            while min_next < stop:
+                if self._shifter is not None:
+                    # margin=2: a speculative window absorbs several
+                    # windows' inflow between decision points, so gear
+                    # selection keeps double headroom
+                    # (core/gearbox.target_level)
+                    self._gear_tick(self._pool_occupancy(), margin=2)
+                ws = min_next
+                we = min(ws + factor * cons, stop)
+                base = self.state  # rollback snapshot (done_t reset)
+                rb0 = rollbacks
+                # pressure-ladder rungs that reshape the pool (gear
+                # downshift) are forbidden while `base` pins the compiled
+                # shapes; non-reshaping rungs (spill-fill escalation) stay
+                # available to the supervisor's RESOURCE_EXHAUSTED retries
+                self._pressure_reshape_ok = False
+                # adopt the issued-ahead first attempt iff base + window
+                # bounds are exactly the serial loop's (recompute rule)
+                first = (
+                    pipe.take(base, (ws, we)) if pipe is not None else None
                 )
+                with metrics_mod.span(obs, "window", factor=factor):
+                    while True:  # attempt [ws, we); shrink on violation
+                        if first is not None:
+                            with metrics_mod.span(obs, "await"):
+                                st, mn, viol = self._sv_await(first)
+                            first = None
+                        else:
+                            with metrics_mod.span(obs, "dispatch"):
+                                p = self._sv_issue(
+                                    "attempt",
+                                    *self._attempt_halves(base, ws, we),
+                                )
+                                st, mn, viol = self._sv_await(p)
+                        self._gear_note_dispatch()
+                        if we <= ws + cons and viol < int(simtime.NEVER):
+                            # A conservative-width window is violation-free
+                            # BY CONSTRUCTION (emission time >= ws +
+                            # runahead >= any processed time). A violation
+                            # here means the conservative-width invariant
+                            # itself is broken — committing would silently
+                            # accept a causally-violated window (ADVICE
+                            # round-5 finding).
+                            raise RuntimeError(
+                                f"speculation violation at t={viol} inside "
+                                f"a conservative-width window [{ws}, {we}): "
+                                f"the conservative-width invariant is "
+                                f"broken — runahead {cons} ns exceeds a "
+                                f"real path latency "
+                                f"({self._runahead_bound_hint()}), or a "
+                                f"handler emitted into the past; refusing "
+                                f"to commit"
+                            )
+                        if viol >= int(simtime.NEVER) or we <= ws + cons:
+                            break
+                        rollbacks += 1
+                        if obs is not None and obs.tracer:
+                            obs.tracer.instant("rollback", viol_ns=viol)
+                        we = max(viol, ws + cons)
+                # driver-plane telemetry bumps ride the state replace the
+                # loop does anyway (handoff boundary — no sync added);
+                # each rollback shrank the window once
+                self._pressure_reshape_ok = True
+                st = obs_mod.bump_win(
+                    st, obs_mod.WIN_ROLLBACKS, rollbacks - rb0
+                )
+                st = obs_mod.bump_win(
+                    st, obs_mod.WIN_SHRINKS, rollbacks - rb0
+                )
+                self.state = st.replace(host=st.host.replace(done_t=neg1))
+                min_next = int(mn)
+                windows += 1
+                if adaptive:
+                    # pure host arithmetic — computed at commit (before
+                    # the speculative issue needs the next factor); the
+                    # schedule is identical to the serial loop's
+                    factor, streak = self.adapt_window_factor(
+                        factor, streak, rollbacks > rb0, window_factor
+                    )
+                # two-slot pipeline: issue window N+1's first attempt
+                # from the committed state before draining this handoff
+                if pipe is not None and min_next < stop:
+                    if (self._handoff_quiet(min_next)
+                            and not self._sv_disrupted()):
+                        ws2 = min_next
+                        we2 = min(ws2 + factor * cons, stop)
+                        with metrics_mod.span(obs, "issue"):
+                            pipe.put(
+                                self._sv_issue(
+                                    "attempt",
+                                    *self._attempt_halves(
+                                        self.state, ws2, we2
+                                    ),
+                                ),
+                                self.state, (ws2, we2),
+                            )
+                    else:
+                        pipe.forced_drain()
+                with metrics_mod.span(obs, "host_drain"):
+                    if self.pressure is not None:
+                        self.pressure.note_progress()
+                    if obs is not None:
+                        obs.round_done(self)
+                    self._audit_tick(min_next)
+                    if self._fault_plane_active():
+                        self._handoff_tick(min_next)
+                        min_next = int(jnp.min(self.state.pool.time))
+                    self._run_handoff_hooks(min_next)
+                if pipe is not None:
+                    if self._sv_disrupted():
+                        pipe.discard()
+                    else:
+                        pipe.invalidate(self.state)
+        finally:
+            if pipe is not None:
+                pipe.close()
         return windows, rollbacks
+
+    def _attempt_halves(self, base, ws: int, we: int):
+        """(issue_fn, fetch_fn) halves of one optimistic attempt from
+        the rollback snapshot `base` (captured explicitly — a supervised
+        retry must re-speculate the same window from the same
+        snapshot)."""
+
+        def issue(base=base, ws=ws, we=we):
+            return self._attempt(base, self.params, ws, we)
+
+        def fetch(out):
+            st, mn, viol = out
+            return st, int(mn), int(viol)
+
+        return issue, fetch
 
     def _runahead_bound_hint(self) -> str:
         """The actually-safe runahead bound for conservative-width
@@ -2119,79 +2262,152 @@ class Simulation:
         return self._spill_store().stats()
 
     # -- fused run: windows execute in on-device while_loop chunks --
+
+    def _run_to_halves(self, stop_at: int, wpd: int):
+        """(issue_fn, fetch_fn) halves of one fused-loop dispatch. issue
+        enqueues the run_to program (jax async dispatch — futures); fetch
+        performs the blocking host reads. The supervisor re-runs BOTH for
+        a retry: issue re-reads the bound kernels and re-clamps the spill
+        stop per attempt, so recovery rebinds and mid-dispatch pressure
+        rungs behave exactly as under the fused thunk."""
+
+        def issue(stop_at=stop_at, wpd=wpd):
+            # per-attempt clamp: a pressure rung may have engaged the
+            # spill tier since the driver computed stop_at
+            stop_at, wpd = self._live_spill_clamp(stop_at, wpd)
+            return self._run_to(self.state, self.params, stop_at, wpd)
+
+        def fetch(out):
+            st, mn, press, occ = out
+            # blocking fetches INSIDE the supervised await: async-
+            # dispatch errors must surface here, not at a later
+            # unsupervised sync
+            return st, int(mn), bool(press), int(occ)
+
+        return issue, fetch
+
     def run(
         self, until: int | None = None, windows_per_dispatch: int = 64
     ) -> None:
         stop = self.stop_time if until is None else min(until, self.stop_time)
         spill = self._spill_store()
         obs = self.obs_session
+        pipe = self._pipeline()
         last = None
-        while True:
-            active = (
-                (last is not None and last[2]) or spill.count
-                or self._force_spill  # injected force_spill fault
-            )
-            if active:
-                with metrics_mod.span(obs, "spill"):
-                    stop_at = spill_mod.manage(self, spill, stop)
-            else:
-                stop_at = stop
-            # whole-host spill residency is only exact with a manage pass
-            # between consecutive windows (core/spill.py manage docstring)
-            wpd = 1 if spill.count else windows_per_dispatch
-            if self._fault_plane_active():
-                # hand off at the next injection/checkpoint mark
-                stop_at = min(stop_at, self._fault_mark())
-            with metrics_mod.span(obs, "dispatch", windows=wpd):
-
-                def _dispatch(stop_at=stop_at, wpd=wpd):
-                    # per-attempt clamp: a pressure rung may have engaged
-                    # the spill tier since the driver computed stop_at
-                    stop_at, wpd = self._live_spill_clamp(stop_at, wpd)
-                    st, mn, press, occ = self._run_to(
-                        self.state, self.params, stop_at, wpd
-                    )
-                    # blocking fetches INSIDE the supervised call: async-
-                    # dispatch errors must surface here, not at a later
-                    # unsupervised sync
-                    return st, int(mn), bool(press), int(occ)
-
-                self.state, mn, press, occ = self._sv("run_to", _dispatch)
-            self._gear_note_dispatch()
-            if obs is not None:
-                obs.round_done(self)
-            self._audit_tick(mn)
-            # gearing: a red-zone early exit upshifts (one pool re-sort)
-            # before the spill tier would pay host drain round-trips
-            shifted = self._gear_tick(occ, press=press)
-            if self._fault_plane_active():
-                self._handoff_tick(mn)
-            if mn >= stop and spill.min_time >= stop and not press:
-                break
-            if self.elastic is not None:
-                # elastic re-expansion probe (parallel/elastic.py): may
-                # raise MeshReexpand at this committed boundary — the
-                # runner drains and relayouts onto the recovered mesh
-                self.elastic.on_dispatch(self, mn)
-            cur = (mn, spill.count, press)
-            if cur == last and mn >= stop_at and not shifted:
-                cap = self._gear_ladder[self._gear].capacity
-                if self._pressure_stall(window=mn, occupancy=occ,
-                                        capacity=cap):
-                    last = None  # a ladder rung reshaped the tier
-                    continue
-                raise self._pool_exhausted(
-                    "spill tier cannot make progress: either a single "
-                    "timestamp holds more events than the pool fill mark, "
-                    "or pool occupancy leaves too little headroom for even "
-                    "one window's emissions (the pool-headroom gate "
-                    "stalled every host); raise "
-                    "experimental.event_capacity",
-                    window=mn, occupancy=occ, capacity=cap,
+        try:
+            while True:
+                active = (
+                    (last is not None and last[2]) or spill.count
+                    or self._force_spill  # injected force_spill fault
                 )
-            elif self.pressure is not None:
-                self.pressure.note_progress()
-            last = cur
+                if active:
+                    if pipe is not None:
+                        # spill manage mutates the pool: a barrier point
+                        # (the boundary was already tallied as a forced
+                        # drain when speculation was skipped)
+                        pipe.close()
+                    with metrics_mod.span(obs, "spill"):
+                        stop_at = spill_mod.manage(self, spill, stop)
+                else:
+                    stop_at = stop
+                # whole-host spill residency is only exact with a manage
+                # pass between consecutive windows (core/spill.py manage)
+                wpd = 1 if spill.count else windows_per_dispatch
+                if self._fault_plane_active():
+                    # hand off at the next injection/checkpoint mark
+                    stop_at = min(stop_at, self._fault_mark())
+                # adopt the issued-ahead dispatch iff the committed state
+                # and recomputed args are exactly what the serial loop
+                # would pass now (core/pipeline.py recompute rule)
+                pending = (
+                    pipe.take(self.state, (stop_at, wpd))
+                    if pipe is not None else None
+                )
+                if pending is None:
+                    with metrics_mod.span(obs, "dispatch", windows=wpd):
+                        p = self._sv_issue(
+                            "run_to", *self._run_to_halves(stop_at, wpd)
+                        )
+                        self.state, mn, press, occ = self._sv_await(p)
+                else:
+                    with metrics_mod.span(obs, "await", windows=wpd):
+                        self.state, mn, press, occ = self._sv_await(pending)
+                # two-slot pipeline: issue dispatch N+1 asynchronously
+                # BEFORE draining dispatch N's handoff — the device
+                # computes while the host drains; state-mutating ticks
+                # stay barrier points (forced_drain), and a drain that
+                # mutates anyway discards the issue (recompute, never
+                # reuse — the invalidate below)
+                if pipe is not None and mn < stop:
+                    if (not press and not spill.count
+                            and not self._force_spill
+                            and self._handoff_quiet(mn)
+                            and not self._sv_disrupted()):
+                        nxt = stop
+                        if self._fault_plane_active():
+                            nxt = min(nxt, self._fault_mark())
+                        with metrics_mod.span(
+                            obs, "issue", windows=windows_per_dispatch
+                        ):
+                            pipe.put(
+                                self._sv_issue(
+                                    "run_to",
+                                    *self._run_to_halves(
+                                        nxt, windows_per_dispatch
+                                    ),
+                                ),
+                                self.state,
+                                (nxt, windows_per_dispatch),
+                            )
+                    else:
+                        pipe.forced_drain()
+                with metrics_mod.span(obs, "host_drain"):
+                    self._gear_note_dispatch()
+                    if obs is not None:
+                        obs.round_done(self)
+                    self._audit_tick(mn)
+                    # gearing: a red-zone early exit upshifts (one pool
+                    # re-sort) before the spill tier would pay host drain
+                    # round-trips
+                    shifted = self._gear_tick(occ, press=press)
+                    if self._fault_plane_active():
+                        self._handoff_tick(mn)
+                    self._run_handoff_hooks(mn)
+                if pipe is not None:
+                    if self._sv_disrupted():
+                        pipe.discard()
+                    else:
+                        pipe.invalidate(self.state)
+                if mn >= stop and spill.min_time >= stop and not press:
+                    break
+                if self.elastic is not None:
+                    # elastic re-expansion probe (parallel/elastic.py):
+                    # may raise MeshReexpand at this committed boundary —
+                    # the runner drains and relayouts onto the recovered
+                    # mesh
+                    self.elastic.on_dispatch(self, mn)
+                cur = (mn, spill.count, press)
+                if cur == last and mn >= stop_at and not shifted:
+                    cap = self._gear_ladder[self._gear].capacity
+                    if self._pressure_stall(window=mn, occupancy=occ,
+                                            capacity=cap):
+                        last = None  # a ladder rung reshaped the tier
+                        continue
+                    raise self._pool_exhausted(
+                        "spill tier cannot make progress: either a single "
+                        "timestamp holds more events than the pool fill "
+                        "mark, or pool occupancy leaves too little "
+                        "headroom for even one window's emissions (the "
+                        "pool-headroom gate stalled every host); raise "
+                        "experimental.event_capacity",
+                        window=mn, occupancy=occ, capacity=cap,
+                    )
+                elif self.pressure is not None:
+                    self.pressure.note_progress()
+                last = cur
+        finally:
+            if pipe is not None:
+                pipe.close()
 
     # -- fault-tolerance plane (shadow_tpu/faults) + auto-checkpointing --
 
@@ -2228,6 +2444,82 @@ class Simulation:
         if self.supervisor is None:
             return thunk()
         return self.supervisor.call(label, thunk)
+
+    def _sv_issue(self, label: str, issue_fn, fetch_fn):
+        """The ISSUE half of a split dispatch: enqueue the device work
+        (jax async dispatch — futures, no blocking) and return the
+        ticket. Supervised when a supervisor is attached; a direct
+        launch otherwise."""
+        if self.supervisor is None:
+            return PendingDispatch.direct(label, issue_fn, fetch_fn)
+        return self.supervisor.issue(label, issue_fn, fetch_fn)
+
+    def _sv_await(self, pending):
+        """The AWAIT half: block on the ticket's host fetches. With a
+        supervisor attached this runs the classified retry ladder,
+        pressure rungs, watchdog and loss policies — all operating on
+        the awaited half, so pipelining never re-serializes them."""
+        if self.supervisor is None:
+            return pending.await_direct()
+        return self.supervisor.await_result(pending)
+
+    def _sv_disrupted(self) -> bool:
+        """True when the supervisor already knows the next dispatch will
+        not run clean (injected kill/stall/exhaust, failover) — the
+        pipelined drivers drain instead of issuing ahead so injected
+        faults keep their serial-schedule ordering."""
+        sup = self.supervisor
+        return sup is not None and sup.pending_disruption
+
+    # -- pipelined CPU↔TPU handoff (core/pipeline.py) --
+
+    def _pipeline(self):
+        """The two-slot pipeline for one driver-loop invocation, or None
+        when the serial arm is configured. Stats accumulate across loops
+        on the same sim (the dict is shared)."""
+        if not self.pipelined_dispatch:
+            return None
+        if self._pipeline_stats is None:
+            self._pipeline_stats = pipeline_mod.new_stats()
+        return pipeline_mod.TwoSlotPipeline(self._pipeline_stats)
+
+    def pipeline_stats(self) -> dict:
+        """Pipelined-handoff telemetry for the metrics `pipeline.*`
+        namespace (schema v14); {} until a pipelined driver loop ran
+        (serial runs emit no pipeline keys)."""
+        st = self._pipeline_stats
+        return dict(st) if st is not None else {}
+
+    def add_handoff_hook(self, fn) -> None:
+        """Register fn(sim, frontier_ns), called inside every driver's
+        host-drain phase (after the fault/checkpoint tick). The hook for
+        host-side per-handoff work — the managed-plane syscall-drain
+        analog — which the pipelined loop overlaps with the in-flight
+        dispatch. Hooks must not assume the next dispatch has not been
+        issued; state mutations they make are detected and discard any
+        in-flight speculation (the recompute rule)."""
+        self._handoff_hooks.append(fn)
+
+    def _run_handoff_hooks(self, mn: int) -> None:
+        for fn in self._handoff_hooks:
+            fn(self, mn)
+
+    def _handoff_quiet(self, mn: int) -> bool:
+        """True when the upcoming handoff tick at committed frontier
+        `mn` cannot mutate state: no due injection or checkpoint mark at
+        or below the frontier, no quarantined-host recurring drain, no
+        forced/sustained spill episode. The pipelined drivers only issue
+        ahead across QUIET boundaries — state-mutating ticks are barrier
+        points (docs/architecture.md §Pipelined handoff)."""
+        if self._dead_hosts or self._force_spill:
+            return False
+        pc = self.pressure
+        if (pc is not None and pc.saturate_frac is not None
+                and pc.saturate_frac < 1.0):
+            return False
+        if self._fault_plane_active() and self._fault_mark() <= mn:
+            return False
+        return True
 
     def _rebind_kernels(self) -> None:
         """Drop every compiled kernel and rebind the active gear — the

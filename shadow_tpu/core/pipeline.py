@@ -1,0 +1,148 @@
+"""Two-slot pipelined CPU↔TPU handoff bookkeeping.
+
+Every driver loop used to run the host-side handoff (spill/syscall
+drains, fault injections, audit tick, checkpoint ring, scheduler work)
+and the next device window strictly serially: dispatch N → block on its
+scalar fetches → host drain → dispatch N+1. With jax's asynchronous
+dispatch the device is IDLE through the whole host drain — the last
+structural stall on the hot path now that the cross-shard barrier is
+gone (asynchronous-conservative literature: hiding coordination latency
+behind compute is where the remaining wall-clock lives, cs/0409032;
+PARSIR's per-worker pipelining, arXiv:2410.00644).
+
+The pipelined loop double-buffers instead: right after awaiting window
+N's scalars (the committed frontier), the driver ISSUES window N+1
+speculatively — jax enqueues it and returns futures — then performs
+window N's host drain while the device computes. The host synchronizes
+only at the next fetch point. Correctness is the serial schedule's,
+enforced by two rules:
+
+  * FORCED DRAINS — a handoff with state-mutating work pending (a due
+    fault injection, an active spill episode, a checkpoint mark, a
+    pressure rung, a balancer migration, an elastic relayout) never
+    overlaps: the driver drains the in-flight dispatch first and stays
+    serial through that boundary (`forced_drains`).
+  * RECOMPUTE, NEVER REUSE — a speculative issue is adopted only if the
+    drained handoff left the committed state UNTOUCHED (object identity
+    on the pytree the dispatch was issued from) and the recomputed
+    dispatch arguments match the predicted ones; otherwise it is
+    discarded unobserved and re-issued from the mutated state
+    (`recompute_discards`). An adopted dispatch is therefore a pure
+    function of exactly the inputs the serial loop would have passed —
+    audit chains stay bit-identical by construction.
+
+This module holds only host bookkeeping (the slot, the validation
+tokens, and the `pipeline.*` metrics tallies). The dispatch halves
+themselves are `core/supervisor.PendingDispatch` tickets, so the retry
+ladder, pressure rungs, stall watchdog, and loss policies all operate on
+the awaited half without re-serializing the loop.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+def new_stats() -> dict:
+    """The `pipeline.*` metrics namespace (schema v14): monotonic host
+    tallies of the two-slot pipeline's behavior."""
+    return {
+        # speculative dispatches issued ahead of the handoff drain
+        "issued_ahead": 0,
+        # wall ns of host-drain work performed while an (eventually
+        # adopted) speculative dispatch was in flight — the hidden latency
+        "overlap_ns": 0,
+        # handoff boundaries where state-mutating tick work (or a known
+        # supervisor disruption) forced the loop to stay serial
+        "forced_drains": 0,
+        # speculative issues discarded because the drained handoff
+        # changed state or the recomputed dispatch args differed — the
+        # dispatch was recomputed from the mutated state, never reused
+        "recompute_discards": 0,
+    }
+
+
+class TwoSlotPipeline:
+    """One speculative dispatch slot plus its validation tokens.
+
+    The driver protocol per handoff boundary:
+
+      1. adopt-or-recompute:  p = pipe.take(state_token, args)
+         → the issued-ahead ticket if the committed state is the very
+           pytree it was issued from AND the recomputed args match;
+           None (after counting a discard) otherwise.
+      2. await p (or issue+await fresh when None).
+      3. speculate: when the upcoming handoff is quiet, issue N+1 and
+         pipe.put(ticket, state_token, args); else pipe.forced_drain().
+      4. after the host drain: pipe.invalidate(state_token) discards the
+         slot if the drain replaced the committed state after all.
+    """
+
+    def __init__(self, stats: dict):
+        self.stats = stats
+        self._pending = None
+        self._token = None
+        self._args = None
+        self._t_issue = 0.0
+
+    @property
+    def pending(self) -> bool:
+        return self._pending is not None
+
+    def put(self, pending, token, args) -> None:
+        """Record a speculative issue: `token` is the committed state
+        pytree the dispatch closes over (identity-compared at take),
+        `args` the host-computed dispatch arguments it was issued with."""
+        self._pending = pending
+        self._token = token
+        self._args = args
+        self._t_issue = time.perf_counter()
+        self.stats["issued_ahead"] += 1
+
+    def take(self, token, args):
+        """Adopt the issued-ahead dispatch iff its inputs are exactly
+        what the serial loop would pass now; discard + count otherwise."""
+        p = self._pending
+        if p is None:
+            return None
+        if token is not self._token or args != self._args:
+            self.discard()
+            return None
+        self._pending = None
+        self._token = self._args = None
+        self.stats["overlap_ns"] += int(
+            (time.perf_counter() - self._t_issue) * 1e9
+        )
+        return p
+
+    def invalidate(self, token) -> None:
+        """Discard the slot when the host drain replaced the committed
+        state the speculation was issued from (gear shift, fault drain,
+        checkpoint-adjacent mutation, migration, pressure rung)."""
+        if self._pending is not None and token is not self._token:
+            self.discard()
+
+    def discard(self) -> None:
+        """Drop the in-flight speculative dispatch unobserved; the next
+        dispatch is recomputed from the (possibly mutated) state."""
+        if self._pending is not None:
+            self._pending.abandon()
+            self._pending = None
+            self._token = self._args = None
+            self.stats["recompute_discards"] += 1
+
+    def close(self) -> None:
+        """Abandon any in-flight speculation WITHOUT counting a discard —
+        loop exit and exception unwind (the dispatch was neither adopted
+        nor recomputed; it simply never happened)."""
+        if self._pending is not None:
+            self._pending.abandon()
+            self._pending = None
+            self._token = self._args = None
+
+    def forced_drain(self) -> None:
+        """A state-mutating handoff (or a known supervisor disruption)
+        kept this boundary serial: drain any in-flight speculation and
+        tally the barrier point."""
+        self.discard()
+        self.stats["forced_drains"] += 1

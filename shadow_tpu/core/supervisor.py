@@ -228,6 +228,88 @@ def probe_backend() -> bool:
 
 _default_probe = probe_backend  # supervisor-internal historical name
 
+
+class PendingDispatch:
+    """One device dispatch split into its two halves (the pipelined
+    drivers' seam, core/pipeline.py):
+
+      issue_fn()     enqueue the dispatch — returns device FUTURES (jax's
+                     async dispatch), never blocks on results;
+      fetch_fn(out)  the blocking host reads of those futures (the
+                     `int()` / `device_get` scalar fetches).
+
+    `BackendSupervisor.issue` launches the issue half immediately (when
+    the backend is believed healthy) and hands back this ticket;
+    `await_result` runs the fetch half under the full classified-retry +
+    watchdog state machine — a retry re-runs BOTH halves, so recovery
+    rebinds and per-attempt clamps behave exactly like the fused
+    `call()` thunk did. A ticket also works unsupervised (the drivers'
+    zero-overhead default): `direct()` + `await_direct()` reproduce a
+    plain thunk call with errors propagating raw."""
+
+    __slots__ = (
+        "label", "issue_fn", "fetch_fn", "_out", "_error", "_t0",
+        "_live",
+    )
+
+    def __init__(self, label: str, issue_fn, fetch_fn):
+        self.label = label
+        self.issue_fn = issue_fn
+        self.fetch_fn = fetch_fn
+        self._out = None
+        self._error = None
+        self._t0 = None
+        self._live = False
+
+    @classmethod
+    def direct(cls, label: str, issue_fn, fetch_fn) -> "PendingDispatch":
+        """Unsupervised ticket: issue now, fetch at await_direct."""
+        p = cls(label, issue_fn, fetch_fn)
+        p.launch(time.monotonic)
+        return p
+
+    def launch(self, clock) -> None:
+        """Run the issue half now. An issue-time error (a tracing bug, an
+        immediately-failing enqueue) is captured and re-raised inside the
+        awaiter's classified try — never lost, never early."""
+        self._t0 = clock()
+        try:
+            self._out = self.issue_fn()
+            self._live = True
+        except Exception as exc:  # noqa: BLE001 — classified at await
+            self._error = exc
+            self._live = True
+
+    def claim(self):
+        """Surrender the issued-ahead attempt ONCE: (t0, out, error), or
+        None when nothing was launched (or it was already claimed /
+        abandoned) — the awaiter then re-issues fresh."""
+        if not self._live:
+            return None
+        self._live = False
+        out, err = self._out, self._error
+        self._out = self._error = None
+        return (self._t0, out, err)
+
+    def abandon(self) -> None:
+        """Drop the issued futures without fetching (a pipelined driver
+        discarding a speculative dispatch whose inputs a handoff
+        invalidated). The device work is wasted, never observed; jax
+        garbage-collects the result buffers."""
+        self._live = False
+        self._out = self._error = None
+
+    def await_direct(self):
+        """The unsupervised await half: fetch the issued futures (or
+        re-run the halves if never launched); errors propagate raw —
+        exactly a bare thunk call."""
+        c = self.claim()
+        if c is None:
+            return self.fetch_fn(self.issue_fn())
+        if c[2] is not None:
+            raise c[2]
+        return self.fetch_fn(c[1])
+
 # _chips_down sentinel for probe-discovered (not injection-driven) dead
 # chips: probing one consults the MeshHealth device prober, never an
 # injection countdown
@@ -460,15 +542,56 @@ class BackendSupervisor:
         blocking host fetches (so async-dispatch errors surface here, not
         at a later unsupervised sync), and must re-read the driver's
         bound kernel attributes — recovery rebinds them.
+
+        Implemented as issue()+await_result() with the whole thunk as the
+        issue half — the fused form every pre-pipeline call site keeps.
         """
+        return self.await_result(self.issue(label, thunk, lambda out: out))
+
+    @property
+    def pending_disruption(self) -> bool:
+        """True when the NEXT supervised dispatch will not run clean: the
+        backend is (injected-)dead, the run is on the CPU fallback, or an
+        injected exhaust/stall is armed. The pipelined drivers consult
+        this instead of issuing ahead — a speculative dispatch against a
+        known disruption would only be discarded (and, for injections,
+        would reorder the fault against the serial schedule)."""
+        return (
+            self._dead or self.failover or self._inject_exhausts > 0
+            or self._inject_stalls > 0
+        )
+
+    def issue(self, label: str, issue_fn, fetch_fn) -> PendingDispatch:
+        """The ISSUE half of a supervised dispatch: enqueue the device
+        work asynchronously (jax dispatch returns futures) and hand back
+        the ticket. Nothing blocks, nothing is classified yet — the full
+        retry ladder, pressure rungs, watchdog, and loss policies all run
+        in await_result, operating on the awaited half. When the backend
+        is already known-disrupted the launch is skipped; await_result
+        then recovers first and issues fresh, exactly like call() did."""
+        p = PendingDispatch(label, issue_fn, fetch_fn)
+        if not self.pending_disruption:
+            p.launch(self._clock)
+        return p
+
+    def await_result(self, p: PendingDispatch):
+        """The AWAIT half: block on the issued dispatch's host fetches
+        under the classified state machine. First pass consumes the
+        issued-ahead futures (deadline measured from their issue time);
+        any retry re-runs BOTH halves — issue_fn re-reads the bound
+        kernels and re-clamps, so recovery and mid-dispatch pressure
+        rungs are picked up exactly as under the fused call()."""
+        label = p.label
         retries = 0
         while True:
             if self._dead:
+                p.abandon()
                 self._recover(label)  # raises under policy `abort`
             if self.failover:
                 self._maybe_failback()
             self.counters["dispatches"] += 1
-            t0 = self._clock()
+            pre = p.claim()
+            t0 = pre[0] if pre is not None else self._clock()
             try:
                 if self._inject_exhausts > 0:
                     self._inject_exhausts -= 1
@@ -476,7 +599,12 @@ class BackendSupervisor:
                         "RESOURCE_EXHAUSTED: out of memory allocating "
                         "window buffers (injected exhaust_backend)"
                     )
-                out = thunk()
+                if pre is not None:
+                    if pre[2] is not None:
+                        raise pre[2]
+                    out = p.fetch_fn(pre[1])
+                else:
+                    out = p.fetch_fn(p.issue_fn())
             except Exception as exc:  # noqa: BLE001 — classified below
                 kind = classify_failure(exc)
                 if kind == TRANSIENT and retries < self.max_retries:

@@ -33,6 +33,7 @@ def build_phold_flagship(
     pool_gears: int = 1,
     audit_digest: bool = True,
     flight_recorder: int = 0,
+    pipelined_dispatch: bool = True,
 ):
     from shadow_tpu.sim import build_simulation
 
@@ -90,6 +91,7 @@ def build_phold_flagship(
                 "pool_gears": pool_gears,
                 "audit_digest": audit_digest,
                 "flight_recorder": flight_recorder,
+                "pipelined_dispatch": pipelined_dispatch,
             },
             "hosts": {
                 "peer": {

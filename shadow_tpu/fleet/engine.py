@@ -42,8 +42,10 @@ import numpy as np
 
 from shadow_tpu.core import gearbox, simtime
 from shadow_tpu.core import engine as engine_mod
+from shadow_tpu.core import pipeline as pipeline_mod
 from shadow_tpu.core import pressure as pressure_mod
 from shadow_tpu.core import state as state_mod
+from shadow_tpu.core import supervisor as supervisor_mod
 from shadow_tpu.core.config import load_config
 from shadow_tpu.fleet.scheduler import (
     DONE, FAILED, TIMEOUT, FleetScheduler, JobRecord,
@@ -172,6 +174,16 @@ class FleetSimulation:
         t = sims[0]
         self.template = t
         self._islands = isinstance(t, islands_mod.IslandSimulation)
+        # Pipelined CPU↔TPU handoff (core/pipeline.py): the fleet adopts
+        # the template job's experimental.pipelined_dispatch knob — one
+        # sweep, one dispatch discipline. Stats lazily created so serial
+        # sweeps emit no pipeline.* keys; handoff hooks run in the
+        # host-drain phase of every fleet dispatch boundary.
+        self.pipelined_dispatch = bool(
+            getattr(t, "pipelined_dispatch", True)
+        )
+        self._pipeline_stats: dict | None = None
+        self._handoff_hooks: list = []
         if self._islands and t.mode != "vmap":
             raise FleetError(
                 "fleet islands jobs run in island_mode: vmap (virtual "
@@ -565,6 +577,83 @@ class FleetSimulation:
         if self.supervisor is None:
             return thunk()
         return self.supervisor.call(label, thunk)
+
+    def _sv_issue(self, label: str, issue_fn, fetch_fn):
+        """ISSUE half of a split fleet dispatch (core/supervisor.py
+        PendingDispatch): enqueue async, never block."""
+        if self.supervisor is None:
+            return supervisor_mod.PendingDispatch.direct(
+                label, issue_fn, fetch_fn
+            )
+        return self.supervisor.issue(label, issue_fn, fetch_fn)
+
+    def _sv_await(self, pending):
+        """AWAIT half: blocking fetches under the classified retry
+        ladder / watchdog / loss policies when supervised."""
+        if self.supervisor is None:
+            return pending.await_direct()
+        return self.supervisor.await_result(pending)
+
+    def _sv_disrupted(self) -> bool:
+        sup = self.supervisor
+        return sup is not None and sup.pending_disruption
+
+    # -- pipelined CPU↔TPU handoff (core/pipeline.py) --
+
+    def _pipeline(self):
+        if not self.pipelined_dispatch:
+            return None
+        if self._pipeline_stats is None:
+            self._pipeline_stats = pipeline_mod.new_stats()
+        return pipeline_mod.TwoSlotPipeline(self._pipeline_stats)
+
+    def pipeline_stats(self) -> dict:
+        """`pipeline.*` telemetry (schema v14); {} until a pipelined
+        fleet loop ran (serial sweeps emit no pipeline keys)."""
+        st = self._pipeline_stats
+        return dict(st) if st is not None else {}
+
+    def add_handoff_hook(self, fn) -> None:
+        """Register fn(fleet, frontier_ns) — called in the host-drain
+        phase of every fleet dispatch boundary (after scheduler work)."""
+        self._handoff_hooks.append(fn)
+
+    def _handoff_quiet(self, mn: np.ndarray) -> bool:
+        """True when the upcoming fleet handoff cannot take a scheduler
+        or state action at frontier vector `mn`: no lane finished, no
+        due lane/backend injection, no wall deadline armed on a running
+        job, no checkpoint mark due, no pressure hold. Speculation only
+        crosses QUIET boundaries; everything else is a barrier point."""
+        if self._evict_hold > 0 or self._admission_paused:
+            return False
+        frontier = int(NEVER)
+        for j in range(self.lanes):
+            rec = self.sched.lane_job[j]
+            if rec is None:
+                # an empty lane means queued work could admit
+                if self.sched.pending():
+                    return False
+                continue
+            if mn[j] >= self._stop[j]:
+                return False  # harvest due
+            if rec.spec.deadline_s:
+                return False  # wall-clock deadline: unpredictable
+            lf = self._lane_faults[j]
+            if lf.pending and lf.pending[0][0] <= mn[j]:
+                return False
+            if lf.dead:
+                return False  # recurring quarantine drain
+            frontier = min(frontier, int(mn[j]))
+        if self._backend_fault_mark() <= frontier:
+            return False
+        if (self.checkpoint_dir and self.checkpoint_every_ns
+                and frontier >= self._ckpt_next_t):
+            return False
+        pc = self.pressure
+        if (pc is not None and pc.saturate_frac is not None
+                and pc.saturate_frac < 1.0):
+            return False
+        return True
 
     def attach_faults(self, faults) -> None:
         """Arm FLEET-scoped injections: backend ops (kill_backend /
@@ -1202,116 +1291,180 @@ class FleetSimulation:
     # drivers
     # ------------------------------------------------------------------
 
+    def _run_to_halves(self, eff_stop: np.ndarray, wpd: int):
+        """(issue_fn, fetch_fn) halves of one fused fleet dispatch.
+        issue enqueues the vmapped per-lane window loops (async —
+        futures); fetch performs every blocking host read. Supervised
+        retries re-run both halves against the bound kernels."""
+
+        def issue(eff_stop=eff_stop, wpd=wpd):
+            if self._async:
+                return self._run_to(
+                    self.state, self.params,
+                    jnp.asarray(self._async_runahead),
+                    jnp.asarray(self._async_look),
+                    jnp.asarray(self._async_spread),
+                    jnp.asarray(eff_stop), wpd,
+                )
+            return self._run_to(
+                self.state, self.params,
+                jnp.asarray(self._runahead),
+                jnp.asarray(eff_stop), wpd,
+            )
+
+        def fetch(out):
+            extra = None
+            if self._async:
+                # frontier [L, S] + fleet-summed async counters
+                extra = (
+                    np.asarray(jax.device_get(out[5])).reshape(
+                        self.lanes, -1),
+                    int(np.max(np.asarray(jax.device_get(out[6])))),
+                    int(np.sum(np.asarray(jax.device_get(out[7])))),
+                    int(np.sum(np.asarray(jax.device_get(out[8])))),
+                    int(np.sum(np.asarray(jax.device_get(out[9])))),
+                    int(np.max(np.asarray(jax.device_get(out[4])))),
+                )
+            return (
+                out[0],
+                np.asarray(jax.device_get(out[1])).reshape(
+                    self.lanes, -1).min(axis=1),
+                np.asarray(jax.device_get(out[2])).reshape(
+                    self.lanes, -1).any(axis=1),
+                int(np.max(np.asarray(jax.device_get(out[3])))),
+                extra,
+            )
+
+        return issue, fetch
+
     def run(self, windows_per_dispatch: int | None = None,
             max_dispatches: int | None = None) -> int:
         """Conservative fleet run: fused per-lane window loops in one
         vmapped dispatch, scheduler work at every handoff boundary.
-        Returns the dispatch count."""
+        Returns the dispatch count.
+
+        Pipelined (core/pipeline.py): dispatch N+1 is issued before
+        window N's scheduler work runs — only across quiet boundaries
+        (no harvest/admission/injection/deadline/checkpoint due), and
+        the issue is recomputed whenever the handoff took any scheduler
+        action (`changed`), shifted the gear, or mutated fleet state."""
         wpd = windows_per_dispatch or self.windows_per_dispatch
         dispatches = 0
         last_sig = None
         obs = self.obs_session
-        while not self.sched.all_terminal():
-            if max_dispatches is not None and dispatches >= max_dispatches:
-                break
-            # expired-deadline lanes free up BEFORE the dispatch — a dead
-            # job never rides another dispatch holding its lane
-            self._reclaim_expired()
-            if self.sched.all_terminal():
-                break
-            eff_stop = np.minimum(
-                np.minimum(self._stop, self._fault_marks()),
-                self._backend_fault_mark(),
-            )
-            with metrics_mod.span(obs, "dispatch", windows=wpd):
-
-                def _dispatch(eff_stop=eff_stop, wpd=wpd):
-                    if self._async:
-                        out = self._run_to(
-                            self.state, self.params,
-                            jnp.asarray(self._async_runahead),
-                            jnp.asarray(self._async_look),
-                            jnp.asarray(self._async_spread),
-                            jnp.asarray(eff_stop), wpd,
+        pipe = self._pipeline()
+        pending = None
+        try:
+            while not self.sched.all_terminal():
+                if max_dispatches is not None \
+                        and dispatches >= max_dispatches:
+                    break
+                # expired-deadline lanes free up BEFORE the dispatch — a
+                # dead job never rides another dispatch holding its lane
+                if self._reclaim_expired() and pipe is not None:
+                    pipe.discard()
+                if self.sched.all_terminal():
+                    break
+                eff_stop = np.minimum(
+                    np.minimum(self._stop, self._fault_marks()),
+                    self._backend_fault_mark(),
+                )
+                pending = (
+                    pipe.take(self.state,
+                              (eff_stop.tobytes(), wpd))
+                    if pipe is not None else None
+                )
+                if pending is None:
+                    with metrics_mod.span(obs, "dispatch", windows=wpd):
+                        p = self._sv_issue(
+                            "run_to", *self._run_to_halves(eff_stop, wpd)
                         )
+                        self.state, mn, press, occ, ainfo = \
+                            self._sv_await(p)
+                else:
+                    with metrics_mod.span(obs, "await", windows=wpd):
+                        self.state, mn, press, occ, ainfo = \
+                            self._sv_await(pending)
+                    pending = None
+                # two-slot pipeline: issue dispatch N+1 while the host
+                # runs this boundary's scheduler work
+                if pipe is not None and not self.sched.all_terminal():
+                    if (not press.any() and self._handoff_quiet(mn)
+                            and not self._sv_disrupted()):
+                        nxt = np.minimum(
+                            np.minimum(self._stop, self._fault_marks()),
+                            self._backend_fault_mark(),
+                        )
+                        with metrics_mod.span(obs, "issue", windows=wpd):
+                            pipe.put(
+                                self._sv_issue(
+                                    "run_to",
+                                    *self._run_to_halves(nxt, wpd),
+                                ),
+                                self.state, (nxt.tobytes(), wpd),
+                            )
                     else:
-                        out = self._run_to(
-                            self.state, self.params,
-                            jnp.asarray(self._runahead),
-                            jnp.asarray(eff_stop), wpd,
+                        pipe.forced_drain()
+                with metrics_mod.span(obs, "host_drain"):
+                    if ainfo is not None:
+                        c = self._async_counters
+                        c["dispatches"] += 1
+                        c["supersteps"] += ainfo[5]
+                        c["shard_windows"] += ainfo[2]
+                        c["yields"] += ainfo[3]
+                        c["blocked_on_neighbor"] += ainfo[4]
+                        self._async_spread_max = max(
+                            self._async_spread_max, ainfo[1]
                         )
-                    extra = None
-                    if self._async:
-                        # frontier [L, S] + fleet-summed async counters
-                        extra = (
-                            np.asarray(jax.device_get(out[5])).reshape(
-                                self.lanes, -1),
-                            int(np.max(np.asarray(jax.device_get(out[6])))),
-                            int(np.sum(np.asarray(jax.device_get(out[7])))),
-                            int(np.sum(np.asarray(jax.device_get(out[8])))),
-                            int(np.sum(np.asarray(jax.device_get(out[9])))),
-                            int(np.max(np.asarray(jax.device_get(out[4])))),
+                        self._async_frontier = ainfo[0]
+                    dispatches += 1
+                    if obs is not None:
+                        obs.round_done(self)
+                    self._backend_fault_tick(mn)
+                    changed = self._handoff(mn, press)
+                    if self._shifter is not None and not (
+                        self.pressure is not None
+                        and self.pressure.hold_gear
+                    ):
+                        new = self._shifter.observe(
+                            self._gear, occ, press=bool(press.any())
                         )
-                    return (
-                        out[0],
-                        np.asarray(jax.device_get(out[1])).reshape(
-                            self.lanes, -1).min(axis=1),
-                        np.asarray(jax.device_get(out[2])).reshape(
-                            self.lanes, -1).any(axis=1),
-                        int(np.max(np.asarray(jax.device_get(out[3])))),
-                        extra,
+                        if new is not None:
+                            self._shift_gear(new)
+                            changed = True
+                    for fn in self._handoff_hooks:
+                        fn(self, mn)
+                if pipe is not None:
+                    if changed or self._sv_disrupted():
+                        pipe.discard()
+                    else:
+                        pipe.invalidate(self.state)
+                sig = (tuple(mn),
+                       tuple(r.status for r in self.sched.records),
+                       tuple(len(lf.pending) for lf in self._lane_faults),
+                       self._gear)
+                if not changed and sig == last_sig:
+                    cap = self._ladder[self._gear].capacity
+                    if self._pressure_stall(
+                        window=int(mn.min()), occupancy=occ,
+                        capacity=cap,
+                    ):
+                        last_sig = None  # a ladder rung reshaped the fleet
+                        continue
+                    raise self._pool_exhausted(
+                        "fleet cannot make progress: no lane advanced and "
+                        "no scheduler action fired (pool occupancy leaves "
+                        "too little headroom for even one window's "
+                        "emissions); raise experimental.event_capacity",
+                        window=int(mn.min()), occupancy=occ,
+                        capacity=cap,
                     )
-
-                self.state, mn, press, occ, ainfo = self._sv(
-                    "run_to", _dispatch
-                )
-            if ainfo is not None:
-                c = self._async_counters
-                c["dispatches"] += 1
-                c["supersteps"] += ainfo[5]
-                c["shard_windows"] += ainfo[2]
-                c["yields"] += ainfo[3]
-                c["blocked_on_neighbor"] += ainfo[4]
-                self._async_spread_max = max(
-                    self._async_spread_max, ainfo[1]
-                )
-                self._async_frontier = ainfo[0]
-            dispatches += 1
-            if obs is not None:
-                obs.round_done(self)
-            self._backend_fault_tick(mn)
-            changed = self._handoff(mn, press)
-            if self._shifter is not None and not (
-                self.pressure is not None and self.pressure.hold_gear
-            ):
-                new = self._shifter.observe(
-                    self._gear, occ, press=bool(press.any())
-                )
-                if new is not None:
-                    self._shift_gear(new)
-                    changed = True
-            sig = (tuple(mn), tuple(r.status for r in self.sched.records),
-                   tuple(len(lf.pending) for lf in self._lane_faults),
-                   self._gear)
-            if not changed and sig == last_sig:
-                cap = self._ladder[self._gear].capacity
-                if self._pressure_stall(
-                    window=int(mn.min()), occupancy=occ,
-                    capacity=cap,
-                ):
-                    last_sig = None  # a ladder rung reshaped the fleet
-                    continue
-                raise self._pool_exhausted(
-                    "fleet cannot make progress: no lane advanced and no "
-                    "scheduler action fired (pool occupancy leaves too "
-                    "little headroom for even one window's emissions); "
-                    "raise experimental.event_capacity",
-                    window=int(mn.min()), occupancy=occ,
-                    capacity=cap,
-                )
-            elif self.pressure is not None:
-                self.pressure.note_progress()
-            last_sig = sig
+                elif self.pressure is not None:
+                    self.pressure.note_progress()
+                last_sig = sig
+        finally:
+            if pipe is not None:
+                pipe.close()
         return dispatches
 
     def _reset_done_t(self) -> None:

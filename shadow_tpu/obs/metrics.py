@@ -25,6 +25,11 @@ import numpy as np
 
 from shadow_tpu.obs import counters as obs_counters
 
+# v14: pipeline.* pipelined-handoff namespace (core/pipeline.py + the
+# driver loops: issued-ahead dispatch count, overlap_ns of host-drain
+# time hidden behind in-flight device work, forced_drains at
+# state-mutating barrier points, recompute_discards where a drained
+# handoff invalidated a speculative issue);
 # v13: dropped the never-emitted `bench` namespace from the closed
 # table — the contract auditor (analysis/contracts.py SLC002) requires
 # every registered namespace to have a statically-visible emitter, and
@@ -57,7 +62,7 @@ from shadow_tpu.obs import counters as obs_counters
 # obs/audit.py) + optional per-job `audit` sub-object on fleet.jobs[*]
 # rows; v4: optional top-level `fleet` section (fleet.jobs[*] per-job
 # rows) + fleet.* counters; v3: faults.* recovery counters
-SCHEMA_VERSION = 13
+SCHEMA_VERSION = 14
 DOC_KIND = "shadow_tpu.metrics"
 
 # metrics-doc `fleet.jobs[*]` rows must carry at least these keys
@@ -92,6 +97,7 @@ KNOWN_METRIC_NAMESPACES = frozenset({
     "balance",     # self-balancing fleet plane (schema v10)
     "mesh",        # multi-chip mesh execution plane (schema v11;
                    # elastic-resilience rows added in v12)
+    "pipeline",    # pipelined CPU↔TPU handoff (schema v14)
     "sim",         # build-level gauges (num_hosts, runahead)
 })
 
@@ -242,6 +248,11 @@ def validate_metrics_doc(doc: dict, strict_namespaces: bool = False) -> None:
             raise ValueError(
                 f"mesh counter {k!r} must be >= 0, got {v}"
             )
+        if k.startswith("pipeline.") and v < 0:
+            # schema v14: pipelined-handoff counters are monotonic tallies
+            raise ValueError(
+                f"pipeline counter {k!r} must be >= 0, got {v}"
+            )
     for k, v in doc["gauges"].items():
         if not isinstance(v, (int, float)) or isinstance(v, bool):
             raise ValueError(f"gauge {k!r} must be a number, got {v!r}")
@@ -376,6 +387,19 @@ def snapshot_device(sim, reg: MetricsRegistry) -> None:
     _snapshot_async(sim, reg)
     _snapshot_balance(sim, reg)
     _snapshot_mesh(sim, reg)
+    _snapshot_pipeline(sim, reg)
+
+
+def _snapshot_pipeline(sim, reg: MetricsRegistry) -> None:
+    """Pipelined-handoff plane (schema v14): issued-ahead / overlap /
+    forced-drain / recompute-discard tallies from the two-slot dispatch
+    pipeline (core/pipeline.py). Serial runs (experimental.
+    pipelined_dispatch: false) report {} and emit no pipeline keys."""
+    ps = getattr(sim, "pipeline_stats", None)
+    if ps is None:
+        return
+    for k, v in ps().items():
+        reg.counter_set(f"pipeline.{k}", int(v))
 
 
 def _snapshot_mesh(sim, reg: MetricsRegistry) -> None:
@@ -495,6 +519,7 @@ def snapshot_fleet(fleet, reg: MetricsRegistry) -> None:
     _snapshot_async(fleet, reg)
     _snapshot_balance(fleet, reg)
     _snapshot_mesh(fleet, reg)
+    _snapshot_pipeline(fleet, reg)
     reg.section_set("fleet", {
         "lanes": int(stats.get("lanes", 0)),
         "lane_swaps": int(stats.get("lane_swaps", 0)),

@@ -1570,6 +1570,50 @@ class IslandSimulation(Simulation):
         if mean > 0 and occ.max() > max(2 * mean, occ.min() + 256):
             self.rebalance_now()
 
+    def _run_to_halves(self, stop_at, wpd):
+        """(issue_fn, fetch_fn) halves of one fused islands dispatch —
+        the async per-shard-frontier loop or the barrier loop. issue
+        enqueues the device program (futures only); fetch performs every
+        blocking host read, once, in one place (the old thunk fetched
+        the window count twice). Supervised retries re-run both halves,
+        re-reading bound kernels and re-clamping the spill stop."""
+
+        def issue(stop_at=stop_at, wpd=wpd):
+            # per-attempt clamp: a pressure rung may have engaged the
+            # spill tier since the driver computed stop_at
+            stop_at, wpd = self._live_spill_clamp(stop_at, wpd)
+            if self._async:
+                return self._run_to_async(
+                    self.state, self.params,
+                    self._async_runahead, self._async_look_in,
+                    self._async_spread, stop_at, wpd,
+                )
+            return self._run_to(self.state, self.params, stop_at, wpd)
+
+        def fetch(out):
+            if self._async:
+                st, mn, press, occ, w, fr, sp, stp, yld, blk = out
+                extra = (
+                    np.asarray(jax.device_get(fr)).reshape(-1),
+                    int(np.max(np.asarray(jax.device_get(sp)))),
+                    int(np.sum(np.asarray(jax.device_get(stp)))),
+                    int(np.sum(np.asarray(jax.device_get(yld)))),
+                    int(np.sum(np.asarray(jax.device_get(blk)))),
+                )
+            else:
+                st, mn, press, occ, w = out
+                extra = None
+            return (
+                st,
+                int(np.min(np.asarray(jax.device_get(mn)))),
+                bool(np.max(np.asarray(jax.device_get(press)))),
+                np.asarray(jax.device_get(occ)).reshape(-1),
+                int(np.max(np.asarray(jax.device_get(w)))),
+                extra,
+            )
+
+        return issue, fetch
+
     def run(self, until=None, windows_per_dispatch: int = 64) -> None:
         from shadow_tpu.core import spill as spill_mod
         from shadow_tpu.obs import metrics as metrics_mod
@@ -1577,115 +1621,142 @@ class IslandSimulation(Simulation):
         stop = self.stop_time if until is None else min(until, self.stop_time)
         spill = self._spill_store()
         obs = self.obs_session
+        pipe = self._pipeline()
         last = None
-        while True:
-            if (
-                (last is not None and last[2]) or spill.count
-                or self._force_spill  # injected force_spill fault
-            ):
-                with metrics_mod.span(obs, "spill"):
-                    self._maybe_rebalance()
-                    stop_at = spill_mod.manage(self, spill, stop)
-            else:
-                stop_at = stop
-            # single-window dispatches while the spill is active (exactness
-            # requires a manage pass between windows — core/spill.py)
-            wpd = 1 if spill.count else windows_per_dispatch
-            if self._fault_plane_active():
-                # hand off at the next injection/checkpoint mark
-                stop_at = min(stop_at, self._fault_mark())
-            with metrics_mod.span(obs, "dispatch", windows=wpd):
-
-                def _dispatch(stop_at=stop_at, wpd=wpd):
-                    # per-attempt clamp: a pressure rung may have engaged
-                    # the spill tier since the driver computed stop_at
-                    stop_at, wpd = self._live_spill_clamp(stop_at, wpd)
-                    if self._async:
-                        (st, mn, press, occ, w, fr, sp, stp, yld,
-                         blk) = self._run_to_async(
-                            self.state, self.params,
-                            self._async_runahead, self._async_look_in,
-                            self._async_spread, stop_at, wpd,
-                        )
-                        extra = (
-                            np.asarray(jax.device_get(fr)).reshape(-1),
-                            int(np.max(np.asarray(jax.device_get(sp)))),
-                            int(np.sum(np.asarray(jax.device_get(stp)))),
-                            int(np.sum(np.asarray(jax.device_get(yld)))),
-                            int(np.sum(np.asarray(jax.device_get(blk)))),
-                        )
-                    else:
-                        st, mn, press, occ, w = self._run_to(
-                            self.state, self.params, stop_at, wpd
-                        )
-                        extra = None
-                    return (
-                        st,
-                        int(np.min(np.asarray(jax.device_get(mn)))),
-                        bool(np.max(np.asarray(jax.device_get(press)))),
-                        np.asarray(jax.device_get(occ)).reshape(-1),
-                        w,
-                        extra,
-                    )
-
-                self.state, mn, press, occ_v, w, ainfo = self._sv(
-                    "run_to", _dispatch
-                )
-            occ = int(occ_v.max())
-            self._gear_note_dispatch()
-            self.windows_run += int(np.max(np.asarray(w)))
-            if ainfo is not None:
-                self._note_async_dispatch(
-                    ainfo, int(np.max(np.asarray(jax.device_get(w))))
-                )
-            if obs is not None:
-                obs.round_done(self)
-            self._audit_tick(mn)
-            # gearing: a red-zone early exit upshifts (one pool re-sort)
-            # before the spill tier would pay host drain round-trips;
-            # under async the decision is PER SHARD from the occupancy
-            # vector (gearbox.ShardGearShifter), each shard's ladder
-            # state advancing at its own dispatch boundary
-            if self._async and self._shard_shifter is not None:
-                shifted = self._gear_tick_async(occ_v)
-            else:
-                shifted = self._gear_tick(occ, press=press)
-            if self._fault_plane_active():
-                self._handoff_tick(mn)
-            if self.balancer is not None:
-                # closed-loop hot-shard healing (parallel/balancer.py):
-                # detection from the dispatch's own occupancy vector +
-                # frontier surface; a committed migration permutes the
-                # layout through the traced-lookahead seam (no recompile)
-                if self.balancer.observe(
-                    self, occ_v,
-                    ainfo[0] if ainfo is not None else None,
+        try:
+            while True:
+                if (
+                    (last is not None and last[2]) or spill.count
+                    or self._force_spill  # injected force_spill fault
                 ):
-                    shifted = True
-            if mn >= stop and spill.min_time >= stop and not press:
-                break
-            if self.elastic is not None:
-                # elastic re-expansion probe (parallel/elastic.py): may
-                # raise MeshReexpand at this committed boundary — the
-                # runner drains and relayouts onto the recovered mesh
-                self.elastic.on_dispatch(self, mn)
-            fr_min = int(ainfo[0].min()) if ainfo is not None else None
-            cur = (mn, spill.count, press, fr_min)
-            if cur == last and mn >= stop_at and not shifted:
-                cap = self._gear_ladder[self._gear].capacity
-                if self._pressure_stall(window=mn, occupancy=occ,
-                                        capacity=cap):
-                    last = None  # a ladder rung reshaped the tier
-                    continue
-                raise self._pool_exhausted(
-                    "spill tier cannot make progress (single over-full "
-                    "timestamp or no pool headroom for one window's "
-                    "emissions); raise experimental.event_capacity",
-                    window=mn, occupancy=occ, capacity=cap,
+                    if pipe is not None:
+                        # rebalance + spill manage mutate the layout /
+                        # pool: a barrier point (already tallied as a
+                        # forced drain when speculation was skipped)
+                        pipe.close()
+                    with metrics_mod.span(obs, "spill"):
+                        self._maybe_rebalance()
+                        stop_at = spill_mod.manage(self, spill, stop)
+                else:
+                    stop_at = stop
+                # single-window dispatches while the spill is active
+                # (exactness requires a manage pass between windows —
+                # core/spill.py)
+                wpd = 1 if spill.count else windows_per_dispatch
+                if self._fault_plane_active():
+                    # hand off at the next injection/checkpoint mark
+                    stop_at = min(stop_at, self._fault_mark())
+                # adopt the issued-ahead dispatch iff the committed state
+                # and recomputed args match (core/pipeline.py)
+                pending = (
+                    pipe.take(self.state, (stop_at, wpd))
+                    if pipe is not None else None
                 )
-            elif self.pressure is not None:
-                self.pressure.note_progress()
-            last = cur
+                if pending is None:
+                    with metrics_mod.span(obs, "dispatch", windows=wpd):
+                        p = self._sv_issue(
+                            "run_to", *self._run_to_halves(stop_at, wpd)
+                        )
+                        (self.state, mn, press, occ_v, w,
+                         ainfo) = self._sv_await(p)
+                else:
+                    with metrics_mod.span(obs, "await", windows=wpd):
+                        (self.state, mn, press, occ_v, w,
+                         ainfo) = self._sv_await(pending)
+                occ = int(occ_v.max())
+                # two-slot pipeline: issue the next fused dispatch before
+                # draining this handoff — the mesh computes its next
+                # supersteps while the host drains; balancer migrations,
+                # fault drains and gear shifts stay barrier points (the
+                # invalidate below discards on any state mutation)
+                if pipe is not None and mn < stop:
+                    if (not press and not spill.count
+                            and not self._force_spill
+                            and self._handoff_quiet(mn)
+                            and not self._sv_disrupted()):
+                        nxt = stop
+                        if self._fault_plane_active():
+                            nxt = min(nxt, self._fault_mark())
+                        with metrics_mod.span(
+                            obs, "issue", windows=windows_per_dispatch
+                        ):
+                            pipe.put(
+                                self._sv_issue(
+                                    "run_to",
+                                    *self._run_to_halves(
+                                        nxt, windows_per_dispatch
+                                    ),
+                                ),
+                                self.state,
+                                (nxt, windows_per_dispatch),
+                            )
+                    else:
+                        pipe.forced_drain()
+                with metrics_mod.span(obs, "host_drain"):
+                    self._gear_note_dispatch()
+                    self.windows_run += w
+                    if ainfo is not None:
+                        self._note_async_dispatch(ainfo, w)
+                    if obs is not None:
+                        obs.round_done(self)
+                    self._audit_tick(mn)
+                    # gearing: a red-zone early exit upshifts (one pool
+                    # re-sort) before the spill tier would pay host drain
+                    # round-trips; under async the decision is PER SHARD
+                    # from the occupancy vector (gearbox.ShardGearShifter),
+                    # each shard's ladder state advancing at its own
+                    # dispatch boundary
+                    if self._async and self._shard_shifter is not None:
+                        shifted = self._gear_tick_async(occ_v)
+                    else:
+                        shifted = self._gear_tick(occ, press=press)
+                    if self._fault_plane_active():
+                        self._handoff_tick(mn)
+                    if self.balancer is not None:
+                        # closed-loop hot-shard healing (parallel/
+                        # balancer.py): detection from the dispatch's own
+                        # occupancy vector + frontier surface; a committed
+                        # migration permutes the layout through the
+                        # traced-lookahead seam (no recompile)
+                        if self.balancer.observe(
+                            self, occ_v,
+                            ainfo[0] if ainfo is not None else None,
+                        ):
+                            shifted = True
+                    self._run_handoff_hooks(mn)
+                if pipe is not None:
+                    if self._sv_disrupted():
+                        pipe.discard()
+                    else:
+                        pipe.invalidate(self.state)
+                if mn >= stop and spill.min_time >= stop and not press:
+                    break
+                if self.elastic is not None:
+                    # elastic re-expansion probe (parallel/elastic.py):
+                    # may raise MeshReexpand at this committed boundary —
+                    # the runner drains and relayouts onto the recovered
+                    # mesh
+                    self.elastic.on_dispatch(self, mn)
+                fr_min = int(ainfo[0].min()) if ainfo is not None else None
+                cur = (mn, spill.count, press, fr_min)
+                if cur == last and mn >= stop_at and not shifted:
+                    cap = self._gear_ladder[self._gear].capacity
+                    if self._pressure_stall(window=mn, occupancy=occ,
+                                            capacity=cap):
+                        last = None  # a ladder rung reshaped the tier
+                        continue
+                    raise self._pool_exhausted(
+                        "spill tier cannot make progress (single over-full "
+                        "timestamp or no pool headroom for one window's "
+                        "emissions); raise experimental.event_capacity",
+                        window=mn, occupancy=occ, capacity=cap,
+                    )
+                elif self.pressure is not None:
+                    self.pressure.note_progress()
+                last = cur
+        finally:
+            if pipe is not None:
+                pipe.close()
 
     def run_stepwise(self, until=None) -> int:
         from shadow_tpu.core import spill as spill_mod
@@ -1696,18 +1767,28 @@ class IslandSimulation(Simulation):
         obs = self.obs_session
         windows = 0
         stall = 0
+        # committed frontier carried from the dispatch's own return value
+        # (a fresh per-iteration jnp.min dispatched one tiny reduce kernel
+        # per window for nothing); None = derive from the pool
+        min_next = None
         while True:
             if self._shifter is not None:
                 # gear decision BEFORE spill manage: an upshift absorbs
                 # red-zone pressure without a host drain episode
                 self._gear_tick(self._pool_occupancy())
             with metrics_mod.span(obs, "spill"):
+                tok = self.state
                 stop_at = spill_mod.manage(self, spill, stop)
-            min_next = int(jax.device_get(jnp.min(self.state.pool.time)))
-            if self._fault_plane_active():
-                self._handoff_tick(min_next)
-                # a drain may have removed the frontier event
+            if self.state is not tok or min_next is None:
                 min_next = int(jax.device_get(jnp.min(self.state.pool.time)))
+            if self._fault_plane_active():
+                tok = self.state
+                self._handoff_tick(min_next)
+                if self.state is not tok:
+                    # a drain may have removed the frontier event
+                    min_next = int(
+                        jax.device_get(jnp.min(self.state.pool.time))
+                    )
             if min_next >= stop_at:
                 if min_next >= stop and spill.min_time >= stop:
                     break
@@ -1745,6 +1826,7 @@ class IslandSimulation(Simulation):
 
                 self.state, mn = self._sv("step", _dispatch)
             self._gear_note_dispatch()
+            min_next = mn
             if self._audit_active():
                 self._audit_tick(mn)
             windows += 1

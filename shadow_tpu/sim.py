@@ -277,6 +277,7 @@ def build_simulation(source) -> Simulation:
         pool_gears=cfg.experimental.pool_gears,
         audit_digest=cfg.experimental.audit_digest,
         flight_capacity=cfg.experimental.flight_recorder,
+        pipelined_dispatch=cfg.experimental.pipelined_dispatch,
     )
     # attach build artifacts for inspection/observability
     sim.config = cfg

@@ -52,6 +52,9 @@ _BUDGET_TIER = {
     "test_async_sync": 3,
     # the self-balancing acceptance gate (ISSUE 11): same rule
     "test_balancer": 3,
+    # the pipelined-handoff chain-equality matrix (ISSUE 15): same rule —
+    # ahead of the compile-heavy tier-4 matrices
+    "test_pipeline": 3,
     # the multi-chip mesh acceptance gate (ISSUE 12): same rule — its
     # shard_map cells compile more than the vmap tiers but the chain
     # matrix + relayout resume must land before the tier-4 tail

@@ -8,6 +8,13 @@ the spec allows: the object form ({"traceEvents": [...]}) and the bare
 JSON array form ([...]). With --json the summary is machine-readable, so
 CI can diff span stats across runs.
 
+Pipelined-handoff traces (docs/architecture.md §Pipelined handoff) carry
+`issue` / `await` / `host_drain` spans instead of one fused `dispatch`
+span per boundary; for those the summary also reports OVERLAP EFFICIENCY
+— the fraction of host-drain wall time that fell inside an in-flight
+device dispatch (between an issue span's end and its await span's end),
+i.e. how much of the host-side handoff the pipeline actually hid.
+
 Usage:  python tools/trace_summary.py shadow.trace.json [-n TOP] [--json]
 """
 
@@ -62,6 +69,58 @@ def summarize(doc) -> tuple[list[dict], dict[str, int]]:
     return rows, other
 
 
+def overlap_stats(doc) -> dict | None:
+    """Pipelined-handoff overlap efficiency from a driver trace.
+
+    Pairs each `await` span with the latest unpaired `issue` span that
+    ended before it: the interval [issue end, await end] is device work
+    in flight. `host_drain` span time inside any in-flight interval was
+    HIDDEN behind the device; time outside was exposed (the serial-loop
+    cost). Returns None when the trace carries no issue/await spans (a
+    serial run, or a pre-pipeline trace)."""
+    events = doc if isinstance(doc, list) else doc.get("traceEvents", [])
+    issues, awaits, drains = [], [], []
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue
+        ts = float(ev.get("ts", 0.0))
+        dur = float(ev.get("dur", 0.0))
+        name = ev.get("name")
+        if name == "issue":
+            issues.append((ts, ts + dur))
+        elif name == "await":
+            awaits.append((ts, ts + dur))
+        elif name == "host_drain":
+            drains.append((ts, ts + dur))
+    if not issues or not awaits:
+        return None
+    issues.sort()
+    awaits.sort()
+    inflight = []
+    i = 0
+    for a0, a1 in awaits:
+        start = None
+        while i < len(issues) and issues[i][1] <= a0:
+            start = issues[i][1]  # latest issue ending before this await
+            i += 1
+        if start is not None:
+            inflight.append((start, a1))
+    total = sum(d1 - d0 for d0, d1 in drains)
+    hidden = 0.0
+    for d0, d1 in drains:
+        for f0, f1 in inflight:
+            lo, hi = max(d0, f0), min(d1, f1)
+            if hi > lo:
+                hidden += hi - lo
+    return {
+        "issued_ahead": len(issues),
+        "adopted": len(inflight),
+        "host_drain_ms": total / 1e3,
+        "hidden_ms": hidden / 1e3,
+        "overlap_efficiency": (hidden / total) if total > 0 else 0.0,
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("trace", help="trace JSON written by --trace-out")
@@ -75,15 +134,19 @@ def main(argv=None) -> int:
         with open(args.trace) as f:
             doc = json.load(f)
         rows, other = summarize(doc)
+        overlap = overlap_stats(doc)
     except (OSError, ValueError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
     if args.as_json:
-        print(json.dumps({
+        out = {
             "spans": rows[: args.top],
             "span_kinds": len(rows),
             "markers": dict(sorted(other.items())),
-        }, indent=1))
+        }
+        if overlap is not None:
+            out["overlap"] = overlap
+        print(json.dumps(out, indent=1))
         return 0
     if not rows:
         print("no span events in trace")
@@ -95,6 +158,14 @@ def main(argv=None) -> int:
         print(
             f"{r['name']:<{w}}  {r['count']:>7}  {r['total_ms']:>10.3f}  "
             f"{r['mean_ms']:>9.3f}  {r['max_ms']:>9.3f}"
+        )
+    if overlap is not None:
+        print(
+            f"\npipeline overlap: {overlap['hidden_ms']:.3f} of "
+            f"{overlap['host_drain_ms']:.3f} ms host-drain hidden "
+            f"({100 * overlap['overlap_efficiency']:.1f}% efficiency, "
+            f"{overlap['adopted']}/{overlap['issued_ahead']} issued-ahead "
+            f"dispatches adopted)"
         )
     if other:
         marks = ", ".join(f"{k} x{v}" for k, v in sorted(other.items()))
