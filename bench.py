@@ -2228,6 +2228,250 @@ def stage_pipeline_smoke(hosts: int = 256, msgload: int = 2,
     }
 
 
+def stage_hostplane_smoke(hosts: int = 48, msgload: int = 2,
+                          stop_s: int = 12, wpd: int = 4,
+                          per_host_drain_ms: float = 1.0):
+    """Multi-worker host-plane gate (ISSUE 17 acceptance).
+
+    Five chain-equality arms prove the host plane changes WHO executes
+    partition-local handoff work, never what it computes or the order it
+    commits: {conservative, optimistic, async-islands, fleet,
+    pipelined-conservative} each run with `experimental.host_workers: 4`
+    AND the serial path (`host_workers: 1`), audit chains + committed
+    events bit-identical per pair — and every pair registers a sharded
+    recorder hook whose (frontier, gid) coverage must match exactly,
+    proving the fan-out visits the same partitions either way.
+
+    The wall-clock arm runs a HANDOFF-HEAVY conservative workload: a
+    per-host drain model attached through
+    `Simulation.add_handoff_hook(fn, sharded=True)` — a blocking wait of
+    `per_host_drain_ms` PER HOST standing in for partition-local
+    syscall/IPC servicing (the latency class PARSIR binds to per-worker
+    queues). The serial arm pays hosts x wait per boundary; the 4-worker
+    arm pays ~hosts/4 x wait — the gate demands >= 1.2x overall wall.
+
+    Also gated: the schema-v15 metrics artifact (hostplane.* recorded
+    with sharded_drains > 0 and ZERO serial_fallbacks,
+    strict-validated), zero kernel retraces with the SAME compile count
+    as the serial arm (the host plane never touches the device program),
+    and trace-derived drain parallelism > 1 from the per-worker
+    host_drain spans tools/trace_summary.py reads.
+
+    CPU-deterministic (all arms share one backend), so no backend
+    wait."""
+    import importlib.util
+    import jax
+
+    from shadow_tpu.core import simtime
+    from shadow_tpu.analysis import hlo_audit
+    from shadow_tpu.flagship import build_phold_flagship
+    from shadow_tpu.fleet import JobSpec, build_fleet
+    from shadow_tpu.obs import metrics as obs_metrics
+    from shadow_tpu.obs.trace import ChromeTracer
+    from shadow_tpu.sim import build_simulation
+
+    _enable_compile_cache()
+
+    # ---- chain-equality arms (small, shared shapes) ----
+    gml = _async_smoke_gml(2, 4)
+
+    def small_cfg(workers, **exp):
+        hosts_d = {}
+        for v in range(8):
+            hosts_d[f"h{v:02d}"] = {
+                "quantity": 1, "network_node_id": v, "app_model": "phold",
+                "app_options": {"msgload": 1, "runtime": 6,
+                                "local_span": 2},
+            }
+        experimental = {
+            "event_capacity": 1024, "events_per_host_per_window": 8,
+            "outbox_slots": 8, "inbox_slots": 4,
+            "host_workers": workers,
+        }
+        experimental.update(exp)
+        return {
+            "general": {"stop_time": 8, "seed": 42},
+            "network": {"graph": {"type": "gml", "inline": gml}},
+            "experimental": experimental,
+            "hosts": hosts_d,
+        }
+
+    def chain_of(sim):
+        return int(sim.audit_chain()), int(
+            sim.counters()["events_committed"]
+        )
+
+    arms = {}
+
+    def pair(name, runner, mk):
+        multi, serial = mk(4), mk(1)
+        hits_m, hits_s = [], []
+        multi.add_handoff_hook(
+            lambda s, mn, gid, h=hits_m: h.append((int(mn), int(gid))),
+            sharded=True,
+        )
+        serial.add_handoff_hook(
+            lambda s, mn, gid, h=hits_s: h.append((int(mn), int(gid))),
+            sharded=True,
+        )
+        runner(multi)
+        runner(serial)
+        cm, cs = chain_of(multi), chain_of(serial)
+        hp = multi.hostplane_stats()
+        arms[name] = {
+            "chain": cm[0], "events": cm[1],
+            "equal": bool(
+                cm == cs
+                and sorted(hits_m) == sorted(hits_s)
+                and bool(hits_m)
+                and hp.get("sharded_drains", 0) > 0
+                and serial.hostplane_stats() == {}
+            ),
+        }
+        return multi
+
+    pair("conservative", lambda s: s.run(windows_per_dispatch=8),
+         lambda w: build_simulation(small_cfg(w)))
+    pair("optimistic", lambda s: s.run_optimistic(),
+         lambda w: build_simulation(small_cfg(w)))
+    pair("async_islands", lambda s: s.run(windows_per_dispatch=8),
+         lambda w: build_simulation(
+             small_cfg(w, num_shards=2, exchange_slots=16)))
+    pair("conservative_pipelined",
+         lambda s: s.run(windows_per_dispatch=8),
+         lambda w: build_simulation(small_cfg(w, pipelined_dispatch=True)))
+
+    def mk_fleet(workers):
+        jobs = [
+            JobSpec(f"j{i}", small_cfg(workers))
+            for i in range(3)
+        ]
+        for i, j in enumerate(jobs):
+            j.config["general"]["seed"] = 42 + i  # data-plane sweep axis
+        return build_fleet(jobs, lanes=2)
+
+    multi_fleet, serial_fleet = mk_fleet(4), mk_fleet(1)
+    lane_hits_m, lane_hits_s = [], []
+    multi_fleet.add_handoff_hook(
+        lambda f, mn, lane, h=lane_hits_m: h.append(int(lane)),
+        sharded=True,
+    )
+    serial_fleet.add_handoff_hook(
+        lambda f, mn, lane, h=lane_hits_s: h.append(int(lane)),
+        sharded=True,
+    )
+    multi_fleet.run()
+    serial_fleet.run()
+    rows_m = {r["name"]: r["audit"]["chain"] for r in multi_fleet.results()}
+    rows_s = {r["name"]: r["audit"]["chain"] for r in serial_fleet.results()}
+    arms["fleet"] = {
+        "chain": rows_m.get("j0", 0),
+        "events": sum(
+            r["events_committed"] for r in multi_fleet.results()
+        ),
+        "equal": bool(
+            rows_m == rows_s and bool(rows_m)
+            and sorted(lane_hits_m) == sorted(lane_hits_s)
+            and multi_fleet.hostplane_stats().get("sharded_drains", 0) > 0
+        ),
+    }
+    gate_chain = all(a["equal"] for a in arms.values())
+
+    # ---- wall-clock arm: handoff-heavy workload + per-host drain ----
+    drain_s = per_host_drain_ms / 1e3
+
+    def drain_model(sim, mn, gid):
+        # the partition-local syscall-drain stand-in: a blocking WAIT
+        # per host at every handoff boundary (state untouched — quiet
+        # and partition-local by contract, so the plane may shard it)
+        time.sleep(drain_s)
+
+    def timing_arm(workers, tracer=None):
+        sim = build_phold_flagship(
+            hosts, msgload=msgload, stop_s=stop_s, runtime_s=stop_s - 1,
+            seed=7, host_workers=workers,
+        )
+        sim.obs_session = obs_metrics.ObsSession(tracer=tracer)
+        # warm the compile, then time the steady region with the drain
+        sim.run(until=2 * simtime.NS_PER_SEC, windows_per_dispatch=wpd)
+        sim.add_handoff_hook(drain_model, sharded=True)
+        t0 = time.perf_counter()
+        sim.run(windows_per_dispatch=wpd)
+        wall = time.perf_counter() - t0
+        return sim, wall
+
+    # interleave arms to decorrelate machine drift from the comparison
+    serial_sim, w_s = timing_arm(1)
+    tracer = ChromeTracer()
+    multi_sim, w_m = timing_arm(4, tracer=tracer)
+    w_s = min(w_s, timing_arm(1)[1])
+    w_m = min(w_m, timing_arm(4)[1])
+    timing_equal = chain_of(multi_sim) == chain_of(serial_sim)
+    gate_wall = w_m > 0 and (w_s / w_m) >= 1.2
+
+    # retrace-free: the host plane must not add a compile — one lowering
+    # per bound kernel, and the same compile count as the serial arm
+    retrace_m = hlo_audit.retrace_report(multi_sim)
+    retrace_s = hlo_audit.retrace_report(serial_sim)
+    gate_retrace = bool(
+        retrace_m["ok"]
+        and retrace_m["compiles_total"] == retrace_s["compiles_total"]
+    )
+
+    # trace-derived drain parallelism (tools/trace_summary.py)
+    spec = importlib.util.spec_from_file_location(
+        "trace_summary", os.path.join(_REPO, "tools", "trace_summary.py")
+    )
+    trace_summary = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(trace_summary)
+    drain = trace_summary.drain_parallelism(tracer.to_doc()) or {}
+
+    # schema-v15 artifact from the 4-worker timing arm
+    metrics_path = os.path.join(_REPO, "hostplane_smoke.metrics.json")
+    session = obs_metrics.ObsSession()
+    session.finalize(multi_sim)
+    doc = session.metrics.dump(metrics_path, meta={
+        "stage": "hostplane_smoke", "hosts": hosts,
+        "per_host_drain_ms": per_host_drain_ms,
+    })
+    obs_metrics.validate_metrics_doc(doc, strict_namespaces=True)
+    hpstats = multi_sim.hostplane_stats()
+    gate_schema = bool(
+        doc["counters"].get("hostplane.workers", 0) == 4
+        and doc["counters"].get("hostplane.sharded_drains", 0) > 0
+        and doc["counters"].get("hostplane.serial_fallbacks", -1) == 0
+    )
+
+    return {
+        "stage": "hostplane_smoke",
+        "platform": jax.default_backend(),
+        "hosts": hosts,
+        "windows_per_dispatch": wpd,
+        "per_host_drain_ms": per_host_drain_ms,
+        "arms": arms,
+        "timing_chain_equal": bool(timing_equal),
+        "wall_serial_s": round(w_s, 3),
+        "wall_multi_s": round(w_m, 3),
+        "wall_ratio": round(w_s / w_m, 2) if w_m else 0.0,
+        "hostplane": {k: int(v) for k, v in sorted(hpstats.items())},
+        "drain_parallelism": round(
+            float(drain.get("parallelism", 0.0)), 2
+        ),
+        "kernel_compiles": int(retrace_m["compiles_total"]),
+        "metrics_out": os.path.relpath(metrics_path, _REPO),
+        "gate_chain": bool(gate_chain and timing_equal),
+        "gate_wall": bool(gate_wall),
+        "gate_parallel": bool(drain.get("parallelism", 0.0) > 1.0),
+        "gate_retrace": gate_retrace,
+        "gate_schema": gate_schema,
+        "gate": bool(
+            gate_chain and timing_equal and gate_wall
+            and drain.get("parallelism", 0.0) > 1.0 and gate_retrace
+            and gate_schema
+        ),
+    }
+
+
 def stage_lint_smoke():
     """shadowlint gate (ISSUE 7 acceptance, extended by ISSUE 14): all
     FOUR static-analysis passes over the tree must report ZERO
@@ -2327,6 +2571,17 @@ def main():
         # backend — no backend wait.
         os.environ.setdefault("SHADOW_TPU_BENCH_ALLOW_CPU", "1")
         print(json.dumps(stage_pipeline_smoke()), flush=True)
+        return
+    if "--hostplane-smoke" in sys.argv:
+        # multi-worker host-plane gate: audit chains bit-identical
+        # host_workers=4 vs 1 across {conservative, optimistic,
+        # async-islands, fleet, pipelined}, >= 1.2x wall on a
+        # handoff-heavy workload (the per-host drain model sharded
+        # across pinned workers), schema-v15 artifact, drain
+        # parallelism > 1, retrace-free. All arms share one CPU
+        # backend — no backend wait.
+        os.environ.setdefault("SHADOW_TPU_BENCH_ALLOW_CPU", "1")
+        print(json.dumps(stage_hostplane_smoke()), flush=True)
         return
     if "--serve-smoke" in sys.argv:
         # sim-as-a-service gate: submit → SIGKILL the daemon → restart →

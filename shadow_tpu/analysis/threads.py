@@ -65,6 +65,7 @@ THREAD_MODULES = (
     "shadow_tpu/fleet/scheduler.py",
     "shadow_tpu/core/supervisor.py",
     "shadow_tpu/parallel/elastic.py",
+    "shadow_tpu/core/hostplane.py",
 )
 
 # (relpath, classname) -> attrs intentionally shared without the lock.

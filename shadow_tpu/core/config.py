@@ -264,6 +264,12 @@ class ExperimentalOptions:
     # state); false restores the strictly-serial loop — the bench
     # comparison arm (bench.py --pipeline-smoke).
     pipelined_dispatch: bool = True
+    # Multi-worker host plane (core/hostplane.py): shard the host-side
+    # handoff drain per owning host across N pinned workers with a
+    # deterministic (virtual-time, host-gid) merge — bit-identical to the
+    # serial drain by construction. 1 (the default) keeps today's serial
+    # inline drain and emits no hostplane.* metrics keys.
+    host_workers: int = 1
     # CPU↔TPU seam: route managed-process UDP through the device-stepped
     # network (procs/bridge.py). The BASELINE north-star path.
     use_device_network: bool = False
@@ -321,6 +327,10 @@ class ExperimentalOptions:
                 setattr(out, name, int(d[name]))
         if out.pool_gears < 1:
             raise ConfigError("experimental.pool_gears must be >= 1")
+        if d.get("host_workers") is not None:
+            out.host_workers = int(d["host_workers"])
+            if out.host_workers < 1:
+                raise ConfigError("experimental.host_workers must be >= 1")
         if d.get("flight_recorder") is not None:
             v = d["flight_recorder"]
             if isinstance(v, dict):

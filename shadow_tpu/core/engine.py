@@ -46,6 +46,7 @@ import numpy as np
 from flax import struct
 
 from shadow_tpu.core import gearbox
+from shadow_tpu.core import hostplane as hostplane_mod
 from shadow_tpu.core import pipeline as pipeline_mod
 from shadow_tpu.core import pressure as pressure_mod
 from shadow_tpu.core.supervisor import PendingDispatch
@@ -1554,6 +1555,7 @@ class Simulation:
         audit_digest: bool = True,
         flight_capacity: int = 0,
         pipelined_dispatch: bool = True,
+        host_workers: int = 1,
     ):
         # initial_events: (time, dst, src, kind, payload words)
         self.num_hosts = num_hosts
@@ -1691,7 +1693,22 @@ class Simulation:
         # driver's host-drain phase (after the fault/checkpoint tick) —
         # the seam for host-side per-handoff work the pipeline overlaps
         # (the managed-plane syscall-drain analog; bench models it here).
+        # Entries are (fn, sharded): sharded hooks take (sim, frontier_ns,
+        # gid) per owning host and drain through the multi-worker host
+        # plane below.
         self._handoff_hooks: list = []
+        # PARSIR-style multi-worker host plane (core/hostplane.py): with
+        # experimental.host_workers > 1 the per-host handoff actions
+        # (sharded hooks, flight-spool extraction) fan out to pinned
+        # workers and merge in canonical (virtual-time, host-gid) order —
+        # bit-exact vs the serial drain by construction, and the drain
+        # runs inside the pipeline's issue->await overlap window. 1 (the
+        # default) keeps today's strictly-serial inline drain: no
+        # threads, and no hostplane.* stats keys.
+        self.host_workers = max(1, int(host_workers))
+        self._hostplane_obj = None
+        self._hostplane_stats: dict | None = None
+        self._hostplane_slot_cache: tuple | None = None
         # Elastic mesh resilience (parallel/elastic.py): the runner's
         # dispatch-boundary hook — probes lost chips and signals the
         # relayout-back-up. None = one attribute check per dispatch.
@@ -2490,19 +2507,93 @@ class Simulation:
         st = self._pipeline_stats
         return dict(st) if st is not None else {}
 
-    def add_handoff_hook(self, fn) -> None:
-        """Register fn(sim, frontier_ns), called inside every driver's
-        host-drain phase (after the fault/checkpoint tick). The hook for
-        host-side per-handoff work — the managed-plane syscall-drain
-        analog — which the pipelined loop overlaps with the in-flight
-        dispatch. Hooks must not assume the next dispatch has not been
-        issued; state mutations they make are detected and discard any
-        in-flight speculation (the recompute rule)."""
-        self._handoff_hooks.append(fn)
+    def add_handoff_hook(self, fn, sharded: bool = False) -> None:
+        """Register per-handoff host work, called inside every driver's
+        host-drain phase (after the fault/checkpoint tick) — the
+        managed-plane syscall-drain analog — which the pipelined loop
+        overlaps with the in-flight dispatch. Hooks must not assume the
+        next dispatch has not been issued; state mutations they make are
+        detected and discard any in-flight speculation (the recompute
+        rule).
+
+        sharded=False: fn(sim, frontier_ns), one whole-sim call, always
+        on the coordinator. sharded=True: fn(sim, frontier_ns, gid), one
+        call per live host, partitioned by owning host across the
+        multi-worker host plane (core/hostplane.py) — the call must only
+        touch that host's partition-local state. With host_workers == 1
+        sharded hooks run inline in the same canonical (frontier, gid)
+        order the parallel merge uses, so both paths are bit-exact."""
+        self._handoff_hooks.append((fn, bool(sharded)))
+
+    # -- PARSIR-style multi-worker host plane (core/hostplane.py) --
+
+    def _hostplane(self):
+        """The drain-worker pool, or None on the serial path (host_workers
+        == 1). Stats are created lazily so serial runs emit no
+        hostplane.* keys."""
+        if self.host_workers <= 1:
+            return None
+        if self._hostplane_obj is None:
+            if self._hostplane_stats is None:
+                self._hostplane_stats = hostplane_mod.new_stats(
+                    self.host_workers
+                )
+            self._hostplane_obj = hostplane_mod.HostPlane(
+                self.host_workers, self._hostplane_stats
+            )
+        return self._hostplane_obj
+
+    def hostplane_stats(self) -> dict:
+        """Host-plane telemetry for the metrics `hostplane.*` namespace
+        (schema v15); {} until a multi-worker drain ran (host_workers ==
+        1 emits no hostplane keys)."""
+        st = self._hostplane_stats
+        return dict(st) if st is not None else {}
+
+    def _hostplane_slot_map(self):
+        """The placement seam's host->slot table for worker pinning, read
+        once per layout epoch (islands bump `rebalances` on every
+        migration/relayout, which invalidates the cache — so a moved host
+        re-pins deterministically). None = identity pinning."""
+        slot = getattr(self.params, "slot_of", None)
+        if slot is None:
+            return None
+        epoch = int(getattr(self, "rebalances", 0))
+        cached = self._hostplane_slot_cache
+        if cached is not None and cached[0] == epoch:
+            return cached[1]
+        m = np.asarray(jax.device_get(slot)).reshape(-1)
+        self._hostplane_slot_cache = (epoch, m)
+        return m
 
     def _run_handoff_hooks(self, mn: int) -> None:
-        for fn in self._handoff_hooks:
-            fn(self, mn)
+        if not self._handoff_hooks:
+            return
+        sharded = [fn for fn, sh in self._handoff_hooks if sh]
+        if sharded:
+            hp = self._hostplane()
+            if hp is None:
+                # serial path: inline, in the same canonical (frontier,
+                # gid, registration) order the parallel merge produces
+                for gid in range(self.num_hosts):
+                    for fn in sharded:
+                        fn(self, mn, gid)
+            else:
+                hp.set_slot_map(self._hostplane_slot_map())
+                obs = self.obs_session
+                hp.drain(
+                    [
+                        hostplane_mod.HostAction(
+                            mn, gid, (lambda f=fn, g=gid: f(self, mn, g))
+                        )
+                        for gid in range(self.num_hosts)
+                        for fn in sharded
+                    ],
+                    tracer=obs.tracer if obs is not None else None,
+                )
+        for fn, sh in self._handoff_hooks:
+            if not sh:
+                fn(self, mn)
 
     def _handoff_quiet(self, mn: int) -> bool:
         """True when the upcoming handoff tick at committed frontier
@@ -3095,7 +3186,11 @@ class Simulation:
             if snap:
                 self.audit.record(snap, frontier)
         if self.flight_spool is not None:
-            self.flight_spool.flush(self, frontier)
+            # per-host ring extraction shards across the host plane's
+            # pinned workers (core/hostplane.py); bytes identical either
+            # way (canonical-order merge + the sort below)
+            self.flight_spool.flush(self, frontier,
+                                    plane=self._hostplane())
 
     def save_checkpoint(self, path: str) -> None:
         """Snapshot the full device state to disk (resume is bit-exact)."""

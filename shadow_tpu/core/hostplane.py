@@ -1,0 +1,261 @@
+"""PARSIR-style multi-worker host plane: sharded handoff drain with a
+deterministic merge.
+
+PR 15's pipeline hides the host drain behind in-flight device work, but
+one host thread still serializes every per-handoff action — managed-plane
+ticks, spill/fault/audit bookkeeping, flight-spool extraction, modeled
+drain work — so on handoff-heavy runs the drain itself is the critical
+path inside the overlap window. PARSIR (PAPERS.md) shows the right shape
+for multi-processor DES host work: bind each simulated host to ONE worker
+(per-worker host binding), run the partitions concurrently, and merge at
+the barrier in a canonical order so the parallel schedule is
+observationally identical to the serial one.
+
+The unit of work is a :class:`HostAction`: ``(vt, gid, work, merge)``.
+
+- ``work()`` runs on the worker the owning host ``gid`` is pinned to.
+  It may touch ONLY partition-local state (that host's rows, its own
+  accumulators) — never ``sim.state`` or another host's partition.
+- ``merge(result)`` runs on the coordinator thread, strictly in
+  canonical ``(vt, gid, seq)`` order (``seq`` = submission order, the
+  tiebreak), AFTER every worker finished its batch. All cross-partition
+  effects — appending to a shared spool, folding a digest, mutating
+  driver state — belong here, so committed order, audit chains and
+  checkpoint bytes are identical to the serial drain by construction.
+
+Pinning is stable and placement-derived: ``worker = slot_of[gid] %
+workers`` when the caller installs the rebalance seam's slot table
+(:meth:`HostPlane.set_slot_map`), else ``gid % workers``. A live
+migration that moves a host's slot re-pins it deterministically (same
+slot table -> same pin on every run) and is counted in ``pin_moves``.
+
+A worker exception never kills the drain: the failed actions re-run
+serially on the coordinator in canonical order (``work`` must therefore
+tolerate a re-run after a mid-action exception — keep it idempotent),
+counted in ``serial_fallbacks``.
+
+``workers == 1`` is not this module's concern: callers keep today's
+inline serial drain (no threads, no stats keys — the bit-exact default
+path). A plane is only constructed for ``workers > 1``.
+
+Thread discipline (policed by shadowlint STH001-004, analysis/
+threads.py — this module is in THREAD_MODULES): every shared attribute
+(`_queues`, `_results`, `_batch_times`, `_pending`, `_stop`, `_pins`,
+`_slot_map`, `stats`) is touched only under ``self._lock``; both
+condition variables share that lock; waits are bounded.
+"""
+
+from __future__ import annotations
+
+import threading
+import time as wall_time
+from typing import Any, Callable
+
+# Chrome-trace tid block for drain workers: far above the fleet lane
+# tids (lane j rides tid j+1) so the rows never collide.
+WORKER_TID_BASE = 100
+
+
+def new_stats(workers: int) -> dict:
+    """The `hostplane.*` stats dict (metrics schema v15). Created lazily
+    by the owning engine the first time a multi-worker plane is built, so
+    workers=1 runs emit no hostplane keys at all."""
+    st = {
+        "workers": int(workers),     # configured pool width (posture)
+        "sharded_drains": 0,         # drains that fanned out to workers
+        "merge_ns": 0,               # coordinator time in canonical merge
+        "serial_fallbacks": 0,       # actions re-run serially after a
+                                     # worker exception
+        "pin_moves": 0,              # host->worker re-pins (migrations)
+    }
+    for w in range(int(workers)):
+        st[f"drain_ns_w{w}"] = 0     # per-worker wall in work() batches
+    return st
+
+
+class HostAction:
+    """One drainable handoff action owned by host ``gid`` at virtual
+    time ``vt``. ``work`` runs on the pinned worker (partition-local
+    effects only); ``merge`` (optional) runs on the coordinator in
+    canonical (vt, gid, seq) order with ``work``'s return value."""
+
+    __slots__ = ("vt", "gid", "seq", "work", "merge")
+
+    def __init__(self, vt: int, gid: int, work: Callable[[], Any],
+                 merge: Callable[[Any], None] | None = None):
+        self.vt = int(vt)
+        self.gid = int(gid)
+        self.seq = 0  # assigned at submission (the canonical tiebreak)
+        self.work = work
+        self.merge = merge
+
+
+class HostPlane:
+    """A pool of pinned drain workers with a deterministic merge barrier.
+
+    One instance per engine, persistent across handoffs (threads start
+    lazily on the first sharded drain and idle between boundaries).
+    ``drain`` is coordinator-only: one thread submits, waits the barrier,
+    and merges; the plane never overlaps two drains."""
+
+    def __init__(self, workers: int, stats: dict):
+        self.workers = max(1, int(workers))
+        self._lock = threading.Lock()
+        self._wake = threading.Condition(self._lock)  # workers: batch ready
+        self._done = threading.Condition(self._lock)  # coordinator: barrier
+        # guarded state (see module docstring for the discipline)
+        self._queues: list[list[HostAction]] = [
+            [] for _ in range(self.workers)
+        ]
+        self._results: list[tuple[HostAction, Any, BaseException | None]] = []
+        self._batch_times: list[tuple[int, float, float]] = []
+        self._pending = 0
+        self._stop = False
+        self._pins: dict[int, int] = {}
+        self._slot_map = None
+        with self._lock:
+            self.stats = stats
+        # coordinator-only (never touched under the lock by design)
+        self._threads: list[threading.Thread] = []
+
+    # -- pinning (PARSIR per-worker host binding) --
+
+    def set_slot_map(self, slot_map) -> None:
+        """Install the placement seam's host->slot table (None = identity).
+        Pins derive from it, so a migration that changes a host's slot
+        re-pins that host deterministically on the next drain."""
+        with self._lock:
+            self._slot_map = slot_map
+
+    def _pin(self, gid: int) -> int:
+        # caller holds self._lock
+        sm = self._slot_map
+        slot = gid
+        if sm is not None and 0 <= gid < len(sm):
+            slot = int(sm[gid])
+        w = slot % self.workers
+        old = self._pins.get(gid)
+        if old is not None and old != w:
+            self.stats["pin_moves"] += 1
+        self._pins[gid] = w
+        return w
+
+    # -- worker pool --
+
+    def _ensure_threads(self) -> None:
+        if self._threads:
+            return
+        for wid in range(self.workers):
+            th = threading.Thread(
+                target=self._worker, args=(wid,),
+                name=f"hostplane-w{wid}", daemon=True,
+            )
+            self._threads.append(th)
+            th.start()
+
+    def _worker(self, wid: int) -> None:
+        while True:
+            with self._lock:
+                while not self._queues[wid] and not self._stop:
+                    self._wake.wait(timeout=0.25)
+                if self._stop and not self._queues[wid]:
+                    return
+                batch = self._queues[wid]
+                self._queues[wid] = []
+            # execute outside the lock: work() is partition-local by
+            # contract, so batches from different workers never touch
+            # the same state
+            t0 = wall_time.perf_counter()
+            out = []
+            for a in batch:
+                try:
+                    out.append((a, a.work(), None))
+                except BaseException as e:  # re-run serially at the merge
+                    out.append((a, None, e))
+            t1 = wall_time.perf_counter()
+            with self._lock:
+                self._results.extend(out)
+                self._batch_times.append((wid, t0, t1))
+                self.stats[f"drain_ns_w{wid}"] += int((t1 - t0) * 1e9)
+                self._pending -= len(batch)
+                if self._pending <= 0:
+                    self._done.notify_all()
+
+    def close(self) -> None:
+        """Stop the pool (threads are daemons; close is for tests and
+        symmetric shutdown, not correctness)."""
+        with self._lock:
+            self._stop = True
+            self._wake.notify_all()
+        for th in self._threads:
+            th.join(timeout=2.0)
+        self._threads = []
+
+    # -- the drain barrier --
+
+    def drain(self, actions, tracer=None) -> int:
+        """Shard `actions` to pinned workers, wait the barrier, merge in
+        canonical (vt, gid, seq) order. Returns the action count.
+
+        When a tracer (obs/trace.ChromeTracer) is attached, each worker
+        batch is emitted as a `host_drain` span on its own tid
+        (WORKER_TID_BASE + wid) so tools/trace_summary.py can report
+        drain parallelism."""
+        acts = list(actions)
+        if not acts:
+            return 0
+        for i, a in enumerate(acts):
+            a.seq = i
+        order = sorted(acts, key=lambda a: (a.vt, a.gid, a.seq))
+        self._ensure_threads()
+        with self._lock:
+            # enqueue in canonical order so each partition also executes
+            # its own actions in canonical order
+            for a in order:
+                self._queues[self._pin(a.gid)].append(a)
+            self._pending += len(order)
+            self._batch_times = []
+            self._wake.notify_all()
+            while self._pending > 0:
+                self._done.wait(timeout=0.25)
+            results = self._results
+            self._results = []
+            batch_times = self._batch_times
+            self._batch_times = []
+            self.stats["sharded_drains"] += 1
+        t0 = wall_time.perf_counter()
+        got: dict[int, tuple[Any, BaseException | None]] = {
+            id(a): (r, e) for a, r, e in results
+        }
+        fallbacks = 0
+        for a in order:
+            r, e = got[id(a)]
+            if e is not None:
+                # a worker raised: re-run serially on the coordinator, IN
+                # PLACE in the canonical walk so the merge order is still
+                # exactly the serial drain's — the plane degrades, it
+                # never drops work or reorders it
+                fallbacks += 1
+                r = a.work()
+            if a.merge is not None:
+                a.merge(r)
+        merge_ns = int((wall_time.perf_counter() - t0) * 1e9)
+        with self._lock:
+            self.stats["merge_ns"] += merge_ns
+            self.stats["serial_fallbacks"] += fallbacks
+        if tracer is not None:
+            # map the workers' perf_counter stamps onto the tracer's
+            # relative-µs clock through one coordinator-side anchor
+            base_us = tracer.now_us()
+            base_pc = wall_time.perf_counter()
+            for wid, b0, b1 in batch_times:
+                tracer.thread_name(
+                    WORKER_TID_BASE + wid, f"hostplane w{wid}"
+                )
+                tracer.complete(
+                    "host_drain",
+                    base_us - (base_pc - b0) * 1e6,
+                    (b1 - b0) * 1e6,
+                    tid=WORKER_TID_BASE + wid, worker=wid,
+                )
+        return len(order)

@@ -34,6 +34,7 @@ def build_phold_flagship(
     audit_digest: bool = True,
     flight_recorder: int = 0,
     pipelined_dispatch: bool = True,
+    host_workers: int = 1,
 ):
     from shadow_tpu.sim import build_simulation
 
@@ -92,6 +93,7 @@ def build_phold_flagship(
                 "audit_digest": audit_digest,
                 "flight_recorder": flight_recorder,
                 "pipelined_dispatch": pipelined_dispatch,
+                "host_workers": host_workers,
             },
             "hosts": {
                 "peer": {

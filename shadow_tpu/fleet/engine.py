@@ -42,6 +42,7 @@ import numpy as np
 
 from shadow_tpu.core import gearbox, simtime
 from shadow_tpu.core import engine as engine_mod
+from shadow_tpu.core import hostplane as hostplane_mod
 from shadow_tpu.core import pipeline as pipeline_mod
 from shadow_tpu.core import pressure as pressure_mod
 from shadow_tpu.core import state as state_mod
@@ -184,6 +185,15 @@ class FleetSimulation:
         )
         self._pipeline_stats: dict | None = None
         self._handoff_hooks: list = []
+        # Multi-worker host plane (core/hostplane.py): the fleet adopts
+        # the template job's experimental.host_workers knob; sharded
+        # handoff hooks partition PER LANE (the lane is the fleet's
+        # owning-host unit) across pinned drain workers and merge in
+        # canonical (frontier, lane) order. 1 = serial inline hooks and
+        # no hostplane.* keys.
+        self.host_workers = max(1, int(getattr(t, "host_workers", 1)))
+        self._hostplane_obj = None
+        self._hostplane_stats: dict | None = None
         if self._islands and t.mode != "vmap":
             raise FleetError(
                 "fleet islands jobs run in island_mode: vmap (virtual "
@@ -613,10 +623,65 @@ class FleetSimulation:
         st = self._pipeline_stats
         return dict(st) if st is not None else {}
 
-    def add_handoff_hook(self, fn) -> None:
-        """Register fn(fleet, frontier_ns) — called in the host-drain
-        phase of every fleet dispatch boundary (after scheduler work)."""
-        self._handoff_hooks.append(fn)
+    def add_handoff_hook(self, fn, sharded: bool = False) -> None:
+        """Register per-boundary host work, called in the host-drain
+        phase of every fleet dispatch boundary (after scheduler work).
+        sharded=False: fn(fleet, frontier_ns), one whole-fleet call on
+        the coordinator. sharded=True: fn(fleet, frontier_ns, lane), one
+        call per lane, partitioned by lane across the multi-worker host
+        plane (core/hostplane.py) — partition-local state only. With
+        host_workers == 1 sharded hooks run inline in the same canonical
+        (frontier, lane) order the parallel merge uses."""
+        self._handoff_hooks.append((fn, bool(sharded)))
+
+    # -- multi-worker host plane (core/hostplane.py) --
+
+    def _hostplane(self):
+        if self.host_workers <= 1:
+            return None
+        if self._hostplane_obj is None:
+            if self._hostplane_stats is None:
+                self._hostplane_stats = hostplane_mod.new_stats(
+                    self.host_workers
+                )
+            self._hostplane_obj = hostplane_mod.HostPlane(
+                self.host_workers, self._hostplane_stats
+            )
+        return self._hostplane_obj
+
+    def hostplane_stats(self) -> dict:
+        """`hostplane.*` telemetry (schema v15); {} until a multi-worker
+        fleet drain ran (host_workers == 1 emits no hostplane keys)."""
+        st = self._hostplane_stats
+        return dict(st) if st is not None else {}
+
+    def _run_handoff_hooks(self, mn) -> None:
+        if not self._handoff_hooks:
+            return
+        frontier = int(np.min(mn)) if np.ndim(mn) else int(mn)
+        sharded = [fn for fn, sh in self._handoff_hooks if sh]
+        if sharded:
+            hp = self._hostplane()
+            if hp is None:
+                for lane in range(self.lanes):
+                    for fn in sharded:
+                        fn(self, frontier, lane)
+            else:
+                obs = self.obs_session
+                hp.drain(
+                    [
+                        hostplane_mod.HostAction(
+                            frontier, lane,
+                            (lambda f=fn, j=lane: f(self, frontier, j)),
+                        )
+                        for lane in range(self.lanes)
+                        for fn in sharded
+                    ],
+                    tracer=obs.tracer if obs is not None else None,
+                )
+        for fn, sh in self._handoff_hooks:
+            if not sh:
+                fn(self, frontier)
 
     def _handoff_quiet(self, mn: np.ndarray) -> bool:
         """True when the upcoming fleet handoff cannot take a scheduler
@@ -1432,8 +1497,11 @@ class FleetSimulation:
                         if new is not None:
                             self._shift_gear(new)
                             changed = True
-                    for fn in self._handoff_hooks:
-                        fn(self, mn)
+                    # handoff hooks: sharded ones fan out per lane
+                    # across the host plane's pinned drain workers,
+                    # inside this host_drain span — i.e. inside the
+                    # pipeline's issue->await overlap window
+                    self._run_handoff_hooks(mn)
                 if pipe is not None:
                     if changed or self._sv_disrupted():
                         pipe.discard()

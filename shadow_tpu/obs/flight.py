@@ -115,10 +115,17 @@ class FlightSpool:
             SPOOL_MAGIC, SPOOL_VERSION, self.num_hosts, self.capacity
         ))
 
-    def flush(self, sim, frontier_ns: int) -> int:
+    def flush(self, sim, frontier_ns: int, plane=None) -> int:
         """One device_get of the ring; emits only records not yet
         spooled (per-host count delta), in (time, host, seq) order.
-        Returns the number of records written."""
+        Returns the number of records written.
+
+        With a multi-worker host plane attached (core/hostplane.py) the
+        per-host record extraction is sharded across its pinned workers —
+        each ring row is one host's partition — and the results merge in
+        canonical (frontier, gid) order; the serial path walks rows in
+        the same gid order, so the spool bytes are identical either
+        way."""
         fl = getattr(sim.state, "flight", None)
         if fl is None or self._f is None:
             return 0
@@ -134,19 +141,44 @@ class FlightSpool:
         ).reshape(-1)
         recs = []
         lost = 0
-        for row in range(t.shape[0]):
+
+        def _extract(row):
+            # partition-local: reads only host gid[row]'s ring row and
+            # its own _last entry (mutated at the merge, not here)
             g = int(gid[row])
             n = int(cnt[row])
             prev = int(self._last[g])
             start = max(prev, n - R)
-            lost += start - prev
+            out = []
             for i in range(start, n):
                 sl = i % R
-                recs.append((
+                out.append((
                     g, int(t[row, sl]), int(s[row, sl]),
                     int(q[row, sl]), int(k[row, sl]),
                 ))
+            return g, n, start - prev, out
+
+        def _merge(res):
+            nonlocal lost
+            g, n, row_lost, out = res
+            lost += row_lost
+            recs.extend(out)
             self._last[g] = n
+
+        order = sorted(range(t.shape[0]), key=lambda r: int(gid[r]))
+        if plane is not None:
+            from shadow_tpu.core import hostplane as hostplane_mod
+
+            plane.drain([
+                hostplane_mod.HostAction(
+                    frontier_ns, int(gid[row]),
+                    (lambda r=row: _extract(r)), _merge,
+                )
+                for row in order
+            ])
+        else:
+            for row in order:
+                _merge(_extract(row))
         if not recs and not lost:
             return 0
         recs.sort(key=lambda r: (r[1], r[0], r[3]))

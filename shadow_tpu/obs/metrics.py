@@ -25,6 +25,10 @@ import numpy as np
 
 from shadow_tpu.obs import counters as obs_counters
 
+# v15: hostplane.* multi-worker host plane (core/hostplane.py): worker
+# pool width, sharded-drain count, canonical-merge wall, per-worker
+# drain wall (drain_ns_w<i>), serial-fallback re-runs after a worker
+# exception, and placement-derived host->worker re-pins;
 # v14: pipeline.* pipelined-handoff namespace (core/pipeline.py + the
 # driver loops: issued-ahead dispatch count, overlap_ns of host-drain
 # time hidden behind in-flight device work, forced_drains at
@@ -62,7 +66,7 @@ from shadow_tpu.obs import counters as obs_counters
 # obs/audit.py) + optional per-job `audit` sub-object on fleet.jobs[*]
 # rows; v4: optional top-level `fleet` section (fleet.jobs[*] per-job
 # rows) + fleet.* counters; v3: faults.* recovery counters
-SCHEMA_VERSION = 14
+SCHEMA_VERSION = 15
 DOC_KIND = "shadow_tpu.metrics"
 
 # metrics-doc `fleet.jobs[*]` rows must carry at least these keys
@@ -98,6 +102,7 @@ KNOWN_METRIC_NAMESPACES = frozenset({
     "mesh",        # multi-chip mesh execution plane (schema v11;
                    # elastic-resilience rows added in v12)
     "pipeline",    # pipelined CPU↔TPU handoff (schema v14)
+    "hostplane",   # multi-worker host-plane drain (schema v15)
     "sim",         # build-level gauges (num_hosts, runahead)
 })
 
@@ -253,6 +258,11 @@ def validate_metrics_doc(doc: dict, strict_namespaces: bool = False) -> None:
             raise ValueError(
                 f"pipeline counter {k!r} must be >= 0, got {v}"
             )
+        if k.startswith("hostplane.") and v < 0:
+            # schema v15: host-plane drain counters are monotonic tallies
+            raise ValueError(
+                f"hostplane counter {k!r} must be >= 0, got {v}"
+            )
     for k, v in doc["gauges"].items():
         if not isinstance(v, (int, float)) or isinstance(v, bool):
             raise ValueError(f"gauge {k!r} must be a number, got {v!r}")
@@ -388,6 +398,20 @@ def snapshot_device(sim, reg: MetricsRegistry) -> None:
     _snapshot_balance(sim, reg)
     _snapshot_mesh(sim, reg)
     _snapshot_pipeline(sim, reg)
+    _snapshot_hostplane(sim, reg)
+
+
+def _snapshot_hostplane(sim, reg: MetricsRegistry) -> None:
+    """Multi-worker host-plane plane (schema v15): pool width, sharded
+    drains, canonical-merge wall, per-worker drain wall, serial
+    fallbacks and re-pins from the drain pool (core/hostplane.py).
+    Serial runs (experimental.host_workers: 1) report {} and emit no
+    hostplane keys."""
+    hs = getattr(sim, "hostplane_stats", None)
+    if hs is None:
+        return
+    for k, v in hs().items():
+        reg.counter_set(f"hostplane.{k}", int(v))
 
 
 def _snapshot_pipeline(sim, reg: MetricsRegistry) -> None:
@@ -520,6 +544,7 @@ def snapshot_fleet(fleet, reg: MetricsRegistry) -> None:
     _snapshot_balance(fleet, reg)
     _snapshot_mesh(fleet, reg)
     _snapshot_pipeline(fleet, reg)
+    _snapshot_hostplane(fleet, reg)
     reg.section_set("fleet", {
         "lanes": int(stats.get("lanes", 0)),
         "lane_swaps": int(stats.get("lane_swaps", 0)),

@@ -47,6 +47,12 @@ class ChromeTracer:
     def _now_us(self) -> float:
         return (time.perf_counter() - self._t0) * 1e6
 
+    def now_us(self) -> float:
+        """The tracer's relative-µs clock — for callers (the host plane's
+        drain workers) that time work off-thread with perf_counter and
+        emit it later through `complete`."""
+        return self._now_us()
+
     def thread_name(self, tid: int, name: str, pid: int = 0) -> None:
         """Name a thread row once via an "M" metadata event (the fleet
         names tid 0 "driver" and each lane "lane <j>")."""

@@ -1829,6 +1829,10 @@ class IslandSimulation(Simulation):
             min_next = mn
             if self._audit_active():
                 self._audit_tick(mn)
+            # host-drain contract parity with the fused driver: handoff
+            # hooks (sharded ones drain through the multi-worker host
+            # plane, core/hostplane.py) run at every stepwise boundary
+            self._run_handoff_hooks(mn)
             windows += 1
             self.windows_run += 1
         return windows
@@ -2077,6 +2081,11 @@ class IslandSimulation(Simulation):
             if self._fault_plane_active():
                 self._handoff_tick(min_next)
                 min_next = int(jax.device_get(jnp.min(self.state.pool.time)))
+            # host-drain contract parity with the conservative driver:
+            # handoff hooks (sharded ones fan out across the host plane's
+            # pinned workers with the canonical (vt, gid) merge) run at
+            # every optimistic commit boundary
+            self._run_handoff_hooks(min_next)
             if adaptive:
                 factor, streak = self.adapt_window_factor(
                     factor, streak, rollbacks > rb0, window_factor

@@ -71,6 +71,7 @@ def build_process_driver(
     driver = ProcessDriver(
         stop_time=cfg.general.stop_time,
         seed=cfg.general.seed,
+        host_workers=cfg.experimental.host_workers,
     )
     driver.dns = dns
     driver.bootstrap_end = cfg.general.bootstrap_end_time

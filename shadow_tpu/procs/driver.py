@@ -698,6 +698,7 @@ class ProcessDriver:
         seed: int = 1,
         spin: int = 4096,
         service_timeout_s: float = 10.0,
+        host_workers: int = 1,
     ):
         self.stop_time = int(stop_time)
         self.latency_ns = int(latency_ns)
@@ -772,11 +773,28 @@ class ProcessDriver:
         # queues, logical_processor.rs:17-68): the service loop visits only
         # processes with RUNNING/READY threads instead of scanning all N
         # procs per quiescence round — the O(N)-scan retirement that makes
-        # 4k+ processes serviceable. Keyed by registration index so the
-        # service order stays deterministic (lowest index first).
-        self._runq_heap: list[int] = []
+        # 4k+ processes serviceable. Ordered by an EXPLICIT canonical key
+        # (virtual time at mark, owning host gid, mark seq) — the same
+        # (vt, gid, seq) key the multi-worker host plane merges by
+        # (core/hostplane.py) — instead of registration order, which made
+        # the service order depend on process creation history (a latent
+        # nondeterminism hazard when runtime forks interleave with
+        # static registration). `_runq_set` stays keyed by reg_idx for
+        # idempotent marking.
+        self._runq_heap: list[tuple[int, int, int, int]] = []
         self._runq_set: dict[int, ManagedProcess] = {}
+        self._runq_seq = 0
         self._next_reg_idx = 0
+        # Multi-worker host plane (core/hostplane.py): with host_workers
+        # > 1 the service loop's IPC waits shard per owning host across
+        # pinned workers — each worker blocks on its partition's shm
+        # semaphores concurrently (the sem waits release the GIL), then
+        # syscall EXECUTION stays on the coordinator in the canonical
+        # runq order above, so two runs service identically.
+        self.host_workers = max(1, int(host_workers))
+        self._hostplane_obj = None
+        self._hostplane_stats: dict | None = None
+        self._prewaited: set[tuple[int, int]] = set()
         # fd-waiter registry: id(watched object) -> (obj, [(thread, Parked)])
         # — replaces the O(procs × fds) scan per wake (_wake_fd_waiters).
         # Entries are registered at park time and lazily pruned.
@@ -846,12 +864,83 @@ class ProcessDriver:
         self.procs.append(p)
 
     def _mark_runnable(self, p) -> None:
-        """Queue p's process for the service loop (idempotent)."""
+        """Queue p's process for the service loop (idempotent), keyed by
+        the canonical (virtual time at mark, owning host gid, mark seq)
+        order — explicit, not insertion order (the host plane's merge
+        key, core/hostplane.py)."""
         proc = p.proc if isinstance(p, ManagedThread) else p
         idx = proc.reg_idx
         if idx not in self._runq_set:
             self._runq_set[idx] = proc
-            heapq.heappush(self._runq_heap, idx)
+            self._runq_seq += 1
+            gid = proc.host.index if proc.host is not None else 0
+            heapq.heappush(
+                self._runq_heap, (self.now, gid, self._runq_seq, idx)
+            )
+
+    def _hostplane(self):
+        """The managed plane's drain-worker pool (core/hostplane.py), or
+        None on the serial path (host_workers == 1)."""
+        if self.host_workers <= 1:
+            return None
+        if self._hostplane_obj is None:
+            from shadow_tpu.core import hostplane as hostplane_mod
+
+            if self._hostplane_stats is None:
+                self._hostplane_stats = hostplane_mod.new_stats(
+                    self.host_workers
+                )
+            self._hostplane_obj = hostplane_mod.HostPlane(
+                self.host_workers, self._hostplane_stats
+            )
+        return self._hostplane_obj
+
+    def hostplane_stats(self) -> dict:
+        """`hostplane.*` telemetry (metrics schema v15); {} until a
+        sharded pre-wait ran (host_workers == 1 emits no keys)."""
+        st = self._hostplane_stats
+        return dict(st) if st is not None else {}
+
+    def _prewait_runnable(self) -> None:
+        """Fan the runnable processes' next IPC waits out per owning
+        host across the host plane's pinned workers. Each worker blocks
+        on its partition's request semaphores (libpthread sem waits
+        release the GIL, so the waits genuinely overlap); a consumed
+        semaphore is recorded in `_prewaited` — the buffered request is
+        then read WITHOUT waiting when the coordinator services that
+        thread, in unchanged canonical order."""
+        if self.host_workers <= 1 or len(self._runq_set) < 2:
+            return
+        from shadow_tpu.core import hostplane as hostplane_mod
+
+        targets = []
+        for idx, p in self._runq_set.items():
+            if p.host is not None and p.host.dead:
+                continue
+            for t in p.threads:
+                if t.state == ManagedThread.RUNNING and t.channel:
+                    key = (idx, t.tid)
+                    if key not in self._prewaited:
+                        targets.append(
+                            (p.host.index if p.host is not None else 0,
+                             key, t)
+                        )
+                    break
+        if len(targets) < 2:
+            return
+
+        def _note(ok, key):
+            if ok:
+                self._prewaited.add(key)
+
+        self._hostplane().drain([
+            hostplane_mod.HostAction(
+                self.now, gid,
+                (lambda ch=t.channel: ch.wait_request(timeout_s=0.02)),
+                (lambda ok, k=key: _note(ok, k)),
+            )
+            for gid, key, t in targets
+        ])
 
     def set_latency_fn(self, fn: Callable[[int, int], int]) -> None:
         """fn(src_ip, dst_ip) -> one-way latency ns (topology hook)."""
@@ -2979,7 +3068,14 @@ class ProcessDriver:
         which the service loop resolves via the on_proc_failure policy."""
         deadline = wall_time.monotonic() + self.service_timeout_s
         attempt = 0
+        prekey = (proc.proc.reg_idx, proc.tid)
         while True:
+            if prekey in self._prewaited:
+                # the sharded pre-wait already consumed this thread's
+                # request semaphore — the message is buffered in the
+                # channel, so read it without waiting again
+                self._prewaited.discard(prekey)
+                break
             if proc.channel.wait_request(timeout_s=0.05):
                 break
             if proc.popen is not None and proc.popen.poll() is not None:
@@ -3256,7 +3352,12 @@ class ProcessDriver:
             # re-queue their process via _mark_runnable.
             t_svc = wall_time.perf_counter()
             while self._runq_heap:
-                idx = heapq.heappop(self._runq_heap)
+                # sharded IPC pre-wait (core/hostplane.py): while the
+                # coordinator services the canonical-order front, pinned
+                # workers consume the OTHER runnable hosts' shm request
+                # semaphores concurrently — execution order is untouched
+                self._prewait_runnable()
+                _, _, _, idx = heapq.heappop(self._runq_heap)
                 p = self._runq_set.pop(idx, None)
                 if p is None:
                     continue

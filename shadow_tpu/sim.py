@@ -278,6 +278,7 @@ def build_simulation(source) -> Simulation:
         audit_digest=cfg.experimental.audit_digest,
         flight_capacity=cfg.experimental.flight_recorder,
         pipelined_dispatch=cfg.experimental.pipelined_dispatch,
+        host_workers=cfg.experimental.host_workers,
     )
     # attach build artifacts for inspection/observability
     sim.config = cfg

@@ -55,6 +55,9 @@ _BUDGET_TIER = {
     # the pipelined-handoff chain-equality matrix (ISSUE 15): same rule —
     # ahead of the compile-heavy tier-4 matrices
     "test_pipeline": 3,
+    # the multi-worker host-plane chain-equality matrix (ISSUE 17):
+    # same rule — ahead of the compile-heavy tier-4 matrices
+    "test_hostplane": 3,
     # the multi-chip mesh acceptance gate (ISSUE 12): same rule — its
     # shard_map cells compile more than the vmap tiers but the chain
     # matrix + relayout resume must land before the tier-4 tail

@@ -770,6 +770,59 @@ def test_thread_lint_noqa_suppresses():
     assert threads.lint_threads_source(src, "serve/d.py") == []
 
 
+# the host plane's discipline in miniature (core/hostplane.py): one lock,
+# per-worker partition queues + merge buffer guarded by it, a Condition
+# on the same lock for the wake path
+_TH_HOSTPLANE_CLEAN = _TH_PREAMBLE + """\
+class Plane:
+    def __init__(self, workers):
+        self._lock = threading.Lock()
+        self._wake = threading.Condition(self._lock)
+        self._queues = [[] for _ in range(workers)]
+        self._results = []
+        self._pending = 0
+
+    def submit(self, wid, action):
+        with self._lock:
+            self._queues[wid].append(action)
+            self._pending += 1
+            self._wake.notify_all()
+
+    def worker(self, wid):
+        with self._lock:
+            while not self._queues[wid]:
+                self._wake.wait(timeout=0.25)
+            batch = self._queues[wid]
+            self._queues[wid] = []
+        done = [a() for a in batch]
+        with self._lock:
+            self._results.extend(done)
+            self._pending -= len(done)
+"""
+
+
+@pytest.mark.quick
+def test_thread_lint_hostplane_clean_discipline_is_silent():
+    out = threads.lint_threads_source(
+        _TH_HOSTPLANE_CLEAN, "core/hostplane.py")
+    assert out == []
+
+
+@pytest.mark.quick
+def test_thread_lint_hostplane_partition_queue_race_fires():
+    # the exact race the plane's discipline exists to prevent: the
+    # coordinator growing the partition-queue table without the lock
+    # while a worker may be swapping its list out under it
+    src = _TH_HOSTPLANE_CLEAN + """\
+
+    def racy_enqueue(self, action):
+        self._queues.append([action])
+"""
+    out = threads.lint_threads_source(src, "core/hostplane.py")
+    assert [f.code for f in out] == ["STH001"]
+    assert "_queues" in out[0].message
+
+
 @pytest.mark.quick
 def test_every_thread_rule_has_a_firing_fixture():
     import re as re_mod

@@ -1,0 +1,401 @@
+"""PARSIR-style multi-worker host plane (ISSUE 17): chain-equality
+matrix host_workers {2,4} × {conservative, optimistic} × {global,
+islands, fleet} in pipelined AND serial arms, migration re-pin
+determinism, kill-mid-drain resume parity, worker-exception serial
+fallback, the canonical (vt, gid, seq) runnable-queue key, and the
+hostplane.* telemetry plane (schema v15).
+
+The load-bearing property: the host plane changes WHICH THREAD executes
+partition-local handoff work — never what it computes or the order its
+effects commit. Worker results merge on the coordinator in the exact
+canonical order the serial drain uses (core/hostplane.py), so every
+multi-worker cell must reproduce the `host_workers: 1` audit digest
+chain bit-for-bit, including runs that migrate hosts mid-flight, resume
+from a checkpoint ring, or lose a worker to an exception.
+"""
+
+import heapq
+
+import numpy as np
+import pytest
+
+from _contracts import assert_current_metrics_schema
+
+from shadow_tpu.core import hostplane as hostplane_mod
+from shadow_tpu.core import simtime
+from shadow_tpu.fleet import JobSpec, build_fleet
+from shadow_tpu.obs import metrics as obs_metrics
+from shadow_tpu.sim import build_simulation
+
+GML = """\
+graph [
+  node [ id 0 ]
+  node [ id 1 ]
+  node [ id 2 ]
+  node [ id 3 ]
+  edge [ source 0 target 1 latency "40 ms" ]
+  edge [ source 1 target 2 latency "55 ms" ]
+  edge [ source 2 target 3 latency "70 ms" ]
+  edge [ source 3 target 0 latency "85 ms" ]
+  edge [ source 0 target 2 latency "60 ms" ]
+  edge [ source 1 target 3 latency "75 ms" ]
+]
+"""
+
+
+def _cfg(workers=1, stop=6, seed=11, runtime=None, **exp):
+    hosts = {}
+    for v in range(4):
+        hosts[f"h{v}"] = {
+            "quantity": 2, "network_node_id": v,
+            "app_model": "phold",
+            "app_options": {
+                "msgload": 1,
+                "runtime": (stop - 1) if runtime is None else runtime,
+            },
+        }
+    experimental = {
+        "event_capacity": 1024, "events_per_host_per_window": 8,
+        "outbox_slots": 8, "inbox_slots": 4,
+        "host_workers": workers,
+    }
+    experimental.update(exp)
+    return {
+        "general": {"stop_time": stop, "seed": seed},
+        "network": {"graph": {"type": "gml", "inline": GML}},
+        "experimental": experimental,
+        "hosts": hosts,
+    }
+
+
+def _chain(sim):
+    return sim.audit_chain(), sim.counters()["events_committed"]
+
+
+def _recorded_run(sim, runner=None):
+    """Run with a sharded recorder hook attached; return (chain, the
+    sorted (frontier, gid) coverage the fan-out visited)."""
+    hits = []
+    sim.add_handoff_hook(
+        lambda s, mn, gid: hits.append((int(mn), int(gid))), sharded=True
+    )
+    (runner or (lambda s: s.run(windows_per_dispatch=16)))(sim)
+    return _chain(sim), sorted(hits)
+
+
+@pytest.fixture(scope="module")
+def serial_ref():
+    """The host_workers=1 conservative chain every multi-worker cell of
+    every driver family must reproduce bit-for-bit. Hook COVERAGE is
+    compared within a driver family (each family drains at its own
+    frontiers), so cells build their own same-driver serial arm."""
+    sim = build_simulation(_cfg(workers=1))
+    chain, hits = _recorded_run(sim)
+    assert sim.hostplane_stats() == {}  # serial arm: no plane, no keys
+    assert hits  # the inline serial fan-out still visits every partition
+    return chain, hits
+
+
+# ---------------------------------------------------------------------------
+# chain-equality matrix: workers × driver × layout, pipelined + serial
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("workers", [2, 4])
+@pytest.mark.parametrize("pipelined", [True, False])
+def test_global_conservative_matrix(serial_ref, workers, pipelined):
+    sim = build_simulation(
+        _cfg(workers=workers, pipelined_dispatch=pipelined)
+    )
+    chain, hits = _recorded_run(sim)
+    assert (chain, hits) == serial_ref
+    st = sim.hostplane_stats()
+    assert st["workers"] == workers
+    assert st["sharded_drains"] > 0
+    assert st["serial_fallbacks"] == 0
+
+
+@pytest.mark.parametrize("workers", [2, 4])
+def test_global_optimistic_matrix(serial_ref, workers):
+    serial = build_simulation(_cfg(workers=1))
+    ref = _recorded_run(serial, lambda s: s.run_optimistic())
+    assert ref[0] == serial_ref[0]  # optimistic matches conservative
+    sim = build_simulation(_cfg(workers=workers))
+    assert _recorded_run(sim, lambda s: s.run_optimistic()) == ref
+    assert sim.hostplane_stats()["sharded_drains"] > 0
+
+
+@pytest.mark.parametrize("workers", [2, 4])
+def test_islands_async_matrix(serial_ref, workers):
+    exp = {"num_shards": 2, "exchange_slots": 16}
+    serial = build_simulation(_cfg(workers=1, **exp))
+    ref = _recorded_run(serial)
+    assert ref[0] == serial_ref[0]  # islands matches the global engine
+    sim = build_simulation(_cfg(workers=workers, **exp))
+    assert sim._async is True  # the fused async driver is the default
+    assert _recorded_run(sim) == ref
+    assert sim.hostplane_stats()["sharded_drains"] > 0
+
+
+def test_islands_optimistic_matches(serial_ref):
+    sim = build_simulation(
+        _cfg(workers=4, num_shards=2, exchange_slots=16)
+    )
+    chain, hits = _recorded_run(sim, lambda s: s.run_optimistic())
+    assert chain == serial_ref[0]
+    assert hits and sim.hostplane_stats()["sharded_drains"] > 0
+
+
+def _fleet_jobs(workers, n=3):
+    # runtime is kernel-shaping and must match across jobs; stop_time
+    # and seed are data-plane sweep axes
+    return [
+        JobSpec(f"job{i}", _cfg(workers=workers, seed=11 + i,
+                                stop=4 + i, runtime=3))
+        for i in range(n)
+    ]
+
+
+@pytest.mark.parametrize("workers", [2, 4])
+def test_fleet_matrix(workers):
+    def run(w):
+        fleet = build_fleet(_fleet_jobs(w), lanes=2)
+        hits = []
+        fleet.add_handoff_hook(
+            lambda f, mn, lane: hits.append(int(lane)), sharded=True
+        )
+        fleet.run()
+        return {r["name"]: (r["audit"]["chain"], r["events_committed"])
+                for r in fleet.results()}, sorted(hits), fleet
+
+    rows_s, hits_s, serial = run(1)
+    rows_m, hits_m, multi = run(workers)
+    assert rows_m == rows_s and rows_m
+    assert hits_m == hits_s and hits_m  # same per-lane fan-out coverage
+    assert serial.hostplane_stats() == {}
+    st = multi.hostplane_stats()
+    assert st["workers"] == workers and st["sharded_drains"] > 0
+
+
+# ---------------------------------------------------------------------------
+# migration re-pin determinism
+# ---------------------------------------------------------------------------
+
+
+def test_migration_repins_and_stays_chain_exact():
+    """A live migration mid-run permutes slot_of; the plane re-pins from
+    the new table on the next drain and the chain still matches the
+    serial migrated run bit-for-bit."""
+    exp = {"num_shards": 2, "exchange_slots": 16, "rebalance": True}
+
+    def run(workers):
+        sim = build_simulation(_cfg(workers=workers, **exp))
+        hits = []
+        sim.add_handoff_hook(
+            lambda s, mn, gid: hits.append((int(mn), int(gid))),
+            sharded=True,
+        )
+        sim.run(until=3 * simtime.NS_PER_SEC, windows_per_dispatch=16)
+        sim.rebalance_now()
+        assert sim.rebalances == 1
+        sim.run(windows_per_dispatch=16)
+        return sim, _chain(sim), sorted(hits)
+
+    serial, chain_s, hits_s = run(1)
+    multi, chain_m, hits_m = run(4)
+    assert chain_m == chain_s
+    assert hits_m == hits_s
+    # the slot cache tracked the layout epoch: post-migration drains
+    # derived pins from the CURRENT slot_of table
+    cached = multi._hostplane_slot_cache
+    assert cached is not None and cached[0] == 1
+    assert np.array_equal(
+        cached[1], np.asarray(multi.params.slot_of).reshape(-1)
+    )
+
+
+def test_repin_determinism_unit():
+    """Same slot-table history -> same pins, same move count, on every
+    run (the placement seam is the only pin input)."""
+    def history(plane, st):
+        pins = []
+        for sm in (None, [3, 2, 1, 0], [3, 2, 1, 0], [0, 1, 2, 3]):
+            plane.set_slot_map(sm)
+            plane.drain([
+                hostplane_mod.HostAction(0, g, lambda: None)
+                for g in range(4)
+            ])
+            with plane._lock:
+                pins.append(dict(plane._pins))
+        plane.close()
+        return pins, st["pin_moves"]
+
+    a = history(*(lambda s: (hostplane_mod.HostPlane(2, s), s))(
+        hostplane_mod.new_stats(2)))
+    b = history(*(lambda s: (hostplane_mod.HostPlane(2, s), s))(
+        hostplane_mod.new_stats(2)))
+    assert a == b
+    pins, moves = a
+    assert pins[0] == {0: 0, 1: 1, 2: 0, 3: 1}   # identity: gid % 2
+    assert pins[1] == {0: 1, 1: 0, 2: 1, 3: 0}   # reversed table
+    assert pins[2] == pins[1]                     # stable under no change
+    assert pins[3] == pins[0]                     # migrated back
+    assert moves == 8                             # two full re-pins of 4
+
+
+# ---------------------------------------------------------------------------
+# kill mid-drain -> resume parity
+# ---------------------------------------------------------------------------
+
+
+def test_kill_mid_run_resume_matches_serial(tmp_path):
+    """Auto-checkpoint a 4-worker run, kill it between handoffs (abandon
+    the process state), resume in a fresh 4-worker build: the final
+    chain equals the uninterrupted serial run's."""
+    serial = build_simulation(_cfg(workers=1))
+    serial.run(windows_per_dispatch=16)
+    want = _chain(serial)
+
+    interrupted = build_simulation(_cfg(workers=4))
+    interrupted.add_handoff_hook(lambda s, mn, gid: None, sharded=True)
+    interrupted.configure_auto_checkpoint(
+        str(tmp_path), every_ns=simtime.NS_PER_SEC
+    )
+    interrupted.run(until=3 * simtime.NS_PER_SEC,
+                    windows_per_dispatch=16)
+    assert interrupted.hostplane_stats()["sharded_drains"] > 0
+    del interrupted  # the SIGKILL: nothing survives but the ring
+
+    res = build_simulation(_cfg(workers=4))
+    res.add_handoff_hook(lambda s, mn, gid: None, sharded=True)
+    res.resume_from(str(tmp_path))
+    res.run(windows_per_dispatch=16)
+    assert _chain(res) == want
+
+
+# ---------------------------------------------------------------------------
+# worker exception -> serial fallback, canonical order preserved
+# ---------------------------------------------------------------------------
+
+
+def test_worker_exception_falls_back_serially(serial_ref):
+    sim = build_simulation(_cfg(workers=4))
+    blown = []
+
+    def fragile(s, mn, gid):
+        # raises exactly once, on a worker thread; the coordinator's
+        # canonical-order re-run must succeed and keep the chain
+        if not blown:
+            blown.append(gid)
+            raise RuntimeError("worker boom")
+
+    sim.add_handoff_hook(fragile, sharded=True)
+    sim.run(windows_per_dispatch=16)
+    assert _chain(sim) == serial_ref[0]
+    st = sim.hostplane_stats()
+    assert st["serial_fallbacks"] >= 1
+    assert blown  # the exception actually fired
+
+
+def test_fallback_merge_order_stays_canonical():
+    """A failed action's coordinator re-run merges IN PLACE in the
+    canonical walk — not appended after the survivors."""
+    st = hostplane_mod.new_stats(2)
+    plane = hostplane_mod.HostPlane(2, st)
+    merged = []
+    armed = [True]
+
+    def work(g):
+        if g == 1 and armed:
+            armed.clear()
+            raise RuntimeError("boom")
+        return g
+
+    acts = [
+        hostplane_mod.HostAction(0, g, (lambda g=g: work(g)), merged.append)
+        for g in (3, 1, 0, 2)
+    ]
+    assert plane.drain(acts) == 4
+    plane.close()
+    assert merged == [0, 1, 2, 3]  # canonical despite the gid-1 failure
+    assert st["serial_fallbacks"] == 1
+
+
+# ---------------------------------------------------------------------------
+# the canonical runnable-queue key (procs/driver.py)
+# ---------------------------------------------------------------------------
+
+
+def test_runnable_queue_pops_in_canonical_order():
+    """The managed plane's runnable queue orders by the host plane's
+    merge key — (virtual time at mark, owning host gid, mark seq) — not
+    by registration index or insertion order."""
+    from shadow_tpu.procs.driver import ProcessDriver
+
+    class _Host:
+        def __init__(self, index):
+            self.index = index
+
+    class _Proc:
+        def __init__(self, reg_idx, gid):
+            self.reg_idx = reg_idx
+            self.host = _Host(gid)
+
+    drv = ProcessDriver(stop_time=1, seed=1)
+    # scrambled insertion at t=0: gids 5, 2, 9, 2 (high reg_idx first)
+    for reg_idx, gid in ((40, 5), (30, 2), (20, 9), (10, 2)):
+        drv._mark_runnable(_Proc(reg_idx, gid))
+    drv.now = 7
+    drv._mark_runnable(_Proc(50, 0))  # later vt loses to earlier vt
+
+    popped = []
+    while drv._runq_heap:
+        t, gid, seq, idx = heapq.heappop(drv._runq_heap)
+        popped.append((t, gid, idx))
+    assert popped == [
+        (0, 2, 30), (0, 2, 10),   # gid ties break by mark seq
+        (0, 5, 40), (0, 9, 20),
+        (7, 0, 50),               # virtual time dominates gid
+    ]
+
+
+# ---------------------------------------------------------------------------
+# hostplane.* telemetry (metrics schema v15)
+# ---------------------------------------------------------------------------
+
+
+def test_hostplane_metrics_schema_v15(tmp_path):
+    sim = build_simulation(_cfg(workers=4))
+    sim.add_handoff_hook(lambda s, mn, gid: None, sharded=True)
+    sim.run(windows_per_dispatch=16)
+    session = obs_metrics.ObsSession()
+    session.finalize(sim)
+    doc = session.metrics.dump(str(tmp_path / "m.json"))
+    assert_current_metrics_schema(doc)
+    obs_metrics.validate_metrics_doc(doc, strict_namespaces=True)
+    c = doc["counters"]
+    assert c["hostplane.workers"] == 4
+    assert c["hostplane.sharded_drains"] > 0
+    assert c["hostplane.serial_fallbacks"] == 0
+    assert c["hostplane.pin_moves"] == 0
+    assert sum(c[f"hostplane.drain_ns_w{w}"] for w in range(4)) >= 0
+
+
+def test_serial_run_emits_no_hostplane_keys(tmp_path):
+    sim = build_simulation(_cfg(workers=1))
+    sim.add_handoff_hook(lambda s, mn, gid: None, sharded=True)
+    sim.run(windows_per_dispatch=16)
+    session = obs_metrics.ObsSession()
+    session.finalize(sim)
+    doc = session.metrics.dump(str(tmp_path / "m.json"))
+    assert not [k for k in doc["counters"] if k.startswith("hostplane.")]
+    assert not [k for k in doc["gauges"] if k.startswith("hostplane.")]
+
+
+def test_config_rejects_bad_host_workers():
+    from shadow_tpu.core.config import ConfigError, load_config
+
+    with pytest.raises(ConfigError):
+        load_config(_cfg(workers=0))
+    cfg = load_config(_cfg(workers=3))
+    assert cfg.experimental.host_workers == 3

@@ -60,6 +60,8 @@ STAGES = [
     ("serve_smoke", [PY, "bench.py", "--serve-smoke"], False, 7200),
     ("pressure_smoke", [PY, "bench.py", "--pressure-smoke"], False, 7200),
     ("pipeline_smoke", [PY, "bench.py", "--pipeline-smoke"], False, 7200),
+    ("hostplane_smoke", [PY, "bench.py", "--hostplane-smoke"],
+     False, 7200),
     ("async_smoke", [PY, "bench.py", "--async-smoke"], False, 7200),
     ("balance_smoke", [PY, "bench.py", "--balance-smoke"], False, 7200),
     ("mesh_smoke", [PY, "bench.py", "--mesh-smoke"], False, 7200),

@@ -15,6 +15,13 @@ span per boundary; for those the summary also reports OVERLAP EFFICIENCY
 device dispatch (between an issue span's end and its await span's end),
 i.e. how much of the host-side handoff the pipeline actually hid.
 
+Multi-worker host-plane traces (docs/architecture.md §Host plane) carry
+additional `host_drain` spans on worker tids (one tid per drain worker,
+numbered from the host plane's WORKER_TID_BASE); for those the summary
+reports DRAIN PARALLELISM — summed per-worker drain time over the union
+of worker-busy wall time, i.e. how many workers were effectively
+draining at once.
+
 Usage:  python tools/trace_summary.py shadow.trace.json [-n TOP] [--json]
 """
 
@@ -121,6 +128,56 @@ def overlap_stats(doc) -> dict | None:
     }
 
 
+# First worker tid the host plane assigns (coordinator spans stay on the
+# driver tid below this). Mirrors shadow_tpu/core/hostplane.py; kept as a
+# literal so the tool stays runnable against a bare trace file.
+WORKER_TID_BASE = 100
+
+
+def drain_parallelism(doc) -> dict | None:
+    """Host-plane drain parallelism from per-worker `host_drain` spans.
+
+    The host plane emits one `host_drain` span per worker per sharded
+    drain, each on its own tid (WORKER_TID_BASE + worker id). Summed
+    worker-busy time over the union of worker-busy intervals is the
+    effective parallelism: 1.0 means the workers never overlapped (or
+    there is only one), N means N workers were always busy together.
+    Returns None when the trace has no worker-tid drain spans (a serial
+    run, or host_workers: 1)."""
+    events = doc if isinstance(doc, list) else doc.get("traceEvents", [])
+    per_worker: dict[int, float] = {}
+    intervals: list[tuple[float, float]] = []
+    for ev in events:
+        if ev.get("ph") != "X" or ev.get("name") != "host_drain":
+            continue
+        tid = int(ev.get("tid", 0))
+        if tid < WORKER_TID_BASE:
+            continue
+        ts = float(ev.get("ts", 0.0))
+        dur = float(ev.get("dur", 0.0))
+        per_worker[tid] = per_worker.get(tid, 0.0) + dur
+        intervals.append((ts, ts + dur))
+    if not intervals:
+        return None
+    intervals.sort()
+    union = 0.0
+    cur0, cur1 = intervals[0]
+    for s, e in intervals[1:]:
+        if s > cur1:
+            union += cur1 - cur0
+            cur0, cur1 = s, e
+        else:
+            cur1 = max(cur1, e)
+    union += cur1 - cur0
+    busy = sum(per_worker.values())
+    return {
+        "workers": len(per_worker),
+        "worker_drain_ms": busy / 1e3,
+        "elapsed_ms": union / 1e3,
+        "parallelism": (busy / union) if union > 0 else 0.0,
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("trace", help="trace JSON written by --trace-out")
@@ -135,6 +192,7 @@ def main(argv=None) -> int:
             doc = json.load(f)
         rows, other = summarize(doc)
         overlap = overlap_stats(doc)
+        drain = drain_parallelism(doc)
     except (OSError, ValueError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
@@ -146,6 +204,8 @@ def main(argv=None) -> int:
         }
         if overlap is not None:
             out["overlap"] = overlap
+        if drain is not None:
+            out["drain_parallelism"] = drain
         print(json.dumps(out, indent=1))
         return 0
     if not rows:
@@ -166,6 +226,13 @@ def main(argv=None) -> int:
             f"({100 * overlap['overlap_efficiency']:.1f}% efficiency, "
             f"{overlap['adopted']}/{overlap['issued_ahead']} issued-ahead "
             f"dispatches adopted)"
+        )
+    if drain is not None:
+        print(
+            f"drain parallelism: {drain['worker_drain_ms']:.3f} ms worker "
+            f"drain over {drain['elapsed_ms']:.3f} ms elapsed "
+            f"({drain['parallelism']:.2f}x across {drain['workers']} "
+            f"workers)"
         )
     if other:
         marks = ", ".join(f"{k} x{v}" for k, v in sorted(other.items()))
