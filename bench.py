@@ -2023,6 +2023,197 @@ def stage_serve_smoke(num_hosts: int = 64, msgload: int = 2):
     }
 
 
+def stage_federation_smoke(num_hosts: int = 64, msgload: int = 2):
+    """Federated serve plane gate (ISSUE 18 acceptance): 3 daemons +
+    the router, all sharing one kcache root. Choreography:
+
+      1. warm one sweep through the router (pays the only traces);
+      2. submit a mixed-tenant batch with a same-tenant burst — sticky
+         affinity piles it onto one peer, so idle peers STEAL through
+         the journaled handoff path (`federation.steals >= 1`);
+      3. SIGKILL the loaded peer mid-sweep — the router's probe ladder
+         declares it lost, replays its journal, and re-places every
+         unfinished sweep onto the survivors.
+
+    Gates: every batch sweep settles `done` with per-job audit chains
+    bit-identical to an uninterrupted in-process fleet run of the same
+    document; at least one steal and one failover-replayed sweep; ZERO
+    window-kernel traces on every batch sweep (the shared AOT cache
+    means peers that never saw the shape bind warm); and the router's
+    schema-v16 `federation.*` metrics document STRICT-validates as the
+    stage artifact. CPU-deterministic: the kill is wall-clock-timed but
+    chains are virtual-time functions, so where it lands never changes
+    the bar."""
+    import tempfile
+
+    from shadow_tpu.fleet import build_fleet, load_sweep
+    from shadow_tpu.obs import metrics as obs_metrics
+    from shadow_tpu.serve.client import ServeClient, ServeClientError
+
+    def sweep_doc(name: str, seed: int) -> dict:
+        return {
+            **_fleet_smoke_job(seed=seed, stop_s=1.0, num_hosts=num_hosts,
+                               msgload=msgload),
+            "sweep": {
+                "name": name,
+                "lanes": 2,
+                "matrix": {"general.seed": [seed, seed + 1]},
+            },
+            "fleet": {"windows_per_dispatch": 2},
+        }
+
+    batch = [
+        # the same-tenant burst (affinity pile-up -> steal pressure) ...
+        ("team-a", sweep_doc("fed-a0", 21)),
+        ("team-a", sweep_doc("fed-a1", 31)),
+        ("team-a", sweep_doc("fed-a2", 41)),
+        ("team-a", sweep_doc("fed-a3", 51)),
+        # ... plus a second tenant so placement is mixed, not monoculture
+        ("team-b", sweep_doc("fed-b0", 61)),
+        ("team-b", sweep_doc("fed-b1", 71)),
+    ]
+
+    with tempfile.TemporaryDirectory(prefix="federation_smoke_") as td:
+        cache_dir = os.path.join(td, "cache")  # ONE root, all peers
+        env = {**os.environ, "SHADOW_TPU_CACHE_DIR": cache_dir}
+        peers = {f"p{i}": os.path.join(td, f"p{i}") for i in range(3)}
+        router_dir = os.path.join(td, "router")
+
+        def start_peer(name: str):
+            return subprocess.Popen(
+                [sys.executable, "-m", "shadow_tpu", "serve",
+                 "--state-dir", peers[name],
+                 "--checkpoint-every-dispatches", "1"],
+                env=env, cwd=_REPO,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            )
+
+        t0 = time.perf_counter()
+        procs = {name: start_peer(name) for name in peers}
+        for name in peers:
+            ServeClient(
+                os.path.join(peers[name], "serve.sock"), timeout=30
+            ).wait_ready(timeout_s=120)
+        router_proc = subprocess.Popen(
+            [sys.executable, "-m", "shadow_tpu", "route",
+             "--state-dir", router_dir,
+             "--probe-interval", "0.25", "--lost-after", "3",
+             "--peers"] + [f"{n}={d}" for n, d in peers.items()],
+            env=env, cwd=_REPO,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+        router = ServeClient(
+            os.path.join(router_dir, "route.sock"), timeout=30, retries=3
+        )
+        router.wait_ready(timeout_s=120)
+
+        # 1. warm the shared kcache through the router (the ONLY traces)
+        warm = router.submit(sweep_doc("fed-warm", 11), tenant="warm")
+        router.wait(warm["id"], timeout_s=600)
+
+        # 2. the batch: a burst faster than the probe refresh, so sticky
+        # affinity piles team-a onto one peer and the stealer has work
+        placed = [
+            (router.submit(doc, tenant=tenant), tenant)
+            for tenant, doc in batch
+        ]
+        handles = [out["id"] for out, _ in placed]
+        pile_peer = placed[0][0]["peer"]
+
+        # 3. wait for a steal, then SIGKILL the loaded peer mid-sweep
+        steals = 0
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            steals = router.metrics()["counters"].get(
+                "federation.steals", 0
+            )
+            if steals >= 1:
+                break
+            time.sleep(0.2)
+        procs[pile_peer].kill()
+        procs[pile_peer].wait()
+
+        results: dict[str, dict] = {}
+        for h in handles:
+            results[h] = router.wait(h, timeout_s=900)
+        metrics_doc = router.metrics()
+        health = router.health()
+        try:
+            router.drain()
+        except ServeClientError:
+            pass
+        router_proc.wait(timeout=60)
+        for name, proc in procs.items():
+            if name == pile_peer:
+                continue
+            try:
+                ServeClient(
+                    os.path.join(peers[name], "serve.sock"), timeout=30
+                ).drain()
+            except ServeClientError:
+                pass
+            proc.wait(timeout=60)
+        wall = time.perf_counter() - t0
+
+    metrics_path = os.path.join(_REPO, "federation_smoke.metrics.json")
+    with open(metrics_path, "w") as f:
+        json.dump(metrics_doc, f, indent=1)
+        f.write("\n")
+    # STRICT validation: federation.* must be a registered namespace
+    obs_metrics.validate_metrics_doc(metrics_doc, strict_namespaces=True)
+
+    # uninterrupted references: each doc as one in-process fleet
+    chains_equal = True
+    zero_recompiles = True
+    for (tenant, doc), h in zip(batch, handles):
+        info = results[h]
+        jobs, _ = load_sweep(json.loads(json.dumps(doc)))
+        ref = build_fleet(jobs, lanes=2, windows_per_dispatch=2)
+        ref.run()
+        ref_rows = ref.results()
+        rows = info.get("results") or []
+        if not (
+            info["status"] == "done"
+            and [r["name"] for r in rows] == [r["name"] for r in ref_rows]
+            and [r.get("audit", {}).get("chain") for r in rows]
+            == [r["audit"]["chain"] for r in ref_rows]
+        ):
+            chains_equal = False
+        if (info.get("stats") or {}).get("kernel_traces", -1) != 0:
+            zero_recompiles = False
+
+    counters = metrics_doc["counters"]
+    gate_steals = counters.get("federation.steals", 0) >= 1
+    gate_failover = (
+        counters.get("federation.failovers", 0) >= 1
+        and counters.get("federation.replayed_sweeps", 0) >= 1
+    )
+    return {
+        "stage": "federation_smoke",
+        "hosts": num_hosts,
+        "peers": len(peers),
+        "sweeps": len(batch),
+        "pile_peer": pile_peer,
+        "wall_s": round(wall, 3),
+        "statuses": {h: results[h]["status"] for h in handles},
+        "chains_equal": chains_equal,
+        "federation": {
+            k: v for k, v in counters.items()
+            if k.startswith("federation.")
+        },
+        "peers_up": health.get("peers_up"),
+        "metrics_out": os.path.relpath(metrics_path, _REPO),
+        "gate_chains": bool(chains_equal),
+        "gate_zero_recompiles": bool(zero_recompiles),
+        "gate_steals": bool(gate_steals),
+        "gate_failover": bool(gate_failover),
+        "gate": bool(
+            chains_equal and zero_recompiles and gate_steals
+            and gate_failover
+        ),
+    }
+
+
 def stage_pipeline_smoke(hosts: int = 256, msgload: int = 2,
                          stop_s: int = 12, wpd: int = 4,
                          drain_ms: float = 40.0):
@@ -2590,6 +2781,15 @@ def main():
         # deterministic by design, so no backend wait.
         os.environ.setdefault("SHADOW_TPU_BENCH_ALLOW_CPU", "1")
         print(json.dumps(stage_serve_smoke()), flush=True)
+        return
+    if "--federation-smoke" in sys.argv:
+        # federated serve gate: 3 peers + router sharing one kcache
+        # root, mixed-tenant batch, steal under affinity pile-up,
+        # SIGKILL one peer mid-sweep → journal-replay failover onto the
+        # survivors with bit-identical chains and zero retraces.
+        # CPU-deterministic by design, so no backend wait.
+        os.environ.setdefault("SHADOW_TPU_BENCH_ALLOW_CPU", "1")
+        print(json.dumps(stage_federation_smoke()), flush=True)
         return
     if "--async-smoke" in sys.argv:
         # async conservative-sync gate: per-shard frontiers beat the

@@ -475,6 +475,13 @@ def main(argv: list[str] | None = None) -> int:
         from shadow_tpu.serve.cli import main as serve_main
 
         return serve_main(argv[1:])
+    if argv and argv[0] == "route":
+        # federation router (shadow_tpu/serve/router): place sweeps
+        # across N serve daemons, probe their health, replay a lost
+        # peer's journal onto survivors — `python -m shadow_tpu route -h`
+        from shadow_tpu.serve.router import main as route_main
+
+        return route_main(argv[1:])
     args = _build_parser().parse_args(argv)
     from shadow_tpu.core.config import ConfigError, load_config
 

@@ -23,6 +23,8 @@ every emit/consume site against it:
   SLC006  config_spec table drift (stale row / undocumented loader key)
   SLC007  supervisor policy set drift (config validator / docs)
   SLC008  fault-op registry drift (ALL_OPS vs the _FIELDS validation table)
+  SLC009  journal record-type docs table drift (serve/journal.py
+          RECORD_TYPES vs the docs/serving.md §2 table)
 
 Every check is a pure function over explicit inputs so the test suite
 can forge drift fixtures; ``audit_tree`` wires the real files in.
@@ -275,6 +277,60 @@ def extract_op_table_region(md_text: str) -> str:
                 break
             out.append(line)
     return "\n".join(out)
+
+
+# ---------------------------------------------------------------------------
+# SLC009: journal record-type docs table
+# ---------------------------------------------------------------------------
+
+
+def extract_journal_table_region(md_text: str) -> str:
+    """The docs/serving.md §2 record-type table: rows between the
+    `| type | when |` header and the next non-table line."""
+    lines = md_text.splitlines()
+    out: list[str] = []
+    in_table = False
+    for line in lines:
+        s = line.strip()
+        if re.match(r"^\|\s*type\s*\|\s*when\s*\|", s):
+            in_table = True
+            continue
+        if in_table:
+            if not s.startswith("|"):
+                break
+            out.append(line)
+    return "\n".join(out)
+
+
+def audit_journal_record_table(
+    md_text: str, relpath: str, record_types: tuple[str, ...] | frozenset
+) -> list[Finding]:
+    """Journal record-type drift: every type in serve/journal.py
+    RECORD_TYPES needs a documented row (same cell style as the fault-op
+    table, so `doc_op_table` reads it), and every row must name a
+    registered type — a HANDOFF/REGISTER-class record that replay folds
+    but operators can't look up is exactly the docs/journal drift SLC004
+    catches for fault ops."""
+    rows = doc_op_table(md_text)
+    registered = set(record_types)
+    findings: list[Finding] = []
+    for rtype in sorted(registered - rows):
+        findings.append(_finding(
+            relpath, 1, 0, "SLC009",
+            f"journal record type `{rtype}` has no row in the {relpath} "
+            f"record table — every type in serve/journal.py RECORD_TYPES "
+            f"needs a documented trigger and payload",
+            f"record:{rtype}",
+        ))
+    for rtype in sorted(rows - registered):
+        findings.append(_finding(
+            relpath, 1, 0, "SLC009",
+            f"record table row `{rtype}` names a type serve/journal.py "
+            f"does not register — stale row (the record was removed or "
+            f"renamed)",
+            f"stale:{rtype}",
+        ))
+    return findings
 
 
 # ---------------------------------------------------------------------------
@@ -624,6 +680,14 @@ def audit_tree(root: str) -> list[Finding]:
         plan_mod.ALL_OPS, set(plan_mod._FIELDS),
     )
 
+    # SLC009: the serve journal record-type table
+    from shadow_tpu.serve import journal as journal_mod
+
+    findings += audit_journal_record_table(
+        extract_journal_table_region(_read(root, "docs/serving.md")),
+        "docs/serving.md", journal_mod.RECORD_TYPES,
+    )
+
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.code))
     return findings
 
@@ -637,4 +701,5 @@ CONTRACT_RULES = {
     "SLC006": "config_spec table drift",
     "SLC007": "supervisor policy set drift",
     "SLC008": "fault-op registry drift",
+    "SLC009": "journal record-type docs table drift",
 }

@@ -62,6 +62,8 @@ from shadow_tpu.analysis.rules import build_imports, resolve_name
 THREAD_MODULES = (
     "shadow_tpu/serve/daemon.py",
     "shadow_tpu/serve/journal.py",
+    "shadow_tpu/serve/federation.py",
+    "shadow_tpu/serve/router.py",
     "shadow_tpu/fleet/scheduler.py",
     "shadow_tpu/core/supervisor.py",
     "shadow_tpu/parallel/elastic.py",

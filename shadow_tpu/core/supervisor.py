@@ -229,6 +229,71 @@ def probe_backend() -> bool:
 _default_probe = probe_backend  # supervisor-internal historical name
 
 
+# ---------------------------------------------------------------------------
+# peer probe state: the SUSPECT→LOST ladder as reusable data
+# ---------------------------------------------------------------------------
+
+PEER_HEALTHY = "healthy"
+PEER_SUSPECT = "suspect"
+PEER_LOST = "lost"
+
+PEER_STATES = (PEER_HEALTHY, PEER_SUSPECT, PEER_LOST)
+
+
+class ProbeLadder:
+    """The bounded-miss health ladder of the supervisor state machine
+    (HEALTHY → SUSPECT → LOST, cs/0409032's bounded-lag signal) packaged
+    as standalone peer-probe state: the serve federation's router
+    (serve/federation.py) runs one ladder per serve daemon, exactly the
+    classification discipline BackendSupervisor applies per backend —
+    a single missed probe is a SIGNAL (SUSPECT), `lost_after`
+    consecutive misses a verdict (LOST), and any success snaps the
+    ladder back to HEALTHY (recovery is instant, loss is earned).
+
+    `backoff_s()` is the jittered exponential wait before the NEXT
+    probe of a non-healthy peer — the same ±50% decorrelation jitter
+    the supervisor applies to its re-probe loop, seeded so tests are
+    deterministic. Wall scheduling only; never simulation results.
+    """
+
+    def __init__(self, *, lost_after: int = 3,
+                 backoff_base_s: float = 0.25,
+                 backoff_cap_s: float = 5.0, seed: int = 0):
+        self.lost_after = max(1, int(lost_after))
+        self.backoff_base_s = float(backoff_base_s)
+        self.backoff_cap_s = float(backoff_cap_s)
+        self._rng = random.Random(seed)
+        self.misses = 0
+        self.probes = 0
+        self.state = PEER_HEALTHY
+
+    def record(self, ok: bool) -> str:
+        """Fold one probe verdict; returns the post-probe state."""
+        self.probes += 1
+        if ok:
+            self.misses = 0
+            self.state = PEER_HEALTHY
+        else:
+            self.misses += 1
+            self.state = (
+                PEER_LOST if self.misses >= self.lost_after
+                else PEER_SUSPECT
+            )
+        return self.state
+
+    def backoff_s(self) -> float:
+        """Jittered exponential wait before the next probe, keyed to the
+        consecutive-miss count (0 misses → 0: healthy peers are probed
+        on the caller's regular cadence)."""
+        if self.misses == 0:
+            return 0.0
+        base = min(
+            self.backoff_base_s * (2 ** (self.misses - 1)),
+            self.backoff_cap_s,
+        )
+        return base * (0.5 + self._rng.random())
+
+
 class PendingDispatch:
     """One device dispatch split into its two halves (the pipelined
     drivers' seam, core/pipeline.py):

@@ -297,6 +297,22 @@ class FleetScheduler:
 
     # -- introspection --
 
+    def steal_export(self) -> dict:
+        """The lane-steal posture lifted one level up (the serve
+        federation's cross-daemon work stealing, serve/federation.py):
+        in-fleet steal/pack tallies plus the predicted load still queued
+        — the router compares queued load ACROSS daemons exactly the way
+        `pick` compares jobs across lanes, so a peer whose queue holds
+        heavy tail jobs is stolen from before a peer with many light
+        ones."""
+        return {
+            "lane_steals": int(self.lane_steals),
+            "pack_decisions": int(self.pack_decisions),
+            "queued_predicted_load": float(
+                sum(self.predicted_load(r) for r in self.pending())
+            ),
+        }
+
     def running(self) -> list[JobRecord]:
         return [r for r in self.lane_job if r is not None]
 

@@ -25,6 +25,11 @@ import numpy as np
 
 from shadow_tpu.obs import counters as obs_counters
 
+# v16: federation.* federated serve plane (serve/federation.py +
+# serve/router.py): placements/steals/failovers/replayed_sweeps/
+# probes/peers_lost/handoff_recoveries counters for the N-daemon
+# router, plus peers_up/peers_total/peers_suspect membership gauges
+# and the queue_depth_max/min spread the work stealer flattens;
 # v15: hostplane.* multi-worker host plane (core/hostplane.py): worker
 # pool width, sharded-drain count, canonical-merge wall, per-worker
 # drain wall (drain_ns_w<i>), serial-fallback re-runs after a worker
@@ -66,7 +71,7 @@ from shadow_tpu.obs import counters as obs_counters
 # obs/audit.py) + optional per-job `audit` sub-object on fleet.jobs[*]
 # rows; v4: optional top-level `fleet` section (fleet.jobs[*] per-job
 # rows) + fleet.* counters; v3: faults.* recovery counters
-SCHEMA_VERSION = 15
+SCHEMA_VERSION = 16
 DOC_KIND = "shadow_tpu.metrics"
 
 # metrics-doc `fleet.jobs[*]` rows must carry at least these keys
@@ -103,6 +108,7 @@ KNOWN_METRIC_NAMESPACES = frozenset({
                    # elastic-resilience rows added in v12)
     "pipeline",    # pipelined CPU↔TPU handoff (schema v14)
     "hostplane",   # multi-worker host-plane drain (schema v15)
+    "federation",  # federated serve plane / router (schema v16)
     "sim",         # build-level gauges (num_hosts, runahead)
 })
 
@@ -262,6 +268,11 @@ def validate_metrics_doc(doc: dict, strict_namespaces: bool = False) -> None:
             # schema v15: host-plane drain counters are monotonic tallies
             raise ValueError(
                 f"hostplane counter {k!r} must be >= 0, got {v}"
+            )
+        if k.startswith("federation.") and v < 0:
+            # schema v16: federated-serve counters are monotonic tallies
+            raise ValueError(
+                f"federation counter {k!r} must be >= 0, got {v}"
             )
     for k, v in doc["gauges"].items():
         if not isinstance(v, (int, float)) or isinstance(v, bool):

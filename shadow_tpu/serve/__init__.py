@@ -1,11 +1,15 @@
 """Sim-as-a-service: the crash-safe fleet daemon (docs/serving.md).
 
-- `serve.daemon`   the resident multi-tenant daemon (journaled queue,
-                   graceful drain, admission quotas, /healthz)
-- `serve.journal`  write-ahead job journal (CRC-framed, fsync'd, replay)
-- `serve.kcache`   AOT window-kernel cache (jax.export artifacts keyed
-                   by config digest / gear / avals / jaxlib version)
-- `serve.client`   HTTP-over-unix-socket client (tools/shadowctl.py)
+- `serve.daemon`     the resident multi-tenant daemon (journaled queue,
+                     graceful drain, admission quotas, /healthz)
+- `serve.journal`    write-ahead job journal (CRC-framed, fsync'd, replay)
+- `serve.kcache`     AOT window-kernel cache (jax.export artifacts keyed
+                     by config digest / gear / avals / jaxlib version)
+- `serve.client`     HTTP-over-unix-socket client (tools/shadowctl.py)
+- `serve.federation` N-daemon peer table: placement, probe ladders,
+                     journal-replay failover, journaled work stealing
+- `serve.router`     the federation front process
+                     (`python -m shadow_tpu route --peers ...`)
 """
 
 from shadow_tpu.serve.journal import Journal, JournalError, JournalState
@@ -24,4 +28,22 @@ __all__ = [
     "cache_root",
     "kernel_config_digest",
     "sweep_corrupt_entries",
+    "Federation",
+    "FederationError",
+    "ShadowRouter",
 ]
+
+
+def __getattr__(name):
+    # federation/router import the client + supervisor stacks; keep the
+    # base package import light (journal replay tools shouldn't pull in
+    # HTTP machinery) by resolving these lazily
+    if name in ("Federation", "FederationError"):
+        from shadow_tpu.serve import federation as _federation
+
+        return getattr(_federation, name)
+    if name == "ShadowRouter":
+        from shadow_tpu.serve import router as _router
+
+        return _router.ShadowRouter
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import http.client
 import json
+import random
 import socket
 import time
 
@@ -31,6 +32,16 @@ class Shed(ServeClientError):
         self.retry_after_s = float(body.get("retry_after_s") or 1)
 
 
+class _Refused(Exception):
+    """Internal marker: connect() itself failed (daemon restarting or a
+    stale socket file) — the one failure mode `ServeClient.request` may
+    safely retry, because the daemon never saw the request."""
+
+    def __init__(self, cause: OSError):
+        super().__init__(str(cause))
+        self.cause = cause
+
+
 class _UnixHTTPConnection(http.client.HTTPConnection):
     def __init__(self, path: str, timeout: float):
         super().__init__("localhost", timeout=timeout)
@@ -44,14 +55,57 @@ class _UnixHTTPConnection(http.client.HTTPConnection):
 
 
 class ServeClient:
-    def __init__(self, socket_path: str, timeout: float = 60.0):
+    def __init__(self, socket_path: str, timeout: float = 60.0,
+                 retries: int = 0, backoff_base_s: float = 0.1,
+                 backoff_cap_s: float = 2.0, sleep=time.sleep):
+        """`retries` bounds the in-client retry of CONNECTION-phase
+        failures only — `ConnectionRefusedError` and the stale-socket
+        `FileNotFoundError` a restarting daemon leaves behind — with
+        jittered exponential backoff between attempts. A request that
+        reached the daemon is NEVER retried here (a replayed submit
+        would double-journal a sweep); the caller owns that policy, as
+        it owns the 429 policy. `sleep` is injectable for tests."""
         self.socket_path = socket_path
         self.timeout = float(timeout)
+        self.retries = max(0, int(retries))
+        self.backoff_base_s = float(backoff_base_s)
+        self.backoff_cap_s = float(backoff_cap_s)
+        self._sleep = sleep
+        self._rng = random.Random(0)
+
+    def _retry_wait_s(self, attempt: int) -> float:
+        base = min(
+            self.backoff_base_s * (2 ** attempt), self.backoff_cap_s
+        )
+        return base * (0.5 + self._rng.random())  # ±50% decorrelation
 
     def request(self, method: str, path: str,
                 body: dict | None = None) -> tuple[int, dict]:
+        for attempt in range(self.retries + 1):
+            try:
+                return self._request_once(method, path, body)
+            except _Refused as e:
+                # the daemon never saw this request (connect() failed):
+                # a bounded, jittered retry rides out a restart window
+                # instead of surfacing a bare traceback (shadowctl)
+                if attempt >= self.retries:
+                    raise ServeClientError(
+                        f"{method} {path}: daemon unreachable at "
+                        f"{self.socket_path} after "
+                        f"{self.retries + 1} attempt(s): {e.cause}"
+                    ) from e.cause
+                self._sleep(self._retry_wait_s(attempt))
+        raise AssertionError("unreachable")  # pragma: no cover
+
+    def _request_once(self, method: str, path: str,
+                      body: dict | None) -> tuple[int, dict]:
         conn = _UnixHTTPConnection(self.socket_path, self.timeout)
         try:
+            try:
+                conn.connect()
+            except (ConnectionRefusedError, FileNotFoundError) as e:
+                # refused / stale socket: the retryable restart window
+                raise _Refused(e) from e
             payload = json.dumps(body).encode() if body is not None else None
             headers = {"Content-Type": "application/json"} if payload else {}
             conn.request(method, path, body=payload, headers=headers)
@@ -103,10 +157,13 @@ class ServeClient:
         return doc
 
     def submit(self, sweep_doc: dict, tenant: str = "default",
-               backend_faults: list | None = None) -> dict:
+               backend_faults: list | None = None,
+               origin: str | None = None) -> dict:
         payload: dict = {"sweep": sweep_doc, "tenant": tenant}
         if backend_faults:
             payload["backend_faults"] = backend_faults
+        if origin is not None:
+            payload["origin"] = origin
         status, doc = self.request("POST", "/v1/sweeps", payload)
         if status == 429:
             raise Shed(doc)
@@ -124,8 +181,36 @@ class ServeClient:
 
     def sweep(self, sid: str) -> dict:
         status, doc = self.request("GET", f"/v1/sweeps/{sid}")
+        if status != 200:
+            raise ServeClientError(
+                doc.get("error", f"sweep {sid}: HTTP {status}")
+            )
+        return doc
+
+    def journal(self) -> dict:
+        """The daemon's journal mirror: {"records": [...],
+        "torn_tail_dropped": bool}. The federation router pulls this on
+        every probe so it can replay a lost peer even when that peer's
+        state-dir died with its box."""
+        status, doc = self.request("GET", "/v1/journal")
+        if status != 200:
+            raise ServeClientError(f"/v1/journal returned {status}")
+        return doc
+
+    def release(self, sid: str, to_peer: str) -> dict:
+        """Ask the daemon to hand queued sweep `sid` to `to_peer` (work
+        stealing). Returns the released sweep document on success;
+        raises Shed on 409 (the sweep already started — running work is
+        never stolen) and ServeClientError on 404."""
+        status, doc = self.request(
+            "POST", f"/v1/sweeps/{sid}/release", {"to_peer": to_peer}
+        )
         if status == 404:
             raise ServeClientError(doc.get("error", f"no sweep {sid}"))
+        if status == 409:
+            raise Shed({"shed": "busy", "retry_after_s": 1, **doc})
+        if status != 200:
+            raise ServeClientError(f"release {sid} returned {status}")
         return doc
 
     def drain(self) -> dict:

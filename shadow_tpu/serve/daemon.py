@@ -115,6 +115,7 @@ class ShadowDaemon:
             "jobs_completed": 0,
             "sheds": 0,
             "memory_sheds": 0,
+            "sweeps_handed_off": 0,
             "pressure_records": 0,
             "balance_records": 0,
             "journal_replays": 0,
@@ -137,6 +138,10 @@ class ShadowDaemon:
         # the surviving-chip admission budget scale
         self._last_mesh: dict = {}
         self._last_async: dict = {}
+        # the running fleet's lane-steal posture lifted for the router
+        # (fleet/scheduler.steal_export): queued predicted load is the
+        # cross-daemon steal ordering signal (serve/federation.py)
+        self._last_steal: dict = {}
         self._journaled_balance: dict[str, int] = {}
         # replay: fold the journal into scheduler-plane truth
         st = self.journal.state()
@@ -166,9 +171,20 @@ class ShadowDaemon:
 
     def retry_after_s(self) -> int:
         """Backpressure hint: how long until a queue slot likely frees —
-        queue depth (sweeps ahead) x the EWMA completed-sweep wall."""
+        queue depth (sweeps ahead) x the EWMA completed-sweep wall.
+        Zero when the daemon is idle: an empty queue has no wait, and
+        the federation router's placement score must see an idle peer
+        as immediately available, not penalized by its sweep-wall EWMA."""
         depth = len(self._queue) + (1 if self._running else 0)
+        if depth == 0:
+            return 0
         return max(1, int(round(depth * self._avg_sweep_wall_s)))
+
+    def _shed_retry_after_s(self) -> int:
+        """Retry hint for SHED responses: never 0 — a rejected client
+        told to retry in 0 s would hot-spin against the same refusal.
+        Only /healthz reports the raw 0-at-idle value, for placement."""
+        return max(1, self.retry_after_s())
 
     def _effective_budget(self):
         """The admission memory budget, scaled to the SURVIVING mesh
@@ -213,23 +229,38 @@ class ShadowDaemon:
         return pressure_mod.estimate_config_bytes(cfg, lanes=L)
 
     def submit(self, doc: dict, tenant: str = "default",
-               backend_faults: list | None = None) -> dict:
+               backend_faults: list | None = None,
+               origin: str | None = None) -> dict:
         """Validate + journal + enqueue one sweep. Raises ServeError
         (HTTP 400) on a bad document; returns {"shed": ...} (HTTP 429)
-        when admission refuses it."""
+        when admission refuses it.
+
+        `origin` is the federation handoff marker (serve/federation.py):
+        a sweep re-placed here after a steal or peer-loss failover
+        carries its origin handle, journaled with the SUBMIT record, so
+        the router's crash recovery can prove the handoff landed instead
+        of re-submitting it (the no-duplicate half of the steal
+        contract). A sweep with an origin already present in this
+        journal is refused as a duplicate."""
         from shadow_tpu.fleet import SweepError, load_sweep
 
         with self._lock:
             if self._draining.is_set():
                 self.counters["sheds"] += 1
                 return {"shed": "draining", "retry_after_s": 30}
+            if origin is not None:
+                for s in self.sweeps.values():
+                    if s.get("origin") == origin:
+                        # handoff replayed by the router's crash
+                        # recovery: the first landing is the claim
+                        return {"id": s["id"], "duplicate": True}
             depth = len(self._queue) + (1 if self._running else 0)
             if depth >= self.opts.max_queue_depth:
                 self.counters["sheds"] += 1
                 return {
                     "shed": "queue_full",
                     "queue_depth": depth,
-                    "retry_after_s": self.retry_after_s(),
+                    "retry_after_s": self._shed_retry_after_s(),
                 }
             quota = self.opts.tenant_quotas.get(
                 tenant, self.opts.default_quota
@@ -239,7 +270,7 @@ class ShadowDaemon:
                 return {
                     "shed": "tenant_quota",
                     "quota": quota,
-                    "retry_after_s": self.retry_after_s(),
+                    "retry_after_s": self._shed_retry_after_s(),
                 }
         # expansion/validation is pure host work: do it OUTSIDE the lock
         # (a slow config build must not block /healthz), and fail the
@@ -289,18 +320,20 @@ class ShadowDaemon:
                     "headroom_bytes": int(
                         budget - self._running_est_bytes
                     ),
-                    "retry_after_s": self.retry_after_s(),
+                    "retry_after_s": self._shed_retry_after_s(),
                 }
             sid = f"s{self._seq:06d}"
             self._seq += 1
+            extra = {"origin": origin} if origin is not None else {}
             self.journal.append(
                 journal_mod.SUBMIT, id=sid, tenant=tenant, doc=doc,
-                backend_faults=backend_faults or [],
+                backend_faults=backend_faults or [], **extra,
             )
             self.sweeps[sid] = {
                 "id": sid, "tenant": tenant, "doc": doc,
                 "status": "queued", "ckpt_dir": None, "results": None,
                 "admits": 0, "backend_faults": backend_faults or [],
+                **extra,
             }
             self._order.append(sid)
             self._queue.append(sid)
@@ -309,9 +342,50 @@ class ShadowDaemon:
             return {"id": sid, "jobs": len(jobs),
                     "queue_position": len(self._queue) - 1}
 
+    def release_sweep(self, sid: str, to_peer: str) -> dict | None:
+        """Hand a QUEUED sweep to another federation member (the router's
+        work-steal / rebalance pull, serve/federation.py). The HANDOFF
+        record is journaled BEFORE the sweep leaves the queue — the
+        torn-tail discipline of PR 8 applied to stealing: a crash after
+        this append can never run the sweep here again (replay folds
+        `handed_off`, which `unfinished()` skips), and a crash BEFORE it
+        leaves nothing for the receiver to duplicate. Returns the full
+        journaled document (the receiver re-submits it under its own
+        journal); None when the sweep is unknown, and a `busy` marker
+        when it is not queued (running/settled sweeps are never stolen —
+        their checkpoints live in THIS daemon's state-dir)."""
+        with self._lock:
+            s = self.sweeps.get(sid)
+            if s is None:
+                return None
+            if s["status"] != "queued" or sid not in self._queue:
+                return {"busy": s["status"]}
+            self.journal.append(
+                journal_mod.HANDOFF, id=sid, to_peer=str(to_peer),
+            )
+            self._queue.remove(sid)
+            s["status"] = "handed_off"
+            s["handoff_to"] = str(to_peer)
+            self.counters["sweeps_handed_off"] += 1
+            return {
+                "id": sid, "tenant": s["tenant"], "doc": s["doc"],
+                "backend_faults": s.get("backend_faults") or [],
+            }
+
     # ------------------------------------------------------------------
     # introspection (HTTP thread)
     # ------------------------------------------------------------------
+
+    def journal_doc(self) -> dict:
+        """The journal as JSON (GET /v1/journal): the peer-to-peer
+        journal copy the federation router mirrors on every probe, so a
+        peer whose state-dir becomes unreadable with the box can still
+        be replayed from the router's last mirror."""
+        with self._lock:
+            return {
+                "records": self.journal.records,
+                "torn_tail_dropped": self.journal.torn_tail_dropped,
+            }
 
     def health(self) -> dict:
         from shadow_tpu.core.supervisor import probe_backend
@@ -346,6 +420,7 @@ class ShadowDaemon:
                 "balance": dict(self._last_balance),
                 "async": dict(self._last_async),
                 "mesh": dict(self._last_mesh),
+                "steal": dict(self._last_steal),
                 "retry_after_s": self.retry_after_s(),
             }
 
@@ -513,6 +588,7 @@ class ShadowDaemon:
             }
             self._last_async = fleet.async_posture()
             self._last_mesh = fleet.mesh_posture()
+            self._last_steal = fleet.sched.steal_export()
             # journal each new batch of ladder rungs: a post-mortem can
             # see WHEN the sweep started degrading even if we die next
             steps = int(pst.get("ladder_steps", 0))
@@ -703,6 +779,8 @@ class ShadowDaemon:
                     return self._reply(200, daemon.metrics_doc())
                 if self.path == "/v1/sweeps":
                     return self._reply(200, {"sweeps": daemon.sweep_list()})
+                if self.path == "/v1/journal":
+                    return self._reply(200, daemon.journal_doc())
                 if self.path.startswith("/v1/sweeps/"):
                     sid = self.path.rsplit("/", 1)[-1]
                     info = daemon.sweep_info(sid)
@@ -724,6 +802,20 @@ class ShadowDaemon:
                 if self.path == "/v1/drain":
                     daemon.drain()
                     return self._reply(200, {"draining": True})
+                if (self.path.startswith("/v1/sweeps/")
+                        and self.path.endswith("/release")):
+                    sid = self.path.rsplit("/", 2)[-2]
+                    out = daemon.release_sweep(
+                        sid, to_peer=str(payload.get("to_peer", "?"))
+                    )
+                    if out is None:
+                        return self._reply(
+                            404, {"error": f"no sweep {sid!r}"}
+                        )
+                    if "busy" in out:
+                        # running/settled sweeps never leave their box
+                        return self._reply(409, out)
+                    return self._reply(200, out)
                 if self.path == "/v1/sweeps":
                     doc = payload.get("sweep")
                     if not isinstance(doc, dict):
@@ -732,10 +824,14 @@ class ShadowDaemon:
                             {"error": "payload needs a `sweep` document"},
                         )
                     try:
+                        origin = payload.get("origin")
                         out = daemon.submit(
                             doc,
                             tenant=str(payload.get("tenant", "default")),
                             backend_faults=payload.get("backend_faults"),
+                            origin=(
+                                str(origin) if origin is not None else None
+                            ),
                         )
                     except ServeError as e:
                         return self._reply(400, {"error": str(e)})

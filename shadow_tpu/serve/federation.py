@@ -1,0 +1,686 @@
+"""Federated serve plane: N daemons behind one placement brain.
+
+A single serve daemon already treats its own death as a non-event
+(journal replay, serve/journal.py). This module scales that contract
+horizontally: several daemons — each with its own `--state-dir`,
+sharing the content-addressed machine-fingerprinted kcache root — are
+registered in a peer table and fronted by a thin router
+(serve/router.py, `python -m shadow_tpu route --peers ...`). Losing a
+box is then a journal replay, not an outage:
+
+* **Placement.** Incoming sweeps go to the peer with the best
+  `placement_score` — queue depth x mesh posture (chips_total /
+  chips_up) x memory headroom, all read off the fields every daemon
+  already publishes on `/healthz`. A tenant sticks to its last peer
+  (warm AOT kernels, colocated checkpoints) while that peer stays
+  healthy and within ~2x of the best score.
+
+* **Probing.** Each peer carries a `ProbeLadder`
+  (core/supervisor.py): HEALTHY -> SUSPECT on a missed probe ->
+  LOST after `lost_after` consecutive misses, with jittered
+  exponential backoff between retries — the BackendSupervisor
+  bounded-retry classification idiom applied to peer liveness. Every
+  successful probe also mirrors the peer's journal (`GET
+  /v1/journal`), so a peer whose state-dir dies WITH its box can
+  still be replayed from the router's last mirror.
+
+* **Failover.** A LOST peer's journal (live `journal.wal` preferred,
+  mirror as fallback) is folded with `JournalState` and every
+  unfinished sweep is re-placed onto surviving peers, who finish them
+  from scratch or from their drain checkpoints with audit chains
+  bit-identical to an uninterrupted run (the shared kcache means warm
+  peers re-dispatch without a single kernel recompile).
+
+* **Stealing.** An idle peer pulls queued work from a loaded one
+  through the router. The handoff is journaled at every step — the
+  source daemon appends HANDOFF before the sweep leaves its queue,
+  the router appends its own HANDOFF intent before asking, and the
+  receiver journals the sweep's `origin` handle with its SUBMIT — so
+  a crash at ANY point mid-steal never duplicates or drops a sweep
+  (`recover_handoffs` proves each intent landed exactly once). Same
+  torn-tail discipline as the single-daemon WAL.
+
+Lock discipline (analysis/threads.py, STH001-004): `_lock` guards the
+peer table, placements, affinity and counters; network I/O (probes,
+submits, releases) ALWAYS happens outside the lock — decide under the
+lock, act outside it, fold results back under it.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from shadow_tpu.core.supervisor import (
+    PEER_HEALTHY,
+    PEER_LOST,
+    PEER_SUSPECT,
+    ProbeLadder,
+)
+from shadow_tpu.serve import journal as journal_mod
+from shadow_tpu.serve.client import ServeClient, ServeClientError
+
+# a tenant's affine peer keeps winning until it is this much worse than
+# the best-scoring peer (warm kernels + colocated checkpoints are worth
+# a bounded amount of queueing, not an unbounded pile-up)
+AFFINITY_SLACK = 2.0
+# steal trigger: an idle peer (depth 0, nothing running) pulls from a
+# peer with at least this many queued sweeps
+STEAL_MIN_DEPTH = 2
+
+
+class FederationError(RuntimeError):
+    pass
+
+
+def parse_peer_spec(spec: str) -> tuple[str, str]:
+    """`NAME=STATE_DIR` or bare `STATE_DIR` (name = directory basename).
+    Returns (name, state_dir). Names join sweep handles as
+    `name:sid`, so ':' and '=' are refused."""
+    if "=" in spec:
+        name, state_dir = spec.split("=", 1)
+    else:
+        state_dir = spec
+        name = os.path.basename(os.path.abspath(spec))
+    name = name.strip()
+    if not name or ":" in name or "=" in name:
+        raise FederationError(f"bad peer name in spec {spec!r}")
+    if not state_dir:
+        raise FederationError(f"bad state dir in spec {spec!r}")
+    return name, os.path.abspath(state_dir)
+
+
+def split_handle(handle: str) -> tuple[str, str]:
+    """A federation sweep handle is `peer:sid` — each daemon numbers
+    sweeps independently, so the bare sid is ambiguous across peers."""
+    if ":" not in handle:
+        raise FederationError(f"bad sweep handle {handle!r} (want peer:sid)")
+    peer, sid = handle.split(":", 1)
+    return peer, sid
+
+
+def placement_score(health: dict) -> float:
+    """Lower is better. Queue wait (the daemon's own `retry_after_s`
+    estimate + raw depth) scaled by mesh degradation (a 7-of-8-chip
+    peer runs ~8/7 slower, and admission already shrank its memory
+    budget to match), plus a hard penalty when memory headroom is
+    exhausted (its next admission would shed anyway)."""
+    queue = health.get("queue") or {}
+    depth = int(queue.get("depth", 0)) + (1 if queue.get("running") else 0)
+    wait_s = float(health.get("retry_after_s", 0) or 0)
+    mesh = health.get("mesh") or {}
+    chips_up = int(mesh.get("chips_up", 0) or 0)
+    chips_total = int(mesh.get("chips_total", 0) or 0)
+    if chips_total > 0 and chips_up <= 0:
+        return float("inf")  # a meshless peer cannot run anything
+    factor = (chips_total / chips_up) if chips_total > 0 else 1.0
+    score = (depth + wait_s) * factor
+    memory = health.get("memory") or {}
+    headroom = memory.get("headroom_bytes")
+    if headroom is not None and int(headroom) <= 0:
+        score += 1000.0
+    if health.get("draining"):
+        score = float("inf")
+    return score
+
+
+class Peer:
+    """One federation member. Mutable fields are guarded by the owning
+    Federation's `_lock`; the ServeClient is only used OUTSIDE it."""
+
+    def __init__(self, name: str, state_dir: str, *,
+                 lost_after: int = 3, seed: int = 0,
+                 client_factory=None):
+        self.name = name
+        self.state_dir = state_dir
+        self.socket_path = os.path.join(state_dir, "serve.sock")
+        factory = client_factory or (
+            lambda path: ServeClient(path, timeout=30.0)
+        )
+        self.client = factory(self.socket_path)
+        self.ladder = ProbeLadder(lost_after=lost_after, seed=seed)
+        self.health: dict = {}
+        self.journal_mirror: list[dict] = []
+        self.next_probe_at = 0.0  # monotonic; 0 = probe immediately
+        self.lost_handled = False
+
+    def journal_records(self) -> list[dict]:
+        """The LOST peer's journal: prefer the live `journal.wal` in its
+        state-dir (survives daemon death on a shared filesystem), fall
+        back to the router's last probe-time mirror (survives the box)."""
+        path = os.path.join(self.state_dir, "journal.wal")
+        if os.path.exists(path):
+            try:
+                return journal_mod.scan(path)["records"]
+            except journal_mod.JournalError:
+                pass  # unreadable with the box: use the mirror
+        return list(self.journal_mirror)
+
+
+class Federation:
+    """Peer table + placement + probe ladder + failover/steal logic.
+
+    The router process (serve/router.py) owns the HTTP surface and the
+    probe cadence; everything stateful lives here so tests can drive
+    loss, failover and crash-mid-steal recovery in-process.
+
+    Single-writer journal discipline: the router journal is appended
+    only from the supervising thread (`probe_once` -> `fail_over`,
+    `steal_once`, and `__init__`) — HTTP threads call `place`/`locate`/
+    introspection, which never append — so the router journal needs no
+    lock of its own."""
+
+    def __init__(self, peer_specs: list[str], journal: journal_mod.Journal,
+                 *, lost_after: int = 3, probe_interval_s: float = 1.0,
+                 seed: int = 0, client_factory=None, now=None):
+        import time as _time
+
+        self._now = now or _time.monotonic
+        self._lock = threading.Lock()
+        self.journal = journal
+        self.probe_interval_s = float(probe_interval_s)
+        self.peers: dict[str, Peer] = {}
+        self.counters: dict[str, int] = {
+            "placements": 0,
+            "steals": 0,
+            "failovers": 0,
+            "replayed_sweeps": 0,
+            "probes": 0,
+            "peers_lost": 0,
+            "handoff_recoveries": 0,
+        }
+        # handle -> {"peer": name, "sid": sid, "tenant": tenant}; after
+        # a failover the ORIGINAL handle stays stable and remaps here
+        self.placements: dict[str, dict] = {}
+        self.affinity: dict[str, str] = {}  # tenant -> peer name
+        already = {
+            rec.get("name") for rec in journal.records
+            if rec["type"] == journal_mod.REGISTER
+        }
+        for i, spec in enumerate(peer_specs):
+            name, state_dir = parse_peer_spec(spec)
+            if name in self.peers:
+                raise FederationError(f"duplicate peer name {name!r}")
+            self.peers[name] = Peer(
+                name, state_dir, lost_after=lost_after, seed=seed + i,
+                client_factory=client_factory,
+            )
+            if name not in already:
+                journal.append(
+                    journal_mod.REGISTER, name=name, state_dir=state_dir,
+                    socket=self.peers[name].socket_path,
+                )
+        if not self.peers:
+            raise FederationError("a federation needs at least one peer")
+
+    # ------------------------------------------------------------------
+    # probing (router probe thread)
+    # ------------------------------------------------------------------
+
+    def probe_once(self) -> list[str]:
+        """One probe round: hit every due peer's /healthz (+ journal
+        mirror), fold the results through each ProbeLadder, then run
+        failover for any peer that just crossed into LOST. Returns the
+        names of peers declared lost this round."""
+        now = self._now()
+        with self._lock:
+            due = [p for p in self.peers.values() if now >= p.next_probe_at]
+        results: list[tuple[Peer, dict | None, dict | None]] = []
+        for p in due:  # network I/O: outside the lock
+            try:
+                health = p.client.health()
+                mirror = p.client.journal()
+            except ServeClientError:
+                results.append((p, None, None))
+            else:
+                results.append((p, health, mirror))
+        newly_lost: list[Peer] = []
+        resurrected: list[Peer] = []
+        with self._lock:
+            for p, health, mirror in results:
+                self.counters["probes"] += 1
+                before = p.ladder.state
+                state = p.ladder.record(health is not None)
+                if health is not None:
+                    p.health = health
+                    p.journal_mirror = mirror.get("records", [])
+                    p.next_probe_at = self._now() + self.probe_interval_s
+                    if before == PEER_LOST:
+                        resurrected.append(p)
+                    p.lost_handled = False
+                else:
+                    p.next_probe_at = self._now() + p.ladder.backoff_s()
+                if state == PEER_LOST and before != PEER_LOST:
+                    self.counters["peers_lost"] += 1
+                if (state == PEER_LOST and not p.lost_handled):
+                    p.lost_handled = True
+                    newly_lost.append(p)
+        for p in newly_lost:  # replay + re-place: outside the lock
+            self.fail_over(p.name)
+        for p in resurrected:
+            self._reconcile_resurrected(p)
+        return [p.name for p in newly_lost]
+
+    def _reconcile_resurrected(self, peer: Peer) -> None:
+        """A peer declared LOST — and failed over — has come back. Its
+        own journal replay is about to re-run sweeps the federation
+        already moved, so release every such still-queued sweep on the
+        returned peer (journaling handed_off there). A sweep its replay
+        already re-admitted races through (release answers 409 busy);
+        the placement map keeps routing reads to the failover copy, so
+        the duplicate compute is wasted but never observed — and with
+        deterministic fleets both copies produce bit-identical chains."""
+        with self._lock:
+            stale = [
+                (split_handle(h)[1], placed["peer"])
+                for h, placed in self.placements.items()
+                if split_handle(h)[0] == peer.name
+                and placed["peer"] != peer.name
+            ]
+        for sid, holder in stale:  # network I/O: outside the lock
+            try:
+                peer.client.release(sid, to_peer=holder)
+            except ServeClientError:
+                pass  # 409 busy / 404 / unreachable: routing unaffected
+
+    # ------------------------------------------------------------------
+    # placement (router HTTP threads)
+    # ------------------------------------------------------------------
+
+    def _pick_peer(self, tenant: str,
+                   exclude: set[str] = frozenset()) -> Peer | None:
+        """Call under `_lock`. Best non-excluded live peer by
+        placement_score, with sticky tenant affinity within
+        AFFINITY_SLACK. None when no candidate can take work."""
+        scored = [
+            (placement_score(p.health), p.name, p)
+            for p in self.peers.values()
+            if p.ladder.state != PEER_LOST and p.name not in exclude
+        ]
+        scored = [(s, n, p) for s, n, p in scored if s != float("inf")]
+        if not scored:
+            return None
+        scored.sort(key=lambda t: (t[0], t[1]))
+        best_score, _, best = scored[0]
+        affine = self.affinity.get(tenant)
+        if affine is not None:
+            for s, n, p in scored:
+                if n == affine and s <= best_score * AFFINITY_SLACK + 1.0:
+                    return p
+        return best
+
+    def place(self, doc: dict, tenant: str = "default",
+              backend_faults: list | None = None) -> dict:
+        """Place one sweep: pick under the lock, submit outside it,
+        record the placement under it. A peer that refuses (shed) or
+        drops mid-submit is skipped and the next-best peer tried; the
+        last shed body is surfaced when every peer sheds."""
+        tried: set[str] = set()
+        last_shed: dict | None = None
+        while True:
+            with self._lock:
+                peer = self._pick_peer(tenant, exclude=tried)
+            if peer is None:
+                break
+            tried.add(peer.name)
+            try:
+                out = peer.client.submit(
+                    doc, tenant=tenant, backend_faults=backend_faults
+                )
+            except ServeClientError:
+                continue  # probe ladder will catch up; try the next peer
+            if "shed" in out:
+                last_shed = out
+                continue
+            handle = f"{peer.name}:{out['id']}"
+            with self._lock:
+                self.placements[handle] = {
+                    "peer": peer.name, "sid": out["id"], "tenant": tenant,
+                }
+                self.affinity[tenant] = peer.name
+                self.counters["placements"] += 1
+            return {**out, "id": handle, "peer": peer.name}
+        if last_shed is not None:
+            return last_shed
+        raise FederationError("no live peer can accept work")
+
+    def locate(self, handle: str) -> tuple[Peer, str]:
+        """Resolve a (possibly failed-over) handle to (peer, local sid)."""
+        with self._lock:
+            placed = self.placements.get(handle)
+            if placed is not None:
+                peer = self.peers.get(placed["peer"])
+                if peer is None:
+                    raise FederationError(
+                        f"handle {handle!r} placed on unknown peer"
+                    )
+                return peer, placed["sid"]
+            name, sid = split_handle(handle)
+            peer = self.peers.get(name)
+            if peer is None:
+                raise FederationError(f"unknown peer in handle {handle!r}")
+            return peer, sid
+
+    # ------------------------------------------------------------------
+    # failover (probe thread) + stealing (router rebalance tick)
+    # ------------------------------------------------------------------
+
+    def fail_over(self, name: str) -> list[str]:
+        """Replay a LOST peer's journal and re-place every unfinished
+        sweep onto surviving peers. Handoff intents are journaled before
+        each re-place, and re-places carry the original handle as their
+        `origin`, so a router crash mid-failover resumes exactly where
+        it stopped (recover_handoffs) without duplicating a sweep.
+        Returns the re-placed handles."""
+        with self._lock:
+            peer = self.peers.get(name)
+            if peer is None:
+                raise FederationError(f"unknown peer {name!r}")
+        records = peer.journal_records()  # filesystem I/O: outside lock
+        st = journal_mod.JournalState(records)
+        unfinished = st.unfinished()
+        if unfinished:
+            with self._lock:
+                self.counters["failovers"] += 1
+        moved: list[str] = []
+        for s in unfinished:
+            handle = f"{name}:{s['id']}"
+            if self._handoff_landed(handle):
+                continue  # an earlier incarnation already moved it
+            self.journal.append(
+                journal_mod.HANDOFF, id=handle, from_peer=name,
+                to_peer="*failover*",
+            )
+            placed = self._replace_sweep(handle, s)
+            if placed:
+                moved.append(handle)
+        return moved
+
+    def _replace_sweep(self, handle: str, s: dict) -> bool:
+        """Submit a replayed sweep to the best surviving peer, origin
+        marker attached. Updates the placement map so the ORIGINAL
+        handle keeps resolving. Returns False when no live peer took it
+        (the next probe round retries via recover_handoffs)."""
+        tenant = s.get("tenant", "default")
+        # the handle's source is NOT pre-excluded: a LOST source is
+        # already masked by its ladder state, and a live source (steal
+        # whose receiver shed) may legitimately re-take the sweep under
+        # a fresh sid — its old sid is journaled handed_off
+        tried: set[str] = set()
+        while True:
+            with self._lock:
+                peer = self._pick_peer(tenant, exclude=tried)
+            if peer is None:
+                return False
+            tried.add(peer.name)
+            try:
+                out = peer.client.submit(
+                    s["doc"], tenant=tenant,
+                    backend_faults=s.get("backend_faults") or None,
+                    origin=handle,
+                )
+            except ServeClientError:
+                continue
+            if "shed" in out:
+                continue
+            with self._lock:
+                self.placements[handle] = {
+                    "peer": peer.name, "sid": out["id"], "tenant": tenant,
+                }
+                self.affinity[tenant] = peer.name
+                self.counters["replayed_sweeps"] += 1
+            return True
+
+    def steal_once(self) -> dict | None:
+        """One rebalance tick: if some peer sits idle while another has
+        ≥ STEAL_MIN_DEPTH queued sweeps, pull the newest queued sweep
+        across. Fully journaled: router HANDOFF intent first, then the
+        source's own HANDOFF (release), then the receiver's SUBMIT with
+        the origin marker — crash anywhere and recover_handoffs settles
+        it. Returns {"id", "from", "to"} or None when balanced."""
+        with self._lock:
+            healthy = [
+                p for p in self.peers.values()
+                if p.ladder.state == PEER_HEALTHY and p.health
+            ]
+            idle = [
+                p for p in healthy
+                if int((p.health.get("queue") or {}).get("depth", 0)) == 0
+                and not (p.health.get("queue") or {}).get("running")
+                and not p.health.get("draining")
+            ]
+            loaded = [
+                p for p in healthy
+                if int((p.health.get("queue") or {}).get("depth", 0))
+                >= STEAL_MIN_DEPTH
+            ]
+            if not idle or not loaded:
+                return None
+            # steal from the peer with the most predicted queued work
+            # (fleet/scheduler.steal_export lifted onto /healthz) — the
+            # LPT logic FleetScheduler.pick applies to lanes, applied
+            # across daemons
+            loaded.sort(
+                key=lambda p: (
+                    -float(
+                        (p.health.get("steal") or {})
+                        .get("queued_predicted_load", 0.0)
+                    ),
+                    -int((p.health.get("queue") or {}).get("depth", 0)),
+                    p.name,
+                ),
+            )
+            src, dst = loaded[0], idle[0]
+        # which sweep? the NEWEST queued one: the head of the queue is
+        # about to start on the loaded peer anyway (sticky cache worth
+        # keeping); the tail has the longest wait and loses nothing
+        try:
+            queued = [
+                s for s in src.client.sweeps() if s["status"] == "queued"
+            ]
+        except ServeClientError:
+            return None
+        if len(queued) < STEAL_MIN_DEPTH:
+            return None  # raced a drain/admit; next tick re-evaluates
+        sid = queued[-1]["id"]
+        handle = f"{src.name}:{sid}"
+        self.journal.append(
+            journal_mod.HANDOFF, id=handle, from_peer=src.name,
+            to_peer=dst.name,
+        )
+        try:
+            released = src.client.release(sid, to_peer=dst.name)
+        except ServeClientError:
+            # 409/404/unreachable: nothing left the source queue, so the
+            # journaled intent is a no-op (recover_handoffs verifies the
+            # source journal and finds no handed_off record)
+            return None
+        out = dst.client.submit(
+            released["doc"], tenant=released.get("tenant", "default"),
+            backend_faults=released.get("backend_faults") or None,
+            origin=handle,
+        )
+        if "shed" in out:
+            # receiver refused AFTER the source released: recover NOW by
+            # re-placing anywhere (the journaled intent + origin marker
+            # keep this idempotent)
+            self._replace_sweep(handle, released)
+            with self._lock:
+                self.counters["steals"] += 1
+            return {"id": handle, "from": src.name, "to": "*recovered*"}
+        with self._lock:
+            self.placements[handle] = {
+                "peer": dst.name, "sid": out["id"],
+                "tenant": released.get("tenant", "default"),
+            }
+            self.counters["steals"] += 1
+        return {"id": handle, "from": src.name, "to": dst.name}
+
+    # ------------------------------------------------------------------
+    # crash recovery (router startup)
+    # ------------------------------------------------------------------
+
+    def _handoff_landed(self, handle: str) -> bool:
+        """Did any live peer journal a SUBMIT with this origin? Probes
+        each peer's journal over the wire (outside `_lock`)."""
+        with self._lock:
+            peers = list(self.peers.values())
+        for p in peers:
+            try:
+                mirror = p.client.journal()
+            except ServeClientError:
+                records = p.journal_records()
+            else:
+                records = mirror.get("records", [])
+            for rec in records:
+                if (rec["type"] == journal_mod.SUBMIT
+                        and rec.get("origin") == handle):
+                    with self._lock:
+                        self.placements[handle] = {
+                            "peer": p.name, "sid": rec["id"],
+                            "tenant": rec.get("tenant", "default"),
+                        }
+                    return True
+        return False
+
+    def recover_handoffs(self) -> list[str]:
+        """Settle every journaled HANDOFF intent after a router restart:
+        for each intent, either the receiver journaled the origin-marked
+        SUBMIT (done — rebuild the placement map entry), or the source
+        shows `handed_off` with no receiver claim (crash mid-steal: the
+        doc still rides the source journal, re-place it), or the source
+        never released (the intent was a no-op). Never duplicates —
+        `_handoff_landed` checks before every re-place and receivers
+        refuse duplicate origins — and never drops, because the doc is
+        always recoverable from the source's SUBMIT record. Returns the
+        handles that needed re-placement."""
+        intents = [
+            rec for rec in self.journal.records
+            if rec["type"] == journal_mod.HANDOFF
+        ]
+        recovered: list[str] = []
+        for rec in intents:
+            handle = rec["id"]
+            if self._handoff_landed(handle):
+                continue
+            src_name, sid = split_handle(handle)
+            with self._lock:
+                src = self.peers.get(src_name)
+            if src is None:
+                continue
+            st = journal_mod.JournalState(src.journal_records())
+            s = st.sweeps.get(sid)
+            if s is None or s["status"] != "handed_off":
+                continue  # release never happened: intent was a no-op
+            if self._replace_sweep(handle, s):
+                with self._lock:
+                    self.counters["handoff_recoveries"] += 1
+                recovered.append(handle)
+        return recovered
+
+    # ------------------------------------------------------------------
+    # introspection
+    # ------------------------------------------------------------------
+
+    def mirror_sweep_info(self, peer: Peer, sid: str) -> dict | None:
+        """Fold a dead (or unreachable) peer's journal and serve the
+        sweep's last durable state from it: a sweep that COMPLETED on a
+        lost box still answers with its results and audit chains,
+        because they ride the COMPLETE record the router mirrored."""
+        st = journal_mod.JournalState(peer.journal_records())
+        s = st.sweeps.get(sid)
+        if s is None:
+            return None
+        info = {k: v for k, v in s.items() if k != "doc"}
+        info["from_mirror"] = True
+        return info
+
+    def peers_up(self) -> int:
+        with self._lock:
+            return sum(
+                1 for p in self.peers.values()
+                if p.ladder.state == PEER_HEALTHY
+            )
+
+    def placements_list(self) -> list[dict]:
+        """The placement table (GET /v1/sweeps on the router): every
+        handle with the peer + local sid it currently resolves to."""
+        with self._lock:
+            return [
+                {"id": h, **placed}
+                for h, placed in sorted(self.placements.items())
+            ]
+
+    def status_rows(self) -> list[dict]:
+        """One row per peer (shadowctl status --peers)."""
+        with self._lock:
+            rows = []
+            for name in sorted(self.peers):
+                p = self.peers[name]
+                q = p.health.get("queue") or {}
+                rows.append({
+                    "peer": name,
+                    "state": p.ladder.state,
+                    "ok": bool(p.health.get("ok")),
+                    "depth": int(q.get("depth", 0)),
+                    "running": q.get("running"),
+                    "retry_after_s": p.health.get("retry_after_s"),
+                    "socket": p.socket_path,
+                })
+            return rows
+
+    def health_doc(self) -> dict:
+        with self._lock:
+            states = {
+                n: p.ladder.state for n, p in self.peers.items()
+            }
+            up = sum(1 for s in states.values() if s == PEER_HEALTHY)
+            suspect = sum(1 for s in states.values() if s == PEER_SUSPECT)
+            depths = [
+                int((p.health.get("queue") or {}).get("depth", 0))
+                for p in self.peers.values()
+                if p.ladder.state != PEER_LOST
+            ]
+            return {
+                "ok": up > 0,
+                "peers_total": len(self.peers),
+                "peers_up": up,
+                "peers_suspect": suspect,
+                "peers_lost": sum(
+                    1 for s in states.values() if s == PEER_LOST
+                ),
+                "peers": states,
+                "placements": len(self.placements),
+                "queue_depth_max": max(depths) if depths else 0,
+                "queue_depth_min": min(depths) if depths else 0,
+                "counters": dict(self.counters),
+            }
+
+    def metrics_doc(self) -> dict:
+        """Schema-v16 `federation.*` metrics (obs/metrics.py): counters
+        for placements / steals / failovers / replayed sweeps, gauges
+        for fleet membership and the queue-depth spread the stealer is
+        trying to flatten."""
+        from shadow_tpu.obs import metrics as obs_metrics
+
+        reg = obs_metrics.MetricsRegistry()
+        h = self.health_doc()
+        reg.counter_set("federation.placements", h["counters"]["placements"])
+        reg.counter_set("federation.steals", h["counters"]["steals"])
+        reg.counter_set("federation.failovers", h["counters"]["failovers"])
+        reg.counter_set(
+            "federation.replayed_sweeps", h["counters"]["replayed_sweeps"]
+        )
+        reg.counter_set("federation.probes", h["counters"]["probes"])
+        reg.counter_set("federation.peers_lost", h["counters"]["peers_lost"])
+        reg.counter_set(
+            "federation.handoff_recoveries",
+            h["counters"]["handoff_recoveries"],
+        )
+        reg.gauge_set("federation.peers_total", h["peers_total"])
+        reg.gauge_set("federation.peers_up", h["peers_up"])
+        reg.gauge_set("federation.peers_suspect", h["peers_suspect"])
+        reg.gauge_set("federation.placements_tracked", h["placements"])
+        reg.gauge_set("federation.queue_depth_max", h["queue_depth_max"])
+        reg.gauge_set("federation.queue_depth_min", h["queue_depth_min"])
+        return reg.to_doc()

@@ -25,6 +25,16 @@ scheduler-plane transition is journaled BEFORE it takes effect:
              healing a hot shard — and whether a migration rolled back
     COMPLETE the sweep finished; per-job results (including each job's
              `audit.chain` digest) ride the record
+    HANDOFF  a queued sweep left this journal's owner for another
+             federation member (serve/federation.py work stealing or
+             peer-loss failover). Appended BEFORE the sweep is handed
+             over, so a crash mid-steal can never run the sweep here
+             AND on the receiving peer: replay sees the HANDOFF and
+             does not requeue it — the receiver's own SUBMIT record is
+             the single surviving claim
+    REGISTER a federation member joined the router's peer table
+             (informational: carries the peer name, socket and
+             state-dir; never a sweep transition)
 
 Framing: append-only binary records, each `!II` (payload length, CRC32)
 followed by the JSON payload, fsync'd per append. A SIGKILL mid-append
@@ -58,9 +68,12 @@ REQUEUE = "requeue"
 PRESSURE = "pressure"
 BALANCE = "balance"
 COMPLETE = "complete"
+HANDOFF = "handoff"
+REGISTER = "register"
 
 RECORD_TYPES = (
-    SUBMIT, ADMIT, DRAIN, REQUEUE, PRESSURE, BALANCE, COMPLETE
+    SUBMIT, ADMIT, DRAIN, REQUEUE, PRESSURE, BALANCE, COMPLETE,
+    HANDOFF, REGISTER,
 )
 
 
@@ -176,6 +189,10 @@ class JournalState:
     def _apply(self, rec: dict) -> None:
         t = rec["type"]
         sid = rec.get("id")
+        if t == REGISTER:
+            # peer-table membership (router journal): never a sweep
+            # transition, so replay folding ignores it
+            return
         if t == SUBMIT:
             if sid in self.sweeps:
                 return  # replayed duplicate; first submit wins
@@ -187,7 +204,12 @@ class JournalState:
                 "ckpt_dir": None,
                 "results": None,
                 "admits": 0,
+                "backend_faults": rec.get("backend_faults") or [],
             }
+            if rec.get("origin") is not None:
+                # federation handoff marker: must survive replay so a
+                # restarted receiver still refuses the duplicate
+                self.sweeps[sid]["origin"] = rec["origin"]
             self.order.append(sid)
         elif sid in self.sweeps:
             s = self.sweeps[sid]
@@ -210,6 +232,13 @@ class JournalState:
                 s["status"] = "done" if rec.get("ok") else "failed"
                 s["results"] = rec.get("results")
                 s["stats"] = rec.get("stats")
+            elif t == HANDOFF:
+                # the sweep now belongs to another federation member:
+                # replay must NOT requeue it here (the torn-tail
+                # discipline's no-duplicate half) — the receiving
+                # peer's SUBMIT record is the single surviving claim
+                s["status"] = "handed_off"
+                s["handoff_to"] = rec.get("to_peer")
 
     def unfinished(self) -> list[dict]:
         """Sweeps the restarted daemon must pick back up, in submission
@@ -226,4 +255,13 @@ class JournalState:
         return [
             self.sweeps[sid] for sid in self.order
             if self.sweeps[sid]["status"] in ("done", "failed")
+        ]
+
+    def handed_off(self) -> list[dict]:
+        """Sweeps this journal's owner gave to another federation member
+        (work stealing / failover): replay skips them — the receiver's
+        journal carries the live claim."""
+        return [
+            self.sweeps[sid] for sid in self.order
+            if self.sweeps[sid]["status"] == "handed_off"
         ]

@@ -1,0 +1,361 @@
+"""The federation router: `python -m shadow_tpu route --peers ...`.
+
+A thin placement front for N serve daemons (serve/federation.py owns
+the brain; this module owns the process). Same serving surface as the
+daemon — HTTP over a unix socket — so `tools/shadowctl.py` talks to a
+router exactly as it talks to a single daemon, sweep handles are just
+`peer:sid` instead of `sid`:
+
+    GET  /healthz            federation posture: peers_up/peers_total,
+                             per-peer ladder states, queue spread
+    GET  /metricz            schema-v16 `federation.*` metrics document
+    GET  /v1/sweeps          placement table (handles -> peer + sid)
+    GET  /v1/sweeps/<h>      proxied sweep info from the owning peer
+                             (follows failover remaps transparently)
+    GET  /v1/journal         the ROUTER's journal (REGISTER + HANDOFF)
+    POST /v1/sweeps          place a sweep on the best peer (429 body
+                             proxied through when every peer sheds)
+    POST /v1/drain           stop the probe loop and exit
+
+Threads: HTTP handlers (placement + reads) run on the server's thread
+pool; the main loop is the supervising thread — probe ladder ticks,
+failover, steal ticks and every router-journal append happen THERE,
+so the journal has a single writer. `drain()` runs from signal
+handlers on the main thread and uses the same bounded-acquire idiom
+as the daemon (STH004).
+
+The router restarts under the same contract it enforces: its journal
+replays the peer table and every in-flight handoff intent
+(`Federation.recover_handoffs`), so a router crash mid-steal or
+mid-failover never duplicates or drops a sweep.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socketserver
+import threading
+import time
+from http.server import BaseHTTPRequestHandler
+
+from shadow_tpu.serve import journal as journal_mod
+from shadow_tpu.serve.federation import Federation, FederationError
+
+ROUTER_JOURNAL_NAME = "router.wal"
+ROUTER_METRICS_NAME = "router.metrics.json"
+
+
+class RouterOptions:
+    def __init__(
+        self,
+        state_dir: str,
+        peers: list[str],
+        socket_path: str | None = None,
+        probe_interval_s: float = 1.0,
+        lost_after: int = 3,
+        steal: bool = True,
+        seed: int = 0,
+    ):
+        self.state_dir = os.path.abspath(state_dir)
+        self.peers = list(peers)
+        self.socket_path = socket_path or os.path.join(
+            self.state_dir, "route.sock"
+        )
+        self.probe_interval_s = float(probe_interval_s)
+        self.lost_after = int(lost_after)
+        self.steal = bool(steal)
+        self.seed = int(seed)
+
+
+class ShadowRouter:
+    def __init__(self, opts: RouterOptions, *, client_factory=None):
+        os.makedirs(opts.state_dir, exist_ok=True)
+        self.opts = opts
+        self.journal = journal_mod.Journal(
+            os.path.join(opts.state_dir, ROUTER_JOURNAL_NAME)
+        )
+        self.federation = Federation(
+            opts.peers,
+            self.journal,
+            lost_after=opts.lost_after,
+            probe_interval_s=opts.probe_interval_s,
+            seed=opts.seed,
+            client_factory=client_factory,
+        )
+        self._draining = threading.Event()
+        self._server: socketserver.ThreadingMixIn | None = None
+        self._started = threading.Event()
+
+    # ------------------------------------------------------------------
+    # introspection (HTTP threads — no journal appends here)
+    # ------------------------------------------------------------------
+
+    def health(self) -> dict:
+        doc = self.federation.health_doc()
+        doc["draining"] = self._draining.is_set()
+        doc["ok"] = doc["ok"] and not self._draining.is_set()
+        return doc
+
+    def journal_doc(self) -> dict:
+        return {
+            "records": self.journal.records,
+            "torn_tail_dropped": self.journal.torn_tail_dropped,
+        }
+
+    def placements_list(self) -> list[dict]:
+        return self.federation.placements_list()
+
+    def sweep_info(self, handle: str) -> tuple[int, dict]:
+        """Proxy a sweep read to the peer that currently owns it."""
+        from shadow_tpu.serve.client import ServeClientError
+
+        try:
+            peer, sid = self.federation.locate(handle)
+        except FederationError as e:
+            return 404, {"error": str(e)}
+        try:
+            info = peer.client.sweep(sid)
+        except ServeClientError as e:
+            # dead / unreachable peer: serve the sweep's last durable
+            # state from the mirrored journal — a sweep that completed
+            # on a lost box still answers with its results
+            info = self.federation.mirror_sweep_info(peer, sid)
+            if info is None:
+                return 503, {"error": str(e), "peer": peer.name}
+        info["id"] = handle  # the federation handle, not the local sid
+        info["peer"] = peer.name
+        return 200, info
+
+    def _dump_metrics(self) -> None:
+        doc = self.federation.metrics_doc()
+        path = os.path.join(self.opts.state_dir, ROUTER_METRICS_NAME)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1)
+            f.write("\n")
+        os.replace(tmp, path)
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    def drain(self) -> None:
+        """Stop routing: runs from signal handlers ON the main (probe)
+        thread, so only the Event is touched — the probe loop checks it
+        every tick and exits; no lock is taken here at all (STH003)."""
+        self._draining.set()
+
+    def _probe_loop(self) -> None:
+        """The supervising thread: probe ladders, failover, steal ticks
+        and metrics dumps — the single writer of the router journal."""
+        while not self._draining.is_set():
+            t0 = time.monotonic()
+            self.federation.probe_once()
+            if self.opts.steal:
+                self.federation.steal_once()
+            self._dump_metrics()
+            # sleep the remainder of the probe interval in short slices
+            # so a drain never waits a full interval to take effect
+            while (not self._draining.is_set()
+                   and time.monotonic() - t0 < self.opts.probe_interval_s):
+                time.sleep(0.05)
+
+    def _make_server(self):
+        router = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def address_string(self):  # pragma: no cover - logging only
+                return "unix"
+
+            def log_message(self, *a):  # quiet by default
+                pass
+
+            def _reply(self, code: int, body: dict,
+                       headers: dict | None = None) -> None:
+                blob = (json.dumps(body) + "\n").encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(blob)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(blob)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    return self._reply(200, router.health())
+                if self.path == "/metricz":
+                    return self._reply(200, router.federation.metrics_doc())
+                if self.path == "/v1/journal":
+                    return self._reply(200, router.journal_doc())
+                if self.path == "/v1/sweeps":
+                    return self._reply(
+                        200, {"sweeps": router.placements_list()}
+                    )
+                if self.path.startswith("/v1/sweeps/"):
+                    handle = self.path.rsplit("/", 1)[-1]
+                    code, doc = router.sweep_info(handle)
+                    return self._reply(code, doc)
+                return self._reply(404, {"error": "unknown path"})
+
+            def do_POST(self):
+                from shadow_tpu.serve.client import ServeClientError
+                from shadow_tpu.serve.daemon import ServeError
+
+                n = int(self.headers.get("Content-Length") or 0)
+                raw = self.rfile.read(n) if n else b"{}"
+                try:
+                    payload = json.loads(raw.decode() or "{}")
+                except (UnicodeDecodeError, json.JSONDecodeError):
+                    return self._reply(400, {"error": "body is not JSON"})
+                if self.path == "/v1/drain":
+                    router.drain()
+                    return self._reply(200, {"draining": True})
+                if self.path == "/v1/sweeps":
+                    if router._draining.is_set():
+                        return self._reply(
+                            429,
+                            {"shed": "draining", "retry_after_s": 30},
+                            headers={"Retry-After": "30"},
+                        )
+                    doc = payload.get("sweep")
+                    if not isinstance(doc, dict):
+                        return self._reply(
+                            400,
+                            {"error": "payload needs a `sweep` document"},
+                        )
+                    try:
+                        out = router.federation.place(
+                            doc,
+                            tenant=str(payload.get("tenant", "default")),
+                            backend_faults=payload.get("backend_faults"),
+                        )
+                    except FederationError as e:
+                        return self._reply(503, {"error": str(e)})
+                    except ServeClientError as e:
+                        # a ServeError on the peer surfaces as a client
+                        # error string; proxy the 400 through
+                        return self._reply(400, {"error": str(e)})
+                    except ServeError as e:  # pragma: no cover - local
+                        return self._reply(400, {"error": str(e)})
+                    if "shed" in out:
+                        return self._reply(
+                            429, out,
+                            headers={
+                                "Retry-After": str(out["retry_after_s"]),
+                            },
+                        )
+                    return self._reply(200, out)
+                return self._reply(404, {"error": "unknown path"})
+
+        class Server(socketserver.ThreadingMixIn,
+                     socketserver.UnixStreamServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        sock = self.opts.socket_path
+        os.makedirs(os.path.dirname(os.path.abspath(sock)), exist_ok=True)
+        if os.path.exists(sock):
+            os.unlink(sock)  # stale socket from a killed incarnation
+        return Server(sock, Handler)
+
+    def serve_forever(self, install_signals: bool = True) -> int:
+        """Run until drained (SIGTERM / POST /v1/drain). Returns 0 on a
+        graceful exit."""
+        recovered = self.federation.recover_handoffs()
+        self._server = self._make_server()
+        if install_signals:
+            signal.signal(signal.SIGTERM, lambda *_: self.drain())
+            signal.signal(signal.SIGINT, lambda *_: self.drain())
+        th = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+        th.start()
+        self._started.set()
+        print(
+            f"route: listening on {self.opts.socket_path} "
+            f"({len(self.federation.peers)} peer(s), "
+            f"{len(recovered)} handoff(s) recovered)",
+            flush=True,
+        )
+        try:
+            self._probe_loop()
+        finally:
+            self._server.shutdown()
+            self._server.server_close()
+            try:
+                os.unlink(self.opts.socket_path)
+            except OSError:
+                pass
+            self._dump_metrics()
+            self.journal.close()
+        print("route: drained, exiting", flush=True)
+        return 0
+
+
+# ----------------------------------------------------------------------
+# CLI (python -m shadow_tpu route ...)
+# ----------------------------------------------------------------------
+
+
+def build_parser():
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="shadow_tpu route",
+        description=(
+            "federation router: place sweeps across serve daemons, "
+            "replay a lost peer's journal onto the survivors"
+        ),
+    )
+    p.add_argument(
+        "--state-dir", required=True,
+        help="router state root: router.wal journal + metrics dump",
+    )
+    p.add_argument(
+        "--peers", required=True, nargs="+", metavar="SPEC",
+        help=(
+            "federation members, NAME=STATE_DIR or bare STATE_DIR "
+            "(socket assumed at <state_dir>/serve.sock)"
+        ),
+    )
+    p.add_argument(
+        "--socket", default=None,
+        help="unix socket for the HTTP API "
+             "(default <state-dir>/route.sock)",
+    )
+    p.add_argument(
+        "--probe-interval", type=float, default=1.0, metavar="S",
+        help="seconds between peer health probes (default 1.0)",
+    )
+    p.add_argument(
+        "--lost-after", type=int, default=3, metavar="N",
+        help="consecutive missed probes before a peer is declared "
+             "lost and failed over (default 3)",
+    )
+    p.add_argument(
+        "--no-steal", action="store_true",
+        help="disable idle-peer work stealing (placement + failover only)",
+    )
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        router = ShadowRouter(RouterOptions(
+            state_dir=args.state_dir,
+            peers=args.peers,
+            socket_path=args.socket,
+            probe_interval_s=args.probe_interval,
+            lost_after=args.lost_after,
+            steal=not args.no_steal,
+        ))
+    except FederationError as e:
+        print(f"route: {e}", flush=True)
+        return 2
+    return router.serve_forever()

@@ -630,6 +630,36 @@ def test_slc008_plan_registry_drift_fires():
 
 
 @pytest.mark.quick
+def test_slc009_journal_record_table_drift_fires():
+    from shadow_tpu.serve import journal as journal_mod
+
+    types = journal_mod.RECORD_TYPES
+    doc = "## journal\n\n| type | when | payload |\n|---|---|---|\n"
+    rows = doc + "".join(
+        f"| `{t}` | trigger | payload |\n" for t in types
+    )
+    region = contracts.extract_journal_table_region(rows)
+    # clean control: every registered type documented, no stale rows
+    assert contracts.audit_journal_record_table(
+        region, "docs/serving.md", types) == []
+    # forged drift: drop the handoff row → missing-record finding
+    missing = contracts.extract_journal_table_region(
+        rows.replace(f"| `{journal_mod.HANDOFF}` | trigger | payload |\n",
+                     ""))
+    out = contracts.audit_journal_record_table(
+        missing, "docs/serving.md", types)
+    assert _slc_codes(out) == ["SLC009"]
+    assert out[0].text == "record:handoff"
+    # forged drift: a row naming an unregistered type → stale finding
+    stale = contracts.extract_journal_table_region(
+        rows + "| `ghost` | never | nothing |\n")
+    out = contracts.audit_journal_record_table(
+        stale, "docs/serving.md", types)
+    assert _slc_codes(out) == ["SLC009"]
+    assert out[0].text == "stale:ghost"
+
+
+@pytest.mark.quick
 def test_every_contract_rule_has_a_firing_fixture():
     import re as re_mod
 

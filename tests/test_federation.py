@@ -1,0 +1,590 @@
+"""Federated serve plane (shadow_tpu/serve/federation.py + router.py).
+
+These tests drive the placement brain IN-PROCESS against fake peers
+that speak the ServeClient surface but keep a REAL journal file in
+their state-dir — so failover, work stealing and crash-mid-steal
+recovery exercise the same journal replay path the production router
+uses, without paying for subprocess daemons or fleet runs. The full
+3-peer chaos choreography (SIGKILL a box mid-sweep, bit-identical
+chains on the survivors) lives in `bench.py --federation-smoke`.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from shadow_tpu.core.supervisor import (
+    PEER_HEALTHY,
+    PEER_LOST,
+    PEER_SUSPECT,
+    ProbeLadder,
+)
+from shadow_tpu.serve import journal as journal_mod
+from shadow_tpu.serve.client import ServeClient, ServeClientError, Shed
+from shadow_tpu.serve.federation import (
+    Federation,
+    FederationError,
+    parse_peer_spec,
+    placement_score,
+    split_handle,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# pure helpers: specs, handles, scores, the probe ladder
+# ---------------------------------------------------------------------------
+
+
+def test_parse_peer_spec_and_split_handle(tmp_path):
+    name, sd = parse_peer_spec(f"p0={tmp_path}")
+    assert name == "p0" and sd == str(tmp_path)
+    # bare dir: name = basename
+    name, sd = parse_peer_spec(str(tmp_path / "box7"))
+    assert name == "box7"
+    # '=' splits only once, so state dirs may contain '='
+    name, sd = parse_peer_spec("p=/tmp/a=b")
+    assert (name, sd) == ("p", "/tmp/a=b")
+    for bad in ("a:b=/tmp/x", "=/tmp/x", "p0="):
+        with pytest.raises(FederationError):
+            parse_peer_spec(bad)
+    assert split_handle("p0:s000003") == ("p0", "s000003")
+    with pytest.raises(FederationError):
+        split_handle("s000003")
+
+
+def test_probe_ladder_states_backoff_and_recovery():
+    lad = ProbeLadder(lost_after=3, seed=7)
+    assert lad.state == PEER_HEALTHY and lad.backoff_s() == 0.0
+    assert lad.record(False) == PEER_SUSPECT
+    assert lad.record(False) == PEER_SUSPECT
+    b2 = lad.backoff_s()
+    assert lad.record(False) == PEER_LOST
+    b3 = lad.backoff_s()
+    # jittered exponential: later rungs wait longer, bounded by the cap
+    assert 0.0 < b2 and b3 <= lad.backoff_cap_s * 1.5
+    # one good probe snaps straight back (recovery is instant)
+    assert lad.record(True) == PEER_HEALTHY
+    assert lad.misses == 0 and lad.backoff_s() == 0.0
+    # deterministic under a fixed seed
+    a = ProbeLadder(lost_after=3, seed=1)
+    b = ProbeLadder(lost_after=3, seed=1)
+    a.record(False), b.record(False)
+    assert a.backoff_s() == b.backoff_s()
+
+
+def _health(depth=0, running=None, wait=0, chips=(8, 8), headroom=1 << 30,
+            draining=False, load=None):
+    return {
+        "ok": True,
+        "draining": draining,
+        "queue": {"depth": depth, "running": running, "sweeps": {}},
+        "retry_after_s": wait,
+        "mesh": {"chips_total": chips[0], "chips_up": chips[1]},
+        "memory": {"headroom_bytes": headroom},
+        "steal": {
+            "queued_predicted_load": float(depth if load is None else load),
+        },
+        "journal": {"records": 0, "lag": 0, "torn_tail_dropped": False},
+    }
+
+
+def test_placement_score_ordering():
+    idle = placement_score(_health())
+    loaded = placement_score(_health(depth=3, wait=6))
+    assert idle == 0.0 < loaded
+    # a degraded mesh runs slower: same queue scores worse at 7/8 chips
+    assert placement_score(_health(depth=2, chips=(8, 7))) > \
+        placement_score(_health(depth=2))
+    # meshless / draining peers can never win
+    assert placement_score(_health(chips=(8, 0))) == float("inf")
+    assert placement_score(_health(draining=True)) == float("inf")
+    # exhausted memory headroom outranks any queue difference
+    assert placement_score(_health(headroom=0)) > \
+        placement_score(_health(depth=8, wait=60))
+
+
+# ---------------------------------------------------------------------------
+# the fake peer: ServeClient surface over a REAL journal file
+# ---------------------------------------------------------------------------
+
+
+class FakePeer:
+    """A serve daemon stand-in: same client methods, same journal
+    discipline (SUBMIT / HANDOFF / COMPLETE appended to a real
+    `journal.wal`), none of the fleet. `dead=True` makes every call
+    raise like a connection refusal would."""
+
+    def __init__(self, state_dir: str):
+        self.state_dir = str(state_dir)
+        os.makedirs(self.state_dir, exist_ok=True)
+        self.wal = journal_mod.Journal(
+            os.path.join(self.state_dir, "journal.wal")
+        )
+        self.seq = 0
+        self.dead = False
+        self.draining = False
+        self.shed_next = 0  # shed this many submits before accepting
+
+    # -- test-side controls ------------------------------------------------
+
+    def _folded(self):
+        return journal_mod.JournalState(self.wal.records)
+
+    def queued_sids(self):
+        return [s["id"] for s in self._folded().unfinished()
+                if s["status"] == "queued"]
+
+    def complete(self, sid: str, ok: bool = True, results=None):
+        self.wal.append(
+            journal_mod.COMPLETE, id=sid, ok=ok,
+            results=results or [{"name": sid, "audit": {"chain": "c" * 8}}],
+        )
+
+    # -- the ServeClient surface ------------------------------------------
+
+    def _check(self):
+        if self.dead:
+            raise ServeClientError(f"{self.state_dir}: daemon unreachable")
+
+    def health(self):
+        self._check()
+        depth = len(self.queued_sids())
+        return _health(depth=depth, wait=depth, draining=self.draining)
+
+    def journal(self):
+        self._check()
+        return {"records": self.wal.records,
+                "torn_tail_dropped": self.wal.torn_tail_dropped}
+
+    def submit(self, doc, tenant="default", backend_faults=None,
+               origin=None):
+        self._check()
+        if self.shed_next > 0:
+            self.shed_next -= 1
+            return {"shed": "queue_full", "retry_after_s": 5}
+        if origin is not None:
+            for s in self._folded().sweeps.values():
+                if s.get("origin") == origin:
+                    return {"id": s["id"], "duplicate": True}
+        sid = f"s{self.seq:06d}"
+        self.seq += 1
+        extra = {"origin": origin} if origin is not None else {}
+        self.wal.append(
+            journal_mod.SUBMIT, id=sid, tenant=tenant, doc=doc,
+            backend_faults=backend_faults or [], **extra,
+        )
+        return {"id": sid, "jobs": 1, "queue_position": 0}
+
+    def sweeps(self):
+        self._check()
+        st = self._folded()
+        return [{"id": s["id"], "tenant": s["tenant"],
+                 "status": s["status"]}
+                for s in (st.sweeps[sid] for sid in st.order)]
+
+    def sweep(self, sid):
+        self._check()
+        s = self._folded().sweeps.get(sid)
+        if s is None:
+            raise ServeClientError(f"no sweep {sid}")
+        return {k: v for k, v in s.items() if k != "doc"}
+
+    def release(self, sid, to_peer):
+        self._check()
+        s = self._folded().sweeps.get(sid)
+        if s is None:
+            raise ServeClientError(f"no sweep {sid}")
+        if s["status"] != "queued":
+            raise Shed({"shed": "busy", "retry_after_s": 1})
+        self.wal.append(journal_mod.HANDOFF, id=sid,
+                       to_peer=str(to_peer))
+        return {"id": sid, "tenant": s["tenant"], "doc": s["doc"],
+                "backend_faults": s.get("backend_faults") or []}
+
+    def drain(self):
+        self._check()
+        self.draining = True
+        return {"draining": True}
+
+    def metrics(self):
+        self._check()
+        return {"counters": {}}
+
+
+
+class Fleet:
+    """N fake peers + an in-process Federation on a fake clock."""
+
+    def __init__(self, tmp_path, n=2, lost_after=3, seed=0):
+        self.clk = [100.0]
+        self.fakes = {}
+        specs = []
+        for i in range(n):
+            sd = str(tmp_path / f"p{i}")
+            self.fakes[sd] = FakePeer(sd)
+            specs.append(f"p{i}={sd}")
+        self.journal = journal_mod.Journal(str(tmp_path / "router.wal"))
+        self.fed = Federation(
+            specs, self.journal, lost_after=lost_after, seed=seed,
+            probe_interval_s=1.0,
+            client_factory=lambda sock: self.fakes[os.path.dirname(sock)],
+            now=lambda: self.clk[0],
+        )
+
+    def fake(self, name):
+        return self.fakes[self.fed.peers[name].state_dir]
+
+    def probe(self, times=1, step=30.0):
+        lost = []
+        for _ in range(times):
+            lost += self.fed.probe_once()
+            self.clk[0] += step
+        return lost
+
+
+DOC = {"sweep": {"name": "x"}, "general": {"seed": 1}}
+
+
+def test_register_records_and_duplicate_name_refused(tmp_path):
+    fl = Fleet(tmp_path, n=2)
+    regs = [r for r in fl.journal.records
+            if r["type"] == journal_mod.REGISTER]
+    assert sorted(r["name"] for r in regs) == ["p0", "p1"]
+    # REGISTER is deduplicated across router restarts
+    fl.journal.close()
+    j2 = journal_mod.Journal(str(tmp_path / "router.wal"))
+    Federation([f"p0={tmp_path}/p0", f"p1={tmp_path}/p1"], j2,
+               client_factory=lambda s: FakePeer(os.path.dirname(s)))
+    regs = [r for r in j2.records if r["type"] == journal_mod.REGISTER]
+    assert len(regs) == 2
+    with pytest.raises(FederationError, match="duplicate"):
+        Federation([f"a={tmp_path}/x", f"a={tmp_path}/y"], j2,
+                   client_factory=lambda s: FakePeer(os.path.dirname(s)))
+
+
+def test_place_affinity_sticks_and_sheds_fall_through(tmp_path):
+    fl = Fleet(tmp_path, n=2)
+    fl.probe()
+    out = fl.fed.place(DOC, tenant="t")
+    first = out["peer"]
+    assert out["id"] == f"{first}:s000000"
+    # stale health still shows depth 0 everywhere: affinity re-picks the
+    # same peer (sticky within AFFINITY_SLACK) instead of round-robining
+    out2 = fl.fed.place(DOC, tenant="t")
+    assert out2["peer"] == first
+    # a fresh probe sees the pile-up; a NEW tenant goes to the idle peer
+    fl.probe()
+    out3 = fl.fed.place(DOC, tenant="u")
+    assert out3["peer"] != first
+    # a shedding best-peer falls through to the next candidate
+    fl.probe()
+    for f in fl.fakes.values():
+        f.shed_next = 0
+    fl.fake(out3["peer"]).shed_next = 99
+    out4 = fl.fed.place(DOC, tenant="u2")
+    assert out4["peer"] != out3["peer"]
+    # every peer shedding surfaces the shed body (the router's 429)
+    for f in fl.fakes.values():
+        f.shed_next = 99
+    assert "shed" in fl.fed.place(DOC, tenant="u3")
+    # every peer DEAD is an error, not a hang
+    for f in fl.fakes.values():
+        f.shed_next = 0
+        f.dead = True
+    with pytest.raises(FederationError, match="no live peer"):
+        fl.fed.place(DOC, tenant="u4")
+
+
+def test_probe_ladder_declares_loss_and_failover_replays(tmp_path):
+    fl = Fleet(tmp_path, n=2, lost_after=3)
+    fl.probe()
+    h0 = fl.fed.place(DOC, tenant="t")["id"]
+    h1 = fl.fed.place(DOC, tenant="t")["id"]
+    src = split_handle(h0)[0]
+    survivor = [n for n in fl.fed.peers if n != src][0]
+    # one sweep settles before the box dies; its journal records that
+    fl.fake(src).complete(split_handle(h0)[1])
+    fl.fake(src).dead = True
+    lost = fl.probe(times=3)
+    assert lost == [src]
+    assert fl.fed.peers[src].ladder.state == PEER_LOST
+    # only the UNFINISHED sweep was re-placed, onto the survivor,
+    # carrying its origin handle
+    assert fl.fed.counters["failovers"] == 1
+    assert fl.fed.counters["replayed_sweeps"] == 1
+    assert fl.fed.counters["peers_lost"] == 1
+    intents = [r for r in fl.journal.records
+               if r["type"] == journal_mod.HANDOFF]
+    assert [r["id"] for r in intents] == [h1]
+    assert intents[0]["to_peer"] == "*failover*"
+    sub = [r for r in fl.fake(survivor).wal.records
+           if r["type"] == journal_mod.SUBMIT]
+    assert sub and sub[-1]["origin"] == h1
+    peer, sid = fl.fed.locate(h1)
+    assert peer.name == survivor and sid == sub[-1]["id"]
+    # failing over again is a no-op: the receiver's origin-marked SUBMIT
+    # is the claim, and the daemon refuses duplicate origins
+    fl.fed.fail_over(src)
+    assert len([r for r in fl.fake(survivor).wal.records
+                if r["type"] == journal_mod.SUBMIT]) == len(sub)
+    # the completed sweep still answers from the router's mirror
+    info = fl.fed.mirror_sweep_info(
+        fl.fed.peers[src], split_handle(h0)[1]
+    )
+    assert info["status"] == "done" and info["from_mirror"]
+
+
+def test_failover_from_mirror_when_state_dir_died_with_the_box(tmp_path):
+    fl = Fleet(tmp_path, n=2)
+    fl.probe()
+    h = fl.fed.place(DOC, tenant="t")["id"]
+    src, sid = split_handle(h)
+    survivor = [n for n in fl.fed.peers if n != src][0]
+    fl.probe()  # mirrors the journal with the SUBMIT aboard
+    fl.fake(src).wal.close()
+    os.remove(os.path.join(fl.fed.peers[src].state_dir, "journal.wal"))
+    fl.fake(src).dead = True
+    assert fl.probe(times=3) == [src]
+    # replay ran from the probe-time mirror, not the (gone) state-dir
+    peer, new_sid = fl.fed.locate(h)
+    assert peer.name == survivor
+    assert fl.fed.counters["replayed_sweeps"] == 1
+
+
+def test_steal_moves_newest_queued_sweep_with_full_journal_trail(tmp_path):
+    fl = Fleet(tmp_path, n=2)
+    fl.probe()
+    handles = [fl.fed.place(DOC, tenant="t")["id"] for _ in range(3)]
+    src = split_handle(handles[0])[0]
+    dst = [n for n in fl.fed.peers if n != src][0]
+    fl.probe()  # src shows depth 3, dst idle
+    moved = fl.fed.steal_once()
+    # the NEWEST queued sweep moves (the head starts on src anyway)
+    assert moved == {"id": handles[-1], "from": src, "to": dst}
+    assert fl.fed.counters["steals"] == 1
+    # router intent, source HANDOFF, receiver origin-SUBMIT: all durable
+    assert [r["id"] for r in fl.journal.records
+            if r["type"] == journal_mod.HANDOFF] == [handles[-1]]
+    st = fl.fake(src)._folded()
+    assert st.sweeps[split_handle(handles[-1])[1]]["status"] == "handed_off"
+    assert [s["id"] for s in st.handed_off()] == \
+        [split_handle(handles[-1])[1]]
+    sub = [r for r in fl.fake(dst).wal.records
+           if r["type"] == journal_mod.SUBMIT]
+    assert sub[-1]["origin"] == handles[-1]
+    assert fl.fed.locate(handles[-1])[0].name == dst
+    # balanced fleet: nothing further to steal this tick
+    fl.probe()
+    assert fl.fed.steal_once() is None
+
+
+def test_steal_receiver_shed_recovers_without_dropping(tmp_path):
+    fl = Fleet(tmp_path, n=2)
+    fl.probe()
+    handles = [fl.fed.place(DOC, tenant="t")["id"] for _ in range(3)]
+    src = split_handle(handles[0])[0]
+    dst = [n for n in fl.fed.peers if n != src][0]
+    fl.probe()
+    fl.fake(dst).shed_next = 1  # refuse AFTER the source released
+    moved = fl.fed.steal_once()
+    assert moved["to"] == "*recovered*"
+    # the sweep lives on exactly ONE live claim somewhere in the fleet
+    claims = []
+    for name in fl.fed.peers:
+        st = fl.fake(name)._folded()
+        claims += [s for s in st.unfinished()]
+    sid = split_handle(handles[-1])[1]
+    assert sid not in [s["id"] for s in fl.fake(src)._folded().unfinished()]
+    peer, new_sid = fl.fed.locate(handles[-1])
+    assert any(s["id"] == new_sid for s in claims)
+
+
+def test_recover_handoffs_settles_every_crash_point(tmp_path):
+    """The crash-mid-steal matrix: router died (a) after journaling the
+    intent but before the source released, (b) after the release but
+    before the receiver's submit, (c) after everything landed. A
+    restarted router must settle all three without duplicating or
+    dropping a sweep."""
+    fl = Fleet(tmp_path, n=2)
+    fl.probe()
+    ha = fl.fed.place(DOC, tenant="t")["id"]
+    hb = fl.fed.place(DOC, tenant="t")["id"]
+    hc = fl.fed.place(DOC, tenant="t")["id"]
+    src = split_handle(ha)[0]
+    dst = [n for n in fl.fed.peers if n != src][0]
+    # (a) intent only — the source never released
+    fl.journal.append(journal_mod.HANDOFF, id=ha, from_peer=src,
+                      to_peer=dst)
+    # (b) intent + source released, receiver never saw it
+    fl.journal.append(journal_mod.HANDOFF, id=hb, from_peer=src,
+                      to_peer=dst)
+    fl.fake(src).release(split_handle(hb)[1], to_peer=dst)
+    # (c) the full protocol landed
+    fl.journal.append(journal_mod.HANDOFF, id=hc, from_peer=src,
+                      to_peer=dst)
+    rel = fl.fake(src).release(split_handle(hc)[1], to_peer=dst)
+    fl.fake(dst).submit(rel["doc"], tenant=rel["tenant"], origin=hc)
+
+    # "restart": a fresh Federation over the same journal + state dirs
+    fl.journal.close()
+    j2 = journal_mod.Journal(str(tmp_path / "router.wal"))
+    fed2 = Federation(
+        [f"{n}={p.state_dir}" for n, p in fl.fed.peers.items()], j2,
+        client_factory=lambda sock: fl.fakes[os.path.dirname(sock)],
+    )
+    recovered = fed2.recover_handoffs()
+    assert recovered == [hb]  # only the torn-mid-steal sweep moved
+    assert fed2.counters["handoff_recoveries"] == 1
+    # (a) stayed where it was: still queued on the source
+    assert split_handle(ha)[1] in fl.fake(src).queued_sids()
+    # (c) resolves to the receiver that already claimed it
+    assert fed2.locate(hc)[0].name == dst
+
+    def origin_subs(handle):
+        return [
+            (name, r["id"])
+            for name in fl.fed.peers
+            for r in fl.fake(name).wal.records
+            if r["type"] == journal_mod.SUBMIT
+            and r.get("origin") == handle
+        ]
+
+    # (b) landed EXACTLY once somewhere live (re-placement before any
+    # probe may legally re-take on the source under a fresh sid), and
+    # the placement map resolves the original handle to that claim
+    assert len(origin_subs(hb)) == 1
+    peer_b, sid_b = fed2.locate(hb)
+    assert (peer_b.name, sid_b) == origin_subs(hb)[0]
+    assert len(origin_subs(hc)) == 1
+    # running recovery again changes nothing (idempotent)
+    total_subs = sum(
+        1 for name in fl.fed.peers
+        for r in fl.fake(name).wal.records
+        if r["type"] == journal_mod.SUBMIT
+    )
+    assert fed2.recover_handoffs() == []
+    assert sum(
+        1 for name in fl.fed.peers
+        for r in fl.fake(name).wal.records
+        if r["type"] == journal_mod.SUBMIT
+    ) == total_subs
+
+
+def test_resurrected_peer_is_told_to_release_moved_sweeps(tmp_path):
+    fl = Fleet(tmp_path, n=2)
+    fl.probe()
+    h = fl.fed.place(DOC, tenant="t")["id"]
+    src, sid = split_handle(h)
+    fl.fake(src).dead = True
+    assert fl.probe(times=3) == [src]
+    holder = fl.fed.locate(h)[0].name
+    assert holder != src
+    # the box comes back and would replay its own journal, re-running a
+    # sweep the federation already moved: reconciliation releases it
+    fl.fake(src).dead = False
+    fl.probe()
+    assert fl.fed.peers[src].ladder.state == PEER_HEALTHY
+    st = fl.fake(src)._folded()
+    assert st.sweeps[sid]["status"] == "handed_off"
+    assert st.sweeps[sid]["handoff_to"] == holder
+    # reads keep resolving to the failover copy
+    assert fl.fed.locate(h)[0].name == holder
+
+
+def test_health_and_metrics_docs_validate(tmp_path):
+    from shadow_tpu.obs import metrics as obs_metrics
+
+    fl = Fleet(tmp_path, n=3)
+    fl.probe()
+    fl.fed.place(DOC, tenant="t")
+    h = fl.fed.health_doc()
+    assert h["ok"] and h["peers_total"] == 3 and h["peers_up"] == 3
+    assert h["placements"] == 1
+    doc = fl.fed.metrics_doc()
+    obs_metrics.validate_metrics_doc(doc, strict_namespaces=True)
+    assert doc["schema_version"] == obs_metrics.SCHEMA_VERSION
+    assert doc["counters"]["federation.placements"] == 1
+    assert doc["gauges"]["federation.peers_up"] == 3
+    # status rows drive `shadowctl status --peers`
+    rows = fl.fed.status_rows()
+    assert [r["peer"] for r in rows] == ["p0", "p1", "p2"]
+    assert all(r["state"] == PEER_HEALTHY for r in rows)
+
+
+# ---------------------------------------------------------------------------
+# the router process surface (in-process, fake peers, real unix socket)
+# ---------------------------------------------------------------------------
+
+
+def test_router_http_surface_and_drain(tmp_path):
+    from shadow_tpu.obs import metrics as obs_metrics
+    from shadow_tpu.serve.router import RouterOptions, ShadowRouter
+
+    fakes = {}
+    specs = []
+    for i in range(2):
+        sd = str(tmp_path / f"p{i}")
+        fakes[sd] = FakePeer(sd)
+        specs.append(f"p{i}={sd}")
+    router = ShadowRouter(
+        RouterOptions(
+            state_dir=str(tmp_path / "router"), peers=specs,
+            probe_interval_s=0.05,
+        ),
+        client_factory=lambda sock: fakes[os.path.dirname(sock)],
+    )
+    th = threading.Thread(
+        target=router.serve_forever, kwargs={"install_signals": False},
+    )
+    th.start()
+    try:
+        client = ServeClient(router.opts.socket_path, timeout=10)
+        health = client.wait_ready(timeout_s=30)
+        assert health["peers_total"] == 2
+        out = client.submit(DOC, tenant="t")
+        handle = out["id"]
+        assert out["peer"] in ("p0", "p1") and ":" in handle
+        # reads proxy through to the owning peer, keyed by handle
+        info = client.sweep(handle)
+        assert info["id"] == handle and info["status"] == "queued"
+        assert [s["id"] for s in client.sweeps()] == [handle]
+        doc = client.metrics()
+        obs_metrics.validate_metrics_doc(doc, strict_namespaces=True)
+        assert doc["counters"]["federation.placements"] == 1
+        # the router journal rides the same surface as a daemon's
+        jd = client.journal()
+        assert [r["type"] for r in jd["records"]] == \
+            [journal_mod.REGISTER] * 2
+        client.drain()
+        # a draining router sheds placements like a draining daemon
+        with pytest.raises((Shed, ServeClientError)):
+            client.submit(DOC, tenant="t2")
+    finally:
+        router.drain()
+        th.join(timeout=30)
+    assert not th.is_alive()
+    assert not os.path.exists(router.opts.socket_path)
+    # the metrics artifact landed and validates
+    mpath = os.path.join(router.opts.state_dir, "router.metrics.json")
+    obs_metrics.validate_metrics_doc(json.load(open(mpath)))
+
+
+def test_shadowctl_status_peers_reports_unreachable(tmp_path):
+    """`shadowctl status --peers` answers one row per peer and exits 3
+    when any peer is unreachable — the operator sees WHICH box is dark
+    instead of a traceback from the first dead socket."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "shadowctl.py"),
+         "--socket", str(tmp_path / "nope.sock"), "--retries", "0",
+         "status", "--peers", f"ghost={tmp_path}/ghost"],
+        capture_output=True, text=True, cwd=REPO, timeout=60,
+    )
+    assert proc.returncode == 3
+    row = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert row["peer"] == "ghost" and row["ok"] is False

@@ -130,6 +130,70 @@ def test_journal_torn_tail_and_corrupt_frame(tmp_path):
     assert journal_mod.scan(empty) == {"records": [], "truncated_at": None}
 
 
+def test_journal_lag_across_replay_boundary(tmp_path):
+    """`lag()` is the /healthz journal-lag gauge: records since the last
+    COMPLETE. It must stay truthful ACROSS a replay boundary — a
+    SIGKILL-torn tail is dropped exactly once (the reopening handle
+    reports `torn_tail_dropped`), the replayed records keep counting
+    toward lag, and a subsequent clean reopen reports no tear."""
+    path = str(tmp_path / "j.wal")
+    j = journal_mod.Journal(path)
+    j.append(journal_mod.SUBMIT, id="s0", tenant="t", doc={"x": 1})
+    j.append(journal_mod.ADMIT, id="s0", ckpt_dir="/d")
+    j.append(journal_mod.COMPLETE, id="s0", ok=True, results=[])
+    j.append(journal_mod.SUBMIT, id="s1", tenant="t", doc={"x": 2})
+    assert j.lag() == 1
+    j.append(journal_mod.ADMIT, id="s1", ckpt_dir="/d1")
+    assert j.lag() == 2
+    j.close()
+
+    # SIGKILL mid-append: the ADMIT frame is torn. The restarted
+    # incarnation drops it and lag resets to the surviving records
+    # (the SUBMIT after the last COMPLETE), not the pre-crash count.
+    blob = open(path, "rb").read()
+    open(path, "wb").write(blob[:-4])
+    j2 = journal_mod.Journal(path)
+    assert j2.torn_tail_dropped  # reported exactly once, by this handle
+    assert [r["type"] for r in j2.records][-1] == journal_mod.SUBMIT
+    assert j2.lag() == 1
+    # appends continue cleanly after the truncated tail; lag tracks them
+    j2.append(journal_mod.ADMIT, id="s1", ckpt_dir="/d1")
+    assert j2.lag() == 2
+    j2.append(journal_mod.COMPLETE, id="s1", ok=True, results=[])
+    assert j2.lag() == 0
+    j2.close()
+
+    # a clean reopen reports NO tear (the flag means "this incarnation
+    # dropped bytes", not "a tear ever happened")
+    j3 = journal_mod.Journal(path)
+    assert not j3.torn_tail_dropped
+    assert j3.lag() == 0
+    assert [s["id"] for s in j3.state().completed()] == ["s0", "s1"]
+    j3.close()
+
+
+def test_retry_after_zero_when_idle(tmp_path):
+    """Regression: an idle daemon must hint `retry_after_s == 0` — the
+    federation router's placement score treats the hint as queue wait,
+    so a floor of 1s made every idle peer look busy and fed the EWMA
+    sweep wall into placements that should have been free."""
+    from shadow_tpu.serve.daemon import ServeOptions, ShadowDaemon
+
+    daemon = ShadowDaemon(ServeOptions(
+        state_dir=str(tmp_path / "state"),
+        cache_dir=str(tmp_path / "cache"),
+    ))
+    daemon._avg_sweep_wall_s = 120.0  # a busy past must not leak
+    assert daemon.retry_after_s() == 0
+    assert daemon.health()["retry_after_s"] == 0
+    # with work queued the hint scales with depth x EWMA again
+    out = daemon.submit(_sweep_doc(jobs=2, lanes=1))
+    assert "id" in out
+    assert daemon.retry_after_s() >= 1
+    assert daemon.health()["retry_after_s"] >= 1
+    daemon.journal.close()
+
+
 # ---------------------------------------------------------------------------
 # kernel cache: roundtrip, corruption eviction, version skew, digest keys
 # ---------------------------------------------------------------------------

@@ -6,6 +6,7 @@ Talks HTTP over the daemon's unix socket (docs/serving.md):
     shadowctl.py --socket DIR/serve.sock health
     shadowctl.py --socket DIR/serve.sock submit sweep.yaml [--tenant t1]
     shadowctl.py --socket DIR/serve.sock status [SWEEP_ID]
+    shadowctl.py --socket DIR/route.sock status --peers a=DIR_A b=DIR_B
     shadowctl.py --socket DIR/serve.sock results SWEEP_ID [--wait SECS]
     shadowctl.py --socket DIR/serve.sock metrics
     shadowctl.py --socket DIR/serve.sock drain
@@ -35,9 +36,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--socket", required=True, metavar="PATH",
                    help="the daemon's unix socket (<state-dir>/serve.sock)")
     p.add_argument("--timeout", type=float, default=60.0, metavar="SECS")
+    p.add_argument("--retries", type=int, default=2, metavar="N",
+                   help="bounded in-client retries (jittered backoff) "
+                   "when the daemon socket refuses a connection — rides "
+                   "out a restart window instead of a bare traceback")
     sub = p.add_subparsers(dest="cmd", required=True)
     sub.add_parser("health", help="GET /healthz")
-    sub.add_parser("metrics", help="GET /metricz (schema-v8 serve.* + pressure.* doc)")
+    sub.add_parser("metrics", help="GET /metricz (the current-schema "
+                   "serve.* + pressure.* doc; federation.* on a router)")
     sub.add_parser("drain", help="graceful drain: flush the running "
                    "fleet to its checkpoint and exit")
     ps = sub.add_parser("submit", help="submit a sweep document")
@@ -49,6 +55,10 @@ def build_parser() -> argparse.ArgumentParser:
                     "saturate_pool) attached to this sweep")
     pst = sub.add_parser("status", help="list sweeps, or show one")
     pst.add_argument("id", nargs="?")
+    pst.add_argument("--peers", nargs="+", metavar="SPEC", default=None,
+                     help="federation members (NAME=STATE_DIR or bare "
+                     "STATE_DIR, docs/serving.md §7): print one health "
+                     "row per member instead of a single-daemon status")
     pr = sub.add_parser("results", help="print a sweep's per-job rows")
     pr.add_argument("id")
     pr.add_argument("--wait", type=float, metavar="SECS", default=None,
@@ -62,8 +72,46 @@ def main(argv: list[str] | None = None) -> int:
         ServeClient, ServeClientError, Shed,
     )
 
-    client = ServeClient(args.socket, timeout=args.timeout)
+    client = ServeClient(
+        args.socket, timeout=args.timeout, retries=args.retries
+    )
     try:
+        if args.cmd == "status" and getattr(args, "peers", None):
+            # federation fleet view (docs/serving.md §7): one line per
+            # member, best-effort — an unreachable peer is a row, not
+            # an error exit (that is exactly when you need the others)
+            import os
+
+            from shadow_tpu.serve.federation import parse_peer_spec
+
+            worst = 0
+            for spec in args.peers:
+                name, state_dir = parse_peer_spec(spec)
+                sock = os.path.join(state_dir, "serve.sock")
+                peer_client = ServeClient(
+                    sock, timeout=args.timeout, retries=args.retries
+                )
+                try:
+                    h = peer_client.health()
+                except ServeClientError as e:
+                    print(json.dumps({
+                        "peer": name, "ok": False, "unreachable": True,
+                        "error": str(e), "socket": sock,
+                    }))
+                    worst = 3
+                    continue
+                q = h.get("queue") or {}
+                print(json.dumps({
+                    "peer": name,
+                    "ok": h.get("ok"),
+                    "draining": h.get("draining"),
+                    "queue_depth": q.get("depth"),
+                    "running": q.get("running"),
+                    "journal_lag": (h.get("journal") or {}).get("lag"),
+                    "retry_after_s": h.get("retry_after_s"),
+                    "socket": sock,
+                }))
+            return worst
         if args.cmd == "health":
             print(json.dumps(client.health(), indent=1))
             return 0

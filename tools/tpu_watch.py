@@ -58,6 +58,8 @@ STAGES = [
     ("resilience_smoke", [PY, "bench.py", "--resilience-smoke"],
      False, 7200),
     ("serve_smoke", [PY, "bench.py", "--serve-smoke"], False, 7200),
+    ("federation_smoke", [PY, "bench.py", "--federation-smoke"],
+     False, 7200),
     ("pressure_smoke", [PY, "bench.py", "--pressure-smoke"], False, 7200),
     ("pipeline_smoke", [PY, "bench.py", "--pipeline-smoke"], False, 7200),
     ("hostplane_smoke", [PY, "bench.py", "--hostplane-smoke"],

@@ -2,9 +2,11 @@
 """Validate metrics JSON documents against the reference schema.
 
 A standalone CLI wrapper over `obs.metrics.validate_metrics_doc`
-(docs/observability.md, schema v8 — v8 added the `pressure.*`
-resource-pressure namespace; v7 added the `serve.*`
-sim-as-a-service daemon namespace): CI and tools/tpu_watch.py gate every
+(docs/observability.md; the schema version and per-namespace rules —
+including `--strict-namespaces` membership of the closed
+KNOWN_METRIC_NAMESPACES table, `federation.*` since schema v16 —
+come from obs/metrics.py, so this tool tracks every schema bump
+automatically): CI and tools/tpu_watch.py gate every
 captured metrics artifact with this at capture time, so a schema
 regression is caught on the line that produced it, not months later by a
 consumer.
