@@ -2663,6 +2663,214 @@ def stage_hostplane_smoke(hosts: int = 48, msgload: int = 2,
     }
 
 
+def stage_qdisc_smoke(stop_s: int = 3, wpd: int = 8):
+    """Per-interface scheduling-plane gate (ISSUE 19 acceptance).
+
+    Arms, all CPU-deterministic (no backend wait):
+
+    - default-compat: the SAME overloaded flood run three ways — no
+      qdisc section, explicit `qdisc: {discipline: fifo}`, and the
+      legacy `experimental.interface_qdisc: fifo` string — must produce
+      bit-identical audit chains (the discipline-interface reroute of
+      nic.py's send ring is invisible to default runs).
+    - eiffel-vs-exact: the bucketed discipline in its exactness regime
+      (fifo rank → rank spread 0 < B) against exact PIFO: chains AND the
+      full qdisc.* counter plane (enqueues/drops/sojourn) bit-identical.
+    - driver matrix: one pifo+wfq+codel config chain-identical under
+      {conservative, optimistic, async-islands(2), fleet} — the queue
+      plane is ordinary [H]-leading sub-state, so every execution engine
+      composes.
+    - separation: a bandwidth-starved udp_echo bufferbloat workload
+      (64-deep drop-tail ring vs pifo with the CoDel drop hook): the
+      FIFO arm's mean RTT must exceed the CoDel arm's by >= 1.5x —
+      the scheduling plane visibly changes end-to-end behavior, not
+      just counters.
+    - retrace-free + schema: zero kernel retraces on the pifo arm, and
+      its metrics artifact strict-validates at schema v17 with live
+      qdisc.* counters."""
+    import jax
+    import numpy as np
+
+    from shadow_tpu.analysis import hlo_audit
+    from shadow_tpu.fleet import JobSpec, build_fleet
+    from shadow_tpu.obs import metrics as obs_metrics
+    from shadow_tpu.sim import build_simulation
+
+    _enable_compile_cache()
+
+    # 400B datagram = 428B wire = ~34 ms at 100 Kbit, sent every 5 ms:
+    # the send queue must absorb a 7x overload
+    gml_slow = (
+        'graph [ node [ id 0 bandwidth_down "10 Mbit" '
+        'bandwidth_up "100 Kbit" ] '
+        'edge [ source 0 target 0 latency "10 ms" packet_loss 0.0 ] ]'
+    )
+
+    def flood_cfg(qdisc=None, seed=6, **exp):
+        experimental = {
+            "event_capacity": 4096, "events_per_host_per_window": 8,
+        }
+        experimental.update(exp)
+        cfg = {
+            "general": {"stop_time": stop_s, "seed": seed},
+            "network": {"graph": {"type": "gml", "inline": gml_slow}},
+            "experimental": experimental,
+            "hosts": {
+                "server": {"app_model": "udp_flood",
+                           "app_options": {"role": "server"},
+                           "bandwidth_down": "10 Mbit",
+                           "bandwidth_up": "10 Mbit"},
+                "client": {"quantity": 3, "app_model": "udp_flood",
+                           "app_options": {"interval": "5 ms",
+                                           "size": 400,
+                                           "runtime": stop_s - 1}},
+            },
+        }
+        if qdisc:
+            cfg["qdisc"] = qdisc
+        return cfg
+
+    def chain_of(sim):
+        return int(sim.audit_chain()), int(
+            sim.counters()["events_committed"]
+        )
+
+    def run(cfg, runner=None):
+        sim = build_simulation(cfg)
+        if runner is None:
+            sim.run(windows_per_dispatch=wpd)
+        else:
+            runner(sim)
+        return sim
+
+    # ---- default-compat arm ----
+    c_none = chain_of(run(flood_cfg()))
+    c_section = chain_of(run(flood_cfg(qdisc={"discipline": "fifo"})))
+    c_legacy = chain_of(run(flood_cfg(interface_qdisc="fifo")))
+    gate_default = c_none == c_section == c_legacy
+
+    # ---- eiffel-vs-exact parity arm (rank spread 0 < B = 8) ----
+    pifo_sim = run(flood_cfg(qdisc={"discipline": "pifo",
+                                    "queue_slots": 32}))
+    eiffel_sim = run(flood_cfg(qdisc={"discipline": "eiffel",
+                                      "queue_slots": 32, "buckets": 8}))
+    qp = jax.device_get(pifo_sim.state.subs["qdisc"])
+    qe = jax.device_get(eiffel_sim.state.subs["qdisc"])
+    counter_keys = ("enqueues", "dequeues", "drops_overflow", "drops_red",
+                    "drops_codel", "sojourn_sum", "depth_peak")
+    counters_equal = all(
+        bool((np.asarray(qp[k]) == np.asarray(qe[k])).all())
+        for k in counter_keys
+    )
+    gate_eiffel = bool(
+        chain_of(pifo_sim) == chain_of(eiffel_sim) and counters_equal
+    )
+
+    # ---- driver matrix arm ----
+    qfull = {"discipline": "pifo", "rank": "wfq", "drop": "codel",
+             "queue_slots": 32}
+    cons_sim = run(flood_cfg(qdisc=qfull))
+    c_cons = chain_of(cons_sim)
+    c_opt = chain_of(run(flood_cfg(qdisc=qfull),
+                         runner=lambda s: s.run_optimistic()))
+    c_isl = chain_of(run(flood_cfg(qdisc=qfull, num_shards=2,
+                                   exchange_slots=16)))
+    jobs = [JobSpec(f"j{i}", flood_cfg(qdisc=qfull, seed=6 + i))
+            for i in range(2)]
+    fl = build_fleet(jobs, lanes=2)
+    fl.run()
+    rows = {r["name"]: (r["audit"]["chain"], r["events_committed"])
+            for r in fl.results()}
+    gate_drivers = bool(
+        c_cons == c_opt == c_isl and rows.get("j0") == c_cons
+    )
+
+    # ---- separation arm: bufferbloat RTT, drop-tail vs CoDel ----
+    gml_echo = (
+        'graph [ '
+        'node [ id 0 bandwidth_down "10 Mbit" bandwidth_up "10 Mbit" ] '
+        'node [ id 1 bandwidth_down "10 Mbit" bandwidth_up "500 Kbit" ] '
+        'edge [ source 0 target 0 latency "1 ms" packet_loss 0.0 ] '
+        'edge [ source 1 target 1 latency "1 ms" packet_loss 0.0 ] '
+        'edge [ source 0 target 1 latency "5 ms" packet_loss 0.0 ] ]'
+    )
+
+    def echo_cfg(qdisc=None):
+        cfg = {
+            "general": {"stop_time": 8, "seed": 5},
+            "network": {"graph": {"type": "gml", "inline": gml_echo}},
+            "experimental": {"event_capacity": 4096,
+                             "events_per_host_per_window": 8},
+            "hosts": {
+                "server": {"network_node_id": 0, "app_model": "udp_echo",
+                           "app_options": {"role": "server"}},
+                "client": {"network_node_id": 1, "app_model": "udp_echo",
+                           "app_options": {"interval": "2 ms",
+                                           "size": 512, "runtime": 6}},
+            },
+        }
+        if qdisc:
+            cfg["qdisc"] = qdisc
+        return cfg
+
+    def rtt_mean_ms(sim):
+        sub = jax.device_get(sim.state.subs["udp_echo"])
+        n = int(np.sum(np.asarray(sub["rtt_count"])))
+        return (
+            float(np.sum(np.asarray(sub["rtt_sum"]))) / n / 1e6
+            if n else 0.0
+        )
+
+    rtt_fifo = rtt_mean_ms(run(echo_cfg()))
+    codel_sim = run(echo_cfg({"discipline": "pifo", "drop": "codel"}))
+    rtt_codel = rtt_mean_ms(codel_sim)
+    gate_separation = bool(
+        rtt_codel > 0 and rtt_fifo >= 1.5 * rtt_codel
+    )
+
+    # ---- retrace + schema arms (on the full-feature pifo sim) ----
+    retrace = hlo_audit.retrace_report(cons_sim)
+    gate_retrace = bool(retrace["ok"])
+
+    metrics_path = os.path.join(_REPO, "qdisc_smoke.metrics.json")
+    session = obs_metrics.ObsSession()
+    session.finalize(cons_sim)
+    doc = session.metrics.dump(metrics_path, meta={
+        "stage": "qdisc_smoke", "discipline": "pifo", "rank": "wfq",
+        "drop": "codel",
+    })
+    obs_metrics.validate_metrics_doc(doc, strict_namespaces=True)
+    gate_schema = bool(
+        doc["schema_version"] == obs_metrics.SCHEMA_VERSION
+        and doc["counters"].get("qdisc.enqueues", 0) > 0
+        and doc["counters"].get("qdisc.dequeues", 0) > 0
+    )
+
+    return {
+        "stage": "qdisc_smoke",
+        "platform": jax.default_backend(),
+        "chain": c_cons[0],
+        "events": c_cons[1],
+        "rtt_fifo_ms": round(rtt_fifo, 2),
+        "rtt_codel_ms": round(rtt_codel, 2),
+        "rtt_ratio": round(rtt_fifo / rtt_codel, 2) if rtt_codel else 0.0,
+        "qdisc": {k: int(np.sum(np.asarray(qp[k])))
+                  for k in counter_keys},
+        "kernel_compiles": int(retrace["compiles_total"]),
+        "metrics_out": os.path.relpath(metrics_path, _REPO),
+        "gate_default": bool(gate_default),
+        "gate_eiffel": gate_eiffel,
+        "gate_drivers": gate_drivers,
+        "gate_separation": gate_separation,
+        "gate_retrace": gate_retrace,
+        "gate_schema": gate_schema,
+        "gate": bool(
+            gate_default and gate_eiffel and gate_drivers
+            and gate_separation and gate_retrace and gate_schema
+        ),
+    }
+
+
 def stage_lint_smoke():
     """shadowlint gate (ISSUE 7 acceptance, extended by ISSUE 14): all
     FOUR static-analysis passes over the tree must report ZERO
@@ -2773,6 +2981,16 @@ def main():
         # backend — no backend wait.
         os.environ.setdefault("SHADOW_TPU_BENCH_ALLOW_CPU", "1")
         print(json.dumps(stage_hostplane_smoke()), flush=True)
+        return
+    if "--qdisc-smoke" in sys.argv:
+        # per-interface scheduling gate: default-FIFO arm bit-identical
+        # to pre-qdisc runs, eiffel-vs-exact chain parity, one pifo
+        # config chain-identical across {conservative, optimistic,
+        # islands, fleet}, drop-tail-vs-CoDel RTT separation on a
+        # bufferbloat workload, retrace-free, schema-v17 artifact.
+        # CPU-deterministic by design, so no backend wait.
+        os.environ.setdefault("SHADOW_TPU_BENCH_ALLOW_CPU", "1")
+        print(json.dumps(stage_qdisc_smoke()), flush=True)
         return
     if "--serve-smoke" in sys.argv:
         # sim-as-a-service gate: submit → SIGKILL the daemon → restart →

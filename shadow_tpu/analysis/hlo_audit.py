@@ -632,6 +632,7 @@ def default_ledger_variants(include_mesh: bool | None = None
 
     from shadow_tpu.flagship import SELF_LOOP_50MS_GML, build_phold_flagship
     from shadow_tpu.fleet import JobSpec, build_fleet
+    from shadow_tpu.sim import build_simulation
 
     if include_mesh is None:
         include_mesh = len(jax.devices()) >= 2
@@ -658,10 +659,43 @@ def default_ledger_variants(include_mesh: bool | None = None
             }},
         }
 
+    def qdisc_cfg(discipline):
+        # a NetStack workload (phold has none) with the device queue
+        # discipline at full feature load: wfq ranks + codel drop hook —
+        # the ledger cells that pin "no scatter, no sorts" for the
+        # compare-and-place / bucket-scan kernels
+        return {
+            "general": {"stop_time": "1 s", "seed": 4},
+            "network": {
+                "graph": {"type": "gml", "inline": SELF_LOOP_50MS_GML}
+            },
+            "experimental": {
+                "event_capacity": 1024, "events_per_host_per_window": 8,
+            },
+            "qdisc": {
+                "discipline": discipline, "rank": "wfq", "drop": "codel",
+                "queue_slots": 16, "buckets": 8,
+            },
+            "hosts": {
+                "server": {"app_model": "udp_flood",
+                           "app_options": {"role": "server"}},
+                "client": {
+                    "quantity": 7, "app_model": "udp_flood",
+                    "app_options": {"interval": "50 ms", "size": 400,
+                                    "runtime": 1},
+                },
+            },
+        }
+
     out: list[KernelVariant] = []
     out += variants_for_sim(tiny(), "global")
     out += variants_for_sim(
         tiny(num_shards=2, exchange_slots=16), "islands")
+    for disc in ("pifo", "eiffel"):
+        out += variants_for_sim(
+            build_simulation(qdisc_cfg(disc)), f"qdisc_{disc}",
+            sync_modes=("conservative",),
+        )
     out += variants_for_fleet(build_fleet(
         [JobSpec("a", fleet_cfg(1)), JobSpec("b", fleet_cfg(2))]))
     if include_mesh:

@@ -673,6 +673,123 @@ class FleetOptions:
 
 
 @dataclasses.dataclass
+class QdiscOptions:
+    """`qdisc` section: the per-interface scheduling plane
+    (shadow_tpu/net/qdisc). `discipline: fifo` (the default) keeps the
+    NIC's plain send ring — runs with no qdisc section are bit-identical
+    to pre-qdisc builds. pifo/eiffel own a device-resident `[H, Q]` queue
+    plane stepped inside the window kernel; every knob here shapes that
+    kernel, so sweep jobs may NOT vary this section (fleet/sweep
+    DATA_PATHS excludes it, same as experimental)."""
+
+    # fifo | roundrobin | pifo | eiffel ("fifo" defers to the legacy
+    # experimental.interface_qdisc string so old configs keep working)
+    discipline: str = "fifo"
+    rank: str = "fifo"  # fifo | prio | wfq
+    queue_slots: int = 64  # per-host queue capacity Q
+    buckets: int = 16  # eiffel: bucket count B
+    bucket_width: int = 1  # eiffel: rank units per bucket
+    classes: int = 4  # wfq/shaping flow classes
+    weights: Optional[list] = None  # per-class wfq weights (len == classes)
+    # per-class token-bucket shaping rates, class index → bandwidth
+    # (e.g. {0: "10 Mbit"}); empty = unshaped
+    shaping: dict = dataclasses.field(default_factory=dict)
+    drop: str = "none"  # none | red | codel
+    red_min_frac: float = 0.25
+    red_max_frac: float = 0.75
+    red_max_p: float = 0.1
+    # host-name-prefix → flow class pin (applies to every expanded host
+    # whose name starts with the prefix); unpinned hosts classify
+    # per-packet by socket slot
+    overrides: dict = dataclasses.field(default_factory=dict)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "QdiscOptions":
+        _check_fields(
+            "qdisc", d,
+            {"discipline", "rank", "queue_slots", "buckets", "bucket_width",
+             "classes", "weights", "shaping", "drop", "red_min_frac",
+             "red_max_frac", "red_max_p", "overrides"},
+        )
+        out = cls()
+        if "discipline" in d:
+            v = str(d["discipline"]).lower()
+            if v not in ("fifo", "roundrobin", "pifo", "eiffel"):
+                raise ConfigError(
+                    f"qdisc.discipline must be fifo|roundrobin|pifo|eiffel, "
+                    f"got {v!r}"
+                )
+            out.discipline = v
+        if "rank" in d:
+            v = str(d["rank"]).lower()
+            if v not in ("fifo", "prio", "wfq"):
+                raise ConfigError(
+                    f"qdisc.rank must be fifo|prio|wfq, got {v!r}"
+                )
+            out.rank = v
+        for k in ("queue_slots", "buckets", "bucket_width", "classes"):
+            if k in d:
+                setattr(out, k, int(d[k]))
+        if out.queue_slots < 1:
+            raise ConfigError("qdisc.queue_slots must be >= 1")
+        if out.buckets < 2:
+            raise ConfigError("qdisc.buckets must be >= 2")
+        if out.bucket_width < 1:
+            raise ConfigError("qdisc.bucket_width must be >= 1")
+        if out.classes < 1:
+            raise ConfigError("qdisc.classes must be >= 1")
+        if d.get("weights") is not None:
+            out.weights = [float(w) for w in d["weights"]]
+            if len(out.weights) != out.classes:
+                raise ConfigError(
+                    f"qdisc.weights length {len(out.weights)} != classes "
+                    f"{out.classes}"
+                )
+            if any(w <= 0 for w in out.weights):
+                raise ConfigError("qdisc.weights must be > 0")
+        for c, bw in (d.get("shaping") or {}).items():
+            ci = int(c)
+            if not (0 <= ci < out.classes):
+                raise ConfigError(
+                    f"qdisc.shaping class {ci} out of range [0, "
+                    f"{out.classes})"
+                )
+            out.shaping[ci] = units.parse_bits(bw)
+        if "drop" in d:
+            v = str(d["drop"]).lower()
+            if v not in ("none", "red", "codel"):
+                raise ConfigError(
+                    f"qdisc.drop must be none|red|codel, got {v!r}"
+                )
+            out.drop = v
+        for k in ("red_min_frac", "red_max_frac", "red_max_p"):
+            if k in d:
+                setattr(out, k, float(d[k]))
+        if not (0.0 <= out.red_min_frac < out.red_max_frac <= 1.0):
+            raise ConfigError(
+                "qdisc red thresholds need "
+                "0 <= red_min_frac < red_max_frac <= 1"
+            )
+        if not (0.0 < out.red_max_p <= 1.0):
+            raise ConfigError("qdisc.red_max_p must be in (0, 1]")
+        for prefix, c in (d.get("overrides") or {}).items():
+            ci = int(c)
+            if not (0 <= ci < out.classes):
+                raise ConfigError(
+                    f"qdisc.overrides[{prefix!r}] class {ci} out of range "
+                    f"[0, {out.classes})"
+                )
+            out.overrides[str(prefix)] = ci
+        if out.discipline in ("fifo", "roundrobin"):
+            for k in ("rank", "drop"):
+                if getattr(out, k) != cls.__dataclass_fields__[k].default:
+                    raise ConfigError(
+                        f"qdisc.{k} requires discipline pifo|eiffel"
+                    )
+        return out
+
+
+@dataclasses.dataclass
 class Config:
     general: GeneralOptions
     network: NetworkOptions
@@ -680,6 +797,7 @@ class Config:
     hosts: list[HostOptions]
     faults: FaultOptions = dataclasses.field(default_factory=FaultOptions)
     fleet: FleetOptions = dataclasses.field(default_factory=FleetOptions)
+    qdisc: QdiscOptions = dataclasses.field(default_factory=QdiscOptions)
     # raw `sweep:` section, if present: expanded by shadow_tpu/fleet/sweep
     # (the `sweep` CLI subcommand); the single-run CLI refuses such files
     # with a pointer there instead of silently running only the base config
@@ -690,7 +808,7 @@ class Config:
         _check_fields(
             "config", d,
             {"general", "network", "experimental", "host_defaults", "hosts",
-             "faults", "fleet", "sweep"},
+             "faults", "fleet", "qdisc", "sweep"},
         )
         if "general" not in d:
             raise ConfigError("general section is required")
@@ -701,6 +819,7 @@ class Config:
         experimental = ExperimentalOptions.from_dict(d.get("experimental") or {})
         faults = FaultOptions.from_dict(d.get("faults") or {})
         fleet = FleetOptions.from_dict(d.get("fleet") or {})
+        qdisc = QdiscOptions.from_dict(d.get("qdisc") or {})
         defaults = d.get("host_defaults") or {}
         hosts: list[HostOptions] = []
         for name, hd in (d.get("hosts") or {}).items():
@@ -709,7 +828,7 @@ class Config:
         # the reference's BTreeMap iteration (configuration.rs:75-76).
         hosts.sort(key=lambda h: h.name)
         return cls(general, network, experimental, hosts, faults, fleet,
-                   d.get("sweep"))
+                   qdisc, d.get("sweep"))
 
     def graph_gml(self) -> str:
         g = self.network.graph

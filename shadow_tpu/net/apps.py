@@ -24,6 +24,47 @@ from shadow_tpu.core.state import (
 from shadow_tpu.net import link, packet as pkt
 
 
+def ring_offset_dst(u, my_id, span, num_hosts):
+    """Map a uniform draw to a destination a nonzero ring offset in
+    [-span..-1, 1..span] away from my_id (mod num_hosts) — the shared
+    topology-locality generator (PHOLD's local_span forwarding and any
+    neighborhood-biased traffic shape)."""
+    off = jnp.clip(
+        jnp.floor(u * (2 * span)).astype(jnp.int32), 0, 2 * span - 1
+    ) - span
+    off = off + (off >= 0)  # skip 0
+    return ((jnp.asarray(my_id, jnp.int32) + off) % num_hosts).astype(
+        jnp.int32
+    )
+
+
+def locality_targets(num_hosts, anchors, local_span):
+    """Static host→anchor table shaped by ring locality: hosts within
+    local_span circular hops of some anchor target their nearest one
+    (ties to the earlier anchor), the rest fall back to round-robin.
+    local_span 0 = pure round-robin — the classic flood fan-in. Build-time
+    numpy ([H] int32); riding in an app sub keeps it islands-shardable."""
+    import numpy as np
+
+    anchors = list(anchors)
+    tgt = np.array(
+        [anchors[i % len(anchors)] for i in range(num_hosts)],
+        dtype=np.int32,
+    )
+    if local_span <= 0:
+        return tgt
+    for h in range(num_hosts):
+        best, bd = None, None
+        for a in anchors:
+            d = abs(h - a)
+            d = min(d, num_hosts - d)
+            if bd is None or d < bd:
+                best, bd = a, d
+        if bd <= local_span:
+            tgt[h] = best
+    return tgt
+
+
 class PholdApp:
     """PHOLD: each received message is forwarded to a random peer over the
     simulated network; message population = hosts × msgload; senders stop
@@ -144,14 +185,7 @@ class PholdApp:
         variant draws a nonzero ring offset in [-span, span]."""
         H = self.num_hosts
         if self.local_span > 0:
-            span = self.local_span
-            off = jnp.clip(
-                jnp.floor(u * (2 * span)).astype(jnp.int32), 0, 2 * span - 1
-            ) - span
-            off = off + (off >= 0)  # skip 0: offsets in [-span..-1, 1..span]
-            return ((jnp.asarray(my_id, jnp.int32) + off) % H).astype(
-                jnp.int32
-            )
+            return ring_offset_dst(u, my_id, self.local_span, H)
         if self.hot_n > 0:
             hs = self.hot_share
             nh = self.hot_n
@@ -258,11 +292,20 @@ class UdpFloodApp:
         size_bytes: int = 1024,
         start_time: int = simtime.NS_PER_SEC,
         stop_sending: int | None = None,
+        local_span: int = 0,
     ):
         self.num_hosts = num_hosts
         self.server_hosts = list(server_hosts)
         self.interval_ns = int(interval_ns)
         self.size_bytes = int(size_bytes)
+        # locality-shaped fan-in: clients within local_span ring hops of a
+        # server flood THAT server (the incast aggregation shape); 0 keeps
+        # the classic round-robin spread
+        self.local_span = int(local_span)
+        if self.local_span < 0 or self.local_span >= num_hosts:
+            raise ValueError(
+                "udp_flood local_span must be in [0, num_hosts)"
+            )
         if self.size_bytes > pkt.MTU - pkt.UDP_HEADER_BYTES:
             raise ValueError(
                 f"datagram size {self.size_bytes} exceeds MTU payload "
@@ -278,15 +321,13 @@ class UdpFloodApp:
         role = np.ones(self.num_hosts, dtype=np.int32)
         role[self.server_hosts] = 0
         self._role = jnp.asarray(role)
-        # clients target servers round-robin
-        tgt = np.array(
-            [
-                self.server_hosts[i % len(self.server_hosts)]
-                for i in range(self.num_hosts)
-            ],
-            dtype=np.int32,
+        # clients target servers round-robin, locality-biased when
+        # local_span is set
+        self._target = jnp.asarray(
+            locality_targets(
+                self.num_hosts, self.server_hosts, self.local_span
+            )
         )
-        self._target = jnp.asarray(tgt)
         for s in self.server_hosts:
             stack.bind_udp(s, 0, SERVER_PORT)
         for h in range(self.num_hosts):

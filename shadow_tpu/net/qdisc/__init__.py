@@ -1,0 +1,149 @@
+"""Per-interface scheduling plane: the qdisc discipline interface.
+
+The reference models exactly two egress disciplines (fifo-by-priority and
+round-robin-over-sockets, network_queuing_disciplines.c); everything else —
+PIFO-style programmable scheduling, Eiffel's bucketed approximate priority
+queues, WFQ, shaping, AQM drops on the SEND side — is out of its reach.
+This package lifts the egress queue behind a small discipline interface so
+the NIC send pump (net/stack.py) is policy-agnostic:
+
+  nonempty(state)                      -> [H] bool
+  enqueue(state, mask, dst, payload, now) -> (state, admitted)
+  dequeue(state, now, want)            -> (state, sent, payload, dst)
+  note_direct(state, mask, payload)    -> state   (uncontended fast path)
+
+Two families implement it:
+
+- ``fifo`` / ``roundrobin`` wrap the existing per-host NIC send ring
+  (net/nic.py) unchanged — zero new state, and the default ``fifo`` arm is
+  bit-identical to pre-qdisc builds (the compat regression test pins the
+  audit chains).
+- ``pifo`` / ``eiffel`` own a `subs["qdisc"]` SoA plane of fixed-capacity
+  [H, Q] rings (every leaf [H]-leading, so islands sharding, fleet
+  stacking, checkpoint slices and rollback all compose for free), with
+  rank functions (qdisc/ranks.py: fifo / prio / wfq virtual finish times +
+  token-bucket shaping as a rank-eligibility term) and drop policies
+  (qdisc/drops.py: deterministic RED at enqueue, CoDel — folded in from
+  net/codel.py — as a dequeue hook).
+
+Kernel-shape discipline: no scatters (soa.set_at one-hot writes), no sorts
+(PIFO inserts by masked compare-and-place, Eiffel dequeues by argmin over a
+circular bucket scan) — the HLO ledger carries a variant cell per
+discipline to keep it that way.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from shadow_tpu.net import nic
+
+SUB = "qdisc"
+
+
+class Discipline:
+    """Egress-discipline interface the send pump drives.
+
+    Implementations operate on the whole SimState so ring-wrapping
+    disciplines (fifo/roundrobin) can reuse the NIC sub while
+    device-queue disciplines (pifo/eiffel) own their own sub plane.
+    """
+
+    name = "base"
+
+    def attach(self, stack) -> None:
+        """Bind build-time stack facts (host count, payload width,
+        sockets per host). Called once from NetStack.__init__."""
+
+    def init_subs(self) -> dict:
+        """Extra SimState subs this discipline owns ({} for ring
+        wrappers)."""
+        return {}
+
+    def nonempty(self, state):
+        raise NotImplementedError
+
+    def enqueue(self, state, mask, dst, payload, now):
+        raise NotImplementedError
+
+    def dequeue(self, state, now, want):
+        raise NotImplementedError
+
+    def note_direct(self, state, mask, payload):
+        """Observe a packet that took the uncontended direct-send path
+        (bypassing the queue). Only round-robin needs it (last-served
+        socket bookkeeping)."""
+        return state
+
+
+class FifoDiscipline(Discipline):
+    """The reference's default qdisc: the NIC ring in arrival order
+    (arrival order IS priority order for device apps)."""
+
+    name = "fifo"
+
+    def nonempty(self, state):
+        n = state.subs[nic.SUB]
+        return n.q_head < n.q_tail
+
+    def enqueue(self, state, mask, dst, payload, now):
+        n, ok = nic.enqueue_send(state.subs[nic.SUB], mask, dst, payload)
+        return state.with_sub(nic.SUB, n), ok
+
+    def dequeue(self, state, now, want):
+        n = state.subs[nic.SUB]
+        payload, dst, has_pkt = nic.peek_send(n)
+        do = want & has_pkt
+        n = nic.pop_send(n, do)
+        return state.with_sub(nic.SUB, n), do, payload, dst
+
+
+class RoundRobinDiscipline(Discipline):
+    """Round-robin over sockets (network_queuing_disciplines.c RR): the
+    next non-empty socket after the last-served one sends its oldest
+    packet; mid-ring slots are consumed via the taken-mask helpers."""
+
+    name = "roundrobin"
+
+    def __init__(self):
+        self.sockets_per_host = 8
+
+    def attach(self, stack) -> None:
+        self.sockets_per_host = stack.sockets_per_host
+
+    def nonempty(self, state):
+        n = state.subs[nic.SUB]
+        return n.q_head < n.q_tail
+
+    def enqueue(self, state, mask, dst, payload, now):
+        n, ok = nic.enqueue_send(state.subs[nic.SUB], mask, dst, payload)
+        return state.with_sub(nic.SUB, n), ok
+
+    def dequeue(self, state, now, want):
+        n = state.subs[nic.SUB]
+        payload, dst, has_pkt, rr_slot = nic.peek_send_rr(
+            n, self.sockets_per_host
+        )
+        do = want & has_pkt
+        n = nic.pop_send_rr(n, do, rr_slot)
+        return state.with_sub(nic.SUB, n), do, payload, dst
+
+    def note_direct(self, state, mask, payload):
+        from shadow_tpu.net import packet as pkt
+
+        n = state.subs[nic.SUB]
+        n = n.replace(last_socket=jnp.where(
+            mask, payload[:, pkt.W_SOCKET], n.last_socket
+        ))
+        return state.with_sub(nic.SUB, n)
+
+
+def make_discipline(qdisc: str) -> Discipline:
+    """Legacy-string constructor (experimental.interface_qdisc values).
+    Device-queue disciplines (pifo/eiffel) carry config and are built by
+    sim.py from the `qdisc:` section instead."""
+    if qdisc == "fifo":
+        return FifoDiscipline()
+    if qdisc == "roundrobin":
+        return RoundRobinDiscipline()
+    raise ValueError(f"unknown qdisc {qdisc!r}")

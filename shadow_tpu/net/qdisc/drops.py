@@ -1,0 +1,183 @@
+"""Drop policies for the PIFO/Eiffel disciplines.
+
+Two hooks, both deterministic (no RNG — reruns chain-prove identical):
+
+- RED at enqueue: an EWMA of queue depth (fixed-point, weight 1/8) gates a
+  count-based early-drop schedule — between min and max thresholds every
+  ceil(1/p)-th admission is dropped where p ramps linearly to max_p, at or
+  above max everything drops. The classic gentle-RED shape with the
+  probabilistic coin replaced by the deterministic inter-drop count (the
+  expectation of the geometric draw), which is what a chain-provable
+  simulator wants anyway.
+
+- CoDel at dequeue: the existing router AQM's target/interval control law
+  (net/codel.py) folded in as a drop hook over the discipline's own pop —
+  the constants, the control law, and the store/drop-mode state machine
+  are IMPORTED from net/codel.py, not re-implemented, so the two paths
+  cannot drift (the parity test drives both against the same schedule).
+
+The pop callable a discipline supplies has signature
+  pop(qd, want) -> (qd, have, payload, dst, enq_ts, empty_hit)
+and must already have decremented qd["q_bytes"] for the popped packet
+(CoDel's "good" test reads the post-pop backlog, exactly like
+codel._pop_helper's new_total).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from shadow_tpu.net import packet as pkt
+from shadow_tpu.net.codel import (
+    DROP_UNROLL,
+    INTERVAL_NS,
+    TARGET_NS,
+    _control_law,
+)
+
+# fixed-point shifts for the RED average: depth carried as depth << 8,
+# EWMA weight 1/8
+RED_FP_SHIFT = 8
+RED_W_SHIFT = 3
+
+DROP_NAMES = ("none", "red", "codel")
+
+
+class RedConfig:
+    def __init__(self, queue_slots: int, min_frac: float, max_frac: float,
+                 max_p: float):
+        if not (0.0 <= min_frac < max_frac <= 1.0):
+            raise ValueError(
+                "qdisc red thresholds need 0 <= min_frac < max_frac <= 1"
+            )
+        if not (0.0 < max_p <= 1.0):
+            raise ValueError("qdisc red_max_p must be in (0, 1]")
+        self.min_fp = int(min_frac * queue_slots) << RED_FP_SHIFT
+        self.max_fp = int(max_frac * queue_slots) << RED_FP_SHIFT
+        if self.max_fp <= self.min_fp:
+            self.max_fp = self.min_fp + (1 << RED_FP_SHIFT)
+        self.max_p = float(max_p)
+
+
+def red_enqueue(qd: dict, attempt, depth, red: RedConfig | None):
+    """EWMA + deterministic early drop. `attempt` masks admission
+    attempts that have ring room; `depth` is the pre-enqueue queue depth
+    [H] i64. Returns (qd, drop [H] bool)."""
+    if red is None:
+        return qd, jnp.zeros(attempt.shape, bool)
+    qd = dict(qd)
+    avg = qd["red_avg"]
+    avg = jnp.where(
+        attempt,
+        avg + (((depth << RED_FP_SHIFT) - avg) >> RED_W_SHIFT),
+        avg,
+    )
+    over = avg >= red.max_fp
+    between = (avg >= red.min_fp) & ~over
+    # deterministic inter-drop spacing: ceil(1/p) admissions per drop,
+    # p ramping linearly min→max threshold (float64 like the codel law —
+    # [H] control math, not the packet fast path)
+    p = red.max_p * (avg - red.min_fp).astype(jnp.float64) / float(
+        red.max_fp - red.min_fp
+    )
+    interval = jnp.ceil(1.0 / jnp.maximum(p, 1e-9)).astype(jnp.int64)
+    cnt = qd["red_count"] + attempt.astype(jnp.int64)
+    drop = attempt & (over | (between & (cnt >= interval)))
+    qd["red_avg"] = avg
+    # the counter runs only inside the ramp region; a drop (or leaving
+    # the region) restarts the spacing
+    qd["red_count"] = jnp.where(drop | ~between, 0, cnt)
+    qd["drops_red"] = qd["drops_red"] + drop.astype(jnp.int64)
+    return qd, drop
+
+
+def _pop_bookkeeping(pop, qd, now, want):
+    """One masked pop with CoDel sojourn bookkeeping — the discipline-
+    generic form of codel._pop_helper. Returns
+    (qd, have, payload, dst, enq_ts, ok_to_drop)."""
+    ie0 = qd["interval_expire"]
+    qd, have, payload, dst, enq_ts, empty_hit = pop(qd, want)
+    sojourn = now - enq_ts
+    good = (sojourn < TARGET_NS) | (qd["q_bytes"] < pkt.MTU)
+
+    # good state: reset interval expiration
+    ie = jnp.where(have & good, 0, ie0)
+    # bad state, first time: arm the interval
+    entering_bad = have & ~good & (ie0 == 0)
+    ie = jnp.where(entering_bad, now + INTERVAL_NS, ie)
+    # bad state, sustained a full interval: ok to drop
+    ok_to_drop = have & ~good & (ie0 != 0) & (now >= ie0)
+    # empty queue resets the interval expiration
+    ie = jnp.where(empty_hit, 0, ie)
+
+    qd = dict(qd)
+    qd["interval_expire"] = ie
+    return qd, have, payload, dst, enq_ts, ok_to_drop
+
+
+def plain_dequeue(pop, qd: dict, now, mask):
+    """No dequeue-side AQM: a single masked pop."""
+    qd, have, payload, dst, enq_ts, _empty = pop(qd, mask)
+    return qd, have, payload, dst, enq_ts
+
+
+def codel_dequeue(pop, qd: dict, now, mask):
+    """CoDel dequeue over a discipline pop — net/codel.py's dequeue state
+    machine verbatim, with the ring pop abstracted and drops tallied
+    per-host in qd["drops_codel"]. Returns
+    (qd, have, payload, dst, enq_ts)."""
+    qd, have, payload, dst, enq_ts, ok = _pop_bookkeeping(pop, qd, now, mask)
+
+    # empty → store mode
+    qd["drop_mode"] = jnp.where(mask & ~have, False, qd["drop_mode"])
+
+    in_drop = mask & have & qd["drop_mode"]
+    # delays low again → leave drop mode
+    qd["drop_mode"] = jnp.where(in_drop & ~ok, False, qd["drop_mode"])
+
+    # drop-mode loop: drop while now >= next_drop (bounded unroll). `ok`
+    # tracks the okToDrop verdict of the packet CURRENTLY in hand.
+    for _ in range(DROP_UNROLL):
+        cond = mask & have & qd["drop_mode"] & (now >= qd["next_drop"])
+        qd["drops_codel"] = qd["drops_codel"] + cond.astype(jnp.int64)
+        qd["drop_count"] = qd["drop_count"] + cond.astype(jnp.int32)
+        qd, have2, payload2, dst2, enq2, ok2 = _pop_bookkeeping(
+            pop, qd, now, cond
+        )
+        have = jnp.where(cond, have2, have)
+        payload = jnp.where(cond[:, None], payload2, payload)
+        dst = jnp.where(cond, dst2, dst)
+        enq_ts = jnp.where(cond, enq2, enq_ts)
+        ok = jnp.where(cond, ok2, ok)
+        qd["next_drop"] = jnp.where(
+            cond & ok2,
+            _control_law(qd["drop_count"], qd["next_drop"]),
+            qd["next_drop"],
+        )
+        qd["drop_mode"] = jnp.where(cond & ~ok2, False, qd["drop_mode"])
+
+    # store mode but the packet in hand should now drop: drop it, enter
+    # drop mode
+    trans = mask & have & ~qd["drop_mode"] & ok
+    qd["drops_codel"] = qd["drops_codel"] + trans.astype(jnp.int64)
+    qd, have3, payload3, dst3, enq3, _ok3 = _pop_bookkeeping(
+        pop, qd, now, trans
+    )
+    have = jnp.where(trans, have3, have)
+    payload = jnp.where(trans[:, None], payload3, payload)
+    dst = jnp.where(trans, dst3, dst)
+    enq_ts = jnp.where(trans, enq3, enq_ts)
+    delta = qd["drop_count"] - qd["drop_count_last"]
+    recently = now < (qd["next_drop"] + 16 * INTERVAL_NS)
+    new_count = jnp.where(recently & (delta > 1), delta, 1).astype(jnp.int32)
+    qd["drop_mode"] = jnp.where(trans, True, qd["drop_mode"])
+    qd["drop_count"] = jnp.where(trans, new_count, qd["drop_count"])
+    qd["next_drop"] = jnp.where(
+        trans,
+        _control_law(new_count, jnp.broadcast_to(now, new_count.shape)),
+        qd["next_drop"],
+    )
+    qd["drop_count_last"] = jnp.where(
+        trans, new_count, qd["drop_count_last"]
+    )
+    return qd, have, payload, dst, enq_ts

@@ -1,0 +1,218 @@
+"""Exact PIFO: rank-ordered push-in-first-out via masked compare-and-place.
+
+The queue is a per-host sorted array — slots 0..len-1 hold packets ordered
+by (rank, enqueue seq) ascending. Enqueue computes the insertion position
+with one broadcast compare (stable: equal ranks keep arrival order),
+then materializes the insert as two elementwise selects over a
+shift-right; dequeue takes slot 0 and shift-lefts. No scatters, no sorts —
+the whole [H, Q] plane moves as full-bandwidth selects, which is why Q
+should stay modest (the Eiffel variant is the layout-friendly path for
+large Q: O(1)-ish bucket scan instead of O(Q) shift traffic per op).
+
+`DeviceQueueDiscipline` here is also the shared base for qdisc/eiffel.py:
+admission (overflow + RED), rank computation, drop-hook dispatch and the
+qdisc.* counter plane are common; only the ring representation
+(_room/_depth/_insert/_pop) differs.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from shadow_tpu.net import packet as pkt
+from shadow_tpu.net.qdisc import SUB, Discipline, drops, ranks
+
+
+def _shift_insert(arr, ok, pos, val):
+    """Insert val at pos, shifting slots pos.. right by one, where ok."""
+    Q = arr.shape[1]
+    j = jnp.arange(Q, dtype=jnp.int32)
+    shifted = jnp.concatenate([arr[:, :1], arr[:, :-1]], axis=1)
+    if arr.ndim == 3:
+        jj, pp = j[None, :, None], pos[:, None, None]
+        ins = jnp.where(
+            jj < pp, arr,
+            jnp.where(jj == pp, jnp.asarray(val, arr.dtype)[:, None, :],
+                      shifted),
+        )
+        return jnp.where(ok[:, None, None], ins, arr)
+    jj, pp = j[None, :], pos[:, None]
+    v = jnp.asarray(val, arr.dtype)
+    if v.ndim == 1:
+        v = v[:, None]
+    ins = jnp.where(jj < pp, arr, jnp.where(jj == pp, v, shifted))
+    return jnp.where(ok[:, None], ins, arr)
+
+
+def _shift_left(arr, have):
+    shifted = jnp.concatenate([arr[:, 1:], arr[:, -1:]], axis=1)
+    if arr.ndim == 3:
+        return jnp.where(have[:, None, None], shifted, arr)
+    return jnp.where(have[:, None], shifted, arr)
+
+
+class DeviceQueueDiscipline(Discipline):
+    """Shared machinery for the device-queue disciplines (pifo/eiffel):
+    owns the `subs["qdisc"]` SoA plane (every leaf [H]-leading — islands
+    sharding, fleet stacking, checkpoints and rollback compose for
+    free), admission with RED, rank functions, and the CoDel drop hook."""
+
+    def __init__(self, queue_slots: int = 64, ranker: ranks.Ranker | None = None,
+                 drop: str = "none", red: drops.RedConfig | None = None,
+                 host_class=None):
+        if drop not in drops.DROP_NAMES:
+            raise ValueError(f"unknown qdisc drop {drop!r}")
+        self.queue_slots = int(queue_slots)
+        self.ranker = ranker or ranks.FifoRank()
+        self.drop = drop
+        self.red = red if drop == "red" else None
+        self.host_class = host_class  # [H] ints or None (per-socket classes)
+        self.num_hosts = 0
+        self.payload_words = 12
+
+    def attach(self, stack) -> None:
+        self.num_hosts = stack.num_hosts
+        self.payload_words = stack.payload_words
+
+    def init_subs(self) -> dict:
+        import numpy as np
+
+        H, Q, P = self.num_hosts, self.queue_slots, self.payload_words
+        C = self.ranker.classes
+        if self.host_class is None:
+            cls = jnp.full((H,), -1, jnp.int32)
+        else:
+            cls = jnp.asarray(np.asarray(self.host_class, np.int32))
+        z64 = lambda: jnp.zeros((H,), jnp.int64)  # noqa: E731
+        qd = {
+            "q_payload": jnp.zeros((H, Q, P), jnp.int32),
+            "q_dst": jnp.zeros((H, Q), jnp.int32),
+            "q_rank": jnp.zeros((H, Q), jnp.int64),
+            "q_seq": jnp.zeros((H, Q), jnp.int64),
+            "q_enq_ts": jnp.zeros((H, Q), jnp.int64),
+            "q_bytes": z64(),
+            "seq": z64(),
+            "cls": cls,
+            # wfq virtual clock + per-class finish times; shaping spacing
+            "vtime": z64(),
+            "finish": jnp.zeros((H, C), jnp.int64),
+            "shape_next": jnp.zeros((H, C), jnp.int64),
+            # codel drop-hook state (net/codel.py state machine)
+            "drop_mode": jnp.zeros((H,), bool),
+            "interval_expire": z64(),
+            "next_drop": z64(),
+            "drop_count": jnp.zeros((H,), jnp.int32),
+            "drop_count_last": jnp.zeros((H,), jnp.int32),
+            # red state
+            "red_avg": z64(),
+            "red_count": z64(),
+            # observability counters (schema v17 qdisc.*)
+            "enqueues": z64(),
+            "dequeues": z64(),
+            "drops_overflow": z64(),
+            "drops_red": z64(),
+            "drops_codel": z64(),
+            "sojourn_sum": z64(),
+            "depth_peak": z64(),
+        }
+        qd.update(self._init_ring(H, Q))
+        return {SUB: qd}
+
+    # ---- representation hooks (pifo: sorted array) ----
+
+    def _init_ring(self, H: int, Q: int) -> dict:
+        return {"q_len": jnp.zeros((H,), jnp.int32)}
+
+    def _room(self, qd):
+        return qd["q_len"] < self.queue_slots
+
+    def _depth(self, qd):
+        return qd["q_len"].astype(jnp.int64)
+
+    def _insert(self, qd, ok, rank, dst, payload, now):
+        Q = self.queue_slots
+        j = jnp.arange(Q, dtype=jnp.int32)[None, :]
+        valid = j < qd["q_len"][:, None]
+        # stable compare-and-place: existing equal-rank packets carry
+        # smaller seqs, so the new packet lands after them
+        pos = jnp.sum(
+            valid & (qd["q_rank"] <= rank[:, None]), axis=1
+        ).astype(jnp.int32)
+        qd = dict(qd)
+        qd["q_payload"] = _shift_insert(qd["q_payload"], ok, pos, payload)
+        qd["q_dst"] = _shift_insert(
+            qd["q_dst"], ok, pos, dst.astype(jnp.int32)
+        )
+        qd["q_rank"] = _shift_insert(qd["q_rank"], ok, pos, rank)
+        qd["q_seq"] = _shift_insert(qd["q_seq"], ok, pos, qd["seq"])
+        qd["q_enq_ts"] = _shift_insert(
+            qd["q_enq_ts"], ok, pos, now.astype(jnp.int64)
+        )
+        qd["q_len"] = qd["q_len"] + ok.astype(jnp.int32)
+        return qd
+
+    def _pop(self, qd, want):
+        qd = dict(qd)
+        present = qd["q_len"] > 0
+        have = want & present
+        empty_hit = want & ~present
+        payload = qd["q_payload"][:, 0]
+        dst = qd["q_dst"][:, 0]
+        enq_ts = qd["q_enq_ts"][:, 0]
+        rank = qd["q_rank"][:, 0]
+        size = pkt.total_bytes(payload).astype(jnp.int64)
+        for k in ("q_payload", "q_dst", "q_rank", "q_seq", "q_enq_ts"):
+            qd[k] = _shift_left(qd[k], have)
+        qd["q_len"] = qd["q_len"] - have.astype(jnp.int32)
+        qd["q_bytes"] = qd["q_bytes"] - jnp.where(have, size, 0)
+        qd["vtime"] = jnp.where(
+            have, jnp.maximum(qd["vtime"], rank), qd["vtime"]
+        )
+        return qd, have, payload, dst, enq_ts, empty_hit
+
+    # ---- Discipline interface ----
+
+    def nonempty(self, state):
+        return self._depth(state.subs[SUB]) > 0
+
+    def enqueue(self, state, mask, dst, payload, now):
+        qd = dict(state.subs[SUB])
+        now64 = now.astype(jnp.int64)
+        depth = self._depth(qd)
+        room = self._room(qd)
+        attempt = mask & room
+        qd, red_drop = drops.red_enqueue(qd, attempt, depth, self.red)
+        ok = attempt & ~red_drop
+        size = pkt.total_bytes(payload).astype(jnp.int64)
+        qd, rank = self.ranker.rank(qd, ok, payload, now64, size)
+        qd = self._insert(qd, ok, rank, dst, payload, now64)
+        qd["q_bytes"] = qd["q_bytes"] + jnp.where(ok, size, 0)
+        qd["seq"] = qd["seq"] + ok.astype(jnp.int64)
+        qd["enqueues"] = qd["enqueues"] + ok.astype(jnp.int64)
+        qd["drops_overflow"] = (
+            qd["drops_overflow"] + (mask & ~room).astype(jnp.int64)
+        )
+        qd["depth_peak"] = jnp.maximum(
+            qd["depth_peak"], depth + ok.astype(jnp.int64)
+        )
+        return state.with_sub(SUB, qd), ok
+
+    def dequeue(self, state, now, want):
+        qd = dict(state.subs[SUB])
+        if self.drop == "codel":
+            qd, have, payload, dst, enq_ts = drops.codel_dequeue(
+                self._pop, qd, now, want
+            )
+        else:
+            qd, have, payload, dst, enq_ts = drops.plain_dequeue(
+                self._pop, qd, now, want
+            )
+        qd["dequeues"] = qd["dequeues"] + have.astype(jnp.int64)
+        qd["sojourn_sum"] = qd["sojourn_sum"] + jnp.where(
+            have, now - enq_ts, 0
+        )
+        return state.with_sub(SUB, qd), have, payload, dst
+
+
+class PifoDiscipline(DeviceQueueDiscipline):
+    name = "pifo"

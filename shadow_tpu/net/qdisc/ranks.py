@@ -1,0 +1,123 @@
+"""Rank functions for the PIFO/Eiffel disciplines.
+
+A rank function maps an admitted packet to an int64 rank; the queue serves
+ranks ascending with the per-host enqueue sequence number as the FIFO
+tiebreak (so equal-rank packets keep arrival order — PIFO's push-in
+stability contract). Rankers may carry per-host running state in the qdisc
+sub so they step inside the window kernel:
+
+  fifo   rank 0 for every packet — the sequence tiebreak makes the queue
+         a plain FIFO (the parity arm for compat and Eiffel-vs-exact
+         equivalence tests).
+  prio   the packet's app-priority word (pkt.W_PRIORITY), strict priority.
+  wfq    weighted fair queueing virtual finish times per flow class:
+         vft = max(vtime[h], finish[h, c]) + size * inv_weight[c], with
+         the per-host virtual clock advanced to each dequeued rank.
+
+Flow class: per-packet ``socket_slot % classes`` unless the host carries a
+config override (qdisc.overrides host-prefix → class pins ALL the host's
+packets to that class; the [H] class table rides in the qdisc sub so the
+islands engine shards it like any other host-leading array).
+
+Token-bucket shaping composes with any ranker as an eligibility term:
+shaped classes keep a virtual next-eligible time that advances by
+size × ns_per_byte(rate) per packet, and the effective rank is
+max(base_rank, eligible_time) — later-eligible packets sink down the
+queue instead of head-blocking it. Intended for the time-like rankers
+(fifo/prio, where ranks are comparable to timestamps); with wfq the max
+still yields a valid monotone schedule but mixes virtual-time units.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from shadow_tpu.core import simtime, soa
+from shadow_tpu.net import packet as pkt
+
+# fixed-point scale for 1/weight in the virtual-finish increment
+WFQ_SCALE = 256
+
+RANK_NAMES = ("fifo", "prio", "wfq")
+
+
+class Ranker:
+    """rank(qd, mask, payload, now, size) -> (qd, rank [H] i64)."""
+
+    name = "fifo"
+    classes = 1
+
+    def __init__(self, classes: int = 1, weights=None, shaping=None):
+        self.classes = int(classes)
+        weights = list(weights) if weights else [1.0] * self.classes
+        if len(weights) != self.classes:
+            raise ValueError(
+                f"qdisc weights length {len(weights)} != classes "
+                f"{self.classes}"
+            )
+        if any(w <= 0 for w in weights):
+            raise ValueError("qdisc weights must be > 0")
+        self._inv_w = jnp.asarray(
+            [max(1, round(WFQ_SCALE / w)) for w in weights], jnp.int64
+        )
+        # per-class shaping rate → ns per wire byte (0 = unshaped)
+        npb = [0] * self.classes
+        for c, bits in sorted((shaping or {}).items()):
+            npb[int(c)] = max(1, simtime.NS_PER_SEC * 8 // int(bits))
+        self._ns_per_byte = jnp.asarray(npb, jnp.int64)
+        self.shaped = any(npb)
+
+    def _cls(self, qd, payload):
+        """Per-packet flow class [H] i32: host override else socket slot
+        mod classes."""
+        sock = payload[:, pkt.W_SOCKET] % jnp.int32(self.classes)
+        return jnp.where(qd["cls"] >= 0, qd["cls"], sock)
+
+    def _base(self, qd, mask, payload, now, size, cls):
+        return qd, jnp.zeros(mask.shape, jnp.int64)
+
+    def rank(self, qd, mask, payload, now, size):
+        cls = self._cls(qd, payload)
+        qd, base = self._base(qd, mask, payload, now, size, cls)
+        if not self.shaped:
+            return qd, base
+        npb = self._ns_per_byte[cls]
+        shaped = mask & (npb > 0)
+        elig = jnp.maximum(now.astype(jnp.int64), soa.get_at(
+            qd["shape_next"], cls
+        ))
+        qd = dict(qd)
+        qd["shape_next"] = soa.set_at(
+            qd["shape_next"], shaped, cls, elig + size * npb
+        )
+        return qd, jnp.where(shaped, jnp.maximum(base, elig), base)
+
+
+class FifoRank(Ranker):
+    name = "fifo"
+
+
+class PrioRank(Ranker):
+    name = "prio"
+
+    def _base(self, qd, mask, payload, now, size, cls):
+        return qd, payload[:, pkt.W_PRIORITY].astype(jnp.int64)
+
+
+class WfqRank(Ranker):
+    name = "wfq"
+
+    def _base(self, qd, mask, payload, now, size, cls):
+        start = jnp.maximum(qd["vtime"], soa.get_at(qd["finish"], cls))
+        vft = start + size * self._inv_w[cls]
+        qd = dict(qd)
+        qd["finish"] = soa.set_at(qd["finish"], mask, cls, vft)
+        return qd, vft
+
+
+def make_ranker(rank: str, classes: int = 1, weights=None,
+                shaping=None) -> Ranker:
+    cls = {"fifo": FifoRank, "prio": PrioRank, "wfq": WfqRank}.get(rank)
+    if cls is None:
+        raise ValueError(f"unknown qdisc rank {rank!r}")
+    return cls(classes=classes, weights=weights, shaping=shaping)

@@ -30,6 +30,7 @@ from shadow_tpu.core.state import (
     SimState,
 )
 from shadow_tpu.net import codel, link, nic, packet as pkt, pds as pds_mod, tcp as tcp_mod, udp
+from shadow_tpu.net import qdisc as qdisc_mod
 
 KIND_NIC_SEND = 100
 KIND_NIC_RECV = KIND_NIC_REFILL
@@ -53,13 +54,19 @@ class NetStack:
         qdisc: str = "fifo",
         router_variant: str = "codel",
         payload_words: int = 12,
+        discipline: qdisc_mod.Discipline | None = None,
     ):
-        if qdisc not in ("fifo", "roundrobin"):
-            raise ValueError(f"unknown qdisc {qdisc!r}")
+        # Egress scheduling plane: either a legacy string ("fifo" /
+        # "roundrobin" — the ring-wrapping disciplines) or a prebuilt
+        # Discipline object (pifo/eiffel, carrying rank/drop config from
+        # the `qdisc:` section; sim.py constructs those).
+        if discipline is None:
+            discipline = qdisc_mod.make_discipline(qdisc)
+        self.disc = discipline
         self.payload_words = payload_words
         if router_variant not in ("codel", "static", "single"):
             raise ValueError(f"unknown router variant {router_variant!r}")
-        self.qdisc = qdisc
+        self.qdisc = discipline.name
         # router_queue_codel.c / _static.c / _single.c vtable analog:
         # "static" = drop-tail FIFO without the AQM control law;
         # "single" = the same with a one-packet ring
@@ -68,6 +75,7 @@ class NetStack:
             router_queue_slots = 1
         self.sockets_per_host = sockets_per_host
         self.num_hosts = num_hosts
+        self.disc.attach(self)
         self._init_nic = nic.init(
             bw_up_bits, bw_down_bits, nic_queue_slots,
             payload_words=payload_words,
@@ -126,6 +134,7 @@ class NetStack:
         }
         if self.tcp is not None:
             subs[tcp_mod.SUB] = self.tcp.init_sub()
+        subs.update(self.disc.init_subs())
         return subs
 
     # ---- generic transmit path (all protocols) ----
@@ -149,6 +158,9 @@ class NetStack:
         now64 = jnp.broadcast_to(now, (H,)).astype(jnp.int64)
         direct = jnp.zeros((H,), bool)
         if params is not None:
+            # empty-queue test BEFORE any mutation: the refill touches
+            # only the token bucket, never the queue plane
+            queued_any = self.disc.nonempty(state)
             tx_rem, tx_tick = nic.lazy_refill(
                 n.tx_rem, n.tx_tick, n.tx_refill, n.tx_cap, now64, mask
             )
@@ -158,7 +170,7 @@ class NetStack:
             # same admission gate as the send pump (rem >= MTU, full size
             # charged, debt allowed) so a packet's timing never depends on
             # which path carried it
-            direct = mask & (n.q_head == n.q_tail) & (
+            direct = mask & ~queued_any & (
                 bootstrap | (n.tx_rem >= pkt.MTU)
             )
             # bootstrap sends are free, exactly like the pump path
@@ -167,11 +179,8 @@ class NetStack:
                                  n.tx_rem)
             )
             n = nic.count_tx(n, direct, size)
-            if self.qdisc == "roundrobin":
-                n = n.replace(last_socket=jnp.where(
-                    direct, payload[:, pkt.W_SOCKET], n.last_socket
-                ))
             state = state.with_sub(nic.SUB, n)
+            state = self.disc.note_direct(state, direct, payload)
             remote = direct & (dst_host != hosts)
             wire = pkt.stamp(payload, direct, pkt.PDS_SENT)
             state = link.send(
@@ -183,16 +192,16 @@ class NetStack:
             lb = direct & (dst_host == hosts)
             emitter.emit(lb, now64, hosts, jnp.int32(KIND_PKT_DELIVER),
                          wire)
-            n = state.subs[nic.SUB]
 
         enq = mask & ~direct
-        n, ok = nic.enqueue_send(
-            n, enq, dst_host.astype(jnp.int32),
-            pkt.stamp(payload, enq, pkt.PDS_NIC_QUEUED),
+        state, ok = self.disc.enqueue(
+            state, enq, dst_host.astype(jnp.int32),
+            pkt.stamp(payload, enq, pkt.PDS_NIC_QUEUED), now64,
         )
         state = pds_mod.record_drop(
             state, enq & ~ok, payload, pkt.PDS_DROPPED_SENDQ, now64
         )
+        n = state.subs[nic.SUB]
         need = ok & ~n.send_pending
         emitter.emit(
             need, now64, hosts,
@@ -370,27 +379,26 @@ class NetStack:
         )
         n = n.replace(tx_rem=tx_rem, tx_tick=tx_tick)
         bootstrap = now < params.bootstrap_end
+        state = state.with_sub(nic.SUB, n)
 
-        rr = self.qdisc == "roundrobin"
         for _ in range(self.PUMP_BATCH):
-            if rr:
-                payload, dst, has_pkt, rr_slot = nic.peek_send_rr(
-                    n, self.sockets_per_host
-                )
-            else:
-                payload, dst, has_pkt = nic.peek_send(n)
+            n = state.subs[nic.SUB]
             can = bootstrap | (n.tx_rem >= pkt.MTU)
-            do = mask & has_pkt & can
+            want = mask & can
+            # the discipline owns head selection, the pop, AND any
+            # dequeue-side drop policy (codel hook) — `do` marks hosts
+            # that produced a deliverable packet this round
+            state, do, payload, dst = self.disc.dequeue(state, now, want)
 
             # Charge the FULL wire size (may go negative — token debt). For
             # MTU-conformant packets this is identical to the reference's
             # clamp-at-zero (rem ≥ MTU ≥ size when the gate passes); for
             # oversize packets debt prevents exceeding configured bandwidth.
             size = pkt.total_bytes(payload).astype(jnp.int64)
+            n = state.subs[nic.SUB]
             n = n.replace(
                 tx_rem=jnp.where(do & ~bootstrap, n.tx_rem - size, n.tx_rem)
             )
-            n = nic.pop_send_rr(n, do, rr_slot) if rr else nic.pop_send(n, do)
             n = nic.count_tx(n, do, size)
             state = state.with_sub(nic.SUB, n)
 
@@ -404,9 +412,9 @@ class NetStack:
             # loopback: deliver at the same timestamp, no transit
             lb = do & (dst == hosts)
             emitter.emit(lb, now, hosts, jnp.int32(KIND_PKT_DELIVER), wire)
-            n = state.subs[nic.SUB]
 
-        still = n.q_head < n.q_tail
+        still = self.disc.nonempty(state)
+        n = state.subs[nic.SUB]
         need = mask & still
         can_next = bootstrap | (n.tx_rem >= pkt.MTU)
         t_next = jnp.where(can_next, now, nic.next_refill_time(now))
@@ -506,7 +514,7 @@ class NetStack:
         )
         quiet = (
             ~codel_mod.nonempty(r)
-            & (n.q_head == n.q_tail)
+            & ~self.disc.nonempty(state)
             & ~n.recv_pending
             & ~n.send_pending
         )

@@ -25,6 +25,11 @@ import numpy as np
 
 from shadow_tpu.obs import counters as obs_counters
 
+# v17: qdisc.* per-interface scheduling plane (net/qdisc/):
+# enqueues/dequeues plus the split drop tallies (drops_overflow /
+# drops_red / drops_codel) for the PIFO and Eiffel-bucketed
+# disciplines, and depth_max/depth_mean/sojourn_mean_ns occupancy
+# gauges over the [H, Q] queue plane;
 # v16: federation.* federated serve plane (serve/federation.py +
 # serve/router.py): placements/steals/failovers/replayed_sweeps/
 # probes/peers_lost/handoff_recoveries counters for the N-daemon
@@ -71,7 +76,7 @@ from shadow_tpu.obs import counters as obs_counters
 # obs/audit.py) + optional per-job `audit` sub-object on fleet.jobs[*]
 # rows; v4: optional top-level `fleet` section (fleet.jobs[*] per-job
 # rows) + fleet.* counters; v3: faults.* recovery counters
-SCHEMA_VERSION = 16
+SCHEMA_VERSION = 17
 DOC_KIND = "shadow_tpu.metrics"
 
 # metrics-doc `fleet.jobs[*]` rows must carry at least these keys
@@ -109,6 +114,7 @@ KNOWN_METRIC_NAMESPACES = frozenset({
     "pipeline",    # pipelined CPU↔TPU handoff (schema v14)
     "hostplane",   # multi-worker host-plane drain (schema v15)
     "federation",  # federated serve plane / router (schema v16)
+    "qdisc",       # per-interface scheduling plane (schema v17)
     "sim",         # build-level gauges (num_hosts, runahead)
 })
 
@@ -274,6 +280,11 @@ def validate_metrics_doc(doc: dict, strict_namespaces: bool = False) -> None:
             raise ValueError(
                 f"federation counter {k!r} must be >= 0, got {v}"
             )
+        if k.startswith("qdisc.") and v < 0:
+            # schema v17: qdisc counters are monotonic tallies
+            raise ValueError(
+                f"qdisc counter {k!r} must be >= 0, got {v}"
+            )
     for k, v in doc["gauges"].items():
         if not isinstance(v, (int, float)) or isinstance(v, bool):
             raise ValueError(f"gauge {k!r} must be a number, got {v!r}")
@@ -410,6 +421,37 @@ def snapshot_device(sim, reg: MetricsRegistry) -> None:
     _snapshot_mesh(sim, reg)
     _snapshot_pipeline(sim, reg)
     _snapshot_hostplane(sim, reg)
+    _snapshot_qdisc(sim, reg)
+
+
+def _snapshot_qdisc(sim, reg: MetricsRegistry) -> None:
+    """Per-interface scheduling plane (schema v17): admission/service/
+    drop tallies plus queue-occupancy gauges from the device queue
+    discipline's [H]-leading counter plane (net/qdisc/). FIFO/round-robin
+    runs carry no `qdisc` sub and emit no qdisc.* keys — pre-v17 docs
+    stay valid."""
+    import jax
+
+    state = getattr(sim, "state", None)
+    qd = state.subs.get("qdisc") if state is not None else None
+    if qd is None:
+        return
+    qd = jax.device_get(qd)
+    for f in ("enqueues", "dequeues", "drops_overflow", "drops_red",
+              "drops_codel"):
+        reg.counter_set(f"qdisc.{f}", int(np.sum(np.asarray(qd[f]))))
+    depth = (
+        np.asarray(qd["q_len"], np.int64)
+        if "q_len" in qd
+        else np.sum(np.asarray(qd["q_valid"], np.int64), axis=-1)
+    )
+    reg.gauge_set("qdisc.depth_max", int(depth.max()))
+    reg.gauge_set("qdisc.depth_mean", float(depth.mean()))
+    deq = int(np.sum(np.asarray(qd["dequeues"])))
+    reg.gauge_set(
+        "qdisc.sojourn_mean_ns",
+        float(np.sum(np.asarray(qd["sojourn_sum"])) / deq) if deq else 0.0,
+    )
 
 
 def _snapshot_hostplane(sim, reg: MetricsRegistry) -> None:
@@ -556,6 +598,7 @@ def snapshot_fleet(fleet, reg: MetricsRegistry) -> None:
     _snapshot_mesh(fleet, reg)
     _snapshot_pipeline(fleet, reg)
     _snapshot_hostplane(fleet, reg)
+    _snapshot_qdisc(fleet, reg)
     reg.section_set("fleet", {
         "lanes": int(stats.get("lanes", 0)),
         "lane_swaps": int(stats.get("lane_swaps", 0)),

@@ -25,6 +25,59 @@ class BuildError(ValueError):
     pass
 
 
+def _qdisc_discipline(cfg: Config, H: int):
+    """Resolve the `qdisc:` section (plus the legacy
+    experimental.interface_qdisc string) to a Discipline instance."""
+    from shadow_tpu.net import qdisc as qdisc_mod
+
+    qopt = cfg.qdisc
+    # an explicit qdisc section wins; `fifo` (the default) defers to the
+    # legacy string so pre-qdisc configs build the exact same stack
+    eff = (
+        qopt.discipline
+        if qopt.discipline != "fifo"
+        else cfg.experimental.interface_qdisc
+    )
+    if eff not in ("pifo", "eiffel"):
+        return qdisc_mod.make_discipline(eff)
+
+    from shadow_tpu.net.qdisc import drops as qdrops
+    from shadow_tpu.net.qdisc import ranks as qranks
+    from shadow_tpu.net.qdisc.eiffel import EiffelDiscipline
+    from shadow_tpu.net.qdisc.pifo import PifoDiscipline
+
+    ranker = qranks.make_ranker(
+        qopt.rank, classes=qopt.classes, weights=qopt.weights,
+        shaping=qopt.shaping,
+    )
+    red = (
+        qdrops.RedConfig(
+            qopt.queue_slots, qopt.red_min_frac, qopt.red_max_frac,
+            qopt.red_max_p,
+        )
+        if qopt.drop == "red"
+        else None
+    )
+    host_class = None
+    if qopt.overrides:
+        # host names are quantity-expanded and sorted by the config
+        # loader, so prefix pins hit every replica of a host block
+        host_class = np.full(H, -1, dtype=np.int32)
+        for i, h in enumerate(cfg.hosts):
+            for prefix, c in qopt.overrides.items():
+                if h.name.startswith(prefix):
+                    host_class[i] = c
+    kw = dict(
+        queue_slots=qopt.queue_slots, ranker=ranker, drop=qopt.drop,
+        red=red, host_class=host_class,
+    )
+    if eff == "eiffel":
+        return EiffelDiscipline(
+            buckets=qopt.buckets, bucket_width=qopt.bucket_width, **kw
+        )
+    return PifoDiscipline(**kw)
+
+
 def build_simulation(source) -> Simulation:
     """Build from a Config, YAML path/string, or dict."""
     cfg = source if isinstance(source, Config) else load_config(source)
@@ -165,7 +218,7 @@ def build_simulation(source) -> Simulation:
             router_queue_slots=cfg.experimental.router_queue_slots,
             router_variant=cfg.experimental.router_queue_variant,
             with_tcp=(name == "tcp_bulk"),
-            qdisc=cfg.experimental.interface_qdisc,
+            discipline=_qdisc_discipline(cfg, H),
             payload_words=payload_words,
         )
         interval = units.parse_time_ns(
@@ -182,6 +235,7 @@ def build_simulation(source) -> Simulation:
                 H, servers, interval,
                 size_bytes=int(client_opts.get("size", 1024)),
                 start_time=start, stop_sending=stop_send,
+                local_span=int(client_opts.get("local_span", 0)),
             )
         elif name == "tcp_bulk":
             app = TcpBulkApp(

@@ -58,6 +58,9 @@ _BUDGET_TIER = {
     # the multi-worker host-plane chain-equality matrix (ISSUE 17):
     # same rule — ahead of the compile-heavy tier-4 matrices
     "test_hostplane": 3,
+    # the per-interface scheduling-plane acceptance gate (ISSUE 19):
+    # same rule — compat goldens + PIFO/Eiffel parity before the tail
+    "test_qdisc": 3,
     # the multi-chip mesh acceptance gate (ISSUE 12): same rule — its
     # shard_map cells compile more than the vmap tiers but the chain
     # matrix + relayout resume must land before the tier-4 tail

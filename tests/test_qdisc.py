@@ -1,0 +1,575 @@
+"""Per-interface scheduling plane (net/qdisc): ISSUE 19 acceptance.
+
+- default-FIFO compat: the discipline-interface reroute of nic.py's send
+  ring is bit-identical to pre-qdisc builds (audit chains pinned from a
+  pre-PR capture of the SAME configs in this SAME 8-virtual-device CPU
+  environment).
+- PIFO/Eiffel properties: exact-PIFO rank order, the bucketed
+  discipline's error bound (inversions only within one bucket width) and
+  its exactness regime (bucket_width 1, rank spread < B → identical to
+  exact PIFO).
+- CoDel-as-drop-hook parity: the folded-in state machine driven against
+  net/codel.py's router on the same schedule must make identical drop
+  decisions.
+- WFQ virtual-finish-time ordering, config validation, schema-v17
+  artifact.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from shadow_tpu.core.config import ConfigError, load_config
+from shadow_tpu.net import codel, packet as pkt
+from shadow_tpu.net.apps import locality_targets
+from shadow_tpu.net.qdisc import drops, ranks
+from shadow_tpu.net.qdisc.eiffel import EiffelDiscipline
+from shadow_tpu.net.qdisc.pifo import PifoDiscipline
+from shadow_tpu.sim import build_simulation
+from tests._contracts import assert_current_metrics_schema
+
+GML_LOOP = (
+    'graph [ node [ id 0 bandwidth_down "100 Mbit" bandwidth_up "100 Mbit" ] '
+    'edge [ source 0 target 0 latency "10 ms" packet_loss 0.0 ] ]'
+)
+GML_2V = """
+graph [
+  node [ id 0 bandwidth_down "1 Gbit" bandwidth_up "1 Gbit" ]
+  node [ id 1 bandwidth_down "1 Gbit" bandwidth_up "1 Gbit" ]
+  edge [ source 0 target 0 latency "1 ms" ]
+  edge [ source 1 target 1 latency "1 ms" ]
+  edge [ source 0 target 1 latency "50 ms" packet_loss 0.0 ]
+]
+"""
+# 400B datagram = 428B wire = ~34 ms at 100 Kbit, sent every 5 ms: the
+# send queue absorbs a 7x overload (the queue-exercising workload)
+GML_SLOW = (
+    'graph [ node [ id 0 bandwidth_down "10 Mbit" bandwidth_up "100 Kbit" ] '
+    'edge [ source 0 target 0 latency "10 ms" packet_loss 0.0 ] ]'
+)
+
+
+def _flood_cfg(qdisc=None, interface_qdisc=None):
+    exp = {"event_capacity": 2048, "events_per_host_per_window": 8}
+    if interface_qdisc:
+        exp["interface_qdisc"] = interface_qdisc
+    cfg = {
+        "general": {"stop_time": 2, "seed": 6},
+        "network": {"graph": {"type": "gml", "inline": GML_LOOP}},
+        "experimental": exp,
+        "hosts": {
+            "server": {"app_model": "udp_flood",
+                       "app_options": {"role": "server"}},
+            "client": {"quantity": 3, "app_model": "udp_flood",
+                       "app_options": {"interval": "50 ms", "size": 400,
+                                       "runtime": 1}},
+        },
+    }
+    if qdisc:
+        cfg["qdisc"] = qdisc
+    return cfg
+
+
+def _overload_cfg(qdisc=None, **exp):
+    experimental = {"event_capacity": 4096, "events_per_host_per_window": 8}
+    experimental.update(exp)
+    cfg = {
+        "general": {"stop_time": 3, "seed": 6},
+        "network": {"graph": {"type": "gml", "inline": GML_SLOW}},
+        "experimental": experimental,
+        "hosts": {
+            "server": {"app_model": "udp_flood",
+                       "app_options": {"role": "server"},
+                       "bandwidth_down": "10 Mbit",
+                       "bandwidth_up": "10 Mbit"},
+            "client": {"quantity": 3, "app_model": "udp_flood",
+                       "app_options": {"interval": "5 ms", "size": 400,
+                                       "runtime": 2}},
+        },
+    }
+    if qdisc:
+        cfg["qdisc"] = qdisc
+    return cfg
+
+
+def _chain(sim):
+    return int(sim.audit_chain()), int(sim.counters()["events_committed"])
+
+
+def _run(cfg):
+    sim = build_simulation(cfg)
+    sim.run()
+    return sim
+
+
+# ---------------------------------------------------------------------------
+# default-arm compat: chains pinned from a pre-qdisc capture
+# ---------------------------------------------------------------------------
+
+# captured on the pre-PR tree (same configs, same virtual-device setup)
+_GOLDEN_FLOOD = (8799656395028767596, 120)
+_GOLDEN_ECHO = (13198824729964439556, 31)
+
+
+def test_default_fifo_chain_matches_pre_qdisc_capture():
+    assert _chain(_run(_flood_cfg())) == _GOLDEN_FLOOD
+    assert _chain(_run(_flood_cfg(interface_qdisc="fifo"))) == _GOLDEN_FLOOD
+    assert _chain(
+        _run(_flood_cfg(qdisc={"discipline": "fifo"}))
+    ) == _GOLDEN_FLOOD
+
+
+def test_default_roundrobin_chain_matches_pre_qdisc_capture():
+    assert _chain(
+        _run(_flood_cfg(interface_qdisc="roundrobin"))
+    ) == _GOLDEN_FLOOD
+    assert _chain(
+        _run(_flood_cfg(qdisc={"discipline": "roundrobin"}))
+    ) == _GOLDEN_FLOOD
+
+
+def test_udp_echo_chain_matches_pre_qdisc_capture():
+    cfg = {
+        "general": {"stop_time": 4, "seed": 5},
+        "network": {"graph": {"type": "gml", "inline": GML_2V}},
+        "experimental": {"event_capacity": 4096,
+                         "events_per_host_per_window": 8},
+        "hosts": {
+            "server": {"network_node_id": 0, "app_model": "udp_echo",
+                       "app_options": {"role": "server"}},
+            "client": {"network_node_id": 1, "app_model": "udp_echo",
+                       "app_options": {"interval": "200 ms", "runtime": 2,
+                                       "size": 512}},
+        },
+    }
+    assert _chain(_run(cfg)) == _GOLDEN_ECHO
+
+
+# ---------------------------------------------------------------------------
+# discipline unit harness (no engine: drive the Discipline interface)
+# ---------------------------------------------------------------------------
+
+
+class _State:
+    """Minimal SimState stand-in: the subs dict + with_sub."""
+
+    def __init__(self, subs):
+        self.subs = subs
+
+    def with_sub(self, key, val):
+        subs = dict(self.subs)
+        subs[key] = val
+        return _State(subs)
+
+
+class _Stack:
+    num_hosts = 1
+    payload_words = 12
+    sockets_per_host = 8
+
+
+def _mk(disc):
+    disc.attach(_Stack())
+    return _State(disc.init_subs())
+
+
+def _payload(priority=0, size=100, socket=0, port=0):
+    return pkt.make_udp(
+        src_port=jnp.array([40000 + port], jnp.int32),
+        dst_port=jnp.array([9000], jnp.int32),
+        length=jnp.array([size], jnp.int32),
+        priority=jnp.array([priority], jnp.int32),
+        src_host=jnp.array([0], jnp.int32),
+        socket_slot=jnp.array([socket], jnp.int32),
+        payload_words=12,
+    )
+
+
+_ON = jnp.array([True])
+_DST = jnp.array([0], jnp.int32)
+
+
+def _t(ns):
+    return jnp.array([ns], jnp.int64)
+
+
+def _drain(disc, st, now):
+    """Pop until empty; return the served packets' priority words."""
+    out = []
+    while bool(disc.nonempty(st)[0]):
+        st, have, payload, _dst = disc.dequeue(st, _t(now), _ON)
+        if bool(have[0]):
+            out.append(int(payload[0, pkt.W_PRIORITY]))
+    return st, out
+
+
+def test_exact_pifo_serves_rank_order_stably():
+    disc = PifoDiscipline(queue_slots=16, ranker=ranks.PrioRank())
+    st = _mk(disc)
+    prios = [5, 1, 9, 1, 3, 9, 0, 5]
+    for i, p in enumerate(prios):
+        st, ok = disc.enqueue(st, _ON, _DST, _payload(priority=p, port=i),
+                              _t(1000 + i))
+        assert bool(ok[0])
+    st, served = _drain(disc, st, 2000)
+    assert served == sorted(prios)
+    qd = st.subs["qdisc"]
+    assert int(qd["enqueues"][0]) == len(prios)
+    assert int(qd["dequeues"][0]) == len(prios)
+    assert int(qd["depth_peak"][0]) == len(prios)
+
+
+def test_eiffel_exact_regime_matches_pifo_order():
+    # bucket_width 1 and rank spread < B: the bucket scan is exact
+    prios = [5, 1, 9, 1, 3, 9, 0, 5]
+    for mk in (
+        lambda: PifoDiscipline(queue_slots=16, ranker=ranks.PrioRank()),
+        lambda: EiffelDiscipline(queue_slots=16, buckets=16,
+                                 bucket_width=1, ranker=ranks.PrioRank()),
+    ):
+        disc = mk()
+        st = _mk(disc)
+        for i, p in enumerate(prios):
+            st, _ok = disc.enqueue(
+                st, _ON, _DST, _payload(priority=p, port=i), _t(1000 + i)
+            )
+        _st, served = _drain(disc, st, 2000)
+        assert served == sorted(prios), disc.name
+
+
+def test_eiffel_ordering_error_bounded_by_bucket_width():
+    width = 4
+    disc = EiffelDiscipline(queue_slots=32, buckets=8, bucket_width=width,
+                            ranker=ranks.PrioRank())
+    st = _mk(disc)
+    prios = [13, 2, 27, 6, 2, 19, 30, 11, 0, 25, 8, 15]  # spread < B*width
+    for i, p in enumerate(prios):
+        st, _ok = disc.enqueue(
+            st, _ON, _DST, _payload(priority=p, port=i), _t(1000 + i)
+        )
+    _st, served = _drain(disc, st, 2000)
+    assert sorted(served) == sorted(prios)
+    # any inversion pair sits in the same bucket: rank gap < bucket width
+    for a in range(len(served)):
+        for b in range(a + 1, len(served)):
+            if served[a] > served[b]:
+                assert served[a] - served[b] < width, served
+
+
+def test_wfq_virtual_finish_times_interleave_by_weight():
+    # class 1 carries 4x the weight of class 0: per-byte virtual-time
+    # cost is 4x smaller, so its finish times advance 4x slower
+    r = ranks.WfqRank(classes=2, weights=[1.0, 4.0])
+    disc = PifoDiscipline(queue_slots=32, ranker=r)
+    st = _mk(disc)
+    for i in range(8):
+        st, _ok = disc.enqueue(
+            st, _ON, _DST,
+            _payload(priority=10 + i, size=256, socket=i % 2, port=i),
+            _t(1000 + i),
+        )
+    qd = st.subs["qdisc"]
+    fin = np.asarray(qd["finish"][0])
+    # 4 packets each; class 0 accumulated 4x the virtual time of class 1
+    assert fin[0] == 4 * fin[1] > 0
+    _st, served = _drain(disc, st, 2000)
+    # heavier class drains sooner: among the first half of services,
+    # class-1 packets (odd sockets -> odd priorities here) dominate
+    first_half = served[: len(served) // 2]
+    cls1 = sum(1 for p in first_half if (p - 10) % 2 == 1)
+    assert cls1 >= 3, served
+
+
+def test_shaping_defers_rank_eligibility():
+    # class 0 shaped to 1 Mbit: 128B packets are eligible 1024000 ns
+    # apart; unshaped packets keep rank 0 and overtake deferred ones
+    r = ranks.FifoRank(classes=2, shaping={0: 1_000_000})
+    disc = PifoDiscipline(queue_slots=8, ranker=r)
+    st = _mk(disc)
+    st, _ok = disc.enqueue(st, _ON, _DST,
+                           _payload(priority=1, size=128, socket=0),
+                           _t(1000))
+    st, _ok = disc.enqueue(st, _ON, _DST,
+                           _payload(priority=2, size=128, socket=0),
+                           _t(1001))
+    st, _ok = disc.enqueue(st, _ON, _DST,
+                           _payload(priority=3, size=128, socket=1),
+                           _t(1002))
+    qd = st.subs["qdisc"]
+    rank = np.asarray(qd["q_rank"][0][: 3])
+    # unshaped class-1 packet (rank 0) heads the queue; the second
+    # class-0 packet is deferred one token-bucket interval after the first
+    assert rank[0] == 0
+    assert rank[2] - rank[1] == (pkt.UDP_HEADER_BYTES + 128) * (
+        ranks.simtime.NS_PER_SEC * 8 // 1_000_000
+    )
+
+
+def test_red_drops_deterministically_between_thresholds():
+    red = drops.RedConfig(queue_slots=16, min_frac=0.0, max_frac=0.5,
+                          max_p=1.0)
+    disc = PifoDiscipline(queue_slots=16, drop="red", red=red)
+    st = _mk(disc)
+    dropped = 0
+    for i in range(16):
+        st, ok = disc.enqueue(st, _ON, _DST, _payload(port=i), _t(1000 + i))
+        dropped += int(not bool(ok[0]))
+    qd = st.subs["qdisc"]
+    assert int(qd["drops_red"][0]) == dropped > 0
+    assert int(qd["drops_overflow"][0]) == 0
+    # rerun: the deterministic schedule reproduces exactly
+    disc2 = PifoDiscipline(queue_slots=16, drop="red", red=red)
+    st2 = _mk(disc2)
+    dropped2 = 0
+    for i in range(16):
+        st2, ok = disc2.enqueue(st2, _ON, _DST, _payload(port=i),
+                                _t(1000 + i))
+        dropped2 += int(not bool(ok[0]))
+    assert dropped2 == dropped
+
+
+def test_codel_drop_hook_parity_with_router():
+    """The folded-in CoDel state machine against net/codel.py's router on
+    an identical schedule: same packets served, same drop counts, same
+    controller state at every step."""
+    H = 1
+    router = codel.init(H, queue_slots=32, payload_words=12)
+    disc = PifoDiscipline(queue_slots=32, drop="codel")
+    st = _mk(disc)
+    on = jnp.ones((H,), bool)
+    src = jnp.zeros((H,), jnp.int32)
+
+    ms = 1_000_000
+    # a sojourn-bloating schedule: a burst, then slow service (sojourn
+    # crosses TARGET and stays there past INTERVAL -> drop mode), then a
+    # second burst during drop mode
+    schedule = [("enq", t * ms) for t in range(0, 24, 2)]
+    schedule += [("deq", 130 * ms + t * 40 * ms) for t in range(12)]
+    schedule += [("enq", 700 * ms + t * ms) for t in range(8)]
+    schedule += [("deq", 900 * ms + t * 60 * ms) for t in range(12)]
+
+    served_r, served_q = [], []
+    for i, (op, t) in enumerate(schedule):
+        now = jnp.full((H,), t, jnp.int64)
+        if op == "enq":
+            payload = _payload(size=1200, port=i)
+            router = codel.enqueue(router, on, payload, src, now)
+            st, _ok = disc.enqueue(st, on, src, payload, now)
+        else:
+            router, have_r, pay_r, _src = codel.dequeue(router, now, on)
+            st, have_q, pay_q, _dst = disc.dequeue(st, now, on)
+            if bool(have_r[0]):
+                served_r.append(int(pay_r[0, pkt.W_SRC_PORT]))
+            if bool(have_q[0]):
+                served_q.append(int(pay_q[0, pkt.W_SRC_PORT]))
+            qd = st.subs["qdisc"]
+            # controller state tracks in lockstep
+            assert bool(router.drop_mode[0]) == bool(qd["drop_mode"][0])
+            assert int(router.drop_count[0]) == int(qd["drop_count"][0])
+            assert int(router.next_drop[0]) == int(qd["next_drop"][0])
+            assert int(router.interval_expire[0]) == int(
+                qd["interval_expire"][0]
+            )
+    assert served_r == served_q
+    qd = st.subs["qdisc"]
+    assert int(qd["drops_codel"][0]) == int(router.codel_dropped) > 0
+
+
+# ---------------------------------------------------------------------------
+# engine-level: the overload workload across disciplines
+# ---------------------------------------------------------------------------
+
+
+def test_eiffel_matches_pifo_chains_and_counters_in_exact_regime():
+    pifo_sim = _run(_overload_cfg({"discipline": "pifo",
+                                   "queue_slots": 32}))
+    eiffel_sim = _run(_overload_cfg({"discipline": "eiffel",
+                                     "queue_slots": 32, "buckets": 8}))
+    assert _chain(pifo_sim) == _chain(eiffel_sim)
+    qp = jax.device_get(pifo_sim.state.subs["qdisc"])
+    qe = jax.device_get(eiffel_sim.state.subs["qdisc"])
+    for k in ("enqueues", "dequeues", "drops_overflow", "drops_red",
+              "drops_codel", "sojourn_sum", "depth_peak", "q_bytes"):
+        assert (np.asarray(qp[k]) == np.asarray(qe[k])).all(), k
+    assert int(np.sum(qp["enqueues"])) > 0
+    assert int(np.sum(qp["drops_overflow"])) > 0
+
+
+def test_qdisc_metrics_schema_v17_artifact(tmp_path):
+    from shadow_tpu.obs import metrics as obs_metrics
+
+    sim = _run(_overload_cfg({"discipline": "pifo", "rank": "wfq",
+                              "drop": "codel", "queue_slots": 32}))
+    session = obs_metrics.ObsSession()
+    session.finalize(sim)
+    doc = session.metrics.dump(str(tmp_path / "m.json"),
+                               meta={"stage": "test_qdisc"})
+    assert_current_metrics_schema(doc)
+    obs_metrics.validate_metrics_doc(doc, strict_namespaces=True)
+    assert doc["counters"]["qdisc.enqueues"] > 0
+    assert doc["counters"]["qdisc.dequeues"] > 0
+    assert doc["counters"]["qdisc.drops_codel"] > 0
+    assert doc["gauges"]["qdisc.sojourn_mean_ns"] > 0
+    # FIFO runs carry no qdisc sub and emit no qdisc.* keys
+    fifo_sim = _run(_flood_cfg())
+    reg = obs_metrics.MetricsRegistry()
+    obs_metrics.snapshot_device(fifo_sim, reg)
+    assert not any(k.startswith("qdisc.") for k in reg.counters)
+
+
+def test_checkpoint_roundtrip_carries_qdisc_plane(tmp_path):
+    # qdisc rings are ordinary SimState pytree leaves: save/load restores
+    # the queue plane and the resumed run reproduces the one-shot chain
+    sim = build_simulation(_overload_cfg({"discipline": "pifo",
+                                          "queue_slots": 32}))
+    sim.run()
+    want = _chain(sim)
+    qd_want = jax.device_get(sim.state.subs["qdisc"])
+
+    path = str(tmp_path / "ck.npz")
+    sim2 = build_simulation(_overload_cfg({"discipline": "pifo",
+                                           "queue_slots": 32}))
+    sim2.run(until=1_500_000_000)
+    sim2.save_checkpoint(path)
+    sim3 = build_simulation(_overload_cfg({"discipline": "pifo",
+                                           "queue_slots": 32}))
+    sim3.load_checkpoint(path)
+    sim3.run()
+    assert _chain(sim3) == want
+    qd_got = jax.device_get(sim3.state.subs["qdisc"])
+    for k in qd_want:
+        assert (np.asarray(qd_want[k]) == np.asarray(qd_got[k])).all(), k
+
+
+# ---------------------------------------------------------------------------
+# satellites: locality targets, config validation
+# ---------------------------------------------------------------------------
+
+
+def test_locality_targets_prefers_nearest_anchor_within_span():
+    tgt = locality_targets(8, [2, 6], 1)
+    # within one hop of an anchor -> that anchor; others round-robin
+    assert tgt[1] == 2 and tgt[2] == 2 and tgt[3] == 2
+    assert tgt[5] == 6 and tgt[6] == 6 and tgt[7] == 6
+    assert tgt[0] == 2 and tgt[4] == 2  # round-robin fallback (i % 2)
+    # span 0 is the classic round-robin spread
+    assert list(locality_targets(6, [0, 3], 0)) == [0, 3, 0, 3, 0, 3]
+    # circular distance: host 7 is 1 hop from anchor 0 on an 8-ring
+    assert locality_targets(8, [0], 1)[7] == 0
+
+
+def test_udp_flood_local_span_shapes_fan_in():
+    cfg = _flood_cfg()
+    cfg["hosts"]["client"]["app_options"]["local_span"] = 1
+    sim = build_simulation(cfg)
+    sub = jax.device_get(sim.state.subs["udp_flood"])
+    # hosts sort as client1, client2, client3, server (index 3): only
+    # clients within 1 ring hop of the server target it here — and all
+    # do, because every other row IS within span or falls back to it
+    assert (np.asarray(sub["target"]) == 3).all()
+    sim.run()
+    assert int(jax.device_get(
+        sim.state.subs["udp_flood"])["recv"][3]) > 0
+
+
+def test_qdisc_config_validation():
+    with pytest.raises(ConfigError, match="discipline"):
+        load_config(_flood_cfg(qdisc={"discipline": "cake"}))
+    with pytest.raises(ConfigError, match="rank"):
+        load_config(_flood_cfg(qdisc={"discipline": "pifo",
+                                      "rank": "lstf"}))
+    with pytest.raises(ConfigError, match="weights"):
+        load_config(_flood_cfg(qdisc={"discipline": "pifo", "rank": "wfq",
+                                      "classes": 2, "weights": [1.0]}))
+    with pytest.raises(ConfigError, match="out of range"):
+        load_config(_flood_cfg(qdisc={"discipline": "pifo", "classes": 2,
+                                      "overrides": {"client": 5}}))
+    with pytest.raises(ConfigError, match="red"):
+        load_config(_flood_cfg(qdisc={"discipline": "pifo", "drop": "red",
+                                      "red_min_frac": 0.9,
+                                      "red_max_frac": 0.2}))
+    with pytest.raises(ConfigError, match="requires discipline"):
+        load_config(_flood_cfg(qdisc={"discipline": "fifo",
+                                      "drop": "codel"}))
+    cfg = load_config(_flood_cfg(qdisc={
+        "discipline": "eiffel", "rank": "wfq", "classes": 2,
+        "weights": [1, 3], "shaping": {0: "10 Mbit"}, "drop": "red",
+        "overrides": {"client": 1},
+    }))
+    assert cfg.qdisc.shaping == {0: 10_000_000}
+    assert cfg.qdisc.overrides == {"client": 1}
+
+
+def test_host_class_override_pins_flow_class():
+    cfg = _overload_cfg({
+        "discipline": "pifo", "rank": "wfq", "classes": 2,
+        "weights": [1, 8], "overrides": {"client": 1},
+    })
+    sim = build_simulation(cfg)
+    cls = np.asarray(jax.device_get(sim.state.subs["qdisc"]["cls"]))
+    # hosts sort client1..client3, server: clients pinned to class 1,
+    # the server unpinned (per-socket classing)
+    assert list(cls) == [1, 1, 1, -1]
+    sim.run()
+    assert int(jax.device_get(
+        sim.state.subs["qdisc"])["enqueues"].sum()) > 0
+
+
+def test_shipped_scenario_configs_expand_and_run():
+    import pathlib
+
+    import yaml
+
+    from shadow_tpu.fleet import expand_sweep
+
+    root = pathlib.Path(__file__).parent.parent / "configs"
+    for name in ("incast.yaml", "bufferbloat.yaml"):
+        doc = yaml.safe_load((root / name).read_text())
+        jobs = expand_sweep(doc)
+        assert len(jobs) == 4 and all(
+            j.config.get("qdisc") for j in jobs
+        ), name
+    # the incast job runs end-to-end with live queue pressure and the
+    # locality-shaped fan-in (all 8 workers within span of the aggregator)
+    doc = yaml.safe_load((root / "incast.yaml").read_text())
+    sim = build_simulation(expand_sweep(doc)[0].config)
+    tgt = np.asarray(jax.device_get(sim.state.subs["udp_flood"]["target"]))
+    role = np.asarray(jax.device_get(sim.state.subs["udp_flood"]["role"]))
+    agg = int(np.flatnonzero(role == 0)[0])
+    assert (tgt == agg).all()
+    sim.run()
+    qd = jax.device_get(sim.state.subs["qdisc"])
+    assert int(np.sum(qd["enqueues"])) > 0
+    assert int(np.sum(qd["drops_red"])) > 0
+    assert int(sim.counters()["events_committed"]) > 0
+
+
+# ---------------------------------------------------------------------------
+# driver matrix (compile-heavy: slow tier; the bench gate runs it too)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_pifo_chain_identical_across_drivers():
+    from shadow_tpu.fleet import JobSpec, build_fleet
+
+    q = {"discipline": "pifo", "rank": "wfq", "drop": "codel",
+         "queue_slots": 32}
+    want = _chain(_run(_overload_cfg(q)))
+
+    opt = build_simulation(_overload_cfg(q))
+    opt.run_optimistic()
+    assert _chain(opt) == want
+
+    isl = build_simulation(_overload_cfg(q, num_shards=2,
+                                         exchange_slots=16))
+    isl.run()
+    assert _chain(isl) == want
+
+    fl = build_fleet([JobSpec("a", _overload_cfg(q)),
+                      JobSpec("b", _overload_cfg(q))], lanes=2)
+    fl.run()
+    rows = {r["name"]: (r["audit"]["chain"], r["events_committed"])
+            for r in fl.results()}
+    assert rows["a"] == want and rows["b"] == want

@@ -64,6 +64,7 @@ STAGES = [
     ("pipeline_smoke", [PY, "bench.py", "--pipeline-smoke"], False, 7200),
     ("hostplane_smoke", [PY, "bench.py", "--hostplane-smoke"],
      False, 7200),
+    ("qdisc_smoke", [PY, "bench.py", "--qdisc-smoke"], False, 7200),
     ("async_smoke", [PY, "bench.py", "--async-smoke"], False, 7200),
     ("balance_smoke", [PY, "bench.py", "--balance-smoke"], False, 7200),
     ("mesh_smoke", [PY, "bench.py", "--mesh-smoke"], False, 7200),

@@ -4,7 +4,7 @@
 A standalone CLI wrapper over `obs.metrics.validate_metrics_doc`
 (docs/observability.md; the schema version and per-namespace rules —
 including `--strict-namespaces` membership of the closed
-KNOWN_METRIC_NAMESPACES table, `federation.*` since schema v16 —
+KNOWN_METRIC_NAMESPACES table, `qdisc.*` since schema v17 —
 come from obs/metrics.py, so this tool tracks every schema bump
 automatically): CI and tools/tpu_watch.py gate every
 captured metrics artifact with this at capture time, so a schema
