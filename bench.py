@@ -1239,6 +1239,151 @@ def stage_async_smoke(shards: int = 4, hosts_per_shard: int = 4,
     }
 
 
+def stage_profile_smoke(shards: int = 4, hosts_per_shard: int = 4,
+                        stop_s: int = 30, span: int = 2,
+                        overhead_tol: float = 0.03):
+    """shadowscope gate (ISSUE 20 acceptance): the profiling plane is
+    observation, never participation. On the async-smoke workload (same
+    topology/seed — shard 0 is the deliberately skewed hot shard):
+
+      * profiler-on vs profiler-off runs keep BIT-IDENTICAL audit chains
+        and equal committed events — the recorder is read-only against
+        the sim;
+      * profiler overhead <= 3% wall (min-of-2 per arm, interleaved);
+      * critical-path attribution names shard 0 (the hot-frac shard the
+        topology skews) from the recorded per-shard frontier intervals;
+      * merging two runs' profile docs folds histograms EXACTLY (merged
+        counts/sums equal the per-peer sums — the router /timez
+        invariant);
+      * the profile doc validates, and the schema-current metrics
+        artifact carries prof.* keys under --strict-namespaces.
+
+    Both arms run the same CPU backend — no backend wait."""
+    import jax
+
+    from shadow_tpu.core import simtime
+    from shadow_tpu.obs import metrics as obs_metrics
+    from shadow_tpu.obs import prof as obs_prof
+    from shadow_tpu.obs.hist import LogHistogram
+    from shadow_tpu.sim import build_simulation
+
+    gml = _async_smoke_gml(shards, hosts_per_shard)
+    hosts = {}
+    for v in range(shards * hosts_per_shard):
+        hosts[f"h{v:02d}"] = {
+            "quantity": 1, "network_node_id": v, "app_model": "phold",
+            "app_options": {
+                "msgload": 1, "runtime": stop_s - 1, "local_span": span,
+            },
+        }
+    cfg = {
+        "general": {"stop_time": stop_s, "seed": 42},
+        "network": {"graph": {"type": "gml", "inline": gml}},
+        "experimental": {
+            "event_capacity": 2048, "events_per_host_per_window": 8,
+            "outbox_slots": 8, "inbox_slots": 4,
+            "num_shards": shards, "exchange_slots": 32,
+            "async_islands": True,
+        },
+        "hosts": hosts,
+    }
+
+    def run_arm(profiled: bool):
+        sim = build_simulation(cfg)
+        prof = None
+        if profiled:
+            prof = obs_prof.ProfRecorder()
+            sim.obs_session = obs_metrics.ObsSession(prof=prof)
+        # small dispatches so every handoff boundary lands an interval
+        # in the ring (the barrier-free loop still overlaps shards)
+        sim.run(until=2 * simtime.NS_PER_SEC, windows_per_dispatch=64)
+        jax.block_until_ready(sim.state.pool.time)
+        t0 = time.perf_counter()
+        sim.run(windows_per_dispatch=64)
+        jax.block_until_ready(sim.state.pool.time)
+        return sim, prof, time.perf_counter() - t0
+
+    # interleave arms to decorrelate machine drift from the comparison
+    off_sim, _, w_off = run_arm(False)
+    on_sim, prof_a, w_on = run_arm(True)
+    w_off = min(w_off, run_arm(False)[2])
+    on2_sim, prof_b, w_on2 = run_arm(True)
+    w_on = min(w_on, w_on2)
+
+    chain_equal = off_sim.audit_chain() == on_sim.audit_chain()
+    ev_off = off_sim.counters()["events_committed"]
+    ev_on = on_sim.counters()["events_committed"]
+    overhead = (w_on - w_off) / w_off if w_off > 0 else 0.0
+
+    doc_a = prof_a.to_doc(meta={"peer": "a"})
+    doc_b = prof_b.to_doc(meta={"peer": "b"})
+    obs_prof.validate_profile_doc(doc_a)
+    obs_prof.validate_profile_doc(doc_b)
+    cp = obs_prof.critical_path(doc_a)
+
+    # the federation /timez invariant: merged histograms ARE the sums
+    merged = obs_prof.merge_profile_docs({"a": doc_a, "b": doc_b})
+    merge_exact = True
+    for name in set(doc_a["hists"]) | set(doc_b["hists"]):
+        ha = LogHistogram.from_doc(
+            doc_a["hists"][name]) if name in doc_a["hists"] \
+            else LogHistogram()
+        hb = LogHistogram.from_doc(
+            doc_b["hists"][name]) if name in doc_b["hists"] \
+            else LogHistogram()
+        hm = LogHistogram.from_doc(merged["hists"][name])
+        if hm.count != ha.count + hb.count \
+                or hm.sum != ha.sum + hb.sum:
+            merge_exact = False
+
+    gate_chain = bool(chain_equal and ev_on == ev_off)
+    gate_overhead = overhead <= overhead_tol
+    gate_critical = cp is not None and cp["critical_shard"] == 0
+    gate_recorded = prof_a.recorded > 0 and bool(doc_a["hists"])
+
+    gate = bool(
+        gate_chain and gate_overhead and gate_critical
+        and gate_recorded and merge_exact
+    )
+    metrics_path = os.path.join(_REPO, "profile_smoke.metrics.json")
+    session = on_sim.obs_session  # carries the run's spans + prof_a
+    session.finalize(on_sim)
+    doc = session.metrics.dump(metrics_path, meta={
+        "stage": "profile_smoke", "hosts": shards * hosts_per_shard,
+        "shards": shards, "wall_s": round(w_on, 3), "ok": gate,
+    })
+    obs_metrics.validate_metrics_doc(doc, strict_namespaces=True)
+    prof_recorded = (
+        doc["counters"].get("prof.intervals", 0) > 0
+        and "prof.critical_shard" in doc["gauges"]
+    )
+    return {
+        "stage": "profile_smoke",
+        "platform": jax.default_backend(),
+        "hosts": shards * hosts_per_shard,
+        "shards": shards,
+        "events": int(ev_on),
+        "chain_equal": chain_equal,
+        "wall_off_s": round(w_off, 3),
+        "wall_on_s": round(w_on, 3),
+        "overhead_frac": round(overhead, 4),
+        "intervals": int(prof_a.recorded),
+        "dropped": int(prof_a.dropped),
+        "critical_shard": None if cp is None else cp["critical_shard"],
+        "critical_wall_frac": None if cp is None
+        else round(cp["wall_frac"], 3),
+        "blocked_frac": None if cp is None
+        else round(cp["blocked_frac"], 3),
+        "metrics_out": os.path.relpath(metrics_path, _REPO),
+        "gate_chain": gate_chain,
+        "gate_overhead": gate_overhead,
+        "gate_critical": gate_critical,
+        "gate_merge": merge_exact,
+        "gate_recorded": bool(gate_recorded and prof_recorded),
+        "gate": bool(gate and prof_recorded),
+    }
+
+
 def _balance_smoke_gml(shards: int, per: int, seed: int = 7) -> str:
     """The balance-smoke topology: one vertex per host, decohered
     UNIFORM intra-shard latency bands (no structurally fast shard — the
@@ -3016,6 +3161,15 @@ def main():
         # the comparison is CPU-deterministic — no backend wait.
         os.environ.setdefault("SHADOW_TPU_BENCH_ALLOW_CPU", "1")
         print(json.dumps(stage_async_smoke()), flush=True)
+        return
+    if "--profile-smoke" in sys.argv:
+        # shadowscope gate: profiler-on vs off bit-identical chains at
+        # <=3% overhead, critical-path attribution naming the skewed
+        # shard, exact two-peer /timez histogram folds, and a strict-
+        # validated schema-current artifact carrying prof.* keys. Both
+        # arms share one CPU backend — no backend wait.
+        os.environ.setdefault("SHADOW_TPU_BENCH_ALLOW_CPU", "1")
+        print(json.dumps(stage_profile_smoke()), flush=True)
         return
     if "--mesh-smoke" in sys.argv:
         # true multi-chip gate: shard_map mesh execution with

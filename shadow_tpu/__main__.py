@@ -66,6 +66,14 @@ def _build_parser() -> argparse.ArgumentParser:
              "device plane only",
     )
     p.add_argument(
+        "--profile-out", metavar="PATH",
+        help="write the profiling plane's time-series + histogram doc "
+             "(fixed ring of per-handoff interval deltas, log-bucketed "
+             "latency histograms, per-shard critical-path counters; "
+             "obs/prof.py): implies experimental.profiler; analyze with "
+             "tools/critical_path.py; device plane only",
+    )
+    p.add_argument(
         "--digest-out", metavar="PATH",
         help="write the determinism-audit digest document (per-handoff "
              "chain records + final per-host sub-chains, obs/audit.py); "
@@ -283,15 +291,22 @@ def _run_device_plane(
     checkpoint_every: str | None = None, checkpoint_dir: str | None = None,
     checkpoint_retain: int = 3, resume: str | None = None,
     data_dir=None, digest_out: str | None = None,
-    flight_out: str | None = None,
+    flight_out: str | None = None, profile_out: str | None = None,
 ) -> int:
     session = None
-    if metrics_out or trace_out:
+    profiling = bool(profile_out) or cfg.experimental.profiler
+    if metrics_out or trace_out or profiling:
         from shadow_tpu.obs import metrics as obs_metrics
         from shadow_tpu.obs import trace as obs_trace
 
+        prof = None
+        if profiling:
+            from shadow_tpu.obs import prof as obs_prof
+
+            prof = obs_prof.ProfRecorder(cfg.experimental.profiler_ring)
         session = obs_metrics.ObsSession(
-            tracer=obs_trace.ChromeTracer() if trace_out else None
+            tracer=obs_trace.ChromeTracer() if trace_out else None,
+            prof=prof,
         )
         sim.obs_session = session
     if digest_out:
@@ -438,6 +453,22 @@ def _run_device_plane(
         if trace_out:
             session.tracer.write(trace_out)
             print(f"trace written to {trace_out}", file=sys.stderr)
+        if session.prof is not None:
+            from shadow_tpu.obs import metrics as obs_metrics
+
+            ppath = profile_out or str(
+                pathlib.Path(data_dir or cfg.general.data_directory)
+                / "shadow.profile.json"
+            )
+            obs_metrics.dump_json_atomic(
+                ppath, session.prof.to_doc(meta=meta)
+            )
+            print(
+                f"profile written to {ppath} "
+                f"({session.prof.recorded} intervals, "
+                f"{session.prof.dropped} dropped)",
+                file=sys.stderr,
+            )
     if sim.flight_spool is not None:
         # final flush at the run's end frontier, then close the spool
         sim.flight_spool.flush(sim, sim.stop_time)
@@ -553,11 +584,11 @@ def main(argv: list[str] | None = None) -> int:
 
     if has_procs:
         if args.metrics_out or args.trace_out or args.digest_out \
-                or args.flight_out:
+                or args.flight_out or args.profile_out:
             print(
-                "note: --metrics-out/--trace-out/--digest-out/--flight-out "
-                "cover the device plane only; ignored for managed-process "
-                "simulations",
+                "note: --metrics-out/--trace-out/--digest-out/--flight-out/"
+                "--profile-out cover the device plane only; ignored for "
+                "managed-process simulations",
                 file=sys.stderr,
             )
         if args.checkpoint_every or args.resume:
@@ -576,6 +607,7 @@ def main(argv: list[str] | None = None) -> int:
         checkpoint_retain=args.checkpoint_retain,
         resume=args.resume, data_dir=data_dir,
         digest_out=args.digest_out, flight_out=args.flight_out,
+        profile_out=args.profile_out,
     )
 
 

@@ -49,11 +49,13 @@ def doc_schema_versions() -> dict[str, int]:
     from shadow_tpu.faults import plan as plan_mod
     from shadow_tpu.obs import audit as audit_mod
     from shadow_tpu.obs import metrics as metrics_mod
+    from shadow_tpu.obs import prof as prof_mod
 
     return {
         "shadow_tpu.metrics": metrics_mod.SCHEMA_VERSION,
         "shadow_tpu.fault_plan": plan_mod.PLAN_SCHEMA_VERSION,
         "shadow_tpu.digest": audit_mod.DIGEST_SCHEMA_VERSION,
+        "shadow_tpu.profile": prof_mod.PROFILE_SCHEMA_VERSION,
     }
 
 

@@ -270,6 +270,17 @@ class ExperimentalOptions:
     # serial drain by construction. 1 (the default) keeps today's serial
     # inline drain and emits no hostplane.* metrics keys.
     host_workers: int = 1
+    # Profiling plane (obs/prof.py, schema v18 `prof.*`): record a
+    # fixed-capacity ring of per-handoff interval deltas (wall +
+    # committed virtual time, event/window/yield/blocked counters,
+    # per-shard async frontiers) plus log-bucketed latency histograms,
+    # dumped as a schema-versioned profile doc (--profile-out overrides
+    # the path). Off by default — the recorder is read-only against the
+    # sim, but the ticks themselves cost a little host wall per handoff.
+    profiler: bool = False
+    # Ring capacity in intervals; oldest intervals are dropped (and
+    # counted) once the ring wraps. Must be >= 8.
+    profiler_ring: int = 512
     # CPU↔TPU seam: route managed-process UDP through the device-stepped
     # network (procs/bridge.py). The BASELINE north-star path.
     use_device_network: bool = False
@@ -331,6 +342,12 @@ class ExperimentalOptions:
             out.host_workers = int(d["host_workers"])
             if out.host_workers < 1:
                 raise ConfigError("experimental.host_workers must be >= 1")
+        if "profiler" in d:
+            out.profiler = bool(d["profiler"])
+        if d.get("profiler_ring") is not None:
+            out.profiler_ring = int(d["profiler_ring"])
+            if out.profiler_ring < 8:
+                raise ConfigError("experimental.profiler_ring must be >= 8")
         if d.get("flight_recorder") is not None:
             v = d["flight_recorder"]
             if isinstance(v, dict):

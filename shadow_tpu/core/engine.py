@@ -2196,7 +2196,7 @@ class Simulation:
                     if self.pressure is not None:
                         self.pressure.note_progress()
                     if obs is not None:
-                        obs.round_done(self)
+                        obs.round_done(self, min_next)
                     self._audit_tick(min_next)
                     if self._fault_plane_active():
                         self._handoff_tick(min_next)
@@ -2381,7 +2381,7 @@ class Simulation:
                 with metrics_mod.span(obs, "host_drain"):
                     self._gear_note_dispatch()
                     if obs is not None:
-                        obs.round_done(self)
+                        obs.round_done(self, mn)
                     self._audit_tick(mn)
                     # gearing: a red-zone early exit upshifts (one pool
                     # re-sort) before the spill tier would pay host drain

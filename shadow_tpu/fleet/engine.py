@@ -263,6 +263,10 @@ class FleetSimulation:
             }
             self._async_spread_max = 0
             self._async_frontier = None
+            # cumulative per-(lane, shard) [3, L, S] steps/yields/blocked
+            # — the fleet's critical-path signal (obs/prof.py)
+            self._async_shard_stats = None
+            self._look_in_cache = None
         self._lane_faults = [
             self._resolve_faults(s) for s in sims
         ]
@@ -1154,6 +1158,7 @@ class FleetSimulation:
             self._async_look[lane] = np.asarray(
                 jax.device_get(sim._async_look_in))
             self._async_spread[lane] = int(sim._async_spread)
+            self._look_in_cache = None
         self._lane_faults[lane] = self._resolve_faults(sim)
         self.sched.admit(lane, rec)
         self.sched.lane_swaps += 1
@@ -1380,15 +1385,23 @@ class FleetSimulation:
         def fetch(out):
             extra = None
             if self._async:
-                # frontier [L, S] + fleet-summed async counters
+                # frontier [L, S] + fleet-summed async counters + the
+                # per-(lane, shard) deltas the profiling plane keeps
+                stp_v = np.asarray(jax.device_get(out[7])).reshape(
+                    self.lanes, -1)
+                yld_v = np.asarray(jax.device_get(out[8])).reshape(
+                    self.lanes, -1)
+                blk_v = np.asarray(jax.device_get(out[9])).reshape(
+                    self.lanes, -1)
                 extra = (
                     np.asarray(jax.device_get(out[5])).reshape(
                         self.lanes, -1),
                     int(np.max(np.asarray(jax.device_get(out[6])))),
-                    int(np.sum(np.asarray(jax.device_get(out[7])))),
-                    int(np.sum(np.asarray(jax.device_get(out[8])))),
-                    int(np.sum(np.asarray(jax.device_get(out[9])))),
+                    int(stp_v.sum()),
+                    int(yld_v.sum()),
+                    int(blk_v.sum()),
                     int(np.max(np.asarray(jax.device_get(out[4])))),
+                    np.stack([stp_v, yld_v, blk_v]).astype(np.int64),
                 )
             return (
                 out[0],
@@ -1482,9 +1495,14 @@ class FleetSimulation:
                             self._async_spread_max, ainfo[1]
                         )
                         self._async_frontier = ainfo[0]
+                        if len(ainfo) > 6 and ainfo[6] is not None:
+                            st6 = self._async_shard_stats
+                            if st6 is None or st6.shape != ainfo[6].shape:
+                                st6 = np.zeros_like(ainfo[6])
+                            self._async_shard_stats = st6 + ainfo[6]
                     dispatches += 1
                     if obs is not None:
-                        obs.round_done(self)
+                        obs.round_done(self, int(mn.min()))
                     self._backend_fault_tick(mn)
                     changed = self._handoff(mn, press)
                     if self._shifter is not None and not (
@@ -1708,7 +1726,7 @@ class FleetSimulation:
             mn = mn_a
             rounds += 1
             if self.obs_session is not None:
-                self.obs_session.round_done(self)
+                self.obs_session.round_done(self, int(mn.min()))
             self._backend_fault_tick(mn)
             if adaptive:
                 for j in range(L):
@@ -1783,6 +1801,34 @@ class FleetSimulation:
         if not self._async:
             return None
         return dict(self._async_counters)
+
+    def async_shard_profile(self) -> dict | None:
+        """Per-shard async profile for the profiling plane (obs/prof.py
+        critical-path attribution, schema v18). Lanes are folded: the
+        cumulative steps/yields/blocked sum over lanes, the frontier is
+        the lane-min per shard (the bound conservative sync enforces),
+        and the in-edge lookahead matrix comes from lane 0 (identical
+        across lanes — one topology per fleet). None for barrier fleets
+        or before the first async dispatch."""
+        if not self._async or self._async_shard_stats is None:
+            return None
+        st = self._async_shard_stats  # [3, L, S]
+        prof = {
+            "shards": int(st.shape[-1]),
+            "lanes": self.lanes,
+            "steps": [int(x) for x in st[0].sum(axis=0)],
+            "yields": [int(x) for x in st[1].sum(axis=0)],
+            "blocked": [int(x) for x in st[2].sum(axis=0)],
+        }
+        if self._async_frontier is not None:
+            f = np.asarray(self._async_frontier)
+            prof["frontier_ns"] = [int(x) for x in f.min(axis=0)]
+        if self._look_in_cache is None:
+            self._look_in_cache = [
+                [int(x) for x in row] for row in self._async_look[0]
+            ]
+        prof["lookahead_in"] = self._look_in_cache
+        return prof
 
     def async_gauges(self) -> dict[str, int] | None:
         if not self._async:

@@ -1,0 +1,195 @@
+"""Log-bucketed fixed-bin integer histograms (HDR-style).
+
+The snapshot registry's Histogram (obs/metrics.py) keeps a stride-decimated
+float sample buffer — fine for one run's summary, useless for federation:
+two sample buffers don't merge into the histogram either run would have
+produced. This module is the mergeable twin: a FIXED bucket layout shared
+by every producer (log-linear, ``SUB_BITS`` sub-buckets per octave) holding
+pure int64 counts, so
+
+  merge(a, b) == merge(b, a)            (commutative)
+  merge(merge(a, b), c) == merge(a, merge(b, c))   (associative)
+
+and a histogram accumulated across fleet lanes, federation peers, or a
+checkpoint-resume boundary is EXACTLY the histogram one uninterrupted
+observer would have built. Values are non-negative integers (nanoseconds
+throughout the profiling plane); the relative quantization error is bounded
+by 2**-SUB_BITS (25% with the default layout) — the HDR trade: coarse
+absolute precision, exact mergeable counts.
+
+Bucket layout (``SUB_BITS = 2``):
+  idx 0..3            exact: value == idx
+  idx 4..             log-linear: octave ``(idx >> 2) - 1`` split into 4
+                      sub-buckets; ``bucket_lo/hi`` give inclusive bounds
+  idx NUM_BINS - 1    overflow: values past the last bounded bucket clamp
+                      here (unbounded above; percentiles report ``max``)
+
+With NUM_BINS = 256 every int64 value has its own bounded bucket — the
+overflow bin only catches arbitrary-precision outliers — but the bin is
+part of the contract: producers with different layouts refuse to merge.
+"""
+
+from __future__ import annotations
+
+SUB_BITS = 2
+NUM_BINS = 256
+
+_SUB = 1 << SUB_BITS  # sub-buckets per octave
+
+
+def bucket_index(v: int) -> int:
+    """Bucket of non-negative integer ``v`` (negatives clamp to 0)."""
+    v = int(v)
+    if v < 0:
+        v = 0
+    if v < _SUB:
+        return v
+    shift = v.bit_length() - 1 - SUB_BITS
+    idx = ((shift + 1) << SUB_BITS) + ((v >> shift) - _SUB)
+    return idx if idx < NUM_BINS - 1 else NUM_BINS - 1
+
+
+def bucket_lo(idx: int) -> int:
+    """Inclusive lower bound of bucket ``idx``."""
+    if idx < _SUB:
+        return idx
+    shift = (idx >> SUB_BITS) - 1
+    base = (idx & (_SUB - 1)) + _SUB
+    return base << shift
+
+
+def bucket_hi(idx: int) -> int | None:
+    """Inclusive upper bound of bucket ``idx``; None for the unbounded
+    overflow bucket (callers clamp to the observed max)."""
+    if idx < _SUB:
+        return idx
+    if idx >= NUM_BINS - 1:
+        return None
+    shift = (idx >> SUB_BITS) - 1
+    base = (idx & (_SUB - 1)) + _SUB
+    return ((base + 1) << shift) - 1
+
+
+class LogHistogram:
+    """Fixed-layout int64 histogram: observe / merge / percentile.
+
+    State is five integers plus a sparse bucket->count map — everything
+    merges by elementwise addition (count, sum, buckets) or min/max, so
+    merge order can never matter.
+    """
+
+    __slots__ = ("count", "sum", "min", "max", "buckets")
+
+    def __init__(self):
+        self.count = 0
+        self.sum = 0
+        self.min = None  # None until the first observation
+        self.max = None
+        self.buckets: dict[int, int] = {}
+
+    def observe(self, v: int) -> None:
+        v = int(v)
+        if v < 0:
+            v = 0
+        self.count += 1
+        self.sum += v
+        self.min = v if self.min is None else min(self.min, v)
+        self.max = v if self.max is None else max(self.max, v)
+        i = bucket_index(v)
+        self.buckets[i] = self.buckets.get(i, 0) + 1
+
+    def merge(self, other: "LogHistogram") -> None:
+        """Fold ``other`` into self (elementwise adds + min/max)."""
+        self.count += other.count
+        self.sum += other.sum
+        if other.min is not None:
+            self.min = other.min if self.min is None \
+                else min(self.min, other.min)
+        if other.max is not None:
+            self.max = other.max if self.max is None \
+                else max(self.max, other.max)
+        for i, n in other.buckets.items():
+            self.buckets[i] = self.buckets.get(i, 0) + n
+
+    def percentile(self, q: float) -> int:
+        """Value at percentile ``q`` in [0, 100]: the upper bound of the
+        bucket holding the rank-``ceil(q/100 * count)`` observation,
+        clamped to the exact observed max (so p100 == max and the
+        overflow bucket never reports an invented bound). Empty
+        histogram: 0."""
+        if self.count == 0:
+            return 0
+        rank = max(1, min(self.count, -(-int(q * self.count) // 100)))
+        seen = 0
+        for i in sorted(self.buckets):
+            seen += self.buckets[i]
+            if seen >= rank:
+                hi = bucket_hi(i)
+                return self.max if hi is None else min(hi, self.max)
+        return self.max  # unreachable when counts are consistent
+
+    def summary(self) -> dict:
+        """count/sum/min/max/mean + p50/p90/p99 — the same key set the
+        snapshot-registry histograms dump, so both render alike."""
+        if self.count == 0:
+            return {"count": 0, "sum": 0, "min": 0, "max": 0, "mean": 0.0,
+                    "p50": 0, "p90": 0, "p99": 0}
+        return {
+            "count": int(self.count), "sum": int(self.sum),
+            "min": int(self.min), "max": int(self.max),
+            "mean": float(self.sum / self.count),
+            "p50": int(self.percentile(50)),
+            "p90": int(self.percentile(90)),
+            "p99": int(self.percentile(99)),
+        }
+
+    def to_doc(self) -> dict:
+        """JSON form. The layout constants travel with the counts so a
+        consumer with a different build refuses to merge instead of
+        silently mis-binning."""
+        return {
+            "sub_bits": SUB_BITS,
+            "num_bins": NUM_BINS,
+            "count": int(self.count),
+            "sum": int(self.sum),
+            "min": 0 if self.min is None else int(self.min),
+            "max": 0 if self.max is None else int(self.max),
+            "buckets": {str(i): int(n)
+                        for i, n in sorted(self.buckets.items())},
+        }
+
+    @classmethod
+    def from_doc(cls, doc: dict) -> "LogHistogram":
+        if (doc.get("sub_bits") != SUB_BITS
+                or doc.get("num_bins") != NUM_BINS):
+            raise ValueError(
+                f"histogram layout mismatch: doc carries sub_bits="
+                f"{doc.get('sub_bits')} num_bins={doc.get('num_bins')}, "
+                f"this build uses {SUB_BITS}/{NUM_BINS} — counts from "
+                f"different layouts do not merge"
+            )
+        h = cls()
+        h.count = int(doc.get("count", 0))
+        h.sum = int(doc.get("sum", 0))
+        if h.count:
+            h.min = int(doc.get("min", 0))
+            h.max = int(doc.get("max", 0))
+        h.buckets = {int(i): int(n)
+                     for i, n in doc.get("buckets", {}).items() if int(n)}
+        return h
+
+    def __eq__(self, other) -> bool:
+        if not isinstance(other, LogHistogram):
+            return NotImplemented
+        return (self.count == other.count and self.sum == other.sum
+                and self.min == other.min and self.max == other.max
+                and {i: n for i, n in self.buckets.items() if n}
+                == {i: n for i, n in other.buckets.items() if n})
+
+
+def merge_docs(a: dict, b: dict) -> dict:
+    """Merge two histogram JSON docs (router /timez roll-up): decode,
+    fold, re-encode. Raises ValueError on layout mismatch."""
+    h = LogHistogram.from_doc(a)
+    h.merge(LogHistogram.from_doc(b))
+    return h.to_doc()

@@ -25,6 +25,12 @@ import numpy as np
 
 from shadow_tpu.obs import counters as obs_counters
 
+# v18: prof.* profiling plane (obs/prof.py + obs/hist.py): mergeable
+# log-bucketed latency histograms surfaced as p50/p90/p99/count gauges
+# (dispatch_wall_ns / host_drain_wall_ns / window_width_ns, plus the
+# serve plane's request_ns), the interval-ring posture counters
+# (prof.intervals / prof.dropped), and the critical-path attribution
+# gauges prof.critical_shard / prof.blocked_frac / prof.wall_frac;
 # v17: qdisc.* per-interface scheduling plane (net/qdisc/):
 # enqueues/dequeues plus the split drop tallies (drops_overflow /
 # drops_red / drops_codel) for the PIFO and Eiffel-bucketed
@@ -76,7 +82,7 @@ from shadow_tpu.obs import counters as obs_counters
 # obs/audit.py) + optional per-job `audit` sub-object on fleet.jobs[*]
 # rows; v4: optional top-level `fleet` section (fleet.jobs[*] per-job
 # rows) + fleet.* counters; v3: faults.* recovery counters
-SCHEMA_VERSION = 17
+SCHEMA_VERSION = 18
 DOC_KIND = "shadow_tpu.metrics"
 
 # metrics-doc `fleet.jobs[*]` rows must carry at least these keys
@@ -115,6 +121,8 @@ KNOWN_METRIC_NAMESPACES = frozenset({
     "hostplane",   # multi-worker host-plane drain (schema v15)
     "federation",  # federated serve plane / router (schema v16)
     "qdisc",       # per-interface scheduling plane (schema v17)
+    "prof",        # profiling plane: histogram percentiles +
+                   # critical-path posture (schema v18)
     "sim",         # build-level gauges (num_hosts, runahead)
 })
 
@@ -207,10 +215,24 @@ class MetricsRegistry:
 
     def dump(self, path: str, meta: dict | None = None) -> dict:
         doc = self.to_doc(meta)
-        with open(path, "w") as f:
-            json.dump(doc, f, indent=1)
-            f.write("\n")
+        dump_json_atomic(path, doc)
         return doc
+
+
+def dump_json_atomic(path: str, doc: dict, indent: int | None = 1) -> None:
+    """tmp + fsync + rename, the checkpoint plane's torn-write discipline
+    (core/checkpoint.py): a poller, tpu_watch, or perf_compare reading
+    `path` concurrently sees either the previous complete document or
+    this one — never a truncated JSON."""
+    import os
+
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=indent)
+        f.write("\n")
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
 
 
 _HIST_KEYS = {"count", "sum", "min", "max", "mean", "p50", "p90", "p99"}
@@ -284,6 +306,11 @@ def validate_metrics_doc(doc: dict, strict_namespaces: bool = False) -> None:
             # schema v17: qdisc counters are monotonic tallies
             raise ValueError(
                 f"qdisc counter {k!r} must be >= 0, got {v}"
+            )
+        if k.startswith("prof.") and v < 0:
+            # schema v18: profiling-plane counters are monotonic tallies
+            raise ValueError(
+                f"prof counter {k!r} must be >= 0, got {v}"
             )
     for k, v in doc["gauges"].items():
         if not isinstance(v, (int, float)) or isinstance(v, bool):
@@ -613,9 +640,11 @@ class ObsSession:
     Chrome tracer; the engine drivers call `span()` around each phase and
     `round_done()` after each dispatch round's handoff sync."""
 
-    def __init__(self, metrics: MetricsRegistry | None = None, tracer=None):
+    def __init__(self, metrics: MetricsRegistry | None = None, tracer=None,
+                 prof=None):
         self.metrics = metrics or MetricsRegistry()
         self.tracer = tracer
+        self.prof = prof  # optional obs/prof.ProfRecorder
         self._last_events = 0
         self._last_wall = time.perf_counter()
         self._dispatches = 0
@@ -631,14 +660,23 @@ class ObsSession:
             yield
         dt = time.perf_counter() - t0
         self.metrics.histogram(f"wall.{name}_s").observe(dt)
+        if self.prof is not None:
+            # mergeable int64 twins (obs/hist.py): `dispatch` and the
+            # pipelined driver's `await` are both device-wait wall
+            if name in ("dispatch", "await"):
+                self.prof.observe_wall("dispatch_wall_ns", dt)
+            elif name == "host_drain":
+                self.prof.observe_wall("host_drain_wall_ns", dt)
         if name == "dispatch":
             self._dispatches += 1
             if self._dispatches == 1:
                 self.metrics.gauge_set("wall.first_dispatch_s", dt)
 
-    def round_done(self, sim) -> None:
+    def round_done(self, sim, frontier_ns: int | None = None) -> None:
         """Per-round throughput sample, taken at the handoff boundary the
-        driver already synced at (the scalar frontier fetch)."""
+        driver already synced at (the scalar frontier fetch). Drivers
+        pass the committed frontier they fetched anyway; the profiling
+        recorder stamps its interval ring with it."""
         now = time.perf_counter()
         ev = sim.counters()["events_committed"]
         dt = now - self._last_wall
@@ -651,9 +689,36 @@ class ObsSession:
                 "progress", {"events_committed": int(ev)}
             )
         self._last_events, self._last_wall = ev, now
+        if self.prof is not None:
+            self.prof.tick_from(sim, frontier_ns=frontier_ns)
 
     def finalize(self, sim) -> None:
         snapshot_device(sim, self.metrics)
+        if self.prof is not None:
+            snapshot_prof(self.prof, self.metrics)
+
+
+def snapshot_prof(prof, reg: MetricsRegistry) -> None:
+    """Profiling plane (schema v18): fold the recorder's mergeable
+    histograms into prof.* percentile gauges, the interval-ring posture
+    counters, and — when the run carried per-shard async data — the
+    critical-path attribution posture (obs/prof.critical_path)."""
+    from shadow_tpu.obs import prof as prof_mod
+
+    for name, h in sorted(prof._hists.items()):
+        if not h.count:
+            continue
+        s = h.summary()
+        reg.counter_set(f"prof.{name}_count", s["count"])
+        for q in ("p50", "p90", "p99", "max"):
+            reg.gauge_set(f"prof.{name}_{q}", int(s[q]))
+    reg.counter_set("prof.intervals", int(prof.recorded))
+    reg.counter_set("prof.dropped", int(prof.dropped))
+    cp = prof_mod.critical_path(prof.to_doc())
+    if cp is not None:
+        reg.gauge_set("prof.critical_shard", int(cp["critical_shard"]))
+        reg.gauge_set("prof.blocked_frac", float(cp["blocked_frac"]))
+        reg.gauge_set("prof.wall_frac", float(cp["wall_frac"]))
 
 
 def span(session: ObsSession | None, name: str, **args):

@@ -19,12 +19,11 @@ as separate rows instead of interleaving into one.
 
 from __future__ import annotations
 
-import json
 import time
 from contextlib import contextmanager
 
 FORMAT = "chrome-trace-events"
-VERSION = 2  # v2: per-tid events + thread_name metadata (fleet lanes)
+VERSION = 3  # v3: t0_unix metadata (tools/trace_merge.py clock alignment)
 
 
 class ChromeTracer:
@@ -36,6 +35,9 @@ class ChromeTracer:
 
     def __init__(self, process_name: str = "shadow_tpu"):
         self._t0 = time.perf_counter()
+        # wall-clock anchor of ts=0: tools/trace_merge.py shifts peer
+        # traces onto one timeline by t0_unix deltas
+        self.t0_unix = time.time()
         self.events: list[dict] = []
         self._depth = 0
         self._named_tids: set[tuple[int, int]] = set()
@@ -120,11 +122,14 @@ class ChromeTracer:
     def to_doc(self) -> dict:
         return {
             "displayTimeUnit": "ms",
-            "metadata": {"format": FORMAT, "version": VERSION},
+            "metadata": {
+                "format": FORMAT, "version": VERSION,
+                "t0_unix": round(self.t0_unix, 6),
+            },
             "traceEvents": list(self.events),
         }
 
     def write(self, path: str) -> None:
-        with open(path, "w") as f:
-            json.dump(self.to_doc(), f)
-            f.write("\n")
+        from shadow_tpu.obs.metrics import dump_json_atomic
+
+        dump_json_atomic(path, self.to_doc(), indent=None)

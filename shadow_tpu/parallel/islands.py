@@ -709,6 +709,11 @@ class IslandSimulation(Simulation):
         }
         self._async_spread_max = 0
         self._async_frontier = None
+        # cumulative per-shard [3, S] (steps / yields / blocked) — the
+        # critical-path attribution signal (obs/prof.py); reset when an
+        # elastic relayout changes S
+        self._async_shard_stats = np.zeros((3, S), np.int64)
+        self._look_in_cache = None
 
         spec = IslandSpec(
             axis=AXIS, num_shards=S, exchange_slots=self.exchange_slots,
@@ -1011,9 +1016,10 @@ class IslandSimulation(Simulation):
             self._async_spread_cfg
             or lookahead_mod.auto_spread(spec, self.runahead)
         )
+        self._look_in_cache = None  # host copy re-derived on next read
 
     def _note_async_dispatch(self, ainfo, supersteps: int) -> None:
-        frontier, spread_max, steps, yields, blocked = ainfo
+        frontier, spread_max, steps, yields, blocked = ainfo[:5]
         c = self._async_counters
         c["dispatches"] += 1
         c["supersteps"] += supersteps
@@ -1022,6 +1028,12 @@ class IslandSimulation(Simulation):
         c["blocked_on_neighbor"] += blocked
         self._async_spread_max = max(self._async_spread_max, spread_max)
         self._async_frontier = frontier
+        if len(ainfo) > 5 and ainfo[5] is not None:
+            delta = ainfo[5]
+            if self._async_shard_stats.shape != delta.shape:
+                # elastic relayout resized the mesh mid-run
+                self._async_shard_stats = np.zeros_like(delta)
+            self._async_shard_stats += delta
         # analytic per-chip frontier-exchange volume: every superstep
         # runs one horizon exchange, plus one f0 exchange per dispatch;
         # each ships one i64 per partner (len(shifts) under ppermute,
@@ -1144,6 +1156,32 @@ class IslandSimulation(Simulation):
         if not self._async:
             return None
         return dict(self._async_counters)
+
+    def async_shard_profile(self) -> dict | None:
+        """Per-shard async posture for the profiling recorder
+        (obs/prof.py): cumulative steps/yields/blocked per shard, the
+        last-fetched frontier surface, and the in-edge lookahead matrix
+        (host-cached — no device read on the tick path). None when the
+        barrier driver is configured."""
+        if not self._async:
+            return None
+        st = self._async_shard_stats
+        p = {
+            "shards": int(self.num_shards),
+            "steps": [int(x) for x in st[0]],
+            "yields": [int(x) for x in st[1]],
+            "blocked": [int(x) for x in st[2]],
+        }
+        if self._async_frontier is not None:
+            p["frontier_ns"] = [int(x) for x in self._async_frontier]
+        la = self._look_in_cache
+        if la is None:
+            la = self._look_in_cache = [
+                [int(x) for x in row]
+                for row in np.asarray(jax.device_get(self._async_look_in))
+            ]
+        p["lookahead_in"] = la
+        return p
 
     def reset_frontier_spread(self) -> None:
         """Zero the max-observed frontier-spread gauge — phase-windowed
@@ -1593,12 +1631,17 @@ class IslandSimulation(Simulation):
         def fetch(out):
             if self._async:
                 st, mn, press, occ, w, fr, sp, stp, yld, blk = out
+                stp_v = np.asarray(jax.device_get(stp)).reshape(-1)
+                yld_v = np.asarray(jax.device_get(yld)).reshape(-1)
+                blk_v = np.asarray(jax.device_get(blk)).reshape(-1)
                 extra = (
                     np.asarray(jax.device_get(fr)).reshape(-1),
                     int(np.max(np.asarray(jax.device_get(sp)))),
-                    int(np.sum(np.asarray(jax.device_get(stp)))),
-                    int(np.sum(np.asarray(jax.device_get(yld)))),
-                    int(np.sum(np.asarray(jax.device_get(blk)))),
+                    int(stp_v.sum()),
+                    int(yld_v.sum()),
+                    int(blk_v.sum()),
+                    # per-shard [3, S] deltas for the profiling plane
+                    np.stack([stp_v, yld_v, blk_v]).astype(np.int64),
                 )
             else:
                 st, mn, press, occ, w = out
@@ -1698,7 +1741,7 @@ class IslandSimulation(Simulation):
                     if ainfo is not None:
                         self._note_async_dispatch(ainfo, w)
                     if obs is not None:
-                        obs.round_done(self)
+                        obs.round_done(self, mn)
                     self._audit_tick(mn)
                     # gearing: a red-zone early exit upshifts (one pool
                     # re-sort) before the spill tier would pay host drain
@@ -2076,7 +2119,7 @@ class IslandSimulation(Simulation):
             min_next = mn_i
             windows += 1
             if obs is not None:
-                obs.round_done(self)
+                obs.round_done(self, min_next)
             self._audit_tick(min_next)
             if self._fault_plane_active():
                 self._handoff_tick(min_next)

@@ -156,6 +156,15 @@ class ServeClient:
             raise ServeClientError(f"/metricz returned {status}: {doc}")
         return doc
 
+    def timez(self) -> dict:
+        """The daemon's live profile document (obs/prof.py): interval
+        ring + mergeable latency histograms. The federation router
+        merges these across peers on its own /timez."""
+        status, doc = self.request("GET", "/timez")
+        if status != 200:
+            raise ServeClientError(f"/timez returned {status}: {doc}")
+        return doc
+
     def submit(self, sweep_doc: dict, tenant: str = "default",
                backend_faults: list | None = None,
                origin: str | None = None) -> dict:

@@ -157,6 +157,14 @@ class ShadowDaemon:
         self._avg_sweep_wall_s = _DEFAULT_SWEEP_WALL_S
         self._server: socketserver.ThreadingMixIn | None = None
         self._started = threading.Event()
+        # shadowscope profiling plane (obs/prof.py): request-latency
+        # histograms + a per-dispatch-slice interval ring ticked from
+        # the running fleet, served live at GET /timez and rolled up
+        # across peers by the federation router. Guarded by self._lock
+        # (the recorder itself is not thread-safe).
+        from shadow_tpu.obs import prof as prof_mod
+
+        self.prof = prof_mod.ProfRecorder()
 
     # ------------------------------------------------------------------
     # admission (HTTP thread)
@@ -421,8 +429,36 @@ class ShadowDaemon:
                 "async": dict(self._last_async),
                 "mesh": dict(self._last_mesh),
                 "steal": dict(self._last_steal),
+                "prof": self._prof_posture(),
                 "retry_after_s": self.retry_after_s(),
             }
+
+    def _prof_posture(self) -> dict:
+        """Critical-path posture for /healthz (caller holds the lock):
+        which shard the running fleet's wall is attributable to and the
+        blocked fraction of all shard-supersteps; -1/0.0 before any
+        per-shard interval lands (barrier fleets, idle daemon)."""
+        from shadow_tpu.obs import prof as prof_mod
+
+        cp = prof_mod.critical_path(self.prof.to_doc())
+        if cp is None:
+            return {"critical_shard": -1, "blocked_frac": 0.0}
+        return {
+            "critical_shard": int(cp["critical_shard"]),
+            "blocked_frac": round(float(cp["blocked_frac"]), 4),
+            "wall_frac": round(float(cp["wall_frac"]), 4),
+        }
+
+    def timez_doc(self) -> dict:
+        """The live profile document (GET /timez): the interval ring +
+        histograms as a schema-versioned shadow_tpu.profile doc — the
+        unit the federation router merges across peers."""
+        with self._lock:
+            return self.prof.to_doc(meta={"daemon": "shadow_tpu serve"})
+
+    def _observe_request(self, dt_s: float) -> None:
+        with self._lock:
+            self.prof.observe_wall("serve_request_ns", dt_s)
 
     def sweep_info(self, sid: str) -> dict | None:
         with self._lock:
@@ -484,16 +520,17 @@ class ShadowDaemon:
                                   int(v == "load"))
                 else:
                     reg.counter_set(f"balance.{k}", int(v))
+            # profiling plane (schema v18): latency percentiles +
+            # critical-path posture folded from the live recorder
+            obs_metrics.snapshot_prof(self.prof, reg)
         return reg.to_doc(meta={"daemon": "shadow_tpu serve"})
 
     def _dump_metrics(self) -> None:
+        from shadow_tpu.obs.metrics import dump_json_atomic
+
         doc = self.metrics_doc()
         path = os.path.join(self.opts.state_dir, METRICS_NAME)
-        tmp = f"{path}.tmp.{os.getpid()}"
-        with open(tmp, "w") as f:
-            json.dump(doc, f, indent=1)
-            f.write("\n")
-        os.replace(tmp, path)
+        dump_json_atomic(path, doc)
 
     # ------------------------------------------------------------------
     # the worker (main thread): one sweep at a time, drained on SIGTERM
@@ -589,6 +626,10 @@ class ShadowDaemon:
             self._last_async = fleet.async_posture()
             self._last_mesh = fleet.mesh_posture()
             self._last_steal = fleet.sched.steal_export()
+            # one profiling-plane interval per dispatch slice: deltas of
+            # the fleet's committed events + async counters, with the
+            # per-(shard) frontier surface when the fleet runs async
+            self.prof.tick_from(fleet)
             # journal each new batch of ladder rungs: a post-mortem can
             # see WHEN the sweep started degrading even if we die next
             steps = int(pst.get("ladder_steps", 0))
@@ -773,10 +814,19 @@ class ShadowDaemon:
                 self.wfile.write(blob)
 
             def do_GET(self):
+                t0 = time.perf_counter()
+                try:
+                    self._route_get()
+                finally:
+                    daemon._observe_request(time.perf_counter() - t0)
+
+            def _route_get(self):
                 if self.path == "/healthz":
                     return self._reply(200, daemon.health())
                 if self.path == "/metricz":
                     return self._reply(200, daemon.metrics_doc())
+                if self.path == "/timez":
+                    return self._reply(200, daemon.timez_doc())
                 if self.path == "/v1/sweeps":
                     return self._reply(200, {"sweeps": daemon.sweep_list()})
                 if self.path == "/v1/journal":
@@ -793,6 +843,13 @@ class ShadowDaemon:
                 return self._reply(404, {"error": "unknown path"})
 
             def do_POST(self):
+                t0 = time.perf_counter()
+                try:
+                    self._route_post()
+                finally:
+                    daemon._observe_request(time.perf_counter() - t0)
+
+            def _route_post(self):
                 n = int(self.headers.get("Content-Length") or 0)
                 raw = self.rfile.read(n) if n else b"{}"
                 try:

@@ -128,14 +128,36 @@ class ShadowRouter:
         info["peer"] = peer.name
         return 200, info
 
+    def timez_doc(self) -> dict:
+        """Federation /timez: every reachable peer's profile document
+        merged into one — histograms folded exactly (int64 adds), rings
+        interleaved onto one wall clock (obs/prof.merge_profile_docs).
+        Unreachable or stale-schema peers are skipped and listed, never
+        allowed to poison the fold."""
+        from shadow_tpu.obs import prof as prof_mod
+        from shadow_tpu.serve.client import ServeClientError
+
+        docs: dict[str, dict] = {}
+        skipped: dict[str, str] = {}
+        for name, peer in sorted(self.federation.peers.items()):
+            try:
+                doc = peer.client.timez()
+                prof_mod.validate_profile_doc(doc)
+                docs[name] = doc
+            except (ServeClientError, ValueError) as e:
+                skipped[name] = str(e)
+        merged = prof_mod.merge_profile_docs(docs)
+        merged["peers_merged"] = len(docs)
+        if skipped:
+            merged["peers_skipped"] = skipped
+        return merged
+
     def _dump_metrics(self) -> None:
+        from shadow_tpu.obs.metrics import dump_json_atomic
+
         doc = self.federation.metrics_doc()
         path = os.path.join(self.opts.state_dir, ROUTER_METRICS_NAME)
-        tmp = f"{path}.tmp.{os.getpid()}"
-        with open(tmp, "w") as f:
-            json.dump(doc, f, indent=1)
-            f.write("\n")
-        os.replace(tmp, path)
+        dump_json_atomic(path, doc)
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -190,6 +212,8 @@ class ShadowRouter:
                     return self._reply(200, router.health())
                 if self.path == "/metricz":
                     return self._reply(200, router.federation.metrics_doc())
+                if self.path == "/timez":
+                    return self._reply(200, router.timez_doc())
                 if self.path == "/v1/journal":
                     return self._reply(200, router.journal_doc())
                 if self.path == "/v1/sweeps":

@@ -61,6 +61,9 @@ _BUDGET_TIER = {
     # the per-interface scheduling-plane acceptance gate (ISSUE 19):
     # same rule — compat goldens + PIFO/Eiffel parity before the tail
     "test_qdisc": 3,
+    # the profiling-plane acceptance gate (ISSUE 20): mostly pure-host
+    # units plus one tiny islands run — cheap, keep it ahead of the tail
+    "test_prof": 2,
     # the multi-chip mesh acceptance gate (ISSUE 12): same rule — its
     # shard_map cells compile more than the vmap tiers but the chain
     # matrix + relayout resume must land before the tier-4 tail

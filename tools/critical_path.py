@@ -1,0 +1,92 @@
+#!/usr/bin/env python3
+"""Critical-path attribution from a shadow_tpu.profile document.
+
+    tools/critical_path.py shadow.profile.json [--json]
+
+Names the shard the run's wall clock is attributable to: per recorded
+interval, the shard holding the minimum committed frontier is what every
+blocked neighbor is waiting on (conservative sync bounds everyone's
+horizon by that frontier plus their in-edge lookahead), so wall time of
+blocking intervals accrues to that interval's laggard. The report names
+the winning shard, the in-edge link it throttles hardest (with the baked
+lookahead bound when the profile carries the matrix), and the fraction
+of total wall / of shard-supersteps lost to blocking.
+
+Exit status: 0 with a report; 1 when the profile has no per-shard
+intervals (barrier or global-engine run — nothing to attribute);
+2 on a bad document.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="critical_path", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    p.add_argument("profile", help="shadow_tpu.profile JSON (--profile-out)")
+    p.add_argument("--json", action="store_true",
+                   help="print the attribution dict instead of prose")
+    args = p.parse_args(argv)
+
+    from shadow_tpu.obs import prof as prof_mod
+
+    try:
+        with open(args.profile) as f:
+            doc = json.load(f)
+        prof_mod.validate_profile_doc(doc)
+    except (OSError, json.JSONDecodeError, ValueError) as e:
+        print(f"error: {args.profile}: {e}", file=sys.stderr)
+        return 2
+    cp = prof_mod.critical_path(doc)
+    if cp is None:
+        print(
+            "no per-shard intervals in this profile (barrier or "
+            "global-engine run) — nothing to attribute",
+            file=sys.stderr,
+        )
+        return 1
+    if args.json:
+        print(json.dumps(cp, indent=1))
+        return 0
+    print(
+        f"critical shard: {cp['critical_shard']} of {cp['shards']} "
+        f"({cp['intervals']} intervals)"
+    )
+    print(
+        f"  attributable wall: {cp['attributed_wall_s']:.3f}s of "
+        f"{cp['wall_s']:.3f}s ({cp['wall_frac']:.0%})"
+    )
+    print(f"  blocked fraction:  {cp['blocked_frac']:.3f} "
+          f"(blocked / (blocked + supersteps + yields))")
+    link = cp.get("link")
+    if link:
+        bound = (
+            f", in-edge lookahead {link['lookahead_ns']}ns"
+            if "lookahead_ns" in link else ""
+        )
+        print(
+            f"  hottest link:      shard {link['src']} -> shard "
+            f"{link['dst']} ({link['blocked']} blocks{bound})"
+        )
+    ranked = sorted(
+        enumerate(cp["per_shard_wall_s"]), key=lambda kv: -kv[1]
+    )[:5]
+    print("  per-shard attributed wall:")
+    for s, w in ranked:
+        if w > 0:
+            print(f"    shard {s:>3}: {w:.3f}s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

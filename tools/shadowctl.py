@@ -9,6 +9,7 @@ Talks HTTP over the daemon's unix socket (docs/serving.md):
     shadowctl.py --socket DIR/route.sock status --peers a=DIR_A b=DIR_B
     shadowctl.py --socket DIR/serve.sock results SWEEP_ID [--wait SECS]
     shadowctl.py --socket DIR/serve.sock metrics
+    shadowctl.py --socket DIR/serve.sock top [--once] [--interval S]
     shadowctl.py --socket DIR/serve.sock drain
 
 Exit status: 0 ok; 2 usage / bad sweep document; 3 daemon unreachable;
@@ -46,6 +47,14 @@ def build_parser() -> argparse.ArgumentParser:
                    "serve.* + pressure.* doc; federation.* on a router)")
     sub.add_parser("drain", help="graceful drain: flush the running "
                    "fleet to its checkpoint and exit")
+    pt = sub.add_parser("top", help="live text dashboard from GET /timez "
+                        "(latency percentiles, interval throughput, "
+                        "critical-path posture); point it at a router "
+                        "socket for the fleet-merged view")
+    pt.add_argument("--once", action="store_true",
+                    help="render one frame and exit (scripts, tests)")
+    pt.add_argument("--interval", type=float, default=2.0, metavar="S",
+                    help="refresh period (default 2.0)")
     ps = sub.add_parser("submit", help="submit a sweep document")
     ps.add_argument("sweep", help="sweep YAML (base config + sweep: matrix)")
     ps.add_argument("--tenant", default="default")
@@ -64,6 +73,87 @@ def build_parser() -> argparse.ArgumentParser:
     pr.add_argument("--wait", type=float, metavar="SECS", default=None,
                     help="block until the sweep settles (max SECS)")
     return p
+
+
+def _fmt_ns(v) -> str:
+    v = int(v)
+    if v >= 1_000_000_000:
+        return f"{v / 1e9:.2f}s"
+    if v >= 1_000_000:
+        return f"{v / 1e6:.1f}ms"
+    if v >= 1_000:
+        return f"{v / 1e3:.1f}us"
+    return f"{v}ns"
+
+
+def render_top(doc: dict) -> str:
+    """One text frame of the /timez dashboard: histogram percentiles,
+    recent interval throughput, and the critical-path posture. Works on
+    a single daemon's profile document and on the router's merged one
+    (which carries `series` + `peers` instead of one ring)."""
+    from shadow_tpu.obs.hist import LogHistogram
+    from shadow_tpu.obs.prof import critical_path
+
+    lines = []
+    peers = doc.get("peers")
+    if peers:
+        up = ", ".join(
+            f"{n}({p.get('recorded', 0)}iv)" for n, p in sorted(peers.items())
+        )
+        lines.append(f"shadowscope top — {len(peers)} peer(s): {up}")
+    else:
+        lines.append(
+            f"shadowscope top — {doc.get('recorded', 0)} interval(s), "
+            f"{doc.get('dropped', 0)} dropped"
+        )
+    hists = doc.get("hists") or {}
+    if hists:
+        lines.append(
+            f"{'histogram':<22}{'count':>8}{'p50':>10}{'p90':>10}"
+            f"{'p99':>10}{'max':>10}"
+        )
+        for name in sorted(hists):
+            s = LogHistogram.from_doc(hists[name]).summary()
+            lines.append(
+                f"{name:<22}{s['count']:>8}{_fmt_ns(s['p50']):>10}"
+                f"{_fmt_ns(s['p90']):>10}{_fmt_ns(s['p99']):>10}"
+                f"{_fmt_ns(s['max']):>10}"
+            )
+    else:
+        lines.append("(no histogram samples yet)")
+    rows = doc.get("intervals") or doc.get("series") or []
+    recent = rows[-5:]
+    if recent:
+        lines.append("recent intervals:")
+        for r in recent:
+            dw = float(r.get("d_wall_s", 0.0)) or 1e-9
+            tag = f" [{r['peer']}]" if "peer" in r else ""
+            lines.append(
+                f"  +{r.get('wall_s', 0.0):>9.3f}s{tag} "
+                f"vt={_fmt_ns(r.get('vt_ns', 0))} "
+                f"ev/s={r.get('d_events', 0) / dw:,.0f} "
+                f"win={r.get('d_windows', 0)} "
+                f"blocked={r.get('d_blocked', 0)}"
+            )
+    cp = critical_path(doc)
+    if cp is not None:
+        link = cp.get("link")
+        edge = ""
+        if link:
+            edge = (
+                f", throttling shard {link['dst']} "
+                f"({link['blocked']} blocks"
+                + (f", lookahead {_fmt_ns(link['lookahead_ns'])}"
+                   if "lookahead_ns" in link else "")
+                + ")"
+            )
+        lines.append(
+            f"critical path: shard {cp['critical_shard']} holds "
+            f"{cp['wall_frac']:.0%} of wall "
+            f"({cp['attributed_wall_s']:.3f}s of {cp['wall_s']:.3f}s), "
+            f"blocked_frac={cp['blocked_frac']:.2f}{edge}"
+        )
+    return "\n".join(lines)
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -121,6 +211,17 @@ def main(argv: list[str] | None = None) -> int:
         if args.cmd == "drain":
             print(json.dumps(client.drain()))
             return 0
+        if args.cmd == "top":
+            import time as time_mod
+
+            while True:
+                frame = render_top(client.timez())
+                if args.once:
+                    print(frame)
+                    return 0
+                # clear + home, like top(1); one frame per interval
+                print("\x1b[2J\x1b[H" + frame, flush=True)
+                time_mod.sleep(args.interval)
         if args.cmd == "submit":
             import yaml
 

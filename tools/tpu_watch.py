@@ -66,6 +66,18 @@ STAGES = [
      False, 7200),
     ("qdisc_smoke", [PY, "bench.py", "--qdisc-smoke"], False, 7200),
     ("async_smoke", [PY, "bench.py", "--async-smoke"], False, 7200),
+    # shadowscope gate: profiler-on vs off bit-identical + <=3% overhead,
+    # critical-path attribution names the deliberately skewed shard,
+    # two-peer /timez merge folds exactly, strict-validated artifact
+    ("profile_smoke", [PY, "bench.py", "--profile-smoke"], False, 7200),
+    # regression diff of this pass's freshly regenerated artifacts: the
+    # async_smoke and profile_smoke stages run the SAME seeded workload,
+    # so determinism keys (events, audit chain) must match exactly and
+    # thresholded perf keys must hold (rc 1 on regression; artifacts
+    # recording ok:false or a stale schema are skipped, not failed)
+    ("perf_compare",
+     [PY, "tools/perf_compare.py", "async_smoke.metrics.json",
+      "profile_smoke.metrics.json", "--json"], False, 600),
     ("balance_smoke", [PY, "bench.py", "--balance-smoke"], False, 7200),
     ("mesh_smoke", [PY, "bench.py", "--mesh-smoke"], False, 7200),
     ("mesh_resilience_smoke",

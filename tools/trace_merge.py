@@ -1,0 +1,129 @@
+#!/usr/bin/env python3
+"""Fuse N peers' Chrome traces into one Perfetto timeline.
+
+    tools/trace_merge.py a=peer_a.trace.json b=peer_b.trace.json -o fused.json
+
+Each input is a --trace-out document (obs/trace.py); bare paths take
+their peer name from the file stem. Every peer lands on its own pid
+(named via process_name metadata) and its timestamps are shifted onto
+one clock using the per-document ``metadata.t0_unix`` anchor — the
+earliest peer defines t=0, later peers start at their real wall offset.
+Traces written before t0_unix existed (format v2) merge too, just
+without the cross-peer alignment (offset 0, noted on stderr).
+
+Exit status: 0 on success; 2 on unreadable/malformed inputs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+
+def _parse_spec(spec: str) -> tuple[str, str]:
+    if "=" in spec:
+        name, path = spec.split("=", 1)
+        return name, path
+    stem = os.path.basename(spec)
+    for suf in (".trace.json", ".json"):
+        if stem.endswith(suf):
+            stem = stem[: -len(suf)]
+            break
+    return stem, spec
+
+
+def merge_traces(docs: dict[str, dict]) -> dict:
+    """Merge named trace documents: one pid per peer (insertion order of
+    the sorted names), timestamps shifted by each document's t0_unix
+    delta from the earliest anchor. Returns the fused document."""
+    anchors = {
+        name: float((doc.get("metadata") or {}).get("t0_unix", 0.0))
+        for name, doc in docs.items()
+    }
+    known = [t for t in anchors.values() if t > 0]
+    t_base = min(known) if known else 0.0
+    events: list[dict] = []
+    for pid, name in enumerate(sorted(docs), start=1):
+        doc = docs[name]
+        t0 = anchors[name]
+        shift_us = (t0 - t_base) * 1e6 if t0 > 0 else 0.0
+        events.append({
+            "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+            "args": {"name": name},
+        })
+        for ev in doc.get("traceEvents", []):
+            if ev.get("ph") == "M" and ev.get("name") == "process_name":
+                continue  # replaced by the peer-named row above
+            out = dict(ev)
+            out["pid"] = pid
+            if "ts" in out:
+                out["ts"] = float(out["ts"]) + shift_us
+            events.append(out)
+    return {
+        "displayTimeUnit": "ms",
+        "metadata": {
+            "format": "chrome-trace-events",
+            "merged": True,
+            "peers": {
+                n: {"pid": i, "t0_unix": anchors[n],
+                    "offset_us": round((anchors[n] - t_base) * 1e6, 3)
+                    if anchors[n] > 0 else 0.0}
+                for i, n in enumerate(sorted(docs), start=1)
+            },
+            "t0_unix": t_base,
+        },
+        "traceEvents": events,
+    }
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="trace_merge", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    p.add_argument("traces", nargs="+", metavar="NAME=PATH",
+                   help="trace documents to fuse (bare PATH uses the "
+                        "file stem as the peer name)")
+    p.add_argument("-o", "--out", required=True,
+                   help="fused trace output path")
+    args = p.parse_args(argv)
+
+    docs: dict[str, dict] = {}
+    for spec in args.traces:
+        name, path = _parse_spec(spec)
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"error: {path}: {e}", file=sys.stderr)
+            return 2
+        if not isinstance(doc.get("traceEvents"), list):
+            print(f"error: {path}: not a trace document "
+                  f"(no traceEvents)", file=sys.stderr)
+            return 2
+        if not float((doc.get("metadata") or {}).get("t0_unix", 0.0)):
+            print(
+                f"note: {path} has no t0_unix anchor (pre-v3 trace); "
+                f"merged at offset 0",
+                file=sys.stderr,
+            )
+        docs[name] = doc
+    fused = merge_traces(docs)
+    from shadow_tpu.obs.metrics import dump_json_atomic
+
+    dump_json_atomic(args.out, fused, indent=None)
+    n_ev = len(fused["traceEvents"])
+    print(
+        f"merged {len(docs)} trace(s), {n_ev} events -> {args.out}",
+        file=sys.stderr,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

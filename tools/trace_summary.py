@@ -23,6 +23,7 @@ of worker-busy wall time, i.e. how many workers were effectively
 draining at once.
 
 Usage:  python tools/trace_summary.py shadow.trace.json [-n TOP] [--json]
+        [--percentiles]  (adds per-span-name p50/p90/p99 duration rows)
 """
 
 from __future__ import annotations
@@ -30,6 +31,34 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+
+
+def _pctl(sorted_us: list[float], q: int) -> float:
+    """Nearest-rank percentile over an ascending duration list (µs)."""
+    rank = max(1, min(len(sorted_us), -(-q * len(sorted_us) // 100)))
+    return sorted_us[rank - 1]
+
+
+def percentiles(doc, qs=(50, 90, 99)) -> list[dict]:
+    """Per-span-name duration percentiles (nearest-rank, in ms) from the
+    complete ("X") events — the --percentiles table: one row per span
+    name, widest p99 first."""
+    events = doc if isinstance(doc, list) else doc.get("traceEvents", [])
+    durs: dict[str, list[float]] = {}
+    for ev in events:
+        if ev.get("ph") == "X":
+            durs.setdefault(ev.get("name", "?"), []).append(
+                float(ev.get("dur", 0.0))
+            )
+    rows = []
+    for name, ds in durs.items():
+        ds.sort()
+        rows.append({
+            "name": name, "count": len(ds),
+            **{f"p{q}_ms": _pctl(ds, q) / 1e3 for q in qs},
+        })
+    rows.sort(key=lambda r: -r[f"p{qs[-1]}_ms"])
+    return rows
 
 
 def summarize(doc) -> tuple[list[dict], dict[str, int]]:
@@ -186,6 +215,9 @@ def main(argv=None) -> int:
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="machine-readable output (spans + marker tallies) "
                          "so CI can diff span stats")
+    ap.add_argument("--percentiles", action="store_true",
+                    help="add per-span-name p50/p90/p99 duration rows "
+                         "(nearest-rank over the span's samples)")
     args = ap.parse_args(argv)
     try:
         with open(args.trace) as f:
@@ -193,6 +225,7 @@ def main(argv=None) -> int:
         rows, other = summarize(doc)
         overlap = overlap_stats(doc)
         drain = drain_parallelism(doc)
+        pctl_rows = percentiles(doc) if args.percentiles else None
     except (OSError, ValueError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
@@ -206,6 +239,8 @@ def main(argv=None) -> int:
             out["overlap"] = overlap
         if drain is not None:
             out["drain_parallelism"] = drain
+        if pctl_rows is not None:
+            out["percentiles"] = pctl_rows[: args.top]
         print(json.dumps(out, indent=1))
         return 0
     if not rows:
@@ -234,6 +269,16 @@ def main(argv=None) -> int:
             f"({drain['parallelism']:.2f}x across {drain['workers']} "
             f"workers)"
         )
+    if pctl_rows:
+        pw = max(len(r["name"]) for r in pctl_rows[: args.top])
+        print(f"\n{'span':<{pw}}  {'count':>7}  {'p50 ms':>9}  "
+              f"{'p90 ms':>9}  {'p99 ms':>9}")
+        for r in pctl_rows[: args.top]:
+            print(
+                f"{r['name']:<{pw}}  {r['count']:>7}  "
+                f"{r['p50_ms']:>9.3f}  {r['p90_ms']:>9.3f}  "
+                f"{r['p99_ms']:>9.3f}"
+            )
     if other:
         marks = ", ".join(f"{k} x{v}" for k, v in sorted(other.items()))
         print(f"\nmarkers: {marks}")
